@@ -1,0 +1,25 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/pallas re-design with the capabilities of the
+reference framework (PaddlePaddle Fluid — see SURVEY.md): layer library,
+optimizers with in-step regularization/clipping, functional state,
+executor-style training, mesh-sharded data/tensor/sequence parallelism,
+sparse embeddings, checkpointing, metrics, profiling, inference export.
+"""
+
+from . import clip, core, framework, initializer, layers, lr_scheduler
+from . import optimizer, parallel, regularizer
+from .core import CPUPlace, CUDAPlace, Place, TPUPlace, default_place
+from .executor import Executor, Scope, Trainer
+from .framework import (
+    LayerHelper,
+    ParamAttr,
+    Program,
+    build,
+    create_parameter,
+    create_variable,
+    name_scope,
+)
+from .parallel import DistStrategy, ShardingRules, make_mesh
+
+__version__ = "0.1.0"

@@ -1,0 +1,67 @@
+"""Gradient clipping.
+
+Analog of python/paddle/fluid/clip.py (GradientClipByValue:~,
+GradientClipByNorm, GradientClipByGlobalNorm). Each is a pure transform
+over the gradient pytree applied inside the jitted optimizer update.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+class GradientClipBase:
+    def __call__(self, grads: Dict[str, jax.Array], params: Dict[str, jax.Array]):
+        raise NotImplementedError
+
+
+class GradientClipByValue(GradientClipBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, grads, params):
+        return {k: jnp.clip(g, self.min, self.max) for k, g in grads.items()}
+
+
+class GradientClipByNorm(GradientClipBase):
+    """Per-tensor L2-norm clip (clip_by_norm_op analog)."""
+
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads, params):
+        out = {}
+        for k, g in grads.items():
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+            out[k] = (g.astype(jnp.float32) * scale).astype(g.dtype)
+        return out
+
+
+class GradientClipByGlobalNorm(GradientClipBase):
+    """Global-norm clip across all grads (clip.py GradientClipByGlobalNorm)."""
+
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads, params):
+        gnorm = global_norm(list(grads.values()))
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return {k: (g.astype(jnp.float32) * scale).astype(g.dtype) for k, g in grads.items()}
+
+
+def global_norm(tensors):
+    return jnp.sqrt(sum(jnp.sum(jnp.square(t.astype(jnp.float32))) for t in tensors))
+
+
+class ErrorClipByValue:
+    """API-parity stub: the reference clips *activation gradients* flowing
+    through named vars (clip.py ErrorClipByValue). With jax autodiff, use
+    ``paddle_tpu.layers.clip``/custom_vjp at the point of interest."""
+
+    def __init__(self, max, min=None):
+        self.max, self.min = max, min

@@ -1,0 +1,65 @@
+"""Global config / flag system.
+
+Analog of the reference's three-tier flag system (SURVEY §5): gflags
+read from env at import (python/paddle/fluid/__init__.py:112-133),
+strategy objects, and build options. Here: a typed flag registry with
+env-var override (``PDTPU_<NAME>``), plus dataclass strategy objects
+living in paddle_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict
+
+
+@dataclasses.dataclass
+class _Flag:
+    name: str
+    default: Any
+    parser: Callable[[str], Any]
+    help: str
+    value: Any = None
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def _parse_bool(s: str) -> bool:
+    return s.lower() in ("1", "true", "yes", "on")
+
+
+def define_flag(name: str, default: Any, help: str = "") -> None:
+    if isinstance(default, bool):
+        parser: Callable[[str], Any] = _parse_bool
+    elif isinstance(default, int):
+        parser = int
+    elif isinstance(default, float):
+        parser = float
+    else:
+        parser = str
+    env = os.environ.get(f"PDTPU_{name.upper()}")
+    value = parser(env) if env is not None else default
+    _REGISTRY[name] = _Flag(name, default, parser, help, value)
+
+
+def get_flag(name: str) -> Any:
+    return _REGISTRY[name].value
+
+
+def set_flag(name: str, value: Any) -> None:
+    _REGISTRY[name].value = value
+
+
+def flags() -> Dict[str, Any]:
+    return {k: f.value for k, f in _REGISTRY.items()}
+
+
+# Core flags — counterparts of the whitelisted gflags the reference
+# re-reads from env (fluid/__init__.py:112-133).
+define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf (FLAGS_check_nan_inf analog)")
+define_flag("benchmark", False, "Synchronize after each step and log timings (FLAGS_benchmark)")
+define_flag("deterministic", False, "Force deterministic reductions (FLAGS_cpu_deterministic)")
+define_flag("default_compute_dtype", "float32", "Compute dtype for layers ('bfloat16' on TPU for MXU)")
+define_flag("seed", 0, "Global random seed (startup-program seed analog)")

@@ -1,0 +1,56 @@
+"""Dtype table.
+
+Analog of the reference's VarType dtype enum (framework.proto:105) and
+float16 support (platform/float16.h). On TPU the preferred compute dtype
+is bfloat16 (MXU native); float16 is kept for API parity.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax.numpy as jnp
+import numpy as np
+
+# String name -> jnp dtype. Mirrors fluid's convert_np_dtype_to_dtype_.
+_STR_TO_DTYPE = {
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "bool": jnp.bool_,
+}
+
+DTypeLike = Union[str, np.dtype, type]
+
+
+def convert_dtype(dtype: DTypeLike):
+    """Normalize a user dtype spec ('float32', np.float32, jnp.float32)."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR_TO_DTYPE:
+            raise ValueError(
+                f"Unsupported dtype {dtype!r}; expected one of {sorted(_STR_TO_DTYPE)}"
+            )
+        return jnp.dtype(_STR_TO_DTYPE[dtype])
+    return jnp.dtype(dtype)
+
+
+def is_floating(dtype: DTypeLike) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype: DTypeLike) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.integer)
+
+
+# Default dtypes. The reference defaults to float32 everywhere; on TPU we
+# keep float32 params with optional bfloat16 compute (see core.config).
+DEFAULT_DTYPE = jnp.float32
+DEFAULT_INT_DTYPE = jnp.int32

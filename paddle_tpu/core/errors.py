@@ -1,0 +1,46 @@
+"""Enforce-style error checking.
+
+TPU-native analog of the reference's ``PADDLE_ENFORCE*`` macro family
+(reference: paddle/fluid/platform/enforce.h). Instead of C++ macros with
+captured backtraces we raise rich Python exceptions; JAX tracebacks carry
+the stack.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NoReturn
+
+
+class EnforceError(RuntimeError):
+    """Framework invariant violation (PADDLE_ENFORCE analog)."""
+
+
+class NotFoundError(EnforceError, KeyError):
+    """A named variable/parameter was not found in the scope."""
+
+
+class ShapeError(EnforceError, ValueError):
+    """Shape mismatch between declared and actual tensors."""
+
+
+def enforce(cond: Any, msg: str = "", *args: Any) -> None:
+    """Raise :class:`EnforceError` unless ``cond`` is truthy.
+
+    Mirrors PADDLE_ENFORCE(cond, fmt, ...) — enforce.h.
+    """
+    if not cond:
+        raise EnforceError(msg % args if args else msg)
+
+
+def enforce_eq(a: Any, b: Any, msg: str = "") -> None:
+    if a != b:
+        raise EnforceError(f"Enforce failed: {a!r} != {b!r}. {msg}")
+
+
+def enforce_gt(a: Any, b: Any, msg: str = "") -> None:
+    if not a > b:
+        raise EnforceError(f"Enforce failed: {a!r} <= {b!r}. {msg}")
+
+
+def not_found(msg: str) -> NoReturn:
+    raise NotFoundError(msg)

@@ -1,0 +1,74 @@
+"""Place / device abstraction.
+
+TPU-native analog of the reference's Place variant (platform/place.h:
+CPUPlace/CUDAPlace/CUDAPinnedPlace) and DeviceContextPool
+(platform/device_context.h:264). In JAX, devices are first-class and
+streams/handles are managed by the runtime, so a Place reduces to a
+device handle (or a set of them, for SPMD execution over a mesh — see
+paddle_tpu.parallel.mesh for the multi-device story that replaces the
+reference's ParallelExecutor places list).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class Place:
+    """Device identity. platform/place.h analog."""
+
+    platform: str  # 'tpu' | 'cpu' | 'gpu'
+    device_id: int = 0
+
+    def device(self) -> jax.Device:
+        devs = [d for d in jax.devices() if _platform_of(d) == self.platform]
+        if not devs:
+            # Fall back to the default backend (e.g. tests forcing cpu).
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+    def __repr__(self) -> str:  # mirrors e.g. "CUDAPlace(0)"
+        return f"{self.platform.upper()}Place({self.device_id})"
+
+
+def _platform_of(d: jax.Device) -> str:
+    p = d.platform
+    # The axon transport exposes TPUs under an experimental platform name.
+    if "tpu" in str(getattr(d, "device_kind", "")).lower():
+        return "tpu"
+    return p
+
+
+def CPUPlace(device_id: int = 0) -> Place:
+    return Place("cpu", device_id)
+
+
+def TPUPlace(device_id: int = 0) -> Place:
+    return Place("tpu", device_id)
+
+
+def CUDAPlace(device_id: int = 0) -> Place:  # API parity; resolves to gpu
+    return Place("gpu", device_id)
+
+
+def default_place() -> Place:
+    """Best available place: TPU > GPU > CPU (InitDevices analog)."""
+    d = jax.devices()[0]
+    return Place(_platform_of(d), 0)
+
+
+def available_places(platform: Optional[str] = None) -> List[Place]:
+    out = []
+    for i, d in enumerate(jax.devices()):
+        p = _platform_of(d)
+        if platform is None or p == platform:
+            out.append(Place(p, i))
+    return out
+
+
+def device_count() -> int:
+    return jax.device_count()

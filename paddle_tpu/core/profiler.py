@@ -1,0 +1,88 @@
+"""Profiling spans + aggregate table.
+
+Analog of the reference's host profiler (platform/profiler.h:27/73/127:
+RecordEvent RAII ranges, EnableProfiler/DisableProfiler with a sorted
+aggregate table) and CUPTI device tracer (device_tracer.h:49). Device
+timelines come from ``jax.profiler`` (xplane/perfetto — tools/timeline.py
+analog is ``start_trace`` below); the host-side RecordEvent span API and
+the calls/total/min/max/ave table are reimplemented here.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, List, Optional
+
+import jax
+
+_enabled = False
+_events: Dict[str, List[float]] = defaultdict(list)
+_trace_dir: Optional[str] = None
+
+
+@contextlib.contextmanager
+def record_event(name: str) -> Iterator[None]:
+    """RAII-style span (RecordEvent, profiler.h:73). Also emits a JAX
+    named trace annotation so spans show up in device traces."""
+    if not _enabled:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+        return
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    _events[name].append((time.perf_counter() - t0) * 1e3)  # ms
+
+
+def enable_profiler(trace_dir: Optional[str] = None) -> None:
+    """EnableProfiler analog; optionally also starts a jax device trace."""
+    global _enabled, _trace_dir
+    _enabled = True
+    _events.clear()
+    _trace_dir = trace_dir
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
+
+
+def disable_profiler(sorted_key: str = "total", print_table: bool = True) -> List[dict]:
+    """DisableProfiler analog: stop tracing, return + print aggregate rows."""
+    global _enabled
+    _enabled = False
+    if _trace_dir:
+        jax.profiler.stop_trace()
+    rows = []
+    for name, samples in _events.items():
+        rows.append(
+            dict(
+                name=name,
+                calls=len(samples),
+                total=sum(samples),
+                min=min(samples),
+                max=max(samples),
+                ave=sum(samples) / len(samples),
+            )
+        )
+    key = sorted_key if sorted_key in ("total", "calls", "min", "max", "ave") else "total"
+    rows.sort(key=lambda r: r[key], reverse=True)
+    if print_table and rows:
+        hdr = f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Min':>10}{'Max':>10}{'Ave':>10}"
+        print(hdr)
+        print("-" * len(hdr))
+        for r in rows:
+            print(
+                f"{r['name']:<40}{r['calls']:>8}{r['total']:>12.3f}"
+                f"{r['min']:>10.3f}{r['max']:>10.3f}{r['ave']:>10.3f}"
+            )
+    return rows
+
+
+@contextlib.contextmanager
+def profiler(trace_dir: Optional[str] = None, sorted_key: str = "total") -> Iterator[None]:
+    """``fluid.profiler.profiler`` context-manager analog (profiler.py:221)."""
+    enable_profiler(trace_dir)
+    try:
+        yield
+    finally:
+        disable_profiler(sorted_key)

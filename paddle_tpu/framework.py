@@ -1,0 +1,395 @@
+"""Framework core: parameter scope, build context, Program.
+
+This is the TPU-native redesign of the reference's central machinery:
+
+- Reference (SURVEY §1 L1/L4): a protobuf ``ProgramDesc`` built by Python
+  layer calls via ``LayerHelper.append_op`` (framework.py:1199), holding
+  ``VarDesc``/``OpDesc``; parameters live in a C++ ``Scope``
+  (scope.h:41) keyed by name; an Executor interprets the program.
+
+- Here: a *function* is the program. Layer calls inside it request
+  parameters by stable unique names from a build-context scope
+  (:class:`BuildContext`); ``Program.init`` traces the function once to
+  materialize the parameter pytree (startup-program analog), and
+  ``Program.apply`` traces it for execution under ``jax.jit`` — the
+  jaxpr is the ProgramDesc analog (see :meth:`Program.desc`).
+
+Parameters are a flat ``{name: jax.Array}`` dict — the Scope — so the
+reference's name-keyed variable semantics (save/load by name, per-param
+attributes, selective trainability) carry over directly, while the whole
+thing stays a pytree that jax.grad / pjit understand.
+
+State variables (batch-norm moving stats etc., the reference's
+non-trainable persistable vars) live in a separate collection and are
+threaded functionally: ``apply`` returns ``(outputs, new_state)``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import inspect
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .core import unique_name as _unique_name
+from .core.dtypes import DEFAULT_DTYPE, convert_dtype
+from .core.errors import EnforceError, NotFoundError, enforce
+
+Params = Dict[str, jax.Array]
+State = Dict[str, jax.Array]
+
+
+# --------------------------------------------------------------------------
+# ParamAttr — per-parameter attributes (param_attr.py analog)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ParamAttr:
+    """Parameter attributes (python/paddle/fluid/param_attr.py analog).
+
+    ``regularizer`` is an object with ``apply(param, grad) -> grad`` (see
+    paddle_tpu.regularizer); ``learning_rate`` is a per-param LR multiplier;
+    ``trainable=False`` freezes the parameter (stop_gradient analog).
+    """
+
+    name: Optional[str] = None
+    initializer: Optional[Any] = None
+    learning_rate: float = 1.0
+    regularizer: Optional[Any] = None
+    trainable: bool = True
+
+    @staticmethod
+    def to_attr(attr: Any) -> "ParamAttr":
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return ParamAttr(trainable=False)
+        raise ValueError(f"Cannot interpret param_attr: {attr!r}")
+
+
+@dataclasses.dataclass
+class ParamInfo:
+    """Static metadata recorded at init for each parameter."""
+
+    shape: Tuple[int, ...]
+    dtype: Any
+    trainable: bool = True
+    learning_rate: float = 1.0
+    regularizer: Optional[Any] = None
+    is_distributed: bool = False  # sharded-embedding marker (distributed lookup table analog)
+
+
+# --------------------------------------------------------------------------
+# BuildContext — the live scope during a trace
+# --------------------------------------------------------------------------
+
+
+class BuildContext:
+    """Per-trace context: parameter scope + name generator + RNG + mode.
+
+    Mode 'init' creates parameters (startup program analog); mode 'apply'
+    fetches them. Name generation is context-local so init/apply traces
+    agree (the determinism requirement Program construction has in the
+    reference too).
+    """
+
+    def __init__(
+        self,
+        mode: str,
+        params: Params,
+        state: State,
+        rng: Optional[jax.Array],
+        training: bool,
+        param_info: Dict[str, ParamInfo],
+    ):
+        assert mode in ("init", "apply")
+        self.mode = mode
+        self.params = params
+        self.state = state
+        self.new_state: State = {}
+        self.rng = rng
+        self._rng_count = 0
+        self.training = training
+        self.param_info = param_info
+        self.namer = _unique_name.UniqueNameGenerator()
+        self.name_stack: List[str] = []
+
+    # -- naming ------------------------------------------------------------
+    def unique_name(self, key: str) -> str:
+        return self.namer(key)
+
+    def full_name(self, suffix: str) -> str:
+        return "/".join(self.name_stack + [suffix]) if self.name_stack else suffix
+
+    # -- rng ---------------------------------------------------------------
+    def next_rng_key(self) -> jax.Array:
+        enforce(
+            self.rng is not None,
+            "This program needs an RNG (dropout/random op) but none was passed; "
+            "call apply(..., rng=key).",
+        )
+        self._rng_count += 1
+        return jax.random.fold_in(self.rng, self._rng_count)
+
+    def param_rng_key(self, name: str) -> jax.Array:
+        # Deterministic per-name key: stable under call-order changes of
+        # unrelated layers, mirrors per-var initializer seeds in the
+        # reference's startup program (initializer.py).
+        h = int.from_bytes(hashlib.sha256(name.encode()).digest()[:4], "little")
+        return jax.random.fold_in(self.rng, h)
+
+
+_tls = threading.local()
+
+
+def _ctx() -> BuildContext:
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None:
+        raise EnforceError(
+            "No build context active: layer functions must run inside "
+            "Program.init/apply (pt.build(fn)) — the program_guard analog."
+        )
+    return ctx
+
+
+def current_context() -> Optional[BuildContext]:
+    return getattr(_tls, "ctx", None)
+
+
+@contextlib.contextmanager
+def _use_ctx(ctx: BuildContext):
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.ctx = prev
+
+
+@contextlib.contextmanager
+def name_scope(name: str):
+    """Hierarchical naming scope (fluid.name_scope analog)."""
+    ctx = _ctx()
+    ctx.name_stack.append(name)
+    try:
+        yield
+    finally:
+        ctx.name_stack.pop()
+
+
+def in_training() -> bool:
+    ctx = current_context()
+    return bool(ctx and ctx.training)
+
+
+def next_rng_key() -> jax.Array:
+    return _ctx().next_rng_key()
+
+
+# --------------------------------------------------------------------------
+# Parameter / variable creation — the LayerHelper primitives
+# --------------------------------------------------------------------------
+
+
+def create_parameter(
+    shape,
+    dtype=None,
+    name: Optional[str] = None,
+    attr: Any = None,
+    initializer: Optional[Any] = None,
+    is_distributed: bool = False,
+) -> jax.Array:
+    """Create-or-fetch a named parameter (LayerHelper.create_parameter
+    analog, layer_helper.py). In init mode runs the initializer; in apply
+    mode fetches from the scope."""
+    from . import initializer as _init_mod  # local import to avoid cycle
+
+    ctx = _ctx()
+    attr = ParamAttr.to_attr(attr)
+    shape = tuple(int(s) for s in shape)
+    dtype = convert_dtype(dtype) if dtype is not None else DEFAULT_DTYPE
+    full = attr.name or ctx.full_name(name or "param")
+
+    if ctx.mode == "init":
+        if full not in ctx.params:
+            init_fn = attr.initializer or initializer
+            if init_fn is None:
+                init_fn = _init_mod.Xavier()
+            ctx.params[full] = init_fn(ctx.param_rng_key(full), shape, dtype)
+            ctx.param_info[full] = ParamInfo(
+                shape=shape,
+                dtype=dtype,
+                trainable=attr.trainable,
+                learning_rate=attr.learning_rate,
+                regularizer=attr.regularizer,
+                is_distributed=is_distributed,
+            )
+    if full not in ctx.params:
+        raise NotFoundError(
+            f"Parameter {full!r} not found in scope (have: {sorted(ctx.params)[:20]}...)"
+        )
+    p = ctx.params[full]
+    info = ctx.param_info.get(full)
+    if info is not None and not info.trainable:
+        p = jax.lax.stop_gradient(p)
+    return p
+
+
+def create_variable(
+    shape,
+    dtype=None,
+    name: Optional[str] = None,
+    initializer: Optional[Any] = None,
+) -> jax.Array:
+    """Create-or-fetch non-trainable persistable state (e.g. BN moving
+    mean — the reference's persistable non-parameter vars)."""
+    from . import initializer as _init_mod
+
+    ctx = _ctx()
+    shape = tuple(int(s) for s in shape)
+    dtype = convert_dtype(dtype) if dtype is not None else DEFAULT_DTYPE
+    full = ctx.full_name(name or "var")
+    if ctx.mode == "init":
+        if full not in ctx.state:
+            init_fn = initializer or _init_mod.Constant(0.0)
+            ctx.state[full] = init_fn(ctx.param_rng_key(full), shape, dtype)
+    if full in ctx.new_state:
+        return ctx.new_state[full]
+    if full not in ctx.state:
+        raise NotFoundError(f"State variable {full!r} not found in scope.")
+    return ctx.state[full]
+
+
+def assign_variable(name_suffix_or_full: str, value: jax.Array, full: bool = False) -> None:
+    """Functional write to a state variable; new value is returned from
+    apply() as part of new_state."""
+    ctx = _ctx()
+    full_name = name_suffix_or_full if full else ctx.full_name(name_suffix_or_full)
+    ctx.new_state[full_name] = value
+
+
+class LayerHelper:
+    """Names a layer instance and scopes its parameters.
+
+    Analog of python/paddle/fluid/layer_helper.py: each call site gets a
+    unique instance name ("fc_0"); parameters created under it are
+    "fc_0/w" etc.
+    """
+
+    def __init__(self, layer_type: str, name: Optional[str] = None):
+        ctx = _ctx()
+        self.name = name or ctx.unique_name(layer_type)
+
+    def scope(self):
+        return name_scope(self.name)
+
+    def create_parameter(self, suffix: str, shape, dtype=None, attr=None, initializer=None,
+                         is_distributed: bool = False) -> jax.Array:
+        with self.scope():
+            return create_parameter(
+                shape, dtype=dtype, name=suffix, attr=attr, initializer=initializer,
+                is_distributed=is_distributed,
+            )
+
+    def create_variable(self, suffix: str, shape, dtype=None, initializer=None) -> jax.Array:
+        with self.scope():
+            return create_variable(shape, dtype=dtype, name=suffix, initializer=initializer)
+
+    def assign_variable(self, suffix: str, value: jax.Array) -> None:
+        with self.scope():
+            assign_variable(suffix, value)
+
+
+# --------------------------------------------------------------------------
+# Program — build/init/apply
+# --------------------------------------------------------------------------
+
+
+class Program:
+    """A traced program: the ProgramDesc analog (framework.py:1404).
+
+    ``fn`` is a pure-Python function of array inputs using
+    paddle_tpu.layers ops; tracing it under init/apply materializes /
+    consumes the parameter scope. ``param_info`` (populated by init)
+    carries per-parameter attrs the optimizer consults — the OpRole /
+    param-attr metadata of the reference.
+    """
+
+    def __init__(self, fn: Callable, name: Optional[str] = None):
+        self.fn = fn
+        self.name = name or getattr(fn, "__name__", "program")
+        self.param_info: Dict[str, ParamInfo] = {}
+
+    # ------------------------------------------------------------------
+    def init(self, rng: jax.Array, *args, **kwargs) -> Tuple[Params, State]:
+        """Run the startup-program analog: trace fn, create params/state.
+
+        ``args``/``kwargs`` are example inputs (concrete or
+        jax.ShapeDtypeStruct)."""
+        params: Params = {}
+        state: State = {}
+        self.param_info = {}
+        ctx = BuildContext("init", params, state, rng, training=False,
+                          param_info=self.param_info)
+
+        def _run(*a, **kw):
+            with _use_ctx(ctx):
+                self.fn(*a, **kw)
+            return 0
+
+        args = tuple(_concretize(a) for a in args)
+        kwargs = {k: _concretize(v) for k, v in kwargs.items()}
+        _run(*args, **kwargs)
+        return params, state
+
+    # ------------------------------------------------------------------
+    def apply(
+        self,
+        params: Params,
+        state: Optional[State],
+        *args,
+        training: bool = False,
+        rng: Optional[jax.Array] = None,
+        **kwargs,
+    ) -> Tuple[Any, State]:
+        """Execute the program functionally. Returns (outputs, new_state)."""
+        ctx = BuildContext(
+            "apply", params, state or {}, rng, training, dict(self.param_info)
+        )
+        with _use_ctx(ctx):
+            out = self.fn(*args, **kwargs)
+        new_state = dict(ctx.state)
+        new_state.update(ctx.new_state)
+        return out, new_state
+
+    # ------------------------------------------------------------------
+    def desc(self, params: Params, state: State, *args, **kwargs):
+        """The jaxpr of this program — the ProgramDesc/debugger analog."""
+        def f(p, s, *a, **kw):
+            return self.apply(p, s, *a, **kw)
+
+        return jax.make_jaxpr(f)(params, state, *args, **kwargs)
+
+    def arg_names(self) -> List[str]:
+        return list(inspect.signature(self.fn).parameters)
+
+
+def _concretize(x):
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return jnp.zeros(x.shape, x.dtype)
+    return x
+
+
+def build(fn: Callable, name: Optional[str] = None) -> Program:
+    """Wrap a layer-composition function into a Program."""
+    return Program(fn, name=name)

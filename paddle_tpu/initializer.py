@@ -1,0 +1,139 @@
+"""Parameter initializers.
+
+Analog of python/paddle/fluid/initializer.py (Constant/Uniform/Normal/
+TruncatedNormal/Xavier/MSRA/Bilinear/NumpyArray). In the reference each
+initializer appends an op to the startup program; here each is a
+callable ``(key, shape, dtype) -> jax.Array`` run during Program.init.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fan_in_out(shape: Sequence[int]):
+    # Matches the reference's fan computation (initializer.py): for conv
+    # filters [out_c, in_c, k...] receptive field multiplies in.
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = shape[1] * receptive if len(shape) > 2 else shape[0]
+    fan_out = shape[0] * receptive if len(shape) > 2 else shape[1]
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, key: jax.Array, shape, dtype) -> jax.Array:
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype=jnp.float32,
+                                  minval=self.low, maxval=self.high).astype(dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0):
+        self.loc, self.scale = loc, scale
+
+    def __call__(self, key, shape, dtype):
+        return (self.loc + self.scale * jax.random.normal(key, shape, dtype=jnp.float32)).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, loc: float = 0.0, scale: float = 1.0):
+        self.loc, self.scale = loc, scale
+
+    def __call__(self, key, shape, dtype):
+        x = jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype=jnp.float32)
+        return (self.loc + self.scale * x).astype(dtype)
+
+
+class Xavier(Initializer):
+    """Glorot init (initializer.py XavierInitializer)."""
+
+    def __init__(self, uniform: bool = True, fan_in: Optional[int] = None,
+                 fan_out: Optional[int] = None):
+        self.uniform, self.fan_in, self.fan_out = uniform, fan_in, fan_out
+
+    def __call__(self, key, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            return Uniform(-limit, limit)(key, shape, dtype)
+        std = math.sqrt(2.0 / (fi + fo))
+        return Normal(0.0, std)(key, shape, dtype)
+
+
+class MSRA(Initializer):
+    """He/Kaiming init (initializer.py MSRAInitializer)."""
+
+    def __init__(self, uniform: bool = True, fan_in: Optional[int] = None):
+        self.uniform, self.fan_in = uniform, fan_in
+
+    def __call__(self, key, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in or fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            return Uniform(-limit, limit)(key, shape, dtype)
+        return Normal(0.0, math.sqrt(2.0 / fi))(key, shape, dtype)
+
+
+class Bilinear(Initializer):
+    """Bilinear upsample filter for conv_transpose (initializer.py
+    BilinearInitializer)."""
+
+    def __call__(self, key, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D filter shape")
+        weight = np.zeros(shape, dtype=np.float32)
+        kh, kw = shape[2], shape[3]
+        f_h, f_w = math.ceil(kh / 2.0), math.ceil(kw / 2.0)
+        c_h, c_w = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h), (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        for i in range(kh):
+            for j in range(kw):
+                v = (1 - abs(i / f_h - c_h)) * (1 - abs(j / f_w - c_w))
+                weight[:, :, i, j] = v
+        return jnp.asarray(weight, dtype=dtype)
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value: np.ndarray):
+        self.value = np.asarray(value)
+
+    def __call__(self, key, shape, dtype):
+        if tuple(self.value.shape) != tuple(shape):
+            raise ValueError(f"NumpyArrayInitializer shape {self.value.shape} != {shape}")
+        return jnp.asarray(self.value, dtype=dtype)
+
+
+# fluid-style aliases
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+XavierInitializer = Xavier
+MSRAInitializer = MSRA
+BilinearInitializer = Bilinear

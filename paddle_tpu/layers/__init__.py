@@ -1,0 +1,6 @@
+"""Layer library — the ``fluid.layers`` surface (python/paddle/fluid/layers/)."""
+
+from . import nn, ops, tensor
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403

@@ -1,0 +1,899 @@
+"""Neural-network layers.
+
+Analog of python/paddle/fluid/layers/nn.py (134 layer functions: fc:167,
+embedding:276, conv2d, batch_norm, layer_norm, softmax_with_cross_entropy,
+…). Each function mirrors the reference's signature/semantics but lowers
+to jax.numpy/lax so XLA tiles matmuls/convs onto the MXU and fuses the
+elementwise epilogues (act=..., bias) that the reference fused by hand.
+
+Parameter management goes through framework.LayerHelper — the same
+create-or-fetch-by-unique-name contract as the reference's LayerHelper
+(layer_helper.py), so weights are name-addressable for save/load and
+sharding rules.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import enforce
+from ..framework import LayerHelper, ParamAttr, in_training, next_rng_key
+from .. import initializer as init
+from .ops import apply_activation
+
+Int2 = Union[int, Sequence[int]]
+
+
+def _pair(v: Int2) -> tuple:
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v, v)
+
+
+# ---------------------------------------------------------------------------
+# fc / embedding / matmul
+# ---------------------------------------------------------------------------
+
+
+def fc(
+    input,
+    size: int,
+    num_flatten_dims: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    name: Optional[str] = None,
+):
+    """Fully-connected layer (layers/nn.py:167 fc; mul_op + elementwise_add).
+
+    Flattens trailing dims from ``num_flatten_dims`` on, multiplies by a
+    [flattened_in, size] weight. Accepts a list of inputs (summed), as the
+    reference does.
+    """
+    helper = LayerHelper("fc", name=name)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    out = None
+    for i, x in enumerate(inputs):
+        in_features = int(np.prod(x.shape[num_flatten_dims:]))
+        lead_shape = x.shape[:num_flatten_dims]
+        x2 = x.reshape((*lead_shape, in_features)) if x.ndim != num_flatten_dims + 1 else x
+        w = helper.create_parameter(
+            f"w_{i}" if len(inputs) > 1 else "w",
+            shape=(in_features, size),
+            dtype=x.dtype,
+            attr=param_attr,
+        )
+        y = jnp.matmul(x2, w)
+        out = y if out is None else out + y
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            "b", shape=(size,), dtype=out.dtype, attr=bias_attr,
+            initializer=init.Constant(0.0),
+        )
+        out = out + b
+    return apply_activation(out, act)
+
+
+def embedding(
+    input,
+    size: Sequence[int],
+    is_sparse: bool = False,
+    is_distributed: bool = False,
+    padding_idx: Optional[int] = None,
+    param_attr=None,
+    dtype="float32",
+    name: Optional[str] = None,
+):
+    """Embedding lookup (layers/nn.py:276; lookup_table_op).
+
+    ``is_sparse`` marks the table for sparse (indices, values) gradient
+    handling — the SelectedRows analog (see paddle_tpu.sparse);
+    ``is_distributed`` marks it for row-sharded placement across the mesh
+    (distributed-lookup-table capability, distribute_transpiler.py:1100).
+    On TPU the lookup itself is a gather; XLA lowers it efficiently.
+    """
+    helper = LayerHelper("embedding", name=name)
+    vocab, dim = int(size[0]), int(size[1])
+    table = helper.create_parameter(
+        "w", shape=(vocab, dim), dtype=dtype, attr=param_attr,
+        is_distributed=is_distributed,
+    )
+    ids = input.astype(jnp.int32)
+    squeeze_last = False
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+        squeeze_last = True
+    out = jnp.take(table, ids, axis=0)
+    if padding_idx is not None:
+        pad = vocab + padding_idx if padding_idx < 0 else padding_idx
+        mask = (ids != pad)[..., None].astype(out.dtype)
+        out = out * mask
+    if squeeze_last:
+        pass  # reference keeps the embedded dim in place of the trailing 1
+    return out
+
+
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False,
+           alpha: float = 1.0, name=None):
+    """matmul_op analog with batched broadcasting."""
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return out
+
+
+def mul(x, y, x_num_col_dims: int = 1, y_num_col_dims: int = 1, name=None):
+    """mul_op analog: flatten x to 2-D at x_num_col_dims, y likewise."""
+    xs = (int(np.prod(x.shape[:x_num_col_dims])), int(np.prod(x.shape[x_num_col_dims:])))
+    ys = (int(np.prod(y.shape[:y_num_col_dims])), int(np.prod(y.shape[y_num_col_dims:])))
+    out = jnp.matmul(x.reshape(xs), y.reshape(ys))
+    return out.reshape(x.shape[:x_num_col_dims] + y.shape[y_num_col_dims:])
+
+
+def linear_chain_matmul(mats, name=None):
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.matmul(out, m)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# convolution / pooling
+# ---------------------------------------------------------------------------
+
+
+def _conv_dn(ndim: int, data_format: str):
+    if ndim == 4:
+        return ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC")
+    if ndim == 5:
+        return ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW" else ("NDHWC", "DHWIO", "NDHWC")
+    raise ValueError(f"conv expects 4-D/5-D input, got {ndim}-D")
+
+
+def conv2d(
+    input,
+    num_filters: int,
+    filter_size: Int2,
+    stride: Int2 = 1,
+    padding: Int2 = 0,
+    dilation: Int2 = 1,
+    groups: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    data_format: str = "NCHW",
+    name: Optional[str] = None,
+    use_cudnn: bool = True,  # accepted for API parity; XLA picks the algo
+):
+    """2-D convolution (conv_op.cc / conv_cudnn_op.cu.cc analog)."""
+    helper = LayerHelper("conv2d", name=name)
+    fs, st, pd, dl = _pair(filter_size), _pair(stride), _pair(padding), _pair(dilation)
+    c_axis = 1 if data_format == "NCHW" else 3
+    in_c = input.shape[c_axis]
+    enforce(in_c % groups == 0, "input channels %d not divisible by groups %d", in_c, groups)
+    w = helper.create_parameter(
+        "w", shape=(num_filters, in_c // groups, fs[0], fs[1]), dtype=input.dtype,
+        attr=param_attr, initializer=init.MSRA(uniform=False),
+    )
+    dn = jax.lax.conv_dimension_numbers(input.shape, w.shape if data_format == "NCHW"
+                                        else (fs[0], fs[1], in_c // groups, num_filters),
+                                        _conv_dn(4, data_format))
+    if data_format != "NCHW":
+        w = jnp.transpose(w, (2, 3, 1, 0))  # OIHW -> HWIO
+    out = jax.lax.conv_general_dilated(
+        input, w, window_strides=st,
+        padding=[(pd[0], pd[0]), (pd[1], pd[1])],
+        rhs_dilation=dl, dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32 if input.dtype == jnp.bfloat16 else None,
+    )
+    out = out.astype(input.dtype)
+    if bias_attr is not False:
+        b = helper.create_parameter("b", shape=(num_filters,), dtype=out.dtype,
+                                    attr=bias_attr, initializer=init.Constant(0.0))
+        bshape = (1, num_filters, 1, 1) if data_format == "NCHW" else (1, 1, 1, num_filters)
+        out = out + b.reshape(bshape)
+    return apply_activation(out, act)
+
+
+def conv2d_transpose(
+    input,
+    num_filters: int,
+    filter_size: Int2,
+    stride: Int2 = 1,
+    padding: Int2 = 0,
+    dilation: Int2 = 1,
+    groups: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    data_format: str = "NCHW",
+    name: Optional[str] = None,
+    output_size=None,
+    use_cudnn: bool = True,
+):
+    """conv2d_transpose_op analog (gradient of conv wrt input)."""
+    helper = LayerHelper("conv2d_transpose", name=name)
+    fs, st, pd, dl = _pair(filter_size), _pair(stride), _pair(padding), _pair(dilation)
+    c_axis = 1 if data_format == "NCHW" else 3
+    in_c = input.shape[c_axis]
+    w = helper.create_parameter(
+        "w", shape=(in_c, num_filters // groups, fs[0], fs[1]), dtype=input.dtype,
+        attr=param_attr, initializer=init.Xavier(),
+    )
+    if data_format != "NCHW":
+        input = jnp.transpose(input, (0, 3, 1, 2))
+    # Transposed conv = conv over the stride-dilated input with a
+    # spatially-flipped, channel-swapped kernel (what conv2d_transpose_op's
+    # GEMM formulation computes via col2im).
+    w_f = w[:, :, ::-1, ::-1]
+    x = input
+    if groups > 1:
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(w_f, groups, axis=0)
+        outs = [_conv_t_one(xg, wg, st, pd, dl) for xg, wg in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        out = _conv_t_one(x, w_f, st, pd, dl)
+    out = out.astype(input.dtype)
+    if data_format != "NCHW":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    if bias_attr is not False:
+        b = helper.create_parameter("b", shape=(num_filters,), dtype=out.dtype,
+                                    attr=bias_attr, initializer=init.Constant(0.0))
+        bshape = (1, num_filters, 1, 1) if data_format == "NCHW" else (1, 1, 1, num_filters)
+        out = out + b.reshape(bshape)
+    return apply_activation(out, act)
+
+
+def _conv_t_one(x, w_f, st, pd, dl):
+    """One group of transposed conv: w_f is (in_c_g, out_c_g, kh, kw),
+    spatially pre-flipped."""
+    w_t = jnp.swapaxes(w_f, 0, 1)  # -> (out_c_g, in_c_g, kh, kw) = OIHW
+    kh = dl[0] * (w_t.shape[2] - 1) + 1
+    kw = dl[1] * (w_t.shape[3] - 1) + 1
+    dn = jax.lax.conv_dimension_numbers(x.shape, w_t.shape, ("NCHW", "OIHW", "NCHW"))
+    return jax.lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1),
+        padding=[(kh - 1 - pd[0], kh - 1 - pd[0]), (kw - 1 - pd[1], kw - 1 - pd[1])],
+        lhs_dilation=st, rhs_dilation=dl, dimension_numbers=dn,
+    )
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCDHW", name=None, use_cudnn=True):
+    """conv3d_op analog."""
+    helper = LayerHelper("conv3d", name=name)
+
+    def _triple(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v, v)
+
+    fs, st, pd, dl = _triple(filter_size), _triple(stride), _triple(padding), _triple(dilation)
+    in_c = input.shape[1]
+    w = helper.create_parameter(
+        "w", shape=(num_filters, in_c // groups, *fs), dtype=input.dtype,
+        attr=param_attr, initializer=init.MSRA(uniform=False),
+    )
+    dn = jax.lax.conv_dimension_numbers(input.shape, w.shape, ("NCDHW", "OIDHW", "NCDHW"))
+    out = jax.lax.conv_general_dilated(
+        input, w, window_strides=st, padding=[(p, p) for p in pd],
+        rhs_dilation=dl, dimension_numbers=dn, feature_group_count=groups,
+    ).astype(input.dtype)
+    if bias_attr is not False:
+        b = helper.create_parameter("b", shape=(num_filters,), dtype=out.dtype,
+                                    attr=bias_attr, initializer=init.Constant(0.0))
+        out = out + b.reshape((1, num_filters, 1, 1, 1))
+    return apply_activation(out, act)
+
+
+def pool2d(
+    input,
+    pool_size: Int2 = 2,
+    pool_type: str = "max",
+    pool_stride: Int2 = 1,
+    pool_padding: Int2 = 0,
+    global_pooling: bool = False,
+    ceil_mode: bool = False,
+    exclusive: bool = True,
+    data_format: str = "NCHW",
+    name=None,
+    use_cudnn: bool = True,
+):
+    """pool2d (pool_op.cc analog) via lax.reduce_window."""
+    spatial = (2, 3) if data_format == "NCHW" else (1, 2)
+    if global_pooling:
+        ps = tuple(input.shape[a] for a in spatial)
+        st, pd = ps, (0, 0)
+    else:
+        ps, st, pd = _pair(pool_size), _pair(pool_stride), _pair(pool_padding)
+    window = [1, 1, 1, 1]
+    strides = [1, 1, 1, 1]
+    pads = [(0, 0)] * 4
+    for i, a in enumerate(spatial):
+        window[a] = ps[i]
+        strides[a] = st[i]
+        hi = pd[i]
+        if ceil_mode:
+            # extra right-pad so the last partial window is included
+            size = input.shape[a]
+            out_floor = (size + 2 * pd[i] - ps[i]) // st[i] + 1
+            out_ceil = -(-(size + 2 * pd[i] - ps[i]) // st[i]) + 1
+            hi = pd[i] + (out_ceil - out_floor) * st[i]
+        pads[a] = (pd[i], hi)
+    if pool_type == "max":
+        neg = jnp.finfo(input.dtype).min if jnp.issubdtype(input.dtype, jnp.floating) else jnp.iinfo(input.dtype).min
+        return jax.lax.reduce_window(input, neg, jax.lax.max, window, strides, pads)
+    if pool_type == "avg":
+        s = jax.lax.reduce_window(input, 0.0, jax.lax.add, window, strides, pads)
+        if exclusive:
+            ones = jnp.ones_like(input)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+            return s / cnt
+        return s / float(np.prod(ps))
+    raise ValueError(f"pool_type must be 'max' or 'avg', got {pool_type}")
+
+
+def adaptive_pool2d(input, pool_size, pool_type="avg", name=None):
+    """adaptive_pool2d analog (NCHW): output spatial dims = pool_size."""
+    oh, ow = _pair(pool_size)
+    n, c, h, w = input.shape
+    enforce(h % oh == 0 and w % ow == 0,
+            "adaptive_pool2d requires divisible spatial dims (got %dx%d -> %dx%d)", h, w, oh, ow)
+    x = input.reshape(n, c, oh, h // oh, ow, w // ow)
+    if pool_type == "avg":
+        return x.mean(axis=(3, 5))
+    return x.max(axis=(3, 5))
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+def batch_norm(
+    input,
+    act: Optional[str] = None,
+    is_test: Optional[bool] = None,
+    momentum: float = 0.9,
+    epsilon: float = 1e-5,
+    param_attr=None,
+    bias_attr=None,
+    data_layout: str = "NCHW",
+    name: Optional[str] = None,
+    moving_mean_name=None,
+    moving_variance_name=None,
+    use_global_stats: bool = False,
+):
+    """Batch normalization (batch_norm_op.cc / .cu analog).
+
+    Training mode computes batch statistics and updates moving stats
+    (functional state — returned from Program.apply as new_state);
+    inference uses the moving stats. ``is_test=None`` follows the build
+    context's training flag, mirroring the reference's is_test attr set
+    by Program.clone(for_test=True).
+    """
+    helper = LayerHelper("batch_norm", name=name)
+    c_axis = 1 if data_layout == "NCHW" else input.ndim - 1
+    c = input.shape[c_axis]
+    red_axes = tuple(a for a in range(input.ndim) if a != c_axis)
+    bshape = [1] * input.ndim
+    bshape[c_axis] = c
+
+    scale = helper.create_parameter("scale", (c,), input.dtype, attr=param_attr,
+                                    initializer=init.Constant(1.0))
+    bias = helper.create_parameter("bias", (c,), input.dtype, attr=bias_attr,
+                                   initializer=init.Constant(0.0))
+    moving_mean = helper.create_variable("moving_mean", (c,), jnp.float32,
+                                         initializer=init.Constant(0.0))
+    moving_var = helper.create_variable("moving_variance", (c,), jnp.float32,
+                                        initializer=init.Constant(1.0))
+
+    training = in_training() if is_test is None else (not is_test)
+    if training and not use_global_stats:
+        x32 = input.astype(jnp.float32)
+        mean = x32.mean(axis=red_axes)
+        var = x32.var(axis=red_axes)
+        helper.assign_variable("moving_mean", momentum * moving_mean + (1 - momentum) * mean)
+        helper.assign_variable("moving_variance", momentum * moving_var + (1 - momentum) * var)
+    else:
+        mean, var = moving_mean, moving_var
+    inv = jax.lax.rsqrt(var + epsilon) * scale.astype(jnp.float32)
+    out = (input.astype(jnp.float32) - mean.reshape(bshape)) * inv.reshape(bshape) \
+        + bias.astype(jnp.float32).reshape(bshape)
+    return apply_activation(out.astype(input.dtype), act)
+
+
+def layer_norm(
+    input,
+    scale: bool = True,
+    shift: bool = True,
+    begin_norm_axis: int = 1,
+    epsilon: float = 1e-5,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    name: Optional[str] = None,
+):
+    """Layer normalization (layer_norm_op analog): normalize over dims
+    [begin_norm_axis, rank)."""
+    helper = LayerHelper("layer_norm", name=name)
+    axes = tuple(range(begin_norm_axis, input.ndim))
+    nshape = tuple(input.shape[a] for a in axes)
+    x32 = input.astype(jnp.float32)
+    mean = x32.mean(axis=axes, keepdims=True)
+    var = x32.var(axis=axes, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + epsilon)
+    if scale:
+        g = helper.create_parameter("scale", nshape, input.dtype, attr=param_attr,
+                                    initializer=init.Constant(1.0))
+        out = out * g.astype(jnp.float32)
+    if shift:
+        b = helper.create_parameter("bias", nshape, input.dtype, attr=bias_attr,
+                                    initializer=init.Constant(0.0))
+        out = out + b.astype(jnp.float32)
+    return apply_activation(out.astype(input.dtype), act)
+
+
+def group_norm(input, groups: int, epsilon: float = 1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    """group_norm_op analog (NCHW)."""
+    helper = LayerHelper("group_norm", name=name)
+    n, c = input.shape[0], input.shape[1]
+    enforce(c % groups == 0, "channels %d not divisible by groups %d", c, groups)
+    x = input.reshape(n, groups, c // groups, *input.shape[2:]).astype(jnp.float32)
+    axes = tuple(range(2, x.ndim))
+    mean = x.mean(axis=axes, keepdims=True)
+    var = x.var(axis=axes, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    x = x.reshape(input.shape)
+    shape = [1, c] + [1] * (input.ndim - 2)
+    g = helper.create_parameter("scale", (c,), input.dtype, attr=param_attr,
+                                initializer=init.Constant(1.0))
+    b = helper.create_parameter("bias", (c,), input.dtype, attr=bias_attr,
+                                initializer=init.Constant(0.0))
+    out = x * g.astype(jnp.float32).reshape(shape) + b.astype(jnp.float32).reshape(shape)
+    return apply_activation(out.astype(input.dtype), act)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    """Local response normalization (lrn_op.cc analog, NCHW)."""
+    sq = jnp.square(input)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + input.shape[1]] for i in range(n))
+    return input / jnp.power(k + alpha * acc, beta)
+
+
+def l2_normalize(x, axis: int = -1, epsilon: float = 1e-10, name=None):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    return x / jnp.maximum(norm, epsilon)
+
+
+def spectral_norm(weight, dim: int = 0, power_iters: int = 1, eps: float = 1e-12, name=None):
+    """spectral_norm_op analog with persistent power-iteration vector."""
+    helper = LayerHelper("spectral_norm", name=name)
+    w = jnp.moveaxis(weight, dim, 0).reshape(weight.shape[dim], -1)
+    h, wdim = w.shape
+    u = helper.create_variable("u", (h,), jnp.float32, initializer=init.Normal(0.0, 1.0))
+    v = None
+    for _ in range(power_iters):
+        v = w.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = w @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    helper.assign_variable("u", jax.lax.stop_gradient(u))
+    sigma = u @ w @ v if v is not None else jnp.linalg.norm(w, 2)
+    return weight / sigma
+
+
+# ---------------------------------------------------------------------------
+# dropout / softmax / losses
+# ---------------------------------------------------------------------------
+
+
+def dropout(
+    x,
+    dropout_prob: float,
+    is_test: Optional[bool] = None,
+    seed: Optional[int] = None,
+    dropout_implementation: str = "downgrade_in_infer",
+    name=None,
+):
+    """dropout_op analog. Default semantics match the reference:
+    'downgrade_in_infer' scales at inference; 'upscale_in_train' scales
+    the kept units during training."""
+    training = in_training() if is_test is None else (not is_test)
+    if dropout_prob == 0.0:
+        return x
+    if not training:
+        if dropout_implementation == "downgrade_in_infer":
+            return x * (1.0 - dropout_prob)
+        return x
+    key = jax.random.PRNGKey(seed) if seed is not None else next_rng_key()
+    keep = jax.random.bernoulli(key, 1.0 - dropout_prob, x.shape)
+    out = jnp.where(keep, x, jnp.zeros_like(x))
+    if dropout_implementation == "upscale_in_train":
+        out = out / (1.0 - dropout_prob)
+    return out
+
+
+def softmax(input, axis: int = -1, name=None, use_cudnn: bool = False):
+    return jax.nn.softmax(input, axis=axis)
+
+
+def log_softmax(input, axis: int = -1, name=None):
+    return jax.nn.log_softmax(input, axis=axis)
+
+
+def softmax_with_cross_entropy(
+    logits,
+    label,
+    soft_label: bool = False,
+    ignore_index: int = -100,
+    numeric_stable_mode: bool = True,
+    return_softmax: bool = False,
+    axis: int = -1,
+):
+    """Fused softmax + cross-entropy (softmax_with_cross_entropy_op.cc
+    analog) — numerically stable log-sum-exp form; XLA fuses it."""
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lab = label.astype(jnp.int32)
+        squeeze = lab.ndim == logits.ndim and lab.shape[axis] == 1
+        if squeeze:
+            lab = jnp.squeeze(lab, axis=axis)
+        picked = jnp.take_along_axis(logp, lab[..., None], axis=axis)
+        valid = (lab != ignore_index)[..., None]
+        loss = jnp.where(valid, -picked, 0.0)
+    if return_softmax:
+        return loss, jax.nn.softmax(logits, axis=axis)
+    return loss
+
+
+def cross_entropy(input, label, soft_label: bool = False, ignore_index: int = -100):
+    """cross_entropy_op analog: ``input`` is probabilities."""
+    eps = 1e-12
+    if soft_label:
+        return -jnp.sum(label * jnp.log(input + eps), axis=-1, keepdims=True)
+    lab = label.astype(jnp.int32)
+    if lab.ndim == input.ndim and lab.shape[-1] == 1:
+        lab = jnp.squeeze(lab, axis=-1)
+    picked = jnp.take_along_axis(input, lab[..., None], axis=-1)
+    valid = (lab != ignore_index)[..., None]
+    return jnp.where(valid, -jnp.log(picked + eps), 0.0)
+
+
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+def huber_loss(input, label, delta: float):
+    r = jnp.abs(input - label)
+    return jnp.where(r <= delta, 0.5 * r * r, delta * (r - 0.5 * delta))
+
+
+def smooth_l1(x, y, sigma: float = 1.0, inside_weight=None, outside_weight=None):
+    diff = (x - y) if inside_weight is None else inside_weight * (x - y)
+    s2 = sigma * sigma
+    absd = jnp.abs(diff)
+    loss = jnp.where(absd < 1.0 / s2, 0.5 * s2 * diff * diff, absd - 0.5 / s2)
+    if outside_weight is not None:
+        loss = loss * outside_weight
+    return jnp.sum(loss, axis=tuple(range(1, loss.ndim)), keepdims=False)[..., None]
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index: int = -100, name=None):
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return jnp.where(label == ignore_index, 0.0, loss)
+
+
+def log_loss(input, label, epsilon: float = 1e-4, name=None):
+    return -label * jnp.log(input + epsilon) - (1 - label) * jnp.log(1 - input + epsilon)
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    loss = target * (jnp.log(jnp.maximum(target, 1e-12)) - x)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    if reduction == "batchmean":
+        return loss.sum() / x.shape[0]
+    return loss
+
+
+def mse_loss(input, label):
+    return jnp.square(input - label).mean()
+
+
+def margin_rank_loss(label, left, right, margin: float = 0.1, name=None):
+    return jnp.maximum(0.0, -label * (left - right) + margin)
+
+
+def rank_loss(label, left, right, name=None):
+    return jnp.log1p(jnp.exp(left - right)) - label * (left - right)
+
+
+def hinge_loss(input, label, name=None):
+    return jnp.maximum(0.0, 1.0 - input * (2.0 * label - 1.0))
+
+
+def npair_loss(anchor, positive, labels, l2_reg: float = 0.002):
+    batch = anchor.shape[0]
+    sim = anchor @ positive.T
+    lbl = labels.reshape(-1)
+    tgt = (lbl[:, None] == lbl[None, :]).astype(anchor.dtype)
+    tgt = tgt / tgt.sum(axis=1, keepdims=True)
+    ce = -jnp.sum(tgt * jax.nn.log_softmax(sim, axis=1), axis=1).mean()
+    reg = l2_reg * (jnp.sum(anchor * anchor) + jnp.sum(positive * positive)) / (2 * batch)
+    return ce + reg
+
+
+def cos_sim(x, y, name=None):
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    return jnp.sum(x * y, axis=-1, keepdims=True) / jnp.maximum(xn * yn, 1e-12)
+
+
+# ---------------------------------------------------------------------------
+# reductions / elementwise (axis-broadcast semantics)
+# ---------------------------------------------------------------------------
+
+
+def _reduce(fn, x, dim, keep_dim):
+    axis = tuple(dim) if isinstance(dim, (list, tuple)) else dim
+    return fn(x, axis=axis, keepdims=keep_dim)
+
+
+def reduce_sum(x, dim=None, keep_dim=False, name=None):
+    return _reduce(jnp.sum, x, dim, keep_dim)
+
+
+def reduce_mean(x, dim=None, keep_dim=False, name=None):
+    return _reduce(jnp.mean, x, dim, keep_dim)
+
+
+def reduce_max(x, dim=None, keep_dim=False, name=None):
+    return _reduce(jnp.max, x, dim, keep_dim)
+
+
+def reduce_min(x, dim=None, keep_dim=False, name=None):
+    return _reduce(jnp.min, x, dim, keep_dim)
+
+
+def reduce_prod(x, dim=None, keep_dim=False, name=None):
+    return _reduce(jnp.prod, x, dim, keep_dim)
+
+
+def reduce_all(x, dim=None, keep_dim=False, name=None):
+    return _reduce(jnp.all, x, dim, keep_dim)
+
+
+def reduce_any(x, dim=None, keep_dim=False, name=None):
+    return _reduce(jnp.any, x, dim, keep_dim)
+
+
+def mean(x, name=None):
+    return jnp.mean(x)
+
+
+def _ew_broadcast(x, y, axis: int):
+    """The reference's elementwise axis semantics (elementwise_op.h):
+    y's shape aligns to x starting at ``axis``."""
+    if axis == -1 or x.ndim == y.ndim:
+        return y
+    shape = [1] * x.ndim
+    for i, s in enumerate(y.shape):
+        shape[axis + i] = s
+    return y.reshape(shape)
+
+
+def elementwise_add(x, y, axis: int = -1, act=None, name=None):
+    return apply_activation(x + _ew_broadcast(x, y, axis), act)
+
+
+def elementwise_sub(x, y, axis: int = -1, act=None, name=None):
+    return apply_activation(x - _ew_broadcast(x, y, axis), act)
+
+
+def elementwise_mul(x, y, axis: int = -1, act=None, name=None):
+    return apply_activation(x * _ew_broadcast(x, y, axis), act)
+
+
+def elementwise_div(x, y, axis: int = -1, act=None, name=None):
+    return apply_activation(x / _ew_broadcast(x, y, axis), act)
+
+
+def elementwise_max(x, y, axis: int = -1, act=None, name=None):
+    return apply_activation(jnp.maximum(x, _ew_broadcast(x, y, axis)), act)
+
+
+def elementwise_min(x, y, axis: int = -1, act=None, name=None):
+    return apply_activation(jnp.minimum(x, _ew_broadcast(x, y, axis)), act)
+
+
+def elementwise_pow(x, y, axis: int = -1, act=None, name=None):
+    return apply_activation(jnp.power(x, _ew_broadcast(x, y, axis)), act)
+
+
+def elementwise_mod(x, y, axis: int = -1, act=None, name=None):
+    return apply_activation(jnp.mod(x, _ew_broadcast(x, y, axis)), act)
+
+
+def elementwise_floordiv(x, y, axis: int = -1, act=None, name=None):
+    return apply_activation(jnp.floor_divide(x, _ew_broadcast(x, y, axis)), act)
+
+
+def scale(x, scale: float = 1.0, bias: float = 0.0, bias_after_scale: bool = True,
+          act=None, name=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return apply_activation(out, act)
+
+
+def clip(x, min: float, max: float, name=None):
+    return jnp.clip(x, min, max)
+
+
+def clip_by_norm(x, max_norm: float, name=None):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.where(norm > max_norm, x * (max_norm / norm), x)
+
+
+# ---------------------------------------------------------------------------
+# misc nn
+# ---------------------------------------------------------------------------
+
+
+def one_hot(input, depth: int, name=None):
+    ids = input.astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    return jax.nn.one_hot(ids, depth, dtype=jnp.float32)
+
+
+def label_smooth(label, prior_dist=None, epsilon: float = 0.1, name=None):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+def topk(input, k: int, name=None):
+    return jax.lax.top_k(input, k)
+
+
+def prelu(x, mode: str = "all", param_attr=None, name=None):
+    """prelu_op analog; mode: all|channel|element."""
+    helper = LayerHelper("prelu", name=name)
+    if mode == "all":
+        shape = (1,)
+    elif mode == "channel":
+        shape = (x.shape[1],)
+    else:
+        shape = tuple(x.shape[1:])
+    alpha = helper.create_parameter("alpha", shape, x.dtype, attr=param_attr,
+                                    initializer=init.Constant(0.25))
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x > 0, x, alpha * x)
+
+
+def pad(x, paddings: Sequence[int], pad_value: float = 0.0, name=None):
+    """pad_op analog: paddings = [lo0, hi0, lo1, hi1, ...]."""
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return jnp.pad(x, cfg, constant_values=pad_value)
+
+
+def pad2d(x, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    t, b, l, r = paddings
+    if data_format == "NCHW":
+        cfg = [(0, 0), (0, 0), (t, b), (l, r)]
+    else:
+        cfg = [(0, 0), (t, b), (l, r), (0, 0)]
+    jmode = {"constant": "constant", "reflect": "reflect", "edge": "edge"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, constant_values=pad_value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+def pad_constant_like(x, y, pad_value: float = 0.0, name=None):
+    cfg = [(0, xd - yd) for xd, yd in zip(x.shape, y.shape)]
+    return jnp.pad(y, cfg, constant_values=pad_value)
+
+
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
+                 align_corners=True, data_format="NCHW", name=None):
+    """interpolate (bilinear/nearest) — bilinear_interp_op analog."""
+    n, c, h, w = input.shape if data_format == "NCHW" else (
+        input.shape[0], input.shape[3], input.shape[1], input.shape[2])
+    if out_shape is None:
+        out_shape = (int(h * scale), int(w * scale))
+    oh, ow = out_shape
+    x = input if data_format == "NHWC" else jnp.transpose(input, (0, 2, 3, 1))
+    method = "bilinear" if resample.upper() == "BILINEAR" else "nearest"
+    out = jax.image.resize(x, (n, oh, ow, c), method=method)
+    return out if data_format == "NHWC" else jnp.transpose(out, (0, 3, 1, 2))
+
+
+def resize_bilinear(input, out_shape=None, scale=None, align_corners=True, name=None):
+    return image_resize(input, out_shape, scale, "BILINEAR", align_corners)
+
+
+def resize_nearest(input, out_shape=None, scale=None, align_corners=True, name=None):
+    return image_resize(input, out_shape, scale, "NEAREST", align_corners)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (unfold_op analog), NCHW -> [N, C*kh*kw, L]."""
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = xp[:, :, i * dh:i * dh + oh * sh:sh, j * dw:j * dw + ow * sw:sw]
+            cols.append(patch.reshape(n, c, -1))
+    return jnp.stack(cols, axis=2).reshape(n, c * kh * kw, oh * ow)
+
+
+def grid_sampler(x, grid, name=None):
+    """grid_sample_op analog (bilinear, NCHW, grid in [-1,1])."""
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1) * (w - 1) / 2
+    gy = (grid[..., 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+
+    def _sample(yy, xx):
+        yy = jnp.clip(yy, 0, h - 1)
+        xx = jnp.clip(xx, 0, w - 1)
+        return x[jnp.arange(n)[:, None, None], :, yy, xx]  # [n, gh, gw, c]
+
+    wa = ((x1 - gx) * (y1 - gy))[..., None]
+    wb = ((gx - x0) * (y1 - gy))[..., None]
+    wc = ((x1 - gx) * (gy - y0))[..., None]
+    wd = ((gx - x0) * (gy - y0))[..., None]
+    out = wa * _sample(y0, x0) + wb * _sample(y0, x1) + wc * _sample(y1, x0) + wd * _sample(y1, x1)
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
+def pixel_shuffle(x, upscale_factor: int, name=None):
+    n, c, h, w = x.shape
+    r = upscale_factor
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+def shuffle_channel(x, group: int, name=None):
+    n, c, h, w = x.shape
+    x = x.reshape(n, group, c // group, h, w)
+    return jnp.transpose(x, (0, 2, 1, 3, 4)).reshape(n, c, h, w)
+
+
+def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25, name=None):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([x[:, 1:, :fold], jnp.zeros_like(x[:, :1, :fold])], axis=1)
+    right = jnp.concatenate([jnp.zeros_like(x[:, :1, fold:2 * fold]), x[:, :-1, fold:2 * fold]], axis=1)
+    rest = x[:, :, 2 * fold:]
+    return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)

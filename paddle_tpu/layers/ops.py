@@ -1,0 +1,199 @@
+"""Elementwise / activation ops.
+
+Analog of python/paddle/fluid/layers/ops.py — there these are
+auto-generated wrappers over C++ activation OpKernels
+(layer_function_generator.py); here they are jax.numpy compositions that
+XLA fuses into neighboring matmuls (the fusion the reference needed
+hand-written passes and xbyak JIT kernels for — operators/math/jit_kernel.h).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(x)
+
+
+def logsigmoid(x, name=None):
+    return jax.nn.log_sigmoid(x)
+
+
+def exp(x, name=None):
+    return jnp.exp(x)
+
+
+def tanh(x, name=None):
+    return jnp.tanh(x)
+
+
+def tanh_shrink(x, name=None):
+    return x - jnp.tanh(x)
+
+
+def softshrink(x, alpha=0.5, name=None):
+    return jnp.where(x > alpha, x - alpha, jnp.where(x < -alpha, x + alpha, 0.0))
+
+
+def sqrt(x, name=None):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x, name=None):
+    return jax.lax.rsqrt(x)
+
+
+def abs(x, name=None):
+    return jnp.abs(x)
+
+
+def ceil(x, name=None):
+    return jnp.ceil(x)
+
+
+def floor(x, name=None):
+    return jnp.floor(x)
+
+
+def cos(x, name=None):
+    return jnp.cos(x)
+
+
+def sin(x, name=None):
+    return jnp.sin(x)
+
+
+def round(x, name=None):
+    return jnp.round(x)
+
+
+def reciprocal(x, name=None):
+    return 1.0 / x
+
+
+def square(x, name=None):
+    return jnp.square(x)
+
+
+def log(x, name=None):
+    return jnp.log(x)
+
+
+def relu(x, name=None):
+    return jax.nn.relu(x)
+
+
+def relu6(x, threshold=6.0, name=None):
+    return jnp.clip(x, 0.0, threshold)
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+def selu(x, name=None):
+    return jax.nn.selu(x)
+
+
+def gelu(x, approximate=True, name=None):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return jnp.clip(x, t_min, t_max)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))
+
+
+def softplus(x, name=None):
+    return jax.nn.softplus(x)
+
+
+def softsign(x, name=None):
+    return x / (1.0 + jnp.abs(x))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    return x * jnp.clip(x + offset, 0.0, threshold) / scale
+
+
+def swish(x, beta=1.0, name=None):
+    return x * jax.nn.sigmoid(beta * x)
+
+
+def mish(x, name=None):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def pow(x, factor=1.0, name=None):
+    return jnp.power(x, factor)
+
+
+def erf(x, name=None):
+    return jax.lax.erf(x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    """maxout_op.cc analog: out[:, k] = max over the ``groups``
+    consecutive channels k*groups..(k+1)*groups; C_out = C/groups."""
+    shape = list(x.shape)
+    c = shape[axis]
+    if c % groups != 0:
+        raise ValueError(f"channels {c} not divisible by groups {groups}")
+    new_shape = shape[:axis] + [c // groups, groups] + shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+# Registry of activation names usable as `act=` on fc/conv2d/... —
+# mirrors LayerHelper.append_activation.
+ACTIVATIONS = {
+    None: lambda x: x,
+    "relu": relu,
+    "relu6": relu6,
+    "leaky_relu": leaky_relu,
+    "sigmoid": sigmoid,
+    "tanh": tanh,
+    "elu": elu,
+    "selu": selu,
+    "gelu": gelu,
+    "softplus": softplus,
+    "softsign": softsign,
+    "stanh": stanh,
+    "hard_sigmoid": hard_sigmoid,
+    "swish": swish,
+    "mish": mish,
+    "exp": exp,
+    "square": square,
+    "sqrt": sqrt,
+    "abs": abs,
+    "brelu": brelu,
+    "soft_relu": soft_relu,
+}
+
+
+def apply_activation(x, act):
+    if callable(act):
+        return act(x)
+    if act not in ACTIVATIONS:
+        raise ValueError(f"Unknown activation {act!r}; known: {sorted(k for k in ACTIVATIONS if k)}")
+    return ACTIVATIONS[act](x)

@@ -1,0 +1,276 @@
+"""Tensor creation / manipulation ops.
+
+Analog of python/paddle/fluid/layers/tensor.py (+ parts of nn.py's shape
+ops). Pure jax.numpy; everything static-shape so XLA can tile for the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtypes import convert_dtype
+from ..framework import next_rng_key
+
+
+def cast(x, dtype):
+    return x.astype(convert_dtype(dtype))
+
+
+def concat(inputs: Sequence[jax.Array], axis: int = 0, name=None):
+    return jnp.concatenate(inputs, axis=axis)
+
+
+def split(x, num_or_sections: Union[int, List[int]], dim: int = -1, name=None):
+    """split_op analog. ``num_or_sections`` int → equal parts; list →
+    section sizes (−1 allowed for one inferred section)."""
+    if isinstance(num_or_sections, int):
+        return list(jnp.split(x, num_or_sections, axis=dim))
+    sections = list(num_or_sections)
+    total = x.shape[dim]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    offsets = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        offsets.append(acc)
+    return list(jnp.split(x, offsets, axis=dim))
+
+
+def reshape(x, shape: Sequence[int], name=None):
+    """reshape_op analog supporting 0 (copy dim) and -1 (infer)."""
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(x.shape[i])
+        else:
+            out.append(s)
+    return jnp.reshape(x, out)
+
+
+def transpose(x, perm: Sequence[int], name=None):
+    return jnp.transpose(x, perm)
+
+
+def squeeze(x, axes: Optional[Sequence[int]] = None, name=None):
+    return jnp.squeeze(x, axis=tuple(axes) if axes else None)
+
+
+def unsqueeze(x, axes: Sequence[int], name=None):
+    for a in sorted(axes):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+def stack(inputs, axis: int = 0, name=None):
+    return jnp.stack(inputs, axis=axis)
+
+
+def unstack(x, axis: int = 0, num=None, name=None):
+    return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, x.shape[axis], axis=axis)]
+
+
+def expand(x, expand_times: Sequence[int], name=None):
+    return jnp.tile(x, expand_times)
+
+
+def expand_as(x, target, name=None):
+    return jnp.broadcast_to(x, target.shape)
+
+
+def tile(x, reps, name=None):
+    return jnp.tile(x, reps)
+
+
+def slice(x, axes: Sequence[int], starts: Sequence[int], ends: Sequence[int], name=None):
+    """slice_op analog with per-axis starts/ends (negative ok)."""
+    idx = [jnp.s_[:]] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = jnp.s_[s:e]
+    return x[tuple(idx)]
+
+
+def gather(x, index, axis: int = 0, name=None):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index, name=None):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def scatter(x, index, updates, overwrite: bool = True, name=None):
+    """scatter_op analog (1-D index over rows)."""
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def fill_constant(shape, dtype, value, name=None):
+    return jnp.full(shape, value, dtype=convert_dtype(dtype))
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value, input_dim_idx=0,
+                                  output_dim_idx=0, name=None):
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return jnp.full(shape, value, dtype=convert_dtype(dtype))
+
+
+def zeros(shape, dtype="float32", name=None):
+    return jnp.zeros(shape, dtype=convert_dtype(dtype))
+
+
+def ones(shape, dtype="float32", name=None):
+    return jnp.ones(shape, dtype=convert_dtype(dtype))
+
+
+def zeros_like(x, name=None):
+    return jnp.zeros_like(x)
+
+
+def ones_like(x, name=None):
+    return jnp.ones_like(x)
+
+
+def assign(x, name=None):
+    return jnp.asarray(x)
+
+
+def arange(start, end=None, step=1, dtype="int64", name=None):
+    return jnp.arange(start, end, step, dtype=convert_dtype(dtype))
+
+
+def range(start, end, step, dtype, name=None):
+    return jnp.arange(start, end, step, dtype=convert_dtype(dtype))
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    return jnp.linspace(start, stop, num, dtype=convert_dtype(dtype))
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else next_rng_key()
+    return jax.random.uniform(key, shape, dtype=convert_dtype(dtype), minval=min, maxval=max)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, dtype="float32", seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else next_rng_key()
+    return mean + std * jax.random.normal(key, shape, dtype=convert_dtype(dtype))
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32", input_dim_idx=0,
+                                   output_dim_idx=0, min=-1.0, max=1.0, seed=0, name=None):
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return uniform_random(shape, dtype, min, max, seed)
+
+
+def shape(x, name=None):
+    return jnp.asarray(x.shape, dtype=jnp.int64)
+
+
+def argmax(x, axis=-1, name=None):
+    return jnp.argmax(x, axis=axis)
+
+
+def argmin(x, axis=-1, name=None):
+    return jnp.argmin(x, axis=axis)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    idx = jnp.argsort(-x if descending else x, axis=axis)
+    vals = jnp.take_along_axis(x, idx, axis=axis)
+    return vals, idx
+
+
+def where(condition, name=None):
+    """where_index_op analog: indices of nonzero (static-shape callers
+    should prefer jnp.where三-arg form)."""
+    return jnp.argwhere(condition)
+
+
+def cond_select(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+def is_empty(x, name=None):
+    return jnp.asarray(x.size == 0)
+
+
+def has_nan(x, name=None):
+    return jnp.any(jnp.isnan(x))
+
+
+def has_inf(x, name=None):
+    return jnp.any(jnp.isinf(x))
+
+
+def isfinite(x, name=None):
+    return jnp.all(jnp.isfinite(x))
+
+
+def increment(x, value=1.0, name=None):
+    return x + value
+
+
+def cumsum(x, axis=None, name=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+def not_equal(x, y, name=None):
+    return jnp.not_equal(x, y)
+
+
+def equal(x, y, name=None):
+    return jnp.equal(x, y)
+
+
+def less_than(x, y, name=None):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y, name=None):
+    return jnp.less_equal(x, y)
+
+
+def greater_than(x, y, name=None):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y, name=None):
+    return jnp.greater_equal(x, y)
+
+
+def logical_and(x, y, name=None):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y, name=None):
+    return jnp.logical_or(x, y)
+
+
+def logical_not(x, name=None):
+    return jnp.logical_not(x)
+
+
+def logical_xor(x, y, name=None):
+    return jnp.logical_xor(x, y)
+
+
+def reverse(x, axis, name=None):
+    return jnp.flip(x, axis=axis)
+
+
+def flatten(x, axis: int = 1, name=None):
+    """flatten_op analog: collapse dims [0,axis) and [axis,rank)."""
+    import numpy as _np
+    lead = int(_np.prod(x.shape[:axis])) if axis > 0 else 1
+    return jnp.reshape(x, (lead, -1))

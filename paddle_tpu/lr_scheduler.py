@@ -1,0 +1,107 @@
+"""Learning-rate schedules.
+
+Analog of python/paddle/fluid/layers/learning_rate_scheduler.py, where
+each decay is built as in-graph ops over a ``@LR_DECAY_COUNTER@`` var.
+Here each schedule is a pure ``step -> lr`` function of the optimizer's
+step counter (traceable, so it lives inside the jitted update).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Sequence
+
+import jax.numpy as jnp
+
+Schedule = Callable  # step (int array) -> float array
+
+
+def _as_f32(step):
+    return jnp.asarray(step, dtype=jnp.float32)
+
+
+def noam_decay(d_model: int, warmup_steps: int, learning_rate: float = 1.0) -> Schedule:
+    def sched(step):
+        s = jnp.maximum(_as_f32(step), 1.0)
+        return learning_rate * (d_model ** -0.5) * jnp.minimum(s ** -0.5, s * warmup_steps ** -1.5)
+    return sched
+
+
+def exponential_decay(learning_rate: float, decay_steps: int, decay_rate: float,
+                      staircase: bool = False) -> Schedule:
+    def sched(step):
+        p = _as_f32(step) / decay_steps
+        if staircase:
+            p = jnp.floor(p)
+        return learning_rate * jnp.power(decay_rate, p)
+    return sched
+
+
+def natural_exp_decay(learning_rate: float, decay_steps: int, decay_rate: float,
+                      staircase: bool = False) -> Schedule:
+    def sched(step):
+        p = _as_f32(step) / decay_steps
+        if staircase:
+            p = jnp.floor(p)
+        return learning_rate * jnp.exp(-decay_rate * p)
+    return sched
+
+
+def inverse_time_decay(learning_rate: float, decay_steps: int, decay_rate: float,
+                       staircase: bool = False) -> Schedule:
+    def sched(step):
+        p = _as_f32(step) / decay_steps
+        if staircase:
+            p = jnp.floor(p)
+        return learning_rate / (1.0 + decay_rate * p)
+    return sched
+
+
+def polynomial_decay(learning_rate: float, decay_steps: int, end_learning_rate: float = 1e-4,
+                     power: float = 1.0, cycle: bool = False) -> Schedule:
+    def sched(step):
+        s = _as_f32(step)
+        if cycle:
+            div = jnp.maximum(1.0, jnp.ceil(s / decay_steps))
+            ds = decay_steps * div
+        else:
+            ds = float(decay_steps)
+            s = jnp.minimum(s, ds)
+        return (learning_rate - end_learning_rate) * jnp.power(1 - s / ds, power) + end_learning_rate
+    return sched
+
+
+def piecewise_decay(boundaries: Sequence[int], values: Sequence[float]) -> Schedule:
+    bs = jnp.asarray(boundaries, dtype=jnp.float32)
+    vs = jnp.asarray(values, dtype=jnp.float32)
+
+    def sched(step):
+        idx = jnp.sum(_as_f32(step) >= bs)
+        return vs[idx]
+    return sched
+
+
+def cosine_decay(learning_rate: float, step_each_epoch: int, epochs: int) -> Schedule:
+    def sched(step):
+        epoch = jnp.floor(_as_f32(step) / step_each_epoch)
+        return learning_rate * 0.5 * (jnp.cos(epoch * math.pi / epochs) + 1.0)
+    return sched
+
+
+def cosine_decay_steps(learning_rate: float, total_steps: int, min_lr: float = 0.0) -> Schedule:
+    def sched(step):
+        frac = jnp.clip(_as_f32(step) / total_steps, 0.0, 1.0)
+        return min_lr + (learning_rate - min_lr) * 0.5 * (1.0 + jnp.cos(math.pi * frac))
+    return sched
+
+
+def linear_lr_warmup(learning_rate, warmup_steps: int, start_lr: float, end_lr: float) -> Schedule:
+    """Wraps a schedule (or constant) with linear warmup
+    (learning_rate_scheduler.py linear_lr_warmup)."""
+    base = learning_rate if callable(learning_rate) else (lambda step: jnp.asarray(learning_rate, jnp.float32))
+
+    def sched(step):
+        s = _as_f32(step)
+        warm = start_lr + (end_lr - start_lr) * (s / max(warmup_steps, 1))
+        return jnp.where(s < warmup_steps, warm, base(step))
+    return sched

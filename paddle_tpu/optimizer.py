@@ -1,0 +1,437 @@
+"""Optimizers.
+
+Analog of python/paddle/fluid/optimizer.py (optimizer.py:274-1313:
+SGD/Momentum/LarsMomentum/Adagrad/Adam/Adamax/DecayedAdagrad/Adadelta/
+RMSProp/Ftrl/ModelAverage). In the reference each optimizer emits
+in-graph ops with accumulator variables per parameter
+(_create_optimization_pass, optimizer.py:195); here each is a pure
+pytree transform: ``init(params) -> opt_state`` builds the accumulators,
+``update(grads, opt_state, params) -> (new_params, new_opt_state)`` is
+the fused update XLA compiles into a handful of kernels (the reference's
+per-param op-dispatch overhead disappears).
+
+Regularization (global or per-ParamAttr), gradient clipping, and
+per-param LR multipliers are applied inside ``update``, mirroring the
+append_regularization_ops / append_gradient_clip_ops / param-lr flow of
+Optimizer.minimize (optimizer.py:248).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .framework import ParamInfo
+
+Params = Dict[str, jax.Array]
+Grads = Dict[str, jax.Array]
+OptState = Dict[str, Any]
+
+
+class Optimizer:
+    """Base optimizer (optimizer.py:41 Optimizer)."""
+
+    def __init__(self, learning_rate, regularization=None, grad_clip=None, name=None):
+        self._lr = learning_rate
+        self.regularization = regularization
+        self.grad_clip = grad_clip
+        self.name = name
+
+    # -- subclass interface -------------------------------------------------
+    def _create_accumulators(self, param: jax.Array) -> Dict[str, jax.Array]:
+        return {}
+
+    def _apply_dense(self, lr, param, grad, acc: Dict[str, jax.Array], state: OptState
+                     ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        raise NotImplementedError
+
+    # -- global-state hooks (e.g. beta powers) ------------------------------
+    def _init_global(self) -> Dict[str, jax.Array]:
+        return {}
+
+    def _update_global(self, g: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        return g
+
+    # -- public pytree API --------------------------------------------------
+    def init(self, params: Params) -> OptState:
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "global": self._init_global(),
+            "accums": {k: self._create_accumulators(v) for k, v in params.items()},
+        }
+
+    def learning_rate(self, step) -> jax.Array:
+        if callable(self._lr):
+            return jnp.asarray(self._lr(step), jnp.float32)
+        return jnp.asarray(self._lr, jnp.float32)
+
+    def update(
+        self,
+        grads: Grads,
+        opt_state: OptState,
+        params: Params,
+        param_info: Optional[Dict[str, ParamInfo]] = None,
+    ) -> Tuple[Params, OptState]:
+        param_info = param_info or {}
+        step = opt_state["step"]
+        lr = self.learning_rate(step)
+
+        # 1. regularization (append_regularization_ops analog; per-param
+        # attr wins over the optimizer-global setting).
+        reg_grads: Grads = {}
+        for k, g in grads.items():
+            info = param_info.get(k)
+            reg = (info.regularizer if info is not None and info.regularizer is not None
+                   else self.regularization)
+            if reg is not None and g is not None:
+                g = reg.apply(params[k], g)
+            reg_grads[k] = g
+
+        # 2. clipping (append_gradient_clip_ops analog).
+        if self.grad_clip is not None:
+            reg_grads = self.grad_clip({k: g for k, g in reg_grads.items() if g is not None},
+                                       params) | {k: g for k, g in reg_grads.items() if g is None}
+
+        # 3. per-param updates.
+        new_state: OptState = {"step": step + 1,
+                               "global": self._update_global(opt_state["global"]),
+                               "accums": {}}
+        new_params: Params = {}
+        for k, p in params.items():
+            g = reg_grads.get(k)
+            info = param_info.get(k)
+            trainable = info.trainable if info is not None else True
+            if g is None or not trainable:
+                new_params[k] = p
+                new_state["accums"][k] = opt_state["accums"][k]
+                continue
+            plr = lr * (info.learning_rate if info is not None else 1.0)
+            state_for_param = {"step": step, "global": opt_state["global"]}
+            np_, nacc = self._apply_dense(plr, p, g.astype(jnp.float32),
+                                          opt_state["accums"][k], state_for_param)
+            new_params[k] = np_.astype(p.dtype)
+            new_state["accums"][k] = nacc
+        return new_params, new_state
+
+    # convenience: apply to a (params, opt_state) pair
+    def apply_gradients(self, params, grads, opt_state, param_info=None):
+        return self.update(grads, opt_state, params, param_info)
+
+
+# ---------------------------------------------------------------------------
+
+
+class SGD(Optimizer):
+    """SGDOptimizer (optimizer.py:274; sgd_op.cc)."""
+
+    def _apply_dense(self, lr, p, g, acc, state):
+        return p - lr * g, acc
+
+
+class Momentum(Optimizer):
+    """MomentumOptimizer (optimizer.py:325; momentum_op)."""
+
+    def __init__(self, learning_rate, momentum: float = 0.9, use_nesterov: bool = False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def _create_accumulators(self, p):
+        return {"velocity": jnp.zeros(p.shape, jnp.float32)}
+
+    def _apply_dense(self, lr, p, g, acc, state):
+        v = self.momentum * acc["velocity"] + g
+        if self.use_nesterov:
+            p = p - lr * (g + self.momentum * v)
+        else:
+            p = p - lr * v
+        return p, {"velocity": v}
+
+
+class LarsMomentum(Optimizer):
+    """LarsMomentumOptimizer (optimizer.py:~400; lars_momentum_op):
+    layer-adaptive rate scaling."""
+
+    def __init__(self, learning_rate, momentum: float = 0.9, lars_coeff: float = 1e-3,
+                 lars_weight_decay: float = 5e-4, epsilon: float = 0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self.momentum = momentum
+        self.lars_coeff = lars_coeff
+        self.lars_weight_decay = lars_weight_decay
+        self.epsilon = epsilon
+
+    def _create_accumulators(self, p):
+        return {"velocity": jnp.zeros(p.shape, jnp.float32)}
+
+    def _apply_dense(self, lr, p, g, acc, state):
+        p32 = p.astype(jnp.float32)
+        pn = jnp.sqrt(jnp.sum(p32 * p32))
+        gn = jnp.sqrt(jnp.sum(g * g))
+        local_lr = jnp.where(
+            (pn > 0) & (gn > 0),
+            lr * self.lars_coeff * pn / (gn + self.lars_weight_decay * pn + self.epsilon),
+            lr)
+        v = self.momentum * acc["velocity"] + local_lr * (g + self.lars_weight_decay * p32)
+        return p32 - v, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    """AdagradOptimizer (optimizer.py:~470; adagrad_op)."""
+
+    def __init__(self, learning_rate, epsilon: float = 1e-6,
+                 initial_accumulator_value: float = 0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self.epsilon = epsilon
+        self.init_acc = initial_accumulator_value
+
+    def _create_accumulators(self, p):
+        return {"moment": jnp.full(p.shape, self.init_acc, jnp.float32)}
+
+    def _apply_dense(self, lr, p, g, acc, state):
+        m = acc["moment"] + g * g
+        return p - lr * g / (jnp.sqrt(m) + self.epsilon), {"moment": m}
+
+
+class Adam(Optimizer):
+    """AdamOptimizer (optimizer.py:~520; adam_op.cc). Bias correction via
+    global beta1^t/beta2^t accumulators, matching the reference."""
+
+    def __init__(self, learning_rate=0.001, beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8, lazy_mode: bool = False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_global(self):
+        return {"beta1_pow": jnp.ones((), jnp.float32) * self.beta1,
+                "beta2_pow": jnp.ones((), jnp.float32) * self.beta2}
+
+    def _update_global(self, g):
+        return {"beta1_pow": g["beta1_pow"] * self.beta1,
+                "beta2_pow": g["beta2_pow"] * self.beta2}
+
+    def _create_accumulators(self, p):
+        return {"moment1": jnp.zeros(p.shape, jnp.float32),
+                "moment2": jnp.zeros(p.shape, jnp.float32)}
+
+    def _apply_dense(self, lr, p, g, acc, state):
+        b1p = state["global"]["beta1_pow"]
+        b2p = state["global"]["beta2_pow"]
+        m1 = self.beta1 * acc["moment1"] + (1 - self.beta1) * g
+        m2 = self.beta2 * acc["moment2"] + (1 - self.beta2) * g * g
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        p = p - lr_t * m1 / (jnp.sqrt(m2) + self.epsilon)
+        return p, {"moment1": m1, "moment2": m2}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay variant (modern addition; weight decay is
+    applied directly to params, not through grads)."""
+
+    def __init__(self, learning_rate=0.001, weight_decay: float = 0.01, **kw):
+        super().__init__(learning_rate, **kw)
+        self.weight_decay = weight_decay
+
+    def _apply_dense(self, lr, p, g, acc, state):
+        p2, nacc = super()._apply_dense(lr, p, g, acc, state)
+        return p2 - lr * self.weight_decay * p.astype(jnp.float32), nacc
+
+
+class Adamax(Optimizer):
+    """AdamaxOptimizer (optimizer.py:~600; adamax_op)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_global(self):
+        return {"beta1_pow": jnp.ones((), jnp.float32) * self.beta1}
+
+    def _update_global(self, g):
+        return {"beta1_pow": g["beta1_pow"] * self.beta1}
+
+    def _create_accumulators(self, p):
+        return {"moment": jnp.zeros(p.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p.shape, jnp.float32)}
+
+    def _apply_dense(self, lr, p, g, acc, state):
+        b1p = state["global"]["beta1_pow"]
+        m = self.beta1 * acc["moment"] + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * acc["inf_norm"], jnp.abs(g) + self.epsilon)
+        p = p - (lr / (1 - b1p)) * m / u
+        return p, {"moment": m, "inf_norm": u}
+
+
+class DecayedAdagrad(Optimizer):
+    """DecayedAdagradOptimizer (optimizer.py:~680; decayed_adagrad_op)."""
+
+    def __init__(self, learning_rate, decay: float = 0.95, epsilon: float = 1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.decay, self.epsilon = decay, epsilon
+
+    def _create_accumulators(self, p):
+        return {"moment": jnp.zeros(p.shape, jnp.float32)}
+
+    def _apply_dense(self, lr, p, g, acc, state):
+        m = self.decay * acc["moment"] + (1 - self.decay) * g * g
+        return p - lr * g / (jnp.sqrt(m) + self.epsilon), {"moment": m}
+
+
+class Adadelta(Optimizer):
+    """AdadeltaOptimizer (optimizer.py:~730; adadelta_op)."""
+
+    def __init__(self, learning_rate=1.0, epsilon: float = 1e-6, rho: float = 0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self.epsilon, self.rho = epsilon, rho
+
+    def _create_accumulators(self, p):
+        return {"avg_squared_grad": jnp.zeros(p.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(p.shape, jnp.float32)}
+
+    def _apply_dense(self, lr, p, g, acc, state):
+        sg = self.rho * acc["avg_squared_grad"] + (1 - self.rho) * g * g
+        upd = g * jnp.sqrt(acc["avg_squared_update"] + self.epsilon) / jnp.sqrt(sg + self.epsilon)
+        su = self.rho * acc["avg_squared_update"] + (1 - self.rho) * upd * upd
+        return p - lr * upd, {"avg_squared_grad": sg, "avg_squared_update": su}
+
+
+class RMSProp(Optimizer):
+    """RMSPropOptimizer (optimizer.py:~790; rmsprop_op) with momentum and
+    centered variants, matching the reference's attrs."""
+
+    def __init__(self, learning_rate, rho: float = 0.95, epsilon: float = 1e-6,
+                 momentum: float = 0.0, centered: bool = False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.rho, self.epsilon, self.momentum, self.centered = rho, epsilon, momentum, centered
+
+    def _create_accumulators(self, p):
+        return {"mean_square": jnp.zeros(p.shape, jnp.float32),
+                "mean_grad": jnp.zeros(p.shape, jnp.float32),
+                "momentum": jnp.zeros(p.shape, jnp.float32)}
+
+    def _apply_dense(self, lr, p, g, acc, state):
+        ms = self.rho * acc["mean_square"] + (1 - self.rho) * g * g
+        if self.centered:
+            mg = self.rho * acc["mean_grad"] + (1 - self.rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self.epsilon)
+        else:
+            mg = acc["mean_grad"]
+            denom = jnp.sqrt(ms + self.epsilon)
+        mom = self.momentum * acc["momentum"] + lr * g / denom
+        return p - mom, {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Ftrl(Optimizer):
+    """FtrlOptimizer (optimizer.py:~870; ftrl_op)."""
+
+    def __init__(self, learning_rate, l1: float = 0.0, l2: float = 0.0,
+                 lr_power: float = -0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2, self.lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, p):
+        return {"squared": jnp.zeros(p.shape, jnp.float32),
+                "linear": jnp.zeros(p.shape, jnp.float32)}
+
+    def _apply_dense(self, lr, p, g, acc, state):
+        p32 = p.astype(jnp.float32)
+        new_sq = acc["squared"] + g * g
+        if self.lr_power == -0.5:
+            sigma = (jnp.sqrt(new_sq) - jnp.sqrt(acc["squared"])) / lr
+        else:
+            sigma = (jnp.power(new_sq, -self.lr_power) - jnp.power(acc["squared"], -self.lr_power)) / lr
+        lin = acc["linear"] + g - sigma * p32
+        if self.lr_power == -0.5:
+            x = self.l2 + jnp.sqrt(new_sq) / lr
+        else:
+            x = self.l2 + jnp.power(new_sq, -self.lr_power) / lr
+        pre = jnp.clip(lin, -self.l1, self.l1) - lin
+        new_p = jnp.where(jnp.abs(lin) > self.l1, pre / x, jnp.zeros_like(p32))
+        return new_p, {"squared": new_sq, "linear": lin}
+
+
+class Lamb(Optimizer):
+    """LAMB (layerwise-adaptive Adam for large batch) — modern addition
+    used for BERT-scale training."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay: float = 0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.wd, self.beta1, self.beta2, self.epsilon = lamb_weight_decay, beta1, beta2, epsilon
+
+    def _create_accumulators(self, p):
+        return {"moment1": jnp.zeros(p.shape, jnp.float32),
+                "moment2": jnp.zeros(p.shape, jnp.float32)}
+
+    def _apply_dense(self, lr, p, g, acc, state):
+        t = state["step"].astype(jnp.float32) + 1.0
+        p32 = p.astype(jnp.float32)
+        m1 = self.beta1 * acc["moment1"] + (1 - self.beta1) * g
+        m2 = self.beta2 * acc["moment2"] + (1 - self.beta2) * g * g
+        m1h = m1 / (1 - jnp.power(self.beta1, t))
+        m2h = m2 / (1 - jnp.power(self.beta2, t))
+        r = m1h / (jnp.sqrt(m2h) + self.epsilon) + self.wd * p32
+        pn = jnp.sqrt(jnp.sum(p32 * p32))
+        rn = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((pn > 0) & (rn > 0), pn / rn, 1.0)
+        return p32 - lr * trust * r, {"moment1": m1, "moment2": m2}
+
+
+class ModelAverage:
+    """ModelAverageOptimizer (optimizer.py:~1313): maintains a running
+    average of parameters for evaluation. Functional version: feed every
+    post-update params pytree to ``accumulate``; use ``average_params``
+    for eval (apply_program analog) and keep the originals to restore."""
+
+    def __init__(self, average_window_rate: float = 0.15,
+                 min_average_window: int = 10000, max_average_window: int = 10000):
+        self.rate = average_window_rate
+        self.min_w, self.max_w = min_average_window, max_average_window
+
+    def init(self, params: Params):
+        return {"sum": jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+                "num": jnp.zeros((), jnp.float32)}
+
+    def accumulate(self, avg_state, params: Params):
+        num = avg_state["num"] + 1.0
+        s = jax.tree.map(lambda a, p: a + p.astype(jnp.float32), avg_state["sum"], params)
+        # window restart mirroring the reference's num_updates window logic
+        restart = num > self.max_w
+        s = jax.tree.map(lambda a, p: jnp.where(restart, p.astype(jnp.float32), a), s, params)
+        num = jnp.where(restart, jnp.ones_like(num), num)
+        return {"sum": s, "num": num}
+
+    def average_params(self, avg_state, params: Params) -> Params:
+        n = jnp.maximum(avg_state["num"], 1.0)
+        return {k: (avg_state["sum"][k] / n).astype(v.dtype) for k, v in params.items()}
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (fluid ExponentialMovingAverage analog)."""
+
+    def __init__(self, decay: float = 0.999):
+        self.decay = decay
+
+    def init(self, params: Params):
+        return jax.tree.map(lambda p: p.astype(jnp.float32), params)
+
+    def accumulate(self, ema, params: Params):
+        return jax.tree.map(lambda e, p: self.decay * e + (1 - self.decay) * p.astype(jnp.float32),
+                            ema, params)
+
+    def average_params(self, ema, params: Params) -> Params:
+        return {k: ema[k].astype(v.dtype) for k, v in params.items()}
+
+
+# fluid-style aliases
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+LarsMomentumOptimizer = LarsMomentum
+AdagradOptimizer = Adagrad
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+DecayedAdagradOptimizer = DecayedAdagrad
+AdadeltaOptimizer = Adadelta
+RMSPropOptimizer = RMSProp
+FtrlOptimizer = Ftrl

@@ -1,0 +1,15 @@
+"""Parallelism over TPU meshes — the reference's ParallelExecutor +
+DistributeTranspiler capabilities re-expressed as sharding (SURVEY §2.2/§7)."""
+
+from . import api, mesh, sharding, strategy
+from .mesh import DATA_AXES, DP, EP, FSDP, PP, SP, TP, data_parallel_size, initialize, make_mesh
+from .sharding import ShardingRules, fsdp, replicated, transformer_tp_rules
+from .strategy import DistStrategy
+
+__all__ = [
+    "api", "mesh", "sharding", "strategy",
+    "DATA_AXES", "DP", "EP", "FSDP", "PP", "SP", "TP",
+    "data_parallel_size", "initialize", "make_mesh",
+    "ShardingRules", "fsdp", "replicated", "transformer_tp_rules",
+    "DistStrategy",
+]

@@ -1,0 +1,72 @@
+"""Glue between Trainer and the mesh/sharding machinery.
+
+Replaces the reference's ParallelExecutor orchestration
+(parallel_executor.cc:94-177: NCCL init, param broadcast, SSA build,
+threaded scheduler): here it is device_put with NamedShardings + one
+jax.jit — XLA's SPMD partitioner plays the role of
+MultiDevSSAGraphBuilder and the collective op handles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .sharding import ShardingRules, replicated
+
+
+def _rules(rules: Optional[ShardingRules]) -> ShardingRules:
+    return rules if rules is not None else replicated()
+
+
+def shard_scope(mesh: Mesh, rules: Optional[ShardingRules], params, state, opt_state):
+    """Place params/state/opt_state on the mesh per the rule table.
+
+    Optimizer accumulators inherit their parameter's spec (they have the
+    same shape — the reference's pserver also co-located optimizer state
+    with its param shard). This is the BCastParamsToDevices analog
+    (parallel_executor.cc:180) — replication or sharding by annotation.
+    """
+    rules = _rules(rules)
+    sharded_params = rules.shard_params(mesh, params)
+
+    repl = NamedSharding(mesh, P())
+    state = {k: jax.device_put(v, repl) for k, v in state.items()}
+
+    def place_opt(os):
+        out: Dict[str, Any] = {}
+        out["step"] = jax.device_put(os["step"], repl)
+        out["global"] = jax.device_put(os["global"], repl)
+        accums = {}
+        for pname, acc in os.get("accums", {}).items():
+            spec = rules.spec_for(pname, params[pname].shape, mesh)
+            ns = NamedSharding(mesh, spec)
+            accums[pname] = {k: jax.device_put(v, ns if v.shape == params[pname].shape else repl)
+                             for k, v in acc.items()}
+        out["accums"] = accums
+        return out
+
+    return sharded_params, state, place_opt(opt_state) if opt_state is not None else None
+
+
+def put_batch(mesh: Mesh, rules: Optional[ShardingRules], feed: Dict[str, Any]):
+    """Shard a host batch over the data axes (DataFeeder.feed_parallel
+    analog, data_feeder.py:201 — without the per-device split loop)."""
+    rules = _rules(rules)
+    out = {}
+    for k, v in feed.items():
+        arr = np.asarray(v) if not isinstance(v, jax.Array) else v
+        spec = rules.batch_spec(mesh, arr.ndim)
+        out[k] = jax.device_put(arr, NamedSharding(mesh, spec))
+    return out
+
+
+def jit_sharded_step(mesh: Mesh, rules: Optional[ShardingRules], fn, donate_argnums=(),
+                     scope=None):
+    """Compile the train step for SPMD execution. Input arrays are
+    already committed to NamedShardings (shard_scope/put_batch), so GSPMD
+    propagates; gradient psums over the data axes are inserted by XLA."""
+    return jax.jit(fn, donate_argnums=donate_argnums)

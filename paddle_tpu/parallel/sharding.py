@@ -1,0 +1,152 @@
+"""Sharding rules: name-pattern → PartitionSpec.
+
+This is the BuildStrategy/DistributeTranspiler analog collapsed into
+data (SURVEY §7): where the reference *rewrote programs* to place
+parameters (slice_variable distribute_transpiler.py:81, multi-device
+SSA replication multi_devices_graph_pass.cc), we annotate. A
+:class:`ShardingRules` maps parameter-name regexes to PartitionSpecs;
+XLA's SPMD partitioner inserts the collectives (psum for grads —
+AllReduceOpHandle analog; all-gathers for fsdp params — the
+param-slicing/broadcast analog).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+SpecLike = Union[P, Tuple, None]
+
+
+class ShardingRules:
+    """Ordered (regex → PartitionSpec) table for parameters, plus the
+    batch-axis spec for inputs.
+
+    Example (transformer TP+FSDP)::
+
+        rules = ShardingRules([
+            (r".*/attn_qkv/w", P("fsdp", "tp")),
+            (r".*/attn_out/w", P("tp", "fsdp")),
+            (r".*/ffn_in/w",  P("fsdp", "tp")),
+            (r".*/ffn_out/w", P("tp", "fsdp")),
+            (r".*embedding.*/w", P("tp", None)),
+        ], default=P())
+    """
+
+    def __init__(self, rules: Optional[Sequence[Tuple[str, SpecLike]]] = None,
+                 default: SpecLike = None,
+                 batch_axes: Optional[Sequence[str]] = None):
+        self.rules = [(re.compile(pat), _as_spec(spec)) for pat, spec in (rules or [])]
+        self.default = _as_spec(default)
+        self.batch_axes = tuple(batch_axes) if batch_axes is not None else None
+
+    # ------------------------------------------------------------------
+    def spec_for(self, name: str, shape: Tuple[int, ...], mesh: Mesh) -> P:
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return _validate(spec, shape, mesh, name)
+        return _validate(self.default, shape, mesh, name)
+
+    def batch_spec(self, mesh: Mesh, ndim: int) -> P:
+        axes = self.batch_axes if self.batch_axes is not None else mesh_lib.data_axis_names(mesh)
+        axes = tuple(a for a in axes if a in mesh.axis_names and mesh.shape[a] > 1)
+        if not axes:
+            return P()
+        return P(axes if len(axes) > 1 else axes[0], *([None] * (ndim - 1)))
+
+    def shard_params(self, mesh: Mesh, params: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        out = {}
+        for k, v in params.items():
+            ns = NamedSharding(mesh, self.spec_for(k, v.shape, mesh))
+            out[k] = jax.device_put(v, ns)
+        return out
+
+
+def _as_spec(spec: SpecLike) -> P:
+    if spec is None:
+        return P()
+    if isinstance(spec, P):
+        return spec
+    return P(*spec)
+
+
+def _validate(spec: P, shape: Tuple[int, ...], mesh: Mesh, name: str) -> P:
+    """Drop axes that don't divide the dim or aren't in the mesh —
+    permissive like GSPMD, but done eagerly so placement is predictable."""
+    out = []
+    for i, entry in enumerate(spec):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        keep = []
+        size = 1
+        for a in axes:
+            if a in mesh.axis_names:
+                keep.append(a)
+                size *= mesh.shape[a]
+        if not keep or i >= len(shape) or shape[i] % size != 0:
+            out.append(None)
+        else:
+            out.append(tuple(keep) if len(keep) > 1 else keep[0])
+    out = out[:len(shape)]
+    return P(*out)
+
+
+# Preset rule tables ---------------------------------------------------------
+
+def replicated() -> ShardingRules:
+    """Pure DP: params replicated, grads psum'd — kAllReduce mode."""
+    return ShardingRules([], default=P())
+
+
+def fsdp(min_size_to_shard: int = 1024) -> ShardingRules:
+    """Shard every parameter's largest dim over 'fsdp' — the kReduce /
+    pserver param-slicing analog (build_strategy.h:34, ZeRO-3-ish).
+    Rule resolution happens per-shape in spec_for via _LargestDim."""
+    return _FsdpRules(min_size_to_shard)
+
+
+class _FsdpRules(ShardingRules):
+    def __init__(self, min_size_to_shard: int):
+        super().__init__([], default=P())
+        self.min_size = min_size_to_shard
+
+    def spec_for(self, name, shape, mesh):
+        if mesh_lib.FSDP not in mesh.axis_names or not shape:
+            return P()
+        n = mesh.shape[mesh_lib.FSDP]
+        size = 1
+        for s in shape:
+            size *= s
+        if size < self.min_size:
+            return P()
+        # shard the largest divisible dim
+        order = sorted(range(len(shape)), key=lambda i: -shape[i])
+        for i in order:
+            if shape[i] % n == 0:
+                spec = [None] * len(shape)
+                spec[i] = mesh_lib.FSDP
+                return P(*spec)
+        return P()
+
+
+def transformer_tp_rules(extra: Sequence[Tuple[str, SpecLike]] = ()) -> ShardingRules:
+    """Megatron-style TP rules for the built-in transformer/BERT models
+    (gap-fill capability per SURVEY §2.2: TP absent in reference)."""
+    rules = [
+        (r".*(q_proj|k_proj|v_proj|qkv_proj)/w$", P("fsdp", "tp")),
+        (r".*(q_proj|k_proj|v_proj|qkv_proj)/b$", P("tp")),
+        (r".*out_proj/w$", P("tp", "fsdp")),
+        (r".*ffn_in/w$", P("fsdp", "tp")),
+        (r".*ffn_in/b$", P("tp")),
+        (r".*ffn_out/w$", P("tp", "fsdp")),
+        (r".*embedding.*/w$", P("tp", None)),
+        (r".*/w$", P(None, "fsdp")),
+    ] + list(extra)
+    return ShardingRules(rules, default=P())

@@ -1,0 +1,27 @@
+"""Distributed/execution strategy objects.
+
+Analog of ExecutionStrategy/BuildStrategy (pybind.cc:675/:757,
+details/build_strategy.h:34) and DistributeTranspilerConfig
+(distribute_transpiler.py:127) — the knob surface, as a dataclass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DistStrategy:
+    # multi_batch_merge_pass analog: microbatch gradient accumulation.
+    accum_steps: int = 1
+    # kAllReduce vs kReduce (build_strategy.h:55): 'allreduce' replicates
+    # params; 'sharded' (fsdp) shards params+optimizer state.
+    reduce_strategy: str = "allreduce"
+    # donation / rematerialization knobs (memory_optimize analog).
+    donate_buffers: bool = True
+    remat: bool = False
+    # loss scaling for mixed precision.
+    loss_scale: Optional[float] = None
+    # debug dump of the compiled HLO (debug_graphviz_path analog).
+    dump_hlo_path: Optional[str] = None

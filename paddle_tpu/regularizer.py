@@ -1,0 +1,44 @@
+"""Weight-decay regularizers.
+
+Analog of python/paddle/fluid/regularizer.py: in the reference these
+append penalty ops to each param's gradient during
+``Optimizer.minimize``; here they are pure ``(param, grad) -> grad``
+transforms the optimizer applies inside the jitted update (XLA fuses
+them into the update kernel — the reference needed separate ops).
+Per-parameter regularizers set via ParamAttr override the optimizer's
+global one, matching the reference's precedence (regularizer.py:36).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    def apply(self, param, grad):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 weight decay: grad += coeff * param (L2DecayRegularizer)."""
+
+    def __init__(self, regularization_coeff: float = 0.0):
+        self.coeff = float(regularization_coeff)
+
+    def apply(self, param, grad):
+        return grad + self.coeff * param
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 decay: grad += coeff * sign(param) (L1DecayRegularizer)."""
+
+    def __init__(self, regularization_coeff: float = 0.0):
+        self.coeff = float(regularization_coeff)
+
+    def apply(self, param, grad):
+        return grad + self.coeff * jnp.sign(param)
+
+
+# fluid aliases
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

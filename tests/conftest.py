@@ -1,0 +1,35 @@
+"""Test config: force an 8-device virtual CPU mesh (SURVEY §4's
+"multi-place in-process fixtures" analog — the XLA host-device-count
+trick) so sharding paths are exercised without TPU hardware."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override the session's axon/TPU default
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax
+
+# The axon sitecustomize boot hook force-updates jax_platforms to
+# "axon,cpu" (axon/register/ifrt.py), which beats the env var — undo it
+# before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+assert jax.devices()[0].platform == "cpu", "tests must run on the virtual CPU mesh"
+assert jax.device_count() == 8, "xla_force_host_platform_device_count=8 not in effect"
+
+
+@pytest.fixture
+def rng():
+    import jax
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture(autouse=True)
+def _seed_numpy():
+    np.random.seed(0)

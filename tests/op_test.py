@@ -1,0 +1,74 @@
+"""Op-test harness: numeric-reference and finite-difference grad checks.
+
+Analog of python/paddle/fluid/tests/unittests/op_test.py (OpTest:131):
+``check_output`` compares a layer's outputs against a numpy reference
+(op_test.py:293), ``check_grad`` compares jax.grad against central
+finite differences (get_numeric_gradient, op_test.py:43).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_output(fn: Callable, np_ref: Callable, inputs: Sequence[np.ndarray],
+                 atol: float = 1e-5, rtol: float = 1e-5):
+    """Run fn (jax) and np_ref (numpy) on the same inputs; compare."""
+    got = fn(*[jnp.asarray(x) for x in inputs])
+    want = np_ref(*inputs)
+    got_flat = jax.tree.leaves(got)
+    want_flat = jax.tree.leaves(want)
+    assert len(got_flat) == len(want_flat), (
+        f"output arity mismatch: {len(got_flat)} vs {len(want_flat)}")
+    for g, w in zip(got_flat, want_flat):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=atol, rtol=rtol)
+
+
+def numeric_grad(fn: Callable, inputs: Sequence[np.ndarray], wrt: int = 0,
+                 eps: float = 1e-3) -> np.ndarray:
+    """Central finite-difference gradient of sum(fn(...)) wrt inputs[wrt]
+    (get_numeric_gradient analog, op_test.py:43)."""
+    inputs = [np.asarray(x, dtype=np.float64 if np.issubdtype(np.asarray(x).dtype, np.floating)
+              else np.asarray(x).dtype) for x in inputs]
+    x = inputs[wrt]
+    grad = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+
+    def f(v):
+        args = list(inputs)
+        args[wrt] = v.reshape(x.shape).astype(np.float32)
+        out = fn(*[jnp.asarray(a) for a in args])
+        return float(jnp.sum(jnp.asarray(out, dtype=jnp.float32)))
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        fp = f(flat)
+        flat[i] = orig - eps
+        fm = f(flat)
+        flat[i] = orig
+        gflat[i] = (fp - fm) / (2 * eps)
+    return grad
+
+
+def check_grad(fn: Callable, inputs: Sequence[np.ndarray], wrt: int = 0,
+               eps: float = 1e-3, atol: float = 1e-2, rtol: float = 1e-2):
+    """Compare jax.grad of sum(fn) against finite differences
+    (check_grad_with_place analog, op_test.py:400)."""
+    jinputs = [jnp.asarray(np.asarray(x, dtype=np.float32)
+                           if np.issubdtype(np.asarray(x).dtype, np.floating)
+                           else np.asarray(x)) for x in inputs]
+
+    def loss(v):
+        args = list(jinputs)
+        args[wrt] = v
+        return jnp.sum(fn(*args).astype(jnp.float32))
+
+    analytic = np.asarray(jax.grad(loss)(jinputs[wrt]))
+    numeric = numeric_grad(fn, inputs, wrt, eps)
+    np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol)

@@ -1,0 +1,133 @@
+"""Framework-core tests: scope, naming, state, ParamAttr — the
+scope_test.cc / operator_test.cc / test_program.py family analog."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import initializer as init
+from paddle_tpu import layers as L
+from paddle_tpu.core.errors import EnforceError, NotFoundError
+
+
+def test_unique_names_stable_across_init_apply():
+    def net(x):
+        a = L.fc(x, 4)
+        b = L.fc(x, 4)
+        return a + b
+
+    prog = pt.build(net)
+    x = np.random.randn(2, 3).astype(np.float32)
+    params, state = prog.init(jax.random.PRNGKey(0), x)
+    assert set(params) == {"fc_0/w", "fc_0/b", "fc_1/w", "fc_1/b"}
+    out, _ = prog.apply(params, state, x)  # must not raise NotFound
+    assert out.shape == (2, 4)
+
+
+def test_param_attr_custom_name_and_initializer():
+    def net(x):
+        return L.fc(x, 3, param_attr=pt.ParamAttr(name="my_w", initializer=init.Constant(2.0)),
+                    bias_attr=False)
+
+    prog = pt.build(net)
+    x = np.ones((1, 2), np.float32)
+    params, _ = prog.init(jax.random.PRNGKey(0), x)
+    assert "my_w" in params
+    np.testing.assert_allclose(np.asarray(params["my_w"]), 2.0)
+
+
+def test_layer_outside_context_raises():
+    with pytest.raises(EnforceError):
+        L.fc(jnp.ones((1, 2)), 3)
+
+
+def test_missing_param_raises_not_found():
+    prog = pt.build(lambda x: L.fc(x, 3))
+    x = np.ones((1, 2), np.float32)
+    prog.init(jax.random.PRNGKey(0), x)
+    with pytest.raises(NotFoundError):
+        prog.apply({}, {}, x)
+
+
+def test_init_deterministic_under_same_seed():
+    prog = pt.build(lambda x: L.fc(x, 8))
+    x = np.ones((1, 4), np.float32)
+    p1, _ = prog.init(jax.random.PRNGKey(7), x)
+    p2, _ = prog.init(jax.random.PRNGKey(7), x)
+    np.testing.assert_allclose(np.asarray(p1["fc_0/w"]), np.asarray(p2["fc_0/w"]))
+    p3, _ = prog.init(jax.random.PRNGKey(8), x)
+    assert not np.allclose(np.asarray(p1["fc_0/w"]), np.asarray(p3["fc_0/w"]))
+
+
+def test_shape_dtype_struct_init():
+    prog = pt.build(lambda x: L.fc(x, 5))
+    spec = jax.ShapeDtypeStruct((2, 3), jnp.float32)
+    params, _ = prog.init(jax.random.PRNGKey(0), spec)
+    assert params["fc_0/w"].shape == (3, 5)
+
+
+def test_state_threading_batch_norm():
+    prog = pt.build(lambda x: L.batch_norm(x))
+    x = np.random.randn(4, 2).astype(np.float32)
+    params, state = prog.init(jax.random.PRNGKey(0), x)
+    assert "batch_norm_0/moving_mean" in state
+    _, s1 = prog.apply(params, state, x, training=True)
+    _, s2 = prog.apply(params, s1, x, training=True)
+    # moving mean moves monotonically toward batch mean over steps
+    assert not np.allclose(np.asarray(s1["batch_norm_0/moving_mean"]),
+                           np.asarray(s2["batch_norm_0/moving_mean"]))
+
+
+def test_name_scope_nesting():
+    def net(x):
+        with pt.name_scope("encoder"):
+            h = L.fc(x, 4)
+        return h
+
+    prog = pt.build(net)
+    params, _ = prog.init(jax.random.PRNGKey(0), np.ones((1, 2), np.float32))
+    assert any(k.startswith("encoder/fc_0/") for k in params)
+
+
+def test_program_desc_jaxpr():
+    prog = pt.build(lambda x: L.fc(x, 3))
+    x = np.ones((1, 2), np.float32)
+    params, state = prog.init(jax.random.PRNGKey(0), x)
+    jaxpr = prog.desc(params, state, x)
+    assert "dot_general" in str(jaxpr)
+
+
+def test_initializers():
+    key = jax.random.PRNGKey(0)
+    assert float(init.Constant(3.0)(key, (2,), jnp.float32)[0]) == 3.0
+    u = init.Uniform(-0.5, 0.5)(key, (1000,), jnp.float32)
+    assert -0.5 <= float(u.min()) and float(u.max()) <= 0.5
+    n = np.asarray(init.Normal(0, 1)(key, (5000,), jnp.float32))
+    assert abs(n.mean()) < 0.1 and abs(n.std() - 1) < 0.1
+    x = np.asarray(init.Xavier()(key, (100, 100), jnp.float32))
+    limit = np.sqrt(6.0 / 200)
+    assert x.min() >= -limit and x.max() <= limit
+    m = np.asarray(init.MSRA(uniform=False)(key, (64, 32, 3, 3), jnp.float32))
+    assert abs(m.std() - np.sqrt(2.0 / (32 * 9))) < 0.01
+    b = init.Bilinear()(key, (1, 1, 4, 4), jnp.float32)
+    assert b.shape == (1, 1, 4, 4)
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    np.testing.assert_allclose(np.asarray(init.NumpyArrayInitializer(arr)(key, (2, 3), jnp.float32)), arr)
+
+
+def test_enforce_helpers():
+    from paddle_tpu.core.errors import enforce, enforce_eq
+    enforce(True)
+    with pytest.raises(EnforceError):
+        enforce(False, "boom %d", 42)
+    with pytest.raises(EnforceError):
+        enforce_eq(1, 2)
+
+
+def test_flags_env(monkeypatch):
+    from paddle_tpu.core import config
+    config.set_flag("check_nan_inf", True)
+    assert config.get_flag("check_nan_inf") is True
+    config.set_flag("check_nan_inf", False)

@@ -1,0 +1,279 @@
+"""Layer-op tests with numpy references + FD grad checks — the
+test_*_op.py suite analog (SURVEY §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+
+from op_test import check_grad, check_output
+
+
+def run_layer(fn, *inputs, training=False, rng_seed=None, **kwargs):
+    """Build a one-layer program and run init+apply — the OpTest
+    single-op-program pattern."""
+    prog = pt.build(lambda *a: fn(*a, **kwargs))
+    params, state = prog.init(jax.random.PRNGKey(0), *inputs)
+    rng = jax.random.PRNGKey(rng_seed) if rng_seed is not None else None
+    out, _ = prog.apply(params, state, *inputs, training=training, rng=rng)
+    return out, params
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_fc_output_and_grad():
+    x = np.random.randn(4, 8).astype(np.float32)
+    prog = pt.build(lambda a: L.fc(a, 16))
+    params, state = prog.init(jax.random.PRNGKey(0), x)
+    out, _ = prog.apply(params, state, x)
+    w, b = np.asarray(params["fc_0/w"]), np.asarray(params["fc_0/b"])
+    np.testing.assert_allclose(np.asarray(out), x @ w + b, rtol=1e-5, atol=1e-5)
+
+
+def test_fc_num_flatten_dims():
+    x = np.random.randn(2, 3, 4, 5).astype(np.float32)
+    out, params = run_layer(L.fc, x, size=7, num_flatten_dims=2)
+    assert out.shape == (2, 3, 7)
+
+
+def test_fc_multiple_inputs_summed():
+    x1 = np.random.randn(4, 8).astype(np.float32)
+    x2 = np.random.randn(4, 6).astype(np.float32)
+    prog = pt.build(lambda a, b: L.fc([a, b], 5))
+    params, state = prog.init(jax.random.PRNGKey(0), x1, x2)
+    out, _ = prog.apply(params, state, x1, x2)
+    assert out.shape == (4, 5)
+    assert "fc_0/w_0" in params and "fc_0/w_1" in params
+
+
+def test_embedding_lookup_and_padding_idx():
+    ids = np.array([[1], [0], [3]], dtype=np.int64)
+    prog = pt.build(lambda i: L.embedding(i, size=[5, 4], padding_idx=0))
+    params, state = prog.init(jax.random.PRNGKey(0), ids)
+    out, _ = prog.apply(params, state, ids)
+    table = np.asarray(params["embedding_0/w"])
+    np.testing.assert_allclose(np.asarray(out[0]), table[1], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out[1]), np.zeros(4), atol=1e-7)
+
+
+def test_conv2d_matches_manual():
+    # 1x1 conv == channelwise matmul
+    x = np.random.randn(2, 3, 5, 5).astype(np.float32)
+    prog = pt.build(lambda a: L.conv2d(a, num_filters=4, filter_size=1, bias_attr=False))
+    params, state = prog.init(jax.random.PRNGKey(0), x)
+    out, _ = prog.apply(params, state, x)
+    w = np.asarray(params["conv2d_0/w"]).reshape(4, 3)
+    want = np.einsum("nchw,oc->nohw", x, w)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_shapes_padding_stride():
+    x = np.random.randn(1, 3, 8, 8).astype(np.float32)
+    out, _ = run_layer(L.conv2d, x, num_filters=6, filter_size=3, stride=2, padding=1)
+    assert out.shape == (1, 6, 4, 4)
+
+
+def test_conv2d_groups():
+    x = np.random.randn(1, 4, 6, 6).astype(np.float32)
+    out, _ = run_layer(L.conv2d, x, num_filters=4, filter_size=3, groups=2, padding=1)
+    assert out.shape == (1, 4, 6, 6)
+
+
+def test_pool2d_max_and_avg():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out, _ = run_layer(L.pool2d, x, pool_size=2, pool_type="max", pool_stride=2)
+    np.testing.assert_allclose(np.asarray(out)[0, 0], [[5, 7], [13, 15]])
+    out, _ = run_layer(L.pool2d, x, pool_size=2, pool_type="avg", pool_stride=2)
+    np.testing.assert_allclose(np.asarray(out)[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_pool2d_global():
+    x = np.random.randn(2, 3, 5, 5).astype(np.float32)
+    out, _ = run_layer(L.pool2d, x, pool_type="avg", global_pooling=True)
+    np.testing.assert_allclose(np.asarray(out)[:, :, 0, 0], x.mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_batch_norm_train_and_infer():
+    x = np.random.randn(8, 4, 3, 3).astype(np.float32) * 3 + 1
+
+    def net(a):
+        return L.batch_norm(a)
+
+    prog = pt.build(net)
+    params, state = prog.init(jax.random.PRNGKey(0), x)
+    out, new_state = prog.apply(params, state, x, training=True)
+    o = np.asarray(out)
+    np.testing.assert_allclose(o.mean(axis=(0, 2, 3)), 0, atol=1e-4)
+    np.testing.assert_allclose(o.std(axis=(0, 2, 3)), 1, atol=1e-2)
+    # moving stats updated toward batch stats
+    mm = np.asarray(new_state["batch_norm_0/moving_mean"])
+    assert not np.allclose(mm, 0)
+    # inference path uses moving stats (no batch dependence)
+    out1, _ = prog.apply(params, new_state, x[:2], training=False)
+    out2, _ = prog.apply(params, new_state, x[:4], training=False)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2)[:2], rtol=1e-5)
+
+
+def test_layer_norm():
+    x = np.random.randn(4, 10).astype(np.float32)
+    out, _ = run_layer(L.layer_norm, x)
+    o = np.asarray(out)
+    np.testing.assert_allclose(o.mean(axis=1), 0, atol=1e-5)
+    np.testing.assert_allclose(o.std(axis=1), 1, atol=1e-2)
+
+
+def test_dropout_semantics():
+    x = np.ones((1000,), dtype=np.float32)
+    # downgrade_in_infer (reference default): infer scales by (1-p)
+    out, _ = run_layer(L.dropout, x, dropout_prob=0.3, training=False)
+    np.testing.assert_allclose(np.asarray(out), 0.7 * x, rtol=1e-6)
+    out, _ = run_layer(L.dropout, x, dropout_prob=0.3, training=True, rng_seed=0)
+    kept = np.asarray(out) > 0
+    assert 0.6 < kept.mean() < 0.8
+    # upscale_in_train: train scales kept by 1/(1-p)
+    out, _ = run_layer(L.dropout, x, dropout_prob=0.5, training=True, rng_seed=0,
+                       dropout_implementation="upscale_in_train")
+    vals = np.unique(np.asarray(out))
+    assert set(np.round(vals, 3)).issubset({0.0, 2.0})
+
+
+def test_softmax_with_cross_entropy_vs_numpy():
+    logits = np.random.randn(6, 10).astype(np.float32)
+    label = np.random.randint(0, 10, (6, 1)).astype(np.int64)
+
+    def np_ref(lg, lb):
+        m = lg - lg.max(axis=1, keepdims=True)
+        logp = m - np.log(np.exp(m).sum(axis=1, keepdims=True))
+        return -logp[np.arange(6), lb[:, 0]][:, None]
+
+    check_output(lambda lg, lb: L.softmax_with_cross_entropy(lg, lb),
+                 np_ref, [logits, label], atol=1e-5)
+
+
+def test_softmax_with_cross_entropy_soft_label():
+    logits = np.random.randn(4, 5).astype(np.float32)
+    soft = np.random.rand(4, 5).astype(np.float32)
+    soft /= soft.sum(axis=1, keepdims=True)
+    out = L.softmax_with_cross_entropy(jnp.asarray(logits), jnp.asarray(soft), soft_label=True)
+    logp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+    want = -jnp.sum(soft * logp, axis=-1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=1e-5)
+
+
+def test_grad_checks_elementwise_ops():
+    x = np.random.randn(3, 4).astype(np.float32)
+    check_grad(lambda a: L.relu(a) * 1.0, [x + 0.1])  # avoid kink at 0
+    check_grad(L.sigmoid, [x])
+    check_grad(L.tanh, [x])
+    check_grad(lambda a: L.softmax(a), [x])
+    check_grad(lambda a: L.reduce_mean(a), [x])
+
+
+def test_grad_check_fc():
+    x = np.random.randn(3, 5).astype(np.float32)
+    w = np.random.randn(5, 4).astype(np.float32)
+    check_grad(lambda a, b: jnp.matmul(a, b), [x, w], wrt=0)
+    check_grad(lambda a, b: jnp.matmul(a, b), [x, w], wrt=1)
+
+
+def test_grad_check_conv2d():
+    x = np.random.randn(1, 2, 5, 5).astype(np.float32)
+    w = np.random.randn(3, 2, 3, 3).astype(np.float32)
+
+    def conv(a, b):
+        dn = jax.lax.conv_dimension_numbers(a.shape, b.shape, ("NCHW", "OIHW", "NCHW"))
+        return jax.lax.conv_general_dilated(a, b, (1, 1), [(1, 1), (1, 1)],
+                                            dimension_numbers=dn)
+
+    check_grad(conv, [x, w], wrt=1, eps=1e-2, atol=5e-2, rtol=5e-2)
+
+
+def test_elementwise_axis_broadcast():
+    x = np.random.randn(2, 3, 4).astype(np.float32)
+    y = np.random.randn(3).astype(np.float32)
+    out = L.elementwise_add(jnp.asarray(x), jnp.asarray(y), axis=1)
+    np.testing.assert_allclose(np.asarray(out), x + y[None, :, None], rtol=1e-6)
+
+
+def test_topk():
+    x = np.array([[1.0, 5.0, 3.0], [9.0, 2.0, 4.0]], dtype=np.float32)
+    vals, idx = L.topk(jnp.asarray(x), 2)
+    np.testing.assert_allclose(np.asarray(vals), [[5, 3], [9, 4]])
+    np.testing.assert_array_equal(np.asarray(idx), [[1, 2], [0, 2]])
+
+
+def test_one_hot_and_label_smooth():
+    ids = np.array([[1], [3]], dtype=np.int64)
+    oh = L.one_hot(jnp.asarray(ids), 4)
+    np.testing.assert_allclose(np.asarray(oh), [[0, 1, 0, 0], [0, 0, 0, 1]])
+    sm = L.label_smooth(oh, epsilon=0.1)
+    np.testing.assert_allclose(np.asarray(sm)[0], [0.025, 0.925, 0.025, 0.025], rtol=1e-5)
+
+
+def test_split_and_concat():
+    x = np.random.randn(4, 6).astype(np.float32)
+    parts = L.split(jnp.asarray(x), [2, -1, 1], dim=1)
+    assert [p.shape[1] for p in parts] == [2, 3, 1]
+    back = L.concat(parts, axis=1)
+    np.testing.assert_allclose(np.asarray(back), x)
+
+
+def test_reshape_zero_and_minus_one():
+    x = np.zeros((2, 3, 4), dtype=np.float32)
+    assert L.reshape(jnp.asarray(x), [0, -1]).shape == (2, 12)
+
+
+def test_lrn_shape():
+    x = np.random.randn(2, 8, 4, 4).astype(np.float32)
+    out = L.lrn(jnp.asarray(x))
+    assert out.shape == x.shape
+
+
+def test_group_norm():
+    x = np.random.randn(2, 6, 4, 4).astype(np.float32)
+    out, _ = run_layer(L.group_norm, x, groups=3)
+    assert out.shape == x.shape
+
+
+def test_conv2d_transpose_shape():
+    x = np.random.randn(1, 3, 4, 4).astype(np.float32)
+    out, _ = run_layer(L.conv2d_transpose, x, num_filters=2, filter_size=2, stride=2)
+    assert out.shape == (1, 2, 8, 8)
+
+
+def test_sigmoid_cross_entropy_with_logits():
+    x = np.random.randn(4, 3).astype(np.float32)
+    lb = np.random.randint(0, 2, (4, 3)).astype(np.float32)
+
+    def np_ref(a, b):
+        return np.maximum(a, 0) - a * b + np.log1p(np.exp(-np.abs(a)))
+
+    check_output(L.sigmoid_cross_entropy_with_logits, np_ref, [x, lb], atol=1e-5)
+
+
+def test_image_resize():
+    x = np.random.randn(1, 3, 4, 4).astype(np.float32)
+    out = L.resize_bilinear(jnp.asarray(x), out_shape=(8, 8))
+    assert out.shape == (1, 3, 8, 8)
+
+
+def test_maxout():
+    x = np.random.randn(2, 6, 3, 3).astype(np.float32)
+    out = L.maxout(jnp.asarray(x), groups=3)
+    assert out.shape == (2, 2, 3, 3)
+    np.testing.assert_allclose(np.asarray(out), x.reshape(2, 2, 3, 3, 3).max(axis=2), rtol=1e-6)
+
+
+def test_pixel_shuffle():
+    x = np.random.randn(1, 8, 2, 2).astype(np.float32)
+    assert L.pixel_shuffle(jnp.asarray(x), 2).shape == (1, 2, 4, 4)
+
+
+def test_unfold_matches_conv():
+    x = np.random.randn(1, 2, 5, 5).astype(np.float32)
+    cols = L.unfold(jnp.asarray(x), 3, paddings=1)
+    assert cols.shape == (1, 2 * 9, 25)

@@ -1,0 +1,211 @@
+"""Optimizer tests vs hand-computed references — the
+test_sgd_op/test_adam_op/... family analog."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import clip as pclip
+from paddle_tpu import lr_scheduler as lrs
+from paddle_tpu import optimizer as opt
+from paddle_tpu import regularizer as reg
+from paddle_tpu.framework import ParamInfo
+
+
+def _one_param(val=None):
+    p = {"w": jnp.asarray(val if val is not None else np.array([1.0, -2.0, 3.0], np.float32))}
+    g = {"w": jnp.asarray(np.array([0.1, 0.2, -0.3], np.float32))}
+    return p, g
+
+
+def test_sgd():
+    p, g = _one_param()
+    o = opt.SGD(0.1)
+    s = o.init(p)
+    p2, s2 = o.update(g, s, p)
+    np.testing.assert_allclose(np.asarray(p2["w"]), [1 - 0.01, -2 - 0.02, 3 + 0.03], rtol=1e-6)
+    assert int(s2["step"]) == 1
+
+
+def test_momentum_matches_reference_formula():
+    p, g = _one_param()
+    o = opt.Momentum(0.1, momentum=0.9)
+    s = o.init(p)
+    p1, s1 = o.update(g, s, p)
+    p2, s2 = o.update(g, s1, p1)
+    # velocity_1 = g; velocity_2 = 0.9 g + g
+    v2 = 0.9 * np.asarray(g["w"]) + np.asarray(g["w"])
+    want = np.asarray(p1["w"]) - 0.1 * v2
+    np.testing.assert_allclose(np.asarray(p2["w"]), want, rtol=1e-6)
+
+
+def test_momentum_nesterov():
+    p, g = _one_param()
+    o = opt.Momentum(0.1, momentum=0.9, use_nesterov=True)
+    s = o.init(p)
+    p1, _ = o.update(g, s, p)
+    gw = np.asarray(g["w"])
+    want = np.asarray(p["w"]) - 0.1 * (gw + 0.9 * gw)
+    np.testing.assert_allclose(np.asarray(p1["w"]), want, rtol=1e-6)
+
+
+def test_adagrad():
+    p, g = _one_param()
+    o = opt.Adagrad(0.5, epsilon=1e-6)
+    s = o.init(p)
+    p1, _ = o.update(g, s, p)
+    gw = np.asarray(g["w"])
+    want = np.asarray(p["w"]) - 0.5 * gw / (np.sqrt(gw * gw) + 1e-6)
+    np.testing.assert_allclose(np.asarray(p1["w"]), want, rtol=1e-5)
+
+
+def test_adam_bias_correction_first_step():
+    p, g = _one_param()
+    o = opt.Adam(0.001, beta1=0.9, beta2=0.999, epsilon=1e-8)
+    s = o.init(p)
+    p1, s1 = o.update(g, s, p)
+    gw = np.asarray(g["w"])
+    m1 = 0.1 * gw
+    m2 = 0.001 * gw * gw
+    lr_t = 0.001 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    want = np.asarray(p["w"]) - lr_t * m1 / (np.sqrt(m2) + 1e-8)
+    np.testing.assert_allclose(np.asarray(p1["w"]), want, rtol=1e-5)
+
+
+def test_adam_converges_quadratic():
+    # Optimize f(w) = ||w - t||^2 — convergence sanity for the suite.
+    target = jnp.asarray([1.0, -0.5, 2.0])
+    params = {"w": jnp.zeros(3)}
+    # LAMB's trust ratio keeps |step| ∝ |param|, so it needs LR decay to
+    # settle — give it the schedule it's designed for.
+    lamb_lr = lrs.polynomial_decay(0.1, 300, end_learning_rate=1e-4)
+    for Opt, lr, kw in [(opt.Adam, 0.1, {}), (opt.RMSProp, 0.05, {}),
+                        (opt.Adadelta, 5.0, {}), (opt.Adamax, 0.2, {}),
+                        (opt.Lamb, lamb_lr, {"lamb_weight_decay": 0.0})]:
+        o = Opt(lr, **kw)
+        s = o.init(params)
+        p = dict(params)
+        for _ in range(300):
+            grads = {"w": 2 * (p["w"] - target)}
+            p, s = o.update(grads, s, p)
+        np.testing.assert_allclose(np.asarray(p["w"]), np.asarray(target), atol=0.05,
+                                   err_msg=f"{Opt.__name__} failed to converge")
+
+
+def test_rmsprop_centered_and_ftrl_run():
+    p, g = _one_param()
+    for o in [opt.RMSProp(0.01, centered=True, momentum=0.9),
+              opt.Ftrl(0.1, l1=0.01, l2=0.01),
+              opt.DecayedAdagrad(0.01), opt.LarsMomentum(0.01)]:
+        s = o.init(p)
+        p1, s1 = o.update(g, s, p)
+        assert np.all(np.isfinite(np.asarray(p1["w"])))
+
+
+def test_l2_regularization_applied():
+    p, g = _one_param()
+    o = opt.SGD(1.0, regularization=reg.L2Decay(0.1))
+    s = o.init(p)
+    p1, _ = o.update(g, s, p)
+    gw = np.asarray(g["w"]) + 0.1 * np.asarray(p["w"])
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p["w"]) - gw, rtol=1e-6)
+
+
+def test_param_attr_regularizer_overrides_global():
+    p, g = _one_param()
+    info = {"w": ParamInfo(shape=(3,), dtype=jnp.float32, regularizer=reg.L2Decay(0.5))}
+    o = opt.SGD(1.0, regularization=reg.L2Decay(0.1))
+    s = o.init(p)
+    p1, _ = o.update(g, s, p, info)
+    gw = np.asarray(g["w"]) + 0.5 * np.asarray(p["w"])
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p["w"]) - gw, rtol=1e-6)
+
+
+def test_grad_clip_by_global_norm():
+    p = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
+    g = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}  # global norm 5
+    o = opt.SGD(1.0, grad_clip=pclip.GradientClipByGlobalNorm(1.0))
+    s = o.init(p)
+    p1, _ = o.update(g, s, p)
+    # grads scaled by 1/5
+    np.testing.assert_allclose(np.asarray(p1["a"]), [3.0 - 0.6], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p1["b"]), [4.0 - 0.8], rtol=1e-5)
+
+
+def test_grad_clip_by_value():
+    p, g = _one_param()
+    o = opt.SGD(1.0, grad_clip=pclip.GradientClipByValue(0.15))
+    s = o.init(p)
+    p1, _ = o.update(g, s, p)
+    want = np.asarray(p["w"]) - np.clip(np.asarray(g["w"]), -0.15, 0.15)
+    np.testing.assert_allclose(np.asarray(p1["w"]), want, rtol=1e-6)
+
+
+def test_non_trainable_param_frozen():
+    p, g = _one_param()
+    info = {"w": ParamInfo(shape=(3,), dtype=jnp.float32, trainable=False)}
+    o = opt.SGD(0.1)
+    s = o.init(p)
+    p1, _ = o.update(g, s, p, info)
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p["w"]))
+
+
+def test_per_param_lr_multiplier():
+    p, g = _one_param()
+    info = {"w": ParamInfo(shape=(3,), dtype=jnp.float32, learning_rate=0.5)}
+    o = opt.SGD(0.2)
+    s = o.init(p)
+    p1, _ = o.update(g, s, p, info)
+    np.testing.assert_allclose(np.asarray(p1["w"]),
+                               np.asarray(p["w"]) - 0.1 * np.asarray(g["w"]), rtol=1e-6)
+
+
+def test_lr_schedule_in_optimizer():
+    sched = lrs.piecewise_decay([2], [0.1, 0.01])
+    p, g = _one_param()
+    o = opt.SGD(sched)
+    s = o.init(p)
+    assert float(o.learning_rate(s["step"])) == pytest.approx(0.1)
+    for _ in range(3):
+        p, s = o.update(g, s, p)
+    assert float(o.learning_rate(s["step"])) == pytest.approx(0.01)
+
+
+def test_lr_schedules_shapes():
+    for sched in [
+        lrs.noam_decay(512, 4000), lrs.exponential_decay(0.1, 100, 0.9),
+        lrs.natural_exp_decay(0.1, 100, 0.9), lrs.inverse_time_decay(0.1, 100, 0.9),
+        lrs.polynomial_decay(0.1, 100), lrs.cosine_decay(0.1, 10, 10),
+        lrs.linear_lr_warmup(0.1, 10, 0.0, 0.1),
+    ]:
+        v0 = float(sched(jnp.asarray(0)))
+        v100 = float(sched(jnp.asarray(100)))
+        assert np.isfinite(v0) and np.isfinite(v100)
+
+
+def test_warmup_then_decay():
+    sched = lrs.linear_lr_warmup(lrs.exponential_decay(0.1, 10, 0.5, staircase=True),
+                                 warmup_steps=5, start_lr=0.0, end_lr=0.1)
+    assert float(sched(jnp.asarray(0))) == pytest.approx(0.0)
+    assert float(sched(jnp.asarray(4))) == pytest.approx(0.08, abs=1e-6)
+    assert float(sched(jnp.asarray(20))) == pytest.approx(0.1 * 0.25)
+
+
+def test_model_average():
+    ma = opt.ModelAverage()
+    params = {"w": jnp.asarray([0.0])}
+    st = ma.init(params)
+    for v in [1.0, 2.0, 3.0]:
+        st = ma.accumulate(st, {"w": jnp.asarray([v])})
+    avg = ma.average_params(st, params)
+    np.testing.assert_allclose(np.asarray(avg["w"]), [2.0], rtol=1e-6)
+
+
+def test_ema():
+    ema = opt.ExponentialMovingAverage(decay=0.5)
+    params = {"w": jnp.asarray([0.0])}
+    st = ema.init(params)
+    st = ema.accumulate(st, {"w": jnp.asarray([2.0])})
+    np.testing.assert_allclose(np.asarray(st["w"]), [1.0], rtol=1e-6)
