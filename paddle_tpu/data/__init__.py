@@ -1,0 +1,14 @@
+"""Data pipeline: reader combinators, datasets, feeders (reference:
+python/paddle/reader/, python/paddle/dataset/, fluid data_feeder.py,
+operators/reader/*)."""
+
+from . import datasets, feeder, reader
+from .feeder import DataFeeder, DeviceFeeder
+from .reader import batch, buffered, cache, chain, compose, firstn, map_readers, shuffle, xmap_readers
+
+__all__ = [
+    "datasets", "feeder", "reader",
+    "DataFeeder", "DeviceFeeder",
+    "batch", "buffered", "cache", "chain", "compose", "firstn",
+    "map_readers", "shuffle", "xmap_readers",
+]

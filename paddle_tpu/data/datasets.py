@@ -1,0 +1,221 @@
+"""Dataset pack.
+
+Analog of python/paddle/dataset/ (mnist, cifar, imdb, uci_housing,
+wmt16, movielens… each a reader-creator factory with download+cache).
+This environment has zero egress, so each dataset loads from a local
+path when present (standard file formats, same as the reference's
+cache dir) and otherwise falls back to a **deterministic synthetic
+generator** with the real shapes/vocab — keeping every example and
+benchmark runnable anywhere. Synthetic mode is clearly marked via
+``synthetic=True`` on the reader functions.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+DATA_HOME = os.environ.get("PDTPU_DATA_HOME", os.path.expanduser("~/.cache/paddle_tpu/dataset"))
+
+
+# ---------------------------------------------------------------------------
+# mnist (dataset/mnist.py analog)
+# ---------------------------------------------------------------------------
+
+
+def _mnist_files(split: str):
+    base = os.path.join(DATA_HOME, "mnist")
+    if split == "train":
+        return (os.path.join(base, "train-images-idx3-ubyte.gz"),
+                os.path.join(base, "train-labels-idx1-ubyte.gz"))
+    return (os.path.join(base, "t10k-images-idx3-ubyte.gz"),
+            os.path.join(base, "t10k-labels-idx1-ubyte.gz"))
+
+
+def _read_idx_images(path):
+    with gzip.open(path, "rb") as f:
+        _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows * cols)
+    return data.astype(np.float32) / 127.5 - 1.0  # reference normalization
+
+
+def _read_idx_labels(path):
+    with gzip.open(path, "rb") as f:
+        _, n = struct.unpack(">II", f.read(8))
+        return np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+
+
+def _synthetic_classification(n: int, feat_shape: Tuple[int, ...], num_classes: int,
+                              centers_seed: int, noise_seed: int,
+                              ) -> Iterator[Tuple[np.ndarray, np.int64]]:
+    """Separable synthetic data: class-dependent means so models actually
+    learn — lets e2e/convergence tests be meaningful without downloads.
+    ``centers_seed`` is shared between train/test splits (same underlying
+    distribution); ``noise_seed`` differs per split."""
+    centers = np.random.RandomState(centers_seed).randn(num_classes, *feat_shape).astype(np.float32)
+    rng = np.random.RandomState(noise_seed)
+    for i in range(n):
+        y = i % num_classes
+        x = centers[y] + 0.5 * rng.randn(*feat_shape).astype(np.float32)
+        yield x, np.int64(y)
+
+
+def mnist(split: str = "train", synthetic_size: int = 2048) -> Callable:
+    """Reader creator for MNIST: yields (image[784] in [-1,1], label)."""
+    imgs_p, lbls_p = _mnist_files(split)
+    if os.path.exists(imgs_p) and os.path.exists(lbls_p):
+        def reader():
+            imgs = _read_idx_images(imgs_p)
+            lbls = _read_idx_labels(lbls_p)
+            for x, y in zip(imgs, lbls):
+                yield x, y
+        reader.synthetic = False
+        return reader
+
+    def reader():
+        yield from _synthetic_classification(synthetic_size, (784,), 10, centers_seed=0,
+                                             noise_seed=0 if split == "train" else 1)
+    reader.synthetic = True
+    return reader
+
+
+def mnist_train():
+    return mnist("train")
+
+
+def mnist_test():
+    return mnist("test")
+
+
+# ---------------------------------------------------------------------------
+# cifar (dataset/cifar.py analog)
+# ---------------------------------------------------------------------------
+
+
+def cifar10(split: str = "train", synthetic_size: int = 1024) -> Callable:
+    """Yields (image[3*32*32] float in [0,1], label)."""
+    import pickle
+    base = os.path.join(DATA_HOME, "cifar-10-batches-py")
+    files = ([os.path.join(base, f"data_batch_{i}") for i in range(1, 6)]
+             if split == "train" else [os.path.join(base, "test_batch")])
+    if all(os.path.exists(f) for f in files):
+        def reader():
+            for fp in files:
+                with open(fp, "rb") as f:
+                    d = pickle.load(f, encoding="bytes")
+                for x, y in zip(d[b"data"], d[b"labels"]):
+                    yield x.astype(np.float32) / 255.0, np.int64(y)
+        reader.synthetic = False
+        return reader
+
+    def reader():
+        yield from _synthetic_classification(synthetic_size, (3 * 32 * 32,), 10, centers_seed=2,
+                                             noise_seed=2 if split == "train" else 3)
+    reader.synthetic = True
+    return reader
+
+
+# ---------------------------------------------------------------------------
+# uci_housing (dataset/uci_housing.py analog)
+# ---------------------------------------------------------------------------
+
+
+def uci_housing(split: str = "train", synthetic_size: int = 404) -> Callable:
+    """Yields (features[13], price[1]) — the fit_a_line dataset."""
+    path = os.path.join(DATA_HOME, "housing.data")
+    if os.path.exists(path):
+        data = np.loadtxt(path).astype(np.float32)
+        feats = (data[:, :-1] - data[:, :-1].mean(0)) / (data[:, :-1].std(0) + 1e-8)
+        n = int(len(data) * 0.8)
+        rows = list(range(n)) if split == "train" else list(range(n, len(data)))
+
+        def reader():
+            for i in rows:
+                yield feats[i], data[i, -1:].astype(np.float32)
+        reader.synthetic = False
+        return reader
+
+    def reader():
+        rng = np.random.RandomState(4 if split == "train" else 5)
+        w = rng.randn(13).astype(np.float32)
+        for _ in range(synthetic_size):
+            x = rng.randn(13).astype(np.float32)
+            y = np.array([x @ w + 0.1 * rng.randn()], dtype=np.float32)
+            yield x, y
+    reader.synthetic = True
+    return reader
+
+
+# ---------------------------------------------------------------------------
+# imdb-style text classification (dataset/imdb.py analog)
+# ---------------------------------------------------------------------------
+
+
+def imdb(split: str = "train", vocab_size: int = 5000, seq_len: int = 128,
+         synthetic_size: int = 1024) -> Callable:
+    """Yields (word_ids[seq_len] int64 padded, label). Synthetic mode
+    generates class-correlated token distributions."""
+
+    def reader():
+        rng = np.random.RandomState(6 if split == "train" else 7)
+        # two class-specific token distributions
+        logits = rng.randn(2, vocab_size)
+        probs = np.exp(logits) / np.exp(logits).sum(axis=1, keepdims=True)
+        for i in range(synthetic_size):
+            y = i % 2
+            length = rng.randint(seq_len // 2, seq_len + 1)
+            ids = rng.choice(vocab_size, size=length, p=probs[y])
+            padded = np.zeros(seq_len, dtype=np.int64)
+            padded[:length] = ids
+            yield padded, np.int64(y)
+    reader.synthetic = True
+    return reader
+
+
+# ---------------------------------------------------------------------------
+# synthetic translation pairs (wmt16 analog) & CTR (DeepFM) data
+# ---------------------------------------------------------------------------
+
+
+def wmt16(split: str = "train", src_vocab: int = 10000, trg_vocab: int = 10000,
+          seq_len: int = 64, synthetic_size: int = 512) -> Callable:
+    """Yields (src_ids, trg_ids, trg_next_ids) padded to seq_len."""
+
+    def reader():
+        rng = np.random.RandomState(8 if split == "train" else 9)
+        for _ in range(synthetic_size):
+            n = rng.randint(seq_len // 2, seq_len)
+            src = np.zeros(seq_len, np.int64)
+            src[:n] = rng.randint(3, src_vocab, n)
+            trg = np.zeros(seq_len, np.int64)
+            trg[0] = 1  # <s>
+            trg[1:n] = (src[:n - 1] % (trg_vocab - 3)) + 3  # learnable mapping
+            nxt = np.zeros(seq_len, np.int64)
+            nxt[:n - 1] = trg[1:n]
+            nxt[n - 1] = 2  # </s>
+            yield src, trg, nxt
+    reader.synthetic = True
+    return reader
+
+
+def ctr(split: str = "train", num_sparse_fields: int = 26, sparse_dim: int = 1000,
+        num_dense: int = 13, synthetic_size: int = 4096) -> Callable:
+    """Criteo-style CTR data for DeepFM (dist_ctr.py analog):
+    (dense[13], sparse_ids[26], label)."""
+
+    def reader():
+        rng = np.random.RandomState(10 if split == "train" else 11)
+        w_d = rng.randn(num_dense).astype(np.float32)
+        w_s = rng.randn(num_sparse_fields, sparse_dim).astype(np.float32) * 0.1
+        for _ in range(synthetic_size):
+            dense = rng.randn(num_dense).astype(np.float32)
+            sparse = rng.randint(0, sparse_dim, num_sparse_fields).astype(np.int64)
+            score = dense @ w_d + sum(w_s[f, sparse[f]] for f in range(num_sparse_fields))
+            y = np.int64(score + 0.5 * rng.randn() > 0)
+            yield dense, sparse, y
+    reader.synthetic = True
+    return reader

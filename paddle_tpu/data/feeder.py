@@ -1,0 +1,78 @@
+"""DataFeeder + device prefetch.
+
+Analog of python/paddle/fluid/data_feeder.py (DataFeeder.feed:167 —
+converts a list of per-sample tuples into batched dense arrays) and of
+the py_reader/double_buffer device pipeline (operators/reader/
+buffered_reader.cc, layers/io.py:478): ``DeviceFeeder`` runs the host
+reader in a background thread and keeps N batches in flight on device so
+host→HBM transfer overlaps with compute.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..core.dtypes import convert_dtype
+
+
+class DataFeeder:
+    """Convert reader samples (tuples) into a named feed dict of batched
+    numpy arrays (DataFeeder.feed analog, data_feeder.py:167)."""
+
+    def __init__(self, feed_list: Sequence[str], dtypes: Optional[Sequence[Any]] = None):
+        self.feed_list = list(feed_list)
+        self.dtypes = list(dtypes) if dtypes is not None else [None] * len(self.feed_list)
+
+    def feed(self, samples: Sequence[Tuple]) -> Dict[str, np.ndarray]:
+        cols = list(zip(*samples))
+        if len(cols) != len(self.feed_list):
+            raise ValueError(
+                f"sample arity {len(cols)} != feed_list arity {len(self.feed_list)}")
+        out = {}
+        for name, dt, col in zip(self.feed_list, self.dtypes, cols):
+            arr = np.stack([np.asarray(v) for v in col])
+            if dt is not None:
+                arr = arr.astype(np.dtype(convert_dtype(dt).name))
+            out[name] = arr
+        return out
+
+
+class DeviceFeeder:
+    """Double-buffered host→device prefetch (py_reader + double_buffer
+    analog). Wraps an iterator of feed dicts; `__iter__` yields dicts of
+    on-device arrays while the next batches transfer in the background."""
+
+    def __init__(self, batches: Callable[[], Iterator[Dict[str, np.ndarray]]],
+                 put_fn: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, jax.Array]]] = None,
+                 capacity: int = 2):
+        self.batches = batches
+        self.put_fn = put_fn or (lambda d: jax.device_put(d))
+        self.capacity = capacity
+
+    def __iter__(self):
+        q: _queue.Queue = _queue.Queue(maxsize=self.capacity)
+        END = object()
+        err: List[BaseException] = []
+
+        def fill():
+            try:
+                for b in self.batches():
+                    q.put(self.put_fn(b))
+            except BaseException as e:  # surfaced on the consumer side
+                err.append(e)
+            finally:
+                q.put(END)
+
+        threading.Thread(target=fill, daemon=True).start()
+        while True:
+            item = q.get()
+            if item is END:
+                if err:
+                    raise err[0]
+                return
+            yield item

@@ -1,0 +1,199 @@
+"""Reader combinators — the host-side data pipeline.
+
+Analog of python/paddle/reader/decorator.py:36-338 (map_readers/
+shuffle/chain/compose/buffered/firstn/xmap_readers/cache) and
+fluid.layers.io batching. A *reader creator* is a zero-arg callable
+returning an iterator of samples, exactly the reference's convention, so
+user code ports 1:1. The device-feeding end (double-buffering, the
+py_reader/buffered_reader analog) lives in paddle_tpu.data.feeder.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+from typing import Any, Callable, Iterable, Iterator, List, Sequence
+
+Reader = Callable[[], Iterator[Any]]
+
+
+def map_readers(func: Callable, *readers: Reader) -> Reader:
+    """Apply func elementwise over zipped readers (decorator.py:36)."""
+
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader: Reader, buf_size: int, seed: int = None) -> Reader:
+    """Shuffle within a sliding buffer (decorator.py:~120)."""
+
+    def new_reader():
+        rnd = _random.Random(seed)
+        buf: List[Any] = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                rnd.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            rnd.shuffle(buf)
+            yield from buf
+
+    return new_reader
+
+
+def chain(*readers: Reader) -> Reader:
+    """Concatenate readers (decorator.py chain)."""
+
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers: Reader, check_alignment: bool = True) -> Reader:
+    """Zip readers into tuple samples (decorator.py compose)."""
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        its = [r() for r in readers]
+        for items in (zip(*its) if check_alignment else itertools.zip_longest(*its)):
+            yield sum((make_tuple(i) for i in items), ())
+
+    return reader
+
+
+def buffered(reader: Reader, size: int) -> Reader:
+    """Read ahead in a daemon thread (decorator.py buffered) — overlaps
+    host IO with device compute."""
+
+    class _End:
+        pass
+
+    def new_reader():
+        q: _queue.Queue = _queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for d in reader():
+                    q.put(d)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return new_reader
+
+
+def firstn(reader: Reader, n: int) -> Reader:
+    def new_reader():
+        yield from itertools.islice(reader(), n)
+
+    return new_reader
+
+
+def cache(reader: Reader) -> Reader:
+    """Materialize once, replay from memory (decorator.py cache)."""
+    data: List[Any] = []
+    filled = [False]
+
+    def new_reader():
+        if not filled[0]:
+            data.extend(reader())
+            filled[0] = True
+        yield from data
+
+    return new_reader
+
+
+def xmap_readers(mapper: Callable, reader: Reader, process_num: int,
+                 buffer_size: int, order: bool = False) -> Reader:
+    """Parallel map via worker threads (decorator.py:~250 xmap_readers).
+    Threads (not processes) suffice here: host-side decode work releases
+    the GIL in numpy, and device feeding is the bottleneck anyway."""
+
+    class _End:
+        pass
+
+    def new_reader():
+        in_q: _queue.Queue = _queue.Queue(buffer_size)
+        out_q: _queue.Queue = _queue.Queue(buffer_size)
+
+        def feed():
+            for i, d in enumerate(reader()):
+                in_q.put((i, d))
+            for _ in range(process_num):
+                in_q.put(_End)
+
+        def work():
+            while True:
+                e = in_q.get()
+                if e is _End:
+                    out_q.put(_End)
+                    break
+                i, d = e
+                out_q.put((i, mapper(d)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        done = 0
+        if order:
+            pending = {}
+            nxt = 0
+            while done < process_num:
+                e = out_q.get()
+                if e is _End:
+                    done += 1
+                    continue
+                i, d = e
+                pending[i] = d
+                while nxt in pending:
+                    yield pending.pop(nxt)
+                    nxt += 1
+            while nxt in pending:
+                yield pending.pop(nxt)
+                nxt += 1
+        else:
+            while done < process_num:
+                e = out_q.get()
+                if e is _End:
+                    done += 1
+                    continue
+                yield e[1]
+
+    return new_reader
+
+
+def batch(reader: Reader, batch_size: int, drop_last: bool = True) -> Reader:
+    """Group samples into lists (paddle.batch analog). drop_last defaults
+    True because XLA wants static shapes (the design decision replacing
+    the reference's dynamic final batch)."""
+
+    def new_reader():
+        b = []
+        for s in reader():
+            b.append(s)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return new_reader

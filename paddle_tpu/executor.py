@@ -201,19 +201,20 @@ class Trainer:
                 # gradient accumulation (multi_batch_merge_pass analog):
                 # microbatch over the leading feed axis with lax.scan.
                 def micro(carry, mb):
-                    (loss, (out, new_state)), grads = jax.value_and_grad(
-                        self._loss_and_aux, has_aux=True)(params, state, mb["rng"], mb["feed"])
-                    acc = jax.tree.map(jnp.add, carry[0], grads)
-                    return (acc, new_state, out), None
+                    acc, st = carry
+                    (loss, (out, new_st)), grads = jax.value_and_grad(
+                        self._loss_and_aux, has_aux=True)(params, st, mb["rng"], mb["feed"])
+                    acc = jax.tree.map(jnp.add, acc, grads)
+                    return (acc, new_st), out
 
                 feed_m = jax.tree.map(
                     lambda x: x.reshape((accum_steps, x.shape[0] // accum_steps) + x.shape[1:]),
                     feed)
                 rngs = jax.random.split(rng, accum_steps)
                 zero = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-                (gsum, new_state, out), _ = jax.lax.scan(
-                    micro, (zero, state, None),
-                    {"rng": rngs, "feed": feed_m})
+                (gsum, new_state), outs = jax.lax.scan(
+                    micro, (zero, state), {"rng": rngs, "feed": feed_m})
+                out = jax.tree.map(lambda x: jnp.mean(x, axis=0), outs)
                 grads = jax.tree.map(lambda g: g / accum_steps, gsum)
             else:
                 (loss, (out, new_state)), grads = jax.value_and_grad(

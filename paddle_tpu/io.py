@@ -1,0 +1,176 @@
+"""Checkpoint save/load + inference export.
+
+Analog of python/paddle/fluid/io.py: save_vars/save_persistables
+(io.py:89/:252 — a program of save ops per var), load_persistables
+(io.py:464), save/load_inference_model (io.py:544/:669 — prune +
+serialized ProgramDesc). Here persistable state is name-keyed pytrees →
+a single .npz per collection (+ JSON meta); the inference model is a
+serialized ``jax.export`` StableHLO artifact next to its weights — the
+ProgramDesc-file analog, portable across processes and (with matching
+XLA version) machines.
+
+Resharding on load (the pserver slice/merge analog,
+io.py:881 _load_slice_up_vars): arrays are saved unsharded (fully
+gathered); loading places them per the current mesh/rules, so mesh
+reshapes between save and load work by construction.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SEP = "||"  # path separator for nested pytree keys (param names use '/')
+
+
+# -- pytree <-> flat dict ----------------------------------------------------
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{SEP}{k}" if prefix else str(k)))
+    elif tree is None:
+        pass
+    else:
+        out[prefix] = np.asarray(tree)
+    return out
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, v in flat.items():
+        parts = key.split(SEP)
+        d = out
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return out
+
+
+# -- persistables ------------------------------------------------------------
+
+
+def save_persistables(dirname: str, params: Dict[str, jax.Array],
+                      state: Optional[Dict[str, jax.Array]] = None,
+                      opt_state: Optional[Dict[str, Any]] = None,
+                      meta: Optional[Dict[str, Any]] = None) -> None:
+    """Save all persistable vars (save_persistables analog, io.py:252).
+    Sharded arrays are gathered to host first."""
+    os.makedirs(dirname, exist_ok=True)
+    np.savez(os.path.join(dirname, "params.npz"), **_flatten(jax.device_get(params)))
+    if state is not None:
+        np.savez(os.path.join(dirname, "state.npz"), **_flatten(jax.device_get(state)))
+    if opt_state is not None:
+        np.savez(os.path.join(dirname, "opt_state.npz"), **_flatten(jax.device_get(opt_state)))
+    with open(os.path.join(dirname, "meta.json"), "w") as f:
+        json.dump(meta or {}, f)
+
+
+def load_persistables(dirname: str) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray],
+                                             Optional[Dict[str, Any]], Dict[str, Any]]:
+    """Load (params, state, opt_state, meta) (load_persistables analog)."""
+
+    def _load(name):
+        p = os.path.join(dirname, name)
+        if not os.path.exists(p):
+            return None
+        with np.load(p, allow_pickle=False) as z:
+            return _unflatten({k: z[k] for k in z.files})
+
+    params = _load("params.npz") or {}
+    state = _load("state.npz") or {}
+    opt_state = _load("opt_state.npz")
+    meta_path = os.path.join(dirname, "meta.json")
+    meta = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    return params, state, opt_state, meta
+
+
+def save_trainer(dirname: str, trainer) -> None:
+    """Checkpoint a Trainer (params+state+opt_state+step) — the
+    CheckpointConfig/save_checkpoint analog (contrib/trainer.py:100)."""
+    save_persistables(dirname, trainer.scope.params, trainer.scope.state,
+                      trainer.scope.opt_state, meta={"global_step": trainer.global_step})
+
+
+def load_trainer(dirname: str, trainer) -> None:
+    """Restore a Trainer in place, re-placing arrays on the trainer's
+    device/mesh (resharding-on-load)."""
+    params, state, opt_state, meta = load_persistables(dirname)
+    if trainer.mesh is not None:
+        from .parallel import api as par_api
+        params, state, opt_state = par_api.shard_scope(
+            trainer.mesh, trainer.sharding_rules, params, state, opt_state)
+    else:
+        dev = trainer.place.device()
+        params = jax.device_put(params, dev)
+        state = jax.device_put(state, dev)
+        opt_state = jax.device_put(opt_state, dev) if opt_state is not None else None
+    # restore exact leaf dtypes (npz roundtrips are exact, but int scalars
+    # may come back as 0-d arrays)
+    if opt_state is not None:
+        opt_state["step"] = jnp.asarray(opt_state["step"], jnp.int32)
+    trainer.scope.params, trainer.scope.state, trainer.scope.opt_state = params, state, opt_state
+    trainer.global_step = int(meta.get("global_step", 0))
+
+
+# -- inference model (save/load_inference_model analog) ----------------------
+
+
+def save_inference_model(dirname: str, program, params: Dict[str, jax.Array],
+                         state: Dict[str, jax.Array], example_feed: Dict[str, Any]) -> None:
+    """Export program.apply (inference mode, params baked as inputs) as a
+    serialized StableHLO artifact + weights (io.py:544 analog: prune to
+    feed/fetch + serialize ProgramDesc + save params)."""
+    os.makedirs(dirname, exist_ok=True)
+    feed_names = sorted(example_feed)
+
+    def infer_fn(params_, state_, *feed_vals):
+        feed = dict(zip(feed_names, feed_vals))
+        out, _ = program.apply(params_, state_, training=False, **feed)
+        return out
+
+    example_vals = [jnp.asarray(np.asarray(example_feed[k])) for k in feed_names]
+    exported = jax.export.export(jax.jit(infer_fn))(
+        jax.device_get(params), jax.device_get(state), *example_vals)
+    with open(os.path.join(dirname, "model.stablehlo"), "wb") as f:
+        f.write(exported.serialize())
+    np.savez(os.path.join(dirname, "params.npz"), **_flatten(jax.device_get(params)))
+    np.savez(os.path.join(dirname, "state.npz"), **_flatten(jax.device_get(state)))
+    with open(os.path.join(dirname, "meta.json"), "w") as f:
+        json.dump({"feed_names": feed_names}, f)
+
+
+class Predictor:
+    """Loaded inference model (PaddlePredictor analog,
+    paddle_inference_api.h:141: Run(inputs)->outputs; Clone is free —
+    the executable is stateless and thread-safe)."""
+
+    def __init__(self, exported, params, state, feed_names):
+        self._exported = exported
+        self._params = params
+        self._state = state
+        self.feed_names = feed_names
+
+    def run(self, feed: Dict[str, Any]):
+        vals = [jnp.asarray(np.asarray(feed[k])) for k in self.feed_names]
+        return self._exported.call(self._params, self._state, *vals)
+
+    def clone(self) -> "Predictor":
+        return Predictor(self._exported, self._params, self._state, self.feed_names)
+
+
+def load_inference_model(dirname: str) -> Predictor:
+    with open(os.path.join(dirname, "model.stablehlo"), "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    params, state, _, meta = load_persistables(dirname)
+    return Predictor(exported, params, state, meta["feed_names"])

@@ -329,7 +329,9 @@ def pool2d(
             hi = pd[i] + (out_ceil - out_floor) * st[i]
         pads[a] = (pd[i], hi)
     if pool_type == "max":
-        neg = jnp.finfo(input.dtype).min if jnp.issubdtype(input.dtype, jnp.floating) else jnp.iinfo(input.dtype).min
+        # -inf init (not finfo.min): only the exact max-monoid identity is
+        # recognized by reduce_window's reverse-mode rule.
+        neg = -jnp.inf if jnp.issubdtype(input.dtype, jnp.floating) else jnp.iinfo(input.dtype).min
         return jax.lax.reduce_window(input, neg, jax.lax.max, window, strides, pads)
     if pool_type == "avg":
         s = jax.lax.reduce_window(input, 0.0, jax.lax.add, window, strides, pads)

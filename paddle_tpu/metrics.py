@@ -1,0 +1,236 @@
+"""Metrics.
+
+Analog of python/paddle/fluid/metrics.py (Precision/Recall/Accuracy/
+Auc/EditDistance/CompositeMetric) plus the in-graph metric ops
+(accuracy_op.cc, auc_op.cc via layers.metric_op). Each metric is a
+host-side accumulator fed per-batch values; the in-graph helpers
+(``accuracy``/``auc_stat``) compute the per-batch tensors inside jit.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# -- in-graph helpers (layers/metric_op.py analog) ---------------------------
+
+
+def accuracy(input, label, k: int = 1):
+    """Per-batch top-k accuracy tensor (accuracy_op.cc analog)."""
+    lab = label.astype(jnp.int32)
+    if lab.ndim == input.ndim and lab.shape[-1] == 1:
+        lab = lab[..., 0]
+    _, idx = jax.lax.top_k(input, k)
+    correct = jnp.any(idx == lab[..., None], axis=-1)
+    return correct.astype(jnp.float32).mean()
+
+
+def auc_stat(pred_pos, label, num_thresholds: int = 200):
+    """Per-batch AUC histogram stats (auc_op.cc analog): returns
+    (tp_hist, fp_hist) over thresholds; combine in the Auc metric."""
+    lab = label.reshape(-1).astype(jnp.bool_)
+    p = jnp.clip(pred_pos.reshape(-1), 0.0, 1.0)
+    bucket = jnp.minimum((p * num_thresholds).astype(jnp.int32), num_thresholds - 1)
+    tp = jnp.zeros(num_thresholds, jnp.int32).at[bucket].add(lab.astype(jnp.int32))
+    fp = jnp.zeros(num_thresholds, jnp.int32).at[bucket].add((~lab).astype(jnp.int32))
+    return tp, fp
+
+
+# -- host-side accumulators (metrics.py analog) ------------------------------
+
+
+class MetricBase:
+    def __init__(self, name: Optional[str] = None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(MetricBase):
+    """Weighted running accuracy (metrics.py Accuracy)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1.0):
+        self.value += float(value) * float(weight)
+        self.weight += float(weight)
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("Accuracy: no updates yet")
+        return self.value / self.weight
+
+
+class Precision(MetricBase):
+    """Binary precision (metrics.py Precision)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds).round().astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def eval(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds).round().astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def eval(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.0
+
+
+class Auc(MetricBase):
+    """Threshold-bucketed ROC AUC (metrics.py Auc / auc_op.cc)."""
+
+    def __init__(self, name=None, num_thresholds: int = 200):
+        super().__init__(name)
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self.tp_hist = np.zeros(self.num_thresholds, np.int64)
+        self.fp_hist = np.zeros(self.num_thresholds, np.int64)
+
+    def update(self, preds, labels):
+        """preds: prob of positive class [N] or [N,2]; labels: [N]."""
+        p = np.asarray(preds)
+        if p.ndim == 2 and p.shape[1] == 2:
+            p = p[:, 1]
+        p = np.clip(p.reshape(-1), 0.0, 1.0)
+        lab = np.asarray(labels).reshape(-1).astype(bool)
+        bucket = np.minimum((p * self.num_thresholds).astype(np.int64),
+                            self.num_thresholds - 1)
+        np.add.at(self.tp_hist, bucket, lab.astype(np.int64))
+        np.add.at(self.fp_hist, bucket, (~lab).astype(np.int64))
+
+    def update_stats(self, tp, fp):
+        """Accumulate stats from the in-graph auc_stat helper."""
+        self.tp_hist += np.asarray(tp, dtype=np.int64)
+        self.fp_hist += np.asarray(fp, dtype=np.int64)
+
+    def eval(self):
+        # cumulative from the highest threshold down = ROC sweep
+        tp_c = np.cumsum(self.tp_hist[::-1]).astype(np.float64)
+        fp_c = np.cumsum(self.fp_hist[::-1]).astype(np.float64)
+        tot_p, tot_n = tp_c[-1], fp_c[-1]
+        if tot_p == 0 or tot_n == 0:
+            return 0.0
+        tpr = np.concatenate([[0.0], tp_c / tot_p])
+        fpr = np.concatenate([[0.0], fp_c / tot_n])
+        return float(np.trapezoid(tpr, fpr))
+
+
+class EditDistance(MetricBase):
+    """Mean Levenshtein distance (metrics.py EditDistance /
+    edit_distance_op.cc) over sequence pairs."""
+
+    def __init__(self, name=None, normalized: bool = True):
+        super().__init__(name)
+        self.normalized = normalized
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+        self.seq_num_err = 0
+
+    @staticmethod
+    def _levenshtein(a: Sequence, b: Sequence) -> int:
+        m, n = len(a), len(b)
+        dp = list(range(n + 1))
+        for i in range(1, m + 1):
+            prev = dp[0]
+            dp[0] = i
+            for j in range(1, n + 1):
+                cur = dp[j]
+                dp[j] = min(dp[j] + 1, dp[j - 1] + 1, prev + (a[i - 1] != b[j - 1]))
+                prev = cur
+        return dp[n]
+
+    def update(self, hyps, refs):
+        for h, r in zip(hyps, refs):
+            d = self._levenshtein(list(h), list(r))
+            if self.normalized:
+                d = d / max(len(r), 1)
+            self.total += d
+            self.count += 1
+            if d > 0:
+                self.seq_num_err += 1
+
+    def eval(self):
+        if self.count == 0:
+            raise ValueError("EditDistance: no updates yet")
+        return self.total / self.count, self.seq_num_err / self.count
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.metrics: List[MetricBase] = []
+
+    def add_metric(self, m: MetricBase):
+        self.metrics.append(m)
+
+    def reset(self):
+        for m in self.metrics:
+            m.reset()
+
+    def update(self, **kwargs):
+        for m in self.metrics:
+            m.update(**kwargs)
+
+    def eval(self):
+        return [m.eval() for m in self.metrics]
+
+
+def chunk_eval(hyp_chunks, ref_chunks):
+    """Chunk-level P/R/F1 (chunk_eval_op analog) over sets of
+    (start, end, type) tuples per sequence."""
+    tp = sum(len(set(h) & set(r)) for h, r in zip(hyp_chunks, ref_chunks))
+    nh = sum(len(h) for h in hyp_chunks)
+    nr = sum(len(r) for r in ref_chunks)
+    p = tp / nh if nh else 0.0
+    r = tp / nr if nr else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    return p, r, f1

@@ -1,0 +1,7 @@
+"""Model zoo mirroring the reference's book/benchmark configs
+(BASELINE.json: MNIST MLP, ResNet-50, Transformer-base, DeepFM,
+BERT-base; plus VGG/LSTM from benchmark/fluid/models/)."""
+
+from . import mnist
+
+__all__ = ["mnist"]

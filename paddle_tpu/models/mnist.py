@@ -1,0 +1,34 @@
+"""MNIST models — the book/recognize_digits configs (reference:
+python/paddle/fluid/tests/book/test_recognize_digits.py: mlp + conv
+variants). The minimum end-to-end slice per SURVEY §7 step 5.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import layers as L
+from ..metrics import accuracy
+
+
+def mlp(image, label):
+    """softmax_regression/mlp from the book test: 784 → 200 → 200 → 10."""
+    h = L.fc(image, 200, act="tanh")
+    h = L.fc(h, 200, act="tanh")
+    logits = L.fc(h, 10)
+    loss = L.mean(L.softmax_with_cross_entropy(logits, label))
+    return {"loss": loss, "acc": accuracy(logits, label), "logits": logits}
+
+
+def conv_net(image, label):
+    """conv_pool x2 + fc (the book's convolutional_neural_network +
+    nets.simple_img_conv_pool analog)."""
+    x = L.reshape(image, [-1, 1, 28, 28])
+    x = L.conv2d(x, num_filters=20, filter_size=5, act="relu")
+    x = L.pool2d(x, pool_size=2, pool_stride=2, pool_type="max")
+    x = L.batch_norm(x)
+    x = L.conv2d(x, num_filters=50, filter_size=5, act="relu")
+    x = L.pool2d(x, pool_size=2, pool_stride=2, pool_type="max")
+    logits = L.fc(x, 10)
+    loss = L.mean(L.softmax_with_cross_entropy(logits, label))
+    return {"loss": loss, "acc": accuracy(logits, label), "logits": logits}

@@ -1,0 +1,126 @@
+"""End-to-end MNIST training — the book/test_recognize_digits analog
+(SURVEY §4 "book" integration tests): train → eval → save → load →
+infer round trip, plus the ParallelExecutor-comparison analog (sharded
+vs single-device losses agree)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import data as pdata
+from paddle_tpu import io as pio
+from paddle_tpu import optimizer as opt
+from paddle_tpu.models import mnist as mnist_models
+
+
+def _feed_iter(batch_size=64, epochs=1):
+    reader = pdata.batch(pdata.shuffle(pdata.datasets.mnist("train"), 512, seed=0),
+                         batch_size)
+    feeder = pdata.DataFeeder(["image", "label"], dtypes=["float32", "int64"])
+    for _ in range(epochs):
+        for samples in reader():
+            feed = feeder.feed(samples)
+            feed["label"] = feed["label"][:, None]
+            yield feed
+
+
+def test_mnist_mlp_trains_to_high_accuracy():
+    prog = pt.build(mnist_models.mlp)
+    trainer = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss")
+    sample = next(_feed_iter())
+    trainer.startup(sample_feed=sample)
+    losses = []
+    for feed in _feed_iter(epochs=3):
+        out = trainer.step(feed)
+        losses.append(float(out["loss"]))
+    assert losses[-1] < losses[0] * 0.5, f"loss did not drop: {losses[0]} -> {losses[-1]}"
+    # eval on held-out synthetic test split
+    test_feed = None
+    reader = pdata.batch(pdata.datasets.mnist("test"), 256)
+    feeder = pdata.DataFeeder(["image", "label"], dtypes=["float32", "int64"])
+    accs = []
+    for samples in reader():
+        feed = feeder.feed(samples)
+        feed["label"] = feed["label"][:, None]
+        out = trainer.eval(feed)
+        accs.append(float(out["acc"]))
+        test_feed = feed
+    assert np.mean(accs) > 0.9, f"test acc too low: {np.mean(accs)}"
+
+    # save → load → infer round trip (book test pattern)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        pio.save_trainer(d, trainer)
+        trainer2 = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss")
+        trainer2.startup(sample_feed=sample)
+        pio.load_trainer(d, trainer2)
+        assert trainer2.global_step == trainer.global_step
+        out1 = trainer.eval(test_feed)
+        out2 = trainer2.eval(test_feed)
+        np.testing.assert_allclose(np.asarray(out1["logits"]), np.asarray(out2["logits"]),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_mnist_conv_net_one_step():
+    prog = pt.build(mnist_models.conv_net)
+    trainer = pt.Trainer(prog, opt.Momentum(0.01, 0.9), loss_name="loss")
+    sample = next(_feed_iter(batch_size=16))
+    trainer.startup(sample_feed=sample)
+    out0 = trainer.step(sample)
+    out1 = trainer.step(sample)
+    assert float(out1["loss"]) < float(out0["loss"])
+
+
+def test_executor_forward_fetch():
+    prog = pt.build(mnist_models.mlp)
+    exe = pt.Executor(pt.CPUPlace())
+    sample = next(_feed_iter(batch_size=8))
+    exe.startup(prog, None, **{k: v for k, v in sample.items()})
+    loss, acc = exe.run(prog, feed=sample, fetch_list=["loss", "acc"])
+    assert np.isfinite(loss)
+    assert 0.0 <= float(acc) <= 1.0
+
+
+def test_sharded_dp_matches_single_device():
+    """ParallelExecutor-vs-Executor loss equivalence analog
+    (test_parallel_executor_* pattern, SURVEY §4): same data, same init →
+    same loss trajectory on an 8-way dp mesh vs single device."""
+    import jax
+    prog = pt.build(mnist_models.mlp)
+    sample = next(_feed_iter(batch_size=64))
+
+    t1 = pt.Trainer(prog, opt.SGD(0.1), loss_name="loss")
+    t1.startup(rng=jax.random.PRNGKey(3), sample_feed=sample)
+
+    mesh = pt.make_mesh({"dp": 8})
+    t2 = pt.Trainer(prog, opt.SGD(0.1), loss_name="loss", mesh=mesh,
+                    sharding_rules=pt.parallel.replicated())
+    t2.startup(rng=jax.random.PRNGKey(3), sample_feed=sample)
+
+    for i, feed in enumerate(_feed_iter(batch_size=64)):
+        o1 = t1.step(feed, rng=jax.random.PRNGKey(100 + i))
+        o2 = t2.step(feed, rng=jax.random.PRNGKey(100 + i))
+        np.testing.assert_allclose(float(o1["loss"]), float(o2["loss"]), rtol=2e-4,
+                                   err_msg=f"diverged at step {i}")
+        if i >= 4:
+            break
+
+
+def test_gradient_accumulation_matches_large_batch():
+    """multi_batch_merge_pass analog: accum_steps=4 on bs=64 ==
+    one step on the same 64 samples."""
+    import jax
+    prog = pt.build(mnist_models.mlp)
+    sample = next(_feed_iter(batch_size=64))
+
+    t_plain = pt.Trainer(prog, opt.SGD(0.1), loss_name="loss")
+    t_plain.startup(rng=jax.random.PRNGKey(5), sample_feed=sample)
+    t_acc = pt.Trainer(prog, opt.SGD(0.1), loss_name="loss",
+                       strategy=pt.DistStrategy(accum_steps=4))
+    t_acc.startup(rng=jax.random.PRNGKey(5), sample_feed=sample)
+
+    o1 = t_plain.step(sample, rng=jax.random.PRNGKey(0))
+    o2 = t_acc.step(sample, rng=jax.random.PRNGKey(0))
+    p1 = t_plain.scope.params["fc_2/w"]
+    p2 = t_acc.scope.params["fc_2/w"]
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-4, atol=1e-5)
