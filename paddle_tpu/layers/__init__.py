@@ -1,6 +1,14 @@
 """Layer library — the ``fluid.layers`` surface (python/paddle/fluid/layers/)."""
 
-from . import nn, ops, tensor
+from . import attention, nn, ops, rnn, tensor
+from .attention import (
+    ffn,
+    multi_head_attention,
+    padding_mask,
+    positional_encoding,
+    scaled_dot_product_attention,
+)
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
+from .rnn import dynamic_gru, dynamic_lstm, rnn as rnn_scan
 from .tensor import *  # noqa: F401,F403

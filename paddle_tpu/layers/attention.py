@@ -1,0 +1,167 @@
+"""Attention layers.
+
+The reference has NO attention kernels — attention exists only as
+composed ops in models (SURVEY §5 "long-context": e.g. benchmark
+machine_translation.py builds dot-product attention from mul/softmax).
+Per SURVEY §7 these are new first-class components for the TPU build:
+a fused scaled-dot-product core (XLA-fused by default, pallas flash
+kernel via ``paddle_tpu.ops.flash_attention`` for long sequences) and a
+multi-head layer whose parameter names line up with the tensor-parallel
+sharding rules (parallel.sharding.transformer_tp_rules).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import LayerHelper, in_training
+from .. import initializer as init
+from .nn import dropout as _dropout
+
+NEG_INF = -1e9  # matches the additive-mask convention (finite to stay bf16-safe)
+
+
+def scaled_dot_product_attention(
+    q, k, v,
+    attn_mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    dropout_rate: float = 0.0,
+    use_flash: Optional[bool] = None,
+):
+    """Fused SDPA over [batch, heads, seq, head_dim] tensors.
+
+    ``attn_mask``: additive mask broadcastable to [b, h, sq, sk] (0 keep,
+    NEG_INF drop) — the convention fluid models built by hand. ``causal``
+    adds the autoregressive mask. Accumulation in fp32 regardless of
+    input dtype (MXU-native bf16 inputs stay bf16 on the matmul inputs).
+    """
+    if use_flash is None:
+        use_flash = False
+    if use_flash and dropout_rate == 0.0:
+        from ..ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, attn_mask=attn_mask)
+
+    head_dim = q.shape[-1]
+    scale = 1.0 / math.sqrt(head_dim)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if attn_mask is not None:
+        logits = logits + attn_mask
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        logits = jnp.where(cm, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0:
+        probs = _dropout(probs, dropout_rate, dropout_implementation="upscale_in_train")
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def multi_head_attention(
+    queries,
+    keys=None,
+    values=None,
+    num_heads: int = 8,
+    d_model: Optional[int] = None,
+    attn_mask: Optional[jax.Array] = None,
+    causal: bool = False,
+    dropout_rate: float = 0.0,
+    cache: Optional[dict] = None,
+    use_flash: Optional[bool] = None,
+    name: Optional[str] = None,
+):
+    """Multi-head attention over [batch, seq, d_model] inputs.
+
+    Parameter names (q_proj/k_proj/v_proj/out_proj) are chosen to match
+    transformer_tp_rules so Megatron-style TP falls out of the rule
+    table. ``cache`` enables incremental decoding: pass {'k':..,'v':..,
+    'index': step} and the layer updates it functionally (returned as
+    second output) — the while-loop decoder analog.
+    """
+    helper = LayerHelper("mha", name=name)
+    self_attn = keys is None
+    keys = queries if keys is None else keys
+    values = keys if values is None else values
+    d_model = d_model or queries.shape[-1]
+    head_dim = d_model // num_heads
+    dtype = queries.dtype
+
+    def proj(x, pname, out_dim):
+        w = helper.create_parameter(f"{pname}/w", (x.shape[-1], out_dim), dtype,
+                                    initializer=init.Xavier())
+        b = helper.create_parameter(f"{pname}/b", (out_dim,), dtype,
+                                    initializer=init.Constant(0.0))
+        return jnp.matmul(x, w) + b
+
+    q = proj(queries, "q_proj", d_model)
+    k = proj(keys, "k_proj", d_model)
+    v = proj(values, "v_proj", d_model)
+
+    def split_heads(x):
+        b, s, _ = x.shape
+        return x.reshape(b, s, num_heads, head_dim).transpose(0, 2, 1, 3)
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+
+    new_cache = None
+    if cache is not None:
+        idx = cache["index"]
+        ck = jax.lax.dynamic_update_slice(cache["k"], k, (0, 0, idx, 0))
+        cv = jax.lax.dynamic_update_slice(cache["v"], v, (0, 0, idx, 0))
+        k, v = ck, cv
+        new_cache = {"k": ck, "v": cv, "index": idx + q.shape[2]}
+        # mask out cache positions beyond the current step
+        kpos = jnp.arange(ck.shape[2])
+        step_mask = jnp.where(kpos[None, None, None, :] <= idx, 0.0, NEG_INF)
+        attn_mask = step_mask if attn_mask is None else attn_mask + step_mask
+        causal = False
+
+    out = scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, causal=causal,
+                                       dropout_rate=dropout_rate, use_flash=use_flash)
+    b, h, s, hd = out.shape
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
+    out = proj(out, "out_proj", d_model)
+    if cache is not None:
+        return out, new_cache
+    return out
+
+
+def ffn(x, d_inner: int, dropout_rate: float = 0.0, activation: str = "relu",
+        name: Optional[str] = None):
+    """Position-wise feed-forward with TP-rule-compatible names."""
+    from .ops import apply_activation
+    helper = LayerHelper("ffn", name=name)
+    d_model = x.shape[-1]
+    w1 = helper.create_parameter("ffn_in/w", (d_model, d_inner), x.dtype,
+                                 initializer=init.Xavier())
+    b1 = helper.create_parameter("ffn_in/b", (d_inner,), x.dtype,
+                                 initializer=init.Constant(0.0))
+    w2 = helper.create_parameter("ffn_out/w", (d_inner, d_model), x.dtype,
+                                 initializer=init.Xavier())
+    b2 = helper.create_parameter("ffn_out/b", (d_model,), x.dtype,
+                                 initializer=init.Constant(0.0))
+    h = apply_activation(jnp.matmul(x, w1) + b1, activation)
+    if dropout_rate:
+        h = _dropout(h, dropout_rate, dropout_implementation="upscale_in_train")
+    return jnp.matmul(h, w2) + b2
+
+
+def positional_encoding(seq_len: int, d_model: int, dtype=jnp.float32):
+    """Sinusoidal position table (the position_encoding_init of the
+    reference's transformer benchmark model)."""
+    pos = jnp.arange(seq_len)[:, None].astype(jnp.float32)
+    i = jnp.arange(d_model // 2)[None, :].astype(jnp.float32)
+    angle = pos / jnp.power(10000.0, 2 * i / d_model)
+    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=-1)
+    return pe.astype(dtype)
+
+
+def padding_mask(ids, pad_id: int = 0):
+    """[b, s] ids -> additive mask [b, 1, 1, s]."""
+    m = (ids == pad_id)
+    return jnp.where(m, NEG_INF, 0.0)[:, None, None, :]

@@ -2,6 +2,6 @@
 (BASELINE.json: MNIST MLP, ResNet-50, Transformer-base, DeepFM,
 BERT-base; plus VGG/LSTM from benchmark/fluid/models/)."""
 
-from . import mnist
+from . import bert, deepfm, lstm, mnist, resnet, transformer, vgg, word2vec
 
-__all__ = ["mnist"]
+__all__ = ["bert", "deepfm", "lstm", "mnist", "resnet", "transformer", "vgg", "word2vec"]

@@ -1,0 +1,56 @@
+"""DeepFM CTR model — the BASELINE "DeepFM CTR (sparse embedding +
+pserver distributed transpiler)" config.
+
+The reference served this workload with the distributed lookup table
+(row-sharded embedding across pservers, distribute_transpiler.py:1100)
+and sparse SelectedRows grads. TPU-native: one [fields*dim] embedding
+table marked is_distributed → row-sharded over the mesh's 'ep'/'fsdp'
+axis by sharding rules; gathers are XLA all-gather/dynamic-gather over
+ICI (see paddle_tpu.sparse for the sparse-grad machinery).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import layers as L
+from ..framework import LayerHelper
+from .. import initializer as init
+
+
+def make_model(num_sparse_fields=26, sparse_feature_dim=1000, embedding_size=16,
+               num_dense=13, hidden_dims=(400, 400, 400)):
+    def deepfm(dense, sparse_ids, label):
+        """dense [b, 13], sparse_ids [b, 26] (field-offset ids), label [b, 1]."""
+        helper = LayerHelper("deepfm")
+        # first-order weights + second-order factor table, row-sharded
+        w1 = helper.create_parameter(
+            "fm_w1/w", (num_sparse_fields * sparse_feature_dim, 1), jnp.float32,
+            initializer=init.Normal(0, 0.01), is_distributed=True)
+        v = helper.create_parameter(
+            "fm_v/w", (num_sparse_fields * sparse_feature_dim, embedding_size),
+            jnp.float32, initializer=init.Normal(0, 0.01), is_distributed=True)
+
+        # offset ids into the flat table: field f occupies rows [f*dim, (f+1)*dim)
+        offsets = (jnp.arange(num_sparse_fields) * sparse_feature_dim)[None, :]
+        flat_ids = sparse_ids.astype(jnp.int32) + offsets
+
+        first = jnp.take(w1, flat_ids, axis=0)[..., 0].sum(axis=1, keepdims=True)
+        emb = jnp.take(v, flat_ids, axis=0)  # [b, fields, k]
+        sum_sq = jnp.square(emb.sum(axis=1))
+        sq_sum = jnp.square(emb).sum(axis=1)
+        second = 0.5 * (sum_sq - sq_sum).sum(axis=1, keepdims=True)
+
+        deep = jnp.concatenate([emb.reshape(emb.shape[0], -1), dense], axis=1)
+        for h in hidden_dims:
+            deep = L.fc(deep, h, act="relu")
+        deep_out = L.fc(deep, 1)
+
+        dense_lin = L.fc(dense, 1)
+        logit = first + second + deep_out + dense_lin
+        labelf = label.astype(jnp.float32)
+        loss = L.mean(L.sigmoid_cross_entropy_with_logits(logit, labelf))
+        prob = L.sigmoid(logit)
+        return {"loss": loss, "prob": prob, "logit": logit}
+
+    return deepfm

@@ -1,0 +1,32 @@
+"""Stacked-LSTM text classification — the benchmark
+stacked_dynamic_lstm model (benchmark/fluid/models/stacked_dynamic_lstm
+.py; the BASELINE LSTM rows: 2 layers + fc, hid=512)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import layers as L
+from ..layers.rnn import dynamic_lstm
+from ..metrics import accuracy
+
+
+def make_model(vocab_size=5000, emb_dim=512, hidden_dim=512, num_layers=2,
+               class_num=2):
+    def lstm_net(word_ids, label, sequence_length=None):
+        x = L.embedding(word_ids, size=[vocab_size, emb_dim])
+        for _ in range(num_layers):
+            x, _ = dynamic_lstm(x, hidden_dim, sequence_length=sequence_length)
+        # mean-pool over valid positions (sequence_pool 'average' analog)
+        if sequence_length is not None:
+            t = x.shape[1]
+            mask = (jnp.arange(t)[None, :] < sequence_length[:, None]).astype(x.dtype)
+            pooled = (x * mask[..., None]).sum(1) / jnp.maximum(
+                mask.sum(1, keepdims=True), 1.0)
+        else:
+            pooled = x.mean(axis=1)
+        logits = L.fc(pooled, class_num)
+        loss = L.mean(L.softmax_with_cross_entropy(logits, label))
+        return {"loss": loss, "acc": accuracy(logits, label), "logits": logits}
+
+    return lstm_net

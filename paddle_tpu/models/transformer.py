@@ -1,0 +1,126 @@
+"""Transformer (encoder-decoder, WMT en-de "base" config).
+
+Capability analog of the reference's fluid transformer benchmark
+(benchmark/fluid/models/machine_translation.py builds attention from
+primitive ops; fluid has no attention kernels — SURVEY §5). Re-designed
+TPU-first: pre-LN residual blocks, bf16-friendly, parameter names
+aligned with parallel.transformer_tp_rules for TP/FSDP sharding, flash
+attention switchable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import layers as L
+from ..framework import LayerHelper, name_scope
+from ..layers import attention as A
+from .. import initializer as init
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    src_vocab: int = 32000
+    trg_vocab: int = 32000
+    max_len: int = 256
+    d_model: int = 512
+    d_inner: int = 2048
+    num_heads: int = 8
+    num_encoder_layers: int = 6
+    num_decoder_layers: int = 6
+    dropout: float = 0.1
+    label_smooth_eps: float = 0.1
+    use_flash: bool = False
+    dtype: str = "float32"
+
+
+def base_config(**kw) -> TransformerConfig:
+    return TransformerConfig(**kw)
+
+
+def _embed(ids, vocab, d_model, dtype, scope_name):
+    with name_scope(scope_name):
+        emb = L.embedding(ids, size=[vocab, d_model], dtype=dtype,
+                          param_attr=None)
+    return emb * (d_model ** 0.5)
+
+
+def encoder_layer(x, cfg: TransformerConfig, mask):
+    h = L.layer_norm(x, begin_norm_axis=2)
+    h = A.multi_head_attention(h, num_heads=cfg.num_heads, attn_mask=mask,
+                               dropout_rate=cfg.dropout, use_flash=cfg.use_flash)
+    x = x + L.dropout(h, cfg.dropout, dropout_implementation="upscale_in_train")
+    h = L.layer_norm(x, begin_norm_axis=2)
+    h = A.ffn(h, cfg.d_inner, dropout_rate=cfg.dropout)
+    return x + L.dropout(h, cfg.dropout, dropout_implementation="upscale_in_train")
+
+
+def decoder_layer(x, enc_out, cfg: TransformerConfig, self_mask, cross_mask,
+                  cache: Optional[dict] = None):
+    h = L.layer_norm(x, begin_norm_axis=2)
+    if cache is not None:
+        h, cache = A.multi_head_attention(h, num_heads=cfg.num_heads, causal=False,
+                                          dropout_rate=0.0, cache=cache)
+    else:
+        h = A.multi_head_attention(h, num_heads=cfg.num_heads, causal=True,
+                                   attn_mask=self_mask, dropout_rate=cfg.dropout,
+                                   use_flash=cfg.use_flash)
+    x = x + L.dropout(h, cfg.dropout, dropout_implementation="upscale_in_train")
+    h = L.layer_norm(x, begin_norm_axis=2)
+    h = A.multi_head_attention(h, keys=enc_out, num_heads=cfg.num_heads,
+                               attn_mask=cross_mask, dropout_rate=cfg.dropout)
+    x = x + L.dropout(h, cfg.dropout, dropout_implementation="upscale_in_train")
+    h = L.layer_norm(x, begin_norm_axis=2)
+    h = A.ffn(h, cfg.d_inner, dropout_rate=cfg.dropout)
+    x = x + L.dropout(h, cfg.dropout, dropout_implementation="upscale_in_train")
+    return (x, cache) if cache is not None else x
+
+
+def encode(src_ids, cfg: TransformerConfig):
+    dtype = jnp.dtype(cfg.dtype)
+    x = _embed(src_ids, cfg.src_vocab, cfg.d_model, dtype, "src")
+    x = x + A.positional_encoding(src_ids.shape[1], cfg.d_model, dtype)[None]
+    x = L.dropout(x, cfg.dropout, dropout_implementation="upscale_in_train")
+    mask = A.padding_mask(src_ids)
+    with name_scope("encoder"):
+        for _ in range(cfg.num_encoder_layers):
+            x = encoder_layer(x, cfg, mask)
+        x = L.layer_norm(x, begin_norm_axis=2)
+    return x, mask
+
+
+def decode(trg_ids, enc_out, cross_mask, cfg: TransformerConfig):
+    dtype = jnp.dtype(cfg.dtype)
+    x = _embed(trg_ids, cfg.trg_vocab, cfg.d_model, dtype, "trg")
+    x = x + A.positional_encoding(trg_ids.shape[1], cfg.d_model, dtype)[None]
+    x = L.dropout(x, cfg.dropout, dropout_implementation="upscale_in_train")
+    with name_scope("decoder"):
+        for _ in range(cfg.num_decoder_layers):
+            x = decoder_layer(x, enc_out, cfg, None, cross_mask)
+        x = L.layer_norm(x, begin_norm_axis=2)
+    helper = LayerHelper("logits_proj")
+    w = helper.create_parameter("w", (cfg.d_model, cfg.trg_vocab), dtype,
+                                initializer=init.Xavier())
+    return jnp.matmul(x, w)
+
+
+def make_model(cfg: TransformerConfig):
+    """Program fn: (src_ids[b,s], trg_ids[b,t], labels[b,t]) -> dict.
+    Loss = label-smoothed CE over non-pad target tokens, matching the
+    reference benchmark's objective."""
+
+    def transformer(src_ids, trg_ids, labels):
+        enc_out, src_mask = encode(src_ids, cfg)
+        logits = decode(trg_ids, enc_out, src_mask, cfg)
+        onehot = L.one_hot(labels, cfg.trg_vocab)
+        smoothed = L.label_smooth(onehot, epsilon=cfg.label_smooth_eps)
+        ce = L.softmax_with_cross_entropy(logits, smoothed, soft_label=True)
+        nonpad = (labels != 0).astype(jnp.float32)
+        token_count = jnp.maximum(nonpad.sum(), 1.0)
+        loss = jnp.sum(ce[..., 0] * nonpad) / token_count
+        return {"loss": loss, "logits": logits, "token_count": token_count}
+
+    return transformer

@@ -1,0 +1,25 @@
+"""word2vec (skip-gram-ish CBOW) — the book/test_word2vec config:
+N-gram context → next word, shared embedding."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import layers as L
+from ..framework import ParamAttr
+
+
+def make_model(dict_size=2000, emb_dim=32, hidden=256, context=4):
+    def w2v(context_ids, label):
+        """context_ids: [b, context] int64; label: [b, 1]."""
+        embs = []
+        for i in range(context):
+            embs.append(L.embedding(context_ids[:, i], size=[dict_size, emb_dim],
+                                    param_attr=ParamAttr(name="shared_emb/w")))
+        x = L.concat(embs, axis=-1)
+        x = L.fc(x, hidden, act="sigmoid")
+        logits = L.fc(x, dict_size)
+        loss = L.mean(L.softmax_with_cross_entropy(logits, label))
+        return {"loss": loss, "logits": logits}
+
+    return w2v

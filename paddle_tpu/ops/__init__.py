@@ -1,0 +1,7 @@
+"""Custom kernels (pallas) — the operators/math kernel-library analog
+(jit_kernel.h xbyak JIT, fused LSTM/softmax kernels): here, hand-written
+TPU kernels for the few ops XLA fusion doesn't already cover."""
+
+from . import flash_attention
+
+__all__ = ["flash_attention"]

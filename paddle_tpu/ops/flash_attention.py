@@ -1,0 +1,275 @@
+"""Flash attention — pallas TPU kernel.
+
+New first-class component per SURVEY §5/§7: the reference has no
+attention kernels at all (attention was composed from mul/softmax ops in
+models), and no answer to long sequences beyond LoD ragged batching.
+This kernel gives O(seq) memory attention on TPU: online-softmax over
+key blocks streamed through VMEM, MXU matmuls with fp32 accumulation.
+
+Forward is a pallas kernel; the custom-VJP backward recomputes
+probabilities blockwise from the saved logsumexp via lax.scan (O(block)
+memory, XLA-fused). Padding is supported as an additive per-key bias
+[b, s_k]; general dense masks should use the XLA path in
+layers.attention.
+
+The ring/context-parallel variant (sequence sharded over the mesh) is
+built on top of this in parallel.ring_attention.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
+                scale: float, causal: bool, block_k: int, seq_k: int):
+    # q_ref: (block_q, d); k_ref/v_ref: (seq_k, d); bias_ref: (1, seq_k) or None
+    block_q = q_ref.shape[0]
+    d = q_ref.shape[1]
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    num_k_blocks = pl.cdiv(seq_k, block_k)
+    if causal:
+        # skip key blocks fully beyond this query block's diagonal
+        last = jnp.minimum(num_k_blocks, pl.cdiv((qi + 1) * block_q, block_k))
+    else:
+        last = num_k_blocks
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        kb = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if bias_ref is not None:
+            s = s + bias_ref[pl.ds(j * block_k, block_k)][None, :]
+        if causal:
+            q_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_idx >= k_idx, s, NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, last, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(l_safe))[None, :]
+
+
+def _flash_fwd(q, k, v, bias, causal: bool, block_q: int, block_k: int,
+               interpret: bool):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    bh = b * h
+    q_r = q.reshape(bh, sq, d)
+    k_r = k.reshape(bh, sk, d)
+    v_r = v.reshape(bh, sk, d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    nq = pl.cdiv(sq, block_q)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), memory_space=pltpu.ANY
+                     if False else pltpu.VMEM),
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+    ]
+    args = [q_r, k_r, v_r]
+    if bias is not None:
+        bias_r = jnp.broadcast_to(bias[:, None, :], (b, h, sk)).reshape(bh, sk)
+        in_specs.append(pl.BlockSpec((1, sk), lambda i, j: (i, 0),
+                                     memory_space=pltpu.VMEM))
+        args.append(bias_r)
+
+    def kernel(*refs):
+        if bias is not None:
+            q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref = refs
+        else:
+            q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+            b_ref = None
+        _fwd_kernel(q_ref.at[0], k_ref.at[0], v_ref.at[0],
+                    b_ref.at[0] if b_ref is not None else None,
+                    o_ref.at[0], lse_ref.at[0],
+                    scale=scale, causal=causal, block_k=block_k, seq_k=sk)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+def _xla_reference(q, k, v, bias, causal):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias[:, None, None, :]
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        s = jnp.where(cm, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, None, causal, block_q, block_k, interpret)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_bias(q, k, v, bias, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, bias, causal, block_q, block_k, interpret)
+    return out
+
+
+def _bwd_blockwise(q, k, v, bias, causal, out, lse, g, block_k):
+    """Blockwise backward from saved lse: O(block) memory, scanned over
+    key blocks (standard flash-attention backward, XLA-compiled)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    of = out.astype(jnp.float32)
+    delta = jnp.sum(of * gf, axis=-1)  # [b,h,sq]
+
+    nkb = sk // block_k if sk % block_k == 0 else -(-sk // block_k)
+    # pad keys to a whole number of blocks
+    pad = nkb * block_k - sk
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    biasp = None
+    if bias is not None:
+        biasp = jnp.pad(bias, ((0, 0), (0, pad)), constant_values=NEG_INF)
+    kb = kp.reshape(b, h, nkb, block_k, d).transpose(2, 0, 1, 3, 4)
+    vb = vp.reshape(b, h, nkb, block_k, d).transpose(2, 0, 1, 3, 4)
+
+    q_idx = jnp.arange(sq)
+
+    def per_block(carry, inp):
+        dq_acc = carry
+        kblk, vblk, j = inp["k"], inp["v"], inp["j"]
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kblk.astype(jnp.float32)) * scale
+        if biasp is not None:
+            bb = jax.lax.dynamic_slice_in_dim(biasp, j * block_k, block_k, axis=1)
+            s = s + bb[:, None, None, :]
+        k_idx = j * block_k + jnp.arange(block_k)
+        if causal:
+            s = jnp.where(q_idx[:, None] >= k_idx[None, :], s, NEG_INF)
+        else:
+            s = jnp.where((k_idx < sk)[None, :], s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # [b,h,sq,bk]
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vblk.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, kblk.astype(jnp.float32))
+        return dq_acc, (dk, dv)
+
+    dq0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    dq, (dks, dvs) = jax.lax.scan(
+        per_block, dq0, {"k": kb, "v": vb, "j": jnp.arange(nkb)})
+    dk = dks.transpose(1, 2, 0, 3, 4).reshape(b, h, nkb * block_k, d)[:, :, :sk]
+    dv = dvs.transpose(1, 2, 0, 3, 4).reshape(b, h, nkb * block_k, d)[:, :, :sk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, None, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    dq, dk, dv = _bwd_blockwise(q, k, v, None, causal, out, lse, g, block_k)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _flash_bias_fwd_rule(q, k, v, bias, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, bias, causal, block_q, block_k, interpret)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _flash_bias_bwd_rule(causal, block_q, block_k, interpret, res, g):
+    q, k, v, bias, out, lse = res
+    dq, dk, dv = _bwd_blockwise(q, k, v, bias, causal, out, lse, g, block_k)
+    return dq, dk, dv, None
+
+
+_flash_bias.defvjp(_flash_bias_fwd_rule, _flash_bias_bwd_rule)
+
+
+def flash_attention(
+    q, k, v,
+    causal: bool = False,
+    attn_mask: Optional[jax.Array] = None,
+    key_bias: Optional[jax.Array] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+):
+    """Flash attention over [b, h, s, d]. ``key_bias``: additive [b, s_k]
+    (padding mask). ``attn_mask``: if given and reducible to a key bias
+    ([b,1,1,s_k] shape), it is converted; otherwise falls back to the
+    XLA composition."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+    if attn_mask is not None:
+        if attn_mask.ndim == 4 and attn_mask.shape[1] == 1 and attn_mask.shape[2] == 1:
+            key_bias = attn_mask[:, 0, 0, :] if key_bias is None \
+                else key_bias + attn_mask[:, 0, 0, :]
+        else:
+            return _xla_reference(q, k, v, None, causal) if attn_mask is None else \
+                _mask_fallback(q, k, v, attn_mask, causal)
+    if key_bias is not None:
+        return _flash_bias(q, k, v, key_bias.astype(jnp.float32), causal,
+                           block_q, block_k, interpret)
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _mask_fallback(q, k, v, attn_mask, causal):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    s = s + attn_mask
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        s = jnp.where(cm, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
