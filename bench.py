@@ -1,0 +1,151 @@
+"""Benchmark driver — fluid_benchmark.py analog (benchmark/fluid/).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline metric: ResNet-50 train throughput (images/sec) on one chip,
+bs=64 — directly comparable to the reference's published ResNet-50
+train number (BASELINE.md: 81.69 images/sec, bs=64, MKL-DNN on 2×Xeon
+6148; the reference has no GPU ResNet-50 number in-tree).
+
+Extra models via --model {resnet50,transformer,mnist_mlp,lstm}; all
+print the same JSON schema (vs_baseline where a reference number
+exists, else null).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+BASELINES = {
+    # reference numbers from BASELINE.md (images/sec or ms/batch-derived)
+    "resnet50": 81.69,        # images/sec, bs=64 (IntelOptimizedPaddle.md:39-45)
+    "vgg16": 28.46,           # images/sec, bs=64 VGG-19 row (closest config)
+    "lstm": 64 / 0.184,       # images(=samples)/sec from 184 ms/batch bs=64 K40m
+    "transformer": None,
+    "mnist_mlp": None,
+}
+
+
+def _bench_loop(step_fn, feeds, warmup=3, iters=10):
+    import jax
+    for i in range(warmup):
+        out = step_fn(feeds[i % len(feeds)])
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = step_fn(feeds[i % len(feeds)])
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return dt / iters
+
+
+def bench_resnet50(batch_size=64, image_size=224, dtype="float32"):
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models import resnet
+
+    model = pt.build(resnet.make_model(depth=50, class_num=1000, image_size=image_size))
+    rng = np.random.RandomState(0)
+    feeds = [{
+        "image": rng.randn(batch_size, 3, image_size, image_size).astype(dtype),
+        "label": rng.randint(0, 1000, (batch_size, 1)).astype(np.int64),
+    } for _ in range(2)]
+    trainer = pt.Trainer(model, opt.Momentum(0.1, 0.9), loss_name="loss")
+    trainer.startup(sample_feed=feeds[0])
+    sec = _bench_loop(lambda f: trainer.step(f), feeds)
+    return batch_size / sec, "images/sec"
+
+
+def bench_transformer(batch_size=32, seq=256, dtype="float32"):
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models import transformer
+
+    cfg = transformer.base_config(src_vocab=32000, trg_vocab=32000, dropout=0.1,
+                                  dtype=dtype)
+    model = pt.build(transformer.make_model(cfg))
+    rng = np.random.RandomState(0)
+    feeds = [{
+        "src_ids": rng.randint(3, 32000, (batch_size, seq)).astype(np.int64),
+        "trg_ids": rng.randint(3, 32000, (batch_size, seq)).astype(np.int64),
+        "labels": rng.randint(3, 32000, (batch_size, seq)).astype(np.int64),
+    } for _ in range(2)]
+    trainer = pt.Trainer(model, opt.Adam(1e-3), loss_name="loss")
+    trainer.startup(sample_feed=feeds[0])
+    sec = _bench_loop(lambda f: trainer.step(f), feeds)
+    return batch_size * seq / sec, "tokens/sec"
+
+
+def bench_mnist_mlp(batch_size=128):
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models import mnist
+
+    model = pt.build(mnist.mlp)
+    rng = np.random.RandomState(0)
+    feeds = [{"image": rng.randn(batch_size, 784).astype(np.float32),
+              "label": rng.randint(0, 10, (batch_size, 1)).astype(np.int64)}
+             for _ in range(2)]
+    trainer = pt.Trainer(model, opt.SGD(0.01), loss_name="loss")
+    trainer.startup(sample_feed=feeds[0])
+    sec = _bench_loop(lambda f: trainer.step(f), feeds, warmup=5, iters=50)
+    return batch_size / sec, "samples/sec"
+
+
+def bench_lstm(batch_size=64, seq=128, hidden=512):
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models import lstm
+
+    model = pt.build(lstm.make_model(vocab_size=10000, emb_dim=hidden,
+                                     hidden_dim=hidden, num_layers=2))
+    rng = np.random.RandomState(0)
+    feeds = [{"word_ids": rng.randint(0, 10000, (batch_size, seq)).astype(np.int64),
+              "label": rng.randint(0, 2, (batch_size, 1)).astype(np.int64),
+              "sequence_length": np.full((batch_size,), seq, np.int64)}
+             for _ in range(2)]
+    trainer = pt.Trainer(model, opt.Adam(1e-3), loss_name="loss")
+    trainer.startup(sample_feed=feeds[0])
+    sec = _bench_loop(lambda f: trainer.step(f), feeds)
+    return batch_size / sec, "samples/sec"
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50",
+                   choices=["resnet50", "transformer", "mnist_mlp", "lstm"])
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--compute_dtype", default="bfloat16",
+                   choices=["float32", "bfloat16"],
+                   help="mixed-precision compute dtype (master params stay f32)")
+    args = p.parse_args()
+
+    from paddle_tpu.core.config import set_flag
+    set_flag("default_compute_dtype", args.compute_dtype)
+
+    kw = {}
+    if args.batch_size:
+        kw["batch_size"] = args.batch_size
+    value, unit = {
+        "resnet50": bench_resnet50,
+        "transformer": bench_transformer,
+        "mnist_mlp": bench_mnist_mlp,
+        "lstm": bench_lstm,
+    }[args.model](**kw)
+
+    base = BASELINES.get(args.model)
+    print(json.dumps({
+        "metric": f"{args.model}_train_throughput_{args.compute_dtype}",
+        "value": round(float(value), 2),
+        "unit": unit,
+        "vs_baseline": round(float(value) / base, 2) if base else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -149,6 +149,38 @@ class BuildContext:
         return jax.random.fold_in(self.rng, h)
 
 
+def compute_dtype():
+    """Mixed-precision compute dtype (float16_transpiler/contrib float16
+    analog, done right for TPU): master params stay float32; layers cast
+    matmul/conv operands to this dtype — bfloat16 hits the MXU natively.
+    Set via config flag 'default_compute_dtype' or amp_guard."""
+    from .core.config import get_flag
+
+    return convert_dtype(get_flag("default_compute_dtype"))
+
+
+@contextlib.contextmanager
+def amp_guard(dtype="bfloat16"):
+    """Scoped mixed precision (fluid contrib float16 rewrite analog)."""
+    from .core.config import get_flag, set_flag
+
+    prev = get_flag("default_compute_dtype")
+    set_flag("default_compute_dtype", dtype)
+    try:
+        yield
+    finally:
+        set_flag("default_compute_dtype", prev)
+
+
+def cast_compute(*arrays):
+    """Cast matmul/conv operands to the compute dtype. Float inputs only;
+    integer arrays pass through."""
+    cd = compute_dtype()
+    out = tuple(a.astype(cd) if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+                else a for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
 _tls = threading.local()
 
 

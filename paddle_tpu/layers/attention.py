@@ -18,7 +18,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ..framework import LayerHelper, in_training
+from ..framework import LayerHelper, cast_compute, in_training
 from .. import initializer as init
 from .nn import dropout as _dropout
 
@@ -92,11 +92,12 @@ def multi_head_attention(
     dtype = queries.dtype
 
     def proj(x, pname, out_dim):
-        w = helper.create_parameter(f"{pname}/w", (x.shape[-1], out_dim), dtype,
+        w = helper.create_parameter(f"{pname}/w", (x.shape[-1], out_dim), jnp.float32,
                                     initializer=init.Xavier())
-        b = helper.create_parameter(f"{pname}/b", (out_dim,), dtype,
+        b = helper.create_parameter(f"{pname}/b", (out_dim,), jnp.float32,
                                     initializer=init.Constant(0.0))
-        return jnp.matmul(x, w) + b
+        x, w = cast_compute(x, w)
+        return jnp.matmul(x, w) + b.astype(x.dtype)
 
     q = proj(queries, "q_proj", d_model)
     k = proj(keys, "k_proj", d_model)
@@ -137,18 +138,19 @@ def ffn(x, d_inner: int, dropout_rate: float = 0.0, activation: str = "relu",
     from .ops import apply_activation
     helper = LayerHelper("ffn", name=name)
     d_model = x.shape[-1]
-    w1 = helper.create_parameter("ffn_in/w", (d_model, d_inner), x.dtype,
+    w1 = helper.create_parameter("ffn_in/w", (d_model, d_inner), jnp.float32,
                                  initializer=init.Xavier())
-    b1 = helper.create_parameter("ffn_in/b", (d_inner,), x.dtype,
+    b1 = helper.create_parameter("ffn_in/b", (d_inner,), jnp.float32,
                                  initializer=init.Constant(0.0))
-    w2 = helper.create_parameter("ffn_out/w", (d_inner, d_model), x.dtype,
+    w2 = helper.create_parameter("ffn_out/w", (d_inner, d_model), jnp.float32,
                                  initializer=init.Xavier())
-    b2 = helper.create_parameter("ffn_out/b", (d_model,), x.dtype,
+    b2 = helper.create_parameter("ffn_out/b", (d_model,), jnp.float32,
                                  initializer=init.Constant(0.0))
-    h = apply_activation(jnp.matmul(x, w1) + b1, activation)
+    x, w1, w2 = cast_compute(x, w1, w2)
+    h = apply_activation(jnp.matmul(x, w1) + b1.astype(x.dtype), activation)
     if dropout_rate:
         h = _dropout(h, dropout_rate, dropout_implementation="upscale_in_train")
-    return jnp.matmul(h, w2) + b2
+    return jnp.matmul(h, w2) + b2.astype(x.dtype)
 
 
 def positional_encoding(seq_len: int, d_model: int, dtype=jnp.float32):

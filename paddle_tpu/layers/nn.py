@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.errors import enforce
-from ..framework import LayerHelper, ParamAttr, in_training, next_rng_key
+from ..framework import LayerHelper, ParamAttr, cast_compute, in_training, next_rng_key
 from .. import initializer as init
 from .ops import apply_activation
 
@@ -64,17 +64,18 @@ def fc(
         w = helper.create_parameter(
             f"w_{i}" if len(inputs) > 1 else "w",
             shape=(in_features, size),
-            dtype=x.dtype,
+            dtype=jnp.float32,
             attr=param_attr,
         )
+        x2, w = cast_compute(x2, w)
         y = jnp.matmul(x2, w)
         out = y if out is None else out + y
     if bias_attr is not False:
         b = helper.create_parameter(
-            "b", shape=(size,), dtype=out.dtype, attr=bias_attr,
+            "b", shape=(size,), dtype=jnp.float32, attr=bias_attr,
             initializer=init.Constant(0.0),
         )
-        out = out + b
+        out = out + b.astype(out.dtype)
     return apply_activation(out, act)
 
 
@@ -108,6 +109,7 @@ def embedding(
         ids = ids[..., 0]
         squeeze_last = True
     out = jnp.take(table, ids, axis=0)
+    out = cast_compute(out)
     if padding_idx is not None:
         pad = vocab + padding_idx if padding_idx < 0 else padding_idx
         mask = (ids != pad)[..., None].astype(out.dtype)
@@ -180,26 +182,27 @@ def conv2d(
     in_c = input.shape[c_axis]
     enforce(in_c % groups == 0, "input channels %d not divisible by groups %d", in_c, groups)
     w = helper.create_parameter(
-        "w", shape=(num_filters, in_c // groups, fs[0], fs[1]), dtype=input.dtype,
+        "w", shape=(num_filters, in_c // groups, fs[0], fs[1]), dtype=jnp.float32,
         attr=param_attr, initializer=init.MSRA(uniform=False),
     )
-    dn = jax.lax.conv_dimension_numbers(input.shape, w.shape if data_format == "NCHW"
+    x, w = cast_compute(input, w)
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape if data_format == "NCHW"
                                         else (fs[0], fs[1], in_c // groups, num_filters),
                                         _conv_dn(4, data_format))
     if data_format != "NCHW":
         w = jnp.transpose(w, (2, 3, 1, 0))  # OIHW -> HWIO
     out = jax.lax.conv_general_dilated(
-        input, w, window_strides=st,
+        x, w, window_strides=st,
         padding=[(pd[0], pd[0]), (pd[1], pd[1])],
         rhs_dilation=dl, dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=jnp.float32 if input.dtype == jnp.bfloat16 else None,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
     )
-    out = out.astype(input.dtype)
+    out = out.astype(x.dtype)
     if bias_attr is not False:
-        b = helper.create_parameter("b", shape=(num_filters,), dtype=out.dtype,
+        b = helper.create_parameter("b", shape=(num_filters,), dtype=jnp.float32,
                                     attr=bias_attr, initializer=init.Constant(0.0))
         bshape = (1, num_filters, 1, 1) if data_format == "NCHW" else (1, 1, 1, num_filters)
-        out = out + b.reshape(bshape)
+        out = out + b.astype(out.dtype).reshape(bshape)
     return apply_activation(out, act)
 
 
@@ -546,9 +549,9 @@ def softmax_with_cross_entropy(
 ):
     """Fused softmax + cross-entropy (softmax_with_cross_entropy_op.cc
     analog) — numerically stable log-sum-exp form; XLA fuses it."""
-    logp = jax.nn.log_softmax(logits, axis=axis)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
     if soft_label:
-        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+        loss = -jnp.sum(label.astype(jnp.float32) * logp, axis=axis, keepdims=True)
     else:
         lab = label.astype(jnp.int32)
         squeeze = lab.ndim == logits.ndim and lab.shape[axis] == 1

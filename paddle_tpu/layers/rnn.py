@@ -18,7 +18,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..framework import LayerHelper
+from ..framework import LayerHelper, cast_compute
 from .. import initializer as init
 
 
@@ -58,15 +58,16 @@ def dynamic_lstm(
     """
     helper = LayerHelper("lstm", name=name)
     b, t, d = input.shape
-    dtype = input.dtype
-    w_x = helper.create_parameter("w_x", (d, 4 * size), dtype, attr=param_attr,
+    w_x = helper.create_parameter("w_x", (d, 4 * size), jnp.float32, attr=param_attr,
                                   initializer=init.Xavier())
-    w_h = helper.create_parameter("w_h", (size, 4 * size), dtype,
+    w_h = helper.create_parameter("w_h", (size, 4 * size), jnp.float32,
                                   initializer=init.Xavier())
-    bias = helper.create_parameter("b", (4 * size,), dtype, attr=bias_attr,
+    bias = helper.create_parameter("b", (4 * size,), jnp.float32, attr=bias_attr,
                                    initializer=init.Constant(0.0))
-
-    x_proj = jnp.matmul(input.reshape(b * t, d), w_x).reshape(b, t, 4 * size) + bias
+    input, w_x, w_h = cast_compute(input, w_x, w_h)
+    dtype = input.dtype
+    x_proj = jnp.matmul(input.reshape(b * t, d), w_x).reshape(b, t, 4 * size) \
+        + bias.astype(dtype)
     x_proj_t = jnp.swapaxes(x_proj, 0, 1)  # [t, b, 4d]
     if is_reverse:
         x_proj_t = x_proj_t[::-1]
@@ -118,14 +119,16 @@ def dynamic_gru(
     Returns outputs [b, t, size]."""
     helper = LayerHelper("gru", name=name)
     b, t, d = input.shape
-    dtype = input.dtype
-    w_x = helper.create_parameter("w_x", (d, 3 * size), dtype, attr=param_attr,
+    w_x = helper.create_parameter("w_x", (d, 3 * size), jnp.float32, attr=param_attr,
                                   initializer=init.Xavier())
-    w_h = helper.create_parameter("w_h", (size, 3 * size), dtype,
+    w_h = helper.create_parameter("w_h", (size, 3 * size), jnp.float32,
                                   initializer=init.Xavier())
-    bias = helper.create_parameter("b", (3 * size,), dtype, attr=bias_attr,
+    bias = helper.create_parameter("b", (3 * size,), jnp.float32, attr=bias_attr,
                                    initializer=init.Constant(0.0))
-    x_proj = jnp.matmul(input.reshape(b * t, d), w_x).reshape(b, t, 3 * size) + bias
+    input, w_x, w_h = cast_compute(input, w_x, w_h)
+    dtype = input.dtype
+    x_proj = jnp.matmul(input.reshape(b * t, d), w_x).reshape(b, t, 3 * size) \
+        + bias.astype(dtype)
     x_proj_t = jnp.swapaxes(x_proj, 0, 1)
     if is_reverse:
         x_proj_t = x_proj_t[::-1]

@@ -1,0 +1,213 @@
+"""Variable-length sequence ops — the LoD equivalent.
+
+The reference's answer to ragged batches is LoD (level-of-detail)
+offsets on tensors (lod_tensor.h:58-110) with ~30 sequence_* ops
+respecting them (sequence_pool/expand/pad/softmax/..., SURVEY §5).
+LoD's dynamic offsets don't fit XLA's static-shape model, so the
+TPU-native design (SURVEY §7 hard-part 1) uses two interchangeable
+static-shape representations:
+
+- **packed**: values [total, ...] + ``segment_ids`` [total] (row id per
+  element, non-decreasing) with a static ``num_seqs``. The direct LoD
+  analog; segment reductions lower to efficient one-hot matmuls /
+  scatter-adds on TPU.
+- **padded**: values [batch, max_len, ...] + ``lengths`` [batch].
+
+Conversions (= sequence_pad/unpad ops) are provided, plus lod-offset
+(row_splits) helpers matching the reference's recursive_sequence_lengths
+API. All ops are jit-safe: shapes depend only on statics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import enforce
+
+# ---------------------------------------------------------------------------
+# representation converters (LoD <-> segment ids <-> padded)
+# ---------------------------------------------------------------------------
+
+
+def lengths_to_offsets(lengths):
+    """lengths [b] -> lod offsets/row_splits [b+1] (lod_tensor.h LoD level)."""
+    return jnp.concatenate([jnp.zeros(1, lengths.dtype), jnp.cumsum(lengths)])
+
+
+def offsets_to_lengths(offsets):
+    return offsets[1:] - offsets[:-1]
+
+
+def lengths_to_segment_ids(lengths, total: int):
+    """lengths [b] -> segment ids [total]; positions past sum(lengths)
+    get id b (one-past-last) so they drop out of segment reductions that
+    use num_segments=b."""
+    offsets = jnp.cumsum(lengths)
+    pos = jnp.arange(total)
+    return jnp.searchsorted(offsets, pos, side="right").astype(jnp.int32)
+
+
+def segment_ids_to_lengths(segment_ids, num_seqs: int):
+    return jax.ops.segment_sum(jnp.ones_like(segment_ids), segment_ids,
+                               num_segments=num_seqs)
+
+
+def sequence_pad(packed, lengths, max_len: int, pad_value=0.0):
+    """packed [total, ...] + lengths [b] -> (padded [b, max_len, ...],
+    lengths) (sequence_pad_op.cc analog)."""
+    total = packed.shape[0]
+    b = lengths.shape[0]
+    offsets = jnp.concatenate([jnp.zeros(1, lengths.dtype), jnp.cumsum(lengths)[:-1]])
+    row = jnp.arange(b)[:, None]
+    col = jnp.arange(max_len)[None, :]
+    src = offsets[:, None] + col  # [b, max_len] gather indices
+    valid = col < lengths[:, None]
+    src = jnp.clip(src, 0, total - 1)
+    out = packed[src]  # [b, max_len, ...]
+    mask = valid.reshape(valid.shape + (1,) * (out.ndim - 2))
+    return jnp.where(mask, out, pad_value), lengths
+
+
+def sequence_unpad(padded, lengths):
+    """padded [b, max_len, ...] + lengths -> packed [b*max_len, ...] with
+    segment ids; invalid tail positions get segment id b (dropped by
+    segment reductions). (sequence_unpad_op.cc analog — static total =
+    b*max_len, the padded-capacity design.)"""
+    b, t = padded.shape[0], padded.shape[1]
+    flat = padded.reshape((b * t,) + padded.shape[2:])
+    col = jnp.arange(t)[None, :]
+    valid = col < lengths[:, None]
+    seg = jnp.where(valid, jnp.arange(b)[:, None], b).reshape(-1).astype(jnp.int32)
+    # order within capacity is row-major; reductions don't care about gaps
+    return flat, seg
+
+
+# ---------------------------------------------------------------------------
+# segment reductions (sequence_pool family, sequence_pool_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def sequence_pool(packed, segment_ids, num_seqs: int, pool_type: str = "average"):
+    """Pool each sequence (sequence_pool_op.cc analog). pool_type ∈
+    {sum, average, sqrt, max, min, first, last}. Elements with
+    segment_id >= num_seqs are ignored."""
+    pool_type = pool_type.lower()
+    if pool_type in ("sum", "average", "sqrt"):
+        s = jax.ops.segment_sum(packed, segment_ids, num_segments=num_seqs)
+        if pool_type == "sum":
+            return s
+        cnt = jax.ops.segment_sum(jnp.ones((packed.shape[0],), packed.dtype),
+                                  segment_ids, num_segments=num_seqs)
+        cnt = jnp.maximum(cnt, 1.0).reshape((num_seqs,) + (1,) * (packed.ndim - 1))
+        return s / cnt if pool_type == "average" else s / jnp.sqrt(cnt)
+    if pool_type == "max":
+        return jax.ops.segment_max(packed, segment_ids, num_segments=num_seqs)
+    if pool_type == "min":
+        return jax.ops.segment_min(packed, segment_ids, num_segments=num_seqs)
+    if pool_type in ("first", "last"):
+        total = packed.shape[0]
+        pos = jnp.arange(total)
+        if pool_type == "first":
+            idx = jax.ops.segment_min(pos, segment_ids, num_segments=num_seqs)
+        else:
+            idx = jax.ops.segment_max(pos, segment_ids, num_segments=num_seqs)
+        idx = jnp.clip(idx, 0, total - 1)
+        return packed[idx]
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+def sequence_first_step(packed, segment_ids, num_seqs: int):
+    return sequence_pool(packed, segment_ids, num_seqs, "first")
+
+
+def sequence_last_step(packed, segment_ids, num_seqs: int):
+    return sequence_pool(packed, segment_ids, num_seqs, "last")
+
+
+def sequence_softmax(packed, segment_ids, num_seqs: int):
+    """Softmax within each sequence (sequence_softmax_op.cc analog):
+    numerically stable segment-wise log-sum-exp."""
+    m = jax.ops.segment_max(packed, segment_ids, num_segments=num_seqs)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    shifted = packed - m[segment_ids]
+    e = jnp.exp(shifted)
+    denom = jax.ops.segment_sum(e, segment_ids, num_segments=num_seqs)
+    return e / jnp.maximum(denom[segment_ids], 1e-30)
+
+
+def sequence_expand(x, ref_lengths, axis_total: int):
+    """Repeat each row x[i] ref_lengths[i] times (sequence_expand_op.cc
+    analog). ``axis_total`` = static output length (= padded capacity of
+    sum(ref_lengths))."""
+    seg = lengths_to_segment_ids(ref_lengths, axis_total)
+    seg = jnp.clip(seg, 0, x.shape[0] - 1)
+    return x[seg]
+
+
+def sequence_reverse(packed, segment_ids, num_seqs: int):
+    """Reverse each sequence in place (sequence_reverse_op.cc analog)."""
+    total = packed.shape[0]
+    pos = jnp.arange(total)
+    first = jax.ops.segment_min(pos, segment_ids, num_segments=num_seqs + 1)
+    last = jax.ops.segment_max(pos, segment_ids, num_segments=num_seqs + 1)
+    sid = jnp.clip(segment_ids, 0, num_seqs)
+    mirrored = first[sid] + last[sid] - pos
+    valid = segment_ids < num_seqs
+    src = jnp.where(valid, mirrored, pos)
+    return packed[src]
+
+
+def sequence_concat(packed_list, segment_ids_list, num_seqs: int):
+    """Concatenate sequences element-wise by segment (sequence_concat_op
+    analog): all inputs share num_seqs; output packs seq0 of every input,
+    then seq1, ... Returns (packed, segment_ids)."""
+    packed = jnp.concatenate(packed_list, axis=0)
+    seg = jnp.concatenate(segment_ids_list, axis=0)
+    order = jnp.argsort(seg, stable=True)
+    return packed[order], seg[order]
+
+
+def sequence_enumerate(ids, win_size: int, pad_value: int = 0):
+    """sequence_enumerate_op analog over padded [b, t] ids: sliding
+    windows [b, t, win_size]."""
+    b, t = ids.shape
+    cols = []
+    for w in range(win_size):
+        shifted = jnp.pad(ids[:, w:], ((0, 0), (0, w)), constant_values=pad_value)
+        cols.append(shifted)
+    return jnp.stack(cols, axis=-1)
+
+
+def sequence_mask(lengths, maxlen: int, dtype=jnp.float32):
+    """sequence_mask op analog: [b, maxlen] 1/0 mask."""
+    return (jnp.arange(maxlen)[None, :] < lengths[:, None]).astype(dtype)
+
+
+def sequence_erase(packed, segment_ids, tokens_to_erase, num_seqs: int):
+    """sequence_erase_op analog — static-shape variant: marks erased
+    positions with segment id num_seqs (so reductions skip them) instead
+    of compacting. Returns (packed, new_segment_ids)."""
+    erase = jnp.zeros(packed.shape[0], jnp.bool_)
+    for t in tokens_to_erase:
+        erase = erase | (packed == t)
+    new_seg = jnp.where(erase, num_seqs, segment_ids).astype(jnp.int32)
+    return packed, new_seg
+
+
+def sequence_slice(packed, segment_ids, num_seqs: int, offset, length,
+                   total_out: int):
+    """sequence_slice_op analog: per-sequence [offset, offset+length)
+    window, repacked into capacity ``total_out`` with fresh segment ids."""
+    pos = jnp.arange(packed.shape[0])
+    first = jax.ops.segment_min(pos, segment_ids, num_segments=num_seqs + 1)[:num_seqs]
+    out_seg = lengths_to_segment_ids(length, total_out)
+    out_seg_c = jnp.clip(out_seg, 0, num_seqs - 1)
+    out_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(length)[:-1].astype(jnp.int32)])
+    within = jnp.arange(total_out) - out_off[out_seg_c]
+    src = first[out_seg_c] + offset[out_seg_c] + within
+    src = jnp.clip(src, 0, packed.shape[0] - 1)
+    return packed[src], jnp.where(out_seg < num_seqs, out_seg, num_seqs).astype(jnp.int32)

@@ -1,0 +1,152 @@
+"""Sequence-op (LoD-equivalent) tests vs per-sequence numpy references —
+the test_sequence_*_op.py family analog."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.layers import sequence as S
+
+
+def _ragged(lengths, dim=3, seed=0):
+    """Build packed values + segment ids from python lengths."""
+    rng = np.random.RandomState(seed)
+    total = sum(lengths)
+    packed = rng.randn(total, dim).astype(np.float32)
+    seg = np.concatenate([[i] * l for i, l in enumerate(lengths)]).astype(np.int32)
+    return packed, seg
+
+
+def test_offsets_roundtrip():
+    lengths = jnp.asarray([3, 1, 4])
+    off = S.lengths_to_offsets(lengths)
+    np.testing.assert_array_equal(np.asarray(off), [0, 3, 4, 8])
+    np.testing.assert_array_equal(np.asarray(S.offsets_to_lengths(off)), [3, 1, 4])
+
+
+def test_lengths_to_segment_ids_with_padding_tail():
+    seg = S.lengths_to_segment_ids(jnp.asarray([2, 3]), total=8)
+    np.testing.assert_array_equal(np.asarray(seg), [0, 0, 1, 1, 1, 2, 2, 2])
+
+
+def test_sequence_pool_all_types():
+    lengths = [2, 3, 1]
+    packed, seg = _ragged(lengths)
+    splits = np.split(packed, np.cumsum(lengths)[:-1])
+    for ptype, ref in [
+        ("sum", np.stack([s.sum(0) for s in splits])),
+        ("average", np.stack([s.mean(0) for s in splits])),
+        ("sqrt", np.stack([s.sum(0) / np.sqrt(len(s)) for s in splits])),
+        ("max", np.stack([s.max(0) for s in splits])),
+        ("min", np.stack([s.min(0) for s in splits])),
+        ("first", np.stack([s[0] for s in splits])),
+        ("last", np.stack([s[-1] for s in splits])),
+    ]:
+        out = S.sequence_pool(jnp.asarray(packed), jnp.asarray(seg), 3, ptype)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"pool_type={ptype}")
+
+
+def test_sequence_pool_ignores_padding_segment():
+    packed, seg = _ragged([2, 2])
+    # append garbage with segment id == num_seqs (padding)
+    packed2 = np.concatenate([packed, 100 * np.ones((3, 3), np.float32)])
+    seg2 = np.concatenate([seg, [2, 2, 2]]).astype(np.int32)
+    out = S.sequence_pool(jnp.asarray(packed2), jnp.asarray(seg2), 2, "sum")
+    ref = S.sequence_pool(jnp.asarray(packed), jnp.asarray(seg), 2, "sum")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_sequence_softmax():
+    lengths = [3, 2]
+    packed = np.array([1.0, 2.0, 3.0, 0.5, 0.5], np.float32)
+    seg = np.array([0, 0, 0, 1, 1], np.int32)
+    out = np.asarray(S.sequence_softmax(jnp.asarray(packed), jnp.asarray(seg), 2))
+    e = np.exp(np.array([1.0, 2.0, 3.0]) - 3.0)
+    np.testing.assert_allclose(out[:3], e / e.sum(), rtol=1e-5)
+    np.testing.assert_allclose(out[3:], [0.5, 0.5], rtol=1e-5)
+    np.testing.assert_allclose(out[:3].sum(), 1.0, rtol=1e-6)
+
+
+def test_sequence_pad_unpad_roundtrip():
+    lengths = [2, 3, 1]
+    packed, seg = _ragged(lengths)
+    padded, lens = S.sequence_pad(jnp.asarray(packed), jnp.asarray(lengths), max_len=4,
+                                  pad_value=0.0)
+    assert padded.shape == (3, 4, 3)
+    np.testing.assert_allclose(np.asarray(padded[0, :2]), packed[:2], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(padded[0, 2:]), 0.0)
+    np.testing.assert_allclose(np.asarray(padded[1, :3]), packed[2:5], rtol=1e-6)
+    flat, seg2 = S.sequence_unpad(padded, jnp.asarray(lengths))
+    pooled_a = S.sequence_pool(flat, seg2, 3, "sum")
+    pooled_b = S.sequence_pool(jnp.asarray(packed), jnp.asarray(seg), 3, "sum")
+    np.testing.assert_allclose(np.asarray(pooled_a), np.asarray(pooled_b), rtol=1e-5)
+
+
+def test_sequence_expand():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    out = S.sequence_expand(jnp.asarray(x), jnp.asarray([2, 3]), axis_total=5)
+    np.testing.assert_allclose(np.asarray(out),
+                               [[1, 2], [1, 2], [3, 4], [3, 4], [3, 4]])
+
+
+def test_sequence_reverse():
+    lengths = [3, 2]
+    packed = np.arange(5, dtype=np.float32)[:, None]
+    seg = np.array([0, 0, 0, 1, 1], np.int32)
+    out = S.sequence_reverse(jnp.asarray(packed), jnp.asarray(seg), 2)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], [2, 1, 0, 4, 3])
+
+
+def test_sequence_concat():
+    p1 = np.array([[1.0], [2.0], [3.0]], np.float32)
+    s1 = np.array([0, 0, 1], np.int32)
+    p2 = np.array([[10.0], [20.0]], np.float32)
+    s2 = np.array([0, 1], np.int32)
+    packed, seg = S.sequence_concat([jnp.asarray(p1), jnp.asarray(p2)],
+                                    [jnp.asarray(s1), jnp.asarray(s2)], 2)
+    np.testing.assert_array_equal(np.asarray(seg), [0, 0, 0, 1, 1])
+    np.testing.assert_allclose(np.asarray(packed)[:, 0], [1, 2, 10, 3, 20])
+
+
+def test_sequence_enumerate():
+    ids = jnp.asarray([[1, 2, 3, 4]])
+    out = S.sequence_enumerate(ids, win_size=2, pad_value=0)
+    np.testing.assert_array_equal(np.asarray(out)[0],
+                                  [[1, 2], [2, 3], [3, 4], [4, 0]])
+
+
+def test_sequence_mask():
+    m = S.sequence_mask(jnp.asarray([1, 3]), maxlen=4)
+    np.testing.assert_allclose(np.asarray(m), [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+
+def test_sequence_erase():
+    packed = jnp.asarray(np.array([1, 2, 1, 3], np.int32))
+    seg = jnp.asarray(np.array([0, 0, 1, 1], np.int32))
+    _, new_seg = S.sequence_erase(packed, seg, [1], 2)
+    np.testing.assert_array_equal(np.asarray(new_seg), [2, 0, 2, 1])
+
+
+def test_sequence_slice():
+    lengths = [4, 3]
+    packed = np.arange(7, dtype=np.float32)[:, None]
+    seg = np.array([0, 0, 0, 0, 1, 1, 1], np.int32)
+    out, out_seg = S.sequence_slice(jnp.asarray(packed), jnp.asarray(seg), 2,
+                                    offset=jnp.asarray([1, 0]),
+                                    length=jnp.asarray([2, 2]), total_out=4)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], [1, 2, 4, 5])
+    np.testing.assert_array_equal(np.asarray(out_seg), [0, 0, 1, 1])
+
+
+def test_jit_safety():
+    """All shape params static: ops must jit without retrace surprises."""
+    import jax
+
+    @jax.jit
+    def fn(packed, seg):
+        return S.sequence_pool(packed, seg, 3, "average")
+
+    packed, seg = _ragged([2, 2, 2])
+    out = fn(jnp.asarray(packed), jnp.asarray(seg))
+    assert out.shape == (3, 3)
