@@ -1,0 +1,86 @@
+"""Control-flow ops.
+
+Analog of python/paddle/fluid/layers/control_flow.py (While:655,
+IfElse:1412, Switch:1286, StaticRNN:429, DynamicRNN:1542) and the C++
+control-flow ops (while_op.cc, conditional_block_op.cc, SURVEY N17).
+The reference interprets sub-blocks with nested executors; here the
+same capabilities are thin, jit-safe wrappers over lax.while_loop /
+cond / switch — XLA compiles the loop body once (no per-iteration
+interpreter). StaticRNN/DynamicRNN live in layers.rnn (scan-based).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Any):
+    """While analog (control_flow.py:655 / while_op.cc): loop_vars is a
+    pytree; cond_fn -> bool scalar; body_fn -> new pytree."""
+    return jax.lax.while_loop(cond_fn, body_fn, loop_vars)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, *operands):
+    """conditional_block/IfElse analog. Both branches are traced (XLA
+    select), matching the reference's requirement that both blocks exist."""
+    return jax.lax.cond(pred, true_fn, false_fn, *operands)
+
+
+def case(pred_fn_pairs: Sequence, default: Callable = None):
+    """layers.case analog: first true predicate wins."""
+    preds = [p for p, _ in pred_fn_pairs]
+    fns = [f for _, f in pred_fn_pairs]
+    if default is not None:
+        fns = fns + [default]
+    # index of first true pred, else len(preds) (default)
+    stacked = jnp.stack([jnp.asarray(p, jnp.bool_) for p in preds])
+    first = jnp.argmax(stacked)
+    any_true = jnp.any(stacked)
+    idx = jnp.where(any_true, first, len(preds) if default is not None else 0)
+    return jax.lax.switch(idx, fns)
+
+
+def switch_case(branch_index, branch_fns: Sequence[Callable], default: Callable = None):
+    """switch/case analog (control_flow.py Switch:1286)."""
+    fns = list(branch_fns)
+    if default is not None:
+        n = len(fns)
+        idx = jnp.clip(branch_index, 0, n)
+        idx = jnp.where((branch_index >= 0) & (branch_index < n), branch_index, n)
+        return jax.lax.switch(idx, fns + [default])
+    return jax.lax.switch(jnp.clip(branch_index, 0, len(fns) - 1), fns)
+
+
+def Print(x, message: str = "", summarize: int = 20, name=None):
+    """In-graph Print op analog (control_flow.py:146) via jax.debug."""
+    jax.debug.print(message + " {}", x)
+    return x
+
+
+def array_write(arr, i, x):
+    """LoDTensorArray write analog: arr is a preallocated [cap, ...]
+    buffer (static capacity — the TPU-native design)."""
+    return jax.lax.dynamic_update_index_in_dim(arr, x, i, axis=0)
+
+
+def array_read(arr, i):
+    return jax.lax.dynamic_index_in_dim(arr, i, axis=0, keepdims=False)
+
+
+def create_array(capacity: int, element_shape, dtype=jnp.float32):
+    return jnp.zeros((capacity,) + tuple(element_shape), dtype)
+
+
+def increment(x, value=1, in_place=None):
+    return x + value
+
+
+def less_than(x, y, force_cpu=None):
+    return jnp.less(x, y)
+
+
+def array_length(arr):
+    return jnp.asarray(arr.shape[0])

@@ -191,13 +191,14 @@ def conv2d(
                                         _conv_dn(4, data_format))
     if data_format != "NCHW":
         w = jnp.transpose(w, (2, 3, 1, 0))  # OIHW -> HWIO
+    # no preferred_element_type: XLA's TPU conv already accumulates bf16
+    # in fp32 on the MXU, and an explicit f32 output breaks the conv VJP
+    # (transpose rule would mix f32 cotangents with bf16 operands).
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=st,
         padding=[(pd[0], pd[0]), (pd[1], pd[1])],
         rhs_dilation=dl, dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
     )
-    out = out.astype(x.dtype)
     if bias_attr is not False:
         b = helper.create_parameter("b", shape=(num_filters,), dtype=jnp.float32,
                                     attr=bias_attr, initializer=init.Constant(0.0))

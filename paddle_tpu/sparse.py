@@ -1,0 +1,163 @@
+"""Sparse gradients & sharded embeddings.
+
+Reference analogs (SURVEY §2.2):
+- **SelectedRows** (selected_rows.h:32): sparse (rows, values) gradient
+  for embedding tables, flowing through allreduce via gather
+  (reduce_and_gather.h) and applied row-wise by optimizer ops.
+- **Distributed lookup table** (distribute_transpiler.py:1100): a large
+  embedding row-sharded across pservers; lookups become split_ids +
+  prefetch RPC + merge; sparse grads are sent per shard.
+
+TPU-native redesign: XLA gathers/scatters are fast and fuse, so the
+*representation* is what matters:
+- :class:`SelectedRows` — (rows, values) pairs with a static row
+  capacity (TPU static shapes), plus merge/dedup (the
+  MergeAdd functor analog).
+- ``lookup_rowwise_grad`` — computes the sparse grad of a lookup
+  without materializing a dense vocab-sized gradient.
+- row-wise optimizer updates (``apply_sgd``/``apply_adagrad``/
+  ``apply_adam_lazy`` — the lazy_mode Adam / sparse sgd_op kernels).
+- ``sharded_embedding_lookup`` — table row-sharded over a mesh axis
+  ('ep'); each device resolves local hits, psum over the axis merges
+  them (the prefetch-and-merge RPC flow, collapsed into one collective).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class SelectedRows:
+    """Sparse rows container (selected_rows.h:32 analog): ``rows`` may
+    contain duplicates (like the reference pre-MergeAdd); ``height`` is
+    the dense dim-0 size."""
+
+    rows: jax.Array     # [n] int32
+    values: jax.Array   # [n, ...] row payloads
+    height: int
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        return cls(children[0], children[1], height)
+
+    def to_dense(self):
+        shape = (self.height,) + self.values.shape[1:]
+        return jnp.zeros(shape, self.values.dtype).at[self.rows].add(self.values)
+
+
+def merge_selected_rows(sr: SelectedRows) -> SelectedRows:
+    """Sum duplicate rows (MergeAdd, selected_rows_functor.h analog).
+    Static-shape version: sorts rows, segment-sums into the same
+    capacity; duplicate slots become padding rows (height) with zero
+    values."""
+    order = jnp.argsort(sr.rows)
+    rows_s = sr.rows[order]
+    vals_s = sr.values[order]
+    is_first = jnp.concatenate([jnp.ones(1, jnp.bool_), rows_s[1:] != rows_s[:-1]])
+    group = jnp.cumsum(is_first) - 1  # group index per element
+    n = sr.rows.shape[0]
+    summed = jnp.zeros_like(vals_s).at[group].add(vals_s)
+    first_pos = jnp.where(is_first, jnp.arange(n), n)
+    # compact: slot g <- rows of the g-th group
+    slot_src = jnp.sort(first_pos)  # first element position of each group (n padding)
+    valid = slot_src < n
+    slot_src_c = jnp.clip(slot_src, 0, n - 1)
+    new_rows = jnp.where(valid, rows_s[slot_src_c], sr.height).astype(jnp.int32)
+    new_vals = jnp.where(valid[:, None], summed[jnp.clip(group[slot_src_c], 0, n - 1)], 0.0)
+    return SelectedRows(new_rows, new_vals, sr.height)
+
+
+def lookup_rowwise_grad(ids, grad_out, vocab: int) -> SelectedRows:
+    """The sparse gradient of ``jnp.take(table, ids)`` wrt the table:
+    rows=ids.flatten(), values=grad_out reshaped — no dense [vocab, d]
+    materialization (the is_sparse=True lookup_table_grad path)."""
+    rows = ids.reshape(-1).astype(jnp.int32)
+    values = grad_out.reshape((rows.shape[0],) + grad_out.shape[ids.ndim:])
+    return SelectedRows(rows, values, vocab)
+
+
+# -- row-wise optimizer kernels (sparse sgd_op / lazy adam analogs) ---------
+
+
+def apply_sgd(table, sr: SelectedRows, lr):
+    """Sparse SGD row update (sgd_op.cc SelectedRows branch)."""
+    safe = jnp.clip(sr.rows, 0, table.shape[0] - 1)
+    mask = (sr.rows < table.shape[0])[:, None].astype(table.dtype)
+    return table.at[safe].add(-lr * sr.values * mask)
+
+
+def apply_adagrad(table, moment, sr: SelectedRows, lr, epsilon=1e-6):
+    sr = merge_selected_rows(sr)
+    safe = jnp.clip(sr.rows, 0, table.shape[0] - 1)
+    mask = (sr.rows < table.shape[0])[:, None].astype(table.dtype)
+    g = sr.values * mask
+    m_rows = moment[safe] + g * g
+    moment = moment.at[safe].set(jnp.where(mask > 0, m_rows, moment[safe]))
+    upd = lr * g / (jnp.sqrt(m_rows) + epsilon)
+    return table.at[safe].add(-upd), moment
+
+
+def apply_adam_lazy(table, m1, m2, sr: SelectedRows, lr, t,
+                    beta1=0.9, beta2=0.999, epsilon=1e-8):
+    """Lazy-mode Adam (adam_op lazy_mode): moments updated only on
+    touched rows."""
+    sr = merge_selected_rows(sr)
+    safe = jnp.clip(sr.rows, 0, table.shape[0] - 1)
+    mask = (sr.rows < table.shape[0])[:, None].astype(table.dtype)
+    g = sr.values * mask
+    m1_rows = beta1 * m1[safe] + (1 - beta1) * g
+    m2_rows = beta2 * m2[safe] + (1 - beta2) * g * g
+    m1 = m1.at[safe].set(jnp.where(mask > 0, m1_rows, m1[safe]))
+    m2 = m2.at[safe].set(jnp.where(mask > 0, m2_rows, m2[safe]))
+    tf = jnp.asarray(t, jnp.float32) + 1.0
+    lr_t = lr * jnp.sqrt(1 - jnp.power(beta2, tf)) / (1 - jnp.power(beta1, tf))
+    upd = lr_t * m1_rows / (jnp.sqrt(m2_rows) + epsilon) * mask
+    return table.at[safe].add(-upd), m1, m2
+
+
+# -- sharded embedding (distributed lookup table analog) --------------------
+
+
+def sharded_embedding_lookup(table, ids, mesh: Mesh, axis: str = "ep",
+                             batch_axes: Tuple[str, ...] = ("dp", "fsdp")):
+    """Lookup into a row-sharded table: table [vocab, d] sharded on dim 0
+    over ``axis``; ids [...] replicated over ``axis`` (sharded over batch
+    axes). Each device gathers local hits; psum merges across shards —
+    one ICI collective instead of the reference's per-pserver prefetch
+    RPCs (request PrefetchVariable, send_recv.proto.in:28)."""
+    if axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        return jnp.take(table, ids, axis=0)
+    vocab = table.shape[0]
+    n = mesh.shape[axis]
+    shard = vocab // n
+
+    bspec = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    bshard = bspec if len(bspec) > 1 else (bspec[0] if bspec else None)
+    ids_spec = P(bshard, *([None] * (ids.ndim - 1)))
+
+    def body(tbl, ids_):
+        k = jax.lax.axis_index(axis)
+        lo = k * shard
+        local = ids_ - lo
+        hit = (local >= 0) & (local < shard)
+        safe = jnp.clip(local, 0, shard - 1)
+        vals = jnp.take(tbl, safe, axis=0)
+        vals = jnp.where(hit[..., None], vals, 0.0)
+        return jax.lax.psum(vals, axis)
+
+    out_spec = P(bshard, *([None] * ids.ndim))
+    return jax.shard_map(body, mesh=mesh,
+                         in_specs=(P(axis, None), ids_spec),
+                         out_specs=out_spec)(table, ids)

@@ -1,0 +1,108 @@
+"""SelectedRows / sparse-update / sharded-embedding tests
+(test_selected_rows / test_lookup_table_op / dist lookup-table analog)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import sparse as sp
+
+
+def test_selected_rows_to_dense_and_merge():
+    sr = sp.SelectedRows(jnp.asarray([1, 3, 1], jnp.int32),
+                         jnp.asarray([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]]), height=5)
+    dense = np.asarray(sr.to_dense())
+    np.testing.assert_allclose(dense[1], [4.0, 4.0])
+    np.testing.assert_allclose(dense[3], [2.0, 2.0])
+    merged = sp.merge_selected_rows(sr)
+    d2 = np.asarray(merged.to_dense())
+    np.testing.assert_allclose(d2, dense)
+    # merged rows are unique (padding slots = height)
+    rows = np.asarray(merged.rows)
+    real = rows[rows < 5]
+    assert len(np.unique(real)) == len(real)
+
+
+def test_lookup_rowwise_grad_matches_dense_grad():
+    vocab, dim = 10, 4
+    rng = np.random.RandomState(0)
+    table = jnp.asarray(rng.randn(vocab, dim).astype(np.float32))
+    ids = jnp.asarray(np.array([[1, 2], [2, 5]], np.int64))
+    w = jnp.asarray(rng.randn(2, 2, dim).astype(np.float32))
+
+    def loss(t):
+        return jnp.sum(jnp.take(t, ids, axis=0) * w)
+
+    dense_grad = jax.grad(loss)(table)
+    grad_out = w  # d loss / d lookup output
+    sr = sp.lookup_rowwise_grad(ids, grad_out, vocab)
+    np.testing.assert_allclose(np.asarray(sr.to_dense()), np.asarray(dense_grad),
+                               rtol=1e-6)
+
+
+def test_apply_sgd_sparse_rows_only():
+    table = jnp.ones((6, 2))
+    sr = sp.SelectedRows(jnp.asarray([0, 3], jnp.int32),
+                         jnp.asarray([[1.0, 1.0], [2.0, 2.0]]), height=6)
+    out = np.asarray(sp.apply_sgd(table, sr, lr=0.5))
+    np.testing.assert_allclose(out[0], [0.5, 0.5])
+    np.testing.assert_allclose(out[3], [0.0, 0.0])
+    np.testing.assert_allclose(out[1], [1.0, 1.0])  # untouched
+
+
+def test_apply_adagrad_and_adam_lazy_touch_only_rows():
+    vocab, dim = 8, 3
+    table = jnp.ones((vocab, dim))
+    moment = jnp.zeros((vocab, dim))
+    sr = sp.SelectedRows(jnp.asarray([2, 2, 5], jnp.int32),
+                         jnp.ones((3, dim)), height=vocab)
+    t2, m2 = sp.apply_adagrad(table, moment, sr, lr=0.1)
+    assert not np.allclose(np.asarray(t2)[2], 1.0)
+    assert not np.allclose(np.asarray(t2)[5], 1.0)
+    np.testing.assert_allclose(np.asarray(t2)[0], 1.0)
+    np.testing.assert_allclose(np.asarray(m2)[0], 0.0)
+
+    m1 = jnp.zeros((vocab, dim))
+    mm2 = jnp.zeros((vocab, dim))
+    t3, nm1, nm2 = sp.apply_adam_lazy(table, m1, mm2, sr, lr=0.1, t=0)
+    assert not np.allclose(np.asarray(t3)[2], 1.0)
+    np.testing.assert_allclose(np.asarray(t3)[1], 1.0)
+    # duplicate rows merged: row 2 got grad 2.0
+    assert np.asarray(nm1)[2, 0] == pytest.approx(0.2, rel=1e-5)
+
+
+def test_sharded_embedding_matches_dense():
+    mesh = pt.make_mesh({"ep": 8})
+    vocab, dim = 32, 4
+    rng = np.random.RandomState(0)
+    table = jnp.asarray(rng.randn(vocab, dim).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, vocab, (5, 7)).astype(np.int32))
+    out = sp.sharded_embedding_lookup(table, ids, mesh, axis="ep", batch_axes=())
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jnp.take(table, ids, axis=0)),
+                               rtol=1e-6)
+
+
+def test_sharded_embedding_with_dp():
+    mesh = pt.make_mesh({"dp": 2, "ep": 4})
+    vocab, dim = 16, 4
+    rng = np.random.RandomState(1)
+    table = jnp.asarray(rng.randn(vocab, dim).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, vocab, (6, 3)).astype(np.int32))
+    out = sp.sharded_embedding_lookup(table, ids, mesh, axis="ep")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jnp.take(table, ids, axis=0)),
+                               rtol=1e-6)
+
+
+def test_sharded_embedding_grad():
+    mesh = pt.make_mesh({"ep": 4}, devices=jax.devices()[:4])
+    vocab, dim = 16, 4
+    rng = np.random.RandomState(2)
+    table = jnp.asarray(rng.randn(vocab, dim).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, vocab, (5,)).astype(np.int32))
+
+    g1 = jax.grad(lambda t: jnp.sum(
+        sp.sharded_embedding_lookup(t, ids, mesh, axis="ep", batch_axes=()) ** 2))(table)
+    g2 = jax.grad(lambda t: jnp.sum(jnp.take(t, ids, axis=0) ** 2))(table)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5)
