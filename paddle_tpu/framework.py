@@ -209,6 +209,22 @@ def _use_ctx(ctx: BuildContext):
 
 
 @contextlib.contextmanager
+def reuse_names():
+    """Replay unique-name counters on exit, so a block of layer calls
+    invoked repeatedly (e.g. a decode step called once outside lax.scan
+    to create params and again inside to reuse them) resolves to the
+    SAME parameter names each time — the ParamAttr-name / while_op
+    sub-block variable-reuse analog."""
+    ctx = _ctx()
+    snapshot = dict(ctx.namer.ids)
+    try:
+        yield
+    finally:
+        ctx.namer.ids.clear()
+        ctx.namer.ids.update(snapshot)
+
+
+@contextlib.contextmanager
 def name_scope(name: str):
     """Hierarchical naming scope (fluid.name_scope analog)."""
     ctx = _ctx()

@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from .. import layers as L
@@ -105,6 +106,73 @@ def decode(trg_ids, enc_out, cross_mask, cfg: TransformerConfig):
     w = helper.create_parameter("w", (cfg.d_model, cfg.trg_vocab), dtype,
                                 initializer=init.Xavier())
     return jnp.matmul(x, w)
+
+
+def make_decoder(cfg: TransformerConfig, max_len: int, beam_size: int = 1,
+                 bos_id: int = 1, eos_id: int = 2, length_penalty_alpha: float = 0.0):
+    """Incremental decoding program (beam_search_op capability): cached
+    self-attention KV, one token per step, greedy or beam. Shares
+    parameter names with make_model's train program, so params from a
+    trained Trainer scope load directly.
+
+    Returns a program fn: (src_ids [b, s]) -> ids [b, max_len] (greedy)
+    or [b, beam, max_len] (beam)."""
+    from ..framework import reuse_names
+    from ..layers.beam_search import beam_search, greedy_search
+
+    def decode_program(src_ids):
+        dtype = jnp.dtype(cfg.dtype)
+        b = src_ids.shape[0]
+        enc_out, src_mask = encode(src_ids, cfg)
+        K = beam_size
+        if K > 1:
+            # tile encoder outputs per beam
+            enc_out = jnp.repeat(enc_out, K, axis=0)
+            src_mask = jnp.repeat(src_mask, K, axis=0)
+        rows = b * K
+        head_dim = cfg.d_model // cfg.num_heads
+        caches = [
+            {"k": jnp.zeros((rows, cfg.num_heads, max_len, head_dim), dtype),
+             "v": jnp.zeros((rows, cfg.num_heads, max_len, head_dim), dtype),
+             "index": jnp.asarray(0, jnp.int32)}
+            for _ in range(cfg.num_decoder_layers)
+        ]
+        pe = A.positional_encoding(max_len, cfg.d_model, dtype)
+
+        def run_step(tokens, caches):
+            with reuse_names():
+                pos = caches[0]["index"]
+                with name_scope("trg"):
+                    x = L.embedding(tokens, size=[cfg.trg_vocab, cfg.d_model],
+                                    dtype=cfg.dtype) * (cfg.d_model ** 0.5)
+                x = x[:, None, :]  # [rows, 1, d_model]
+                x = x + jax.lax.dynamic_slice_in_dim(pe, pos, 1, axis=0)[None]
+                new_caches = []
+                with name_scope("decoder"):
+                    for li in range(cfg.num_decoder_layers):
+                        x, c = decoder_layer(x, enc_out, cfg, None, src_mask,
+                                             cache=caches[li])
+                        new_caches.append(c)
+                    x = L.layer_norm(x, begin_norm_axis=2)
+                helper = LayerHelper("logits_proj")
+                w = helper.create_parameter("w", (cfg.d_model, cfg.trg_vocab), dtype,
+                                            initializer=init.Xavier())
+                logits = jnp.matmul(x[:, 0], w)
+                return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1), new_caches
+
+        # materialize params once outside the scan (init-mode safety)
+        _, caches0 = run_step(jnp.full((rows,), bos_id, jnp.int32), caches)
+        del caches0
+        if K > 1:
+            seqs, scores = beam_search(run_step, caches, b, K, max_len,
+                                       bos_id=bos_id, eos_id=eos_id,
+                                       length_penalty_alpha=length_penalty_alpha)
+            return {"ids": seqs, "scores": scores}
+        seqs = greedy_search(run_step, caches, rows, max_len, bos_id=bos_id,
+                             eos_id=eos_id)
+        return {"ids": seqs}
+
+    return decode_program
 
 
 def make_model(cfg: TransformerConfig):
