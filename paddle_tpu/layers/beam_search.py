@@ -24,6 +24,8 @@ NEG_INF = -1e9
 def _gather_beams(tree, idx, batch, beam):
     """Reindex the beam dimension of every [B*beam, ...] leaf."""
     def g(x):
+        if x.ndim == 0 or x.shape[0] != batch * beam:
+            return x  # non-batched leaf (e.g. a cache step index)
         xb = x.reshape((batch, beam) + x.shape[1:])
         return jnp.take_along_axis(
             xb, idx.reshape((batch, beam) + (1,) * (x.ndim - 1)), axis=1
