@@ -54,13 +54,26 @@ def shard_scope(mesh: Mesh, rules: Optional[ShardingRules], params, state, opt_s
 
 def put_batch(mesh: Mesh, rules: Optional[ShardingRules], feed: Dict[str, Any]):
     """Shard a host batch over the data axes (DataFeeder.feed_parallel
-    analog, data_feeder.py:201 — without the per-device split loop)."""
+    analog, data_feeder.py:201 — without the per-device split loop).
+
+    Single-process: device_put with the batch sharding. Multi-process
+    (jax.distributed initialized): each process passes its LOCAL batch
+    shard and the global array is assembled across hosts — the
+    num_trainers/trainer_id data split of the reference
+    (distribute_transpiler trainer-side), without program surgery.
+    """
     rules = _rules(rules)
+    multiproc = jax.process_count() > 1
     out = {}
     for k, v in feed.items():
         arr = np.asarray(v) if not isinstance(v, jax.Array) else v
         spec = rules.batch_spec(mesh, arr.ndim)
-        out[k] = jax.device_put(arr, NamedSharding(mesh, spec))
+        ns = NamedSharding(mesh, spec)
+        if multiproc:
+            global_shape = (arr.shape[0] * jax.process_count(),) + arr.shape[1:]
+            out[k] = jax.make_array_from_process_local_data(ns, arr, global_shape)
+        else:
+            out[k] = jax.device_put(arr, ns)
     return out
 
 

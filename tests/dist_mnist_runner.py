@@ -1,0 +1,57 @@
+"""Runnable distributed-trainer script — the dist_mnist.py analog
+(SURVEY §4: model scripts driven by runtime_main in test_dist_base.py).
+
+Launched as subprocesses by test_dist_multiprocess.py:
+    python dist_mnist_runner.py <proc_id> <nprocs> <port> <steps>
+Trains MNIST MLP data-parallel across processes, prints per-step losses
+as `LOSS <step> <value>` lines for the parent to compare."""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+pid, nprocs, port, steps = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], int(sys.argv[4])
+if nprocs > 1:
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nprocs, process_id=pid)
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import optimizer as opt
+from paddle_tpu.models import mnist as mnist_models
+
+
+def global_batches(step, global_bs=64):
+    """Deterministic global batch for step; each process takes its slice."""
+    rng = np.random.RandomState(1000 + step)
+    centers = np.random.RandomState(0).randn(10, 784).astype(np.float32)
+    y = rng.randint(0, 10, (global_bs,))
+    x = centers[y] + 0.5 * rng.randn(global_bs, 784).astype(np.float32)
+    return x, y[:, None].astype(np.int64)
+
+
+def main():
+    prog = pt.build(mnist_models.mlp)
+    mesh = pt.make_mesh({"dp": jax.device_count()})
+    trainer = pt.Trainer(prog, opt.SGD(0.1), loss_name="loss", mesh=mesh,
+                         sharding_rules=pt.parallel.replicated())
+    x0, y0 = global_batches(0)
+    local = x0.shape[0] // nprocs
+    sample = {"image": x0[:local], "label": y0[:local]}
+    trainer.startup(rng=jax.random.PRNGKey(42), sample_feed=sample)
+    for s in range(steps):
+        x, y = global_batches(s)
+        lo, hi = pid * local, (pid + 1) * local
+        out = trainer.step({"image": x[lo:hi], "label": y[lo:hi]},
+                           rng=jax.random.PRNGKey(s))
+        print(f"LOSS {s} {float(out['loss']):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

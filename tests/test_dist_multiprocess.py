@@ -1,0 +1,61 @@
+"""Multi-process localhost distributed training — the TestDistBase
+analog (test_dist_base.py:377 check_with_place: subprocesses on
+127.0.0.1 free ports, trainer losses ≈ local losses)."""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(__file__)
+RUNNER = os.path.join(HERE, "dist_mnist_runner.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_procs(nprocs, steps, timeout=240):
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    repo_root = os.path.dirname(HERE)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, RUNNER, str(i), str(nprocs), str(port), str(steps)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True)
+        for i in range(nprocs)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, f"trainer failed:\n{err[-3000:]}"
+        outs.append(out)
+    return outs
+
+
+def _losses(out):
+    return {int(m.group(1)): float(m.group(2))
+            for m in re.finditer(r"LOSS (\d+) ([\d.]+)", out)}
+
+
+@pytest.mark.slow
+def test_two_process_dp_matches_single_process():
+    steps = 5
+    single = _losses(_run_procs(1, steps)[0])
+    multi = _run_procs(2, steps)
+    l0, l1 = _losses(multi[0]), _losses(multi[1])
+    assert len(single) == steps and len(l0) == steps
+    for s in range(steps):
+        # both workers report the same (psum'd) loss
+        assert abs(l0[s] - l1[s]) < 1e-5
+        # and it matches the single-process run on the same global batch
+        assert abs(l0[s] - single[s]) < 1e-3, (
+            f"step {s}: dist {l0[s]} vs local {single[s]}")

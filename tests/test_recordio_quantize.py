@@ -1,0 +1,152 @@
+"""RecordIO (C++ core) + quantization-pass tests."""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import quantize as Q
+from paddle_tpu import recordio as rio
+
+
+def test_recordio_roundtrip_bytes():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "t.rio")
+        recs = [b"hello", b"", b"x" * 100000, b"world"]
+        with rio.Writer(path, compress=True, chunk_bytes=4096) as w:
+            for r in recs:
+                w.write(r)
+        got = list(rio.Scanner(path))
+        assert got == recs
+
+
+def test_recordio_uncompressed_and_multi_chunk():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "t.rio")
+        recs = [os.urandom(1000) for _ in range(300)]  # spans chunks
+        with rio.Writer(path, compress=False, chunk_bytes=8192) as w:
+            for r in recs:
+                w.write(r)
+        assert list(rio.Scanner(path)) == recs
+
+
+def test_recordio_corruption_detected():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "t.rio")
+        with rio.Writer(path) as w:
+            w.write(b"a" * 1000)
+        data = bytearray(open(path, "rb").read())
+        data[-10] ^= 0xFF  # flip a payload byte
+        open(path, "wb").write(bytes(data))
+        with pytest.raises(IOError):
+            list(rio.Scanner(path))
+
+
+def test_recordio_numpy_arrays_and_reader():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "data.rio")
+        samples = [(np.random.randn(784).astype(np.float32), np.int64(i % 10))
+                   for i in range(50)]
+        n = rio.write_arrays(path, samples)
+        assert n == 50
+        back = list(rio.reader_creator(path)())
+        assert len(back) == 50
+        np.testing.assert_allclose(back[3][0], samples[3][0])
+        assert back[3][1] == samples[3][1]
+        # composes with reader combinators
+        from paddle_tpu import data as pdata
+        batches = list(pdata.batch(rio.reader_creator(path), 16)())
+        assert len(batches) == 3
+
+
+# -- quantization ------------------------------------------------------------
+
+
+def test_fake_quant_forward_and_ste_grad():
+    x = jnp.asarray(np.linspace(-2, 2, 11).astype(np.float32))
+    scale = jnp.asarray(1.0)
+    out = Q.fake_quant(x, scale)
+    # values clipped to [-1, 1] range times scale
+    assert float(jnp.max(out)) <= 1.0 + 1e-6
+    g = jax.grad(lambda a: jnp.sum(Q.fake_quant(a, scale)))(x)
+    # straight-through: grad 1 inside [-scale, scale], 0 outside
+    inside = np.abs(np.asarray(x)) <= 1.0
+    np.testing.assert_allclose(np.asarray(g), inside.astype(np.float32))
+
+
+def test_fake_quant_abs_max_quantizes():
+    x = jnp.asarray(np.random.RandomState(0).randn(64).astype(np.float32))
+    out = np.asarray(Q.fake_quant_abs_max(x, num_bits=8))
+    scale = np.abs(np.asarray(x)).max()
+    levels = np.round(np.asarray(x) / scale * 127)
+    np.testing.assert_allclose(out, levels * scale / 127, rtol=1e-5, atol=1e-6)
+
+
+def test_ptq_roundtrip_error_small():
+    rng = np.random.RandomState(0)
+    params = {"fc_0/w": jnp.asarray(rng.randn(32, 16).astype(np.float32)),
+              "fc_0/b": jnp.asarray(rng.randn(16).astype(np.float32))}
+    store = Q.quantize_params(params)
+    assert store["fc_0/w"]["q"].dtype == jnp.int8
+    assert isinstance(store["fc_0/b"], jax.Array)  # bias passthrough
+    deq = Q.dequantize_params(store)
+    err = np.abs(np.asarray(deq["fc_0/w"]) - np.asarray(params["fc_0/w"])).max()
+    scale = np.abs(np.asarray(params["fc_0/w"])).max()
+    assert err < scale / 100  # 8-bit per-channel: <1% of range
+
+
+def test_quantized_mlp_accuracy_close():
+    """PTQ on a trained MLP: quantized inference stays close."""
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models import mnist as mnist_models
+
+    prog = pt.build(mnist_models.mlp)
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 784).astype(np.float32)
+    y = rng.randint(0, 10, (64, 1)).astype(np.int64)
+    trainer = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss")
+    trainer.startup(sample_feed={"image": x, "label": y})
+    for _ in range(5):
+        trainer.step({"image": x, "label": y})
+    out_fp, _ = prog.apply(trainer.scope.params, trainer.scope.state, x, y)
+    deq = Q.dequantize_params(Q.quantize_params(trainer.scope.params))
+    out_q, _ = prog.apply(deq, trainer.scope.state, x, y)
+    agree = (np.asarray(out_fp["logits"]).argmax(1) ==
+             np.asarray(out_q["logits"]).argmax(1)).mean()
+    assert agree > 0.95
+
+
+def test_bf16_inference_cast():
+    params = {"w": jnp.ones((4, 4)), "ids": jnp.ones((3,), jnp.int32)}
+    cast = Q.cast_params_for_inference(params, jnp.bfloat16)
+    assert cast["w"].dtype == jnp.bfloat16
+    assert cast["ids"].dtype == jnp.int32
+
+
+def test_fold_batch_norms():
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 3, 3, 3).astype(np.float32)
+    params = {"conv2d_0/w": jnp.asarray(w),
+              "batch_norm_0/scale": jnp.asarray(rng.rand(8).astype(np.float32) + 0.5),
+              "batch_norm_0/bias": jnp.asarray(rng.randn(8).astype(np.float32))}
+    state = {"batch_norm_0/moving_mean": jnp.asarray(rng.randn(8).astype(np.float32)),
+             "batch_norm_0/moving_variance": jnp.asarray(rng.rand(8).astype(np.float32) + 0.5)}
+    folded = Q.fold_batch_norms(params, state, [("conv2d_0", "batch_norm_0")])
+    x = jnp.asarray(rng.randn(1, 3, 8, 8).astype(np.float32))
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+
+    def conv(xx, ww):
+        return jax.lax.conv_general_dilated(xx, ww, (1, 1), [(1, 1), (1, 1)],
+                                            dimension_numbers=dn)
+
+    # reference: conv -> BN(inference)
+    y = conv(x, jnp.asarray(w))
+    inv = params["batch_norm_0/scale"] * jax.lax.rsqrt(state["batch_norm_0/moving_variance"] + 1e-5)
+    ref = (y - state["batch_norm_0/moving_mean"].reshape(1, -1, 1, 1)) * inv.reshape(1, -1, 1, 1) \
+        + params["batch_norm_0/bias"].reshape(1, -1, 1, 1)
+    got = conv(x, folded["conv2d_0/w"]) + folded["conv2d_0/folded_bias"].reshape(1, -1, 1, 1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-5)
