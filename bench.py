@@ -31,15 +31,22 @@ BASELINES = {
 }
 
 
-def _bench_loop(step_fn, feeds, warmup=3, iters=10):
+def _sync(out):
+    # device_get of a scalar forces a real sync — block_until_ready alone
+    # does not fully synchronize on the experimental axon transport.
     import jax
+    v = out["loss"] if isinstance(out, dict) and "loss" in out else out
+    jax.device_get(v)
+
+
+def _bench_loop(step_fn, feeds, warmup=5, iters=10):
     for i in range(warmup):
         out = step_fn(feeds[i % len(feeds)])
-    jax.block_until_ready(out)
+        _sync(out)
     t0 = time.perf_counter()
     for i in range(iters):
         out = step_fn(feeds[i % len(feeds)])
-    jax.block_until_ready(out)
+    _sync(out)
     dt = time.perf_counter() - t0
     return dt / iters
 
@@ -67,15 +74,16 @@ def bench_transformer(batch_size=32, seq=256, dtype="float32"):
     from paddle_tpu.models import transformer
 
     cfg = transformer.base_config(src_vocab=32000, trg_vocab=32000, dropout=0.1,
-                                  dtype=dtype)
+                                  dtype=dtype, use_flash=True)
     model = pt.build(transformer.make_model(cfg))
     rng = np.random.RandomState(0)
     feeds = [{
-        "src_ids": rng.randint(3, 32000, (batch_size, seq)).astype(np.int64),
-        "trg_ids": rng.randint(3, 32000, (batch_size, seq)).astype(np.int64),
-        "labels": rng.randint(3, 32000, (batch_size, seq)).astype(np.int64),
+        "src_ids": rng.randint(3, 32000, (batch_size, seq)).astype(np.int32),
+        "trg_ids": rng.randint(3, 32000, (batch_size, seq)).astype(np.int32),
+        "labels": rng.randint(3, 32000, (batch_size, seq)).astype(np.int32),
     } for _ in range(2)]
-    trainer = pt.Trainer(model, opt.Adam(1e-3), loss_name="loss")
+    trainer = pt.Trainer(model, opt.Adam(1e-3), loss_name="loss",
+                         fetch_list=["loss"])
     trainer.startup(sample_feed=feeds[0])
     sec = _bench_loop(lambda f: trainer.step(f), feeds)
     return batch_size * seq / sec, "tokens/sec"

@@ -149,6 +149,7 @@ class Trainer:
         sharding_rules=None,
         strategy=None,
         donate: bool = True,
+        fetch_list: Optional[Sequence[str]] = None,
     ):
         self.program = program
         self.optimizer = optimizer
@@ -158,6 +159,10 @@ class Trainer:
         self.sharding_rules = sharding_rules
         self.strategy = strategy
         self.donate = donate
+        # fetch_list prunes the per-step outputs INSIDE jit (executor.py
+        # fetch-op analog) — unfetched outputs (e.g. full logits) are
+        # dead-code-eliminated by XLA instead of materialized.
+        self.fetch_list = list(fetch_list) if fetch_list is not None else None
         self.scope = Scope()
         self._step_fn = None
         self._eval_fn = None
@@ -191,6 +196,8 @@ class Trainer:
         else:
             loss = out
             out = {self.loss_name: loss}
+        if self.fetch_list is not None:
+            out = {k: out[k] for k in set(self.fetch_list) | {self.loss_name}}
         return loss, (out, new_state)
 
     def _build_step(self):

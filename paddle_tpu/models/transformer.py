@@ -183,12 +183,17 @@ def make_model(cfg: TransformerConfig):
     def transformer(src_ids, trg_ids, labels):
         enc_out, src_mask = encode(src_ids, cfg)
         logits = decode(trg_ids, enc_out, src_mask, cfg)
-        onehot = L.one_hot(labels, cfg.trg_vocab)
-        smoothed = L.label_smooth(onehot, epsilon=cfg.label_smooth_eps)
-        ce = L.softmax_with_cross_entropy(logits, smoothed, soft_label=True)
+        # Label-smoothed CE without materializing a [b,t,vocab] one-hot:
+        # loss = (1-eps)·NLL(target) + eps·mean(-logp) — algebraically
+        # identical to smoothing over the uniform prior, HBM-friendly.
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        lab = labels.astype(jnp.int32)
+        nll = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
+        eps = cfg.label_smooth_eps
+        ce = (1.0 - eps) * nll - eps * jnp.mean(logp, axis=-1)
         nonpad = (labels != 0).astype(jnp.float32)
         token_count = jnp.maximum(nonpad.sum(), 1.0)
-        loss = jnp.sum(ce[..., 0] * nonpad) / token_count
+        loss = jnp.sum(ce * nonpad) / token_count
         return {"loss": loss, "logits": logits, "token_count": token_count}
 
     return transformer

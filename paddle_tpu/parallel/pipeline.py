@@ -1,0 +1,122 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+Gap-fill component (SURVEY §2.2: PP is absent in the reference).
+TPU-native design: for repeated-structure models (transformer blocks),
+per-layer parameters are STACKED on a leading [num_layers, ...] axis and
+sharded over ``pp`` — each rank owns a contiguous span of layers. A
+GPipe-style schedule runs M microbatches through the ranks inside one
+``shard_map``: each tick, every rank applies its local layers to the
+activation it holds, then ``ppermute``s the result to the next rank
+(neighbor ICI hop). The loop runs M + P - 1 ticks (the pipeline bubble);
+activations enter at rank 0 and exit at rank P-1, which all-gathers the
+finished microbatches.
+
+Composable with dp/tp: batch stays sharded on dp; stacked layer params
+can additionally shard their weight dims on tp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_layer_params(per_layer_params: list) -> Any:
+    """Stack a list of per-layer param pytrees into [L, ...] leaves."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer_params)
+
+
+def _pp_body(x, stacked, layer_fn, axis_name: str, microbatches: int,
+             layers_per_stage: int, varying_axes: Tuple[str, ...]):
+    """Per-rank body. x: local microbatch stack [M, ...mb shape...] on
+    rank 0's slot (all ranks receive the same x spec; only rank 0's
+    content is used). stacked: this rank's [layers_per_stage, ...] params."""
+    p = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    m = microbatches
+
+    def apply_stage(act):
+        def one_layer(a, layer_params):
+            return layer_fn(a, layer_params), None
+        out, _ = jax.lax.scan(one_layer, act, stacked)
+        return out
+
+    mb_shape = x.shape[1:]
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def tick(carry, t):
+        holding, outputs = carry
+        # rank 0 ingests microbatch t (if t < m), others use what arrived
+        inject = jnp.where(t < m, t, m - 1)
+        fresh = x[inject]
+        cur = jnp.where(rank == 0, fresh, holding)
+        done = apply_stage(cur)
+        # last rank records finished microbatch (tick t finishes mb t-p+1)
+        out_idx = t - (p - 1)
+        record = (rank == p - 1) & (out_idx >= 0)
+        outputs = jnp.where(
+            record,
+            jax.lax.dynamic_update_index_in_dim(
+                outputs, done, jnp.clip(out_idx, 0, m - 1), axis=0),
+            outputs)
+        nxt = jax.lax.ppermute(done, axis_name, perm)
+        return (nxt, outputs), None
+
+    holding0 = jax.lax.pvary(jnp.zeros(mb_shape, x.dtype), varying_axes)
+    outputs0 = jax.lax.pvary(jnp.zeros((m,) + mb_shape, x.dtype), varying_axes)
+    (_, outputs), _ = jax.lax.scan(tick, (holding0, outputs0),
+                                   jnp.arange(m + p - 1))
+    # broadcast final outputs from last rank to all (so out spec can be
+    # replicated over pp)
+    outputs = jnp.where(rank == p - 1, outputs, jnp.zeros_like(outputs))
+    return jax.lax.psum(outputs, axis_name)
+
+
+def pipeline_apply(
+    x,
+    stacked_params,
+    layer_fn: Callable,
+    mesh: Mesh,
+    axis_name: str = "pp",
+    microbatches: int = 4,
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+):
+    """Run ``layer_fn`` over stacked layers pipelined across ``axis_name``.
+
+    - x: activations [B, ...]; B divisible by ``microbatches``.
+    - stacked_params: pytree with leading [L, ...] axis per leaf, L
+      divisible by the pp size; rank k owns layers [k·L/P, (k+1)·L/P).
+    - layer_fn(activation, layer_params) -> activation.
+    """
+    if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        def one(a, lp):
+            return layer_fn(a, lp), None
+        out, _ = jax.lax.scan(one, x, stacked_params)
+        return out
+
+    p = mesh.shape[axis_name]
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    assert L % p == 0, f"{L} layers not divisible by pp={p}"
+    b = x.shape[0]
+    assert b % microbatches == 0, f"batch {b} not divisible by microbatches"
+    mb = b // microbatches
+    xm = x.reshape((microbatches, mb) + x.shape[1:])
+
+    bspec = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    bshard = bspec if len(bspec) > 1 else (bspec[0] if bspec else None)
+    x_spec = P(None, bshard, *([None] * (x.ndim - 1)))
+    param_spec = jax.tree.map(lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))),
+                              stacked_params)
+
+    body = functools.partial(
+        _pp_body, layer_fn=layer_fn, axis_name=axis_name,
+        microbatches=microbatches, layers_per_stage=L // p,
+        varying_axes=tuple(mesh.axis_names))
+    out = jax.shard_map(body, mesh=mesh,
+                        in_specs=(x_spec, param_spec),
+                        out_specs=x_spec)(xm, stacked_params)
+    return out.reshape((b,) + x.shape[1:])
