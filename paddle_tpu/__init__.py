@@ -3,18 +3,22 @@
 A ground-up JAX/XLA/pallas re-design with the capabilities of the
 reference framework (PaddlePaddle Fluid — see SURVEY.md): layer library,
 optimizers with in-step regularization/clipping, functional state,
-executor-style training, mesh-sharded data/tensor/sequence parallelism,
-sparse embeddings, checkpointing, metrics, profiling, inference export.
+executor-style training, mesh-sharded data/tensor/sequence/pipeline
+parallelism, sparse & sharded embeddings, checkpointing, metrics,
+profiling, quantization, RecordIO data format (C++ core), beam-search
+decoding, and a StableHLO inference/export path.
 """
 
-from . import clip, core, framework, initializer, layers, lr_scheduler
-from . import optimizer, parallel, regularizer
+from . import clip, core, data, debugger, evaluator, framework, initializer
+from . import io, layers, lr_scheduler, metrics, models, nets, optimizer
+from . import parallel, quantize, regularizer, sparse
 from .core import CPUPlace, CUDAPlace, Place, TPUPlace, default_place
-from .executor import Executor, Scope, Trainer
+from .executor import CheckpointConfig, Event, Executor, Scope, Trainer, fit
 from .framework import (
     LayerHelper,
     ParamAttr,
     Program,
+    amp_guard,
     build,
     create_parameter,
     create_variable,

@@ -1,0 +1,72 @@
+"""Program visualization & debugging.
+
+Analog of python/paddle/fluid/debugger.py + graphviz.py (program → dot)
+and the graph_viz_pass (ir/graph_viz_pass.cc): renders a Program's
+jaxpr (the ProgramDesc analog) as graphviz dot, dumps HLO text, and
+summarizes parameters (memory_usage_calc.py analog).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def program_to_dot(program, params, state, *args, max_nodes: int = 400, **kwargs) -> str:
+    """Render the traced program as graphviz dot (draw_block_graphviz
+    analog, debugger.py)."""
+    jaxpr = program.desc(params, state, *args, **kwargs).jaxpr
+    lines = ["digraph program {", '  rankdir="TB";',
+             '  node [shape=box, fontsize=10];']
+    var_ids: Dict[Any, str] = {}
+
+    def vid(v):
+        key = id(v)  # Literals are unhashable; identity is fine here
+        if key not in var_ids:
+            var_ids[key] = f"v{len(var_ids)}"
+        return var_ids[key]
+
+    for i, eqn in enumerate(jaxpr.eqns[:max_nodes]):
+        op = f"op{i}"
+        lines.append(f'  {op} [label="{eqn.primitive.name}", style=filled, fillcolor=lightblue];')
+        for invar in eqn.invars:
+            if hasattr(invar, "aval") and not hasattr(invar, "val"):
+                v = vid(invar)
+                lines.append(f'  {v} [label="{getattr(invar.aval, "shape", "")}", shape=ellipse];')
+                lines.append(f"  {v} -> {op};")
+        for outvar in eqn.outvars:
+            v = vid(outvar)
+            lines.append(f'  {v} [label="{getattr(outvar.aval, "shape", "")}", shape=ellipse];')
+            lines.append(f"  {op} -> {v};")
+    if len(jaxpr.eqns) > max_nodes:
+        lines.append(f'  trunc [label="... {len(jaxpr.eqns) - max_nodes} more ops"];')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def program_hlo(program, params, state, *args, optimized: bool = False, **kwargs) -> str:
+    """Dump (optimized) HLO text — the debug_graphviz_path /
+    inspection analog at the XLA level."""
+    def f(p, s):
+        return program.apply(p, s, *args, **kwargs)
+
+    lowered = jax.jit(f).lower(params, state)
+    if optimized:
+        return lowered.compile().as_text()
+    return lowered.as_text()
+
+
+def summarize_params(params: Dict[str, jax.Array]) -> str:
+    """Parameter/memory table (memory_usage_calc.py analog)."""
+    rows = []
+    total = 0
+    for name in sorted(params):
+        v = params[name]
+        n = int(np.prod(v.shape))
+        total += n * v.dtype.itemsize
+        rows.append(f"{name:<50} {str(v.shape):<20} {str(v.dtype):<10} {n:>12,}")
+    header = f"{'name':<50} {'shape':<20} {'dtype':<10} {'elements':>12}"
+    rows.append(f"TOTAL {total / 1e6:.2f} MB")
+    return "\n".join([header, "-" * len(header)] + rows)

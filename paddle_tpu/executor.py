@@ -276,6 +276,79 @@ class Trainer:
                 for k, v in feed.items()}
 
 
+class CheckpointConfig:
+    """contrib.trainer CheckpointConfig analog (contrib/trainer.py:100)."""
+
+    def __init__(self, checkpoint_dir: str, epoch_interval: int = 1,
+                 step_interval: int = 0, max_num_checkpoints: int = 3):
+        self.checkpoint_dir = checkpoint_dir
+        self.epoch_interval = epoch_interval
+        self.step_interval = step_interval
+        self.max_num_checkpoints = max_num_checkpoints
+
+
+class Event:
+    """Training events (contrib.trainer BeginEpochEvent/EndStepEvent…)."""
+
+    def __init__(self, kind: str, epoch: int, step: int, metrics=None):
+        self.kind = kind  # begin_epoch | end_epoch | begin_step | end_step
+        self.epoch = epoch
+        self.step = step
+        self.metrics = metrics or {}
+
+
+def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
+        dtypes: Optional[Sequence[Any]] = None, event_handler=None,
+        checkpoint_config: Optional[CheckpointConfig] = None,
+        prefetch: bool = True):
+    """High-level train loop (contrib.trainer.Trainer.train analog):
+    reader → DataFeeder → (optional double-buffered prefetch) →
+    trainer.step, with event callbacks and periodic checkpoints."""
+    import os
+
+    from . import io as _io
+    from .data.feeder import DataFeeder, DeviceFeeder
+
+    feeder = DataFeeder(feed_names, dtypes)
+    kept: List[str] = []
+
+    def save(tag: str):
+        if checkpoint_config is None:
+            return
+        d = os.path.join(checkpoint_config.checkpoint_dir, tag)
+        _io.save_trainer(d, trainer)
+        kept.append(d)
+        while len(kept) > checkpoint_config.max_num_checkpoints:
+            import shutil
+            shutil.rmtree(kept.pop(0), ignore_errors=True)
+
+    for epoch in range(num_epochs):
+        if event_handler:
+            event_handler(Event("begin_epoch", epoch, trainer.global_step))
+
+        def batches():
+            for samples in reader():
+                yield feeder.feed(samples)
+
+        iterator = DeviceFeeder(batches, put_fn=trainer._put_feed) if prefetch \
+            else map(trainer._put_feed, batches())
+        for feed in iterator:
+            if event_handler:
+                event_handler(Event("begin_step", epoch, trainer.global_step))
+            out = trainer.step(feed)
+            if event_handler:
+                event_handler(Event("end_step", epoch, trainer.global_step, out))
+            if (checkpoint_config and checkpoint_config.step_interval and
+                    trainer.global_step % checkpoint_config.step_interval == 0):
+                save(f"step_{trainer.global_step}")
+        if event_handler:
+            event_handler(Event("end_epoch", epoch, trainer.global_step))
+        if checkpoint_config and checkpoint_config.epoch_interval and \
+                (epoch + 1) % checkpoint_config.epoch_interval == 0:
+            save(f"epoch_{epoch}")
+    return trainer
+
+
 def _abstractify(v):
     if isinstance(v, jax.ShapeDtypeStruct):
         return v

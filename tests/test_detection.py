@@ -1,0 +1,84 @@
+"""Detection-op tests (test_iou_similarity_op / test_box_coder_op /
+test_multiclass_nms_op / test_prior_box_op family analog)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.layers import detection as D
+
+
+def test_iou_similarity():
+    a = jnp.asarray([[0.0, 0.0, 2.0, 2.0]])
+    b = jnp.asarray([[1.0, 1.0, 3.0, 3.0], [0.0, 0.0, 2.0, 2.0], [5.0, 5.0, 6.0, 6.0]])
+    iou = np.asarray(D.iou_similarity(a, b))[0]
+    np.testing.assert_allclose(iou, [1 / 7, 1.0, 0.0], rtol=1e-5)
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(0)
+    priors = jnp.asarray(np.abs(rng.rand(6, 4)).astype(np.float32))
+    priors = priors.at[:, 2:].set(priors[:, :2] + 0.5)
+    targets = priors + 0.1
+    var = jnp.ones((6, 4)) * jnp.asarray([0.1, 0.1, 0.2, 0.2])
+    enc = D.box_coder(priors, var, targets, "encode_center_size")
+    dec = D.box_coder(priors, var, enc, "decode_center_size")
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(targets), rtol=1e-4, atol=1e-5)
+
+
+def test_prior_box_shapes_and_range():
+    boxes, var = D.prior_box((4, 4), (64, 64), min_sizes=[16.0], max_sizes=[32.0],
+                             aspect_ratios=[1.0, 2.0], flip=True, clip=True)
+    assert boxes.shape[:2] == (4, 4) and boxes.shape[-1] == 4
+    assert var.shape == boxes.shape
+    b = np.asarray(boxes)
+    assert b.min() >= 0.0 and b.max() <= 1.0
+    # center of cell (0,0) prior ~ (8/64, 8/64)
+    cx = (b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2
+    assert abs(cx - 8 / 64) < 1e-5
+
+
+def test_nms_suppresses_overlaps():
+    boxes = jnp.asarray([[0, 0, 2, 2], [0.1, 0.1, 2.1, 2.1], [5, 5, 7, 7]],
+                        jnp.float32)
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    out_boxes, out_scores, valid = D.nms(boxes, scores, max_out=3, iou_threshold=0.5)
+    v = np.asarray(valid)
+    assert v.sum() == 2  # the overlapping 0.8 box suppressed
+    np.testing.assert_allclose(np.asarray(out_scores)[:2], [0.9, 0.7], rtol=1e-6)
+
+
+def test_multiclass_nms():
+    boxes = jnp.asarray([[0, 0, 2, 2], [5, 5, 7, 7]], jnp.float32)
+    scores = jnp.asarray([[0.9, 0.1], [0.2, 0.8]])  # [c=2, n=2]
+    ob, osc, lbl, valid = D.multiclass_nms(boxes, scores, max_per_class=2)
+    assert ob.shape == (2, 2, 4)
+    assert bool(valid[0, 0]) and float(osc[0, 0]) == pytest.approx(0.9)
+
+
+def test_bipartite_match():
+    dist = jnp.asarray([[0.9, 0.1], [0.2, 0.8]])
+    idx, val = D.bipartite_match(dist)
+    np.testing.assert_array_equal(np.asarray(idx), [0, 1])
+    np.testing.assert_allclose(np.asarray(val), [0.9, 0.8])
+
+
+def test_ssd_loss_runs_and_positive():
+    rng = np.random.RandomState(0)
+    n, p, c = 2, 8, 4
+    loss = D.ssd_loss(
+        jnp.asarray(rng.randn(n, p, 4).astype(np.float32)),
+        jnp.asarray(rng.randn(n, p, c).astype(np.float32)),
+        jnp.asarray(rng.randn(n, p, 4).astype(np.float32)),
+        jnp.asarray(rng.randint(0, c, (n, p))),
+        jnp.asarray((rng.rand(n, p) > 0.7).astype(np.float32)))
+    assert float(loss) > 0
+
+
+def test_yolo_box_shapes():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(1, 3 * 7, 4, 4).astype(np.float32))
+    boxes, scores = D.yolo_box(x, (128, 128), anchors=[10, 13, 16, 30, 33, 23],
+                               class_num=2)
+    assert boxes.shape == (1, 48, 4)
+    assert scores.shape == (1, 48, 2)

@@ -1,0 +1,116 @@
+"""High-level fit loop, evaluator, debugger, profiler tests
+(contrib.trainer + debugger + profiler analog coverage)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import data as pdata
+from paddle_tpu import optimizer as opt
+from paddle_tpu.core import profiler
+from paddle_tpu.evaluator import DetectionMAP, Evaluator
+from paddle_tpu.models import mnist as mnist_models
+
+
+def _reader():
+    return pdata.batch(pdata.firstn(pdata.datasets.mnist("train"), 256), 64)
+
+
+def _to_feed_sample():
+    feeder = pdata.DataFeeder(["image", "label"], dtypes=["float32", "int64"])
+    samples = next(_reader()())
+    feed = feeder.feed(samples)
+    feed["label"] = feed["label"][:, None]
+    return feed
+
+
+def _label2d(reader):
+    def r():
+        for batch in reader():
+            yield [(x, np.asarray([y])) for x, y in batch]
+    return r
+
+
+def test_fit_with_events_and_checkpoints():
+    prog = pt.build(mnist_models.mlp)
+    trainer = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss")
+    trainer.startup(sample_feed=_to_feed_sample())
+    events = []
+    with tempfile.TemporaryDirectory() as d:
+        cfg = pt.CheckpointConfig(d, epoch_interval=1, max_num_checkpoints=2)
+        pt.fit(trainer, _label2d(_reader()), num_epochs=3,
+               feed_names=["image", "label"], dtypes=["float32", "int64"],
+               event_handler=lambda e: events.append(e.kind),
+               checkpoint_config=cfg)
+        kinds = set(events)
+        assert {"begin_epoch", "end_epoch", "begin_step", "end_step"} <= kinds
+        # only max_num_checkpoints kept
+        assert len(os.listdir(d)) == 2
+        # resume from checkpoint
+        t2 = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss")
+        t2.startup(sample_feed=_to_feed_sample())
+        from paddle_tpu import io as pio
+        pio.load_trainer(os.path.join(d, "epoch_2"), t2)
+        assert t2.global_step == trainer.global_step
+
+
+def test_evaluator():
+    prog = pt.build(mnist_models.mlp)
+    trainer = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss")
+    trainer.startup(sample_feed=_to_feed_sample())
+    ev = Evaluator(trainer, ["image", "label"], dtypes=["float32", "int64"],
+                   metric_keys=["acc", "loss"])
+    res = ev.evaluate(_label2d(_reader()))
+    assert 0.0 <= res["acc"] <= 1.0 and np.isfinite(res["loss"])
+
+
+def test_debugger_dot_hlo_summary():
+    import jax
+    from paddle_tpu import debugger
+
+    prog = pt.build(mnist_models.mlp)
+    feed = _to_feed_sample()
+    params, state = prog.init(jax.random.PRNGKey(0), **feed)
+    dot = debugger.program_to_dot(prog, params, state, feed["image"], feed["label"])
+    assert dot.startswith("digraph") and "dot_general" in dot
+    hlo = debugger.program_hlo(prog, params, state, feed["image"], feed["label"])
+    assert "HloModule" in hlo or "module" in hlo
+    table = debugger.summarize_params(params)
+    assert "fc_0/w" in table and "TOTAL" in table
+
+
+def test_profiler_table():
+    import time
+    profiler.enable_profiler()
+    with profiler.record_event("work"):
+        time.sleep(0.01)
+    with profiler.record_event("work"):
+        time.sleep(0.005)
+    rows = profiler.disable_profiler(print_table=False)
+    row = [r for r in rows if r["name"] == "work"][0]
+    assert row["calls"] == 2 and row["total"] >= 10
+
+
+def test_detection_map_perfect_and_miss():
+    m = DetectionMAP()
+    gts = [[(0, 0.0, 0.0, 1.0, 1.0)]]
+    dets = [[(0, 0.9, 0.0, 0.0, 1.0, 1.0)]]
+    m.update(dets, gts)
+    assert m.eval() == pytest.approx(1.0)
+    m.reset()
+    dets_bad = [[(0, 0.9, 5.0, 5.0, 6.0, 6.0)]]
+    m.update(dets_bad, gts)
+    assert m.eval() == pytest.approx(0.0)
+
+
+def test_amp_guard_scoped():
+    import jax.numpy as jnp
+    from paddle_tpu.framework import compute_dtype
+
+    assert compute_dtype() == jnp.float32
+    with pt.amp_guard("bfloat16"):
+        assert compute_dtype() == jnp.bfloat16
+    assert compute_dtype() == jnp.float32
