@@ -1,0 +1,110 @@
+"""Linear-chain CRF.
+
+Analog of linear_chain_crf_op.cc + crf_decoding_op.cc (used by the
+label_semantic_roles book model). Batched, padded [b, t, n_tags]
+emissions with lengths (LoD analog); forward algorithm (log-likelihood)
+via lax.scan, Viterbi decode with backtrace. Transition parameters
+follow the reference's layout: learned [n+2, n] matrix whose first two
+rows are start/end transitions (linear_chain_crf_op.h).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import LayerHelper
+from .. import initializer as init
+
+
+def _split_transition(transition):
+    start = transition[0]       # [n]
+    end = transition[1]         # [n]
+    trans = transition[2:]      # [n, n] trans[i, j]: i -> j
+    return start, end, trans
+
+
+def linear_chain_crf(emission, label, lengths, param_attr=None, name=None):
+    """Negative log-likelihood per sequence (linear_chain_crf op analog).
+
+    emission [b, t, n] unnormalized scores, label [b, t] int, lengths
+    [b]. Returns nll [b] (the reference returns per-sequence
+    log-likelihood cost; minimize its mean)."""
+    helper = LayerHelper("crf", name=name)
+    b, t, n = emission.shape
+    transition = helper.create_parameter("transition", (n + 2, n), jnp.float32,
+                                         attr=param_attr,
+                                         initializer=init.Uniform(-0.1, 0.1))
+    return crf_nll(emission, label, lengths, transition), transition
+
+
+def crf_nll(emission, label, lengths, transition):
+    b, t, n = emission.shape
+    start, end, trans = _split_transition(transition)
+    em = emission.astype(jnp.float32)
+    lab = label.astype(jnp.int32)
+    steps = jnp.arange(t)
+
+    # --- score of the gold path ---
+    first_score = start[lab[:, 0]] + em[:, 0][jnp.arange(b), lab[:, 0]]
+
+    def gold_step(carry, i):
+        score = carry
+        valid = (i < lengths)
+        s = trans[lab[:, i - 1], lab[:, i]] + em[:, i][jnp.arange(b), lab[:, i]]
+        return score + jnp.where(valid, s, 0.0), None
+
+    gold, _ = jax.lax.scan(gold_step, first_score, steps[1:])
+    last_idx = jnp.clip(lengths - 1, 0, t - 1)
+    gold = gold + end[lab[jnp.arange(b), last_idx]]
+
+    # --- partition function (forward algorithm) ---
+    alpha0 = start[None, :] + em[:, 0]  # [b, n]
+
+    def fwd_step(alpha, i):
+        valid = (i < lengths)[:, None]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None], axis=1) + em[:, i]
+        return jnp.where(valid, nxt, alpha), None
+
+    alpha, _ = jax.lax.scan(fwd_step, alpha0, steps[1:])
+    logz = jax.nn.logsumexp(alpha + end[None, :], axis=1)
+    return logz - gold
+
+
+def crf_decoding(emission, lengths, transition) -> jnp.ndarray:
+    """Viterbi decode (crf_decoding op analog): returns best path
+    [b, t] (entries past each length are 0)."""
+    b, t, n = emission.shape
+    start, end, trans = _split_transition(transition)
+    em = emission.astype(jnp.float32)
+    steps = jnp.arange(t)
+
+    delta0 = start[None, :] + em[:, 0]
+
+    def vit_step(carry, i):
+        delta = carry
+        scores = delta[:, :, None] + trans[None]  # [b, from, to]
+        best_prev = jnp.argmax(scores, axis=1)    # [b, to]
+        nxt = jnp.max(scores, axis=1) + em[:, i]
+        valid = (i < lengths)[:, None]
+        nxt = jnp.where(valid, nxt, delta)
+        bp = jnp.where(valid, best_prev, jnp.arange(n)[None, :])
+        return nxt, bp
+
+    delta, bps = jax.lax.scan(vit_step, delta0, steps[1:])  # bps [t-1, b, n]
+    last = jnp.argmax(delta + end[None, :], axis=1)  # [b]
+
+    # Backtrace: process bps from the last timestep backwards; each tick
+    # emits the tag AT that timestep and steps the carry to the previous
+    # tag. ys[i] = tag at time i+1; final carry = tag at time 0.
+    def back_step(carry, bp):
+        cur = carry
+        prev = jnp.take_along_axis(bp, cur[:, None], axis=1)[:, 0]
+        return prev, cur
+
+    first, tail = jax.lax.scan(back_step, last, bps, reverse=True)
+    path = jnp.vstack([first[None, :], tail]).T  # [b, t]
+    mask = steps[None, :] < lengths[:, None]
+    return jnp.where(mask, path, 0)

@@ -1,0 +1,79 @@
+"""RNN encoder-decoder with attention — the book
+rnn_encoder_decoder / machine_translation configs (test_machine_
+translation.py; GRU encoder + attention decoder, the reference's only
+in-tree attention, built from primitive ops)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import layers as L
+from ..framework import LayerHelper
+from ..layers.rnn import dynamic_gru, gru_cell_step
+from .. import initializer as init
+
+
+def make_model(src_vocab=2000, trg_vocab=2000, emb_dim=128, hidden=256):
+    """Program fn: (src_ids [b,s], trg_ids [b,t], labels [b,t],
+    src_lengths [b]) -> dict with token-mean CE loss."""
+
+    def seq2seq(src_ids, trg_ids, labels, src_lengths):
+        helper = LayerHelper("seq2seq")
+        # --- encoder: bi-GRU ---
+        src_emb = L.embedding(src_ids, size=[src_vocab, emb_dim])
+        fwd = dynamic_gru(src_emb, hidden, sequence_length=src_lengths)
+        bwd = dynamic_gru(src_emb, hidden, sequence_length=src_lengths,
+                          is_reverse=True)
+        enc = jnp.concatenate([fwd, bwd], axis=-1)  # [b, s, 2h]
+        src_mask = (jnp.arange(src_ids.shape[1])[None, :]
+                    < src_lengths[:, None])  # [b, s]
+
+        # --- decoder: GRU with additive attention over enc ---
+        b, t = trg_ids.shape
+        trg_emb = L.embedding(trg_ids, size=[trg_vocab, emb_dim])
+
+        w_att_enc = helper.create_parameter("att_enc/w", (2 * hidden, hidden),
+                                            jnp.float32, initializer=init.Xavier())
+        w_att_dec = helper.create_parameter("att_dec/w", (hidden, hidden),
+                                            jnp.float32, initializer=init.Xavier())
+        v_att = helper.create_parameter("att_v/w", (hidden, 1), jnp.float32,
+                                        initializer=init.Xavier())
+        w_x = helper.create_parameter("dec_gru_x/w", (emb_dim + 2 * hidden, 3 * hidden),
+                                      jnp.float32, initializer=init.Xavier())
+        w_h = helper.create_parameter("dec_gru_h/w", (hidden, 3 * hidden),
+                                      jnp.float32, initializer=init.Xavier())
+        b_g = helper.create_parameter("dec_gru/b", (3 * hidden,), jnp.float32,
+                                      initializer=init.Constant(0.0))
+        w_out = helper.create_parameter("dec_out/w", (hidden, trg_vocab), jnp.float32,
+                                        initializer=init.Xavier())
+
+        enc_att = jnp.matmul(enc, w_att_enc)  # precompute [b, s, h]
+
+        def step(h, x_t):
+            # additive attention
+            q = jnp.matmul(h, w_att_dec)[:, None, :]           # [b,1,h]
+            e = jnp.matmul(jnp.tanh(enc_att + q), v_att)[..., 0]  # [b,s]
+            e = jnp.where(src_mask, e, -1e9)
+            a = jax.nn.softmax(e, axis=-1)
+            ctx = jnp.einsum("bs,bsd->bd", a, enc)             # [b,2h]
+            inp = jnp.concatenate([x_t, ctx], axis=-1)
+            x_proj = jnp.matmul(inp, w_x) + b_g
+            h_new = gru_cell_step(x_proj, h, w_h)
+            return h_new, h_new
+
+        h0 = jnp.tanh(L.fc(jnp.concatenate([fwd[:, -1], bwd[:, 0]], axis=-1),
+                           hidden, name="init_state"))
+        xs = jnp.swapaxes(trg_emb, 0, 1)
+        _, hs = jax.lax.scan(step, h0, xs)
+        hs = jnp.swapaxes(hs, 0, 1)  # [b, t, h]
+        logits = jnp.matmul(hs, w_out)
+
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, labels.astype(jnp.int32)[..., None],
+                                   axis=-1)[..., 0]
+        nonpad = (labels != 0).astype(jnp.float32)
+        loss = jnp.sum(nll * nonpad) / jnp.maximum(nonpad.sum(), 1.0)
+        return {"loss": loss, "logits": logits}
+
+    return seq2seq

@@ -1,0 +1,87 @@
+"""Linear-chain CRF vs brute-force enumeration
+(test_linear_chain_crf_op / test_crf_decoding_op analog)."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.layers import crf as C
+
+
+def _brute_force(em, trans_full, length):
+    """All-paths scores for one sequence; returns (logZ, best_path,
+    gold_score_fn)."""
+    start, end, trans = trans_full[0], trans_full[1], trans_full[2:]
+    n = em.shape[1]
+    scores = {}
+    for path in itertools.product(range(n), repeat=length):
+        s = start[path[0]] + em[0, path[0]]
+        for i in range(1, length):
+            s += trans[path[i - 1], path[i]] + em[i, path[i]]
+        s += end[path[-1]]
+        scores[path] = s
+    logz = np.logaddexp.reduce(list(scores.values()))
+    best = max(scores, key=scores.get)
+    return logz, best, scores
+
+
+def test_crf_nll_matches_brute_force():
+    rng = np.random.RandomState(0)
+    b, t, n = 3, 4, 3
+    em = rng.randn(b, t, n).astype(np.float32)
+    trans_full = rng.randn(n + 2, n).astype(np.float32) * 0.5
+    labels = rng.randint(0, n, (b, t))
+    lengths = np.array([4, 3, 2])
+    nll = np.asarray(C.crf_nll(jnp.asarray(em), jnp.asarray(labels),
+                               jnp.asarray(lengths), jnp.asarray(trans_full)))
+    for i in range(b):
+        L = lengths[i]
+        logz, _, scores = _brute_force(em[i], trans_full, L)
+        gold = scores[tuple(labels[i, :L])]
+        np.testing.assert_allclose(nll[i], logz - gold, rtol=1e-4, atol=1e-4)
+
+
+def test_crf_decoding_matches_brute_force():
+    rng = np.random.RandomState(1)
+    b, t, n = 3, 4, 3
+    em = rng.randn(b, t, n).astype(np.float32)
+    trans_full = rng.randn(n + 2, n).astype(np.float32) * 0.5
+    lengths = np.array([4, 2, 3])
+    path = np.asarray(C.crf_decoding(jnp.asarray(em), jnp.asarray(lengths),
+                                     jnp.asarray(trans_full)))
+    for i in range(b):
+        L = lengths[i]
+        _, best, _ = _brute_force(em[i], trans_full, L)
+        np.testing.assert_array_equal(path[i, :L], best,
+                                      err_msg=f"seq {i}: {path[i, :L]} vs {best}")
+        assert (path[i, L:] == 0).all()
+
+
+def test_crf_layer_trains():
+    """Sequence tagging learns a simple emission rule through the CRF."""
+    def net(feats, label, lengths):
+        from paddle_tpu import layers as L
+        em = L.fc(feats, 3, num_flatten_dims=2)
+        nll, transition = C.linear_chain_crf(em, label, lengths)
+        return {"loss": nll.mean(), "emission": em, "transition": transition}
+
+    prog = pt.build(net)
+    rng = np.random.RandomState(0)
+    b, t = 16, 6
+    feats = rng.randn(b, t, 4).astype(np.float32)
+    label = (feats[..., 0] > 0).astype(np.int64) + (feats[..., 1] > 0).astype(np.int64)
+    lengths = np.full((b,), t, np.int64)
+    from paddle_tpu import optimizer as opt
+    trainer = pt.Trainer(prog, opt.Adam(0.05), loss_name="loss")
+    feed = {"feats": feats, "label": label, "lengths": lengths}
+    trainer.startup(sample_feed=feed)
+    losses = [float(trainer.step(feed)["loss"]) for _ in range(120)]
+    assert losses[-1] < losses[0] * 0.5
+    out = trainer.eval(feed)
+    decoded = np.asarray(C.crf_decoding(out["emission"], jnp.asarray(lengths),
+                                        out["transition"]))
+    assert (decoded == label).mean() > 0.8
