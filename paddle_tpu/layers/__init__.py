@@ -1,6 +1,8 @@
 """Layer library — the ``fluid.layers`` surface (python/paddle/fluid/layers/)."""
 
-from . import attention, nn, ops, rnn, tensor
+from . import attention, beam_search, control_flow, crf, ctc, detection
+from . import nn, ops, rnn, sequence, tensor
+from .ctc import ctc_greedy_decoder, edit_distance, warpctc
 from .attention import (
     ffn,
     multi_head_attention,

@@ -47,16 +47,12 @@ def crf_nll(emission, label, lengths, transition):
     lab = label.astype(jnp.int32)
     steps = jnp.arange(t)
 
-    # --- score of the gold path ---
+    # --- score of the gold path: pure gather + masked sum, no recurrence ---
     first_score = start[lab[:, 0]] + em[:, 0][jnp.arange(b), lab[:, 0]]
-
-    def gold_step(carry, i):
-        score = carry
-        valid = (i < lengths)
-        s = trans[lab[:, i - 1], lab[:, i]] + em[:, i][jnp.arange(b), lab[:, i]]
-        return score + jnp.where(valid, s, 0.0), None
-
-    gold, _ = jax.lax.scan(gold_step, first_score, steps[1:])
+    step_scores = trans[lab[:, :-1], lab[:, 1:]] \
+        + jnp.take_along_axis(em[:, 1:], lab[:, 1:, None], axis=2)[..., 0]
+    valid = steps[1:][None, :] < lengths[:, None]
+    gold = first_score + jnp.sum(jnp.where(valid, step_scores, 0.0), axis=1)
     last_idx = jnp.clip(lengths - 1, 0, t - 1)
     gold = gold + end[lab[jnp.arange(b), last_idx]]
 

@@ -1,0 +1,180 @@
+"""CTC family: loss, greedy decoding, edit distance.
+
+Analog of the reference's warpctc_op (operators/warpctc_op.cc, dynload of
+libwarpctc), ctc_align_op (ctc_greedy_decoder, layers/nn.py) and
+edit_distance_op (operators/edit_distance_op.cc). The reference handles
+variable length via LoD; here sequences are padded + explicit lengths
+(the framework's static-shape LoD design, layers/sequence.py), and the
+whole computation is a log-space forward algorithm under ``lax.scan`` —
+differentiable by jax autodiff, so no hand-written backward like
+warp-ctc's.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _extend_labels(labels, blank):
+    """[B, L] labels -> [B, 2L+1] blank-interleaved extended labels."""
+    b, l = labels.shape
+    ext = jnp.full((b, 2 * l + 1), blank, labels.dtype)
+    return ext.at[:, 1::2].set(labels)
+
+
+def warpctc(
+    logits,
+    labels,
+    logit_lengths,
+    label_lengths,
+    blank: int = 0,
+    norm_by_times: bool = False,
+):
+    """CTC negative log-likelihood (warpctc_op analog).
+
+    Args:
+      logits: [B, T, C] unnormalized activations (the reference feeds
+        pre-softmax activations to warp-ctc; same here).
+      labels: [B, L] padded label ids (no blanks).
+      logit_lengths: [B] valid timesteps per sample.
+      label_lengths: [B] valid labels per sample.
+      blank: blank label id.
+      norm_by_times: divide each loss by its input length.
+
+    Returns [B, 1] per-sample loss, matching the reference's summed-time
+    output shape.
+    """
+    logits = jnp.asarray(logits)
+    labels = jnp.asarray(labels).astype(jnp.int32)
+    logit_lengths = jnp.asarray(logit_lengths).astype(jnp.int32).reshape(-1)
+    label_lengths = jnp.asarray(label_lengths).astype(jnp.int32).reshape(-1)
+    b, t, _ = logits.shape
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    ext = _extend_labels(labels, blank)          # [B, S], S = 2L+1
+    s = ext.shape[1]
+    pos = jnp.arange(s)[None, :]                 # [1, S]
+
+    # transition mask: alpha[s] may also come from alpha[s-2] when
+    # ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :s]
+    allow_skip = (ext != blank) & (ext != ext_m2)        # [B, S]
+
+    # initial alpha: positions 0 (blank) and 1 (first label)
+    alpha0 = jnp.where(pos == 0, 0.0, NEG_INF)
+    first = jnp.where((pos == 1) & (label_lengths[:, None] > 0), 0.0, NEG_INF)
+    emit0 = jnp.take_along_axis(log_probs[:, 0, :], ext, axis=1)
+    alpha0 = jnp.maximum(alpha0, first) + emit0          # log(a or b) where disjoint
+
+    def step(alpha, lp_t):
+        lp, tt = lp_t
+        prev1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=NEG_INF)[:, :s]
+        prev2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=NEG_INF)[:, :s]
+        prev2 = jnp.where(allow_skip, prev2, NEG_INF)
+        stacked = jnp.stack([alpha, prev1, prev2], axis=0)
+        merged = jax.scipy.special.logsumexp(stacked, axis=0)
+        emit = jnp.take_along_axis(lp, ext, axis=1)
+        new = merged + emit
+        # freeze alpha once past this sample's input length
+        active = (tt < logit_lengths)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    lps = jnp.moveaxis(log_probs, 1, 0)                  # [T, B, C]
+    alpha, _ = jax.lax.scan(step, alpha0, (lps[1:], jnp.arange(1, t)))
+
+    send = 2 * label_lengths                             # index of final blank
+    last_blank = jnp.take_along_axis(alpha, send[:, None], axis=1)[:, 0]
+    last_label = jnp.take_along_axis(
+        alpha, jnp.maximum(send - 1, 0)[:, None], axis=1)[:, 0]
+    last_label = jnp.where(label_lengths > 0, last_label, NEG_INF)
+    ll = jax.scipy.special.logsumexp(jnp.stack([last_blank, last_label]), axis=0)
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(logit_lengths, 1).astype(loss.dtype)
+    return loss[:, None]
+
+
+def ctc_greedy_decoder(input, blank: int, input_length=None, padding_value: int = -1):
+    """Greedy (best-path) CTC decoding (layers/nn.py ctc_greedy_decoder;
+    ctc_align_op): argmax per step, merge repeats, drop blanks.
+
+    Args:
+      input: [B, T, C] probabilities or logits.
+      blank: blank id.
+      input_length: optional [B] valid timesteps.
+      padding_value: fill for the padded decoded output.
+
+    Returns (decoded [B, T] padded with ``padding_value``, lengths [B]).
+    """
+    x = jnp.asarray(input)
+    b, t, _ = x.shape
+    tok = jnp.argmax(x, axis=-1).astype(jnp.int32)       # [B, T]
+    prev = jnp.pad(tok, ((0, 0), (1, 0)), constant_values=-1)[:, :t]
+    keep = (tok != blank) & (tok != prev)
+    if input_length is not None:
+        il = jnp.asarray(input_length).astype(jnp.int32).reshape(-1)
+        keep = keep & (jnp.arange(t)[None, :] < il[:, None])
+    dest = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1  # write position
+    lengths = jnp.max(dest, axis=1) + 1
+    dest = jnp.where(keep, dest, t)                       # dropped -> OOB (ignored)
+    out = jnp.full((b, t + 1), padding_value, jnp.int32)
+    out = jax.vmap(lambda o, d, v: o.at[d].set(v, mode="drop"))(out, dest, tok)
+    return out[:, :t], lengths
+
+
+def edit_distance(
+    input,
+    label,
+    input_length=None,
+    label_length=None,
+    normalized: bool = True,
+):
+    """Levenshtein distance between token sequences (edit_distance_op.cc).
+
+    Args:
+      input/label: [B, Th] / [B, Tr] padded int sequences (hypothesis, ref).
+      input_length/label_length: [B] valid lengths (default: full width).
+      normalized: divide by reference length.
+
+    Returns (distance [B, 1] float32, sequence_num scalar) like the
+    reference (the op also outputs SequenceNum).
+    """
+    hyp = jnp.asarray(input).astype(jnp.int32)
+    ref = jnp.asarray(label).astype(jnp.int32)
+    b, th = hyp.shape
+    tr = ref.shape[1]
+    hl = (jnp.full((b,), th, jnp.int32) if input_length is None
+          else jnp.asarray(input_length).astype(jnp.int32).reshape(-1))
+    rl = (jnp.full((b,), tr, jnp.int32) if label_length is None
+          else jnp.asarray(label_length).astype(jnp.int32).reshape(-1))
+
+    # DP over hyp rows; each row is itself a left-to-right scan over ref.
+    row0 = jnp.broadcast_to(jnp.arange(tr + 1, dtype=jnp.int32), (b, tr + 1))
+
+    def outer(prev_row, i):
+        htok = hyp[:, i]                                  # [B]
+
+        def inner(left, j):
+            up = prev_row[:, j + 1]
+            diag = prev_row[:, j]
+            cost = (htok != ref[:, j]).astype(jnp.int32)
+            val = jnp.minimum(jnp.minimum(up + 1, left + 1), diag + cost)
+            return val, val
+
+        first = prev_row[:, 0] + 1
+        _, rest = jax.lax.scan(inner, first, jnp.arange(tr))
+        row = jnp.concatenate([first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1)
+        return row, row
+
+    _, rows = jax.lax.scan(outer, row0, jnp.arange(th))
+    table = jnp.concatenate([row0[None], rows], axis=0)   # [Th+1, B, Tr+1]
+    dist = table[hl, jnp.arange(b), rl].astype(jnp.float32)
+    if normalized:
+        dist = dist / jnp.maximum(rl, 1).astype(jnp.float32)
+    return dist[:, None], jnp.asarray(b, jnp.int32)

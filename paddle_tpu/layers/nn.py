@@ -406,12 +406,14 @@ def batch_norm(
 
     training = in_training() if is_test is None else (not is_test)
     if training and not use_global_stats:
-        # Single pass over the tensor: E[x], E[x²] with fp32 accumulation
-        # (dtype=) but NO fp32 materialization of the activations — the
-        # big tensor stays in its compute dtype so HBM traffic is halved
-        # and XLA fuses the normalize into the producer's epilogue.
-        mean = jnp.mean(input, axis=red_axes, dtype=jnp.float32)
-        mean2 = jnp.mean(jax.lax.square(input), axis=red_axes, dtype=jnp.float32)
+        # Single pass over the tensor: E[x], E[x²]. The square must happen
+        # in fp32 — squaring in bf16 loses the variance signal for
+        # un-centered activations — but the elementwise convert fuses into
+        # the reduction, so the activations are never materialized in fp32
+        # and HBM traffic stays halved.
+        x32 = input.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=red_axes)
+        mean2 = jnp.mean(jax.lax.square(x32), axis=red_axes)
         var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
         helper.assign_variable("moving_mean", momentum * moving_mean + (1 - momentum) * mean)
         helper.assign_variable("moving_variance", momentum * moving_var + (1 - momentum) * var)
@@ -910,3 +912,126 @@ def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25, name=None):
     right = jnp.concatenate([jnp.zeros_like(x[:, :1, fold:2 * fold]), x[:, :-1, fold:2 * fold]], axis=1)
     rest = x[:, :, 2 * fold:]
     return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+
+# ---------------------------------------------------------------------------
+# Sampled / hierarchical classifiers (nce_op.cc, hierarchical_sigmoid_op.cc,
+# sampling_id_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def sampling_id(x, min: float = 0.0, max: float = 1.0, seed: int = 0, dtype="int64", name=None):
+    """Sample one class id per row of a probability matrix
+    (sampling_id_op.cc). x: [B, C] probabilities."""
+    enforce(min == 0.0 and max == 1.0,
+            "sampling_id: restricted [min,max) CDF sampling is not supported")
+    key = jax.random.PRNGKey(seed) if seed else next_rng_key()
+    logits = jnp.log(jnp.maximum(x, 1e-20))
+    return jax.random.categorical(key, logits, axis=-1).astype(dtype)
+
+
+def nce(
+    input,
+    label,
+    num_total_classes: int,
+    num_neg_samples: int = 10,
+    sampler: str = "uniform",
+    custom_dist=None,
+    param_attr=None,
+    bias_attr=None,
+    seed: int = 0,
+    name=None,
+):
+    """Noise-contrastive estimation loss (layers/nn.py nce; nce_op.cc).
+
+    input: [B, dim]; label: [B] or [B, 1] int ids. Weight [C, dim] and
+    bias [C] live in the layer scope like the reference's. Returns [B, 1]
+    loss. Sampling is uniform or from ``custom_dist`` (the reference's
+    'custom_dist' sampler); 'log_uniform' follows the Zipfian sampler.
+    """
+    helper = LayerHelper("nce", name=name)
+    dim = int(input.shape[-1])
+    w = helper.create_parameter("w", shape=(num_total_classes, dim),
+                                dtype=jnp.float32, attr=param_attr)
+    b = helper.create_parameter("b", shape=(num_total_classes,), dtype=jnp.float32,
+                                attr=bias_attr, initializer=init.Constant(0.0))
+    lab = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    bsz = lab.shape[0]
+    key = jax.random.PRNGKey(seed) if seed else next_rng_key()
+    if sampler == "uniform":
+        neg = jax.random.randint(key, (bsz, num_neg_samples), 0, num_total_classes)
+        logp = jnp.full((), -jnp.log(float(num_total_classes)))
+        logp_neg = jnp.broadcast_to(logp, neg.shape)
+        logp_pos = jnp.broadcast_to(logp, lab.shape)
+    elif sampler == "log_uniform":
+        # P(k) = (log(k+2)-log(k+1)) / log(C+1)  (Zipfian)
+        u = jax.random.uniform(key, (bsz, num_neg_samples))
+        neg = (jnp.exp(u * jnp.log(float(num_total_classes + 1))) - 1).astype(jnp.int32)
+        neg = jnp.clip(neg, 0, num_total_classes - 1)
+        def _lp(k):
+            k = k.astype(jnp.float32)
+            return jnp.log((jnp.log(k + 2) - jnp.log(k + 1)) /
+                           jnp.log(float(num_total_classes + 1)))
+        logp_neg, logp_pos = _lp(neg), _lp(lab)
+    elif sampler == "custom_dist":
+        enforce(custom_dist is not None, "custom_dist sampler needs custom_dist")
+        dist = jnp.asarray(custom_dist, jnp.float32)
+        dist = dist / dist.sum()
+        neg = jax.random.categorical(key, jnp.log(dist)[None, :],
+                                     shape=(bsz, num_neg_samples))
+        logp_neg = jnp.log(dist)[neg]
+        logp_pos = jnp.log(dist)[lab]
+    else:
+        raise ValueError(f"unknown sampler {sampler!r}")
+
+    x = cast_compute(input)
+    def score(ids):
+        return jnp.einsum("bkd,bd->bk", w[ids].astype(x.dtype), x) + b[ids].astype(x.dtype)
+    s_pos = score(lab[:, None])[:, 0]
+    s_neg = score(neg)
+    k = float(num_neg_samples)
+    # NCE logistic: Δ = s - log(k·P);  loss = softplus(-Δ_pos) + Σ softplus(Δ_neg)
+    d_pos = s_pos - (jnp.log(k) + logp_pos)
+    d_neg = s_neg - (jnp.log(k) + logp_neg)
+    loss = jax.nn.softplus(-d_pos) + jnp.sum(jax.nn.softplus(d_neg), axis=1)
+    return loss[:, None].astype(jnp.float32)
+
+
+def hsigmoid(
+    input,
+    label,
+    num_classes: int,
+    param_attr=None,
+    bias_attr=None,
+    name=None,
+):
+    """Hierarchical sigmoid over a complete binary tree
+    (layers/nn.py hsigmoid; hierarchical_sigmoid_op.cc, SimpleCode in
+    operators/math/matrix_bit_code.h: c = label + num_classes,
+    node(bit) = (c >> (bit+1)) - 1, code(bit) = (c >> bit) & 1).
+
+    input: [B, dim]; label: [B] or [B,1]. Returns [B, 1] loss. Cost is
+    O(log C) vs softmax's O(C).
+    """
+    enforce(num_classes >= 2, "hsigmoid needs num_classes >= 2")
+    helper = LayerHelper("hsigmoid", name=name)
+    dim = int(input.shape[-1])
+    w = helper.create_parameter("w", shape=(num_classes - 1, dim),
+                                dtype=jnp.float32, attr=param_attr)
+    b = helper.create_parameter("b", shape=(num_classes - 1,), dtype=jnp.float32,
+                                attr=bias_attr, initializer=init.Constant(0.0))
+    lab = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    c = lab + num_classes                          # heap code, in [C, 2C-1]
+    max_len = int(np.floor(np.log2(2 * num_classes - 1)))
+    bits = jnp.arange(max_len)
+    # path length = (position of MSB of c) ; valid bits are 0..len-1
+    msb = jnp.floor(jnp.log2(c.astype(jnp.float32))).astype(jnp.int32)  # [B]
+    valid = bits[None, :] < msb[:, None]                                # [B, L]
+    node = jnp.where(valid, (c[:, None] >> (bits[None, :] + 1)) - 1, 0)
+    code = ((c[:, None] >> bits[None, :]) & 1).astype(jnp.float32)
+    x = cast_compute(input)
+    t = jnp.einsum("bld,bd->bl", w[node].astype(x.dtype), x) + b[node].astype(x.dtype)
+    t = t.astype(jnp.float32)
+    bce = jax.nn.softplus(t) - code * t            # BCE-with-logits vs code bit
+    loss = jnp.sum(jnp.where(valid, bce, 0.0), axis=1)
+    return loss[:, None]
