@@ -1022,10 +1022,11 @@ def hsigmoid(
                                 attr=bias_attr, initializer=init.Constant(0.0))
     lab = jnp.asarray(label).reshape(-1).astype(jnp.int32)
     c = lab + num_classes                          # heap code, in [C, 2C-1]
-    max_len = int(np.floor(np.log2(2 * num_classes - 1)))
+    max_len = (2 * num_classes - 1).bit_length() - 1
     bits = jnp.arange(max_len)
-    # path length = (position of MSB of c) ; valid bits are 0..len-1
-    msb = jnp.floor(jnp.log2(c.astype(jnp.float32))).astype(jnp.int32)  # [B]
+    # path length = (position of MSB of c); integer clz — float log2 is
+    # inexact at powers of two and would truncate those paths
+    msb = 31 - jax.lax.clz(c)                                           # [B]
     valid = bits[None, :] < msb[:, None]                                # [B, L]
     node = jnp.where(valid, (c[:, None] >> (bits[None, :] + 1)) - 1, 0)
     code = ((c[:, None] >> bits[None, :]) & 1).astype(jnp.float32)

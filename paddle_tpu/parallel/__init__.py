@@ -1,15 +1,18 @@
 """Parallelism over TPU meshes — the reference's ParallelExecutor +
 DistributeTranspiler capabilities re-expressed as sharding (SURVEY §2.2/§7)."""
 
-from . import api, mesh, sharding, strategy
+from . import api, mesh, moe, sharding, strategy, ulysses
 from .mesh import DATA_AXES, DP, EP, FSDP, PP, SP, TP, data_parallel_size, initialize, make_mesh
+from .moe import moe_ep_rules
 from .sharding import ShardingRules, fsdp, replicated, transformer_tp_rules
 from .strategy import DistStrategy
+from .ulysses import ulysses_attention
 
 __all__ = [
-    "api", "mesh", "sharding", "strategy",
+    "api", "mesh", "moe", "sharding", "strategy", "ulysses",
     "DATA_AXES", "DP", "EP", "FSDP", "PP", "SP", "TP",
     "data_parallel_size", "initialize", "make_mesh",
+    "moe_ep_rules", "ulysses_attention",
     "ShardingRules", "fsdp", "replicated", "transformer_tp_rules",
     "DistStrategy",
 ]

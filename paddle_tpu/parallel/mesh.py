@@ -59,6 +59,15 @@ def make_mesh(axes: Optional[Dict[str, int]] = None,
     return Mesh(arr, tuple(axes.keys()))
 
 
+def pvary(x, axis_names):
+    """Mark ``x`` as device-varying over ``axis_names`` inside shard_map
+    (vma bookkeeping for mixing replicated operands with sharded ones).
+    Wraps lax.pcast with fallback to the deprecated lax.pvary."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_names, to="varying")
+    return jax.lax.pvary(x, axis_names)
+
+
 def data_axis_names(mesh: Mesh) -> tuple:
     return tuple(a for a in mesh.axis_names if a in DATA_AXES)
 

@@ -1,0 +1,204 @@
+"""Mixture-of-experts with expert parallelism over the mesh ``ep`` axis.
+
+Gap-fill component (SURVEY §2.2: TP/PP/SP/**MoE-EP** are absent in the
+reference — its only model partitioning is the distributed lookup table,
+distribute_transpiler.py:1100). This supplies the modern equivalent:
+a top-k-routed expert FFN bank whose experts are sharded across the
+``ep`` mesh axis, with token dispatch as ``lax.all_to_all`` pairs riding
+ICI — the TPU-native analog of the reference's prefetch-RPC row-sharded
+table (split_ids → PrefetchVariable → merge becomes dispatch-einsum →
+all_to_all → combine-einsum).
+
+Design (GShard/Switch-style, static shapes for XLA):
+- router softmax in f32, top-k selection with a *static capacity* per
+  expert: C = ceil(local_tokens · k / E · capacity_factor). Tokens over
+  capacity are dropped (their combine weight is zero) — this is what
+  keeps every shape static under jit.
+- dispatch/combine are one-hot einsums → the MXU does the routing.
+- expert compute is a batched einsum over the local expert bank
+  ([E_local, C·n, d] @ [E_local, d, ff]) — large, batched, bf16-ready.
+- EP path runs under ``shard_map``: experts sharded on ``ep``, tokens
+  sharded on (data axes + ``ep``), two tiled all_to_alls swap the
+  token↔expert sharding around the expert compute.
+
+Returns ``(out, aux_loss)`` — aux_loss is the load-balance term
+(mean-prob · dispatch-fraction · E) to be added to the model loss.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..framework import LayerHelper, cast_compute
+from .. import initializer as init
+from . import mesh as mesh_lib
+
+
+def _topk_dispatch(probs, top_k: int, capacity: int, normalize_gates: bool):
+    """Build dispatch/combine tensors [t, E, C] from router probs [t, E].
+
+    Position-in-expert is assigned k-major (all 1st choices before any
+    2nd choices), matching GShard's priority so 1st-choice tokens are
+    dropped last.
+    """
+    t, e = probs.shape
+    vals, idx = jax.lax.top_k(probs, top_k)            # [t, k]
+    if normalize_gates:
+        vals = vals / (jnp.sum(vals, axis=-1, keepdims=True) + 1e-9)
+    mask = jax.nn.one_hot(idx, e, dtype=jnp.float32)   # [t, k, E]
+    flat = jnp.transpose(mask, (1, 0, 2)).reshape(top_k * t, e)
+    pos = jnp.cumsum(flat, axis=0) - flat              # position within expert
+    pos = jnp.transpose(pos.reshape(top_k, t, e), (1, 0, 2))
+    pos_k = jnp.sum(pos * mask, axis=-1)               # [t, k]
+    keep = (pos_k < capacity).astype(jnp.float32)
+    slot = jax.nn.one_hot(pos_k.astype(jnp.int32), capacity, dtype=jnp.float32)
+    dispatch = jnp.einsum("tke,tkc,tk->tec", mask, slot, keep)
+    combine = jnp.einsum("tke,tkc,tk->tec", mask, slot, keep * vals)
+    return dispatch, combine, mask
+
+
+def _aux_loss(probs, mask):
+    """Load-balance loss (Switch eq. 4): E · Σ_e fraction_e · meanprob_e."""
+    e = probs.shape[-1]
+    me = jnp.mean(probs, axis=0)                       # mean router prob per expert
+    ce = jnp.mean(jnp.sum(mask, axis=1), axis=0)       # fraction routed per expert
+    ce = ce / jnp.maximum(jnp.sum(ce), 1e-9)
+    return e * jnp.sum(me * ce)
+
+
+def _expert_ffn(xe, w1, b1, w2, b2, act):
+    """Batched expert FFN: xe [E_local, C', d] through per-expert weights."""
+    xe, w1, w2 = cast_compute(xe, w1, w2)
+    h = jnp.einsum("ecd,edf->ecf", xe, w1,
+                   preferred_element_type=jnp.float32) + b1[:, None, :]
+    h = act(h).astype(xe.dtype)
+    y = jnp.einsum("ecf,efd->ecd", h, w2,
+                   preferred_element_type=jnp.float32) + b2[:, None, :]
+    return y
+
+
+def _route_compute(xt, wg, w1, b1, w2, b2, *, top_k, capacity, act,
+                   normalize_gates, exchange=None):
+    """Shared router→dispatch→experts→combine over tokens [t, d].
+    ``exchange(x, inverse)`` wraps the expert compute with the EP
+    token↔expert reshard; None on the dense path."""
+    logits = jnp.matmul(xt.astype(jnp.float32), wg)
+    probs = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine, mask = _topk_dispatch(probs, top_k, capacity, normalize_gates)
+    aux = _aux_loss(probs, mask)
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(xt.dtype), xt)   # [E, C, d]
+    if exchange is not None:
+        xe = exchange(xe, inverse=False)
+    ye = _expert_ffn(xe, w1, b1, w2, b2, act)
+    if exchange is not None:
+        ye = exchange(ye, inverse=True)
+    yt = jnp.einsum("tec,ecd->td", combine.astype(ye.dtype), ye)
+    return yt, aux
+
+
+def _moe_body(x, wg, w1, b1, w2, b2, *, axis_name, top_k, capacity, act,
+              normalize_gates, data_axes):
+    """Per-device EP computation: x [b_local, s, d] local tokens,
+    w1/b1/w2/b2 local expert shard [E_local, ...], wg replicated."""
+    varying = tuple(data_axes) + (axis_name,)
+    wg = mesh_lib.pvary(wg, varying)
+    if data_axes:
+        w1, b1, w2, b2 = (mesh_lib.pvary(a, tuple(data_axes)) for a in (w1, b1, w2, b2))
+
+    def exchange(x, inverse):
+        # token-shard ↔ expert-shard: [E, C, d] → [E/n, n·C, d] and back
+        split, concat = (1, 0) if inverse else (0, 1)
+        return jax.lax.all_to_all(x, axis_name, split_axis=split,
+                                  concat_axis=concat, tiled=True)
+
+    b, s, d = x.shape
+    yt, aux = _route_compute(x.reshape(b * s, d), wg, w1, b1, w2, b2,
+                             top_k=top_k, capacity=capacity, act=act,
+                             normalize_gates=normalize_gates, exchange=exchange)
+    aux = jax.lax.pmean(aux, varying)
+    return yt.reshape(b, s, d).astype(x.dtype), aux
+
+
+def moe(
+    x,
+    num_experts: int,
+    d_ff: int,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = mesh_lib.EP,
+    act: str = "gelu",
+    normalize_gates: bool = True,
+    param_attr=None,
+    name: Optional[str] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k-routed MoE FFN over ``x`` [batch, seq, d_model].
+
+    Returns ``(out, aux_loss)``. With ``mesh`` given and its ``ep`` axis
+    >1, experts are sharded over ``ep`` and tokens dispatched via
+    all_to_all (batch must be sharded over data axes + ``ep``);
+    otherwise runs the dense single-device path with identical numerics
+    (capacity permitting).
+    """
+    from ..layers.ops import apply_activation
+
+    helper = LayerHelper("moe", name=name)
+    b, s, d = x.shape
+    act_fn = lambda h: apply_activation(h, act)
+
+    wg = helper.create_parameter("router_w", shape=(d, num_experts),
+                                 dtype=jnp.float32, attr=param_attr)
+    w1 = helper.create_parameter("expert_w1", shape=(num_experts, d, d_ff),
+                                 dtype=jnp.float32, attr=param_attr)
+    b1 = helper.create_parameter("expert_b1", shape=(num_experts, d_ff),
+                                 dtype=jnp.float32, initializer=init.Constant(0.0))
+    w2 = helper.create_parameter("expert_w2", shape=(num_experts, d_ff, d),
+                                 dtype=jnp.float32, attr=param_attr)
+    b2 = helper.create_parameter("expert_b2", shape=(num_experts, d),
+                                 dtype=jnp.float32, initializer=init.Constant(0.0))
+
+    ep = mesh.shape[axis_name] if mesh is not None and axis_name in mesh.axis_names else 1
+    if ep > 1 and num_experts % ep != 0:
+        raise ValueError(f"num_experts={num_experts} not divisible by ep={ep}")
+
+    data_axes = tuple(a for a in (mesh_lib.DATA_AXES if mesh is None else
+                                  mesh_lib.data_axis_names(mesh))
+                      if mesh is not None and mesh.shape[a] > 1)
+    shards = ep * int(np.prod([mesh.shape[a] for a in data_axes] or [1]))
+    t_local = (b // max(1, shards)) * s if ep > 1 else b * s
+    capacity = max(1, int(math.ceil(t_local * top_k / num_experts * capacity_factor)))
+
+    if ep == 1:
+        # dense path (single device / ep absent): same algorithm, no collectives
+        yt, aux = _route_compute(x.reshape(b * s, d), wg, w1, b1, w2, b2,
+                                 top_k=top_k, capacity=capacity, act=act_fn,
+                                 normalize_gates=normalize_gates)
+        return yt.reshape(b, s, d).astype(x.dtype), aux
+
+    batch_shard = tuple(data_axes) + (axis_name,)
+    xspec = P(batch_shard if len(batch_shard) > 1 else batch_shard[0], None, None)
+    espec = P(axis_name)
+    body = functools.partial(_moe_body, axis_name=axis_name, top_k=top_k,
+                             capacity=capacity, act=act_fn,
+                             normalize_gates=normalize_gates, data_axes=data_axes)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(xspec, P(), espec, espec, espec, espec),
+        out_specs=(xspec, P()))
+    return fn(x, wg, w1, b1, w2, b2)
+
+
+def moe_ep_rules():
+    """Sharding-rule entries placing expert banks on ``ep`` — append to a
+    ShardingRules table (transformer_tp_rules(extra=moe_ep_rules()))."""
+    return [
+        (r".*moe.*/expert_(w1|b1|w2|b2)$", P("ep")),
+        (r".*moe.*/router_w$", P()),
+    ]

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .mesh import pvary
+
 
 def stack_layer_params(per_layer_params: list) -> Any:
     """Stack a list of per-layer param pytrees into [L, ...] leaves."""
@@ -66,8 +68,8 @@ def _pp_body(x, stacked, layer_fn, axis_name: str, microbatches: int,
         nxt = jax.lax.ppermute(done, axis_name, perm)
         return (nxt, outputs), None
 
-    holding0 = jax.lax.pvary(jnp.zeros(mb_shape, x.dtype), varying_axes)
-    outputs0 = jax.lax.pvary(jnp.zeros((m,) + mb_shape, x.dtype), varying_axes)
+    holding0 = pvary(jnp.zeros(mb_shape, x.dtype), varying_axes)
+    outputs0 = pvary(jnp.zeros((m,) + mb_shape, x.dtype), varying_axes)
     (_, outputs), _ = jax.lax.scan(tick, (holding0, outputs0),
                                    jnp.arange(m + p - 1))
     # broadcast final outputs from last rank to all (so out spec can be

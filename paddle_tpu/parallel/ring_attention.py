@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .mesh import pvary
+
 NEG_INF = -1e30
 
 
@@ -60,9 +62,9 @@ def _ring_body(q, k0, v0, axis_name: str, causal: bool, scale: float,
     # pvary: mark fresh accumulators as device-varying over every manual
     # mesh axis so the scan carry types line up (shard_map vma rules).
     vaxes = tuple(varying_axes) or (axis_name,)
-    m0 = jax.lax.pvary(jnp.full((b, h, sl), NEG_INF, jnp.float32), vaxes)
-    l0 = jax.lax.pvary(jnp.zeros((b, h, sl), jnp.float32), vaxes)
-    acc0 = jax.lax.pvary(jnp.zeros((b, h, sl, d), jnp.float32), vaxes)
+    m0 = pvary(jnp.full((b, h, sl), NEG_INF, jnp.float32), vaxes)
+    l0 = pvary(jnp.zeros((b, h, sl), jnp.float32), vaxes)
+    acc0 = pvary(jnp.zeros((b, h, sl, d), jnp.float32), vaxes)
     (k_f, v_f, m, l, acc), _ = jax.lax.scan(
         step, (k0, v0, m0, l0, acc0), jnp.arange(n))
     l_safe = jnp.maximum(l, 1e-30)
@@ -84,14 +86,8 @@ def ring_attention(
     scale = 1.0 / math.sqrt(q.shape[-1])
     if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
         # degenerate ring: plain attention
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                       preferred_element_type=jnp.float32) * scale
-        if causal:
-            sl = s.shape[-1]
-            cm = jnp.tril(jnp.ones((sl, sl), jnp.bool_))
-            s = jnp.where(cm, s, NEG_INF)
-        p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+        from ..layers.attention import scaled_dot_product_attention
+        return scaled_dot_product_attention(q, k, v, causal=causal)
 
     bspec = tuple(a for a in (batch_axes or ()) if a in mesh.axis_names)
     bshard = bspec if len(bspec) > 1 else (bspec[0] if bspec else None)

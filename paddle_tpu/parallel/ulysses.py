@@ -1,0 +1,80 @@
+"""Ulysses-style sequence parallelism: all-to-all head↔sequence reshard.
+
+The second of the two context-parallel schemes this framework supplies
+(SURVEY §5: the reference has no sequence parallelism at all; ring
+attention in ``ring_attention.py`` is the other). Where ring attention
+keeps queries resident and rotates K/V shards around the ICI ring,
+Ulysses (DeepSpeed-Ulysses / all-to-all CP) reshards activations so
+attention itself runs over the *full* sequence but only ``h/n`` heads
+per device:
+
+    [b, h, s/n, d] —all_to_all→ [b, h/n, s, d] —attention→
+    [b, h/n, s, d] —all_to_all→ [b, h, s/n, d]
+
+Two tiled all_to_alls per attention call; the core attention sees the
+whole sequence, so any inner kernel (flash attention) composes without
+modification. Requires num_heads % sp == 0; complements ring attention
+which has no head-count constraint.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _plain_attention(q, k, v, causal: bool):
+    from ..layers.attention import scaled_dot_product_attention
+    return scaled_dot_product_attention(q, k, v, causal=causal)
+
+
+def _ulysses_body(q, k, v, *, axis_name, causal, attn_fn):
+    """Local shards [b, h, s/n, d] → all-to-all → full-seq attention on
+    h/n heads → all-to-all back."""
+    def seq2head(x):
+        # split heads (axis 1) across the group, gather sequence (axis 2)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)   # [b, h/n, s, d]
+    oh = attn_fn(qh, kh, vh, causal)
+    # head-shard → seq-shard (inverse)
+    return jax.lax.all_to_all(oh, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def ulysses_attention(
+    q, k, v,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = False,
+    batch_axes: Optional[tuple] = ("dp", "fsdp"),
+    attn_fn: Optional[Callable] = None,
+):
+    """Attention over [b, h, s, d] with s sharded on ``axis_name``.
+
+    ``attn_fn(q, k, v, causal)`` is the full-sequence inner attention
+    (defaults to plain softmax attention; pass a flash-attention wrapper
+    to compose with the pallas kernel). Requires h % sp_size == 0.
+    """
+    if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        return (attn_fn or _plain_attention)(q, k, v, causal)
+
+    n = mesh.shape[axis_name]
+    if q.shape[1] % n != 0:
+        raise ValueError(f"ulysses needs num_heads ({q.shape[1]}) divisible by "
+                         f"sp axis size ({n}); use ring_attention otherwise")
+
+    bspec = tuple(a for a in (batch_axes or ()) if a in mesh.axis_names)
+    bshard = bspec if len(bspec) > 1 else (bspec[0] if bspec else None)
+    spec = P(bshard, None, axis_name, None)
+
+    fn = jax.shard_map(
+        functools.partial(_ulysses_body, axis_name=axis_name, causal=causal,
+                          attn_fn=attn_fn or _plain_attention),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

@@ -169,3 +169,40 @@ def test_sampling_id_distribution():
     probs = np.array([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]], np.float32)
     ids = layers.sampling_id(jnp.asarray(probs), seed=3)
     np.testing.assert_array_equal(np.asarray(ids), [1, 0])
+
+
+def test_hsigmoid_power_of_two_code_path():
+    # heap code c = label + num_classes exactly a power of two: float log2
+    # is inexact there (floor(log2f(32768)) == 14) — verify against a
+    # brute-force per-sample path walk
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    num_classes, dim = 20000, 8
+    labels = np.array([12768, 0, 12767, 19999], np.int64)  # 12768+20000 = 2^15
+    rng = np.random.RandomState(3)
+    feat = rng.randn(len(labels), dim).astype(np.float32)
+
+    def net(feat, label):
+        return {"per": layers.hsigmoid(feat, label, num_classes=num_classes, name="hs")}
+
+    prog = pt.build(net)
+    params, _ = prog.init(jax.random.PRNGKey(0), feat, labels)
+    out, _ = prog.apply(params, {}, feat, labels)
+
+    wkey = next(k for k in params if k.endswith("/w"))
+    w = np.asarray(params[wkey]); b = np.asarray(params[wkey[:-2] + "/b"])
+
+    def ref_loss(x, lab):
+        c, total = int(lab) + num_classes, 0.0
+        bit = 0
+        while (c >> (bit + 1)) > 0:
+            node = (c >> (bit + 1)) - 1
+            code = (c >> bit) & 1
+            t = float(w[node] @ x + b[node])
+            total += np.logaddexp(0.0, t) - code * t
+            bit += 1
+        return total
+
+    expect = np.array([ref_loss(feat[i], labels[i]) for i in range(len(labels))])
+    np.testing.assert_allclose(np.asarray(out["per"])[:, 0], expect, rtol=1e-4, atol=1e-4)
