@@ -1,0 +1,93 @@
+"""Automatic mixed precision: loss scaling.
+
+The reference's fp16 story is program rewriting —
+paddle/contrib/float16/float16_transpiler.py casts an inference program
+to fp16; training-side AMP did not exist yet. On TPU the compute-dtype
+half is already handled by ``framework.compute_dtype``/``amp_guard``
+(bf16 on the MXU, f32 master params). This module supplies the other
+half for float16-style training: **loss scaling** with overflow-skip —
+scale the loss before backward, unscale gradients, skip the optimizer
+step when any gradient is non-finite, and (dynamic mode) grow/shrink the
+scale from overflow history. bf16 training normally needs no scaling
+(same exponent range as f32); this exists for fp16 parity and as a
+general non-finite-gradient guard (FLAGS_check_nan_inf's actionable
+cousin: instead of aborting, skip and shrink).
+
+All update logic is branchless (jnp.where) so it stays inside the
+jitted train step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+LossScaleState = Dict[str, jax.Array]
+
+
+class LossScaler:
+    """Static or dynamic loss scaling.
+
+    Dynamic policy (the standard one): on overflow, scale ×= 1/factor and
+    the good-step counter resets; after ``growth_interval`` consecutive
+    finite steps, scale ×= factor. Static: fixed scale, overflow still
+    skips the step.
+    """
+
+    def __init__(self, init_scale: float = 2.0 ** 15, dynamic: bool = True,
+                 growth_interval: int = 1000, factor: float = 2.0,
+                 min_scale: float = 1.0, max_scale: float = 2.0 ** 24):
+        self.init_scale = float(init_scale)
+        self.dynamic = dynamic
+        self.growth_interval = int(growth_interval)
+        self.factor = float(factor)
+        self.min_scale = float(min_scale)
+        self.max_scale = float(max_scale)
+
+    # ------------------------------------------------------------------
+    def init_state(self) -> LossScaleState:
+        return {"scale": jnp.float32(self.init_scale),
+                "good_steps": jnp.int32(0),
+                "overflows": jnp.int32(0)}
+
+    # jit-side pieces ---------------------------------------------------
+    @staticmethod
+    def scale_loss(loss, ls: LossScaleState):
+        return loss * ls["scale"].astype(loss.dtype)
+
+    @staticmethod
+    def unscale(grads, ls: LossScaleState):
+        inv = 1.0 / ls["scale"]
+        return jax.tree.map(lambda g: g * inv.astype(g.dtype), grads)
+
+    @staticmethod
+    def all_finite(grads) -> jax.Array:
+        leaves = jax.tree.leaves(grads)
+        if not leaves:
+            return jnp.bool_(True)
+        flags = [jnp.all(jnp.isfinite(g)) for g in leaves]
+        return jnp.stack(flags).all()
+
+    def update(self, ls: LossScaleState, finite: jax.Array) -> LossScaleState:
+        overflows = ls["overflows"] + jnp.where(finite, 0, 1).astype(jnp.int32)
+        if not self.dynamic:
+            return {"scale": ls["scale"],
+                    "good_steps": ls["good_steps"] + finite.astype(jnp.int32),
+                    "overflows": overflows}
+        good = jnp.where(finite, ls["good_steps"] + 1, 0)
+        grow = good >= self.growth_interval
+        scale = jnp.where(finite,
+                          jnp.where(grow, ls["scale"] * self.factor, ls["scale"]),
+                          ls["scale"] / self.factor)
+        scale = jnp.clip(scale, self.min_scale, self.max_scale)
+        good = jnp.where(grow, 0, good)
+        return {"scale": scale, "good_steps": good.astype(jnp.int32),
+                "overflows": overflows}
+
+    @staticmethod
+    def select(finite: jax.Array, new_tree: Any, old_tree: Any) -> Any:
+        """Keep ``new_tree`` on finite steps, ``old_tree`` otherwise —
+        the step-skip, branchless for jit."""
+        return jax.tree.map(lambda n, o: jnp.where(finite, n, o), new_tree, old_tree)

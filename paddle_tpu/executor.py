@@ -167,6 +167,14 @@ class Trainer:
         self._step_fn = None
         self._eval_fn = None
         self.global_step = 0
+        self.loss_scaler = None
+        if strategy is not None and (getattr(strategy, "loss_scale", None)
+                                     or getattr(strategy, "dynamic_loss_scale", False)):
+            from .amp import LossScaler
+            self.loss_scaler = LossScaler(
+                init_scale=strategy.loss_scale or 2.0 ** 15,
+                dynamic=strategy.dynamic_loss_scale,
+                growth_interval=strategy.loss_scale_growth_interval)
 
     # ------------------------------------------------------------------
     def startup(self, rng: Optional[jax.Array] = None, sample_feed: Optional[Feed] = None):
@@ -185,6 +193,14 @@ class Trainer:
             state = jax.device_put(state, dev)
             opt_state = jax.device_put(opt_state, dev)
         self.scope.params, self.scope.state, self.scope.opt_state = params, state, opt_state
+        if self.loss_scaler is not None:
+            ls = self.loss_scaler.init_state()
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                ls = jax.device_put(ls, NamedSharding(self.mesh, PartitionSpec()))
+            else:
+                ls = jax.device_put(ls, self.place.device())
+            self.scope.loss_scale_state = ls
         self._build_step()
         return self.scope
 
@@ -202,15 +218,22 @@ class Trainer:
 
     def _build_step(self):
         accum_steps = getattr(self.strategy, "accum_steps", 1) if self.strategy else 1
+        scaler = self.loss_scaler
 
-        def train_step(params, opt_state, state, rng, feed):
+        def train_step(params, opt_state, state, rng, feed, ls):
+            def loss_and_aux(p, st, r, f):
+                loss, aux = self._loss_and_aux(p, st, r, f)
+                if scaler is not None:
+                    loss = scaler.scale_loss(loss, ls)
+                return loss, aux
+
             if accum_steps > 1:
                 # gradient accumulation (multi_batch_merge_pass analog):
                 # microbatch over the leading feed axis with lax.scan.
                 def micro(carry, mb):
                     acc, st = carry
                     (loss, (out, new_st)), grads = jax.value_and_grad(
-                        self._loss_and_aux, has_aux=True)(params, st, mb["rng"], mb["feed"])
+                        loss_and_aux, has_aux=True)(params, st, mb["rng"], mb["feed"])
                     acc = jax.tree.map(jnp.add, acc, grads)
                     return (acc, new_st), out
 
@@ -225,12 +248,27 @@ class Trainer:
                 grads = jax.tree.map(lambda g: g / accum_steps, gsum)
             else:
                 (loss, (out, new_state)), grads = jax.value_and_grad(
-                    self._loss_and_aux, has_aux=True)(params, state, rng, feed)
-            new_params, new_opt = self.optimizer.update(
-                grads, opt_state, params, self.program.param_info)
-            return new_params, new_opt, new_state, out
+                    loss_and_aux, has_aux=True)(params, state, rng, feed)
 
-        donate = (0, 1, 2) if self.donate else ()
+            if scaler is not None:
+                grads = scaler.unscale(grads, ls)
+                finite = scaler.all_finite(grads)
+                new_params, new_opt = self.optimizer.update(
+                    grads, opt_state, params, self.program.param_info)
+                # overflow-skip: keep old params/opt/state on non-finite grads
+                new_params = scaler.select(finite, new_params, params)
+                new_opt = scaler.select(finite, new_opt, opt_state)
+                new_state = scaler.select(finite, new_state, state)
+                new_ls = scaler.update(ls, finite)
+                out = dict(out)
+                out["loss_scale"] = new_ls["scale"]
+            else:
+                new_params, new_opt = self.optimizer.update(
+                    grads, opt_state, params, self.program.param_info)
+                new_ls = ls
+            return new_params, new_opt, new_state, out, new_ls
+
+        donate = (0, 1, 2, 5) if self.donate else ()
         if self.mesh is not None:
             from .parallel import api as par_api
             self._step_fn = par_api.jit_sharded_step(
@@ -252,10 +290,13 @@ class Trainer:
         if rng is None:
             rng = jax.random.fold_in(jax.random.PRNGKey(get_flag("seed") + 1), self.global_step)
         feed = self._put_feed(feed)
+        ls = getattr(self.scope, "loss_scale_state", None) or {}
         with profiler.record_event("trainer.step"):
-            p, o, s, out = self._step_fn(self.scope.params, self.scope.opt_state,
-                                         self.scope.state, rng, feed)
+            p, o, s, out, new_ls = self._step_fn(self.scope.params, self.scope.opt_state,
+                                                 self.scope.state, rng, feed, ls)
         self.scope.params, self.scope.opt_state, self.scope.state = p, o, s
+        if self.loss_scaler is not None:
+            self.scope.loss_scale_state = new_ls
         self.global_step += 1
         if get_flag("benchmark"):
             jax.block_until_ready(out)

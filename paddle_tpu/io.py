@@ -87,6 +87,11 @@ def load_persistables(dirname: str) -> Tuple[Dict[str, np.ndarray], Dict[str, np
     params = _load("params.npz") or {}
     state = _load("state.npz") or {}
     opt_state = _load("opt_state.npz")
+    if opt_state is not None:
+        # empty sub-dicts ("global"/"accums" for stateless optimizers)
+        # flatten to nothing on save — restore the keys
+        opt_state.setdefault("global", {})
+        opt_state.setdefault("accums", {})
     meta_path = os.path.join(dirname, "meta.json")
     meta = {}
     if os.path.exists(meta_path):
@@ -98,14 +103,23 @@ def load_persistables(dirname: str) -> Tuple[Dict[str, np.ndarray], Dict[str, np
 def save_trainer(dirname: str, trainer) -> None:
     """Checkpoint a Trainer (params+state+opt_state+step) — the
     CheckpointConfig/save_checkpoint analog (contrib/trainer.py:100)."""
+    meta = {"global_step": trainer.global_step}
+    ls = getattr(trainer.scope, "loss_scale_state", None)
+    if ls:
+        meta["loss_scale_state"] = {k: float(v) for k, v in ls.items()}
     save_persistables(dirname, trainer.scope.params, trainer.scope.state,
-                      trainer.scope.opt_state, meta={"global_step": trainer.global_step})
+                      trainer.scope.opt_state, meta=meta)
 
 
 def load_trainer(dirname: str, trainer) -> None:
     """Restore a Trainer in place, re-placing arrays on the trainer's
     device/mesh (resharding-on-load)."""
     params, state, opt_state, meta = load_persistables(dirname)
+    if opt_state is not None:
+        # stateless-optimizer per-param accums are empty dicts, which
+        # flatten to nothing on save — restore the per-param keys
+        for k in params:
+            opt_state["accums"].setdefault(k, {})
     if trainer.mesh is not None:
         from .parallel import api as par_api
         params, state, opt_state = par_api.shard_scope(
@@ -121,6 +135,13 @@ def load_trainer(dirname: str, trainer) -> None:
         opt_state["step"] = jnp.asarray(opt_state["step"], jnp.int32)
     trainer.scope.params, trainer.scope.state, trainer.scope.opt_state = params, state, opt_state
     trainer.global_step = int(meta.get("global_step", 0))
+    ls_meta = meta.get("loss_scale_state")
+    if ls_meta and trainer.loss_scaler is not None:
+        trainer.scope.loss_scale_state = jax.device_put({
+            "scale": jnp.float32(ls_meta["scale"]),
+            "good_steps": jnp.int32(ls_meta["good_steps"]),
+            "overflows": jnp.int32(ls_meta["overflows"]),
+        })
 
 
 # -- inference model (save/load_inference_model analog) ----------------------

@@ -21,7 +21,11 @@ class DistStrategy:
     # donation / rematerialization knobs (memory_optimize analog).
     donate_buffers: bool = True
     remat: bool = False
-    # loss scaling for mixed precision.
+    # loss scaling for mixed precision: a float enables scaling at that
+    # initial value; dynamic_loss_scale grows/shrinks it from overflow
+    # history (non-finite grads always skip the step when enabled).
     loss_scale: Optional[float] = None
+    dynamic_loss_scale: bool = False
+    loss_scale_growth_interval: int = 1000
     # debug dump of the compiled HLO (debug_graphviz_path analog).
     dump_hlo_path: Optional[str] = None

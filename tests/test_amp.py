@@ -1,0 +1,95 @@
+"""Loss scaling (amp.py): scaler dynamics, overflow-skip in the Trainer,
+static-scale equivalence, checkpoint roundtrip."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer as opt
+from paddle_tpu.amp import LossScaler
+from paddle_tpu.parallel import DistStrategy
+
+
+def test_scaler_dynamics():
+    sc = LossScaler(init_scale=1024.0, dynamic=True, growth_interval=3, factor=2.0)
+    ls = sc.init_state()
+    ls = sc.update(ls, jnp.bool_(False))             # overflow → halve
+    assert float(ls["scale"]) == 512.0 and int(ls["good_steps"]) == 0
+    assert int(ls["overflows"]) == 1
+    for _ in range(2):
+        ls = sc.update(ls, jnp.bool_(True))
+    assert float(ls["scale"]) == 512.0               # not yet at interval
+    ls = sc.update(ls, jnp.bool_(True))              # 3rd good step → grow
+    assert float(ls["scale"]) == 1024.0 and int(ls["good_steps"]) == 0
+
+
+def test_scaler_static_mode():
+    sc = LossScaler(init_scale=128.0, dynamic=False)
+    ls = sc.init_state()
+    ls = sc.update(ls, jnp.bool_(False))
+    assert float(ls["scale"]) == 128.0 and int(ls["overflows"]) == 1
+
+
+def _mlp_trainer(strategy=None, seed=0):
+    def net(x, label):
+        h = layers.fc(x, 32, act="relu", name="h")
+        logits = layers.fc(h, 4, name="out")
+        return {"loss": layers.mean(layers.softmax_with_cross_entropy(logits, label))}
+
+    prog = pt.build(net)
+    tr = pt.Trainer(prog, opt.SGD(0.1), loss_name="loss", strategy=strategy)
+    rng = np.random.RandomState(seed)
+    feed = {"x": rng.randn(16, 8).astype(np.float32),
+            "label": rng.randint(0, 4, (16, 1)).astype(np.int64)}
+    tr.startup(sample_feed=feed)
+    return tr, feed
+
+
+def test_overflow_skips_step_and_shrinks_scale():
+    tr, feed = _mlp_trainer(DistStrategy(dynamic_loss_scale=True, loss_scale=1024.0))
+    p0 = {k: np.asarray(v) for k, v in tr.scope.params.items()}
+
+    bad = dict(feed)
+    bad["x"] = feed["x"].copy()
+    bad["x"][0, 0] = np.nan
+    out = tr.step(bad)
+    assert float(out["loss_scale"]) == 512.0
+    for k, v in tr.scope.params.items():
+        np.testing.assert_array_equal(np.asarray(v), p0[k], err_msg=k)
+
+    out = tr.step(feed)                              # clean batch → params move
+    assert float(out["loss_scale"]) == 512.0
+    moved = any(not np.array_equal(np.asarray(v), p0[k])
+                for k, v in tr.scope.params.items())
+    assert moved
+    assert int(tr.scope.loss_scale_state["overflows"]) == 1
+
+
+def test_static_scale_matches_unscaled_training():
+    tr_a, feed = _mlp_trainer()
+    tr_b, _ = _mlp_trainer(DistStrategy(loss_scale=1024.0))
+    for i in range(3):
+        rng = jax.random.PRNGKey(7 + i)
+        tr_a.step(feed, rng=rng)
+        tr_b.step(feed, rng=rng)
+    for k in tr_a.scope.params:
+        np.testing.assert_allclose(np.asarray(tr_a.scope.params[k]),
+                                   np.asarray(tr_b.scope.params[k]),
+                                   atol=1e-6, rtol=1e-6, err_msg=k)
+
+
+def test_loss_scale_checkpoint_roundtrip(tmp_path):
+    from paddle_tpu import io
+    tr, feed = _mlp_trainer(DistStrategy(dynamic_loss_scale=True, loss_scale=256.0))
+    bad = dict(feed)
+    bad["x"] = feed["x"].copy()
+    bad["x"][0, 0] = np.inf
+    tr.step(bad)
+    io.save_trainer(str(tmp_path / "ck"), tr)
+
+    tr2, _ = _mlp_trainer(DistStrategy(dynamic_loss_scale=True, loss_scale=256.0))
+    io.load_trainer(str(tmp_path / "ck"), tr2)
+    assert float(tr2.scope.loss_scale_state["scale"]) == 128.0
+    assert int(tr2.scope.loss_scale_state["overflows"]) == 1
+    tr2.step(feed)  # still steppable after restore
