@@ -219,3 +219,60 @@ def ctr(split: str = "train", num_sparse_fields: int = 26, sparse_dim: int = 100
             yield dense, sparse, y
     reader.synthetic = True
     return reader
+
+
+def conll05(split: str = "train", vocab_size: int = 5000, num_labels: int = 20,
+            seq_len: int = 32, synthetic_size: int = 512) -> Callable:
+    """CoNLL-2005 SRL-style data (dataset/conll05.py analog, synthetic-
+    backed): (word_ids[t], mark_ids[t], label[t], length). Labels follow
+    a learnable word→tag mapping shifted on the predicate span so the
+    mark feature carries signal."""
+
+    def reader():
+        rng = np.random.RandomState(12 if split == "train" else 13)
+        tag_of = rng.randint(0, num_labels, vocab_size)
+        for _ in range(synthetic_size):
+            n = rng.randint(seq_len // 2, seq_len)
+            words = np.zeros(seq_len, np.int64)
+            words[:n] = rng.randint(1, vocab_size, n)
+            marks = np.zeros(seq_len, np.int64)
+            p0 = rng.randint(0, n)
+            marks[p0:min(n, p0 + 3)] = 1
+            labels = np.zeros(seq_len, np.int64)
+            labels[:n] = (tag_of[words[:n]] + marks[:n]) % num_labels
+            yield words, marks, labels, np.int64(n)
+    reader.synthetic = True
+    return reader
+
+
+def movielens(split: str = "train", num_users: int = 944, num_movies: int = 1683,
+              num_categories: int = 18, title_vocab: int = 1000,
+              max_categories: int = 4, title_len: int = 6,
+              synthetic_size: int = 1024) -> Callable:
+    """MovieLens-style data (dataset/movielens.py analog, synthetic-
+    backed): (user_id[1], gender_id[1], age_id[1], job_id[1],
+    movie_id[1], category_ids[max_cat], title_ids[title_len], score[1]).
+    Ratings follow latent user/movie factors so the model can learn."""
+
+    def reader():
+        rng = np.random.RandomState(14 if split == "train" else 15)
+        uf = rng.randn(num_users, 4).astype(np.float32)
+        mf = rng.randn(num_movies, 4).astype(np.float32)
+        for _ in range(synthetic_size):
+            u = rng.randint(0, num_users)
+            m = rng.randint(0, num_movies)
+            ncat = rng.randint(1, max_categories + 1)
+            cats = np.zeros(max_categories, np.int64)
+            cats[:ncat] = rng.randint(1, num_categories, ncat)
+            title = np.zeros(title_len, np.int64)
+            nt = rng.randint(1, title_len + 1)
+            title[:nt] = rng.randint(1, title_vocab, nt)
+            raw = float(uf[u] @ mf[m])
+            score = np.clip(2.5 + raw, 1.0, 5.0).astype(np.float32)
+            yield (np.array([u], np.int64), np.array([rng.randint(0, 2)], np.int64),
+                   np.array([rng.randint(0, 7)], np.int64),
+                   np.array([rng.randint(0, 21)], np.int64),
+                   np.array([m], np.int64), cats, title,
+                   np.array([score], np.float32))
+    reader.synthetic = True
+    return reader

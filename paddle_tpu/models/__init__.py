@@ -2,7 +2,7 @@
 (BASELINE.json: MNIST MLP, ResNet-50, Transformer-base, DeepFM,
 BERT-base; plus VGG/LSTM from benchmark/fluid/models/)."""
 
-from . import bert, convnets, deepfm, lstm, mnist, resnet, seq2seq, transformer, vgg, word2vec
+from . import bert, convnets, deepfm, lstm, mnist, recommender, resnet, seq2seq, srl, transformer, vgg, word2vec
 
 __all__ = ["bert", "convnets", "deepfm", "lstm", "mnist", "resnet", "seq2seq",
            "transformer", "vgg", "word2vec"]

@@ -1,0 +1,54 @@
+"""Personalized recommendation — the book `recommender_system` config
+(python/paddle/fluid/tests/book/test_recommender_system.py: movielens
+user tower [id/gender/age/job embeddings → fc] and movie tower
+[id embedding, mean-pooled category + title embeddings → fc], cosine
+similarity scaled to the rating range, square_error_cost)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import layers as L
+
+
+def make_model(num_users=944, num_movies=1683, num_genders=2, num_ages=7,
+               num_jobs=21, num_categories=18, title_vocab=1000,
+               emb_dim=32, fc_dim=200):
+    """Inputs: user_id/gender_id/age_id/job_id [b,1] int, movie_id [b,1],
+    category_ids [b, n_cat] (0-padded multi-hot), title_ids [b, n_title]
+    (0-padded), score [b,1] float rating."""
+
+    def usr_mov_net(user_id, gender_id, age_id, job_id, movie_id,
+                    category_ids, title_ids, score):
+        # -- user tower
+        feats = [
+            L.embedding(user_id, size=[num_users, emb_dim], name="usr_emb"),
+            L.embedding(gender_id, size=[num_genders, emb_dim // 2], name="gender_emb"),
+            L.embedding(age_id, size=[num_ages, emb_dim // 2], name="age_emb"),
+            L.embedding(job_id, size=[num_jobs, emb_dim // 2], name="job_emb"),
+        ]
+        usr = jnp.concatenate([f.reshape(f.shape[0], -1) for f in feats], axis=-1)
+        usr = L.fc(usr, fc_dim, act="tanh", name="usr_fc")
+
+        # -- movie tower (category/title are 0-padded id lists → mean pool,
+        # the sequence_pool('average') the reference applies to LoD inputs)
+        mov_id = L.embedding(movie_id, size=[num_movies, emb_dim], name="mov_emb")
+        mov_id = mov_id.reshape(mov_id.shape[0], -1)
+
+        def pooled(ids, vocab, name):
+            e = L.embedding(ids, size=[vocab, emb_dim // 2], name=name)
+            m = (ids != 0).astype(e.dtype)[..., None]
+            return (e * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+
+        cat = pooled(category_ids, num_categories, "cat_emb")
+        title = pooled(title_ids, title_vocab, "title_emb")
+        mov = jnp.concatenate([mov_id, cat, title], axis=-1)
+        mov = L.fc(mov, fc_dim, act="tanh", name="mov_fc")
+
+        # -- cosine similarity scaled to [0, 5] (cos_sim + scale op chain)
+        sim = L.cos_sim(usr, mov)
+        pred = 5.0 * sim
+        loss = L.mean(L.square_error_cost(pred, score))
+        return {"loss": loss, "pred": pred}
+
+    return usr_mov_net

@@ -1,0 +1,45 @@
+"""Semantic role labeling — the book `label_semantic_roles` config
+(python/paddle/fluid/tests/book/test_label_semantic_roles.py: word +
+predicate-mark embeddings → stacked alternating-direction LSTMs → per-
+position scores → linear_chain_crf loss, crf_decoding inference).
+
+TPU-native: padded [b, t] batches with explicit lengths (the LoD
+equivalent, DESIGN.md "LoD decision"), scan-based LSTMs, the CRF from
+layers.crf (forward algorithm under scan, Viterbi decode)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import layers as L
+from ..layers.crf import crf_decoding, linear_chain_crf
+from ..layers.rnn import dynamic_lstm
+
+
+def make_model(vocab_size=5000, num_labels=20, word_dim=32, hidden_dim=128,
+               depth=4):
+    """word_ids [b,t], mark_ids [b,t] (1 on the predicate span), label
+    [b,t], lengths [b]. Stacked BiLSTM via alternating direction per
+    layer, as the reference's 8-layer config does."""
+
+    def srl_net(word_ids, mark_ids, label, lengths):
+        word = L.embedding(word_ids, size=[vocab_size, word_dim], name="word_emb")
+        mark = L.embedding(mark_ids, size=[2, word_dim], name="mark_emb")
+        x = jnp.concatenate([word, mark], axis=-1)
+
+        h, _ = dynamic_lstm(x, hidden_dim, sequence_length=lengths, name="lstm_0")
+        for i in range(1, depth):
+            rev = bool(i % 2)
+            nxt, _ = dynamic_lstm(h, hidden_dim, sequence_length=lengths,
+                                  is_reverse=rev, name=f"lstm_{i}")
+            h = nxt + h  # residual keeps deep stacks trainable
+        emission = L.fc(h, num_labels, num_flatten_dims=2, name="emission")
+
+        nll, transition = linear_chain_crf(emission, label, lengths, name="crf")
+        decoded = crf_decoding(emission, lengths, transition)
+        mask = (jnp.arange(label.shape[1])[None, :] < lengths[:, None])
+        correct = jnp.sum((decoded == label) & mask)
+        acc = correct / jnp.maximum(jnp.sum(mask), 1)
+        return {"loss": jnp.mean(nll), "decoded": decoded, "acc": acc}
+
+    return srl_net
