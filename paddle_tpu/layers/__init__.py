@@ -1,8 +1,22 @@
 """Layer library — the ``fluid.layers`` surface (python/paddle/fluid/layers/)."""
 
 from . import attention, beam_search, control_flow, crf, ctc, detection
-from . import nn, ops, rnn, sequence, tensor
+from . import io, nn, ops, rnn, sequence, tensor
+from .beam_search import beam_search_decode
+from .control_flow import DynamicRNN, IfElse, StaticRNN, Switch, While
 from .ctc import ctc_greedy_decoder, edit_distance, warpctc
+from .io import (
+    Preprocessor,
+    PyReader,
+    batch,
+    data,
+    double_buffer,
+    open_files,
+    py_reader,
+    random_data_generator,
+    read_file,
+    shuffle,
+)
 from .attention import (
     ffn,
     multi_head_attention,
@@ -10,7 +24,44 @@ from .attention import (
     positional_encoding,
     scaled_dot_product_attention,
 )
+from .detection import (
+    anchor_generator,
+    bipartite_match,
+    box_coder,
+    density_prior_box,
+    detection_map,
+    detection_output,
+    generate_proposal_labels,
+    generate_proposals,
+    iou_similarity,
+    multi_box_head,
+    multiclass_nms,
+    polygon_box_transform,
+    prior_box,
+    roi_align,
+    roi_perspective_transform,
+    roi_pool,
+    rpn_target_assign,
+    ssd_loss,
+    target_assign,
+    yolo_box,
+)
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
-from .rnn import dynamic_gru, dynamic_lstm, rnn as rnn_scan
+from .rnn import (
+    dynamic_gru,
+    dynamic_lstm,
+    dynamic_lstmp,
+    gru_unit,
+    lstm_unit,
+    rnn as rnn_scan,
+)
+from .sequence import (
+    lod_reset,
+    reorder_lod_tensor_by_rank,
+    sequence_conv,
+    sequence_expand_as,
+    sequence_reshape,
+    sequence_scatter,
+)
 from .tensor import *  # noqa: F401,F403

@@ -110,3 +110,31 @@ def greedy_search(step_fn, init_state, batch_size: int, max_len: int,
     (tokens, finished, seqs, _), _ = jax.lax.scan(
         step, (tokens0, finished0, seqs0, init_state), jnp.arange(max_len))
     return seqs
+
+
+def beam_search_decode(step_ids, step_parents, end_id: int = 2, name=None):
+    """beam_search_decode_op analog: backtrack per-step (ids, parent beam
+    indices) into full sequences.
+
+    step_ids/step_parents: [T, B, K] int32 — token chosen at step t per
+    beam, and the beam lane it extended. Returns (sequences [B, K, T],
+    valid [B, K, T]) — valid marks tokens up to and including the first
+    ``end_id``, the LoD-lengths equivalent of the reference's ragged
+    sentence output.
+    """
+    step_ids = jnp.asarray(step_ids)
+    step_parents = jnp.asarray(step_parents)
+    t_steps, b, k = step_ids.shape
+
+    def back(lane, inp):
+        ids_t, par_t = inp                                   # [B, K]
+        tok = jnp.take_along_axis(ids_t, lane, axis=1)       # [B, K]
+        lane = jnp.take_along_axis(par_t, lane, axis=1)
+        return lane, tok
+
+    lane0 = jnp.tile(jnp.arange(k)[None, :], (b, 1))
+    _, toks = jax.lax.scan(back, lane0, (step_ids[::-1], step_parents[::-1]))
+    seqs = jnp.transpose(toks[::-1], (1, 2, 0))              # [B, K, T]
+    ended_before = jnp.cumsum((seqs == end_id).astype(jnp.int32), axis=-1) \
+        - (seqs == end_id).astype(jnp.int32)
+    return seqs, ended_before == 0

@@ -84,3 +84,87 @@ def less_than(x, y, force_cpu=None):
 
 def array_length(arr):
     return jnp.asarray(arr.shape[0])
+
+
+class While:
+    """Class-form While (control_flow.py While:655) over the functional
+    while_loop: ``While(cond_fn)(body_fn, loop_vars)``. Both are pytree →
+    pytree; lowers to lax.while_loop."""
+
+    def __init__(self, cond_fn: Callable, name=None):
+        self.cond_fn = cond_fn
+
+    def __call__(self, body_fn: Callable, loop_vars):
+        return while_loop(self.cond_fn, body_fn, loop_vars)
+
+
+class IfElse:
+    """Row-wise IfElse (control_flow.py IfElse:1412): the reference
+    scatters batch rows into true/false sub-blocks and merges. Dense TPU
+    lowering: both branch fns run on the full batch and rows are selected
+    by the mask — identical results, MXU-friendly.
+
+    ``IfElse(cond_rows)(true_fn, false_fn, x)`` with cond_rows [b] or
+    [b,1] boolean."""
+
+    def __init__(self, cond, name=None):
+        self.cond = jnp.asarray(cond)
+
+    def __call__(self, true_fn: Callable, false_fn: Callable, *operands):
+        t = true_fn(*operands)
+        f = false_fn(*operands)
+        mask = self.cond.reshape(-1)
+
+        def sel(a, b):
+            m = mask.reshape((-1,) + (1,) * (a.ndim - 1))
+            return jnp.where(m, a, b)
+
+        return jax.tree.map(sel, t, f)
+
+
+class Switch:
+    """Scalar Switch (control_flow.py Switch:1286): ordered
+    (predicate, fn) cases + default — first true wins, like the
+    reference's cascade of conditional_blocks."""
+
+    def __init__(self, name=None):
+        self.cases: List = []
+        self.default_fn: Callable = None
+
+    def case(self, pred, fn: Callable):
+        self.cases.append((pred, fn))
+        return self
+
+    def default(self, fn: Callable):
+        self.default_fn = fn
+        return self
+
+    def __call__(self):
+        return case(self.cases, self.default_fn)
+
+
+class StaticRNN:
+    """StaticRNN (control_flow.py:429): fixed-length scan over time.
+    ``StaticRNN()(cell_fn, inputs, init_state)`` with cell_fn(state, x_t)
+    → (new_state, out_t); inputs [b, t, …]. Lowers to lax.scan."""
+
+    def __init__(self, name=None):
+        pass
+
+    def __call__(self, cell_fn: Callable, inputs, init_state):
+        from .rnn import rnn as _rnn
+        return _rnn(cell_fn, inputs, init_state)
+
+
+class DynamicRNN:
+    """DynamicRNN (control_flow.py:1542): ragged-batch scan. Same as
+    StaticRNN plus per-row ``sequence_length`` masking — the
+    lod_rank_table/shrink_memory machinery replaced by state masking
+    (numerically equal; see layers/rnn.py docstring)."""
+
+    def __init__(self, name=None):
+        pass
+
+    def __call__(self, cell_fn: Callable, inputs, init_state, sequence_length=None):
+        from .rnn import rnn as _rnn
+        return _rnn(cell_fn, inputs, init_state, sequence_length=sequence_length)

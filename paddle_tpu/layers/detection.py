@@ -254,3 +254,432 @@ def yolo_box(x, img_size, anchors: Sequence[int], class_num: int,
     boxes = boxes.transpose(0, 1, 3, 4, 2).reshape(n, -1, 4)
     scores = prob.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
     return boxes, scores
+
+
+def detection_map(detect_res, gt_label, gt_box, class_num: int,
+                  overlap_threshold: float = 0.5, ap_version: str = "integral"):
+    """detection_map_op analog (host-side, like the reference's CPU-only
+    kernel): one-batch mAP. detect_res: per-image list of
+    (label, score, x1,y1,x2,y2); gt_label/gt_box: per-image lists.
+    Delegates to evaluator.DetectionMAP."""
+    from ..evaluator import DetectionMAP
+
+    m = DetectionMAP(overlap_threshold=overlap_threshold, ap_version=ap_version)
+    gts = [[(int(l),) + tuple(b) for l, b in zip(labs, boxes)]
+           for labs, boxes in zip(gt_label, gt_box)]
+    m.update(detect_res, gts)
+    return m.eval()
+
+
+# ---------------------------------------------------------------------------
+# RoI / RPN family (operators/roi_pool_op.cc, roi_align_op.cc,
+# detection/anchor_generator_op.cc, generate_proposals_op.cc,
+# rpn_target_assign_op.cc, generate_proposal_labels_op.cc,
+# target_assign_op.cc, polygon_box_transform_op.cc,
+# roi_perspective_transform_op.cc, multi_box_head layers/detection.py)
+# Static-shape TPU designs: padded outputs + valid masks instead of LoD.
+# ---------------------------------------------------------------------------
+
+
+def roi_pool(input, rois, rois_batch_idx, pooled_height: int = 1,
+             pooled_width: int = 1, spatial_scale: float = 1.0):
+    """RoI max pooling (roi_pool_op.cc): input [N,C,H,W], rois [R,4]
+    image-coord (x1,y1,x2,y2), rois_batch_idx [R]. Bin boundaries use the
+    reference's round/floor/ceil arithmetic; empty bins give 0. The
+    rectangular-bin max is separable: masked max over H, then over W —
+    two dense reductions instead of per-bin gathers."""
+    n, c, h, w = input.shape
+    r = rois.shape[0]
+    roi = jnp.round(rois.astype(jnp.float32) * spatial_scale)
+    x1, y1, x2, y2 = roi[:, 0], roi[:, 1], roi[:, 2], roi[:, 3]
+    rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+    rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+    bin_h = rh / pooled_height
+    bin_w = rw / pooled_width
+    ph = jnp.arange(pooled_height, dtype=jnp.float32)
+    pw = jnp.arange(pooled_width, dtype=jnp.float32)
+    hstart = jnp.clip(jnp.floor(ph[None, :] * bin_h[:, None]) + y1[:, None], 0, h)
+    hend = jnp.clip(jnp.ceil((ph[None, :] + 1) * bin_h[:, None]) + y1[:, None], 0, h)
+    wstart = jnp.clip(jnp.floor(pw[None, :] * bin_w[:, None]) + x1[:, None], 0, w)
+    wend = jnp.clip(jnp.ceil((pw[None, :] + 1) * bin_w[:, None]) + x1[:, None], 0, w)
+
+    feats = input[rois_batch_idx]                                   # [R,C,H,W]
+    hh = jnp.arange(h, dtype=jnp.float32)
+    hmask = (hh[None, None, :] >= hstart[:, :, None]) & (hh[None, None, :] < hend[:, :, None])
+    rowmax = jnp.max(
+        jnp.where(hmask[:, None, :, :, None], feats[:, :, None, :, :], -jnp.inf),
+        axis=3)                                                      # [R,C,Ph,W]
+    ww = jnp.arange(w, dtype=jnp.float32)
+    wmask = (ww[None, None, :] >= wstart[:, :, None]) & (ww[None, None, :] < wend[:, :, None])
+    out = jnp.max(
+        jnp.where(wmask[:, None, None, :, :], rowmax[:, :, :, None, :], -jnp.inf),
+        axis=4)                                                      # [R,C,Ph,Pw]
+    return jnp.where(jnp.isfinite(out), out, 0.0).astype(input.dtype)
+
+
+def roi_align(input, rois, rois_batch_idx, pooled_height: int = 1,
+              pooled_width: int = 1, spatial_scale: float = 1.0,
+              sampling_ratio: int = 2):
+    """RoI align (roi_align_op.cc): bilinear-sampled average per bin.
+    ``sampling_ratio`` is static (the reference's adaptive -1 mode is
+    data-dependent; fixed 2 is its common setting)."""
+    n, c, h, w = input.shape
+    s = max(sampling_ratio, 1)
+    roi = rois.astype(jnp.float32) * spatial_scale
+    x1, y1, x2, y2 = roi[:, 0], roi[:, 1], roi[:, 2], roi[:, 3]
+    rh = jnp.maximum(y2 - y1, 1.0)
+    rw = jnp.maximum(x2 - x1, 1.0)
+    bin_h = rh / pooled_height
+    bin_w = rw / pooled_width
+    # sample grid: [R, Ph*S] y coords, [R, Pw*S] x coords
+    iy = jnp.arange(pooled_height * s, dtype=jnp.float32)
+    ix = jnp.arange(pooled_width * s, dtype=jnp.float32)
+    ys = y1[:, None] + (iy[None, :] // s) * bin_h[:, None] \
+        + ((iy[None, :] % s) + 0.5) * bin_h[:, None] / s
+    xs = x1[:, None] + (ix[None, :] // s) * bin_w[:, None] \
+        + ((ix[None, :] % s) + 0.5) * bin_w[:, None] / s
+
+    feats = input[rois_batch_idx]                                   # [R,C,H,W]
+
+    def bilinear(feat, ys_r, xs_r):
+        y0 = jnp.clip(jnp.floor(ys_r), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs_r), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+        ly = jnp.clip(ys_r - y0, 0.0, 1.0)
+        lx = jnp.clip(xs_r - x0, 0.0, 1.0)
+        # outer product over (y samples, x samples)
+        def gather(yy, xx):
+            return feat[:, yy][:, :, xx]                            # [C, Sy, Sx]
+        v = (gather(y0i, x0i) * ((1 - ly)[:, None] * (1 - lx)[None, :])[None]
+             + gather(y0i, x1i) * ((1 - ly)[:, None] * lx[None, :])[None]
+             + gather(y1i, x0i) * (ly[:, None] * (1 - lx)[None, :])[None]
+             + gather(y1i, x1i) * (ly[:, None] * lx[None, :])[None])
+        return v                                                     # [C, Ph*S, Pw*S]
+
+    vals = jax.vmap(bilinear)(feats, ys, xs)                         # [R,C,Ph*S,Pw*S]
+    vals = vals.reshape(r_shape := vals.shape[0], c, pooled_height, s, pooled_width, s)
+    return jnp.mean(vals, axis=(3, 5)).astype(input.dtype)
+
+
+def anchor_generator(input, anchor_sizes: Sequence[float],
+                     aspect_ratios: Sequence[float],
+                     variance=(0.1, 0.1, 0.2, 0.2),
+                     stride=(16.0, 16.0), offset: float = 0.5):
+    """RPN anchors (anchor_generator_op.cc): input [N,C,H,W] →
+    (anchors [H,W,A,4] x1y1x2y2 in image coords, variances [H,W,A,4])."""
+    h, w = input.shape[2], input.shape[3]
+    sw, sh = float(stride[0]), float(stride[1])
+    ws, hs = [], []
+    for ar in aspect_ratios:
+        for size in anchor_sizes:
+            area = sw * sh
+            area_ratio = area / ar
+            base_w = jnp.round(jnp.sqrt(area_ratio))
+            base_h = jnp.round(base_w * ar)
+            scale_w = size / sw
+            scale_h = size / sh
+            ws.append(scale_w * base_w)
+            hs.append(scale_h * base_h)
+    ws = jnp.stack(ws)
+    hs = jnp.stack(hs)
+    cx = (jnp.arange(w, dtype=jnp.float32) * sw + offset * sw)
+    cy = (jnp.arange(h, dtype=jnp.float32) * sh + offset * sh)
+    gx, gy = jnp.meshgrid(cx, cy)                                    # [H,W]
+    anchors = jnp.stack([
+        gx[:, :, None] - 0.5 * (ws - 1.0),
+        gy[:, :, None] - 0.5 * (hs - 1.0),
+        gx[:, :, None] + 0.5 * (ws - 1.0),
+        gy[:, :, None] + 0.5 * (hs - 1.0),
+    ], axis=-1)                                                      # [H,W,A,4]
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), anchors.shape)
+    return anchors, var
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n: int = 6000, post_nms_top_n: int = 1000,
+                       nms_thresh: float = 0.5, min_size: float = 0.1,
+                       eta: float = 1.0):
+    """RPN proposal generation (generate_proposals_op.cc): per image
+    top-k → decode → clip → min-size filter → NMS. scores [N,A,H,W],
+    bbox_deltas [N,4A,H,W], anchors/variances [H,W,A,4], im_info [N,3]
+    (h, w, scale). Returns (rois [N,post,4], roi_probs [N,post], valid
+    [N,post]) — the padded-batch LoD equivalent."""
+    n, a, h, w = scores.shape
+    total = a * h * w
+    anc = anchors.transpose(2, 0, 1, 3).reshape(total, 4)
+    var = variances.transpose(2, 0, 1, 3).reshape(total, 4)
+    k = min(pre_nms_top_n, total)
+
+    def per_image(sc, bd, info):
+        sc = sc.reshape(total)
+        bd = bd.reshape(a, 4, h, w).transpose(0, 2, 3, 1).reshape(total, 4)
+        top_sc, idx = jax.lax.top_k(sc, k)
+        boxes = box_coder(anc[idx], var[idx], bd[idx],
+                          code_type="decode_center_size", box_normalized=False)
+        img_h, img_w = info[0], info[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, img_w - 1), jnp.clip(boxes[:, 1], 0, img_h - 1),
+            jnp.clip(boxes[:, 2], 0, img_w - 1), jnp.clip(boxes[:, 3], 0, img_h - 1),
+        ], axis=1)
+        ms = min_size * info[2]
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1) >= ms) & ((boxes[:, 3] - boxes[:, 1] + 1) >= ms)
+        top_sc = jnp.where(keep, top_sc, -jnp.inf)
+        bx, bs, valid = nms(boxes, top_sc, post_nms_top_n, nms_thresh, -jnp.inf)
+        return bx, bs, valid
+
+    return jax.vmap(per_image)(scores, bbox_deltas, im_info)
+
+
+def rpn_target_assign(anchors, gt_boxes, gt_valid, im_info,
+                      rpn_batch_size_per_im: int = 256,
+                      rpn_straddle_thresh: float = 0.0,
+                      rpn_fg_fraction: float = 0.5,
+                      rpn_positive_overlap: float = 0.7,
+                      rpn_negative_overlap: float = 0.3,
+                      rng_key=None):
+    """RPN training targets (rpn_target_assign_op.cc), static-shape
+    design: instead of gathered index lists, returns per-anchor
+    (labels [N,A] ∈ {1 fg, 0 bg, −1 ignore}, bbox_targets [N,A,4],
+    fg_mask, bg_mask) with random subsampling to the reference's batch
+    size/fraction. anchors [A,4]; gt_boxes [N,G,4] padded with
+    gt_valid [N,G] mask; im_info [N,3]."""
+    from ..framework import next_rng_key
+
+    key = rng_key if rng_key is not None else next_rng_key()
+    a = anchors.shape[0]
+
+    def per_image(gt, gtv, info, k):
+        inside = ((anchors[:, 0] >= -rpn_straddle_thresh)
+                  & (anchors[:, 1] >= -rpn_straddle_thresh)
+                  & (anchors[:, 2] < info[1] + rpn_straddle_thresh)
+                  & (anchors[:, 3] < info[0] + rpn_straddle_thresh))
+        iou = iou_similarity(anchors, gt)                            # [A,G]
+        iou = jnp.where(gtv[None, :], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        # anchors matching each gt's best iou are fg too
+        gt_best = jnp.max(jnp.where(inside[:, None], iou, -1.0), axis=0)  # [G]
+        is_gt_best = jnp.any((iou >= gt_best[None, :] - 1e-6) & (gt_best[None, :] > 0)
+                             & gtv[None, :], axis=1)
+        fg = inside & ((best_iou >= rpn_positive_overlap) | is_gt_best)
+        bg = inside & ~fg & (best_iou < rpn_negative_overlap)
+        # subsample: keep ≤ fg_cap fgs, fill rest with bgs
+        fg_cap = int(rpn_batch_size_per_im * rpn_fg_fraction)
+        r = jax.random.uniform(k, (a,))
+        fg_rank = jnp.argsort(jnp.argsort(jnp.where(fg, r, 2.0)))    # random rank among fg
+        fg_keep = fg & (fg_rank < fg_cap)
+        n_fg = jnp.sum(fg_keep)
+        bg_cap = rpn_batch_size_per_im - n_fg
+        bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, r, 2.0)))
+        bg_keep = bg & (bg_rank < bg_cap)
+        labels = jnp.where(fg_keep, 1, jnp.where(bg_keep, 0, -1))
+        tgt = box_coder(anchors, None, gt[best_gt],
+                        code_type="encode_center_size", box_normalized=False)
+        return labels, tgt, fg_keep, bg_keep
+
+    keys = jax.random.split(key, gt_boxes.shape[0])
+    return jax.vmap(per_image)(gt_boxes, gt_valid, im_info, keys)
+
+
+def generate_proposal_labels(rois, rois_valid, gt_classes, gt_boxes, gt_valid,
+                             batch_size_per_im: int = 512,
+                             fg_fraction: float = 0.25,
+                             fg_thresh: float = 0.5,
+                             bg_thresh_hi: float = 0.5,
+                             bg_thresh_lo: float = 0.0,
+                             class_nums: int = 81,
+                             rng_key=None):
+    """Fast-RCNN head sampling (generate_proposal_labels_op.cc),
+    static-shape: labels per roi (class id, 0 = background, −1 =
+    unsampled), bbox targets vs matched gt, and fg/sample masks.
+    rois [N,R,4] + rois_valid [N,R]; gt_* padded with gt_valid."""
+    from ..framework import next_rng_key
+
+    key = rng_key if rng_key is not None else next_rng_key()
+    r = rois.shape[1]
+
+    def per_image(roi, rv, gcls, gbox, gv, k):
+        iou = iou_similarity(roi, gbox)
+        iou = jnp.where(gv[None, :] & rv[:, None], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        fg = rv & (best_iou >= fg_thresh)
+        bg = rv & (best_iou < bg_thresh_hi) & (best_iou >= bg_thresh_lo)
+        fg_cap = int(batch_size_per_im * fg_fraction)
+        rnd = jax.random.uniform(k, (r,))
+        fg_rank = jnp.argsort(jnp.argsort(jnp.where(fg, rnd, 2.0)))
+        fg_keep = fg & (fg_rank < fg_cap)
+        bg_cap = batch_size_per_im - jnp.sum(fg_keep)
+        bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, rnd, 2.0)))
+        bg_keep = bg & (bg_rank < bg_cap)
+        labels = jnp.where(fg_keep, gcls[best_gt],
+                           jnp.where(bg_keep, 0, -1)).astype(jnp.int32)
+        tgt = box_coder(roi, None, gbox[best_gt],
+                        code_type="encode_center_size", box_normalized=False)
+        tgt = jnp.where(fg_keep[:, None], tgt, 0.0)
+        return labels, tgt, fg_keep, fg_keep | bg_keep
+
+    keys = jax.random.split(key, rois.shape[0])
+    return jax.vmap(per_image)(rois, rois_valid, gt_classes, gt_boxes, gt_valid, keys)
+
+
+def target_assign(x, match_indices, mismatch_value: float = 0.0):
+    """target_assign_op: out[b, p, :] = x[b, match_indices[b,p], :] where
+    matched (index ≥ 0), else mismatch_value; weight 1.0 on matched rows.
+    Returns (out, out_weight)."""
+    b, p = match_indices.shape
+    idx = jnp.maximum(match_indices, 0)
+    gathered = jnp.take_along_axis(x, idx[:, :, None], axis=1)
+    matched = (match_indices >= 0)[:, :, None]
+    out = jnp.where(matched, gathered, mismatch_value)
+    return out, matched.astype(jnp.float32)
+
+
+def polygon_box_transform(input):
+    """EAST geometry restore (detection/polygon_box_transform_op.cc):
+    even channels: out = 4*w_index − in; odd channels: out = 4*h_index −
+    in. input [N, geo_channels, H, W]."""
+    n, g, h, w = input.shape
+    wi = jnp.broadcast_to(jnp.arange(w, dtype=input.dtype)[None, None, None, :], input.shape)
+    hi = jnp.broadcast_to(jnp.arange(h, dtype=input.dtype)[None, None, :, None], input.shape)
+    even = (jnp.arange(g) % 2 == 0)[None, :, None, None]
+    return jnp.where(even, 4.0 * wi - input, 4.0 * hi - input)
+
+
+def roi_perspective_transform(input, rois, rois_batch_idx,
+                              transformed_height: int, transformed_width: int,
+                              spatial_scale: float = 1.0):
+    """Perspective-warp RoI quads to rectangles
+    (detection/roi_perspective_transform_op.cc, EAST/OCR): rois [R,8]
+    quad corners (clockwise x1..y4). Per roi, solve the 8-dof homography
+    output→input and bilinear-sample. Returns [R, C, th, tw]."""
+    n, c, h, w = input.shape
+    quad = rois.astype(jnp.float32).reshape(-1, 4, 2) * spatial_scale
+    tw_, th_ = float(transformed_width - 1), float(transformed_height - 1)
+    dst = jnp.asarray([[0.0, 0.0], [tw_, 0.0], [tw_, th_], [0.0, th_]])
+
+    def homography(src):
+        # solve M (8 params) with dst→src correspondence
+        rows = []
+        rhs = []
+        for i in range(4):
+            X, Y = dst[i, 0], dst[i, 1]
+            x, y = src[i, 0], src[i, 1]
+            rows.append(jnp.stack([X, Y, 1.0, 0.0 * X, 0.0 * X, 0.0 * X, -X * x, -Y * x]))
+            rows.append(jnp.stack([0.0 * X, 0.0 * X, 0.0 * X, X, Y, 1.0, -X * y, -Y * y]))
+            rhs += [x, y]
+        A = jnp.stack(rows)
+        bvec = jnp.stack(rhs)
+        m = jnp.linalg.solve(A, bvec)
+        return jnp.concatenate([m, jnp.ones(1)]).reshape(3, 3)
+
+    mats = jax.vmap(homography)(quad)                                # [R,3,3]
+    gy, gx = jnp.meshgrid(jnp.arange(transformed_height, dtype=jnp.float32),
+                          jnp.arange(transformed_width, dtype=jnp.float32), indexing="ij")
+    ones = jnp.ones_like(gx)
+    grid = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)         # [th*tw, 3]
+
+    feats = input[rois_batch_idx]
+
+    def warp(mat, feat):
+        src = grid @ mat.T                                            # [P,3]
+        sx = src[:, 0] / jnp.maximum(src[:, 2], 1e-8)
+        sy = src[:, 1] / jnp.maximum(src[:, 2], 1e-8)
+        x0 = jnp.clip(jnp.floor(sx), 0, w - 1)
+        y0 = jnp.clip(jnp.floor(sy), 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+        lx = jnp.clip(sx - x0, 0.0, 1.0)
+        ly = jnp.clip(sy - y0, 0.0, 1.0)
+        v = (feat[:, y0i, x0i] * ((1 - ly) * (1 - lx))
+             + feat[:, y0i, x1i] * ((1 - ly) * lx)
+             + feat[:, y1i, x0i] * (ly * (1 - lx))
+             + feat[:, y1i, x1i] * (ly * lx))                         # [C,P]
+        inb = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
+        return jnp.where(inb[None, :], v, 0.0).reshape(c, transformed_height,
+                                                       transformed_width)
+
+    return jax.vmap(warp)(mats, feats).astype(input.dtype)
+
+
+def detection_output(loc, scores, prior_boxes, prior_variances,
+                     background_label: int = 0, nms_threshold: float = 0.45,
+                     nms_top_k: int = 400, keep_top_k: int = 200,
+                     score_threshold: float = 0.01):
+    """SSD output layer (layers/detection.py detection_output =
+    box_coder decode + multiclass_nms): loc [N,P,4] offsets, scores
+    [N,P,C] probabilities, priors [P,4]+[P,4]. Returns padded
+    (out [N, keep_top_k, 6] rows (label, score, x1,y1,x2,y2), valid)."""
+    n, p, cnum = scores.shape
+
+    def per_image(lc, sc):
+        boxes = box_coder(prior_boxes, prior_variances, lc,
+                          code_type="decode_center_size")
+        cls_scores = sc.T                                             # [C,P]
+        cls_scores = cls_scores.at[background_label].set(-jnp.inf)
+        bx, bs, labels, valid = multiclass_nms(
+            boxes, cls_scores, max_per_class=nms_top_k,
+            iou_threshold=nms_threshold, score_threshold=score_threshold)
+        flat_scores = jnp.where(valid, bs, -jnp.inf).reshape(-1)
+        top_sc, idx = jax.lax.top_k(flat_scores, keep_top_k)
+        rows = jnp.concatenate([
+            labels.reshape(-1)[idx][:, None].astype(jnp.float32),
+            top_sc[:, None],
+            bx.reshape(-1, 4)[idx],
+        ], axis=1)
+        return rows, jnp.isfinite(top_sc)
+
+    return jax.vmap(per_image)(loc, scores)
+
+
+def multi_box_head(inputs, image, base_size: int, num_classes: int,
+                   aspect_ratios: Sequence[Sequence[float]],
+                   min_ratio: int = 20, max_ratio: int = 90,
+                   min_sizes=None, max_sizes=None,
+                   steps=None, offset: float = 0.5, flip: bool = True,
+                   clip: bool = False, kernel_size: int = 1, pad: int = 0,
+                   variance=(0.1, 0.1, 0.2, 0.2), name=None):
+    """SSD multi-scale head (layers/detection.py multi_box_head): per
+    feature map, 3×3 convs predict loc (A·4) and conf (A·C) + prior
+    boxes. Returns (mbox_locs [N,total,4], mbox_confs [N,total,C],
+    boxes [total,4], variances [total,4])."""
+    from .nn import conv2d
+
+    nmaps = len(inputs)
+    img_h, img_w = image.shape[2], image.shape[3]
+    if min_sizes is None:
+        # reference ratio schedule: evenly spaced between min/max ratio
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (nmaps - 2)) if nmaps > 2 else 0
+        for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes[:nmaps - 1]
+        max_sizes = [base_size * 0.20] + max_sizes[:nmaps - 1]
+
+    locs, confs, all_boxes, all_vars = [], [], [], []
+    for i, feat in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ars = aspect_ratios[i]
+        boxes, vars_ = prior_box(
+            (feat.shape[2], feat.shape[3]), (img_h, img_w),
+            min_sizes=[mins] if not isinstance(mins, (list, tuple)) else mins,
+            max_sizes=[maxs] if maxs and not isinstance(maxs, (list, tuple)) else (maxs or ()),
+            aspect_ratios=ars, flip=flip, clip=clip,
+            steps=(steps[i] if steps else (0.0, 0.0)),
+            offset=offset, variance=variance)
+        a = boxes.shape[2]
+        loc = conv2d(feat, a * 4, kernel_size, padding=pad, name=f"{name or 'mbox'}_loc{i}")
+        conf = conv2d(feat, a * num_classes, kernel_size, padding=pad,
+                      name=f"{name or 'mbox'}_conf{i}")
+        nb = feat.shape[0]
+        locs.append(loc.transpose(0, 2, 3, 1).reshape(nb, -1, 4))
+        confs.append(conf.transpose(0, 2, 3, 1).reshape(nb, -1, num_classes))
+        all_boxes.append(boxes.reshape(-1, 4))
+        all_vars.append(vars_.reshape(-1, 4))
+    return (jnp.concatenate(locs, axis=1), jnp.concatenate(confs, axis=1),
+            jnp.concatenate(all_boxes, axis=0), jnp.concatenate(all_vars, axis=0))

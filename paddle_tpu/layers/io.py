@@ -1,0 +1,126 @@
+"""Graph-embedded IO surface (python/paddle/fluid/layers/io.py).
+
+The reference embeds the data pipeline in the program (py_reader blocking
+queues, recordio reader ops, shuffle/batch/double-buffer decorator ops —
+operators/reader/*). TPU-native equivalent: the pipeline is host-side
+(data/reader.py combinators + data/feeder.py device prefetch), and these
+functions keep the fluid API names, delegating to it. ``data()`` returns
+a ShapeDtypeStruct placeholder for program tracing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtypes import convert_dtype
+from ..data import reader as _reader
+from ..data.feeder import DataFeeder, DeviceFeeder
+from .. import recordio as _recordio
+
+
+def data(name: str, shape: Sequence[int], dtype="float32", lod_level: int = 0,
+         append_batch_size: bool = True):
+    """fluid.layers.data analog: a typed placeholder (ShapeDtypeStruct)
+    used as an example arg when tracing/compiling a Program. A leading
+    batch dim of 1 stands in for the runtime batch (append_batch_size)."""
+    full = ([1] if append_batch_size else []) + [abs(s) if s != -1 else 1 for s in shape]
+    return jax.ShapeDtypeStruct(tuple(full), convert_dtype(dtype))
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """layers.io.batch = reader-level batching (batch_op analog)."""
+    return _reader.batch(reader, batch_size, drop_last=drop_last)
+
+
+def shuffle(reader, buffer_size: int):
+    """layers.io.shuffle (shuffle_reader op analog)."""
+    return _reader.shuffle(reader, buffer_size)
+
+
+def double_buffer(reader, place=None, name=None):
+    """double_buffer_reader analog: host→device prefetch of one batch
+    ahead. Returns a generator of device arrays."""
+    return DeviceFeeder(reader)
+
+
+def py_reader(capacity: int, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer: bool = True):
+    """create_py_reader analog (lod_tensor_blocking_queue.h): a
+    background-thread feeding queue. Returns a PyReader with
+    decorate_paddle_reader/start/reset, yielding ready device batches."""
+    return PyReader(capacity, use_double_buffer=use_double_buffer)
+
+
+class PyReader:
+    """Python-fed async reader (reader/create_py_reader_op.cc capability):
+    a bounded queue filled by a background thread, drained by the train
+    loop — the host-side overlap the reference got from the blocking
+    queue + double_buffer ops."""
+
+    def __init__(self, capacity: int, use_double_buffer: bool = True):
+        self.capacity = capacity
+        self.use_double_buffer = use_double_buffer
+        self._reader = None
+
+    def decorate_paddle_reader(self, reader):
+        self._reader = reader
+
+    decorate_sample_list_generator = decorate_paddle_reader
+    decorate_batch_generator = decorate_paddle_reader
+
+    def start(self):
+        r = _reader.buffered(self._reader, self.capacity)
+        if self.use_double_buffer:
+            return DeviceFeeder(r)
+        return r()
+
+    def __call__(self):
+        return self.start()
+
+    def reset(self):
+        pass
+
+
+def open_files(filenames: Sequence[str], shapes=None, dtypes=None, lod_levels=None,
+               thread_num: int = 1, buffer_size: Optional[int] = None):
+    """open_files/open_recordio_file analog: a reader over recordio
+    shards, round-robin by file, decoded to numpy tuples."""
+    def _r():
+        for fn in filenames:
+            for rec in _recordio.reader_creator(fn)():
+                yield rec
+    if buffer_size:
+        return _reader.buffered(_r, buffer_size)
+    return _r
+
+
+def read_file(reader):
+    """read_file op analog: pull one batch from a started reader."""
+    it = reader() if callable(reader) else iter(reader)
+    return next(it)
+
+
+def random_data_generator(low: float, high: float, shapes, lod_levels=None, name=None):
+    """create_random_data_generator_op analog — the synthetic in-graph
+    data source the reference uses widely in tests/benchmarks."""
+    rng = np.random.RandomState(0)
+
+    def _r():
+        while True:
+            yield tuple(rng.uniform(low, high, s).astype(np.float32) for s in shapes)
+    return _r
+
+
+class Preprocessor:
+    """reader/create_custom_reader_op analog: attach a per-sample
+    transform to a reader: ``Preprocessor(reader)(fn)``."""
+
+    def __init__(self, reader, name=None):
+        self.reader = reader
+
+    def __call__(self, fn):
+        return _reader.map_readers(fn, self.reader)

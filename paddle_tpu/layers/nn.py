@@ -1036,3 +1036,238 @@ def hsigmoid(
     bce = jax.nn.softplus(t) - code * t            # BCE-with-logits vs code bit
     loss = jnp.sum(jnp.where(valid, bce, 0.0), axis=1)
     return loss[:, None]
+
+
+# ---------------------------------------------------------------------------
+# Vision / misc ops (affine_channel_op.cc, affine_grid_op.cc, crop_op.cc,
+# dice_loss / mean_iou_op.cc, hash_op.cc, add_position_encoding_op.cc,
+# multiplex_op.cc, pool3d, conv3d_transpose, im2sequence_op.cc,
+# row_conv_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def affine_channel(x, scale=None, bias=None, data_layout: str = "NCHW", name=None):
+    """Per-channel affine: out = scale*x + bias (affine_channel_op.cc).
+    Used to freeze BN for detection fine-tuning."""
+    c_axis = 1 if data_layout == "NCHW" else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[c_axis] = x.shape[c_axis]
+    out = x
+    if scale is not None:
+        out = out * scale.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def affine_grid(theta, out_shape, name=None):
+    """2D affine sampling grid (affine_grid_op.cc): theta [N,2,3] →
+    grid [N,H,W,2] of (x,y) source coords in [-1,1], consumable by
+    grid_sampler."""
+    n, _, h, w = out_shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3)     # [1,HW,3]
+    grid = jnp.einsum("bhk,bok->bho", jnp.broadcast_to(base, (n, h * w, 3)),
+                      theta.astype(base.dtype))                         # [N,HW,2]
+    return grid.reshape(n, h, w, 2)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Static crop (crop_op.cc): slice ``shape`` out of x starting at
+    ``offsets`` (defaults to 0s). ``shape`` may be an array exemplar whose
+    .shape is used."""
+    tgt = list(shape.shape) if hasattr(shape, "shape") else list(shape)
+    offs = list(offsets) if offsets is not None else [0] * x.ndim
+    return jax.lax.slice(x, offs, [o + s for o, s in zip(offs, tgt)])
+
+
+def random_crop(x, shape, seed=None, name=None):
+    """Random crop over trailing dims (random_crop_op.cc). ``shape``
+    covers the last len(shape) dims; leading dims are kept whole."""
+    key = jax.random.PRNGKey(seed) if seed is not None else next_rng_key()
+    nlead = x.ndim - len(shape)
+    lead = x.shape[:nlead]
+    maxs = jnp.array([x.shape[nlead + i] - s for i, s in enumerate(shape)])
+    offs = jnp.floor(jax.random.uniform(key, (len(shape),)) * (maxs + 1)).astype(jnp.int32)
+    starts = [jnp.int32(0)] * nlead + [offs[i] for i in range(len(shape))]
+    return jax.lax.dynamic_slice(x, starts, list(lead) + list(shape))
+
+
+def dice_loss(input, label, epsilon: float = 1e-5):
+    """Dice coefficient loss (layers/nn.py dice_loss): label is int class
+    ids with trailing dim 1; one-hot to input's last dim."""
+    lab = jnp.squeeze(jnp.asarray(label), axis=-1)
+    oh = jax.nn.one_hot(lab, input.shape[-1], dtype=input.dtype)
+    red = tuple(range(1, input.ndim))
+    inse = jnp.sum(input * oh, axis=red)
+    denom = jnp.sum(input, axis=red) + jnp.sum(oh, axis=red)
+    return jnp.mean(1.0 - 2.0 * inse / (denom + epsilon))
+
+
+def mean_iou(input, label, num_classes: int):
+    """Mean Intersection-over-Union metric (mean_iou_op.cc). input/label:
+    int class maps of equal shape. Returns (mean_iou, out_wrong,
+    out_correct) like the reference."""
+    pred = jnp.asarray(input).reshape(-1).astype(jnp.int32)
+    lab = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    correct_mask = (pred == lab).astype(jnp.int32)
+    # O(N) scatter-add histograms — segmentation maps are large
+    pred_cnt = jnp.zeros(num_classes, jnp.int32).at[pred].add(1)
+    lab_cnt = jnp.zeros(num_classes, jnp.int32).at[lab].add(1)
+    correct = jnp.zeros(num_classes, jnp.int32).at[lab].add(correct_mask)
+    union = pred_cnt + lab_cnt - correct
+    valid = union > 0
+    iou = jnp.where(valid, correct / jnp.maximum(union, 1), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    wrong = (lab_cnt - correct).astype(jnp.int32)
+    return miou, wrong, correct.astype(jnp.int32)
+
+
+def hash(input, hash_size: int, num_hash: int = 1, name=None):  # noqa: A001
+    """Row-wise integer hashing (hash_op.cc): each row of int ids is
+    hashed by ``num_hash`` seeded mix functions into [0, hash_size).
+    Output [N, num_hash]. Deterministic murmur3-style uint32 mixing
+    replaces xxhash — same capability (feature hashing for simnet/CTR);
+    32-bit so it works without jax x64 mode."""
+    x = jnp.asarray(input).astype(jnp.uint32).reshape(input.shape[0], -1)
+
+    def _mix(h):
+        h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
+        h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
+        return h ^ (h >> 16)
+
+    outs = []
+    for seed in range(num_hash):
+        h = jnp.full((x.shape[0],), jnp.uint32((seed * 0x9E3779B9 + 1) & 0xFFFFFFFF))
+        for j in range(x.shape[1]):
+            h = _mix(h ^ x[:, j])
+        outs.append((h % jnp.uint32(hash_size)).astype(jnp.int32))
+    return jnp.stack(outs, axis=1)
+
+
+def add_position_encoding(input, alpha: float = 1.0, beta: float = 1.0, name=None):
+    """out = alpha*x + beta*sinusoid_pos_enc (add_position_encoding_op.cc).
+    input: [B, T, D]."""
+    b, t, d = input.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    half = d // 2
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos / div[None, :]
+    pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)          # [T, D]
+    return alpha * input + beta * pe[None].astype(input.dtype)
+
+
+def multiplex(inputs: Sequence[jax.Array], index, name=None):
+    """Row-wise select across candidate tensors (multiplex_op.cc):
+    out[i] = inputs[index[i]][i]."""
+    stacked = jnp.stack(inputs, axis=0)                                  # [K, N, ...]
+    idx = jnp.asarray(index).reshape(-1).astype(jnp.int32)               # [N]
+    return jnp.take_along_axis(
+        stacked, idx[None, :].reshape((1, -1) + (1,) * (stacked.ndim - 2)), axis=0
+    )[0]
+
+
+def pool3d(input, pool_size=2, pool_type: str = "max", pool_stride=1,
+           pool_padding=0, global_pooling: bool = False, ceil_mode: bool = False,
+           name=None):
+    """3D pooling over NCDHW (pool3d analog of pool2d)."""
+    ks = (pool_size,) * 3 if isinstance(pool_size, int) else tuple(pool_size)
+    st = (pool_stride,) * 3 if isinstance(pool_stride, int) else tuple(pool_stride)
+    pd = (pool_padding,) * 3 if isinstance(pool_padding, int) else tuple(pool_padding)
+    if global_pooling:
+        ks = input.shape[2:]
+        st = (1, 1, 1)
+        pd = (0, 0, 0)
+    dims = (1, 1) + ks
+    strides = (1, 1) + st
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+    if pool_type == "max":
+        return jax.lax.reduce_window(input, -jnp.inf, jax.lax.max, dims, strides, pads)
+    s = jax.lax.reduce_window(input, 0.0, jax.lax.add, dims, strides, pads)
+    cnt = jax.lax.reduce_window(jnp.ones_like(input), 0.0, jax.lax.add, dims, strides, pads)
+    return s / cnt
+
+
+def conv3d_transpose(input, num_filters: int, filter_size, stride=1, padding=0,
+                     dilation=1, groups: int = 1, param_attr=None, bias_attr=None,
+                     act=None, name=None):
+    """Transposed 3D convolution over NCDHW (conv3d_transpose analog)."""
+    helper = LayerHelper("conv3d_transpose", name=name)
+    ks = (filter_size,) * 3 if isinstance(filter_size, int) else tuple(filter_size)
+    st = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    dl = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+    cin = input.shape[1]
+    enforce(groups == 1, "conv3d_transpose: groups>1 not supported")
+    w = helper.create_parameter("w", (cin, num_filters) + ks, input.dtype, attr=param_attr)
+    pads = tuple((dl[i] * (ks[i] - 1) - pd[i], dl[i] * (ks[i] - 1) - pd[i]) for i in range(3))
+    out = jax.lax.conv_general_dilated(
+        input, jnp.flip(w, axis=(2, 3, 4)).swapaxes(0, 1), (1, 1, 1), pads,
+        lhs_dilation=st, rhs_dilation=dl,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    if bias_attr is not False:
+        b = helper.create_parameter("b", (num_filters,), input.dtype, attr=bias_attr,
+                                    initializer=init.Constant(0.0))
+        out = out + b.reshape(1, -1, 1, 1, 1)
+    return apply_activation(out, act)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    """Extract image patches as a packed sequence (im2sequence_op.cc):
+    NCHW → (values [N*oh*ow, kh*kw*C], lengths [N] all equal oh*ow).
+    The per-image patch count is the LoD; here it's the lengths vector."""
+    kh, kw = _pair(filter_size)
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    n, c, h, w = input.shape
+    cols = unfold(input, (kh, kw), (sh, sw), (ph, pw))                   # [N, C*kh*kw, L]
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    # reference row layout: per output position, kh*kw*C values ordered
+    # channel-major (C, kh, kw)
+    vals = jnp.transpose(cols, (0, 2, 1)).reshape(n * oh * ow, c * kh * kw)
+    lengths = jnp.full((n,), oh * ow, dtype=jnp.int32)
+    return vals, lengths
+
+
+def row_conv(input, future_context_size: int, lengths=None, param_attr=None, name=None):
+    """Lookahead row convolution (row_conv_op.cc, DeepSpeech2):
+    out[t] = Σ_{i=0..k} w[i] ⊙ x[t+i], per sequence. input: [B, T, D]
+    padded; ``lengths`` masks tail positions so context never crosses a
+    sequence end."""
+    helper = LayerHelper("row_conv", name=name)
+    b, t, d = input.shape
+    k = future_context_size
+    w = helper.create_parameter("w", (k + 1, d), input.dtype, attr=param_attr)
+    x = input
+    if lengths is not None:
+        mask = (jnp.arange(t)[None, :] < jnp.asarray(lengths)[:, None]).astype(input.dtype)
+        x = x * mask[:, :, None]
+    xp = jnp.pad(x, ((0, 0), (0, k), (0, 0)))
+    out = jnp.zeros_like(input)
+    for i in range(k + 1):
+        out = out + xp[:, i:i + t, :] * w[i]
+    return out
+
+
+def image_resize_short(input, out_short_len: int, resample: str = "BILINEAR"):
+    """Resize so the short side equals out_short_len, keeping aspect
+    ratio (layers/nn.py image_resize_short)."""
+    n, c, h, w = input.shape
+    short = min(h, w)
+    oh = int(round(h * out_short_len / short))
+    ow = int(round(w * out_short_len / short))
+    return image_resize(input, (oh, ow), resample=resample)
+
+
+def gaussian_random_batch_size_like(input, shape, mean: float = 0.0, std: float = 1.0,
+                                    input_dim_idx: int = 0, output_dim_idx: int = 0,
+                                    dtype="float32", name=None):
+    """Gaussian noise whose output_dim_idx dim copies input's
+    input_dim_idx dim (gaussian_random_batch_size_like_op.cc)."""
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = input.shape[input_dim_idx]
+    return mean + std * jax.random.normal(next_rng_key(), tuple(out_shape)).astype(dtype)

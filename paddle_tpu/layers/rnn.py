@@ -171,3 +171,106 @@ def rnn(cell_fn, inputs, initial_state, sequence_length: Optional[jax.Array] = N
 
     last_state, outs = jax.lax.scan(step, initial_state, (xs, steps))
     return jnp.swapaxes(outs, 0, 1), last_state
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias: float = 0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """Single LSTM step with its own weights (lstm_unit_op.cc +
+    layers/nn.py lstm_unit): concat(x, h) × [d+h, 4h] GEMM. Returns
+    (hidden_t, cell_t)."""
+    helper = LayerHelper("lstm_unit", name=name)
+    d = x_t.shape[-1]
+    size = hidden_t_prev.shape[-1]
+    w = helper.create_parameter("w", (d + size, 4 * size), jnp.float32,
+                                attr=param_attr, initializer=init.Xavier())
+    b = helper.create_parameter("b", (4 * size,), jnp.float32, attr=bias_attr,
+                                initializer=init.Constant(0.0))
+    x_t, hidden_t_prev, cell_t_prev, w = cast_compute(x_t, hidden_t_prev, cell_t_prev, w)
+    x_proj = jnp.matmul(x_t, w[:d]) + b.astype(x_t.dtype)
+    return lstm_cell_step(x_proj, hidden_t_prev, cell_t_prev, w[d:], forget_bias)
+
+
+def gru_unit(input, hidden, size: int, param_attr=None, bias_attr=None,
+             activation: str = "tanh", gate_activation: str = "sigmoid", name=None):
+    """Single GRU step (gru_unit_op.cc; fluid passes size = 3×dim).
+    Returns (new_hidden, reset_hidden_pre, gate) like the reference."""
+    from ..core.errors import enforce
+    enforce(activation == "tanh" and gate_activation == "sigmoid",
+            "gru_unit: only tanh/sigmoid activations (reference defaults) supported")
+    dim = size // 3
+    helper = LayerHelper("gru_unit", name=name)
+    w_h = helper.create_parameter("w_h", (dim, 3 * dim), jnp.float32,
+                                  attr=param_attr, initializer=init.Xavier())
+    b = helper.create_parameter("b", (3 * dim,), jnp.float32, attr=bias_attr,
+                                initializer=init.Constant(0.0))
+    input, hidden, w_h = cast_compute(input, hidden, w_h)
+    xp = input + b.astype(input.dtype)
+    zr_x, c_x = xp[..., :2 * dim], xp[..., 2 * dim:]
+    zr = jax.nn.sigmoid(zr_x + jnp.matmul(hidden, w_h[:, :2 * dim]))
+    z, r = jnp.split(zr, 2, axis=-1)
+    reset_hidden_pre = r * hidden
+    c = jnp.tanh(c_x + jnp.matmul(reset_hidden_pre, w_h[:, 2 * dim:]))
+    new_hidden = (1 - z) * hidden + z * c
+    gate = jnp.concatenate([z, r, c], axis=-1)
+    return new_hidden, reset_hidden_pre, gate
+
+
+def dynamic_lstmp(input, size: int, proj_size: int,
+                  sequence_length: Optional[jax.Array] = None,
+                  is_reverse: bool = False, forget_bias: float = 0.0,
+                  proj_clip: Optional[float] = None, cell_clip: Optional[float] = None,
+                  param_attr=None, bias_attr=None, name=None):
+    """LSTM with recurrent projection (lstmp_op.cc): the recurrent state
+    fed back into the gates is r = proj(h) [proj_size], shrinking the
+    recurrent GEMM — the LSTMP of Sak et al. that the reference ships for
+    large-vocab acoustic models. Returns (projected outputs
+    [b, t, proj_size], (r_last, c_last))."""
+    helper = LayerHelper("lstmp", name=name)
+    b, t, d = input.shape
+    w_x = helper.create_parameter("w_x", (d, 4 * size), jnp.float32, attr=param_attr,
+                                  initializer=init.Xavier())
+    w_r = helper.create_parameter("w_r", (proj_size, 4 * size), jnp.float32,
+                                  attr=param_attr, initializer=init.Xavier())
+    w_p = helper.create_parameter("w_p", (size, proj_size), jnp.float32,
+                                  attr=param_attr, initializer=init.Xavier())
+    bias = helper.create_parameter("b", (4 * size,), jnp.float32, attr=bias_attr,
+                                   initializer=init.Constant(0.0))
+    input, w_x, w_r, w_p = cast_compute(input, w_x, w_r, w_p)
+    dtype = input.dtype
+    x_proj = jnp.matmul(input.reshape(b * t, d), w_x).reshape(b, t, 4 * size) \
+        + bias.astype(dtype)
+    x_proj_t = jnp.swapaxes(x_proj, 0, 1)
+    steps = jnp.arange(t)
+    if is_reverse:
+        x_proj_t = x_proj_t[::-1]
+        steps = steps[::-1]
+
+    def step(carry, inp):
+        r, c = carry
+        xp, idx = inp
+        gates = xp + jnp.matmul(r, w_r)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f + forget_bias)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        if cell_clip is not None:
+            c_new = jnp.clip(c_new, -cell_clip, cell_clip)
+        h_new = o * jnp.tanh(c_new)
+        r_new = jnp.matmul(h_new, w_p)
+        if proj_clip is not None:
+            r_new = jnp.clip(r_new, -proj_clip, proj_clip)
+        if sequence_length is not None:
+            valid = (idx < sequence_length)[:, None]
+            r_new = jnp.where(valid, r_new, r)
+            c_new = jnp.where(valid, c_new, c)
+        return (r_new, c_new), r_new
+
+    r0 = jnp.zeros((b, proj_size), dtype)
+    c0 = jnp.zeros((b, size), dtype)
+    (r_last, c_last), outs = jax.lax.scan(step, (r0, c0), (x_proj_t, steps))
+    outs = jnp.swapaxes(outs, 0, 1)
+    if is_reverse:
+        outs = outs[:, ::-1]
+    return outs, (r_last, c_last)

@@ -211,3 +211,84 @@ def sequence_slice(packed, segment_ids, num_seqs: int, offset, length,
     src = first[out_seg_c] + offset[out_seg_c] + within
     src = jnp.clip(src, 0, packed.shape[0] - 1)
     return packed[src], jnp.where(out_seg < num_seqs, out_seg, num_seqs).astype(jnp.int32)
+
+
+def sequence_conv(packed, segment_ids, num_filters: int, filter_size: int = 3,
+                  filter_stride: int = 1, padding=None, param_attr=None,
+                  bias_attr=None, act=None, name=None):
+    """Sequence (time) convolution on packed values + segment-ids
+    (sequence_conv_op.cc; layers/nn.py:1349 sets context_start =
+    -filter_size//2). Each output row t sees rows
+    [t+context_start, t+context_start+filter_size) of its own sequence;
+    positions crossing a boundary contribute zero — the im2col-over-time
+    the reference does per LoD span, here as one shifted-matmul per tap
+    so the MXU sees filter_size big GEMMs."""
+    from ..framework import LayerHelper, cast_compute
+    from .. import initializer as init
+    from .ops import apply_activation
+
+    enforce(filter_stride == 1, "sequence_conv: only stride 1 (reference semantics)")
+    helper = LayerHelper("sequence_conv", name=name)
+    total, d = packed.shape
+    context_start = -(filter_size // 2)
+    w = helper.create_parameter("w", (filter_size * d, num_filters), jnp.float32,
+                                attr=param_attr, initializer=init.Xavier())
+    x, w = cast_compute(packed, w)
+    out = jnp.zeros((total, num_filters), x.dtype)
+    pos = jnp.arange(total)
+    for tap in range(filter_size):
+        off = context_start + tap
+        src = jnp.clip(pos + off, 0, total - 1)
+        valid = ((pos + off >= 0) & (pos + off < total)
+                 & (segment_ids[src] == segment_ids))[:, None]
+        shifted = jnp.where(valid, x[src], 0.0)
+        out = out + jnp.matmul(shifted, w[tap * d:(tap + 1) * d])
+    if bias_attr is not False:
+        b = helper.create_parameter("b", (num_filters,), jnp.float32, attr=bias_attr,
+                                    initializer=init.Constant(0.0))
+        out = out + b.astype(out.dtype)
+    return apply_activation(out, act)
+
+
+def sequence_expand_as(x, ref_lengths, axis_total: int):
+    """sequence_expand_as_op analog: row i of x is repeated
+    ref_lengths[i] times (each input sequence must have exactly one row —
+    the common fluid usage). Same lowering as sequence_expand."""
+    return sequence_expand(x, ref_lengths, axis_total)
+
+
+def sequence_reshape(packed, lengths, new_dim: int):
+    """sequence_reshape_op analog: refold each sequence's flat payload to
+    width new_dim. lengths scale by old_dim/new_dim. Returns
+    (packed2, lengths2)."""
+    total, d = packed.shape
+    enforce(total * d % new_dim == 0, "sequence_reshape: size not divisible")
+    out = packed.reshape(total * d // new_dim, new_dim)
+    new_lengths = (jnp.asarray(lengths) * d) // new_dim
+    return out, new_lengths
+
+
+def sequence_scatter(x, ids, ids_segment_ids, updates):
+    """sequence_scatter_op analog: for packed (ids, updates) with
+    segment-ids mapping each entry to a row of x:
+    out[seg[j], ids[j]] += updates[j]."""
+    seg = jnp.asarray(ids_segment_ids).astype(jnp.int32)
+    idx = jnp.asarray(ids).reshape(-1).astype(jnp.int32)
+    return x.at[seg, idx].add(updates.astype(x.dtype))
+
+
+def lod_reset(x, target_lengths, capacity: Optional[int] = None):
+    """lod_reset_op analog: keep values, re-segment. Returns
+    (x, segment_ids) built from target_lengths over x's row capacity."""
+    cap = capacity if capacity is not None else x.shape[0]
+    return x, lengths_to_segment_ids(jnp.asarray(target_lengths), cap)
+
+
+def reorder_lod_tensor_by_rank(padded, lengths):
+    """reorder_lod_tensor_by_rank_op + lod_rank_table analog: permute the
+    batch into descending-length order. Returns (padded', lengths', perm);
+    invert with jnp.argsort(perm) — the reorder_lod_tensor_by_rank(X,
+    RankTable) inverse the reference builds for restoring order."""
+    lengths = jnp.asarray(lengths)
+    perm = jnp.argsort(-lengths, stable=True)
+    return padded[perm], lengths[perm], perm

@@ -274,3 +274,48 @@ def flatten(x, axis: int = 1, name=None):
     import numpy as _np
     lead = int(_np.prod(x.shape[:axis])) if axis > 0 else 1
     return jnp.reshape(x, (lead, -1))
+
+
+def create_tensor(dtype="float32", name=None, persistable: bool = False):
+    """create_tensor analog (layers/tensor.py): a named scalar/empty slot.
+    In the traced world this is a 0-size placeholder array; use
+    create_global_var for persistable state."""
+    return jnp.zeros((1,), convert_dtype(dtype))
+
+
+def create_global_var(shape, value, dtype="float32", persistable: bool = False,
+                      force_cpu: bool = False, name=None):
+    """create_global_var analog: a named persistable state variable
+    initialized to ``value`` (lives in Program state, checkpointed)."""
+    from ..framework import LayerHelper
+    from .. import initializer as init
+
+    helper = LayerHelper("global_var", name=name)
+    return helper.create_variable("value", tuple(shape), convert_dtype(dtype),
+                                  initializer=init.Constant(float(value)))
+
+
+def sums(input, out=None, name=None):
+    """sum_op over a list of tensors (layers/tensor.py sums)."""
+    total = input[0]
+    for x in input[1:]:
+        total = total + x
+    if out is not None:
+        total = total + out * 0  # reference accumulates into out's slot
+    return total
+
+
+def autoincreased_step_counter(counter_name=None, begin: int = 1, step: int = 1):
+    """@LR_DECAY_COUNTER@ analog (layers/nn.py autoincreased_step_counter):
+    persistable int64 counter incremented once per apply(). Returns the
+    pre-increment value + step (matching the reference, whose increment op
+    runs before consumers)."""
+    from ..framework import LayerHelper
+    from .. import initializer as init
+
+    helper = LayerHelper("step_counter", name=counter_name or "step_counter")
+    cnt = helper.create_variable("value", (1,), jnp.int64,
+                                 initializer=init.Constant(float(begin - step)))
+    new = cnt + jnp.int64(step)
+    helper.assign_variable("value", new)
+    return new

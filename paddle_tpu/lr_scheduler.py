@@ -105,3 +105,21 @@ def linear_lr_warmup(learning_rate, warmup_steps: int, start_lr: float, end_lr: 
         warm = start_lr + (end_lr - start_lr) * (s / max(warmup_steps, 1))
         return jnp.where(s < warmup_steps, warm, base(step))
     return sched
+
+
+def append_LARS(params_grads, learning_rate, weight_decay: float = 0.0,
+                epsilon: float = 1e-9):
+    """Layer-wise Adaptive Rate Scaling helper
+    (learning_rate_scheduler.py append_LARS): per-param lr =
+    lr * ||param|| / (||grad|| + weight_decay*||param||). Returns the list
+    of per-parameter scaled learning rates (the reference rewrites each
+    optimizer op's LR input; functionally the LarsMomentum optimizer is the
+    first-class path)."""
+    import jax.numpy as jnp
+
+    out = []
+    for p, g in params_grads:
+        pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+        gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+        out.append(learning_rate * pn / (gn + weight_decay * pn + epsilon))
+    return out

@@ -234,3 +234,33 @@ def chunk_eval(hyp_chunks, ref_chunks):
     r = tp / nr if nr else 0.0
     f1 = 2 * p * r / (p + r) if p + r else 0.0
     return p, r, f1
+
+
+def auc(input, label, curve: str = "ROC", num_thresholds: int = 200, name=None):
+    """In-graph streaming AUC (layers.auc / auc_op.cc): persistable tp/fp
+    histograms (auc_stat buckets) accumulated across steps in Program
+    state, integrated with the same (0,0)-anchored ROC sweep as
+    Auc.eval. ``input`` [B, 2] two-class probabilities (reference
+    contract); returns (auc_value, batch_auc_value)."""
+    from .framework import LayerHelper
+    from . import initializer as init
+
+    helper = LayerHelper("auc", name=name)
+    tp_b, fp_b = auc_stat(input[:, 1], jnp.asarray(label), num_thresholds)
+
+    def _auc(tp_hist, fp_hist):
+        # cumulative from the highest threshold down = ROC sweep,
+        # anchored at (0,0) so the final segment is included
+        tp_c = jnp.cumsum(tp_hist[::-1]).astype(jnp.float32)
+        fp_c = jnp.cumsum(fp_hist[::-1]).astype(jnp.float32)
+        tpr = jnp.concatenate([jnp.zeros(1), tp_c]) / jnp.maximum(tp_c[-1], 1e-8)
+        fpr = jnp.concatenate([jnp.zeros(1), fp_c]) / jnp.maximum(fp_c[-1], 1e-8)
+        return jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) / 2.0)
+
+    stats = {}
+    for nm, batch in (("tp", tp_b), ("fp", fp_b)):
+        acc = helper.create_variable(nm, (num_thresholds,), jnp.int32,
+                                     initializer=init.Constant(0.0))
+        stats[nm] = acc + batch
+        helper.assign_variable(nm, stats[nm])
+    return _auc(stats["tp"], stats["fp"]), _auc(tp_b, fp_b)

@@ -1,8 +1,9 @@
 """Model zoo mirroring the reference's book/benchmark configs
 (BASELINE.json: MNIST MLP, ResNet-50, Transformer-base, DeepFM,
-BERT-base; plus VGG/LSTM from benchmark/fluid/models/)."""
+BERT-base; plus VGG/AlexNet/GoogLeNet/LSTM from benchmark/fluid/models/
+and the recommender_system / label_semantic_roles book chapters)."""
 
 from . import bert, convnets, deepfm, lstm, mnist, recommender, resnet, seq2seq, srl, transformer, vgg, word2vec
 
-__all__ = ["bert", "convnets", "deepfm", "lstm", "mnist", "resnet", "seq2seq",
-           "transformer", "vgg", "word2vec"]
+__all__ = ["bert", "convnets", "deepfm", "lstm", "mnist", "recommender", "resnet",
+           "seq2seq", "srl", "transformer", "vgg", "word2vec"]
