@@ -9,20 +9,34 @@ profiling, quantization, RecordIO data format (C++ core), beam-search
 decoding, and a StableHLO inference/export path.
 """
 
-from . import clip, core, data, debugger, evaluator, framework, initializer
+from . import backward, clip, core, data, debugger, evaluator, framework, initializer
 from . import io, layers, lr_scheduler, metrics, models, nets, optimizer
-from . import parallel, quantize, regularizer, sparse
+from . import parallel, quantize, regularizer, sparse, transpiler
 from .core import CPUPlace, CUDAPlace, Place, TPUPlace, default_place
 from .executor import CheckpointConfig, Event, Executor, Scope, Trainer, fit
 from .framework import (
     LayerHelper,
     ParamAttr,
     Program,
+    WeightNormParamAttr,
     amp_guard,
     build,
     create_parameter,
     create_variable,
+    default_main_program,
+    default_startup_program,
     name_scope,
+    program_guard,
+)
+from .backward import append_backward, calc_gradient
+from .executor import global_scope, scope_guard
+from .transpiler import (
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+    HashName,
+    RoundRobin,
+    memory_optimize,
+    release_memory,
 )
 from .parallel import DistStrategy, ShardingRules, make_mesh
 

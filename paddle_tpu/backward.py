@@ -1,0 +1,63 @@
+"""Autodiff surface (python/paddle/fluid/backward.py).
+
+The reference's append_backward (backward.py:469) rewrites the program:
+reverse-walks ops, asks each C++ GradOpDescMaker for grad ops, sums
+duplicated outputs, prunes no-grad branches. Under tracing all of that
+is jax.grad; these wrappers keep the (loss, parameter_list) →
+[(param, grad)] API so optimizer-driver code ports directly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from .framework import Program
+
+
+def append_backward(program: Program, loss_name: str = "loss",
+                    parameter_list: Optional[Sequence[str]] = None,
+                    no_grad_set: Optional[set] = None) -> Callable:
+    """Returns grad_fn(params, state, *args) → (loss, [(name, grad)]),
+    the param_grads list the reference returns. ``parameter_list`` /
+    ``no_grad_set`` restrict differentiation like backward.py:469's
+    arguments (stop-gradient pruning = jax's lazy evaluation of unused
+    cotangents)."""
+
+    def grad_fn(params: Dict, state: Dict, *args, **kwargs):
+        names = list(parameter_list or params.keys())
+        if no_grad_set:
+            names = [n for n in names if n not in no_grad_set]
+        wrt = {n: params[n] for n in names}
+        rest = {n: v for n, v in params.items() if n not in wrt}
+
+        def loss_of(wrt_params):
+            out, _ = program.apply({**rest, **wrt_params}, state, *args, **kwargs)
+            loss = out[loss_name] if isinstance(out, dict) else out
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_of)(wrt)
+        return loss, [(n, grads[n]) for n in names]
+
+    return grad_fn
+
+
+def calc_gradient(program: Program, target_name: str,
+                  input_names: Sequence[str]) -> Callable:
+    """backward.py:685 calc_gradient analog: d(target)/d(inputs) for
+    non-parameter inputs. Returns grad_fn(params, state, feed_dict) →
+    dict of gradients keyed by input name."""
+
+    def grad_fn(params: Dict, state: Dict, feed: Dict):
+        wrt = {n: feed[n] for n in input_names}
+        rest = {n: v for n, v in feed.items() if n not in wrt}
+
+        def target_of(wrt_feed):
+            out, _ = program.apply(params, state, **{**rest, **wrt_feed})
+            t = out[target_name] if isinstance(out, dict) else out
+            return t.sum()
+
+        return jax.grad(target_of)(wrt)
+
+    return grad_fn

@@ -86,3 +86,26 @@ def profiler(trace_dir: Optional[str] = None, sorted_key: str = "total") -> Iter
         yield
     finally:
         disable_profiler(sorted_key)
+
+
+def start_profiler(state: str = "All", trace_dir=None):
+    """profiler.py start_profiler analog."""
+    enable_profiler(trace_dir)
+
+
+def stop_profiler(sorted_key: str = "total", profile_path=None):
+    """profiler.py stop_profiler analog — prints the aggregate table."""
+    return disable_profiler(sorted_key=sorted_key)
+
+
+def reset_profiler():
+    """profiler.py reset_profiler analog: drop collected spans."""
+    _events.clear()
+
+
+def cuda_profiler(*args, **kwargs):
+    """profiler.py:39 cuda_profiler (nvprof control) — vendor-profiler
+    control is jax.profiler's trace on TPU; kept as an explicit stub so
+    ported drivers fail loudly rather than silently."""
+    raise NotImplementedError(
+        "cuda_profiler is CUDA-specific; use profiler()/jax.profiler traces")

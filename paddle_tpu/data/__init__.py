@@ -2,9 +2,11 @@
 python/paddle/reader/, python/paddle/dataset/, fluid data_feeder.py,
 operators/reader/*)."""
 
-from . import datasets, feeder, reader
+from . import datasets, feeder, image, reader
 from .feeder import DataFeeder, DeviceFeeder
-from .reader import batch, buffered, cache, chain, compose, firstn, map_readers, shuffle, xmap_readers
+from .reader import (Fake, PipeReader, batch, buffered, cache, chain, compose,
+                     fake, firstn, map_readers, multiprocess_reader, shuffle,
+                     xmap_readers)
 
 __all__ = [
     "datasets", "feeder", "reader",

@@ -276,3 +276,175 @@ def movielens(split: str = "train", num_users: int = 944, num_movies: int = 1683
                    np.array([score], np.float32))
     reader.synthetic = True
     return reader
+
+
+# ---------------------------------------------------------------------------
+# cifar100 / flowers / voc2012 (dataset/{cifar,flowers,voc2012}.py analogs)
+# ---------------------------------------------------------------------------
+
+
+def cifar100(split: str = "train", synthetic_size: int = 1024) -> Callable:
+    """Yields (image[3*32*32] float in [0,1], fine label 0..99)."""
+    def reader():
+        for x, y in _synthetic_classification(
+                synthetic_size, (3 * 32 * 32,), 100, centers_seed=7,
+                noise_seed=20 if split == "train" else 21):
+            yield np.clip(0.25 * x + 0.5, 0.0, 1.0), y
+    reader.synthetic = True
+    return reader
+
+
+def flowers(split: str = "train", synthetic_size: int = 256,
+            image_hw: Tuple[int, int] = (224, 224)) -> Callable:
+    """dataset/flowers.py (102-category Oxford flowers): yields
+    (image [3*h*w] float in [0,1], label 0..101)."""
+    h, w = image_hw
+    def reader():
+        for x, y in _synthetic_classification(
+                synthetic_size, (3 * h * w,), 102, centers_seed=9,
+                noise_seed=30 if split == "train" else 31):
+            yield np.clip(0.25 * x + 0.5, 0.0, 1.0), y
+    reader.synthetic = True
+    return reader
+
+
+def voc2012(split: str = "train", synthetic_size: int = 64,
+            image_hw: Tuple[int, int] = (128, 128), num_classes: int = 21) -> Callable:
+    """dataset/voc2012.py (segmentation): yields (image [3,h,w] float,
+    label mask [h,w] int in [0, 21)). Synthetic masks are class-colored
+    rectangles so a segmentation head actually converges."""
+    h, w = image_hw
+
+    def reader():
+        rng = np.random.RandomState(40 if split == "train" else 41)
+        for _ in range(synthetic_size):
+            cls = rng.randint(1, num_classes)
+            img = rng.rand(3, h, w).astype(np.float32) * 0.2
+            mask = np.zeros((h, w), np.int64)
+            y0, x0 = rng.randint(0, h // 2), rng.randint(0, w // 2)
+            y1, x1 = y0 + h // 3, x0 + w // 3
+            mask[y0:y1, x0:x1] = cls
+            img[:, y0:y1, x0:x1] += cls / num_classes  # signal correlated w/ class
+            yield img, mask
+    reader.synthetic = True
+    return reader
+
+
+# ---------------------------------------------------------------------------
+# imikolov (PTB LM) / sentiment / wmt14 / mq2007
+# ---------------------------------------------------------------------------
+
+
+class _DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+DataType = _DataType
+
+
+def imikolov_build_dict(min_word_freq: int = 50, vocab_size: int = 2073) -> dict:
+    """imikolov.py build_dict analog — synthetic mode returns the id map
+    of the synthetic vocabulary ("w0".."wN", <s>, <e>, <unk>)."""
+    words = {f"w{i}": i for i in range(vocab_size - 2)}
+    words["<s>"] = vocab_size - 2
+    words["<unk>"] = vocab_size - 1
+    return words
+
+
+def imikolov(split: str = "train", word_idx: Optional[dict] = None, n: int = 5,
+             data_type: int = DataType.NGRAM, synthetic_size: int = 4096) -> Callable:
+    """imikolov.py train/test analog (PTB language model): NGRAM mode
+    yields n-tuples of word ids (the word2vec/NPLM input); SEQ mode
+    yields (src_seq, trg_seq) shifted pairs. Synthetic text follows a
+    deterministic first-order Markov chain so an LM has real structure
+    to learn."""
+    vocab = len(word_idx) if word_idx else 2073
+
+    def reader():
+        rng = np.random.RandomState(50 if split == "train" else 51)
+        # sparse Markov transition: each word has 8 likely successors
+        succ = np.random.RandomState(52).randint(0, vocab, (vocab, 8))
+        for _ in range(synthetic_size):
+            length = rng.randint(n, 24)
+            sent = [rng.randint(0, vocab)]
+            for _ in range(length - 1):
+                sent.append(int(succ[sent[-1], rng.randint(0, 8)])
+                            if rng.rand() < 0.9 else rng.randint(0, vocab))
+            if data_type == DataType.NGRAM:
+                if len(sent) >= n:
+                    for i in range(n - 1, len(sent)):
+                        yield tuple(sent[i - n + 1:i + 1])
+            else:
+                yield sent[:-1], sent[1:]
+    reader.synthetic = True
+    return reader
+
+
+def imikolov_train(word_idx=None, n: int = 5, data_type: int = DataType.NGRAM):
+    return imikolov("train", word_idx, n, data_type)
+
+
+def imikolov_test(word_idx=None, n: int = 5, data_type: int = DataType.NGRAM):
+    return imikolov("test", word_idx, n, data_type)
+
+
+def sentiment(split: str = "train", vocab_size: int = 5147, seq_len: int = 100,
+              synthetic_size: int = 1024) -> Callable:
+    """dataset/sentiment.py (NLTK movie reviews): yields
+    (word-id list, label ∈ {0,1}). Synthetic mode plants
+    polarity-correlated token distributions (same scheme as imdb)."""
+    def reader():
+        rng = np.random.RandomState(60 if split == "train" else 61)
+        pos_words = np.arange(0, vocab_size // 2)
+        neg_words = np.arange(vocab_size // 2, vocab_size)
+        for i in range(synthetic_size):
+            y = i % 2
+            base = pos_words if y == 1 else neg_words
+            length = rng.randint(10, seq_len)
+            ids = rng.choice(base, size=length).tolist()
+            # 20% noise from the full vocab
+            for j in range(length // 5):
+                ids[rng.randint(0, length)] = int(rng.randint(0, vocab_size))
+            yield ids, np.int64(y)
+    reader.synthetic = True
+    return reader
+
+
+def wmt14(split: str = "train", dict_size: int = 30000, seq_len: int = 24,
+          synthetic_size: int = 2048) -> Callable:
+    """dataset/wmt14.py analog: yields (src_ids, trg_in_ids, trg_next_ids)
+    — same contract as wmt16 at the wmt14 30K dict size."""
+    reader = wmt16(split, src_vocab=dict_size, trg_vocab=dict_size,
+                   seq_len=seq_len, synthetic_size=synthetic_size)
+    return reader
+
+
+def mq2007(split: str = "train", format: str = "pairwise", n_queries: int = 256,
+           docs_per_query: int = 8, feat_dim: int = 46) -> Callable:
+    """dataset/mq2007.py (LETOR learning-to-rank). Synthetic queries:
+    relevance = quantized linear score of the 46-dim features, so
+    rankers learn a real signal.
+    - pointwise: yields (feature [46], score)
+    - pairwise:  yields (d_high [46], d_low [46]) for every ordered pair
+    - listwise:  yields (label list, feature list) per query
+    """
+    def reader():
+        rng = np.random.RandomState(70 if split == "train" else 71)
+        w = np.random.RandomState(72).randn(feat_dim).astype(np.float32)
+        for _ in range(n_queries):
+            feats = rng.randn(docs_per_query, feat_dim).astype(np.float32)
+            raw = feats @ w
+            labels = np.digitize(raw, np.quantile(raw, [0.5, 0.8])).astype(np.float32)
+            if format == "pointwise":
+                for f, l in zip(feats, labels):
+                    yield f, l
+            elif format == "pairwise":
+                for i in range(docs_per_query):
+                    for j in range(docs_per_query):
+                        if labels[i] > labels[j]:
+                            yield feats[i], feats[j]
+            else:
+                yield labels.tolist(), [f for f in feats]
+    reader.synthetic = True
+    return reader

@@ -197,3 +197,85 @@ def batch(reader: Reader, batch_size: int, drop_last: bool = True) -> Reader:
             yield b
 
     return new_reader
+
+
+class ComposeNotAligned(ValueError):
+    """reader/decorator.py ComposeNotAligned: compose() inputs yielded
+    different lengths."""
+
+
+def fake(reader, n: int = 1):
+    """decorator.py Fake: cache the first sample and replay it forever —
+    the input-pipeline-removal benchmark trick."""
+    def _r():
+        cached = None
+        for sample in reader():
+            cached = sample
+            break
+        while True:
+            yield cached
+    return _r
+
+
+Fake = fake
+
+
+class PipeReader:
+    """decorator.py PipeReader: stream samples from a shell command's
+    stdout (e.g. zcat / hadoop fs -cat), split on a delimiter."""
+
+    def __init__(self, command: str, bufsize: int = 8192, file_type: str = "plain"):
+        import subprocess
+        self.command = command
+        self.bufsize = bufsize
+        self.file_type = file_type
+        self.process = subprocess.Popen(
+            command.split(" "), bufsize=bufsize, stdout=subprocess.PIPE)
+
+    def get_line(self, cut_lines: bool = True, line_break: str = "\n"):
+        remained = ""
+        while True:
+            buff = self.process.stdout.read(self.bufsize)
+            if not buff:
+                break
+            buff = buff.decode("utf-8", errors="replace")
+            if cut_lines:
+                lines = (remained + buff).split(line_break)
+                remained = lines.pop(-1)
+                for line in lines:
+                    yield line
+            else:
+                yield buff
+        if remained:
+            yield remained
+
+
+def multiprocess_reader(readers, use_pipe: bool = True, queue_size: int = 1000):
+    """decorator.py multiprocess_reader: run N readers in worker
+    processes, merge into one stream. Thread-based on TPU hosts (workers
+    are IO-bound; avoids fork-vs-XLA-runtime hazards) — same interleaved
+    stream contract."""
+    import queue as _q
+
+    def _r():
+        q: _q.Queue = _q.Queue(maxsize=queue_size)
+        _sentinel = object()
+
+        def work(r):
+            try:
+                for sample in r():
+                    q.put(sample)
+            finally:
+                q.put(_sentinel)
+
+        ts = [threading.Thread(target=work, args=(r,), daemon=True) for r in readers]
+        for t in ts:
+            t.start()
+        done = 0
+        while done < len(readers):
+            item = q.get()
+            if item is _sentinel:
+                done += 1
+            else:
+                yield item
+    return _r

@@ -24,6 +24,7 @@ Mesh-parallel execution plugs in through ``mesh``/``sharding_rules``
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
@@ -395,3 +396,31 @@ def _abstractify(v):
         return v
     arr = np.asarray(v)
     return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    """executor.py global_scope analog: the process-wide name→array
+    scope used when no explicit scope is passed."""
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    """executor.py scope_guard analog: swap the global scope within a
+    with-block."""
+    global _global_scope
+    old, _global_scope = _global_scope, scope
+    try:
+        yield scope
+    finally:
+        _global_scope = old
+
+
+def _switch_scope(scope: Scope) -> Scope:
+    """executor.py _switch_scope analog (reference exports it)."""
+    global _global_scope
+    old, _global_scope = _global_scope, scope
+    return old

@@ -290,6 +290,8 @@ def create_parameter(
     info = ctx.param_info.get(full)
     if info is not None and not info.trainable:
         p = jax.lax.stop_gradient(p)
+    if isinstance(attr, WeightNormParamAttr):
+        p = _weight_norm_reparam(p, attr, full, ctx)
     return p
 
 
@@ -441,3 +443,65 @@ def _concretize(x):
 def build(fn: Callable, name: Optional[str] = None) -> Program:
     """Wrap a layer-composition function into a Program."""
     return Program(fn, name=name)
+
+
+# --------------------------------------------------------------------------
+# default-program registry (framework.py default_main_program:1404 region /
+# program_guard). In the traced design a Program is a function, not a
+# mutable op list; the "default program" is a module slot driver code can
+# swap with program_guard — the structural shape fluid scripts expect.
+# --------------------------------------------------------------------------
+
+_default_programs: List["Program"] = []
+
+
+def default_main_program() -> "Program":
+    """framework.py default_main_program analog: the innermost
+    program_guard program (or None outside any guard)."""
+    return _default_programs[-1] if _default_programs else None
+
+
+def default_startup_program() -> "Program":
+    """Startup = init trace of the same Program (double-program
+    convention collapses: Program.init IS the startup program)."""
+    return default_main_program()
+
+
+@contextlib.contextmanager
+def program_guard(main_program: "Program", startup_program: Optional["Program"] = None):
+    """framework.py program_guard analog."""
+    _default_programs.append(main_program)
+    try:
+        yield main_program
+    finally:
+        _default_programs.pop()
+
+
+class WeightNormParamAttr(ParamAttr):
+    """param_attr.py WeightNormParamAttr: weight-norm reparameterization
+    w = g·v/‖v‖ along ``dim`` (Salimans & Kingma). create_parameter
+    detects this attr and returns the reparameterized weight; the stored
+    trainables are v (under the layer's name) and g ("<name>@wn_g",
+    initialized to ‖v_init‖ so the first forward equals plain init)."""
+
+    def __init__(self, dim: Optional[int] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
+def _weight_norm_reparam(p: jax.Array, attr: "WeightNormParamAttr", full: str,
+                         ctx: "BuildContext") -> jax.Array:
+    dim = attr.dim if attr.dim is not None else 0
+    axes = tuple(a for a in range(p.ndim) if a != dim)
+    gname = full + "@wn_g"
+    norm = jnp.sqrt(jnp.sum(jnp.square(p), axis=axes) + 1e-12)
+    if ctx.mode == "init" and gname not in ctx.params:
+        ctx.params[gname] = norm
+        ctx.param_info[gname] = ParamInfo(
+            shape=tuple(norm.shape), dtype=norm.dtype, trainable=attr.trainable,
+            learning_rate=attr.learning_rate, regularizer=None,
+            is_distributed=False)
+    g = ctx.params[gname]
+    shape = [1] * p.ndim
+    shape[dim] = p.shape[dim]
+    return p / norm.reshape(shape) * g.reshape(shape)

@@ -137,3 +137,27 @@ TruncatedNormalInitializer = TruncatedNormal
 XavierInitializer = Xavier
 MSRAInitializer = MSRA
 BilinearInitializer = Bilinear
+
+
+_init_on_cpu = False
+
+
+def force_init_on_cpu() -> bool:
+    """initializer.py force_init_on_cpu flag (reference puts e.g. LR-decay
+    counters on host). Initialization placement is the runtime's call on
+    TPU; the flag is kept for driver compatibility."""
+    return _init_on_cpu
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    """initializer.py init_on_cpu context manager analog."""
+    global _init_on_cpu
+    old, _init_on_cpu = _init_on_cpu, True
+    try:
+        yield
+    finally:
+        _init_on_cpu = old

@@ -195,3 +195,23 @@ def load_inference_model(dirname: str) -> Predictor:
         exported = jax.export.deserialize(f.read())
     params, state, _, meta = load_persistables(dirname)
     return Predictor(exported, params, state, meta["feed_names"])
+
+
+def save_params(dirname: str, params, state=None, opt_state=None):
+    """io.py:252 save_params analog — trainable parameters only."""
+    save_persistables(dirname, params, {}, None)
+
+
+def save_vars(dirname: str, vars: Dict[str, jax.Array], filename=None):
+    """io.py:89 save_vars analog: save an arbitrary name→array dict."""
+    save_persistables(dirname, dict(vars), {}, None)
+
+
+def load_params(dirname: str):
+    """io.py load_params analog: returns the parameter dict."""
+    return load_persistables(dirname)[0]
+
+
+def load_vars(dirname: str):
+    """io.py:295 load_vars analog."""
+    return load_persistables(dirname)[0]

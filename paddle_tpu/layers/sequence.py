@@ -292,3 +292,30 @@ def reorder_lod_tensor_by_rank(padded, lengths):
     lengths = jnp.asarray(lengths)
     perm = jnp.argsort(-lengths, stable=True)
     return padded[perm], lengths[perm], perm
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """lod_tensor.py create_lod_tensor analog: build the packed
+    (values, lengths, segment_ids) triple from per-sequence lengths.
+    Only one LoD level (the overwhelmingly common case); nested levels
+    flatten to their innermost lengths."""
+    import numpy as np
+    lens = recursive_seq_lens[-1] if isinstance(recursive_seq_lens[0], (list, tuple)) \
+        else recursive_seq_lens
+    lens = jnp.asarray(np.asarray(lens, np.int32))
+    values = jnp.asarray(data)
+    enforce(int(lens.sum()) == values.shape[0],
+            "create_lod_tensor: lengths must sum to data rows")
+    seg = lengths_to_segment_ids(lens, values.shape[0])
+    return values, lens, seg
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low: int = 0, high: int = 1):
+    """lod_tensor.py create_random_int_lodtensor analog."""
+    import numpy as np
+    lens = recursive_seq_lens[-1] if isinstance(recursive_seq_lens[0], (list, tuple)) \
+        else recursive_seq_lens
+    total = int(np.sum(lens))
+    data = np.random.randint(low, high + 1, (total,) + tuple(base_shape)).astype(np.int32)
+    return create_lod_tensor(data, recursive_seq_lens, place)

@@ -264,3 +264,41 @@ def auc(input, label, curve: str = "ROC", num_thresholds: int = 200, name=None):
         stats[nm] = acc + batch
         helper.assign_variable(nm, stats[nm])
     return _auc(stats["tp"], stats["fp"]), _auc(tp_b, fp_b)
+
+
+class ChunkEvaluator(MetricBase):
+    """metrics.py ChunkEvaluator: streaming chunk-level precision /
+    recall / F1 (chunk_eval_op counts accumulated across batches)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks: int, num_label_chunks: int,
+               num_correct_chunks: int):
+        self.num_infer_chunks += int(num_infer_chunks)
+        self.num_label_chunks += int(num_label_chunks)
+        self.num_correct_chunks += int(num_correct_chunks)
+
+    def eval(self):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+# re-export (reference metrics.py __all__ includes DetectionMAP; the
+# implementation lives with the evaluators)
+def __getattr__(name):
+    if name == "DetectionMAP":
+        from .evaluator import DetectionMAP
+        return DetectionMAP
+    raise AttributeError(name)

@@ -1,0 +1,222 @@
+"""Tests for the fluid compat surfaces: transpiler module, backward,
+program_guard/scopes, weight norm, reader decorators, datasets, image
+utils, ChunkEvaluator, profiler controls, io aliases."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+from paddle_tpu import metrics as M
+from paddle_tpu.data import datasets as D
+from paddle_tpu.data import image as IMG
+
+
+def test_distribute_transpiler_shapes_strategy():
+    t = pt.DistributeTranspiler()
+    prog = pt.build(lambda x: {"loss": L.mean(x)})
+    t.transpile(trainer_id=0, program=prog, pservers="h1:6174,h2:6174", trainers=2)
+    p, strategy = t.get_trainer_program()
+    assert p is prog
+    assert strategy.reduce_strategy == "sharded"  # param-slicing capability
+    p2, s2 = t.get_pserver_program("h1:6174")
+    assert p2 is prog
+    with pytest.raises(NotImplementedError):
+        t.transpile(0, prog, "h1:6174", 2, sync_mode=False)
+
+
+def test_ps_dispatchers():
+    from paddle_tpu.transpiler import HashName, RoundRobin
+    eps = ["a", "b", "c"]
+    rr = RoundRobin(eps)
+    assert rr.dispatch(list("wxyz")) == ["a", "b", "c", "a"]
+    hn = HashName(eps)
+    d1 = hn.dispatch(["p1", "p2"])
+    assert d1 == hn.dispatch(["p1", "p2"])  # stable
+    assert set(d1) <= set(eps)
+
+
+def test_memory_optimize_returns_remat_strategy():
+    s = pt.memory_optimize()
+    assert s.remat is True
+    assert pt.release_memory(None) is None
+
+
+def test_append_backward_param_grads():
+    x = np.random.randn(4, 3).astype(np.float32)
+    prog = pt.build(lambda a: {"loss": L.mean(L.fc(a, 2, name="f"))})
+    params, state = prog.init(jax.random.PRNGKey(0), x)
+    grad_fn = pt.append_backward(prog, "loss")
+    loss, pg = grad_fn(params, state, x)
+    names = [n for n, _ in pg]
+    assert "f/w" in names and "f/b" in names
+    gb = dict(pg)["f/b"]
+    # loss = mean over 4*2 outputs; each bias column feeds 4 of them
+    np.testing.assert_allclose(np.asarray(gb), np.full(2, 0.5), rtol=1e-5)
+
+    # parameter_list restriction
+    loss2, pg2 = pt.append_backward(prog, "loss", parameter_list=["f/w"])(params, state, x)
+    assert [n for n, _ in pg2] == ["f/w"]
+
+
+def test_calc_gradient():
+    prog = pt.build(lambda a: {"y": (a ** 2).sum()})
+    params, state = prog.init(jax.random.PRNGKey(0), np.ones((2,), np.float32))
+    g = pt.calc_gradient(prog, "y", ["a"])(params, state, {"a": jnp.asarray([3.0, 4.0])})
+    np.testing.assert_allclose(np.asarray(g["a"]), [6.0, 8.0], rtol=1e-6)
+
+
+def test_program_guard_and_scopes():
+    prog = pt.build(lambda x: x)
+    assert pt.default_main_program() is None
+    with pt.program_guard(prog):
+        assert pt.default_main_program() is prog
+        assert pt.default_startup_program() is prog
+    assert pt.default_main_program() is None
+
+    s = pt.Scope()
+    g0 = pt.global_scope()
+    with pt.scope_guard(s):
+        assert pt.global_scope() is s
+    assert pt.global_scope() is g0
+
+
+def test_weight_norm_param_attr():
+    x = np.random.randn(4, 6).astype(np.float32)
+    prog = pt.build(lambda a: L.fc(a, 3, name="wn",
+                                   param_attr=pt.WeightNormParamAttr(dim=1)))
+    params, state = prog.init(jax.random.PRNGKey(0), x)
+    assert "wn/w@wn_g" in params
+    v = np.asarray(params["wn/w"])
+    g = np.asarray(params["wn/w@wn_g"])
+    # g initialized to ||v|| per output column -> first forward == plain fc
+    np.testing.assert_allclose(g, np.linalg.norm(v, axis=0), rtol=1e-5)
+    out, _ = prog.apply(params, state, x)
+    np.testing.assert_allclose(np.asarray(out), x @ v + np.asarray(params["wn/b"]),
+                               rtol=1e-4, atol=1e-5)
+    # scaling g scales the effective weight
+    params2 = dict(params)
+    params2["wn/w@wn_g"] = params["wn/w@wn_g"] * 2.0
+    out2, _ = prog.apply(params2, state, x)
+    np.testing.assert_allclose(np.asarray(out2 - np.asarray(params["wn/b"])),
+                               2 * (np.asarray(out) - np.asarray(params["wn/b"])),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_reader_decorators_fake_pipe_multiprocess():
+    from paddle_tpu.data import Fake, PipeReader, multiprocess_reader
+
+    def r():
+        for i in range(5):
+            yield (i,)
+
+    fk = Fake(r)
+    it = fk()
+    assert next(it) == (0,) and next(it) == (0,)
+
+    pr = PipeReader("echo a\nb\nc")
+    lines = list(pr.get_line())
+    assert "b" in "".join(lines)
+
+    def r2():
+        for i in range(10, 13):
+            yield (i,)
+    merged = sorted(s[0] for s in multiprocess_reader([r, r2])())
+    assert merged == [0, 1, 2, 3, 4, 10, 11, 12]
+
+
+def test_new_datasets_yield_and_learnable_shapes():
+    s = next(iter(D.cifar100()()))
+    assert s[0].shape == (3 * 32 * 32,) and 0 <= s[1] < 100
+    f = next(iter(D.flowers(image_hw=(32, 32))()))
+    assert f[0].shape == (3 * 32 * 32,) and 0 <= f[1] < 102
+    img, mask = next(iter(D.voc2012(image_hw=(32, 32))()))
+    assert img.shape == (3, 32, 32) and mask.shape == (32, 32) and mask.max() > 0
+
+    grams = list(D.imikolov(synthetic_size=4, n=3)())
+    assert all(len(g) == 3 for g in grams)
+    src, trg = next(iter(D.imikolov(synthetic_size=2, data_type=D.DataType.SEQ)()))
+    assert len(src) == len(trg)
+
+    ids, y = next(iter(D.sentiment()()))
+    assert y in (0, 1) and len(ids) > 0
+
+    s14 = next(iter(D.wmt14(synthetic_size=4)()))
+    assert len(s14) == 3 and s14[1][0] == 1  # trg starts with <s>
+
+    pt_, sc = next(iter(D.mq2007(format="pointwise")()))
+    assert pt_.shape == (46,)
+    hi, lo = next(iter(D.mq2007(format="pairwise")()))
+    assert hi.shape == lo.shape == (46,)
+    labels, feats = next(iter(D.mq2007(format="listwise")()))
+    assert len(labels) == len(feats) == 8
+
+
+def test_image_utils():
+    im = (np.random.rand(40, 60, 3) * 255).astype(np.uint8)
+    r = IMG.resize_short(im, 20)
+    assert min(r.shape[:2]) == 20 and r.shape[1] == 30
+    c = IMG.center_crop(im, 30)
+    assert c.shape[:2] == (30, 30)
+    rc = IMG.random_crop(im, 16, rng=np.random.RandomState(0))
+    assert rc.shape[:2] == (16, 16)
+    fl = IMG.left_right_flip(im)
+    np.testing.assert_array_equal(fl[:, 0], im[:, -1])
+    chw = IMG.to_chw(im)
+    assert chw.shape == (3, 40, 60)
+    t = IMG.simple_transform(im, 32, 24, is_train=False, mean=np.array([1.0, 2.0, 3.0]))
+    assert t.shape == (3, 24, 24) and t.dtype == np.float32
+
+
+def test_chunk_evaluator():
+    ce = M.ChunkEvaluator()
+    ce.update(num_infer_chunks=4, num_label_chunks=5, num_correct_chunks=3)
+    ce.update(num_infer_chunks=2, num_label_chunks=1, num_correct_chunks=1)
+    p, r, f1 = ce.eval()
+    np.testing.assert_allclose(p, 4 / 6, rtol=1e-6)
+    np.testing.assert_allclose(r, 4 / 6, rtol=1e-6)
+    np.testing.assert_allclose(f1, 4 / 6, rtol=1e-6)
+
+
+def test_profiler_controls():
+    from paddle_tpu.core import profiler as P
+    P.start_profiler()
+    with P.record_event("op_x"):
+        pass
+    rows = P.stop_profiler()
+    assert any(r["name"] == "op_x" for r in rows)
+    P.reset_profiler()
+    with pytest.raises(NotImplementedError):
+        P.cuda_profiler()
+
+
+def test_io_aliases_roundtrip(tmp_path):
+    from paddle_tpu import io as pio
+    params = {"a/w": jnp.ones((2, 2)), "b": jnp.zeros((3,))}
+    d = str(tmp_path / "ckpt")
+    pio.save_params(d, params)
+    loaded = pio.load_params(d)
+    np.testing.assert_allclose(np.asarray(loaded["a/w"]), 1.0)
+    pio.save_vars(d, params)
+    assert set(pio.load_vars(d)) == set(params)
+
+
+def test_create_lod_tensor():
+    vals, lens, seg = L.sequence.create_lod_tensor(
+        np.arange(10, dtype=np.float32).reshape(5, 2), [[2, 3]])
+    np.testing.assert_array_equal(np.asarray(lens), [2, 3])
+    np.testing.assert_array_equal(np.asarray(seg), [0, 0, 1, 1, 1])
+    v2, l2, s2 = L.sequence.create_random_int_lodtensor([[1, 2]], (3,), low=0, high=4)
+    assert v2.shape == (3, 3) and np.asarray(v2).max() <= 4
+
+
+def test_init_on_cpu_flag():
+    from paddle_tpu import initializer as I
+    assert I.force_init_on_cpu() is False
+    with I.init_on_cpu():
+        assert I.force_init_on_cpu() is True
+    assert I.force_init_on_cpu() is False
