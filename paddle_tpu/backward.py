@@ -26,7 +26,7 @@ def append_backward(program: Program, loss_name: str = "loss",
     cotangents)."""
 
     def grad_fn(params: Dict, state: Dict, *args, **kwargs):
-        names = list(parameter_list or params.keys())
+        names = list(parameter_list) if parameter_list is not None else list(params.keys())
         if no_grad_set:
             names = [n for n in names if n not in no_grad_set]
         wrt = {n: params[n] for n in names}

@@ -19,6 +19,7 @@ import jax
 
 _enabled = False
 _events: Dict[str, List[float]] = defaultdict(list)
+_spans: List[tuple] = []   # (name, start_us, dur_us) for the timeline dump
 _trace_dir: Optional[str] = None
 
 
@@ -33,7 +34,10 @@ def record_event(name: str) -> Iterator[None]:
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
         yield
-    _events[name].append((time.perf_counter() - t0) * 1e3)  # ms
+    t1 = time.perf_counter()
+    _events[name].append((t1 - t0) * 1e3)  # ms
+    import threading as _th
+    _spans.append((name, t0 * 1e6, (t1 - t0) * 1e6, _th.get_ident() % 10000))
 
 
 def enable_profiler(trace_dir: Optional[str] = None) -> None:
@@ -41,6 +45,7 @@ def enable_profiler(trace_dir: Optional[str] = None) -> None:
     global _enabled, _trace_dir
     _enabled = True
     _events.clear()
+    _spans.clear()
     _trace_dir = trace_dir
     if trace_dir:
         jax.profiler.start_trace(trace_dir)
@@ -98,14 +103,32 @@ def stop_profiler(sorted_key: str = "total", profile_path=None):
     return disable_profiler(sorted_key=sorted_key)
 
 
-def reset_profiler():
-    """profiler.py reset_profiler analog: drop collected spans."""
-    _events.clear()
-
-
 def cuda_profiler(*args, **kwargs):
     """profiler.py:39 cuda_profiler (nvprof control) — vendor-profiler
     control is jax.profiler's trace on TPU; kept as an explicit stub so
     ported drivers fail loudly rather than silently."""
     raise NotImplementedError(
         "cuda_profiler is CUDA-specific; use profiler()/jax.profiler traces")
+
+
+def reset_profiler():
+    """profiler.py reset_profiler analog: drop collected spans."""
+    _events.clear()
+    _spans.clear()
+
+
+def timeline(path: str) -> int:
+    """tools/timeline.py:115 analog: dump recorded host spans as
+    chrome://tracing JSON (device-side timelines come from the
+    jax.profiler trace directory — perfetto-compatible). Returns the
+    number of events written."""
+    import json as _json
+
+    events = [
+        {"name": name, "ph": "X", "ts": ts, "dur": dur,
+         "pid": 0, "tid": tid, "cat": "host"}
+        for name, ts, dur, tid in _spans
+    ]
+    with open(path, "w") as f:
+        _json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return len(events)

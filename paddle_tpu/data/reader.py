@@ -64,9 +64,18 @@ def compose(*readers: Reader, check_alignment: bool = True) -> Reader:
     def make_tuple(x):
         return x if isinstance(x, tuple) else (x,)
 
+    _miss = object()
+
     def reader():
         its = [r() for r in readers]
-        for items in (zip(*its) if check_alignment else itertools.zip_longest(*its)):
+        if not check_alignment:
+            for items in zip(*its):
+                yield sum((make_tuple(i) for i in items), ())
+            return
+        for items in itertools.zip_longest(*its, fillvalue=_miss):
+            if any(i is _miss for i in items):
+                raise ComposeNotAligned(
+                    "compose: input readers yielded different lengths")
             yield sum((make_tuple(i) for i in items), ())
 
     return reader
@@ -205,14 +214,15 @@ class ComposeNotAligned(ValueError):
 
 
 def fake(reader, n: int = 1):
-    """decorator.py Fake: cache the first sample and replay it forever —
-    the input-pipeline-removal benchmark trick."""
+    """decorator.py Fake: cache the first sample and replay it ``n``
+    times — the input-pipeline-removal benchmark trick."""
     def _r():
-        cached = None
-        for sample in reader():
-            cached = sample
-            break
-        while True:
+        it = iter(reader())
+        try:
+            cached = next(it)
+        except StopIteration:
+            raise ValueError("fake(): source reader is empty") from None
+        for _ in range(n):
             yield cached
     return _r
 
@@ -226,6 +236,8 @@ class PipeReader:
 
     def __init__(self, command: str, bufsize: int = 8192, file_type: str = "plain"):
         import subprocess
+        if file_type not in ("plain", "gzip"):
+            raise ValueError(f"PipeReader: unsupported file_type {file_type!r}")
         self.command = command
         self.bufsize = bufsize
         self.file_type = file_type
@@ -233,11 +245,16 @@ class PipeReader:
             command.split(" "), bufsize=bufsize, stdout=subprocess.PIPE)
 
     def get_line(self, cut_lines: bool = True, line_break: str = "\n"):
+        import zlib
+        decomp = zlib.decompressobj(32 + zlib.MAX_WBITS) \
+            if self.file_type == "gzip" else None
         remained = ""
         while True:
             buff = self.process.stdout.read(self.bufsize)
             if not buff:
                 break
+            if decomp is not None:
+                buff = decomp.decompress(buff)
             buff = buff.decode("utf-8", errors="replace")
             if cut_lines:
                 lines = (remained + buff).split(line_break)
@@ -251,10 +268,11 @@ class PipeReader:
 
 
 def multiprocess_reader(readers, use_pipe: bool = True, queue_size: int = 1000):
-    """decorator.py multiprocess_reader: run N readers in worker
-    processes, merge into one stream. Thread-based on TPU hosts (workers
-    are IO-bound; avoids fork-vs-XLA-runtime hazards) — same interleaved
-    stream contract."""
+    """decorator.py multiprocess_reader: run N readers in workers, merge
+    into one stream. Thread-based on TPU hosts (workers are IO-bound;
+    avoids fork-vs-XLA-runtime hazards; ``use_pipe`` is accepted for API
+    parity — both reference transports map to the same queue here).
+    Worker exceptions are re-raised in the consumer."""
     import queue as _q
 
     def _r():
@@ -265,8 +283,9 @@ def multiprocess_reader(readers, use_pipe: bool = True, queue_size: int = 1000):
             try:
                 for sample in r():
                     q.put(sample)
-            finally:
                 q.put(_sentinel)
+            except BaseException as e:  # propagate to the consumer
+                q.put(_WorkerError(e))
 
         ts = [threading.Thread(target=work, args=(r,), daemon=True) for r in readers]
         for t in ts:
@@ -276,6 +295,13 @@ def multiprocess_reader(readers, use_pipe: bool = True, queue_size: int = 1000):
             item = q.get()
             if item is _sentinel:
                 done += 1
+            elif isinstance(item, _WorkerError):
+                raise item.error
             else:
                 yield item
     return _r
+
+
+class _WorkerError:
+    def __init__(self, error: BaseException):
+        self.error = error

@@ -198,8 +198,9 @@ def load_inference_model(dirname: str) -> Predictor:
 
 
 def save_params(dirname: str, params, state=None, opt_state=None):
-    """io.py:252 save_params analog — trainable parameters only."""
-    save_persistables(dirname, params, {}, None)
+    """io.py:252 save_params analog — parameters (+state/opt_state when
+    given)."""
+    save_persistables(dirname, params, state or {}, opt_state)
 
 
 def save_vars(dirname: str, vars: Dict[str, jax.Array], filename=None):

@@ -3,7 +3,7 @@
 BERT-base; plus VGG/AlexNet/GoogLeNet/LSTM from benchmark/fluid/models/
 and the recommender_system / label_semantic_roles book chapters)."""
 
-from . import bert, convnets, deepfm, lstm, mnist, recommender, resnet, seq2seq, srl, transformer, vgg, word2vec
+from . import bert, convnets, deepfm, fit_a_line, lstm, mnist, recommender, resnet, seq2seq, srl, transformer, vgg, word2vec
 
-__all__ = ["bert", "convnets", "deepfm", "lstm", "mnist", "recommender", "resnet",
-           "seq2seq", "srl", "transformer", "vgg", "word2vec"]
+__all__ = ["bert", "convnets", "deepfm", "fit_a_line", "lstm", "mnist", "recommender",
+           "resnet", "seq2seq", "srl", "transformer", "vgg", "word2vec"]

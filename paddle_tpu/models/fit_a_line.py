@@ -1,0 +1,19 @@
+"""fit_a_line — the first book chapter (tests/book/test_fit_a_line.py):
+linear regression on UCI housing (13 features → price) with square
+error cost. The smallest end-to-end program in the reference; kept as
+the minimal smoke model here too."""
+
+from __future__ import annotations
+
+from .. import layers
+
+
+def make_model():
+    def fit_a_line(x, y):
+        """x: [b, 13] float features; y: [b, 1] float prices."""
+        y_predict = layers.fc(x, 1, name="fc")
+        cost = layers.square_error_cost(y_predict, y)
+        avg_cost = layers.mean(cost)
+        return {"loss": avg_cost, "pred": y_predict}
+
+    return fit_a_line

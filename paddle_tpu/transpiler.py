@@ -58,9 +58,15 @@ class HashName(PSDispatcher):
     reference hashes the variable name so placement survives restarts)."""
 
     def dispatch(self, varlist: List) -> List:
-        def _name(v):
-            return v if isinstance(v, str) else getattr(v, "name", str(v))
-        return [self._eplist[hash(_name(v)) % len(self._eplist)] for v in varlist]
+        import hashlib
+
+        def _stable_hash(v):
+            name = v if isinstance(v, str) else getattr(v, "name", str(v))
+            # builtin hash() is salted per process; placement must survive
+            # restarts (checkpoint shards follow it)
+            return int(hashlib.md5(name.encode()).hexdigest(), 16)
+
+        return [self._eplist[_stable_hash(v) % len(self._eplist)] for v in varlist]
 
 
 @dataclasses.dataclass

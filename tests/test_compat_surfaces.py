@@ -114,9 +114,8 @@ def test_reader_decorators_fake_pipe_multiprocess():
         for i in range(5):
             yield (i,)
 
-    fk = Fake(r)
-    it = fk()
-    assert next(it) == (0,) and next(it) == (0,)
+    fk = Fake(r, 2)
+    assert list(fk()) == [(0,), (0,)]
 
     pr = PipeReader("echo a\nb\nc")
     lines = list(pr.get_line())
@@ -220,3 +219,107 @@ def test_init_on_cpu_flag():
     with I.init_on_cpu():
         assert I.force_init_on_cpu() is True
     assert I.force_init_on_cpu() is False
+
+
+def test_fit_a_line_converges_and_roundtrips(tmp_path):
+    """Book chapter 1 (test_fit_a_line.py): train -> save -> load ->
+    infer round trip on uci_housing."""
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu import io as pio
+    from paddle_tpu.models import fit_a_line
+    from paddle_tpu.data import datasets, reader as rd
+
+    prog = pt.build(fit_a_line.make_model())
+    train_reader = rd.batch(datasets.uci_housing("train"), 32, drop_last=True)
+
+    def to_feed(b):
+        xs, ys = zip(*b)
+        return {"x": np.stack(xs).astype(np.float32),
+                "y": np.asarray(ys, np.float32).reshape(-1, 1)}
+
+    batches = [to_feed(b) for b in train_reader()]
+    tr = pt.Trainer(prog, opt.SGD(0.01), loss_name="loss")
+    tr.startup(sample_feed=batches[0])
+    first = float(tr.step(batches[0])["loss"])
+    for _ in range(3):
+        for b in batches:
+            out = tr.step(b)
+    assert float(out["loss"]) < first * 0.5
+
+    d = str(tmp_path / "fit_a_line")
+    pio.save_persistables(d, tr.scope.params, tr.scope.state)
+    params, state, _, _ = pio.load_persistables(d)
+    pred, _ = prog.apply(params, state, **batches[0])
+    assert np.isfinite(np.asarray(pred["pred"])).all()
+
+
+def test_timeline_dump(tmp_path):
+    from paddle_tpu.core import profiler as P
+    import json
+    P.start_profiler()
+    with P.record_event("step"):
+        with P.record_event("fwd"):
+            pass
+    P.stop_profiler()
+    path = str(tmp_path / "tl.json")
+    n = P.timeline(path)
+    assert n == 2
+    ev = json.load(open(path))["traceEvents"]
+    assert {e["name"] for e in ev} == {"step", "fwd"}
+
+
+def test_review_fixes_reader_and_dispatch():
+    from paddle_tpu.data import reader as rd
+
+    # fake honors n; empty reader errors
+    def r():
+        yield (1,)
+    assert len(list(rd.fake(r, 3)())) == 3
+    with pytest.raises(ValueError):
+        list(rd.fake(lambda: iter(()), 2)())
+
+    # compose raises on misalignment when check_alignment
+    def r5():
+        yield from [(i,) for i in range(5)]
+    def r3():
+        yield from [(i,) for i in range(3)]
+    with pytest.raises(rd.ComposeNotAligned):
+        list(rd.compose(r5, r3)())
+    assert len(list(rd.compose(r5, r3, check_alignment=False)())) == 3
+
+    # multiprocess_reader propagates worker exceptions
+    def bad():
+        yield (1,)
+        raise IOError("disk gone")
+    with pytest.raises(IOError):
+        list(rd.multiprocess_reader([bad])())
+
+    # PipeReader rejects unknown file_type, decompresses gzip
+    with pytest.raises(ValueError):
+        rd.PipeReader("echo x", file_type="zstd")
+    import gzip as _gz, tempfile
+    p = tempfile.mktemp()
+    with _gz.open(p, "wb") as f:
+        f.write(b"hello\nworld\n")
+    lines = [l for l in rd.PipeReader(f"cat {p}", file_type="gzip").get_line() if l]
+    assert lines == ["hello", "world"]
+
+    # HashName stable across instances (md5, not salted hash)
+    from paddle_tpu.transpiler import HashName
+    assert HashName(["a", "b"]).dispatch(["w1"]) == HashName(["a", "b"]).dispatch(["w1"])
+
+
+def test_append_backward_empty_parameter_list():
+    prog = pt.build(lambda a: {"loss": L.mean(L.fc(a, 2, name="g"))})
+    x = np.random.randn(2, 3).astype(np.float32)
+    params, state = prog.init(jax.random.PRNGKey(0), x)
+    _, pg = pt.append_backward(prog, "loss", parameter_list=[])(params, state, x)
+    assert pg == []  # empty list means "no params", not "all params"
+
+
+def test_save_params_forwards_state(tmp_path):
+    from paddle_tpu import io as pio
+    d = str(tmp_path / "sp")
+    pio.save_params(d, {"w": jnp.ones(2)}, state={"bn/mean": jnp.zeros(3)})
+    _, state, _, _ = pio.load_persistables(d)
+    assert "bn/mean" in state
