@@ -79,7 +79,7 @@ def bench_transformer(batch_size=32, seq=256, dtype="float32"):
     from paddle_tpu.models import transformer
 
     cfg = transformer.base_config(src_vocab=32000, trg_vocab=32000, dropout=0.1,
-                                  dtype=dtype, use_flash=True)
+                                  dtype=dtype, use_flash=True, fused_ce=True)
     model = pt.build(transformer.make_model(cfg))
     rng = np.random.RandomState(0)
     feeds = [{

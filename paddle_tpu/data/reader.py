@@ -252,9 +252,21 @@ class PipeReader:
         while True:
             buff = self.process.stdout.read(self.bufsize)
             if not buff:
+                if decomp is not None:
+                    tail = decomp.flush()
+                    if tail:
+                        remained += tail.decode("utf-8", errors="replace")
                 break
             if decomp is not None:
-                buff = decomp.decompress(buff)
+                out = decomp.decompress(buff)
+                # concatenated gzip members (cat a.gz b.gz): restart the
+                # stream on each member boundary or data after the first
+                # member is silently dropped
+                while decomp.eof and decomp.unused_data:
+                    rest = decomp.unused_data
+                    decomp = zlib.decompressobj(32 + zlib.MAX_WBITS)
+                    out += decomp.decompress(rest)
+                buff = out
             buff = buff.decode("utf-8", errors="replace")
             if cut_lines:
                 lines = (remained + buff).split(line_break)

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from .. import layers as L
 from ..framework import LayerHelper, name_scope
 from ..layers import attention as A
+from ..ops.fused_ce import chunked_softmax_cross_entropy
 from .. import initializer as init
 
 
@@ -35,6 +36,9 @@ class TransformerConfig:
     dropout: float = 0.1
     label_smooth_eps: float = 0.1
     use_flash: bool = False
+    # chunked logits-free CE (ops/fused_ce.py); chunk = vocab tile width
+    fused_ce: bool = False
+    ce_chunk: int = 4096
     dtype: str = "float32"
 
 
@@ -93,7 +97,9 @@ def encode(src_ids, cfg: TransformerConfig):
     return x, mask
 
 
-def decode(trg_ids, enc_out, cross_mask, cfg: TransformerConfig):
+def decode_hidden(trg_ids, enc_out, cross_mask, cfg: TransformerConfig):
+    """Decoder stack up to (hidden states, vocab projection weight) —
+    split out so the loss can run the projection chunked (fused_ce)."""
     dtype = jnp.dtype(cfg.dtype)
     x = _embed(trg_ids, cfg.trg_vocab, cfg.d_model, dtype, "trg")
     x = x + A.positional_encoding(trg_ids.shape[1], cfg.d_model, dtype)[None]
@@ -105,6 +111,11 @@ def decode(trg_ids, enc_out, cross_mask, cfg: TransformerConfig):
     helper = LayerHelper("logits_proj")
     w = helper.create_parameter("w", (cfg.d_model, cfg.trg_vocab), dtype,
                                 initializer=init.Xavier())
+    return x, w
+
+
+def decode(trg_ids, enc_out, cross_mask, cfg: TransformerConfig):
+    x, w = decode_hidden(trg_ids, enc_out, cross_mask, cfg)
     return jnp.matmul(x, w)
 
 
@@ -182,17 +193,27 @@ def make_model(cfg: TransformerConfig):
 
     def transformer(src_ids, trg_ids, labels):
         enc_out, src_mask = encode(src_ids, cfg)
+        eps = cfg.label_smooth_eps
+        lab = labels.astype(jnp.int32)
+        nonpad = (labels != 0).astype(jnp.float32)
+        token_count = jnp.maximum(nonpad.sum(), 1.0)
+        if cfg.fused_ce:
+            # Chunked projection+CE: never materializes [b,t,vocab]
+            # logits (ops/fused_ce.py) — the LM-head HBM hot spot.
+            x, w = decode_hidden(trg_ids, enc_out, src_mask, cfg)
+            b, t, d = x.shape
+            ce = chunked_softmax_cross_entropy(
+                x.reshape(b * t, d), w, None, lab.reshape(-1), eps,
+                cfg.ce_chunk).reshape(b, t)
+            loss = jnp.sum(ce * nonpad) / token_count
+            return {"loss": loss, "token_count": token_count}
         logits = decode(trg_ids, enc_out, src_mask, cfg)
         # Label-smoothed CE without materializing a [b,t,vocab] one-hot:
         # loss = (1-eps)·NLL(target) + eps·mean(-logp) — algebraically
         # identical to smoothing over the uniform prior, HBM-friendly.
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        lab = labels.astype(jnp.int32)
         nll = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
-        eps = cfg.label_smooth_eps
         ce = (1.0 - eps) * nll - eps * jnp.mean(logp, axis=-1)
-        nonpad = (labels != 0).astype(jnp.float32)
-        token_count = jnp.maximum(nonpad.sum(), 1.0)
         loss = jnp.sum(ce * nonpad) / token_count
         return {"loss": loss, "logits": logits, "token_count": token_count}
 

@@ -268,7 +268,7 @@ def test_timeline_dump(tmp_path):
     assert {e["name"] for e in ev} == {"step", "fwd"}
 
 
-def test_review_fixes_reader_and_dispatch():
+def test_review_fixes_reader_and_dispatch(tmp_path):
     from paddle_tpu.data import reader as rd
 
     # fake honors n; empty reader errors
@@ -294,15 +294,18 @@ def test_review_fixes_reader_and_dispatch():
     with pytest.raises(IOError):
         list(rd.multiprocess_reader([bad])())
 
-    # PipeReader rejects unknown file_type, decompresses gzip
+    # PipeReader rejects unknown file_type, decompresses gzip — incl.
+    # concatenated members (cat a.gz b.gz)
     with pytest.raises(ValueError):
         rd.PipeReader("echo x", file_type="zstd")
-    import gzip as _gz, tempfile
-    p = tempfile.mktemp()
-    with _gz.open(p, "wb") as f:
+    import gzip as _gz
+    p1, p2 = str(tmp_path / "a.gz"), str(tmp_path / "b.gz")
+    with _gz.open(p1, "wb") as f:
         f.write(b"hello\nworld\n")
-    lines = [l for l in rd.PipeReader(f"cat {p}", file_type="gzip").get_line() if l]
-    assert lines == ["hello", "world"]
+    with _gz.open(p2, "wb") as f:
+        f.write(b"again\n")
+    lines = [l for l in rd.PipeReader(f"cat {p1} {p2}", file_type="gzip").get_line() if l]
+    assert lines == ["hello", "world", "again"]
 
     # HashName stable across instances (md5, not salted hash)
     from paddle_tpu.transpiler import HashName
