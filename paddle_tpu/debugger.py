@@ -70,3 +70,61 @@ def summarize_params(params: Dict[str, jax.Array]) -> str:
     header = f"{'name':<50} {'shape':<20} {'dtype':<10} {'elements':>12}"
     rows.append(f"TOTAL {total / 1e6:.2f} MB")
     return "\n".join([header, "-" * len(header)] + rows)
+
+
+def _walk_jaxprs(jx, visit):
+    """Depth-first over a jaxpr and every nested jaxpr (scan/cond/pjit)."""
+    visit(jx)
+    for eqn in jx.eqns:
+        for v in eqn.params.values():
+            if hasattr(v, "jaxpr"):
+                _walk_jaxprs(v.jaxpr, visit)
+            elif isinstance(v, (list, tuple)):
+                for u in v:
+                    if hasattr(u, "jaxpr"):
+                        _walk_jaxprs(u.jaxpr, visit)
+
+
+def op_frequence(program, params, state, *args, **kwargs) -> Dict[str, int]:
+    """tools/op_frequence.py analog: histogram of primitive ops in the
+    traced program (jaxpr = ProgramDesc), including nested bodies."""
+    from collections import Counter
+
+    jaxpr = program.desc(params, state, *args, **kwargs)
+    counts: Counter = Counter()
+
+    def visit(jx):
+        for eqn in jx.eqns:
+            counts[eqn.primitive.name] += 1
+
+    _walk_jaxprs(jaxpr.jaxpr, visit)
+    return dict(counts.most_common())
+
+
+def memory_usage(program, params, state, *args, **kwargs) -> Dict[str, float]:
+    """contrib/memory_usage_calc.py analog: estimate a program's memory
+    footprint in MB — parameters (×3 for grads+momentum-style optimizer
+    state, the calc the reference does) plus the sum of traced
+    intermediate sizes (including scan/cond bodies) as an activation
+    upper bound (XLA buffer reuse brings the true peak far below the
+    sum; this mirrors the reference's coarse DESC-walk estimate). The
+    estimate is for the example args' shapes — re-trace to size a
+    different batch."""
+    param_bytes = sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                      for v in jax.tree.leaves(params))
+    jaxpr = program.desc(params, state, *args, **kwargs)
+    act = [0]
+
+    def visit(jx):
+        for eqn in jx.eqns:
+            for ov in eqn.outvars:
+                aval = getattr(ov, "aval", None)
+                if aval is not None and hasattr(aval, "shape"):
+                    act[0] += int(np.prod(aval.shape or (1,))) * aval.dtype.itemsize
+
+    _walk_jaxprs(jaxpr.jaxpr, visit)
+    return {
+        "param_mb": param_bytes / 1e6,
+        "param_with_optimizer_mb": 3 * param_bytes / 1e6,
+        "activation_sum_mb": act[0] / 1e6,
+    }

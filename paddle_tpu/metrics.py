@@ -302,3 +302,69 @@ def __getattr__(name):
         from .evaluator import DetectionMAP
         return DetectionMAP
     raise AttributeError(name)
+
+
+def chunk_eval_counts(inference, label, lengths, num_chunk_types: int,
+                      chunk_scheme: str = "IOB"):
+    """In-graph chunk counting (chunk_eval_op.cc analog), jittable.
+
+    inference/label: [b, t] int tag ids with the reference's encoding
+    ``tag_id = chunk_type * tag_num + tag`` (IOB: tag 0=B, 1=I, tag_num=2;
+    IOE: 0=I, 1=E, tag_num=2; IOBES: 0=B,1=I,2=E,3=S, tag_num=4;
+    plain: tag_num=1). Ids >=
+    num_chunk_types*tag_num (and positions >= lengths) are outside (O).
+    Returns (num_infer_chunks, num_label_chunks, num_correct_chunks) —
+    feed ChunkEvaluator.update. A chunk is correct iff (start, end, type)
+    all match, computed via begin-masks + run-length span ends (no host
+    loop)."""
+    import jax
+
+    tag_num = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[chunk_scheme]
+    b, t = inference.shape
+    pos = jnp.arange(t)[None, :]
+    valid = pos < jnp.asarray(lengths)[:, None]
+
+    def spans(tags):
+        tags = jnp.asarray(tags).astype(jnp.int32)
+        inside_vocab = (tags >= 0) & (tags < num_chunk_types * tag_num) & valid
+        ctype = jnp.where(inside_vocab, tags // tag_num, -1)
+        tag = jnp.where(inside_vocab, tags % tag_num, -1)
+        prev_ctype = jnp.concatenate([jnp.full((b, 1), -1), ctype[:, :-1]], axis=1)
+        prev_tag = jnp.concatenate([jnp.full((b, 1), -1), tag[:, :-1]], axis=1)
+        if chunk_scheme == "plain":
+            begin = inside_vocab & (ctype != prev_ctype)
+        elif chunk_scheme == "IOB":
+            is_b, is_i = tag == 0, tag == 1
+            # B always begins; I begins when not continuing same type
+            cont = is_i & (prev_ctype == ctype) & ((prev_tag == 0) | (prev_tag == 1))
+            begin = inside_vocab & (is_b | (is_i & ~cont))
+        elif chunk_scheme == "IOE":
+            # I (tag 0) continues into the next same-type token; E closes
+            cont_prev = (prev_ctype == ctype) & (prev_tag == 0)
+            begin = inside_vocab & ~cont_prev
+        else:  # IOBES
+            is_b, is_i, is_e, is_s = tag == 0, tag == 1, tag == 2, tag == 3
+            cont = (is_i | is_e) & (prev_ctype == ctype) & ((prev_tag == 0) | (prev_tag == 1))
+            begin = inside_vocab & (is_b | is_s | ((is_i | is_e) & ~cont))
+        # continues[i]: token i+1 belongs to the chunk containing i
+        nxt_begin = jnp.concatenate([begin[:, 1:], jnp.ones((b, 1), bool)], axis=1)
+        nxt_inside = jnp.concatenate([inside_vocab[:, 1:], jnp.zeros((b, 1), bool)], axis=1)
+        nxt_ctype = jnp.concatenate([ctype[:, 1:], jnp.full((b, 1), -1)], axis=1)
+        continues = inside_vocab & nxt_inside & ~nxt_begin & (nxt_ctype == ctype)
+
+        # run-length of continues -> span end index per position
+        def back(carry, inp):
+            cont_t = inp
+            run = jnp.where(cont_t, carry + 1, 0)
+            return run, run
+        _, runs = jax.lax.scan(back, jnp.zeros((b,), jnp.int32),
+                               jnp.swapaxes(continues, 0, 1), reverse=True)
+        end = pos + jnp.swapaxes(runs, 0, 1)
+        return begin, ctype, end
+
+    h_begin, h_type, h_end = spans(inference)
+    r_begin, r_type, r_end = spans(label)
+    correct = h_begin & r_begin & (h_type == r_type) & (h_end == r_end)
+    return (jnp.sum(h_begin).astype(jnp.int32),
+            jnp.sum(r_begin).astype(jnp.int32),
+            jnp.sum(correct).astype(jnp.int32))

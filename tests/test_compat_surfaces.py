@@ -326,3 +326,82 @@ def test_save_params_forwards_state(tmp_path):
     pio.save_params(d, {"w": jnp.ones(2)}, state={"bn/mean": jnp.zeros(3)})
     _, state, _, _ = pio.load_persistables(d)
     assert "bn/mean" in state
+
+
+def test_chunk_eval_counts_vs_bruteforce():
+    """In-graph chunk_eval (IOB/IOBES/plain) vs a python span extractor."""
+    rng = np.random.RandomState(3)
+
+    def extract(tags, length, num_types, scheme):
+        """Independent chain-based span extractor: token j+1 joins the
+        chunk of token j iff same type and the scheme's (prev_tag,
+        next_tag) link rule holds; spans are maximal chains."""
+        tag_num = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+        tags = list(tags[:length])
+
+        def info(t):
+            if 0 <= t < num_types * tag_num:
+                return t // tag_num, t % tag_num
+            return None
+
+        def links(ptag, ntag, scheme):
+            if scheme == "IOB":
+                return ntag == 1 and ptag in (0, 1)
+            if scheme == "IOE":
+                return ptag == 0
+            if scheme == "IOBES":
+                return ntag in (1, 2) and ptag in (0, 1)
+            return True  # plain
+
+        spans, i = set(), 0
+        while i < length:
+            cur = info(tags[i])
+            if cur is None:
+                i += 1
+                continue
+            ctype, tag = cur
+            if scheme == "IOBES" and tag in (2, 3):   # E/S close immediately
+                spans.add((i, i, ctype))
+                i += 1
+                continue
+            j = i
+            while j + 1 < length:
+                nxt = info(tags[j + 1])
+                ptag = info(tags[j])[1]
+                if nxt is None or nxt[0] != ctype or not links(ptag, nxt[1], scheme):
+                    break
+                j += 1
+                if scheme == "IOBES" and info(tags[j])[1] == 2:   # E closes
+                    break
+            spans.add((i, j, ctype))
+            i = j + 1
+        return spans
+
+    for scheme in ("IOB", "IOE", "IOBES", "plain"):
+        tag_num = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+        num_types = 3
+        b, t = 4, 12
+        vocab = num_types * tag_num + 2            # includes O ids
+        hyp = rng.randint(0, vocab, (b, t))
+        ref = rng.randint(0, vocab, (b, t))
+        lengths = rng.randint(5, t + 1, (b,))
+        nh, nr, nc = M.chunk_eval_counts(jnp.asarray(hyp), jnp.asarray(ref),
+                                         jnp.asarray(lengths), num_types, scheme)
+        eh = er = ec = 0
+        for i in range(b):
+            sh = extract(hyp[i], lengths[i], num_types, scheme)
+            sr = extract(ref[i], lengths[i], num_types, scheme)
+            eh += len(sh); er += len(sr); ec += len(sh & sr)
+        assert (int(nh), int(nr), int(nc)) == (eh, er, ec), scheme
+
+
+def test_op_frequence_and_memory_usage():
+    from paddle_tpu import debugger
+    x = np.random.randn(4, 8).astype(np.float32)
+    prog = pt.build(lambda a: {"loss": L.mean(L.fc(a, 16, act="relu"))})
+    params, state = prog.init(jax.random.PRNGKey(0), x)
+    freq = debugger.op_frequence(prog, params, state, x)
+    assert freq.get("dot_general", 0) >= 1
+    mem = debugger.memory_usage(prog, params, state, x)
+    assert mem["param_mb"] > 0 and mem["activation_sum_mb"] > 0
+    assert mem["param_with_optimizer_mb"] == pytest.approx(3 * mem["param_mb"])

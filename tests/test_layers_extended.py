@@ -554,3 +554,72 @@ def test_layers_io_surface():
 
     ph = L.data("x", shape=[3, 4], dtype="float32")
     assert tuple(ph.shape) == (1, 3, 4)
+
+
+# ---------------------------------------------------------------------------
+# FD grad checks for new ops (op_test.py check_grad pattern)
+# ---------------------------------------------------------------------------
+
+from op_test import check_grad
+
+
+def test_grad_roi_align():
+    x = np.random.randn(1, 2, 6, 6).astype(np.float32)
+    rois = np.array([[1.0, 1.0, 4.0, 4.0]], np.float32)
+    check_grad(lambda im: L.roi_align(im, jnp.asarray(rois), jnp.asarray([0]), 2, 2),
+               [x])
+
+
+def test_grad_roi_pool():
+    x = np.random.randn(1, 2, 6, 6).astype(np.float32)
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    check_grad(lambda im: L.roi_pool(im, jnp.asarray(rois), jnp.asarray([0]), 2, 2),
+               [x])
+
+
+def test_grad_row_conv_weights():
+    # FD-check the REAL layer: grad wrt its created filter param
+    x = np.random.randn(2, 4, 3).astype(np.float32)
+    prog = pt.build(lambda a: L.row_conv(a, 2))
+    params, state = prog.init(jax.random.PRNGKey(0), x)
+    (wname,) = params.keys()
+
+    def fn(wv):
+        out, _ = prog.apply({wname: wv}, state, jnp.asarray(x))
+        return out
+    check_grad(fn, [np.asarray(params[wname])])
+
+
+def test_grad_sequence_conv_input_and_weights():
+    # FD-check the REAL layer: grads wrt input and created weight
+    seg = jnp.asarray(np.array([0, 0, 1, 1, 1], np.int32))
+    vals = np.random.randn(5, 3).astype(np.float32)
+    prog = pt.build(lambda v: L.sequence_conv(v, seg, num_filters=4, filter_size=3,
+                                              bias_attr=False))
+    params, state = prog.init(jax.random.PRNGKey(0), vals)
+    (wname,) = params.keys()
+
+    def fn_input(v):
+        out, _ = prog.apply(params, state, v)
+        return out
+    check_grad(fn_input, [vals])
+
+    def fn_weight(wv):
+        out, _ = prog.apply({wname: wv}, state, jnp.asarray(vals))
+        return out
+    check_grad(fn_weight, [np.asarray(params[wname])])
+
+
+def test_grad_polygon_and_affine():
+    x = np.random.randn(1, 2, 3, 4).astype(np.float32)
+    check_grad(lambda a: L.polygon_box_transform(a), [x])
+    theta = np.tile(np.array([[1.0, 0.1, 0], [0, 1.0, -0.1]], np.float32), (1, 1, 1))
+    check_grad(lambda t: L.affine_grid(t, (1, 2, 3, 4)), [theta])
+
+
+def test_grad_fused_ce_hidden():
+    from paddle_tpu.ops.fused_ce import chunked_softmax_cross_entropy
+    h = np.random.randn(4, 6).astype(np.float32)
+    w = jnp.asarray(np.random.randn(6, 10).astype(np.float32))
+    lab = jnp.asarray(np.array([1, 3, 9, 0]))
+    check_grad(lambda hv: chunked_softmax_cross_entropy(hv, w, None, lab, 0.1, 4), [h])
