@@ -317,8 +317,6 @@ def chunk_eval_counts(inference, label, lengths, num_chunk_types: int,
     feed ChunkEvaluator.update. A chunk is correct iff (start, end, type)
     all match, computed via begin-masks + run-length span ends (no host
     loop)."""
-    import jax
-
     tag_num = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[chunk_scheme]
     b, t = inference.shape
     pos = jnp.arange(t)[None, :]

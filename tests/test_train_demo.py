@@ -1,0 +1,37 @@
+"""C++ train demo (native/train_demo.cc = train/demo/demo_trainer.cc
+analog): compile with g++ and run end-to-end — C++ owns data
+generation, RecordIO IO, batching and the epoch loop; the embedded
+interpreter only loads the XLA runtime."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+NATIVE = os.path.join(os.path.dirname(__file__), "..", "paddle_tpu", "native")
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="g++ unavailable")
+def test_cpp_train_demo_compiles_and_converges(tmp_path):
+    import sys
+    import sysconfig
+
+    binary = str(tmp_path / "train_demo")
+    # derive embed flags from THE RUNNING interpreter — a PATH
+    # python3-config may describe a different python whose libpython
+    # can't import this venv's jax
+    ver = f"{sys.version_info.major}.{sys.version_info.minor}"
+    includes = [f"-I{sysconfig.get_path('include')}"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ldflags = [f"-L{libdir}", f"-lpython{ver}", "-ldl", "-lm"]
+    subprocess.check_call(
+        ["g++", "-O3", "-std=c++17", os.path.join(NATIVE, "train_demo.cc"),
+         os.path.join(NATIVE, "recordio.cc")] + includes + ldflags + ["-lz", "-o", binary])
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.pop("XLA_FLAGS", None)  # single CPU device is fine for the demo
+    out = subprocess.run([binary], env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "PASS" in out.stdout
