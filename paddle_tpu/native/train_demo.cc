@@ -166,6 +166,7 @@ int main() {
             (Py_ssize_t)batch.size(), kBatch);
         if (!res) {
           PyErr_Print();
+          std::remove(data_path);
           return 1;
         }
         total += PyFloat_AsDouble(res);
@@ -192,6 +193,7 @@ int main() {
 
   Py_DECREF(step_fn);
   Py_Finalize();
+  std::remove(data_path);
 
   if (last_epoch_loss < first_epoch_loss * 0.5) {
     std::printf("PASS: loss %.4f -> %.4f\n", first_epoch_loss, last_epoch_loss);
