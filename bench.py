@@ -94,6 +94,24 @@ def bench_transformer(batch_size=32, seq=256, dtype="float32"):
     return batch_size * seq / sec, "tokens/sec"
 
 
+def bench_vgg16(batch_size=64, image_size=224, dtype="float32"):
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models import vgg
+
+    model = pt.build(vgg.make_model(depth=16, class_num=1000))
+    rng = np.random.RandomState(0)
+    feeds = [{
+        "image": rng.randn(batch_size, 3, image_size, image_size).astype(dtype),
+        "label": rng.randint(0, 1000, (batch_size, 1)).astype(np.int64),
+    } for _ in range(2)]
+    trainer = pt.Trainer(model, opt.Momentum(0.01, 0.9), loss_name="loss",
+                         fetch_list=["loss"])
+    trainer.startup(sample_feed=feeds[0])
+    sec = _bench_loop(lambda f: trainer.step(f), feeds, trainer=trainer)
+    return batch_size / sec, "images/sec"
+
+
 def bench_mnist_mlp(batch_size=128):
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
@@ -131,7 +149,7 @@ def bench_lstm(batch_size=64, seq=128, hidden=512):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "transformer", "mnist_mlp", "lstm"])
+                   choices=["resnet50", "transformer", "mnist_mlp", "lstm", "vgg16"])
     p.add_argument("--batch_size", type=int, default=None)
     p.add_argument("--compute_dtype", default="bfloat16",
                    choices=["float32", "bfloat16"],
@@ -149,6 +167,7 @@ def main():
         "transformer": bench_transformer,
         "mnist_mlp": bench_mnist_mlp,
         "lstm": bench_lstm,
+        "vgg16": bench_vgg16,
     }[args.model](**kw)
 
     base = BASELINES.get(args.model)

@@ -13,7 +13,7 @@ from . import backward, clip, core, data, debugger, evaluator, framework, initia
 from . import io, layers, lr_scheduler, metrics, models, nets, optimizer
 from . import parallel, quantize, regularizer, sparse, transpiler
 from .core import CPUPlace, CUDAPlace, Place, TPUPlace, default_place
-from .executor import CheckpointConfig, Event, Executor, Scope, Trainer, fit
+from .executor import CheckpointConfig, Event, Executor, Inferencer, Scope, Trainer, fit
 from .framework import (
     LayerHelper,
     ParamAttr,

@@ -424,3 +424,35 @@ def _switch_scope(scope: Scope) -> Scope:
     global _global_scope
     old, _global_scope = _global_scope, scope
     return old
+
+
+class Inferencer:
+    """High-level inference wrapper (contrib/inferencer.py:31): build the
+    inference program fn, load a checkpoint, run batches.
+
+        inf = Inferencer(infer_fn, param_path="ckpt_dir")
+        out = inf.infer({"image": batch})
+
+    ``param_path`` may hold either a persistables checkpoint
+    (io.save_persistables / save_trainer) or explicit (params, state)."""
+
+    def __init__(self, infer_func: Callable, param_path: Optional[str] = None,
+                 params=None, state=None, place: Optional[Place] = None):
+        from .framework import build
+
+        self.program = infer_func if isinstance(infer_func, Program) else build(infer_func)
+        self.place = place or default_place()
+        if param_path is not None:
+            from . import io as _io
+            params, state, _, _ = _io.load_persistables(param_path)
+            enforce(bool(params),
+                    f"Inferencer: no parameters found in {param_path!r}")
+        enforce(params is not None, "Inferencer: need param_path or params")
+        dev = self.place.device()
+        self._params = jax.device_put(params, dev)
+        self._state = jax.device_put(state or {}, dev)
+        self._jit = jax.jit(functools.partial(self.program.apply, training=False))
+
+    def infer(self, inputs: Feed, return_numpy: bool = True):
+        out, _ = self._jit(self._params, self._state, **inputs)
+        return jax.device_get(out) if return_numpy else out

@@ -247,6 +247,7 @@ def save_sharded(dirname: str, tree: Dict[str, Any], async_save: bool = False):
     call wait_for_checkpoints() (or save again) before reading the dir."""
     import orbax.checkpoint  # noqa: F401  (fail loudly if unavailable)
 
+    wait_for_checkpoints()   # an in-flight async save may still own the dir
     path = os.path.abspath(dirname)
     if os.path.exists(path):
         import shutil
@@ -306,9 +307,17 @@ def load_trainer_sharded(dirname: str, trainer) -> None:
         "opt_state": trainer.scope.opt_state or {},
         "meta": {"global_step": 0},
     }
-    ls = getattr(trainer.scope, "loss_scale_state", None)
-    if ls:
-        target["loss_scale_state"] = ls
+    # key the optional loss-scaler entry off the CHECKPOINT's contents —
+    # a structure mismatch with the target makes orbax raise
+    import orbax.checkpoint as ocp
+    meta_tree = ocp.Checkpointer(ocp.StandardCheckpointHandler()).metadata(
+        os.path.abspath(dirname))
+    saved_keys = set(getattr(meta_tree, "item_metadata", meta_tree) or {})
+    if "loss_scale_state" in saved_keys:
+        ls = getattr(trainer.scope, "loss_scale_state", None)
+        target["loss_scale_state"] = ls or {"scale": jnp.float32(0),
+                                            "good_steps": jnp.int32(0),
+                                            "overflows": jnp.int32(0)}
     restored = load_sharded(dirname, target=target)
     trainer.scope.params = restored["params"]
     trainer.scope.state = restored["state"]

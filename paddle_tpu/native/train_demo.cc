@@ -118,11 +118,17 @@ def _step(batch_bytes, batch_size):
 
 }  // namespace
 
+struct FileGuard {  // remove the temp dataset on every exit path
+  const char* path;
+  ~FileGuard() { std::remove(path); }
+};
+
 int main() {
   // pid-tagged path so concurrent runs don't rewrite each other's data
   char data_path[128];
   std::snprintf(data_path, sizeof(data_path),
                 "/tmp/paddle_tpu_train_demo.%d.recordio", (int)getpid());
+  FileGuard guard{data_path};
   std::string err = WriteDataset(data_path);
   if (!err.empty()) {
     std::fprintf(stderr, "dataset: %s\n", err.c_str());
@@ -166,7 +172,6 @@ int main() {
             (Py_ssize_t)batch.size(), kBatch);
         if (!res) {
           PyErr_Print();
-          std::remove(data_path);
           return 1;
         }
         total += PyFloat_AsDouble(res);
@@ -193,7 +198,6 @@ int main() {
 
   Py_DECREF(step_fn);
   Py_Finalize();
-  std::remove(data_path);
 
   if (last_epoch_loss < first_epoch_loss * 0.5) {
     std::printf("PASS: loss %.4f -> %.4f\n", first_epoch_loss, last_epoch_loss);

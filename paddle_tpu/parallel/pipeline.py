@@ -86,6 +86,7 @@ def pipeline_apply(
     axis_name: str = "pp",
     microbatches: int = 4,
     batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
+    param_specs=None,
 ):
     """Run ``layer_fn`` over stacked layers pipelined across ``axis_name``.
 
@@ -93,12 +94,30 @@ def pipeline_apply(
     - stacked_params: pytree with leading [L, ...] axis per leaf, L
       divisible by the pp size; rank k owns layers [k·L/P, (k+1)·L/P).
     - layer_fn(activation, layer_params) -> activation.
+    - param_specs: optional pytree of PartitionSpecs for each leaf's
+      NON-layer dims (tensor parallelism inside a stage): e.g.
+      ``{"w1": P("tp"), "w2": P(None, "tp")}`` — composed after the
+      leading pp dim; layer_fn must then psum its tp partial sums
+      (Megatron pattern), making dp×tp×pp 3D parallelism one call.
     """
     if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
-        def one(a, lp):
-            return layer_fn(a, lp), None
-        out, _ = jax.lax.scan(one, x, stacked_params)
-        return out
+        def _seq(xv, sp):
+            def one(a, lp):
+                return layer_fn(a, lp), None
+            out, _ = jax.lax.scan(one, xv, sp)
+            return out
+        if param_specs is None:
+            return _seq(x, stacked_params)
+        # degenerate pipeline but tp-parallel stages: layer_fn uses mesh
+        # collectives, so it still needs to run under shard_map
+        bspec = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+        bshard = bspec if len(bspec) > 1 else (bspec[0] if bspec else None)
+        x_spec = P(bshard, *([None] * (x.ndim - 1)))
+        param_spec = jax.tree.map(
+            lambda leaf, extra: P(None, *(tuple(extra) + (None,) * (leaf.ndim - 1 - len(extra)))),
+            stacked_params, param_specs)
+        return jax.shard_map(_seq, mesh=mesh, in_specs=(x_spec, param_spec),
+                             out_specs=x_spec, check_vma=False)(x, stacked_params)
 
     p = mesh.shape[axis_name]
     L = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -111,14 +130,23 @@ def pipeline_apply(
     bspec = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
     bshard = bspec if len(bspec) > 1 else (bspec[0] if bspec else None)
     x_spec = P(None, bshard, *([None] * (x.ndim - 1)))
-    param_spec = jax.tree.map(lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))),
-                              stacked_params)
+    if param_specs is None:
+        param_spec = jax.tree.map(lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))),
+                                  stacked_params)
+    else:
+        param_spec = jax.tree.map(
+            lambda leaf, extra: P(axis_name, *(tuple(extra) + (None,) * (leaf.ndim - 1 - len(extra)))),
+            stacked_params, param_specs)
 
     body = functools.partial(
         _pp_body, layer_fn=layer_fn, axis_name=axis_name,
         microbatches=microbatches, layers_per_stage=L // p,
         varying_axes=tuple(mesh.axis_names))
+    # with in-stage tensor parallelism the carried activation is
+    # tp-invariant only because layer_fn psums — beyond the static
+    # varying-axes analysis, so drop the VMA check in that case
     out = jax.shard_map(body, mesh=mesh,
                         in_specs=(x_spec, param_spec),
-                        out_specs=x_spec)(xm, stacked_params)
+                        out_specs=x_spec,
+                        check_vma=param_specs is None)(xm, stacked_params)
     return out.reshape((b,) + x.shape[1:])

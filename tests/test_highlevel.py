@@ -114,3 +114,34 @@ def test_amp_guard_scoped():
     with pt.amp_guard("bfloat16"):
         assert compute_dtype() == jnp.bfloat16
     assert compute_dtype() == jnp.float32
+
+
+def test_inferencer(tmp_path):
+    import jax
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import io as pio, layers as L, optimizer as opt
+
+    def net(image, label):
+        logits = L.fc(image, 3, name="clf")
+        return {"loss": L.mean(L.softmax_with_cross_entropy(logits, label)),
+                "logits": logits}
+
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.randn(4, 6).astype(np.float32),
+            "label": rng.randint(0, 3, (4, 1)).astype(np.int64)}
+    prog = pt.build(net)
+    tr = pt.Trainer(prog, opt.SGD(0.1), loss_name="loss")
+    tr.startup(sample_feed=feed)
+    tr.step(feed)
+    d = str(tmp_path / "ck")
+    pio.save_persistables(d, tr.scope.params, tr.scope.state)
+
+    def infer_net(image):
+        return {"logits": L.fc(image, 3, name="clf")}
+
+    inf = pt.Inferencer(infer_net, param_path=d)
+    out = inf.infer({"image": feed["image"]})
+    ref, _ = prog.apply(tr.scope.params, tr.scope.state, **feed)
+    np.testing.assert_allclose(out["logits"], np.asarray(ref["logits"]),
+                               rtol=1e-5, atol=1e-5)
