@@ -73,13 +73,14 @@ def bench_resnet50(batch_size=64, image_size=224, dtype="float32"):
     return batch_size / sec, "images/sec"
 
 
-def bench_transformer(batch_size=32, seq=256, dtype="float32"):
+def _bench_transformer_config(batch_size, seq, dtype, dropout, max_len=256):
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
     from paddle_tpu.models import transformer
 
-    cfg = transformer.base_config(src_vocab=32000, trg_vocab=32000, dropout=0.1,
-                                  dtype=dtype, use_flash=True, fused_ce=True)
+    cfg = transformer.base_config(src_vocab=32000, trg_vocab=32000, dropout=dropout,
+                                  max_len=max_len, dtype=dtype, use_flash=True,
+                                  fused_ce=True)
     model = pt.build(transformer.make_model(cfg))
     rng = np.random.RandomState(0)
     feeds = [{
@@ -92,6 +93,17 @@ def bench_transformer(batch_size=32, seq=256, dtype="float32"):
     trainer.startup(sample_feed=feeds[0])
     sec = _bench_loop(lambda f: trainer.step(f), feeds, trainer=trainer)
     return batch_size * seq / sec, "tokens/sec"
+
+
+def bench_transformer(batch_size=32, seq=256, dtype="float32"):
+    return _bench_transformer_config(batch_size, seq, dtype, dropout=0.1)
+
+
+def bench_transformer_long(batch_size=4, seq=4096, dtype="float32"):
+    """Long-context train step: flash attention pallas kernel (dense
+    attention at this length is ~26x slower / memory-bound)."""
+    return _bench_transformer_config(batch_size, seq, dtype, dropout=0.0,
+                                     max_len=seq)
 
 
 def bench_vgg16(batch_size=64, image_size=224, dtype="float32"):
@@ -149,7 +161,7 @@ def bench_lstm(batch_size=64, seq=128, hidden=512):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "transformer", "mnist_mlp", "lstm", "vgg16"])
+                   choices=["resnet50", "transformer", "transformer_long", "mnist_mlp", "lstm", "vgg16"])
     p.add_argument("--batch_size", type=int, default=None)
     p.add_argument("--compute_dtype", default="bfloat16",
                    choices=["float32", "bfloat16"],
@@ -165,6 +177,7 @@ def main():
     value, unit = {
         "resnet50": bench_resnet50,
         "transformer": bench_transformer,
+        "transformer_long": bench_transformer_long,
         "mnist_mlp": bench_mnist_mlp,
         "lstm": bench_lstm,
         "vgg16": bench_vgg16,

@@ -323,5 +323,8 @@ def load_trainer_sharded(dirname: str, trainer) -> None:
     trainer.scope.state = restored["state"]
     trainer.scope.opt_state = restored["opt_state"] or None
     trainer.global_step = int(restored["meta"]["global_step"])
-    if "loss_scale_state" in restored:
+    # only adopt scaler state if this trainer actually runs a scaler —
+    # step() donates the buffer and only a scaler refreshes it, so a
+    # scaler-less trainer holding it would pass deleted arrays on step 2
+    if "loss_scale_state" in restored and trainer.loss_scaler is not None:
         trainer.scope.loss_scale_state = restored["loss_scale_state"]

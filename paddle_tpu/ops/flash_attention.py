@@ -34,11 +34,17 @@ NEG_INF = -1e30
 
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
                 scale: float, causal: bool, block_k: int, seq_k: int):
-    # q_ref: (block_q, d); k_ref/v_ref: (seq_k, d); bias_ref: (1, seq_k) or None
-    block_q = q_ref.shape[0]
-    d = q_ref.shape[1]
+    # Blocks carry a leading singleton (batch·head) dim; index it in the
+    # LOADS, never via ``ref.at[0]`` — a sub-ref slices the memref, and
+    # Mosaic requires lane-dim (last-dim) slices aligned to the 128
+    # tiling, which head_dim 64 is not.
+    # q_ref: (1, block_q, d); k_ref/v_ref: (1, seq_k, d);
+    # bias_ref: (1, 1, seq_k) or None; o_ref: (1, block_q, d);
+    # lse_ref: (1, 1, block_q)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
     qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * scale
+    q = q_ref[0].astype(jnp.float32) * scale
 
     num_k_blocks = pl.cdiv(seq_k, block_k)
     if causal:
@@ -49,12 +55,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
 
     def body(j, carry):
         m_prev, l_prev, acc = carry
-        kb = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if bias_ref is not None:
-            s = s + bias_ref[pl.ds(j * block_k, block_k)][None, :]
+            s = s + bias_ref[0, 0, pl.ds(j * block_k, block_k)][None, :]
         if causal:
             q_idx = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_idx = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -72,8 +78,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, last, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
-    o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[:] = (m + jnp.log(l_safe))[None, :]
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe))[None, :]
 
 
 def _flash_fwd(q, k, v, bias, causal: bool, block_q: int, block_k: int,
@@ -90,15 +96,16 @@ def _flash_fwd(q, k, v, bias, causal: bool, block_q: int, block_k: int,
     nq = pl.cdiv(sq, block_q)
 
     in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), memory_space=pltpu.ANY
-                     if False else pltpu.VMEM),
+        pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
         pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
         pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
     ]
     args = [q_r, k_r, v_r]
     if bias is not None:
-        bias_r = jnp.broadcast_to(bias[:, None, :], (b, h, sk)).reshape(bh, sk)
-        in_specs.append(pl.BlockSpec((1, sk), lambda i, j: (i, 0),
+        # 3-d (bh, 1, sk) so the block's last two dims equal the array's
+        # (Mosaic requires last-two divisible by (8,128) or full-size)
+        bias_r = jnp.broadcast_to(bias[:, None, :], (b, h, sk)).reshape(bh, 1, sk)
+        in_specs.append(pl.BlockSpec((1, 1, sk), lambda i, j: (i, 0, 0),
                                      memory_space=pltpu.VMEM))
         args.append(bias_r)
 
@@ -108,9 +115,7 @@ def _flash_fwd(q, k, v, bias, causal: bool, block_q: int, block_k: int,
         else:
             q_ref, k_ref, v_ref, o_ref, lse_ref = refs
             b_ref = None
-        _fwd_kernel(q_ref.at[0], k_ref.at[0], v_ref.at[0],
-                    b_ref.at[0] if b_ref is not None else None,
-                    o_ref.at[0], lse_ref.at[0],
+        _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
                     scale=scale, causal=causal, block_k=block_k, seq_k=sk)
 
     out, lse = pl.pallas_call(
