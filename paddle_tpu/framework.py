@@ -491,8 +491,16 @@ class WeightNormParamAttr(ParamAttr):
 
 def _weight_norm_reparam(p: jax.Array, attr: "WeightNormParamAttr", full: str,
                          ctx: "BuildContext") -> jax.Array:
-    dim = attr.dim if attr.dim is not None else 0
-    axes = tuple(a for a in range(p.ndim) if a != dim)
+    # dim=None = norm over ALL axes (scalar g), matching the reference's
+    # layer_helper __norm_except_dim; an integer dim keeps a per-slice g
+    dim = attr.dim
+    if dim is None:
+        axes = tuple(range(p.ndim))
+        shape = [1] * p.ndim
+    else:
+        axes = tuple(a for a in range(p.ndim) if a != dim)
+        shape = [1] * p.ndim
+        shape[dim] = p.shape[dim]
     gname = full + "@wn_g"
     norm = jnp.sqrt(jnp.sum(jnp.square(p), axis=axes) + 1e-12)
     if ctx.mode == "init" and gname not in ctx.params:
@@ -502,6 +510,4 @@ def _weight_norm_reparam(p: jax.Array, attr: "WeightNormParamAttr", full: str,
             learning_rate=attr.learning_rate, regularizer=None,
             is_distributed=False)
     g = ctx.params[gname]
-    shape = [1] * p.ndim
-    shape[dim] = p.shape[dim]
     return p / norm.reshape(shape) * g.reshape(shape)

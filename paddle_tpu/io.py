@@ -265,6 +265,7 @@ def load_sharded(dirname: str, target: Optional[Dict[str, Any]] = None):
     _load_slice_up_vars analog)."""
     import orbax.checkpoint as ocp
 
+    wait_for_checkpoints()   # an in-flight async save may still own the dir
     ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
     path = os.path.abspath(dirname)
     if target is None:

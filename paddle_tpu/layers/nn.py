@@ -1087,9 +1087,16 @@ def crop(x, shape=None, offsets=None, name=None):
 def random_crop(x, shape, seed=None, name=None):
     """Random crop over trailing dims (random_crop_op.cc). ``shape``
     covers the last len(shape) dims; leading dims are kept whole."""
+    from ..core.errors import enforce
+
     key = jax.random.PRNGKey(seed) if seed is not None else next_rng_key()
     nlead = x.ndim - len(shape)
+    enforce(nlead >= 0,
+            f"random_crop: crop rank {len(shape)} exceeds input rank {x.ndim}")
     lead = x.shape[:nlead]
+    enforce(all(x.shape[nlead + i] >= s for i, s in enumerate(shape)),
+            f"random_crop: crop shape {tuple(shape)} exceeds input dims "
+            f"{x.shape[nlead:]}")
     maxs = jnp.array([x.shape[nlead + i] - s for i, s in enumerate(shape)])
     offs = jnp.floor(jax.random.uniform(key, (len(shape),)) * (maxs + 1)).astype(jnp.int32)
     starts = [jnp.int32(0)] * nlead + [offs[i] for i in range(len(shape))]

@@ -314,8 +314,11 @@ def autoincreased_step_counter(counter_name=None, begin: int = 1, step: int = 1)
     from .. import initializer as init
 
     helper = LayerHelper("step_counter", name=counter_name or "step_counter")
-    cnt = helper.create_variable("value", (1,), jnp.int64,
+    # int64 only when x64 is on; otherwise JAX silently truncates to
+    # int32 with a UserWarning, so ask for int32 up front
+    ctype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    cnt = helper.create_variable("value", (1,), ctype,
                                  initializer=init.Constant(float(begin - step)))
-    new = cnt + jnp.int64(step)
+    new = cnt + ctype(step)
     helper.assign_variable("value", new)
     return new

@@ -245,14 +245,24 @@ def auc(input, label, curve: str = "ROC", num_thresholds: int = 200, name=None):
     from .framework import LayerHelper
     from . import initializer as init
 
+    from .core.errors import enforce
+
+    enforce(curve in ("ROC", "PR"), f"auc: unknown curve {curve!r}")
     helper = LayerHelper("auc", name=name)
     tp_b, fp_b = auc_stat(input[:, 1], jnp.asarray(label), num_thresholds)
 
     def _auc(tp_hist, fp_hist):
-        # cumulative from the highest threshold down = ROC sweep,
-        # anchored at (0,0) so the final segment is included
+        # cumulative from the highest threshold down; ROC anchored at
+        # (0,0), PR anchored at (recall 0, precision 1)
         tp_c = jnp.cumsum(tp_hist[::-1]).astype(jnp.float32)
         fp_c = jnp.cumsum(fp_hist[::-1]).astype(jnp.float32)
+        if curve == "PR":
+            recall = jnp.concatenate([jnp.zeros(1), tp_c]) / jnp.maximum(tp_c[-1], 1e-8)
+            # precision is 1 by convention while no prediction is positive
+            prec = jnp.where(tp_c + fp_c > 0, tp_c / jnp.maximum(tp_c + fp_c, 1e-8), 1.0)
+            precision = jnp.concatenate([jnp.ones(1), prec])
+            return jnp.sum((recall[1:] - recall[:-1])
+                           * (precision[1:] + precision[:-1]) / 2.0)
         tpr = jnp.concatenate([jnp.zeros(1), tp_c]) / jnp.maximum(tp_c[-1], 1e-8)
         fpr = jnp.concatenate([jnp.zeros(1), fp_c]) / jnp.maximum(fp_c[-1], 1e-8)
         return jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) / 2.0)

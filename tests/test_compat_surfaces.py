@@ -405,3 +405,19 @@ def test_op_frequence_and_memory_usage():
     mem = debugger.memory_usage(prog, params, state, x)
     assert mem["param_mb"] > 0 and mem["activation_sum_mb"] > 0
     assert mem["param_with_optimizer_mb"] == pytest.approx(3 * mem["param_mb"])
+
+
+def test_weight_norm_default_dim_scalar_g():
+    """dim=None norms over ALL axes (scalar g), matching the reference's
+    layer_helper __norm_except_dim(dim=None)."""
+    x = np.random.randn(4, 6).astype(np.float32)
+    prog = pt.build(lambda a: L.fc(a, 3, name="wn0",
+                                   param_attr=pt.WeightNormParamAttr()))
+    params, state = prog.init(jax.random.PRNGKey(0), x)
+    g = np.asarray(params["wn0/w@wn_g"])
+    assert g.shape == (), f"expected scalar g, got shape {g.shape}"
+    v = np.asarray(params["wn0/w"])
+    np.testing.assert_allclose(g, np.linalg.norm(v), rtol=1e-5)
+    out, _ = prog.apply(params, state, x)
+    np.testing.assert_allclose(np.asarray(out), x @ v + np.asarray(params["wn0/b"]),
+                               rtol=1e-4, atol=1e-5)

@@ -191,3 +191,37 @@ def test_save_load_inference_model():
                                    rtol=1e-5, atol=1e-5)
         out2 = pred.clone().run({"image": x, "label": y})
         np.testing.assert_allclose(np.asarray(out2["loss"]), np.asarray(out["loss"]), rtol=1e-6)
+
+
+def test_auc_layer_pr_curve():
+    """curve='PR' integrates precision over recall (auc_op PR mode) rather
+    than silently returning ROC."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.core.errors import EnforceError
+
+    labels = np.array([0] * 50 + [1] * 50, np.int64)
+    probs = np.stack([1 - (labels * 0.8 + 0.1), labels * 0.8 + 0.1], axis=1)
+
+    def f(p, lab, curve):
+        val, batch_val = metrics.auc(p, lab, curve=curve, num_thresholds=200)
+        return {"v": val, "b": batch_val}
+
+    import functools
+    for curve, expect in (("PR", 1.0), ("ROC", 1.0)):
+        prog = pt.build(functools.partial(f, curve=curve))
+        params, state = prog.init(jax.random.PRNGKey(0), probs, labels)
+        out, _ = prog.apply(params, state, probs, labels)
+        assert float(out["v"]) > 0.99, (curve, float(out["v"]))
+    # random scores: ROC auc ~0.5 but PR auc ~positive fraction; both finite
+    rng = np.random.RandomState(0)
+    p2 = rng.rand(2000)
+    lab2 = np.concatenate([np.ones(200, np.int64), np.zeros(1800, np.int64)])
+    probs2 = np.stack([1 - p2, p2], axis=1)
+    prog = pt.build(functools.partial(f, curve="PR"))
+    params, state = prog.init(jax.random.PRNGKey(0), probs2, lab2)
+    out, _ = prog.apply(params, state, probs2, lab2)
+    assert 0.03 < float(out["v"]) < 0.35  # near the 10% positive base rate
+    with pytest.raises(EnforceError):
+        pt.build(functools.partial(f, curve="XX")).init(
+            jax.random.PRNGKey(0), probs, labels)

@@ -623,3 +623,25 @@ def test_grad_fused_ce_hidden():
     w = jnp.asarray(np.random.randn(6, 10).astype(np.float32))
     lab = jnp.asarray(np.array([1, 3, 9, 0]))
     check_grad(lambda hv: chunked_softmax_cross_entropy(hv, w, None, lab, 0.1, 4), [h])
+
+
+def test_random_crop_oversize_raises():
+    from paddle_tpu.core.errors import EnforceError
+    x = np.zeros((1, 4, 4), np.float32)
+    with pytest.raises(EnforceError):
+        L.random_crop(jnp.asarray(x), (8, 8), seed=0)
+
+
+def test_step_counter_int32_no_x64_warning():
+    import warnings
+
+    def f(x):
+        return x, L.autoincreased_step_counter()
+
+    prog = pt.build(f)
+    x = np.zeros((1,), np.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any truncation UserWarning fails
+        params, state = prog.init(jax.random.PRNGKey(0), x)
+        (_, step), _ = prog.apply(params, state, x)
+    assert int(np.asarray(step)[0]) == 1
