@@ -39,5 +39,10 @@ from .transpiler import (
     release_memory,
 )
 from .parallel import DistStrategy, ShardingRules, make_mesh
+from .core.config import enable_determinism
+
+# honor PDTPU_DETERMINISTIC=1 before any backend work happens
+if core.config.get_flag("deterministic"):
+    enable_determinism()
 
 __version__ = "0.1.0"

@@ -56,6 +56,45 @@ def flags() -> Dict[str, Any]:
     return {k: f.value for k, f in _REGISTRY.items()}
 
 
+_determinism_saved: Dict[str, Any] = {}
+
+
+def enable_determinism() -> None:
+    """Wire the ``deterministic`` flag (FLAGS_cpu_deterministic analog)
+    to real knobs: bitwise-reproducible matmul precision, the
+    sharding-invariant threefry RNG, and XLA's deterministic-ops flag
+    for any backend initialized after this call. Invoked automatically
+    at package import when ``PDTPU_DETERMINISTIC=1``."""
+    import jax
+
+    if not _determinism_saved:
+        _determinism_saved["matmul_precision"] = jax.config.jax_default_matmul_precision
+        _determinism_saved["threefry"] = jax.config.jax_threefry_partitionable
+    jax.config.update("jax_default_matmul_precision", "highest")
+    jax.config.update("jax_threefry_partitionable", True)
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_gpu_deterministic_ops=false" in xla_flags:
+        xla_flags = xla_flags.replace("--xla_gpu_deterministic_ops=false",
+                                      "--xla_gpu_deterministic_ops=true")
+        os.environ["XLA_FLAGS"] = xla_flags
+    elif "--xla_gpu_deterministic_ops" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (xla_flags + " --xla_gpu_deterministic_ops=true").strip()
+    set_flag("deterministic", True)
+
+
+def disable_determinism() -> None:
+    """Restore the jax-config state captured by :func:`enable_determinism`
+    (the XLA env flag only affects backends not yet initialized)."""
+    import jax
+
+    if _determinism_saved:
+        jax.config.update("jax_default_matmul_precision",
+                          _determinism_saved.pop("matmul_precision"))
+        jax.config.update("jax_threefry_partitionable",
+                          _determinism_saved.pop("threefry"))
+    set_flag("deterministic", False)
+
+
 # Core flags — counterparts of the whitelisted gflags the reference
 # re-reads from env (fluid/__init__.py:112-133).
 define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf (FLAGS_check_nan_inf analog)")

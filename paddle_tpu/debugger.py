@@ -128,3 +128,31 @@ def memory_usage(program, params, state, *args, **kwargs) -> Dict[str, float]:
         "param_with_optimizer_mb": 3 * param_bytes / 1e6,
         "activation_sum_mb": act[0] / 1e6,
     }
+
+
+def compiled_memory_usage(trainer, feed) -> Dict[str, float]:
+    """Buffer-assignment memory of the Trainer's compiled train step —
+    the runtime-accurate sibling of :func:`memory_usage` (the reference's
+    DESC-walk estimate, contrib/memory_usage_calc.py): lowers the jitted
+    step for the current scope + feed shapes and reads XLA's
+    ``memory_analysis()``. The ``temp_mb`` delta is how remat/donation
+    knobs are verified (memory_optimization_transpiler.py:456 analog)."""
+    import jax.random as jrandom
+
+    from .core.errors import enforce
+
+    enforce(trainer._step_fn is not None, "call startup() before compiled_memory_usage()")
+    feed = trainer._put_feed(feed)
+    ls = getattr(trainer.scope, "loss_scale_state", None) or {}
+    lowered = trainer._step_fn.lower(trainer.scope.params, trainer.scope.opt_state,
+                                     trainer.scope.state, jrandom.PRNGKey(0),
+                                     feed, ls)
+    ma = lowered.compile().memory_analysis()
+    if ma is None:
+        return {}
+    return {
+        "temp_mb": ma.temp_size_in_bytes / 1e6,
+        "argument_mb": ma.argument_size_in_bytes / 1e6,
+        "output_mb": ma.output_size_in_bytes / 1e6,
+        "generated_code_mb": ma.generated_code_size_in_bytes / 1e6,
+    }

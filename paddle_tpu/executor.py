@@ -207,7 +207,14 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def _loss_and_aux(self, params, state, rng, feed):
-        out, new_state = self.program.apply(params, state, training=True, rng=rng, **feed)
+        from .framework import remat_mode
+
+        # strategy.remat (memory_optimize analog) flips the ambient
+        # trace-time switch; zoo models wrap their repeated blocks in
+        # maybe_remat, so jax.checkpoint lands per block
+        with remat_mode(bool(getattr(self.strategy, "remat", False))):
+            out, new_state = self.program.apply(params, state, training=True,
+                                                rng=rng, **feed)
         if isinstance(out, dict):
             loss = out[self.loss_name]
         else:

@@ -452,6 +452,50 @@ def build(fn: Callable, name: Optional[str] = None) -> Program:
 # swap with program_guard — the structural shape fluid scripts expect.
 # --------------------------------------------------------------------------
 
+_remat_mode = threading.local()
+
+
+@contextlib.contextmanager
+def remat_mode(enabled: bool = True):
+    """Ambient rematerialization switch (memory_optimization_transpiler
+    analog, consumed at trace time). Trainer enters this around
+    ``program.apply`` when ``DistStrategy.remat`` is set; zoo models
+    check it via :func:`maybe_remat` around their repeated blocks, so
+    ``memory_optimize()`` turns on per-block ``jax.checkpoint`` without
+    the model config having to opt in."""
+    old = getattr(_remat_mode, "on", False)
+    _remat_mode.on = bool(enabled)
+    try:
+        yield
+    finally:
+        _remat_mode.on = old
+
+
+def remat_enabled() -> bool:
+    return getattr(_remat_mode, "on", False)
+
+
+def maybe_remat(fn: Callable, enabled: Optional[bool] = None,
+                policy: Optional[Callable] = None) -> Callable:
+    """Wrap ``fn`` in ``jax.checkpoint`` when remat is requested — either
+    explicitly (``enabled=True``, e.g. a model config flag) or ambiently
+    (``enabled=None`` and :func:`remat_enabled`). Activations inside the
+    block are recomputed in the backward pass; only the block inputs (and
+    anything ``policy`` saves) stay live — the TPU trade of HBM for MXU
+    FLOPs that the reference's liveness-based var reuse approximated
+    (memory_optimization_transpiler.py:456).
+
+    Never wraps during init-mode builds: jax.checkpoint traces its body,
+    and init-mode create_parameter writes eager arrays into the build
+    context as a side effect — tracing would leak tracers into params."""
+    ctx = current_context()
+    if ctx is not None and ctx.mode == "init":
+        return fn
+    if enabled or (enabled is None and remat_enabled()):
+        return jax.checkpoint(fn, policy=policy)
+    return fn
+
+
 _default_programs: List["Program"] = []
 
 

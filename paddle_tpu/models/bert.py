@@ -10,7 +10,7 @@ import dataclasses
 import jax.numpy as jnp
 
 from .. import layers as L
-from ..framework import LayerHelper, name_scope
+from ..framework import LayerHelper, maybe_remat, name_scope
 from ..layers import attention as A
 from .. import initializer as init
 from .transformer import TransformerConfig, encoder_layer
@@ -27,6 +27,8 @@ class BertConfig:
     num_layers: int = 12
     dropout: float = 0.1
     use_flash: bool = False
+    # per-block jax.checkpoint over encoder layers (memory_optimize analog)
+    remat: bool = False
     dtype: str = "float32"
 
 
@@ -54,7 +56,9 @@ def encode(input_ids, token_type_ids, cfg: BertConfig):
                              use_flash=cfg.use_flash, dtype=cfg.dtype)
     with name_scope("encoder"):
         for _ in range(cfg.num_layers):
-            x = encoder_layer(x, tcfg, mask)
+            # fresh wrapper per layer (jax.checkpoint caches per fn object)
+            x = maybe_remat(lambda a, m: encoder_layer(a, tcfg, m),
+                            enabled=cfg.remat or None)(x, mask)
         x = L.layer_norm(x, begin_norm_axis=2)
     return x
 

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import layers as L
-from ..framework import LayerHelper, name_scope
+from ..framework import LayerHelper, maybe_remat, name_scope
 from ..layers import attention as A
 from ..ops.fused_ce import chunked_softmax_cross_entropy
 from .. import initializer as init
@@ -39,6 +39,10 @@ class TransformerConfig:
     # chunked logits-free CE (ops/fused_ce.py); chunk = vocab tile width
     fused_ce: bool = False
     ce_chunk: int = 4096
+    # per-block jax.checkpoint: drop intra-layer activations, recompute
+    # in backward (memory_optimize analog). False still honors the
+    # ambient framework.remat_mode the Trainer sets from strategy.remat.
+    remat: bool = False
     dtype: str = "float32"
 
 
@@ -92,7 +96,11 @@ def encode(src_ids, cfg: TransformerConfig):
     mask = A.padding_mask(src_ids)
     with name_scope("encoder"):
         for _ in range(cfg.num_encoder_layers):
-            x = encoder_layer(x, cfg, mask)
+            # fresh wrapper per layer: jax.checkpoint caches the traced
+            # body per fn object, and each layer must trace (and create
+            # its own params) separately
+            x = maybe_remat(lambda a, m: encoder_layer(a, cfg, m),
+                            enabled=cfg.remat or None)(x, mask)
         x = L.layer_norm(x, begin_norm_axis=2)
     return x, mask
 
@@ -106,7 +114,8 @@ def decode_hidden(trg_ids, enc_out, cross_mask, cfg: TransformerConfig):
     x = L.dropout(x, cfg.dropout, dropout_implementation="upscale_in_train")
     with name_scope("decoder"):
         for _ in range(cfg.num_decoder_layers):
-            x = decoder_layer(x, enc_out, cfg, None, cross_mask)
+            x = maybe_remat(lambda a, e, cm: decoder_layer(a, e, cfg, None, cm),
+                            enabled=cfg.remat or None)(x, enc_out, cross_mask)
         x = L.layer_norm(x, begin_norm_axis=2)
     helper = LayerHelper("logits_proj")
     w = helper.create_parameter("w", (cfg.d_model, cfg.trg_vocab), dtype,

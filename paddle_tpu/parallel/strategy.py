@@ -19,6 +19,8 @@ class DistStrategy:
     # params; 'sharded' (fsdp) shards params+optimizer state.
     reduce_strategy: str = "allreduce"
     # donation / rematerialization knobs (memory_optimize analog).
+    # remat flips framework.remat_mode during the Trainer's trace: zoo
+    # models' maybe_remat blocks become per-block jax.checkpoint.
     donate_buffers: bool = True
     remat: bool = False
     # loss scaling for mixed precision: a float enables scaling at that

@@ -132,7 +132,10 @@ def memory_optimize(input_program=None, skip_opt_set=None, print_log: bool = Fal
     """memory_optimization_transpiler.py:456 analog. The liveness-based
     var-reuse rewrite is XLA's buffer assignment; the user-controllable
     parts are donation + rematerialization. Returns a DistStrategy with
-    remat enabled — pass it to the Trainer."""
+    remat enabled — pass it to the Trainer, which flips the trace-time
+    framework.remat_mode switch so zoo models' maybe_remat blocks compile
+    to per-block jax.checkpoint (verify the delta with
+    debugger.compiled_memory_usage)."""
     s = DistStrategy()
     s.remat = True
     return s
