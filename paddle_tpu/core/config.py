@@ -70,6 +70,7 @@ def enable_determinism() -> None:
     if not _determinism_saved:
         _determinism_saved["matmul_precision"] = jax.config.jax_default_matmul_precision
         _determinism_saved["threefry"] = jax.config.jax_threefry_partitionable
+        _determinism_saved["xla_flags"] = os.environ.get("XLA_FLAGS")
     jax.config.update("jax_default_matmul_precision", "highest")
     jax.config.update("jax_threefry_partitionable", True)
     xla_flags = os.environ.get("XLA_FLAGS", "")
@@ -92,6 +93,11 @@ def disable_determinism() -> None:
                           _determinism_saved.pop("matmul_precision"))
         jax.config.update("jax_threefry_partitionable",
                           _determinism_saved.pop("threefry"))
+        old_xla = _determinism_saved.pop("xla_flags")
+        if old_xla is None:
+            os.environ.pop("XLA_FLAGS", None)
+        else:
+            os.environ["XLA_FLAGS"] = old_xla
     set_flag("deterministic", False)
 
 

@@ -1,9 +1,33 @@
-"""Layer library — the ``fluid.layers`` surface (python/paddle/fluid/layers/)."""
+"""Layer library — the ``fluid.layers`` surface (python/paddle/fluid/layers/).
+
+Every name in the union of the reference's ``layers/*`` ``__all__``
+lists (199 public + 5 layer_function_generator helpers) is importable
+from this namespace — tests/test_layers_parity.py pins the full list so
+the claim cannot drift."""
 
 from . import attention, beam_search, control_flow, crf, ctc, detection
-from . import io, nn, ops, rnn, sequence, tensor
+from . import io, layer_function_generator, nn, ops, rnn, sequence, tensor
 from .beam_search import beam_search_decode
-from .control_flow import DynamicRNN, IfElse, StaticRNN, Switch, While
+from .control_flow import (
+    DynamicRNN,
+    IfElse,
+    Print,
+    StaticRNN,
+    Switch,
+    While,
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+)
+from .crf import crf_decoding, linear_chain_crf
+from .layer_function_generator import (
+    autodoc,
+    deprecated,
+    generate_layer_fn,
+    generate_layer_fn_noattr,
+    templatedoc,
+)
 from .ctc import ctc_greedy_decoder, edit_distance, warpctc
 from .io import (
     Preprocessor,
@@ -11,6 +35,7 @@ from .io import (
     batch,
     data,
     double_buffer,
+    load,
     open_files,
     py_reader,
     random_data_generator,
@@ -59,9 +84,39 @@ from .rnn import (
 from .sequence import (
     lod_reset,
     reorder_lod_tensor_by_rank,
+    sequence_concat,
     sequence_conv,
+    sequence_enumerate,
+    sequence_expand,
     sequence_expand_as,
+    sequence_first_step,
+    sequence_last_step,
+    sequence_mask,
+    sequence_pad,
+    sequence_pool,
     sequence_reshape,
+    sequence_reverse,
     sequence_scatter,
+    sequence_slice,
+    sequence_softmax,
+    sequence_unpad,
 )
 from .tensor import *  # noqa: F401,F403
+from .tensor import _sum_layer as sum  # noqa: A004  (reference API name)
+
+# names the reference's fluid.layers re-exports from sibling modules:
+# metric ops (layers/metric_op.py), LR decays
+# (layers/learning_rate_scheduler.py), and create_parameter
+# (layers/tensor.py → our framework)
+from ..framework import create_parameter
+from ..lr_scheduler import (
+    append_LARS,
+    cosine_decay,
+    exponential_decay,
+    inverse_time_decay,
+    natural_exp_decay,
+    noam_decay,
+    piecewise_decay,
+    polynomial_decay,
+)
+from ..metrics import accuracy, auc, chunk_eval

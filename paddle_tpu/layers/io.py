@@ -124,3 +124,27 @@ class Preprocessor:
 
     def __call__(self, fn):
         return _reader.map_readers(fn, self.reader)
+
+
+def load(out, file_path, load_as_fp16=None):
+    """load_op analog (reference layers/io.py:1070, operators/load_op.cc):
+    read one saved array from ``file_path`` (``.npy`` via numpy, or a
+    single-entry ``.npz``). The reference mutates ``out`` in place; here
+    the loaded array is returned (pass ``out=None`` or an exemplar whose
+    dtype the result is checked against)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..core.errors import enforce
+
+    arr = np.load(file_path, allow_pickle=False)
+    if hasattr(arr, "files"):  # npz archive: exactly one entry
+        enforce(len(arr.files) == 1,
+                f"load: {file_path!r} holds {len(arr.files)} arrays; expected 1")
+        arr = arr[arr.files[0]]
+    if load_as_fp16:
+        arr = arr.astype(np.float16)
+    if out is not None and hasattr(out, "dtype") and not load_as_fp16:
+        arr = arr.astype(out.dtype)
+    return jnp.asarray(arr)

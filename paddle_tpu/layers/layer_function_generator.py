@@ -1,0 +1,85 @@
+"""Layer-function generation helpers.
+
+Analog of python/paddle/fluid/layers/layer_function_generator.py, whose
+``__all__`` ({generate_layer_fn, generate_layer_fn_noattr, autodoc,
+templatedoc, deprecated}) is part of the public ``fluid.layers``
+namespace. The reference generates Python wrappers from C++ OpProtos
+(get_all_op_protos, pybind.cc:407); here the "op registry" is the set
+of jnp/lax-backed layer functions across the layers submodules, so
+generation is a lookup that returns the already-idiomatic function.
+"""
+
+from __future__ import annotations
+
+import functools
+import warnings
+from typing import Callable, Optional
+
+__all__ = ["deprecated", "generate_layer_fn", "generate_layer_fn_noattr",
+           "autodoc", "templatedoc"]
+
+
+def _registry_modules():
+    from . import control_flow, detection, nn, ops, sequence, tensor
+
+    return (ops, nn, tensor, sequence, control_flow, detection)
+
+
+def generate_layer_fn(op_type: str) -> Callable:
+    """Return the layer function registered under ``op_type``
+    (layer_function_generator.py generate_layer_fn analog — the OpProto
+    walk collapses to a module lookup)."""
+    import inspect
+
+    from ..core.errors import NotFoundError
+
+    for mod in _registry_modules():
+        fn = getattr(mod, op_type, None)
+        # only functions DEFINED in a layers module count as registered
+        # ops — imported helpers (enforce, LayerHelper, jnp…) must not
+        # resolve, or a typo'd op name silently returns a non-layer
+        if (inspect.isfunction(fn)
+                and getattr(fn, "__module__", "").startswith("paddle_tpu.layers")):
+            return fn
+    raise NotFoundError(f"no layer function registered for op {op_type!r}")
+
+
+def generate_layer_fn_noattr(op_type: str) -> Callable:
+    """Same lookup for attr-less activation-style ops."""
+    return generate_layer_fn(op_type)
+
+
+def autodoc(comment: str = "") -> Callable:
+    """Docstring decorator (autodoc analog): prepend ``comment`` to the
+    function's docstring."""
+    def decorator(func):
+        func.__doc__ = comment + (func.__doc__ or "")
+        return func
+    return decorator
+
+
+def templatedoc(op_type: Optional[str] = None) -> Callable:
+    """templatedoc analog. The reference substitutes ${comment} fields
+    from the OpProto; here docstrings are authored directly, so this
+    simply tags the function with its op type."""
+    def decorator(func):
+        func.__doc__ = (func.__doc__ or "").strip()
+        func._op_type = op_type or func.__name__
+        return func
+    return decorator
+
+
+def deprecated(since: str = "", instead: str = "") -> Callable:
+    """Mark a layer deprecated; warns once per call site like the
+    reference's annotations.deprecated."""
+    def decorator(func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(
+                f"{func.__name__} is deprecated"
+                + (f" since {since}" if since else "")
+                + (f"; use {instead} instead" if instead else ""),
+                DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+        return wrapper
+    return decorator

@@ -6,6 +6,7 @@ ops). Pure jax.numpy; everything static-shape so XLA can tile for the MXU.
 
 from __future__ import annotations
 
+import builtins
 from typing import List, Optional, Sequence, Union
 
 import jax
@@ -31,7 +32,7 @@ def split(x, num_or_sections: Union[int, List[int]], dim: int = -1, name=None):
     sections = list(num_or_sections)
     total = x.shape[dim]
     if -1 in sections:
-        known = sum(s for s in sections if s != -1)
+        known = builtins.sum(s for s in sections if s != -1)
         sections[sections.index(-1)] = total - known
     offsets = []
     acc = 0
@@ -322,3 +323,16 @@ def autoincreased_step_counter(counter_name=None, begin: int = 1, step: int = 1)
     new = cnt + ctype(step)
     helper.assign_variable("value", new)
     return new
+
+
+def _sum_layer(x):
+    """sum_op (reference layers/nn.py:7215, operators/sum_op.cc):
+    elementwise sum of a list of same-shaped tensors; a single tensor is
+    returned as-is (sum of one input). Exported as ``layers.sum`` —
+    kept private here so the module doesn't shadow the builtin."""
+    if isinstance(x, (list, tuple)):
+        total = jnp.asarray(x[0])
+        for t in x[1:]:
+            total = total + t
+        return total
+    return jnp.asarray(x)

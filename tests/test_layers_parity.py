@@ -1,0 +1,272 @@
+"""Namespace parity with the reference's ``fluid.layers``.
+
+The pinned list below is the union of every ``__all__`` in the
+reference's ``python/paddle/fluid/layers/*.py`` (199 public layer names
+plus the 5 layer_function_generator helpers the reference also
+exports). Each must be importable from ``paddle_tpu.layers`` so the
+parity claim cannot drift."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+
+REFERENCE_LAYERS_ALL = [
+    "DynamicRNN",
+    "IfElse",
+    "Preprocessor",
+    "Print",
+    "StaticRNN",
+    "Switch",
+    "While",
+    "accuracy",
+    "add_position_encoding",
+    "affine_channel",
+    "affine_grid",
+    "anchor_generator",
+    "append_LARS",
+    "argmax",
+    "argmin",
+    "argsort",
+    "array_length",
+    "array_read",
+    "array_write",
+    "assign",
+    "auc",
+    "autodoc",
+    "autoincreased_step_counter",
+    "batch",
+    "batch_norm",
+    "beam_search",
+    "beam_search_decode",
+    "bipartite_match",
+    "box_coder",
+    "brelu",
+    "cast",
+    "chunk_eval",
+    "clip",
+    "clip_by_norm",
+    "concat",
+    "conv2d",
+    "conv2d_transpose",
+    "conv3d",
+    "conv3d_transpose",
+    "cos_sim",
+    "create_array",
+    "create_global_var",
+    "create_parameter",
+    "create_tensor",
+    "crf_decoding",
+    "crop",
+    "cross_entropy",
+    "ctc_greedy_decoder",
+    "data",
+    "deprecated",
+    "detection_map",
+    "detection_output",
+    "dice_loss",
+    "double_buffer",
+    "dropout",
+    "dynamic_gru",
+    "dynamic_lstm",
+    "dynamic_lstmp",
+    "edit_distance",
+    "elementwise_add",
+    "elementwise_div",
+    "elementwise_max",
+    "elementwise_min",
+    "elementwise_mul",
+    "elementwise_pow",
+    "elementwise_sub",
+    "elu",
+    "embedding",
+    "equal",
+    "expand",
+    "exponential_decay",
+    "fc",
+    "fill_constant",
+    "fill_constant_batch_size_like",
+    "flatten",
+    "gather",
+    "gaussian_random",
+    "gaussian_random_batch_size_like",
+    "generate_layer_fn",
+    "generate_layer_fn_noattr",
+    "generate_proposal_labels",
+    "generate_proposals",
+    "grid_sampler",
+    "gru_unit",
+    "hard_sigmoid",
+    "has_inf",
+    "has_nan",
+    "hash",
+    "hsigmoid",
+    "im2sequence",
+    "image_resize",
+    "image_resize_short",
+    "increment",
+    "inverse_time_decay",
+    "iou_similarity",
+    "is_empty",
+    "isfinite",
+    "l2_normalize",
+    "label_smooth",
+    "layer_norm",
+    "leaky_relu",
+    "less_than",
+    "linear_chain_crf",
+    "load",
+    "lod_reset",
+    "log",
+    "log_loss",
+    "logical_and",
+    "logical_not",
+    "logical_or",
+    "logical_xor",
+    "lrn",
+    "lstm_unit",
+    "margin_rank_loss",
+    "matmul",
+    "maxout",
+    "mean",
+    "mean_iou",
+    "mul",
+    "multi_box_head",
+    "multiplex",
+    "natural_exp_decay",
+    "nce",
+    "noam_decay",
+    "one_hot",
+    "ones",
+    "open_files",
+    "pad",
+    "pad2d",
+    "pad_constant_like",
+    "piecewise_decay",
+    "polygon_box_transform",
+    "polynomial_decay",
+    "pool2d",
+    "pool3d",
+    "pow",
+    "prelu",
+    "prior_box",
+    "py_reader",
+    "random_crop",
+    "random_data_generator",
+    "rank_loss",
+    "read_file",
+    "reduce_max",
+    "reduce_mean",
+    "reduce_min",
+    "reduce_prod",
+    "reduce_sum",
+    "relu",
+    "relu6",
+    "reorder_lod_tensor_by_rank",
+    "reshape",
+    "resize_bilinear",
+    "reverse",
+    "roi_align",
+    "roi_perspective_transform",
+    "roi_pool",
+    "row_conv",
+    "rpn_target_assign",
+    "sampling_id",
+    "scale",
+    "scatter",
+    "sequence_concat",
+    "sequence_conv",
+    "sequence_enumerate",
+    "sequence_expand",
+    "sequence_expand_as",
+    "sequence_first_step",
+    "sequence_last_step",
+    "sequence_mask",
+    "sequence_pad",
+    "sequence_pool",
+    "sequence_reshape",
+    "sequence_reverse",
+    "sequence_scatter",
+    "sequence_slice",
+    "sequence_softmax",
+    "sequence_unpad",
+    "shape",
+    "shuffle",
+    "sigmoid_cross_entropy_with_logits",
+    "slice",
+    "smooth_l1",
+    "soft_relu",
+    "softmax",
+    "softmax_with_cross_entropy",
+    "split",
+    "square_error_cost",
+    "squeeze",
+    "ssd_loss",
+    "stack",
+    "stanh",
+    "sum",
+    "sums",
+    "swish",
+    "target_assign",
+    "templatedoc",
+    "topk",
+    "transpose",
+    "uniform_random_batch_size_like",
+    "unsqueeze",
+    "unstack",
+    "warpctc",
+    "zeros",
+]
+
+
+def test_reference_layers_namespace_complete():
+    missing = [n for n in REFERENCE_LAYERS_ALL if not hasattr(L, n)]
+    assert not missing, f"absent from paddle_tpu.layers: {missing}"
+    assert len(REFERENCE_LAYERS_ALL) == 204
+
+
+def test_sum_layer():
+    import jax.numpy as jnp
+
+    xs = [jnp.asarray(np.full((2, 3), float(i))) for i in range(1, 4)]
+    out = np.asarray(L.sum(xs))
+    np.testing.assert_allclose(out, np.full((2, 3), 6.0))
+    one = np.asarray(L.sum(xs[0]))
+    np.testing.assert_allclose(one, np.full((2, 3), 1.0))
+
+
+def test_load_layer(tmp_path):
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    p = str(tmp_path / "t.npy")
+    np.save(p, arr)
+    out = L.load(None, p)
+    np.testing.assert_allclose(np.asarray(out), arr)
+    out16 = L.load(None, p, load_as_fp16=True)
+    assert out16.dtype == np.float16
+
+
+def test_create_parameter_from_layers():
+    def f(x):
+        w = L.create_parameter(shape=[4, 2], dtype="float32", name="cp")
+        return {"out": x @ w}
+
+    prog = pt.build(f)
+    import jax
+
+    x = np.ones((3, 4), np.float32)
+    params, state = prog.init(jax.random.PRNGKey(0), x)
+    assert any(k.endswith("cp") or "cp" in k for k in params)
+    out, _ = prog.apply(params, state, x)
+    assert out["out"].shape == (3, 2)
+
+
+def test_generate_layer_fn_lookup():
+    fn = L.generate_layer_fn("relu")
+    import jax.numpy as jnp
+
+    np.testing.assert_allclose(np.asarray(fn(jnp.asarray([-1.0, 2.0]))), [0.0, 2.0])
+    from paddle_tpu.core.errors import NotFoundError
+
+    with pytest.raises(NotFoundError):
+        L.generate_layer_fn("definitely_not_an_op")
