@@ -104,7 +104,10 @@ class Executor:
         """
         scope = scope or self.scope
         feed = feed or {}
-        key = (id(program), training, tuple(sorted(feed)))
+        # key on the Program object itself (not id(): a GC'd Program's id
+        # can be reused and hit a stale compiled fn); the strong ref lives
+        # until close() like the reference's per-executor program cache
+        key = (program, training, tuple(sorted(feed)))
         if key not in self._jit_cache:
             def fwd(params, state, rng_, feed_):
                 out, new_state = program.apply(params, state, training=training,

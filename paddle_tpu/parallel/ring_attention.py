@@ -4,71 +4,162 @@ Gap-fill component (SURVEY §2.2/§5): the reference has NO sequence
 parallelism — nothing distributes a single sequence. Here, attention
 over a sequence sharded on the mesh's ``sp`` axis: each device holds a
 query/key/value shard, K/V shards rotate around the ring via
-``ppermute`` (neighbor ICI hops), and softmax is combined online with
-per-shard (max, sum) statistics — so attention over a sequence of
-length S costs O(S/n) memory per chip and the K/V transfer overlaps
-ring steps. Differentiable end-to-end (scan + ppermute transpose).
+``ppermute`` (neighbor ICI hops), and per-shard results merge in
+log-space from the flash kernel's (out, lse) pairs.
 
-Use via ``ring_attention(..., mesh, axis_name='sp')`` inside/outside
-jit, or through ``shard_map`` composition in a seq-parallel model.
+Each ring step runs the pallas flash kernel (ops/flash_attention) on
+the local Q shard against the visiting K/V shard, so per-chip memory is
+O(S/n · d) for the shard buffers plus O(block²) inside the kernel —
+never an S/n × S/n score matrix. The backward is a second ring pass
+reusing the flash backward kernels with the COMBINED logsumexp
+(flash-attention-2 style): dq accumulates locally, dk/dv accumulate on
+buffers that travel with their K/V shard and arrive home after the full
+cycle. Differentiable end-to-end via a custom VJP.
+
+Causal ring schedule: the visiting shard is fully visible (earlier
+ranks), causally visible (own rank), or invisible (later ranks) —
+selected with lax.switch so invisible steps do no FLOPs. (Known load
+imbalance: rank r does r+1 real steps; a zigzag block order would even
+it out — future work.)
 """
 
 from __future__ import annotations
 
-import functools
-import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops import flash_attention as fa
 from .mesh import pvary
 
 NEG_INF = -1e30
 
 
-def _ring_body(q, k0, v0, axis_name: str, causal: bool, scale: float,
-               varying_axes: tuple = ()):
-    """Per-device computation: q,k0,v0 are local shards [b,h,sl,d]."""
+def _merge(acc, lse_c, out_i, lse_i):
+    """Log-space merge of per-shard flash results."""
+    lse_new = jnp.logaddexp(lse_c, lse_i)
+    w_old = jnp.exp(lse_c - lse_new)[..., None]
+    w_new = jnp.exp(lse_i - lse_new)[..., None]
+    return acc * w_old + out_i.astype(jnp.float32) * w_new, lse_new
+
+
+def _ring_fwd_body(q, k0, v0, *, axis_name, causal, varying_axes,
+                   block_q, block_k):
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, sl, d = q.shape
-    qf = q.astype(jnp.float32) * scale
-    q_pos = idx * sl + jnp.arange(sl)  # global query positions
-
     perm = [(j, (j + 1) % n) for j in range(n)]
 
+    def full_step(k_cur, v_cur):
+        return fa.flash_attention(q, k_cur, v_cur, causal=False,
+                                  block_q=block_q, block_k=block_k,
+                                  return_lse=True)
+
+    def diag_step(k_cur, v_cur):
+        return fa.flash_attention(q, k_cur, v_cur, causal=True,
+                                  block_q=block_q, block_k=block_k,
+                                  return_lse=True)
+
+    def masked_step(k_cur, v_cur):
+        return (jnp.zeros_like(q), jnp.full((b, h, sl), NEG_INF, jnp.float32))
+
     def step(carry, i):
-        k_cur, v_cur, m, l, acc = carry
-        src = (idx - i) % n  # rank whose chunk we currently hold
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32),
-                       preferred_element_type=jnp.float32)
+        k_cur, v_cur, acc, lse_c = carry
         if causal:
-            k_pos = src * sl + jnp.arange(sl)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask[None, None], s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[..., None])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
-        # rotate k/v to the next rank (overlaps with next step's compute)
+            src = (idx - i) % n
+            branch = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+            out_i, lse_i = jax.lax.switch(
+                branch, [full_step, diag_step, masked_step], k_cur, v_cur)
+        else:
+            out_i, lse_i = full_step(k_cur, v_cur)
+        acc, lse_c = _merge(acc, lse_c, out_i, lse_i)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+        return (k_nxt, v_nxt, acc, lse_c), None
 
-    # pvary: mark fresh accumulators as device-varying over every manual
-    # mesh axis so the scan carry types line up (shard_map vma rules).
     vaxes = tuple(varying_axes) or (axis_name,)
-    m0 = pvary(jnp.full((b, h, sl), NEG_INF, jnp.float32), vaxes)
-    l0 = pvary(jnp.zeros((b, h, sl), jnp.float32), vaxes)
     acc0 = pvary(jnp.zeros((b, h, sl, d), jnp.float32), vaxes)
-    (k_f, v_f, m, l, acc), _ = jax.lax.scan(
-        step, (k0, v0, m0, l0, acc0), jnp.arange(n))
-    l_safe = jnp.maximum(l, 1e-30)
-    return (acc / l_safe[..., None]).astype(q.dtype)
+    lse0 = pvary(jnp.full((b, h, sl), NEG_INF, jnp.float32), vaxes)
+    (_, _, acc, lse), _ = jax.lax.scan(step, (k0, v0, acc0, lse0), jnp.arange(n))
+    return acc.astype(q.dtype), lse
+
+
+def _ring_bwd_body(q, k0, v0, out, lse, g, *, axis_name, causal,
+                   varying_axes, block_q, block_k):
+    """Second ring pass: flash backward kernels with the combined lse.
+    dk/dv ride with their shard and come home after n rotations."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    # delta is k/v-shard-invariant: compute once, not per ring step
+    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
+
+    def grads(k_cur, v_cur, caus):
+        return fa._flash_bwd(q, k_cur, v_cur, None, None, None, caus,
+                             out, lse, g, block_q, block_k,
+                             interpret=jax.devices()[0].platform == "cpu",
+                             delta=delta)
+
+    def full_step(k_cur, v_cur):
+        return grads(k_cur, v_cur, False)
+
+    def diag_step(k_cur, v_cur):
+        return grads(k_cur, v_cur, True)
+
+    def masked_step(k_cur, v_cur):
+        return (jnp.zeros_like(q), jnp.zeros_like(k_cur), jnp.zeros_like(v_cur))
+
+    def step(carry, i):
+        k_cur, v_cur, dk_cur, dv_cur, dq_acc = carry
+        if causal:
+            src = (idx - i) % n
+            branch = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+            dq_i, dk_i, dv_i = jax.lax.switch(
+                branch, [full_step, diag_step, masked_step], k_cur, v_cur)
+        else:
+            dq_i, dk_i, dv_i = full_step(k_cur, v_cur)
+        dq_acc = dq_acc + dq_i.astype(jnp.float32)
+        dk_cur = dk_cur + dk_i.astype(jnp.float32)
+        dv_cur = dv_cur + dv_i.astype(jnp.float32)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
+        return (k_nxt, v_nxt, dk_nxt, dv_nxt, dq_acc), None
+
+    vaxes = tuple(varying_axes) or (axis_name,)
+    dk0 = pvary(jnp.zeros(k0.shape, jnp.float32), vaxes)
+    dv0 = pvary(jnp.zeros(v0.shape, jnp.float32), vaxes)
+    dq0 = pvary(jnp.zeros(q.shape, jnp.float32), vaxes)
+    (_, _, dk, dv, dq), _ = jax.lax.scan(
+        step, (k0, v0, dk0, dv0, dq0), jnp.arange(n))
+    return dq.astype(q.dtype), dk.astype(k0.dtype), dv.astype(v0.dtype)
+
+
+def _make_ring(axis_name, causal, varying_axes, block_q, block_k):
+    @jax.custom_vjp
+    def ring(q, k, v):
+        out, _ = _ring_fwd_body(q, k, v, axis_name=axis_name, causal=causal,
+                                varying_axes=varying_axes, block_q=block_q,
+                                block_k=block_k)
+        return out
+
+    def ring_fwd(q, k, v):
+        out, lse = _ring_fwd_body(q, k, v, axis_name=axis_name, causal=causal,
+                                  varying_axes=varying_axes, block_q=block_q,
+                                  block_k=block_k)
+        return out, (q, k, v, out, lse)
+
+    def ring_bwd(res, g):
+        q, k, v, out, lse = res
+        return _ring_bwd_body(q, k, v, out, lse, g, axis_name=axis_name,
+                              causal=causal, varying_axes=varying_axes,
+                              block_q=block_q, block_k=block_k)
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring
 
 
 def ring_attention(
@@ -77,24 +168,24 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = False,
     batch_axes: Optional[tuple] = ("dp", "fsdp"),
+    block_q: int = fa.DEFAULT_BLOCK_Q,
+    block_k: int = fa.DEFAULT_BLOCK_K,
 ):
     """Attention over [b, h, s, d] with s sharded on ``axis_name``.
 
     Batch may additionally be sharded over ``batch_axes``; heads stay
     unsharded here (combine with TP by sharding h outside via shard_map
     composition)."""
-    scale = 1.0 / math.sqrt(q.shape[-1])
     if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
-        # degenerate ring: plain attention
-        from ..layers.attention import scaled_dot_product_attention
-        return scaled_dot_product_attention(q, k, v, causal=causal)
+        # degenerate ring: single-shard flash attention
+        return fa.flash_attention(q, k, v, causal=causal,
+                                  block_q=block_q, block_k=block_k)
 
     bspec = tuple(a for a in (batch_axes or ()) if a in mesh.axis_names)
     bshard = bspec if len(bspec) > 1 else (bspec[0] if bspec else None)
     spec = P(bshard, None, axis_name, None)
 
-    fn = jax.shard_map(
-        functools.partial(_ring_body, axis_name=axis_name, causal=causal, scale=scale,
-                          varying_axes=tuple(mesh.axis_names)),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    body = _make_ring(axis_name, causal, tuple(mesh.axis_names), block_q, block_k)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
     return fn(q, k, v)

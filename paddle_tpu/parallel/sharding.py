@@ -13,6 +13,7 @@ param-slicing/broadcast analog).
 from __future__ import annotations
 
 import re
+import warnings
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -75,9 +76,23 @@ def _as_spec(spec: SpecLike) -> P:
     return P(*spec)
 
 
+_warned_drops = set()
+
+
+def _warn_drop(key, msg):
+    """Warn once per drop site — the multi_devices_check_pass analog:
+    a rule that silently degrades to replicated is the reference's
+    classic mis-sharding failure mode."""
+    if key not in _warned_drops:
+        _warned_drops.add(key)
+        warnings.warn(msg, stacklevel=4)
+
+
 def _validate(spec: P, shape: Tuple[int, ...], mesh: Mesh, name: str) -> P:
     """Drop axes that don't divide the dim or aren't in the mesh —
-    permissive like GSPMD, but done eagerly so placement is predictable."""
+    permissive like GSPMD so preset rule tables degrade gracefully on
+    smaller meshes, but each drop warns once (size-1 mesh axes excepted:
+    dropping those is a no-op)."""
     out = []
     for i, entry in enumerate(spec):
         if entry is None:
@@ -90,7 +105,27 @@ def _validate(spec: P, shape: Tuple[int, ...], mesh: Mesh, name: str) -> P:
             if a in mesh.axis_names:
                 keep.append(a)
                 size *= mesh.shape[a]
-        if not keep or i >= len(shape) or shape[i] % size != 0:
+            else:
+                # once per (axis, mesh shape): presets legitimately run on
+                # smaller meshes, so per-param warnings would flood
+                _warn_drop(("missing", a, tuple(mesh.shape.items())),
+                           f"sharding rule for {name!r} names axis {a!r} which is "
+                           f"not in the mesh {dict(mesh.shape)}; replicating that "
+                           f"dim (warned once per axis and mesh shape)")
+        if i >= len(shape):
+            if keep and size > 1:
+                _warn_drop(("rank", name, i),
+                           f"sharding rule for {name!r} has more entries than the "
+                           f"param rank {len(shape)}; extra axes {keep} dropped")
+            out.append(None)
+        elif not keep:
+            out.append(None)
+        elif shape[i] % size != 0:
+            if size > 1:
+                _warn_drop(("divide", name, i),
+                           f"sharding rule for {name!r}: dim {i} of shape {shape} "
+                           f"is not divisible by mesh axes {keep} (size {size}); "
+                           f"replicating that dim")
             out.append(None)
         else:
             out.append(tuple(keep) if len(keep) > 1 else keep[0])

@@ -109,3 +109,130 @@ def test_attention_layer_uses_flash():
     out_x = A.scaled_dot_product_attention(q, k, v, causal=True, use_flash=False)
     out_f = A.scaled_dot_product_attention(q, k, v, causal=True, use_flash=True)
     np.testing.assert_allclose(np.asarray(out_x), np.asarray(out_f), atol=2e-5, rtol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# v2: segment ids, pallas backward, ragged shapes, lse merging
+
+
+def _ref_seg(q, k, v, seg_q, seg_k, causal=False):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    mask = seg_q[:, None, :, None] == seg_k[:, None, None, :]
+    s = jnp.where(mask, s, -1e30)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        s = jnp.where(cm, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    # rows with every key masked -> zero them like the kernel does
+    allmask = jnp.all(s <= -1e29, axis=-1, keepdims=True)
+    p = jnp.where(allmask, 0.0, p)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def test_segment_ids_match_reference():
+    q, k, v = _rand(b=2, s=128, d=32, seed=3)
+    seg = jnp.asarray(np.repeat([[0, 1, 2, 3]], 32, axis=1).reshape(1, 128)
+                      .repeat(2, axis=0))
+    out = fa.flash_attention(q, k, v, segment_ids=seg, block_q=64, block_k=64)
+    ref = _ref_seg(q, k, v, seg, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_segment_ids_causal_grads():
+    q, k, v = _rand(b=1, s=128, d=32, seed=4)
+    seg = jnp.asarray(np.repeat([0, 1], 64).reshape(1, 128))
+
+    def loss_f(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, causal=True, segment_ids=seg,
+                                          block_q=64, block_k=64) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(_ref_seg(q, k, v, seg, seg, causal=True) ** 2)
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-3)
+
+
+def test_non_divisible_seq_pads():
+    q, k, v = _rand(b=1, h=1, s=100, d=32, sk=84, seed=5)
+    out = fa.flash_attention(q, k, v, block_q=64, block_k=64)
+    ref = _ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    g = jax.grad(lambda q, k, v: jnp.sum(
+        fa.flash_attention(q, k, v, block_q=64, block_k=64) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(_ref(q, k, v) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-3)
+
+
+def test_many_k_blocks_streams():
+    """seq >> block: K/V streamed across many grid steps (the VMEM-ceiling
+    fix) — numerics must still match the dense reference."""
+    q, k, v = _rand(b=1, h=1, s=64, d=32, sk=1024, seed=6)
+    out = fa.flash_attention(q, k, v, block_q=64, block_k=128)
+    ref = _ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_return_lse_matches_logsumexp():
+    q, k, v = _rand(b=1, h=1, s=64, d=32, seed=7)
+    out, lse = fa.flash_attention(q, k, v, block_q=32, block_k=32, return_lse=True)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    np.testing.assert_allclose(np.asarray(lse),
+                               np.asarray(jax.scipy.special.logsumexp(s, axis=-1)),
+                               atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(q, k, v)),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_key_bias_grads_pallas_backward():
+    q, k, v = _rand(b=2, s=96, d=32, seed=8)
+    bias = jnp.asarray(np.where(np.arange(96) < 70, 0.0, -1e30)[None]
+                       .repeat(2, axis=0).astype(np.float32))
+
+    def loss_f(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, key_bias=bias,
+                                          block_q=32, block_k=32) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(_ref(q, k, v, key_bias=bias) ** 2)
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-3)
+
+
+def test_causal_bottom_right_alignment_decode():
+    """sq < sk causal (decode suffix): last query sees all keys —
+    bottom-right alignment, matching the XLA fallback convention."""
+    q, k, v = _rand(b=1, h=1, s=32, d=32, sk=128, seed=9)
+    out = fa.flash_attention(q, k, v, causal=True, block_q=32, block_k=64)
+    ref = _ref(q, k, v, causal=True)  # _ref uses tril(k=sk-sq)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_kv_segment_ids_requires_query_ids():
+    from paddle_tpu.core.errors import EnforceError
+
+    q, k, v = _rand(s=64, d=32)
+    seg = jnp.zeros((1, 64), jnp.int32)
+    with pytest.raises(EnforceError):
+        fa.flash_attention(q, k, v, kv_segment_ids=seg)
+
+
+def test_dense_mask_fallback_keeps_bias_and_segments():
+    q, k, v = _rand(b=1, h=2, s=64, d=32, seed=10)
+    dense = jnp.zeros((1, 2, 64, 64), jnp.float32)  # not key-bias-reducible
+    bias = jnp.asarray(np.where(np.arange(64) < 40, 0.0, -1e30)[None].astype(np.float32))
+    out = fa.flash_attention(q, k, v, attn_mask=dense, key_bias=bias)
+    ref = _ref(q, k, v, key_bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)

@@ -10,6 +10,7 @@ from paddle_tpu import optimizer as opt
 from paddle_tpu.models import bert, deepfm, lstm, resnet, transformer, vgg, word2vec
 
 
+@pytest.mark.slow
 def test_resnet50_forward_backward():
     model = pt.build(resnet.make_model(depth=50, class_num=10, image_size=32))
     x = np.random.randn(2, 3, 32, 32).astype(np.float32)
@@ -23,6 +24,7 @@ def test_resnet50_forward_backward():
     assert np.isfinite(float(out["loss"]))
 
 
+@pytest.mark.slow
 def test_vgg16_forward():
     model = pt.build(vgg.make_model(depth=16, class_num=10))
     x = np.random.randn(2, 3, 32, 32).astype(np.float32)
@@ -138,6 +140,7 @@ def test_deepfm_learns():
     assert losses[-1] < losses[0] * 0.7
 
 
+@pytest.mark.slow
 def test_bert_pretrain_step():
     cfg = bert.base_config(vocab_size=100, max_len=32, d_model=32, d_inner=64,
                            num_heads=4, num_layers=2, dropout=0.0)

@@ -9,6 +9,7 @@ from paddle_tpu import optimizer as opt
 from paddle_tpu.models import convnets, seq2seq
 
 
+@pytest.mark.slow
 def test_seq2seq_learns_copy():
     model = pt.build(seq2seq.make_model(src_vocab=15, trg_vocab=15, emb_dim=16,
                                         hidden=32))
@@ -42,6 +43,7 @@ def test_alexnet_step():
     assert np.isfinite(float(out["loss"]))
 
 
+@pytest.mark.slow
 def test_googlenet_step():
     model = pt.build(convnets.make_googlenet(class_num=10))
     feed = _img_feed(size=96)
@@ -51,6 +53,7 @@ def test_googlenet_step():
     assert np.isfinite(float(out["loss"]))
 
 
+@pytest.mark.slow
 def test_se_resnext_step():
     model = pt.build(convnets.make_se_resnext(depth=50, class_num=10))
     feed = _img_feed(size=64)

@@ -54,6 +54,7 @@ def test_ep_with_dp_axis():
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_ep_gradients_match_dense():
     x = _input()
     dense = _build(None)

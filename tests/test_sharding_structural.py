@@ -1,0 +1,128 @@
+"""Transpiler-style structural sharding tests (SURVEY §4 implication 2,
+test_dist_transpiler.py pattern): assert the EXACT PartitionSpec each
+preset rule table produces for zoo-model parameters, and that dropped
+axes warn loudly (multi_devices_check_pass analog)."""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu.models import transformer
+from paddle_tpu.parallel import sharding
+
+
+@pytest.fixture
+def tp_mesh():
+    return pt.make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+
+
+def _transformer_params():
+    cfg = transformer.base_config(src_vocab=64, trg_vocab=64, d_model=16,
+                                  d_inner=32, num_heads=2, num_encoder_layers=1,
+                                  num_decoder_layers=1, dropout=0.0)
+    prog = pt.build(transformer.make_model(cfg))
+    rng = np.random.RandomState(0)
+    src = rng.randint(3, 64, (2, 8)).astype(np.int64)
+    feed = {"src_ids": src, "trg_ids": src, "labels": src}
+    params, _ = prog.init(jax.random.PRNGKey(0), **feed)
+    return params
+
+
+EXPECTED_TP_SPECS = {
+    "encoder/mha_0/q_proj/w": P("fsdp", "tp"),
+    "encoder/mha_0/k_proj/w": P("fsdp", "tp"),
+    "encoder/mha_0/v_proj/w": P("fsdp", "tp"),
+    "encoder/mha_0/q_proj/b": P("tp"),
+    "encoder/mha_0/out_proj/w": P("tp", "fsdp"),
+    "encoder/mha_0/out_proj/b": P(),
+    "encoder/ffn_0/ffn_in/w": P("fsdp", "tp"),
+    "encoder/ffn_0/ffn_in/b": P("tp"),
+    "encoder/ffn_0/ffn_out/w": P("tp", "fsdp"),
+    "encoder/layer_norm_0/scale": P(),
+    "decoder/mha_1/v_proj/w": P("fsdp", "tp"),
+    "decoder/ffn_1/ffn_out/w": P("tp", "fsdp"),
+    "src/embedding_0/w": P("tp", None),
+    "trg/embedding_1/w": P("tp", None),
+    "logits_proj_0/w": P(None, "fsdp"),
+}
+
+
+def test_transformer_tp_rules_exact_specs(tp_mesh):
+    params = _transformer_params()
+    rules = pt.parallel.transformer_tp_rules()
+    for name, expected in EXPECTED_TP_SPECS.items():
+        assert name in params, f"model no longer has param {name}"
+        got = rules.spec_for(name, params[name].shape, tp_mesh)
+        assert got == expected, f"{name}: got {got}, want {expected}"
+
+
+def test_transformer_tp_rules_every_param_resolves(tp_mesh):
+    """Every zoo param resolves to a spec whose axes divide its dims —
+    i.e. the preset never relies on the permissive drop path."""
+    params = _transformer_params()
+    rules = pt.parallel.transformer_tp_rules()
+    sharding._warned_drops.clear()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        for name, v in params.items():
+            rules.spec_for(name, v.shape, tp_mesh)
+    drops = [w for w in rec if "sharding rule" in str(w.message)]
+    assert not drops, [str(w.message) for w in drops]
+
+
+def test_fsdp_preset_shards_largest_dim():
+    mesh = pt.make_mesh({"fsdp": 8})
+    rules = pt.parallel.fsdp(min_size_to_shard=64)
+    assert rules.spec_for("x/w", (128, 64), mesh) == P("fsdp", None)
+    assert rules.spec_for("x/w", (64, 128), mesh) == P(None, "fsdp")
+    # too small -> replicated
+    assert rules.spec_for("x/b", (7,), mesh) == P()
+    # no dim divisible -> replicated
+    assert rules.spec_for("x/w", (65, 67), mesh) == P()
+
+
+def test_dropped_axis_warns_once(tp_mesh):
+    sharding._warned_drops.clear()
+    rules = pt.parallel.ShardingRules([(r".*typo.*", P("tpp"))], default=P())
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        rules.spec_for("a/typo/w", (16, 16), tp_mesh)
+        rules.spec_for("b/typo/w", (16, 16), tp_mesh)
+    msgs = [str(w.message) for w in rec if "not in the mesh" in str(w.message)]
+    assert len(msgs) == 1 and "'tpp'" in msgs[0], msgs
+
+
+def test_non_divisible_dim_warns(tp_mesh):
+    sharding._warned_drops.clear()
+    rules = pt.parallel.ShardingRules([(r".*odd.*", P("tp"))], default=P())
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        spec = rules.spec_for("x/odd/w", (15, 16), tp_mesh)
+    assert spec == P(None)  # degraded to replicated...
+    msgs = [str(w.message) for w in rec if "not divisible" in str(w.message)]
+    assert len(msgs) == 1, msgs  # ...but loudly
+
+
+def test_executor_jit_cache_keyed_on_program_object():
+    """A dead Program's id must not alias a new Program's cache entry."""
+    import gc
+
+    exe = pt.Executor()
+
+    def make(mult):
+        def f(x):
+            return {"y": x * mult}
+        return pt.build(f)
+
+    x = np.ones((2,), np.float32)
+    outs = []
+    for mult in (2.0, 3.0, 4.0):
+        prog = make(mult)
+        outs.append(float(exe.run(prog, feed={"x": x}, fetch_list=["y"])[0][0]))
+        del prog
+        gc.collect()
+    assert outs == [2.0, 3.0, 4.0]

@@ -14,6 +14,7 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
 @pytest.mark.skipif(shutil.which("g++") is None, reason="g++ unavailable")
+@pytest.mark.slow
 def test_cpp_train_demo_compiles_and_converges(tmp_path):
     import sys
     import sysconfig
