@@ -1,16 +1,26 @@
 """Benchmark driver — fluid_benchmark.py analog (benchmark/fluid/).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Default (no args — the driver's command) runs the FULL suite: every
+BASELINE config (MNIST MLP, ResNet-50, Transformer-base, BERT-base,
+DeepFM) plus VGG-16, LSTM, long-context transformer, the 10M-row
+sharded-embedding DeepFM, and the inference configs (ResNet-50 bs=16
+fp32/bf16/int8-PTQ-weights). Prints ONE JSON line:
 
-Headline metric: ResNet-50 train throughput (images/sec) on one chip,
-bs=64 — directly comparable to the reference's published ResNet-50
-train number (BASELINE.md: 81.69 images/sec, bs=64, MKL-DNN on 2×Xeon
-6148; the reference has no GPU ResNet-50 number in-tree).
+  {"metric": "suite", "value": <headline train MFU>, "unit": "MFU",
+   "vs_baseline": <resnet50 imgs/sec ratio vs reference>,
+   "configs": {name: {"value", "unit", "mfu", "compute_only", ...}}}
 
-Extra models via --model {resnet50,transformer,mnist_mlp,lstm}; all
-print the same JSON schema (vs_baseline where a reference number
-exists, else null).
+Honesty rules (VERDICT r2 #1):
+- throughput is measured WITH the input pipeline in the loop: host
+  numpy batches stream through DeviceFeeder (double-buffered host→HBM
+  transfer, data/feeder.py) exactly as `fit()` trains; the pre-staged
+  compute-only number is kept as a secondary field;
+- MFU uses analytic model FLOPs (paddle_tpu/core/flops.py — causal
+  attention halved, elementwise excluded: undercounts, never inflates)
+  over the chip's published bf16 peak (table by device_kind, measured
+  matmul fallback);
+- vs_baseline ratios against the reference's 2018-Xeon/K40m numbers are
+  reported per config where they exist, but the headline metric is MFU.
 """
 
 from __future__ import annotations
@@ -25,9 +35,10 @@ BASELINES = {
     # reference numbers from BASELINE.md (images/sec or ms/batch-derived)
     "resnet50": 81.69,        # images/sec, bs=64 (IntelOptimizedPaddle.md:39-45)
     "vgg16": 28.46,           # images/sec, bs=64 VGG-19 row (closest config)
-    "lstm": 64 / 0.184,       # images(=samples)/sec from 184 ms/batch bs=64 K40m
-    "transformer": None,
-    "mnist_mlp": None,
+    "lstm": 64 / 0.184,       # samples/sec from 184 ms/batch bs=64 K40m
+    "resnet50_infer_fp32": 217.69,   # images/sec, bs=16 (IntelOptimizedPaddle.md:81-87)
+    "resnet50_infer_bf16": 217.69,
+    "resnet50_infer_int8": 217.69,
 }
 
 
@@ -35,114 +46,233 @@ def _sync(out):
     # device_get of a scalar forces a real sync — block_until_ready alone
     # does not fully synchronize on the experimental axon transport.
     import jax
-    v = out["loss"] if isinstance(out, dict) and "loss" in out else out
-    jax.device_get(v)
+    if isinstance(out, dict):
+        for v in out.values():
+            jax.device_get(v)
+            return
+    jax.device_get(out)
 
 
-def _bench_loop(step_fn, feeds, warmup=5, iters=10, trainer=None):
-    if trainer is not None:
-        # stage feeds on device once — the double-buffered input pipeline
-        # (DeviceFeeder) overlaps transfer in real training; the bench
-        # measures the compute path.
-        feeds = [trainer._put_feed(f) for f in feeds]
-    for i in range(warmup):
-        out = step_fn(feeds[i % len(feeds)])
-        _sync(out)
+def _time_trainer(trainer, host_batches, warmup=3, iters=20):
+    """(pipelined sec/step, compute-only sec/step).
+
+    Pipelined = host numpy → DeviceFeeder (background-thread device_put,
+    capacity 2) → step: the full input path BASELINE targets. Compute-
+    only = feeds pre-staged on device (the old bench's number, kept as a
+    secondary field)."""
+    from paddle_tpu.data.feeder import DeviceFeeder
+
+    staged0 = trainer._put_feed(host_batches[0])
+    for _ in range(warmup):
+        out = trainer.step(staged0)
+    _sync(out)
+
+    def gen():
+        for i in range(iters):
+            yield host_batches[i % len(host_batches)]
+
+    t0 = time.perf_counter()
+    for feed in DeviceFeeder(gen, put_fn=trainer._put_feed, capacity=2):
+        out = trainer.step(feed)
+    _sync(out)
+    dt_pipe = (time.perf_counter() - t0) / iters
+
+    staged = [trainer._put_feed(b) for b in host_batches[:2]]
+    out = trainer.step(staged[0])
+    _sync(out)
     t0 = time.perf_counter()
     for i in range(iters):
-        out = step_fn(feeds[i % len(feeds)])
+        out = trainer.step(staged[i % 2])
     _sync(out)
-    dt = time.perf_counter() - t0
-    return dt / iters
+    dt_comp = (time.perf_counter() - t0) / iters
+    return dt_pipe, dt_comp
 
 
-def bench_resnet50(batch_size=64, image_size=224, dtype="float32"):
+def _result(n_per_step, unit, dt_pipe, dt_comp, flops_per_step, peak, baseline_key=None):
+    value = n_per_step / dt_pipe
+    out = {
+        "value": round(float(value), 2),
+        "unit": unit,
+        "compute_only": round(float(n_per_step / dt_comp), 2),
+        "step_time_ms": round(dt_pipe * 1e3, 3),
+        "model_flops_per_step": float(flops_per_step),
+        "mfu": round(flops_per_step / dt_pipe / peak, 4),
+        "mfu_compute_only": round(flops_per_step / dt_comp / peak, 4),
+    }
+    base = BASELINES.get(baseline_key or "")
+    out["vs_baseline"] = round(float(value) / base, 2) if base else None
+    return out
+
+
+# -- train configs -----------------------------------------------------------
+
+
+def bench_resnet50(peak, batch_size=64, image_size=224, iters=20):
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
+    from paddle_tpu.core import flops
     from paddle_tpu.models import resnet
 
     model = pt.build(resnet.make_model(depth=50, class_num=1000, image_size=image_size))
     rng = np.random.RandomState(0)
     feeds = [{
-        "image": rng.randn(batch_size, 3, image_size, image_size).astype(dtype),
+        "image": rng.randn(batch_size, 3, image_size, image_size).astype(np.float32),
         "label": rng.randint(0, 1000, (batch_size, 1)).astype(np.int64),
-    } for _ in range(2)]
-    trainer = pt.Trainer(model, opt.Momentum(0.1, 0.9), loss_name="loss")
+    } for _ in range(4)]
+    trainer = pt.Trainer(model, opt.Momentum(0.1, 0.9), loss_name="loss",
+                         fetch_list=["loss"])
     trainer.startup(sample_feed=feeds[0])
-    sec = _bench_loop(lambda f: trainer.step(f), feeds, trainer=trainer)
-    return batch_size / sec, "images/sec"
+    dt_pipe, dt_comp = _time_trainer(trainer, feeds, iters=iters)
+    f = flops.convnet_train_flops(flops.resnet_fwd_flops(50, image_size), batch_size)
+    return _result(batch_size, "images/sec", dt_pipe, dt_comp, f, peak, "resnet50")
 
 
-def _bench_transformer_config(batch_size, seq, dtype, dropout, max_len=256):
+def bench_vgg16(peak, batch_size=64, image_size=224, iters=20):
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
+    from paddle_tpu.core import flops
+    from paddle_tpu.models import vgg
+
+    model = pt.build(vgg.make_model(depth=16, class_num=1000))
+    rng = np.random.RandomState(0)
+    feeds = [{
+        "image": rng.randn(batch_size, 3, image_size, image_size).astype(np.float32),
+        "label": rng.randint(0, 1000, (batch_size, 1)).astype(np.int64),
+    } for _ in range(4)]
+    trainer = pt.Trainer(model, opt.Momentum(0.01, 0.9), loss_name="loss",
+                         fetch_list=["loss"])
+    trainer.startup(sample_feed=feeds[0])
+    dt_pipe, dt_comp = _time_trainer(trainer, feeds, iters=iters)
+    f = flops.convnet_train_flops(flops.vgg_fwd_flops(16, image_size), batch_size)
+    return _result(batch_size, "images/sec", dt_pipe, dt_comp, f, peak, "vgg16")
+
+
+def _bench_transformer_config(peak, batch_size, seq, dtype, dropout,
+                              max_len=256, iters=20):
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.core import flops
     from paddle_tpu.models import transformer
 
-    cfg = transformer.base_config(src_vocab=32000, trg_vocab=32000, dropout=dropout,
-                                  max_len=max_len, dtype=dtype, use_flash=True,
-                                  fused_ce=True)
+    cfg = transformer.base_config(src_vocab=32000, trg_vocab=32000,
+                                  dropout=dropout, max_len=max_len,
+                                  dtype=dtype, use_flash=True, fused_ce=True)
     model = pt.build(transformer.make_model(cfg))
     rng = np.random.RandomState(0)
     feeds = [{
         "src_ids": rng.randint(3, 32000, (batch_size, seq)).astype(np.int32),
         "trg_ids": rng.randint(3, 32000, (batch_size, seq)).astype(np.int32),
         "labels": rng.randint(3, 32000, (batch_size, seq)).astype(np.int32),
-    } for _ in range(2)]
+    } for _ in range(4)]
     trainer = pt.Trainer(model, opt.Adam(1e-3), loss_name="loss",
                          fetch_list=["loss"])
     trainer.startup(sample_feed=feeds[0])
-    sec = _bench_loop(lambda f: trainer.step(f), feeds, trainer=trainer)
-    return batch_size * seq / sec, "tokens/sec"
+    dt_pipe, dt_comp = _time_trainer(trainer, feeds, iters=iters)
+    f = flops.transformer_train_flops(batch_size, seq, cfg)
+    return _result(batch_size * seq, "tokens/sec", dt_pipe, dt_comp, f, peak)
 
 
-def bench_transformer(batch_size=32, seq=256, dtype="float32"):
-    return _bench_transformer_config(batch_size, seq, dtype, dropout=0.1)
+def bench_transformer(peak, batch_size=32, seq=256, dtype="bfloat16", iters=20):
+    return _bench_transformer_config(peak, batch_size, seq, dtype, dropout=0.1,
+                                     iters=iters)
 
 
-def bench_transformer_long(batch_size=4, seq=4096, dtype="float32"):
+def bench_transformer_long(peak, batch_size=4, seq=4096, dtype="bfloat16", iters=10):
     """Long-context train step: flash attention pallas kernel (dense
     attention at this length is ~26x slower / memory-bound)."""
-    return _bench_transformer_config(batch_size, seq, dtype, dropout=0.0,
-                                     max_len=seq)
+    return _bench_transformer_config(peak, batch_size, seq, dtype, dropout=0.0,
+                                     max_len=seq, iters=iters)
 
 
-def bench_vgg16(batch_size=64, image_size=224, dtype="float32"):
+def bench_bert(peak, batch_size=32, seq=128, num_masked=20, dtype="bfloat16",
+               iters=20):
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
-    from paddle_tpu.models import vgg
+    from paddle_tpu.core import flops
+    from paddle_tpu.models import bert
 
-    model = pt.build(vgg.make_model(depth=16, class_num=1000))
+    cfg = bert.base_config(dtype=dtype, use_flash=True, max_len=512)
+    model = pt.build(bert.make_pretrain_model(cfg))
     rng = np.random.RandomState(0)
     feeds = [{
-        "image": rng.randn(batch_size, 3, image_size, image_size).astype(dtype),
-        "label": rng.randint(0, 1000, (batch_size, 1)).astype(np.int64),
-    } for _ in range(2)]
-    trainer = pt.Trainer(model, opt.Momentum(0.01, 0.9), loss_name="loss",
-                         fetch_list=["loss"])
+        "input_ids": rng.randint(0, cfg.vocab_size, (batch_size, seq)).astype(np.int32),
+        "token_type_ids": rng.randint(0, 2, (batch_size, seq)).astype(np.int32),
+        "mlm_positions": rng.randint(0, seq, (batch_size, num_masked)).astype(np.int32),
+        "mlm_labels": rng.randint(0, cfg.vocab_size, (batch_size, num_masked, 1)).astype(np.int64),
+        "nsp_label": rng.randint(0, 2, (batch_size, 1)).astype(np.int64),
+    } for _ in range(4)]
+    trainer = pt.Trainer(model, opt.AdamW(1e-4, weight_decay=0.01),
+                         loss_name="loss", fetch_list=["loss"])
     trainer.startup(sample_feed=feeds[0])
-    sec = _bench_loop(lambda f: trainer.step(f), feeds, trainer=trainer)
-    return batch_size / sec, "images/sec"
+    dt_pipe, dt_comp = _time_trainer(trainer, feeds, iters=iters)
+    f = flops.bert_train_flops(batch_size, seq, num_masked, cfg)
+    return _result(batch_size * seq, "tokens/sec", dt_pipe, dt_comp, f, peak)
 
 
-def bench_mnist_mlp(batch_size=128):
+def _bench_deepfm_config(peak, batch_size, sparse_feature_dim, iters=20):
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
+    from paddle_tpu.core import flops
+    from paddle_tpu.models import deepfm
+
+    fields, emb, dense_n, hidden = 26, 16, 13, (400, 400, 400)
+    model = pt.build(deepfm.make_model(num_sparse_fields=fields,
+                                       sparse_feature_dim=sparse_feature_dim,
+                                       embedding_size=emb, num_dense=dense_n,
+                                       hidden_dims=hidden))
+    rng = np.random.RandomState(0)
+    feeds = [{
+        "dense": rng.randn(batch_size, dense_n).astype(np.float32),
+        "sparse_ids": rng.randint(0, sparse_feature_dim, (batch_size, fields)).astype(np.int32),
+        "label": rng.randint(0, 2, (batch_size, 1)).astype(np.int64),
+    } for _ in range(4)]
+    trainer = pt.Trainer(model, opt.Adagrad(0.01), loss_name="loss",
+                         fetch_list=["loss"])
+    trainer.startup(sample_feed=feeds[0])
+    dt_pipe, dt_comp = _time_trainer(trainer, feeds, iters=iters)
+    f = flops.deepfm_train_flops(batch_size, fields, emb, dense_n, hidden)
+    res = _result(batch_size, "samples/sec", dt_pipe, dt_comp, f, peak)
+    res["embedding_rows"] = fields * sparse_feature_dim
+    return res
+
+
+def bench_deepfm(peak, batch_size=2048, iters=20):
+    """BASELINE DeepFM CTR config (Criteo-shaped: 26 sparse fields,
+    13 dense)."""
+    return _bench_deepfm_config(peak, batch_size, sparse_feature_dim=1000,
+                                iters=iters)
+
+
+def bench_deepfm_10m(peak, batch_size=2048, iters=20):
+    """Vocab-at-scale variant: 26×400k ≈ 10.4M embedding rows — the
+    distributed-lookup-table workload (distribute_transpiler.py:1100)
+    measured single-chip (lookup + row-update throughput)."""
+    return _bench_deepfm_config(peak, batch_size, sparse_feature_dim=400_000,
+                                iters=iters)
+
+
+def bench_mnist_mlp(peak, batch_size=128, iters=50):
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.core import flops
     from paddle_tpu.models import mnist
 
     model = pt.build(mnist.mlp)
     rng = np.random.RandomState(0)
     feeds = [{"image": rng.randn(batch_size, 784).astype(np.float32),
               "label": rng.randint(0, 10, (batch_size, 1)).astype(np.int64)}
-             for _ in range(2)]
+             for _ in range(4)]
     trainer = pt.Trainer(model, opt.SGD(0.01), loss_name="loss")
     trainer.startup(sample_feed=feeds[0])
-    sec = _bench_loop(lambda f: trainer.step(f), feeds, warmup=5, iters=50, trainer=trainer)
-    return batch_size / sec, "samples/sec"
+    dt_pipe, dt_comp = _time_trainer(trainer, feeds, warmup=5, iters=iters)
+    f = flops.mlp_train_flops(batch_size, (784, 200, 200, 10))
+    return _result(batch_size, "samples/sec", dt_pipe, dt_comp, f, peak)
 
 
-def bench_lstm(batch_size=64, seq=128, hidden=512):
+def bench_lstm(peak, batch_size=64, seq=128, hidden=512, iters=20):
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
+    from paddle_tpu.core import flops
     from paddle_tpu.models import lstm
 
     model = pt.build(lstm.make_model(vocab_size=10000, emb_dim=hidden,
@@ -151,44 +281,163 @@ def bench_lstm(batch_size=64, seq=128, hidden=512):
     feeds = [{"word_ids": rng.randint(0, 10000, (batch_size, seq)).astype(np.int64),
               "label": rng.randint(0, 2, (batch_size, 1)).astype(np.int64),
               "sequence_length": np.full((batch_size,), seq, np.int64)}
-             for _ in range(2)]
+             for _ in range(4)]
     trainer = pt.Trainer(model, opt.Adam(1e-3), loss_name="loss")
     trainer.startup(sample_feed=feeds[0])
-    sec = _bench_loop(lambda f: trainer.step(f), feeds, trainer=trainer)
-    return batch_size / sec, "samples/sec"
+    dt_pipe, dt_comp = _time_trainer(trainer, feeds, iters=iters)
+    f = flops.lstm_train_flops(batch_size, seq, hidden, num_layers=2)
+    return _result(batch_size, "samples/sec", dt_pipe, dt_comp, f, peak, "lstm")
+
+
+# -- inference configs -------------------------------------------------------
+
+
+def bench_resnet50_infer(peak, variant="fp32", batch_size=16, image_size=224,
+                         iters=50):
+    """AOT Predictor serving loop (api_impl.cc Run analog): host numpy →
+    device → compiled executable, per call. Variants: fp32, bf16 (weights
+    + compute cast), int8 (PTQ weight quantization, dequantized to bf16
+    at load — weight-compression parity with the reference's INT8 path)."""
+    import tempfile
+
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import io as pio, quantize
+    from paddle_tpu.core import flops
+    from paddle_tpu.core.config import set_flag
+    from paddle_tpu.models import resnet
+
+    set_flag("default_compute_dtype",
+             "float32" if variant == "fp32" else "bfloat16")
+    model = pt.build(resnet.make_model(depth=50, class_num=1000,
+                                       image_size=image_size))
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.randn(batch_size, 3, image_size, image_size).astype(np.float32),
+            "label": rng.randint(0, 1000, (batch_size, 1)).astype(np.int64)}
+    params, state = model.init(jax.random.PRNGKey(0), **feed)
+    if variant == "bf16":
+        params = quantize.cast_params_for_inference(params)
+    elif variant == "int8":
+        params = quantize.dequantize_params(quantize.quantize_params(params),
+                                            dtype=jax.numpy.bfloat16)
+    with tempfile.TemporaryDirectory() as d:
+        pio.save_inference_model(d, model, params, state, feed)
+        pred = pio.load_inference_model(d)
+    feeds = [{"image": rng.randn(batch_size, 3, image_size, image_size).astype(np.float32),
+              "label": feed["label"]} for _ in range(4)]
+    for i in range(5):
+        out = pred.run(feeds[i % len(feeds)])
+    _sync(out)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = pred.run(feeds[i % len(feeds)])
+    _sync(out)
+    dt = (time.perf_counter() - t0) / iters
+    f = flops.resnet_fwd_flops(50, image_size) * batch_size
+    res = _result(batch_size, "images/sec", dt, dt, f, peak,
+                  f"resnet50_infer_{variant}")
+    del res["compute_only"], res["mfu_compute_only"]  # serving loop has no pre-staged variant
+    return res
+
+
+# -- suite -------------------------------------------------------------------
+
+TRAIN_CONFIGS = {
+    "mnist_mlp": bench_mnist_mlp,
+    "resnet50": bench_resnet50,
+    "vgg16": bench_vgg16,
+    "lstm": bench_lstm,
+    "transformer": bench_transformer,
+    "transformer_long": bench_transformer_long,
+    "bert": bench_bert,
+    "deepfm": bench_deepfm,
+    "deepfm_10m": bench_deepfm_10m,
+}
+
+INFER_VARIANTS = ("fp32", "bf16", "int8")
+
+
+def run_suite(compute_dtype="bfloat16", quick=False):
+    import sys
+
+    import jax
+    from paddle_tpu.core import flops
+    from paddle_tpu.core.config import set_flag
+
+    set_flag("default_compute_dtype", compute_dtype)
+    dev = jax.devices()[0]
+    peak, peak_source = flops.device_peak_flops(dev)
+    configs = {}
+    kw = {"iters": 3} if quick else {}
+    for name, fn in TRAIN_CONFIGS.items():
+        try:
+            set_flag("default_compute_dtype", compute_dtype)
+            configs[f"{name}_train"] = fn(peak, **kw)
+        except Exception as e:  # record the failure, keep the suite going
+            configs[f"{name}_train"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] {name} failed: {e}", file=sys.stderr)
+    for variant in INFER_VARIANTS:
+        try:
+            configs[f"resnet50_infer_{variant}"] = bench_resnet50_infer(
+                peak, variant=variant, **({"iters": 3} if quick else {}))
+        except Exception as e:
+            configs[f"resnet50_infer_{variant}"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"[bench] infer/{variant} failed: {e}", file=sys.stderr)
+    set_flag("default_compute_dtype", "float32")
+
+    mfus = [c["mfu"] for n, c in configs.items()
+            if n.endswith("_train") and "mfu" in c]
+    headline = max(mfus) if mfus else 0.0
+    rn = configs.get("resnet50_train", {})
+    return {
+        "metric": "suite",
+        "value": round(headline, 4),
+        "unit": "MFU",
+        "vs_baseline": rn.get("vs_baseline"),
+        "device": getattr(dev, "device_kind", str(dev)),
+        "peak_flops": peak,
+        "peak_source": peak_source,
+        "compute_dtype": compute_dtype,
+        "configs": configs,
+    }
 
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "transformer", "transformer_long", "mnist_mlp", "lstm", "vgg16"])
+    p.add_argument("--model", default=None,
+                   choices=sorted(TRAIN_CONFIGS) + ["suite"],
+                   help="single config (default: full suite)")
     p.add_argument("--batch_size", type=int, default=None)
     p.add_argument("--compute_dtype", default="bfloat16",
                    choices=["float32", "bfloat16"],
                    help="mixed-precision compute dtype (master params stay f32)")
+    p.add_argument("--quick", action="store_true",
+                   help="3 timing iters per config (harness smoke test)")
     args = p.parse_args()
 
-    from paddle_tpu.core.config import set_flag
-    set_flag("default_compute_dtype", args.compute_dtype)
+    if args.model in (None, "suite"):
+        if args.batch_size:
+            p.error("--batch_size applies to a single --model config, "
+                    "not the full suite")
+        print(json.dumps(run_suite(args.compute_dtype, quick=args.quick)))
+        return
 
+    import jax
+    from paddle_tpu.core import flops
+    from paddle_tpu.core.config import set_flag
+
+    set_flag("default_compute_dtype", args.compute_dtype)
+    peak, peak_source = flops.device_peak_flops(jax.devices()[0])
     kw = {}
     if args.batch_size:
         kw["batch_size"] = args.batch_size
-    value, unit = {
-        "resnet50": bench_resnet50,
-        "transformer": bench_transformer,
-        "transformer_long": bench_transformer_long,
-        "mnist_mlp": bench_mnist_mlp,
-        "lstm": bench_lstm,
-        "vgg16": bench_vgg16,
-    }[args.model](**kw)
-
-    base = BASELINES.get(args.model)
+    if args.quick:
+        kw["iters"] = 3
+    res = TRAIN_CONFIGS[args.model](peak, **kw)
     print(json.dumps({
         "metric": f"{args.model}_train_throughput_{args.compute_dtype}",
-        "value": round(float(value), 2),
-        "unit": unit,
-        "vs_baseline": round(float(value) / base, 2) if base else None,
+        "peak_source": peak_source,
+        **res,
     }))
 
 

@@ -436,7 +436,9 @@ class Program:
 
 def _concretize(x):
     if isinstance(x, jax.ShapeDtypeStruct):
-        return jnp.zeros(x.shape, x.dtype)
+        # canonicalize first: int64 specs under the default x64-off config
+        # would otherwise emit a truncation UserWarning on every trace
+        return jnp.zeros(x.shape, jax.dtypes.canonicalize_dtype(x.dtype))
     return x
 
 

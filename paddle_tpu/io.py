@@ -27,6 +27,12 @@ import numpy as np
 
 SEP = "||"  # path separator for nested pytree keys (param names use '/')
 
+# numpy's npz format stores ml_dtypes extension types (bfloat16, fp8) as
+# raw void bytes that can't round-trip; encode them as a same-width
+# integer view with a "@dtype" key suffix instead.
+_EXOTIC_DTYPES = {"bfloat16": np.uint16,
+                  "float8_e4m3fn": np.uint8, "float8_e5m2": np.uint8}
+
 
 # -- pytree <-> flat dict ----------------------------------------------------
 
@@ -39,13 +45,27 @@ def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
     elif tree is None:
         pass
     else:
-        out[prefix] = np.asarray(tree)
+        arr = np.asarray(tree)
+        if arr.dtype.name in _EXOTIC_DTYPES:
+            out[f"{prefix}@{arr.dtype.name}"] = arr.view(_EXOTIC_DTYPES[arr.dtype.name])
+        else:
+            out[prefix] = arr
     return out
 
 
 def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    import ml_dtypes
+
     out: Dict[str, Any] = {}
     for key, v in flat.items():
+        if "@" in key:
+            maybe_key, _, dtname = key.rpartition("@")
+            # only strip the suffix for dtypes *we* appended on save (the
+            # stored array then has the matching integer view); a user
+            # param literally named "x@foo" must pass through intact
+            if dtname in _EXOTIC_DTYPES and v.dtype == _EXOTIC_DTYPES[dtname]:
+                key = maybe_key
+                v = v.view(np.dtype(getattr(ml_dtypes, dtname)))
         parts = key.split(SEP)
         d = out
         for p in parts[:-1]:
@@ -174,20 +194,39 @@ def save_inference_model(dirname: str, program, params: Dict[str, jax.Array],
 class Predictor:
     """Loaded inference model (PaddlePredictor analog,
     paddle_inference_api.h:141: Run(inputs)->outputs; Clone is free —
-    the executable is stateless and thread-safe)."""
+    the executable is stateless and thread-safe).
 
-    def __init__(self, exported, params, state, feed_names):
+    The executable is **AOT-compiled once** at construction
+    (jit(exported.call).lower(...).compile() from the export's own
+    in_avals — the NativePaddlePredictor Init/Prepare split,
+    api_impl.cc:64): ``run()`` never re-enters tracing/compilation, it
+    only device_puts the feeds and executes."""
+
+    def __init__(self, exported, params, state, feed_names, _compiled=None):
         self._exported = exported
-        self._params = params
-        self._state = state
+        self._params = jax.device_put(params)
+        self._state = jax.device_put(state)
         self.feed_names = feed_names
+        if _compiled is None:
+            flat = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                    for a in exported.in_avals]
+            try:
+                args, kwargs = jax.tree.unflatten(exported.in_tree, flat)
+                _compiled = jax.jit(exported.call).lower(*args, **kwargs).compile()
+            except Exception:
+                # fall back to the jit dispatch cache: first run() traces,
+                # subsequent calls still skip tracing/compilation
+                _compiled = jax.jit(exported.call)
+        self._compiled = _compiled
 
     def run(self, feed: Dict[str, Any]):
         vals = [jnp.asarray(np.asarray(feed[k])) for k in self.feed_names]
-        return self._exported.call(self._params, self._state, *vals)
+        return self._compiled(self._params, self._state, *vals)
 
     def clone(self) -> "Predictor":
-        return Predictor(self._exported, self._params, self._state, self.feed_names)
+        # share the compiled executable and device-resident weights
+        return Predictor(self._exported, self._params, self._state,
+                         self.feed_names, _compiled=self._compiled)
 
 
 def load_inference_model(dirname: str) -> Predictor:

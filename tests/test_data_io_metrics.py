@@ -225,3 +225,54 @@ def test_auc_layer_pr_curve():
     with pytest.raises(EnforceError):
         pt.build(functools.partial(f, curve="XX")).init(
             jax.random.PRNGKey(0), probs, labels)
+
+
+def test_persistables_bfloat16_roundtrip(tmp_path):
+    """npz stores ml_dtypes extension types as void bytes; the @dtype key
+    encoding must round-trip bf16 params exactly (infer-export path)."""
+    import jax.numpy as jnp
+
+    params = {"w": jnp.arange(6, dtype=jnp.bfloat16).reshape(2, 3) * 0.5,
+              "nested": {"b": jnp.ones((4,), jnp.bfloat16)}}
+    pio.save_persistables(str(tmp_path / "ck"), params, {})
+    loaded, _, _, _ = pio.load_persistables(str(tmp_path / "ck"))
+    assert loaded["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(params["w"]).view(np.uint16),
+                                  loaded["w"].view(np.uint16))
+    assert loaded["nested"]["b"].dtype == jnp.bfloat16
+
+
+def test_persistables_at_sign_in_name(tmp_path):
+    """Param names may contain '@' (reference uses @LR_DECAY_COUNTER@,
+    p@GRAD); the exotic-dtype key suffix must not swallow them."""
+    import jax.numpy as jnp
+
+    params = {"@LR_DECAY_COUNTER@": np.float32(3.0),
+              "x@bfloat16": np.ones((2,), np.float32),  # adversarial name
+              "real_bf16": jnp.ones((2,), jnp.bfloat16)}
+    pio.save_persistables(str(tmp_path / "ck"), params, {})
+    loaded, _, _, _ = pio.load_persistables(str(tmp_path / "ck"))
+    assert float(loaded["@LR_DECAY_COUNTER@"]) == 3.0
+    assert loaded["x@bfloat16"].dtype == np.float32
+    assert loaded["real_bf16"].dtype == jnp.bfloat16
+
+
+def test_predictor_aot_no_retrace(tmp_path):
+    """Predictor compiles once at load; run() executes the same compiled
+    executable (api_impl.cc:64 Init/Run split) — 100 calls, no tracing."""
+    import jax
+
+    from paddle_tpu.models import mnist
+
+    prog = pt.build(mnist.mlp)
+    feed = {"image": np.random.randn(8, 784).astype(np.float32),
+            "label": np.random.randint(0, 10, (8, 1)).astype(np.int64)}
+    params, state = prog.init(jax.random.PRNGKey(0), **feed)
+    pio.save_inference_model(str(tmp_path / "m"), prog, params, state, feed)
+    pred = pio.load_inference_model(str(tmp_path / "m"))
+    assert type(pred._compiled).__name__ == "Compiled"  # AOT, not a jit wrapper
+    outs = [pred.run(feed)["loss"] for _ in range(100)]
+    assert np.allclose([float(o) for o in outs], float(outs[0]))
+    clone = pred.clone()
+    assert clone._compiled is pred._compiled  # Clone shares the executable
+    np.testing.assert_allclose(float(clone.run(feed)["loss"]), float(outs[0]))
