@@ -210,14 +210,37 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def _loss_and_aux(self, params, state, rng, feed):
-        from .framework import remat_mode
+        import contextlib
+
+        from .framework import pipeline_mode, remat_mode
 
         # strategy.remat (memory_optimize analog) flips the ambient
         # trace-time switch; zoo models wrap their repeated blocks in
         # maybe_remat, so jax.checkpoint lands per block
-        with remat_mode(bool(getattr(self.strategy, "remat", False))):
+        pp_m = getattr(self.strategy, "pp_microbatches", 0) if self.strategy else 0
+        pp_on = (pp_m > 0 and self.mesh is not None
+                 and "pp" in self.mesh.axis_names and self.mesh.shape["pp"] > 1)
+        if pp_m > 0 and not pp_on:
+            import warnings
+            warnings.warn(
+                f"DistStrategy.pp_microbatches={pp_m} but the mesh "
+                f"{dict(self.mesh.shape) if self.mesh is not None else None} "
+                f"has no 'pp' axis (size>1); training proceeds WITHOUT "
+                f"pipeline parallelism")
+        pp_ctx = (pipeline_mode(self.mesh, pp_m) if pp_on
+                  else contextlib.nullcontext())
+        with remat_mode(bool(getattr(self.strategy, "remat", False))), pp_ctx as pp_cfg:
             out, new_state = self.program.apply(params, state, training=True,
                                                 rng=rng, **feed)
+        if pp_on and not pp_cfg["consumed"]:
+            import warnings
+            warnings.warn(
+                "DistStrategy.pp_microbatches is set but the model never "
+                "routed a stacked block stack through the pipeline (no "
+                "layers.stacked.apply_stacked call) — every pp rank is "
+                "redundantly computing the full model. Build the model "
+                "with its stacked/pipeline representation (e.g. "
+                "TransformerConfig(stacked=True)).")
         if isinstance(out, dict):
             loss = out[self.loss_name]
         else:

@@ -477,6 +477,39 @@ def remat_enabled() -> bool:
     return getattr(_remat_mode, "on", False)
 
 
+_pipeline_mode = threading.local()
+
+
+@contextlib.contextmanager
+def pipeline_mode(mesh, microbatches: int, axis: str = "pp"):
+    """Ambient pipeline-parallel switch (trace-time, like
+    :func:`remat_mode`). Trainer enters this around ``program.apply``
+    when ``DistStrategy.pp_microbatches`` is set and the mesh has a
+    ``pp`` axis; zoo models route their stacked block stacks through
+    ``layers.stacked.apply_stacked``, which consumes it and runs
+    ``parallel.pipeline.pipeline_apply`` instead of a sequential scan."""
+    old = getattr(_pipeline_mode, "cfg", None)
+    cfg = {"mesh": mesh, "microbatches": int(microbatches), "axis": axis,
+           "consumed": False}
+    _pipeline_mode.cfg = cfg
+    try:
+        yield cfg
+    finally:
+        _pipeline_mode.cfg = old
+
+
+def pipeline_config() -> Optional[dict]:
+    """The active pipeline context, or None. Init-mode builds always see
+    None: parameter creation must not run under shard_map."""
+    ctx = current_context()
+    if ctx is not None and ctx.mode == "init":
+        return None
+    cfg = getattr(_pipeline_mode, "cfg", None)
+    if cfg is not None:
+        cfg["consumed"] = True
+    return cfg
+
+
 def maybe_remat(fn: Callable, enabled: Optional[bool] = None,
                 policy: Optional[Callable] = None) -> Callable:
     """Wrap ``fn`` in ``jax.checkpoint`` when remat is requested — either

@@ -1,0 +1,286 @@
+"""Stacked transformer blocks — the pipeline-parallel layer representation.
+
+Gap-fill component (SURVEY §2.2: PP absent in the reference; the closest
+machinery is the multi-device SSA replication of
+framework/details/multi_devices_graph_pass.cc, which replicates ops per
+device — here we *partition layers* per device instead).
+
+TPU-native design: per-layer parameters live STACKED on a leading
+``[num_layers, ...]`` axis, created once through the normal LayerHelper
+scope (so save/load, sharding rules, and optimizers see ordinary named
+params). The stack is applied either
+
+- sequentially with ``lax.scan`` (single chip, or dp/fsdp/tp meshes where
+  GSPMD partitions the scanned matmuls), or
+- pipelined with ``parallel.pipeline.pipeline_apply`` when the Trainer
+  has entered :func:`framework.pipeline_mode` (``DistStrategy.pp_microbatches``),
+  each pp rank owning a contiguous span of layers.
+
+Blocks are pure functions of ``(activation, layer_params, extra)`` — no
+LayerHelper calls inside, so they trace safely under scan and shard_map.
+Dropout is intentionally unsupported inside stacked blocks (a scan-traced
+RNG fold-in would reuse one key across layers); stacked configs train
+with dropout 0, as the long-context/pp configs do anyway.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.errors import enforce
+from ..framework import LayerHelper, cast_compute, pipeline_config
+from .. import initializer as init
+
+NEG_INF = -1e9
+
+
+class StackedInit:
+    """Apply a base initializer per layer over the leading stack axis, so
+    a ``[L, d, k]`` leaf gets L independent ``[d, k]`` inits (fan-in/out
+    computed per layer, matching the unstacked model exactly)."""
+
+    def __init__(self, base):
+        self.base = base
+
+    def __call__(self, key, shape, dtype):
+        keys = jax.random.split(key, shape[0])
+        return jnp.stack([self.base(k, shape[1:], dtype) for k in keys])
+
+
+def _ln(x, scale, bias, eps: float = 1e-5):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    return out * scale + bias
+
+
+def _sdpa(q, k, v, key_bias, causal: bool, use_flash: bool):
+    """[b,h,s,hd] attention with an additive [b,s_k] key bias."""
+    if use_flash:
+        from ..ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, key_bias=key_bias)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if key_bias is not None:
+        logits = logits + key_bias[:, None, None, :]
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        logits = jnp.where(cm, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _split_heads(x, head_dim):
+    # split by head_dim, not head count: under tensor parallelism the
+    # projection output is a tp-local slice holding num_heads/tp whole
+    # heads, so the local head count falls out of the shape
+    b, s, d = x.shape
+    return x.reshape(b, s, d // head_dim, head_dim).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x):
+    b, h, s, hd = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
+
+
+# -- parameter stacks --------------------------------------------------------
+
+
+def encoder_stack_params(num_layers: int, d_model: int, d_inner: int,
+                         name: str = "encoder_stack") -> Dict[str, jax.Array]:
+    """Create the stacked params of ``num_layers`` pre-LN self-attention
+    blocks. The fused qkv weight is [L, d, 3, d_model] (q/k/v on their own
+    axis, so a tensor-parallel shard of the LAST dim keeps whole heads —
+    the Megatron fused-qkv layout)."""
+    helper = LayerHelper(name, name=name)
+    xavier = StackedInit(init.Xavier())
+    zeros = init.Constant(0.0)
+    ones = init.Constant(1.0)
+    L, d, di = num_layers, d_model, d_inner
+    p = {
+        "ln1/scale": helper.create_parameter("ln1/scale", (L, d), jnp.float32, initializer=ones),
+        "ln1/bias": helper.create_parameter("ln1/bias", (L, d), jnp.float32, initializer=zeros),
+        "qkv/w": helper.create_parameter("qkv/w", (L, d, 3, d), jnp.float32, initializer=xavier),
+        "qkv/b": helper.create_parameter("qkv/b", (L, 3, d), jnp.float32, initializer=zeros),
+        "out/w": helper.create_parameter("out/w", (L, d, d), jnp.float32, initializer=xavier),
+        "out/b": helper.create_parameter("out/b", (L, d), jnp.float32, initializer=zeros),
+        "ln2/scale": helper.create_parameter("ln2/scale", (L, d), jnp.float32, initializer=ones),
+        "ln2/bias": helper.create_parameter("ln2/bias", (L, d), jnp.float32, initializer=zeros),
+        "ffn_in/w": helper.create_parameter("ffn_in/w", (L, d, di), jnp.float32, initializer=xavier),
+        "ffn_in/b": helper.create_parameter("ffn_in/b", (L, di), jnp.float32, initializer=zeros),
+        "ffn_out/w": helper.create_parameter("ffn_out/w", (L, di, d), jnp.float32, initializer=xavier),
+        "ffn_out/b": helper.create_parameter("ffn_out/b", (L, d), jnp.float32, initializer=zeros),
+    }
+    return p
+
+
+def decoder_stack_params(num_layers: int, d_model: int, d_inner: int,
+                         name: str = "decoder_stack") -> Dict[str, jax.Array]:
+    """Stacked pre-LN decoder blocks: causal self-attention + cross
+    attention (encoder-decoder capability of the reference's transformer
+    benchmark) + FFN."""
+    p = encoder_stack_params(num_layers, d_model, d_inner, name=name)
+    helper = LayerHelper(name, name=name)
+    xavier = StackedInit(init.Xavier())
+    zeros = init.Constant(0.0)
+    ones = init.Constant(1.0)
+    L, d = num_layers, d_model
+    p.update({
+        "lnx/scale": helper.create_parameter("lnx/scale", (L, d), jnp.float32, initializer=ones),
+        "lnx/bias": helper.create_parameter("lnx/bias", (L, d), jnp.float32, initializer=zeros),
+        "xq/w": helper.create_parameter("xq/w", (L, d, d), jnp.float32, initializer=xavier),
+        "xq/b": helper.create_parameter("xq/b", (L, d), jnp.float32, initializer=zeros),
+        "xkv/w": helper.create_parameter("xkv/w", (L, d, 2, d), jnp.float32, initializer=xavier),
+        "xkv/b": helper.create_parameter("xkv/b", (L, 2, d), jnp.float32, initializer=zeros),
+        "xout/w": helper.create_parameter("xout/w", (L, d, d), jnp.float32, initializer=xavier),
+        "xout/b": helper.create_parameter("xout/b", (L, d), jnp.float32, initializer=zeros),
+    })
+    return p
+
+
+# -- block functions ---------------------------------------------------------
+
+
+def _self_attention(x, p, num_heads, causal, use_flash, key_bias, tp_axis):
+    head_dim = x.shape[-1] // num_heads  # d_model is replicated across tp
+    h = _ln(x, p["ln1/scale"], p["ln1/bias"])
+    h, w = cast_compute(h, p["qkv/w"])
+    qkv = jnp.einsum("bsd,dke->bske", h, w) + p["qkv/b"].astype(h.dtype)
+    q, k, v = (_split_heads(qkv[:, :, i], head_dim) for i in range(3))
+    o = _merge_heads(_sdpa(q, k, v, key_bias, causal, use_flash))
+    o, ow = cast_compute(o, p["out/w"])
+    o = jnp.matmul(o, ow)
+    if tp_axis:
+        o = jax.lax.psum(o, tp_axis)
+    return x + o + p["out/b"].astype(o.dtype)
+
+
+def _ffn(x, p, tp_axis):
+    h = _ln(x, p["ln2/scale"], p["ln2/bias"])
+    h, w1, w2 = cast_compute(h, p["ffn_in/w"], p["ffn_out/w"])
+    h = jax.nn.relu(jnp.matmul(h, w1) + p["ffn_in/b"].astype(h.dtype))
+    h = jnp.matmul(h, w2)
+    if tp_axis:
+        h = jax.lax.psum(h, tp_axis)
+    return x + h + p["ffn_out/b"].astype(h.dtype)
+
+
+def make_encoder_block(num_heads: int, use_flash: bool = False,
+                       causal: bool = False,
+                       tp_axis: Optional[str] = None) -> Callable:
+    """layer_fn(x, layer_params, key_bias) for pipeline_apply/scan. When
+    ``tp_axis`` is set, attention/ffn heads are tp-local and the output
+    projections psum partial sums (Megatron pattern inside a stage)."""
+
+    def block(x, p, key_bias=None):
+        x = _self_attention(x, p, num_heads, causal, use_flash,
+                            key_bias, tp_axis)
+        return _ffn(x, p, tp_axis)
+
+    return block
+
+
+def make_decoder_block(num_heads: int, use_flash: bool = False,
+                       causal: bool = True,
+                       tp_axis: Optional[str] = None) -> Callable:
+    """layer_fn(x, layer_params, extra) with extra = {"enc": encoder
+    output [b,s,d], "enc_bias": additive [b,s] padding bias}. Causal
+    self-attention + cross attention + FFN."""
+
+    def block(x, p, extra):
+        head_dim = x.shape[-1] // num_heads
+        x = _self_attention(x, p, num_heads, causal, use_flash, None, tp_axis)
+        h = _ln(x, p["lnx/scale"], p["lnx/bias"])
+        h, wq, wkv, enc = cast_compute(h, p["xq/w"], p["xkv/w"], extra["enc"])
+        q = jnp.matmul(h, wq) + p["xq/b"].astype(h.dtype)
+        kv = jnp.einsum("bsd,dke->bske", enc, wkv) + p["xkv/b"].astype(h.dtype)
+        q = _split_heads(q, head_dim)
+        k, v = (_split_heads(kv[:, :, i], head_dim) for i in range(2))
+        o = _merge_heads(_sdpa(q, k, v, extra.get("enc_bias"), False, use_flash))
+        o, ow = cast_compute(o, p["xout/w"])
+        o = jnp.matmul(o, ow)
+        if tp_axis:
+            o = jax.lax.psum(o, tp_axis)
+        x = x + o + p["xout/b"].astype(o.dtype)
+        return _ffn(x, p, tp_axis)
+
+    return block
+
+
+# -- tensor-parallel specs (non-layer dims, pipeline_apply param_specs) ------
+
+_ENCODER_TP_SPECS = {
+    "ln1/scale": P(), "ln1/bias": P(),
+    "qkv/w": P(None, None, "tp"), "qkv/b": P(None, "tp"),
+    "out/w": P("tp"), "out/b": P(),
+    "ln2/scale": P(), "ln2/bias": P(),
+    "ffn_in/w": P(None, "tp"), "ffn_in/b": P("tp"),
+    "ffn_out/w": P("tp"), "ffn_out/b": P(),
+}
+
+_DECODER_TP_SPECS = dict(_ENCODER_TP_SPECS, **{
+    "lnx/scale": P(), "lnx/bias": P(),
+    "xq/w": P(None, "tp"), "xq/b": P("tp"),
+    "xkv/w": P(None, None, "tp"), "xkv/b": P(None, "tp"),
+    "xout/w": P("tp"), "xout/b": P(),
+})
+
+
+def stack_tp_specs(stacked: Dict[str, Any]) -> Dict[str, Any]:
+    table = _DECODER_TP_SPECS if "xq/w" in stacked else _ENCODER_TP_SPECS
+    return {k: table[k] for k in stacked}
+
+
+# -- apply -------------------------------------------------------------------
+
+
+def apply_stacked(x, stacked: Dict[str, jax.Array], make_block: Callable,
+                  extras=None, num_heads: int = 8, use_flash: bool = False,
+                  causal: bool = False, remat: bool = False):
+    """Run a parameter stack over ``x``: pipelined across the ``pp`` mesh
+    axis when the Trainer has entered :func:`framework.pipeline_mode`
+    (DistStrategy.pp_microbatches — the BuildStrategy-knob analog),
+    sequential ``lax.scan`` otherwise (where GSPMD still tp/fsdp-shards
+    the scanned matmuls from the rule-table shardings).
+
+    ``make_block(num_heads=…, use_flash=…, causal=…, tp_axis=…)`` builds
+    the layer fn — tp_axis is set when the pipeline mesh also has a
+    ``tp`` axis, making dp×tp×pp one call.
+    """
+    cfg = pipeline_config()
+    if cfg is None:
+        block = make_block(num_heads=num_heads, use_flash=use_flash,
+                           causal=causal, tp_axis=None)
+
+        from ..framework import maybe_remat
+        def scan_body(a, lp):
+            fn = (lambda a_, lp_: block(a_, lp_, extras)) if extras is not None \
+                else (lambda a_, lp_: block(a_, lp_))
+            # remat=True forces per-layer checkpointing (cfg.remat);
+            # False defers to the ambient strategy.remat switch
+            return maybe_remat(fn, enabled=remat or None)(a, lp), None
+        out, _ = jax.lax.scan(scan_body, x, stacked)
+        return out
+
+    from ..parallel.pipeline import pipeline_apply
+    mesh = cfg["mesh"]
+    tp = "tp" if ("tp" in mesh.axis_names and mesh.shape["tp"] > 1) else None
+    if tp:
+        enforce(num_heads % mesh.shape["tp"] == 0,
+                f"stacked blocks with tp={mesh.shape['tp']} need num_heads "
+                f"({num_heads}) divisible by tp")
+    block = make_block(num_heads=num_heads, use_flash=use_flash,
+                       causal=causal, tp_axis=tp)
+    layer_fn = block if extras is not None else (lambda a, lp: block(a, lp))
+    return pipeline_apply(
+        x, stacked, layer_fn, mesh, axis_name=cfg["axis"],
+        microbatches=cfg["microbatches"],
+        param_specs=stack_tp_specs(stacked) if tp else None,
+        extras=extras)

@@ -43,6 +43,11 @@ class TransformerConfig:
     # in backward (memory_optimize analog). False still honors the
     # ambient framework.remat_mode the Trainer sets from strategy.remat.
     remat: bool = False
+    # stacked-block representation (layers.stacked): per-layer params on
+    # a leading [L, ...] axis — required for pipeline parallelism
+    # (DistStrategy.pp_microbatches) and scan-compiled on a single chip.
+    # Needs dropout == 0 (see layers/stacked.py docstring).
+    stacked: bool = False
     dtype: str = "float32"
 
 
@@ -88,6 +93,13 @@ def decoder_layer(x, enc_out, cfg: TransformerConfig, self_mask, cross_mask,
     return (x, cache) if cache is not None else x
 
 
+def _check_stacked(cfg):
+    from ..core.errors import enforce
+    enforce(cfg.dropout == 0.0,
+            "cfg.stacked requires dropout == 0 (stacked blocks are pure "
+            "functions; see layers/stacked.py)")
+
+
 def encode(src_ids, cfg: TransformerConfig):
     dtype = jnp.dtype(cfg.dtype)
     x = _embed(src_ids, cfg.src_vocab, cfg.d_model, dtype, "src")
@@ -95,12 +107,22 @@ def encode(src_ids, cfg: TransformerConfig):
     x = L.dropout(x, cfg.dropout, dropout_implementation="upscale_in_train")
     mask = A.padding_mask(src_ids)
     with name_scope("encoder"):
-        for _ in range(cfg.num_encoder_layers):
-            # fresh wrapper per layer: jax.checkpoint caches the traced
-            # body per fn object, and each layer must trace (and create
-            # its own params) separately
-            x = maybe_remat(lambda a, m: encoder_layer(a, cfg, m),
-                            enabled=cfg.remat or None)(x, mask)
+        if cfg.stacked:
+            _check_stacked(cfg)
+            from ..layers import stacked as S
+            stack = S.encoder_stack_params(cfg.num_encoder_layers,
+                                           cfg.d_model, cfg.d_inner)
+            key_bias = mask[:, 0, 0, :]  # additive [b, s]
+            x = S.apply_stacked(x, stack, S.make_encoder_block,
+                                extras=key_bias, num_heads=cfg.num_heads,
+                                use_flash=cfg.use_flash, remat=cfg.remat)
+        else:
+            for _ in range(cfg.num_encoder_layers):
+                # fresh wrapper per layer: jax.checkpoint caches the traced
+                # body per fn object, and each layer must trace (and create
+                # its own params) separately
+                x = maybe_remat(lambda a, m: encoder_layer(a, cfg, m),
+                                enabled=cfg.remat or None)(x, mask)
         x = L.layer_norm(x, begin_norm_axis=2)
     return x, mask
 
@@ -113,9 +135,20 @@ def decode_hidden(trg_ids, enc_out, cross_mask, cfg: TransformerConfig):
     x = x + A.positional_encoding(trg_ids.shape[1], cfg.d_model, dtype)[None]
     x = L.dropout(x, cfg.dropout, dropout_implementation="upscale_in_train")
     with name_scope("decoder"):
-        for _ in range(cfg.num_decoder_layers):
-            x = maybe_remat(lambda a, e, cm: decoder_layer(a, e, cfg, None, cm),
-                            enabled=cfg.remat or None)(x, enc_out, cross_mask)
+        if cfg.stacked:
+            _check_stacked(cfg)
+            from ..layers import stacked as S
+            stack = S.decoder_stack_params(cfg.num_decoder_layers,
+                                           cfg.d_model, cfg.d_inner)
+            extras = {"enc": enc_out, "enc_bias": cross_mask[:, 0, 0, :]}
+            x = S.apply_stacked(x, stack, S.make_decoder_block,
+                                extras=extras, num_heads=cfg.num_heads,
+                                use_flash=cfg.use_flash, causal=True,
+                                remat=cfg.remat)
+        else:
+            for _ in range(cfg.num_decoder_layers):
+                x = maybe_remat(lambda a, e, cm: decoder_layer(a, e, cfg, None, cm),
+                                enabled=cfg.remat or None)(x, enc_out, cross_mask)
         x = L.layer_norm(x, begin_norm_axis=2)
     helper = LayerHelper("logits_proj")
     w = helper.create_parameter("w", (cfg.d_model, cfg.trg_vocab), dtype,
@@ -137,8 +170,13 @@ def make_decoder(cfg: TransformerConfig, max_len: int, beam_size: int = 1,
 
     Returns a program fn: (src_ids [b, s]) -> ids [b, max_len] (greedy)
     or [b, beam, max_len] (beam)."""
+    from ..core.errors import enforce
     from ..framework import reuse_names
     from ..layers.beam_search import beam_search, greedy_search
+
+    enforce(not cfg.stacked,
+            "make_decoder (incremental decoding) supports the per-layer "
+            "param layout only; build it with cfg.stacked=False")
 
     def decode_program(src_ids):
         dtype = jnp.dtype(cfg.dtype)

@@ -32,18 +32,23 @@ def stack_layer_params(per_layer_params: list) -> Any:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer_params)
 
 
-def _pp_body(x, stacked, layer_fn, axis_name: str, microbatches: int,
+def _pp_body(x, stacked, extras, layer_fn, axis_name: str, microbatches: int,
              layers_per_stage: int, varying_axes: Tuple[str, ...]):
     """Per-rank body. x: local microbatch stack [M, ...mb shape...] on
     rank 0's slot (all ranks receive the same x spec; only rank 0's
-    content is used). stacked: this rank's [layers_per_stage, ...] params."""
+    content is used). stacked: this rank's [layers_per_stage, ...] params.
+    extras: pytree of [M, ...] per-microbatch side inputs (masks, encoder
+    outputs) — at tick t rank r works on microbatch t-r, so each rank
+    indexes the extras it needs directly rather than forwarding them."""
     p = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     m = microbatches
 
-    def apply_stage(act):
+    def apply_stage(act, extra):
         def one_layer(a, layer_params):
-            return layer_fn(a, layer_params), None
+            if extra is None:
+                return layer_fn(a, layer_params), None
+            return layer_fn(a, layer_params, extra), None
         out, _ = jax.lax.scan(one_layer, act, stacked)
         return out
 
@@ -56,7 +61,10 @@ def _pp_body(x, stacked, layer_fn, axis_name: str, microbatches: int,
         inject = jnp.where(t < m, t, m - 1)
         fresh = x[inject]
         cur = jnp.where(rank == 0, fresh, holding)
-        done = apply_stage(cur)
+        mb_idx = jnp.clip(t - rank, 0, m - 1)  # microbatch this rank holds
+        extra = (None if extras is None
+                 else jax.tree.map(lambda e: e[mb_idx], extras))
+        done = apply_stage(cur, extra)
         # last rank records finished microbatch (tick t finishes mb t-p+1)
         out_idx = t - (p - 1)
         record = (rank == p - 1) & (out_idx >= 0)
@@ -78,6 +86,14 @@ def _pp_body(x, stacked, layer_fn, axis_name: str, microbatches: int,
     return jax.lax.psum(outputs, axis_name)
 
 
+def bubble_fraction(pp: int, microbatches: int) -> float:
+    """GPipe bubble: of the M+P-1 schedule ticks, P-1 are fill/drain —
+    every rank executes its stage each tick (SPMD programs cannot skip
+    compute), so the wasted-FLOP fraction is exactly (P-1)/(M+P-1).
+    At pp=4, m=16: 15.8%; m=64: 4.5%. Raise ``microbatches`` to amortize."""
+    return (pp - 1) / (microbatches + pp - 1)
+
+
 def pipeline_apply(
     x,
     stacked_params,
@@ -87,27 +103,39 @@ def pipeline_apply(
     microbatches: int = 4,
     batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
     param_specs=None,
+    extras=None,
 ):
     """Run ``layer_fn`` over stacked layers pipelined across ``axis_name``.
 
     - x: activations [B, ...]; B divisible by ``microbatches``.
     - stacked_params: pytree with leading [L, ...] axis per leaf, L
       divisible by the pp size; rank k owns layers [k·L/P, (k+1)·L/P).
-    - layer_fn(activation, layer_params) -> activation.
+    - layer_fn(activation, layer_params[, extra]) -> activation.
     - param_specs: optional pytree of PartitionSpecs for each leaf's
       NON-layer dims (tensor parallelism inside a stage): e.g.
       ``{"w1": P("tp"), "w2": P(None, "tp")}`` — composed after the
       leading pp dim; layer_fn must then psum its tp partial sums
       (Megatron pattern), making dp×tp×pp 3D parallelism one call.
+    - extras: optional pytree of [B, ...] side inputs constant across
+      layers (attention masks, encoder outputs for cross-attention);
+      microbatched like ``x`` and delivered to whichever rank is working
+      on that microbatch each tick.
     """
+    if extras is not None and jax.tree.leaves(extras):
+        assert all(e.shape[0] == x.shape[0] for e in jax.tree.leaves(extras)), \
+            "extras leaves must share x's batch dim"
+    else:
+        extras = None
+
     if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
-        def _seq(xv, sp):
+        def _seq(xv, sp, ex):
             def one(a, lp):
-                return layer_fn(a, lp), None
+                out = layer_fn(a, lp) if ex is None else layer_fn(a, lp, ex)
+                return out, None
             out, _ = jax.lax.scan(one, xv, sp)
             return out
         if param_specs is None:
-            return _seq(x, stacked_params)
+            return _seq(x, stacked_params, extras)
         # degenerate pipeline but tp-parallel stages: layer_fn uses mesh
         # collectives, so it still needs to run under shard_map
         bspec = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
@@ -116,8 +144,12 @@ def pipeline_apply(
         param_spec = jax.tree.map(
             lambda leaf, extra: P(None, *(tuple(extra) + (None,) * (leaf.ndim - 1 - len(extra)))),
             stacked_params, param_specs)
-        return jax.shard_map(_seq, mesh=mesh, in_specs=(x_spec, param_spec),
-                             out_specs=x_spec, check_vma=False)(x, stacked_params)
+        ex_spec = None if extras is None else jax.tree.map(
+            lambda e: P(bshard, *([None] * (e.ndim - 1))), extras)
+        return jax.shard_map(_seq, mesh=mesh,
+                             in_specs=(x_spec, param_spec, ex_spec),
+                             out_specs=x_spec, check_vma=False)(
+                                 x, stacked_params, extras)
 
     p = mesh.shape[axis_name]
     L = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -126,10 +158,14 @@ def pipeline_apply(
     assert b % microbatches == 0, f"batch {b} not divisible by microbatches"
     mb = b // microbatches
     xm = x.reshape((microbatches, mb) + x.shape[1:])
+    exm = None if extras is None else jax.tree.map(
+        lambda e: e.reshape((microbatches, mb) + e.shape[1:]), extras)
 
     bspec = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
     bshard = bspec if len(bspec) > 1 else (bspec[0] if bspec else None)
     x_spec = P(None, bshard, *([None] * (x.ndim - 1)))
+    ex_spec = None if exm is None else jax.tree.map(
+        lambda e: P(None, bshard, *([None] * (e.ndim - 2))), exm)
     if param_specs is None:
         param_spec = jax.tree.map(lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))),
                                   stacked_params)
@@ -146,7 +182,8 @@ def pipeline_apply(
     # tp-invariant only because layer_fn psums — beyond the static
     # varying-axes analysis, so drop the VMA check in that case
     out = jax.shard_map(body, mesh=mesh,
-                        in_specs=(x_spec, param_spec),
+                        in_specs=(x_spec, param_spec, ex_spec),
                         out_specs=x_spec,
-                        check_vma=param_specs is None)(xm, stacked_params)
+                        check_vma=param_specs is None and extras is None)(
+                            xm, stacked_params, exm)
     return out.reshape((b,) + x.shape[1:])

@@ -173,8 +173,21 @@ class _FsdpRules(ShardingRules):
 
 def transformer_tp_rules(extra: Sequence[Tuple[str, SpecLike]] = ()) -> ShardingRules:
     """Megatron-style TP rules for the built-in transformer/BERT models
-    (gap-fill capability per SURVEY §2.2: TP absent in reference)."""
+    (gap-fill capability per SURVEY §2.2: TP absent in reference).
+
+    The ``_stack/`` rules cover stacked-block parameters
+    (layers.stacked): leading layer dim over ``pp``, Megatron dims over
+    ``tp`` — matching the specs pipeline_apply uses inside its
+    shard_map, so jit-level and pipeline-level shardings agree."""
     rules = [
+        (r".*_stack/(qkv|xkv)/w$", P("pp", None, None, "tp")),
+        (r".*_stack/(qkv|xkv)/b$", P("pp", None, "tp")),
+        (r".*_stack/(out|xout)/w$", P("pp", "tp", None)),
+        (r".*_stack/(ffn_in|xq)/w$", P("pp", None, "tp")),
+        (r".*_stack/(ffn_in|xq)/b$", P("pp", "tp")),
+        (r".*_stack/ffn_out/w$", P("pp", "tp", None)),
+        (r".*_stack/", P("pp")),
+    ] + [
         (r".*(q_proj|k_proj|v_proj|qkv_proj)/w$", P("fsdp", "tp")),
         (r".*(q_proj|k_proj|v_proj|qkv_proj)/b$", P("tp")),
         (r".*out_proj/w$", P("tp", "fsdp")),

@@ -31,3 +31,9 @@ class DistStrategy:
     loss_scale_growth_interval: int = 1000
     # debug dump of the compiled HLO (debug_graphviz_path analog).
     dump_hlo_path: Optional[str] = None
+    # pipeline parallelism: >0 routes zoo models' stacked block stacks
+    # through parallel.pipeline.pipeline_apply with this many
+    # microbatches (Trainer enters framework.pipeline_mode when the mesh
+    # has a 'pp' axis). Bubble fraction = (pp-1)/(m+pp-1); see
+    # parallel.pipeline.bubble_fraction.
+    pp_microbatches: int = 0
