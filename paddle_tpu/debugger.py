@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+import re as _re
+
 import jax
 import numpy as np
 
@@ -130,6 +132,127 @@ def memory_usage(program, params, state, *args, **kwargs) -> Dict[str, float]:
     }
 
 
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1, "c64": 8, "c128": 16,
+}
+
+# Tuple shapes may carry /*index=N*/ comments between elements, so match
+# the whole parenthesized group opaquely (shapes contain no parens) and
+# let _shape_sizes scan the dtypes/dims inside.
+_HLO_SHAPE = r"(?:\w+\[[^\]]*\](?:\{[^}]*\})?)"
+_COLLECTIVE_RE = _re.compile(
+    r"=\s+(\([^)]*\)|" + _HLO_SHAPE + r")\s+"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all|collective-broadcast)(-start)?\(")
+_GROUP_RE = _re.compile(r"replica_groups=\{?\{([0-9,]+)\}")
+_IOTA_GROUP_RE = _re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+_SHAPE_ELEM_RE = _re.compile(r"(\w+)\[([0-9,]*)\]")
+
+
+def _shape_sizes(s: str):
+    """Byte size of each array shape inside an HLO shape string."""
+    out = []
+    for m in _SHAPE_ELEM_RE.finditer(s):
+        dt, dims = m.group(1), m.group(2)
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        out.append(n * _DTYPE_BYTES.get(dt, 4))
+    return out
+
+
+def _parse_hlo_collectives(hlo_text: str, fallback_group_size: int = 0):
+    """Scan optimized-HLO text for collective ops; returns a list of
+    (kind, payload_bytes, group_size) triples ('-done' async halves are
+    skipped so each op counts once).
+
+    Payload = the op's result bytes. For sync ops and all-reduce-start
+    that is the summed output tuple (variadic all-reduce tuples are all
+    results); for all-gather-start / collective-permute-start the output
+    tuple also aliases the *operand* (plus u32 context scalars), so the
+    largest element — the result — is taken instead of the sum.
+
+    Group size comes from ``replica_groups`` in either the explicit
+    ``{{0,1},{2,3}}`` or the iota ``[G,S]<=[N]`` form; an empty ``{}``
+    (all devices) falls back to ``fallback_group_size``."""
+    out = []
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        kind, started = m.group(2), m.group(3) is not None
+        sizes = _shape_sizes(m.group(1))
+        if started and kind in ("all-gather", "collective-permute"):
+            payload = max(sizes, default=0)
+        else:
+            payload = sum(sizes)
+        g = _GROUP_RE.search(line)
+        if g:
+            gsize = len(g.group(1).split(","))
+        else:
+            gi = _IOTA_GROUP_RE.search(line)
+            gsize = int(gi.group(2)) if gi else fallback_group_size
+        out.append((kind, payload, gsize))
+    return out
+
+
+def _lower_step(trainer, feed):
+    """Lower the Trainer's compiled train step for the current scope +
+    feed shapes (shared preamble of the compiled-introspection family)."""
+    from .core.errors import enforce
+
+    enforce(trainer._step_fn is not None,
+            "call startup() before inspecting the compiled step")
+    feed = trainer._put_feed(feed)
+    ls = getattr(trainer.scope, "loss_scale_state", None) or {}
+    return trainer._step_fn.lower(trainer.scope.params, trainer.scope.opt_state,
+                                  trainer.scope.state, jax.random.PRNGKey(0),
+                                  feed, ls)
+
+
+def collective_report(trainer, feed) -> Dict[str, Any]:
+    """Per-step collective-traffic inventory of the compiled train step —
+    the scaling-efficiency evidence we can produce without a pod
+    (benchmark/README.md:70-95's 4-GPU scaling tables are the reference
+    anchor; here we count what XLA actually put on the wire).
+
+    Walks the optimized HLO and reports, per collective kind: op count,
+    summed payload bytes (output shapes), and estimated per-device wire
+    bytes using ring formulas (all-reduce 2·S·(n-1)/n; all-gather /
+    reduce-scatter / all-to-all S·(n-1)/n; collective-permute S), with n
+    the replica-group size. Numbers are for the current scope + feed
+    shapes on the trainer's mesh."""
+    hlo = _lower_step(trainer, feed).compile().as_text()
+    n_dev = (trainer.mesh.devices.size if trainer.mesh is not None
+             else jax.device_count())
+    entries = _parse_hlo_collectives(hlo, fallback_group_size=n_dev)
+
+    kinds: Dict[str, Dict[str, float]] = {}
+    total_payload = total_wire = 0.0
+    for kind, payload, gsize in entries:
+        n = max(gsize, 2)
+        factor = {"all-reduce": 2.0 * (n - 1) / n,
+                  "collective-permute": 1.0,
+                  "collective-broadcast": 1.0}.get(kind, (n - 1) / n)
+        wire = payload * factor
+        rec = kinds.setdefault(kind, {"count": 0, "payload_mb": 0.0, "wire_mb": 0.0})
+        rec["count"] += 1
+        rec["payload_mb"] += payload / 1e6
+        rec["wire_mb"] += wire / 1e6
+        total_payload += payload
+        total_wire += wire
+    mesh_shape = dict(trainer.mesh.shape) if trainer.mesh is not None else {}
+    return {
+        "mesh": mesh_shape,
+        "collectives": kinds,
+        "total_payload_mb": total_payload / 1e6,
+        "est_wire_mb_per_device": total_wire / 1e6,
+    }
+
+
 def compiled_memory_usage(trainer, feed) -> Dict[str, float]:
     """Buffer-assignment memory of the Trainer's compiled train step —
     the runtime-accurate sibling of :func:`memory_usage` (the reference's
@@ -137,17 +260,7 @@ def compiled_memory_usage(trainer, feed) -> Dict[str, float]:
     step for the current scope + feed shapes and reads XLA's
     ``memory_analysis()``. The ``temp_mb`` delta is how remat/donation
     knobs are verified (memory_optimization_transpiler.py:456 analog)."""
-    import jax.random as jrandom
-
-    from .core.errors import enforce
-
-    enforce(trainer._step_fn is not None, "call startup() before compiled_memory_usage()")
-    feed = trainer._put_feed(feed)
-    ls = getattr(trainer.scope, "loss_scale_state", None) or {}
-    lowered = trainer._step_fn.lower(trainer.scope.params, trainer.scope.opt_state,
-                                     trainer.scope.state, jrandom.PRNGKey(0),
-                                     feed, ls)
-    ma = lowered.compile().memory_analysis()
+    ma = _lower_step(trainer, feed).compile().memory_analysis()
     if ma is None:
         return {}
     return {
