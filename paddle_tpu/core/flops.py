@@ -101,13 +101,15 @@ def _attn_train_flops(tokens: int, seq: int, d_model: int, layers: int,
 def transformer_train_flops(bs: int, seq: int, cfg) -> float:
     """Train-step FLOPs of the encoder-decoder transformer
     (models/transformer.py). Encoder: full self-attn. Decoder: causal
-    self-attn (halved) + full cross-attn. Vocab projection counted on
-    decoder tokens only."""
+    self-attn (halved) + full cross-attn, whose q/kv/out projections add
+    ~4·d² params per decoder layer on top of the self-attn 4·d². Vocab
+    projection counted on decoder tokens only."""
     d, di = cfg.d_model, cfg.d_inner
     tokens = bs * seq
-    per_layer_params = 4 * d * d + 2 * d * di
-    f = 6.0 * per_layer_params * tokens * (cfg.num_encoder_layers +
-                                           cfg.num_decoder_layers)
+    enc_layer_params = 4 * d * d + 2 * d * di
+    dec_layer_params = 8 * d * d + 2 * d * di  # + cross q/kv/out projections
+    f = 6.0 * tokens * (enc_layer_params * cfg.num_encoder_layers +
+                        dec_layer_params * cfg.num_decoder_layers)
     f += _attn_train_flops(tokens, seq, d, cfg.num_encoder_layers, causal=False)
     f += _attn_train_flops(tokens, seq, d, cfg.num_decoder_layers, causal=True)
     f += _attn_train_flops(tokens, seq, d, cfg.num_decoder_layers, causal=False)  # cross

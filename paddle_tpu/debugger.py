@@ -199,6 +199,18 @@ def _parse_hlo_collectives(hlo_text: str, fallback_group_size: int = 0):
     return out
 
 
+def _wire_factor(kind: str, n: int) -> float:
+    """Per-device ring wire bytes per RESULT byte for an n-member group.
+    The payload we parse is the op's result: an all-gather result is the
+    full gathered array (wire (n-1)/n of it), but a reduce-scatter result
+    is already 1/n of the logical input, so its ring wire is (n-1)× the
+    result."""
+    return {"all-reduce": 2.0 * (n - 1) / n,
+            "reduce-scatter": float(n - 1),
+            "collective-permute": 1.0,
+            "collective-broadcast": 1.0}.get(kind, (n - 1) / n)
+
+
 def _lower_step(trainer, feed):
     """Lower the Trainer's compiled train step for the current scope +
     feed shapes (shared preamble of the compiled-introspection family)."""
@@ -233,11 +245,7 @@ def collective_report(trainer, feed) -> Dict[str, Any]:
     kinds: Dict[str, Dict[str, float]] = {}
     total_payload = total_wire = 0.0
     for kind, payload, gsize in entries:
-        n = max(gsize, 2)
-        factor = {"all-reduce": 2.0 * (n - 1) / n,
-                  "collective-permute": 1.0,
-                  "collective-broadcast": 1.0}.get(kind, (n - 1) / n)
-        wire = payload * factor
+        wire = payload * _wire_factor(kind, max(gsize, 2))
         rec = kinds.setdefault(kind, {"count": 0, "payload_mb": 0.0, "wire_mb": 0.0})
         rec["count"] += 1
         rec["payload_mb"] += payload / 1e6

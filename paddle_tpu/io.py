@@ -48,6 +48,14 @@ def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
         arr = np.asarray(tree)
         if arr.dtype.name in _EXOTIC_DTYPES:
             out[f"{prefix}@{arr.dtype.name}"] = arr.view(_EXOTIC_DTYPES[arr.dtype.name])
+        elif (prefix.endswith("@raw")
+              or any(prefix.endswith(f"@{dt}") and arr.dtype == enc
+                     for dt, enc in _EXOTIC_DTYPES.items())):
+            # a genuine integer param whose NAME ends in '@bfloat16' etc.
+            # (or '@raw' itself) would be indistinguishable from our
+            # encoding on load — escape with a '@raw' marker (load strips
+            # exactly one suffix, so escaping nests safely)
+            out[f"{prefix}@raw"] = arr
         else:
             out[prefix] = arr
     return out
@@ -60,10 +68,13 @@ def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
     for key, v in flat.items():
         if "@" in key:
             maybe_key, _, dtname = key.rpartition("@")
-            # only strip the suffix for dtypes *we* appended on save (the
-            # stored array then has the matching integer view); a user
-            # param literally named "x@foo" must pass through intact
-            if dtname in _EXOTIC_DTYPES and v.dtype == _EXOTIC_DTYPES[dtname]:
+            # only strip the suffix for markers *we* appended on save; a
+            # user param literally named "x@foo" passes through intact,
+            # and "x@bfloat16" of genuine integer dtype arrives escaped
+            # as "x@bfloat16@raw"
+            if dtname == "raw":
+                key = maybe_key
+            elif dtname in _EXOTIC_DTYPES and v.dtype == _EXOTIC_DTYPES[dtname]:
                 key = maybe_key
                 v = v.view(np.dtype(getattr(ml_dtypes, dtname)))
         parts = key.split(SEP)

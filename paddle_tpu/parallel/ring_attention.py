@@ -358,6 +358,12 @@ def ring_attention(
         schedule = "zigzag" if (causal and q.shape[2] % (2 * n) == 0) else "ring"
     if schedule == "zigzag" and not causal:
         schedule = "ring"  # zigzag only changes causal visibility
+    # zigzag-ordered activations under the contiguous ring schedule would
+    # mask the wrong token pairs — silently wrong attention
+    enforce(not (layout == "zigzag" and schedule != "zigzag"),
+            f"layout='zigzag' requires the zigzag schedule, but schedule "
+            f"resolved to {schedule!r} (causal={causal}, seq={q.shape[2]}, "
+            f"2n={2 * n}); un-permute the activations or fix seq divisibility")
 
     bspec = tuple(a for a in (batch_axes or ()) if a in mesh.axis_names)
     bshard = bspec if len(bspec) > 1 else (bspec[0] if bspec else None)

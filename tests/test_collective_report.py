@@ -48,6 +48,22 @@ def test_parse_hlo_async_start_counts_result_once():
     assert got[2] == ("all-reduce", (10 + 20) * 4, 8)   # variadic: summed
 
 
+def test_reduce_scatter_wire_is_result_times_n_minus_1():
+    """A reduce-scatter RESULT is 1/n of the logical input; ring wire is
+    result*(n-1), not result*(n-1)/n — the dominant FSDP collective must
+    not be undercounted by n (review finding)."""
+    from paddle_tpu.debugger import _parse_hlo_collectives as parse
+
+    from paddle_tpu.debugger import _wire_factor
+
+    hlo = "%rs = f32[8]{0} reduce-scatter(f32[32]{0} %g), replica_groups={{0,1,2,3}}, dimensions={0}, to_apply=%sum"
+    ((kind, payload, gsize),) = parse(hlo)
+    assert (kind, payload, gsize) == ("reduce-scatter", 32, 4)
+    assert payload * _wire_factor(kind, gsize) == 96.0  # 32B result -> 96B wire
+    assert _wire_factor("all-gather", 4) == pytest.approx(0.75)
+    assert _wire_factor("all-reduce", 4) == pytest.approx(1.5)
+
+
 def _trainer(mesh, rules):
     cfg = transformer.base_config(src_vocab=64, trg_vocab=64, d_model=32,
                                   d_inner=64, num_heads=4, num_encoder_layers=2,

@@ -248,12 +248,17 @@ def test_persistables_at_sign_in_name(tmp_path):
     import jax.numpy as jnp
 
     params = {"@LR_DECAY_COUNTER@": np.float32(3.0),
-              "x@bfloat16": np.ones((2,), np.float32),  # adversarial name
+              "x@bfloat16": np.ones((2,), np.float32),   # adversarial name
+              "y@bfloat16": np.full((2,), 7, np.uint16),  # name AND dtype collide
+              "z@raw": np.arange(3, dtype=np.int32),      # escape-marker name
               "real_bf16": jnp.ones((2,), jnp.bfloat16)}
     pio.save_persistables(str(tmp_path / "ck"), params, {})
     loaded, _, _, _ = pio.load_persistables(str(tmp_path / "ck"))
     assert float(loaded["@LR_DECAY_COUNTER@"]) == 3.0
     assert loaded["x@bfloat16"].dtype == np.float32
+    assert loaded["y@bfloat16"].dtype == np.uint16
+    np.testing.assert_array_equal(loaded["y@bfloat16"], params["y@bfloat16"])
+    np.testing.assert_array_equal(loaded["z@raw"], params["z@raw"])
     assert loaded["real_bf16"].dtype == jnp.bfloat16
 
 

@@ -1,0 +1,86 @@
+"""Fault-tolerance END-TO-END composition (VERDICT r2 #5): two trainer
+processes drain the C++ master queue while checkpointing; one is killed
+mid-task; its lease times out and the task requeues; the worker restarts
+from its sharded checkpoint with step/loss continuity; every task is
+processed exactly once. This is the composition the Go master exists for
+(go/master/service.go:313 processFailedTask, :341 checkTimeoutFunc,
+go/pserver/service.go:346 checkpoint)."""
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.data.master import MasterClient, MasterServer
+
+HERE = os.path.dirname(__file__)
+WORKER = os.path.join(HERE, "ft_worker.py")
+N_SHARDS = 6
+KILL_AFTER = 2  # victim crashes while holding its 3rd task's lease
+
+
+def _spawn(port, ckpt_dir, kill_after, worker_id):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = os.path.dirname(HERE) + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, WORKER, str(port), ckpt_dir, str(kill_after), worker_id],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True)
+
+
+@pytest.mark.slow
+def test_kill_requeue_resume_composition(tmp_path):
+    snap = str(tmp_path / "master.snap")
+    ck_a = str(tmp_path / "ck_victim")
+    ck_b = str(tmp_path / "ck_survivor")
+
+    with MasterServer(snapshot_path=snap, failure_max=3,
+                      lease_timeout_ms=5000) as srv:
+        admin = MasterClient(srv.addr)
+        shards = [f"shard-{i}" for i in range(N_SHARDS)]
+        admin.set_tasks(shards)
+
+        victim = _spawn(srv.port, ck_a, KILL_AFTER, "victim")
+        survivor = _spawn(srv.port, ck_b, -1, "survivor")
+
+        v_out, v_err = victim.communicate(timeout=300)
+        assert victim.returncode == 137, f"victim didn't crash as scripted:\n{v_err[-2000:]}"
+        v_ckpts = re.findall(r"CKPT step=(\d+) loss=([\d.]+)", v_out)
+        assert len(v_ckpts) == KILL_AFTER  # checkpointed each finished task
+        last_step, last_loss = int(v_ckpts[-1][0]), float(v_ckpts[-1][1])
+
+        # restart the victim from its checkpoint; it rejoins the drain
+        restarted = _spawn(srv.port, ck_a, -1, "victim2")
+        r_out, r_err = restarted.communicate(timeout=300)
+        s_out, s_err = survivor.communicate(timeout=300)
+        assert restarted.returncode == 0, r_err[-2000:]
+        assert survivor.returncode == 0, s_err[-2000:]
+
+        # --- step/loss continuity from the sharded checkpoint ---------
+        m = re.search(r"RESUMED step=(\d+) loss=([\d.]+)", r_out)
+        assert m, r_out
+        assert int(m.group(1)) == last_step, \
+            "restart must resume at the last checkpointed step (in-flight " \
+            "steps of the crashed task are lost, not the checkpointed ones)"
+        assert abs(float(m.group(2)) - last_loss) < 1e-5, \
+            "restored params must reproduce the checkpointed probe loss"
+
+        # --- exactly-once-or-requeued: every shard finished once ------
+        done = re.findall(r"DONE (shard-\d+)", v_out + r_out + s_out)
+        assert sorted(done) == sorted(shards), (
+            f"each task must be finished exactly once across all workers "
+            f"(crashed lease requeued, no loss, no dup): {sorted(done)}")
+        st = admin.status()
+        assert st["done"] == N_SHARDS and st["todo"] == 0 \
+            and st["leased"] == 0 and st["discarded"] == 0, st
+
+        # the shard whose lease died with the victim was re-processed by
+        # a peer — find it: victim's unfinished 3rd task
+        v_done = set(re.findall(r"DONE (shard-\d+)", v_out))
+        requeued = set(shards) - v_done - set(re.findall(r"DONE (shard-\d+)", s_out))
+        # (it may have landed on either the survivor or the restarted
+        # victim; the exactly-once assertion above already pins it)
+        admin.close()
