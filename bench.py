@@ -26,6 +26,7 @@ Honesty rules (VERDICT r2 #1):
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import time
 
@@ -357,7 +358,33 @@ TRAIN_CONFIGS = {
 INFER_VARIANTS = ("fp32", "bf16", "int8")
 
 
-def run_suite(compute_dtype="bfloat16", quick=False):
+class _ConfigTimeout(Exception):
+    pass
+
+
+@contextlib.contextmanager
+def _deadline(seconds: int):
+    """Per-config SIGALRM deadline so one wedged config does not cost
+    the whole suite record. CPython only runs signal handlers between
+    bytecodes, so this catches Python-level stalls (slow iteration, a
+    runaway retry loop) but NOT a hang inside a C call (wedged XLA
+    compile / blocked transfer) — those need the driver's process-level
+    timeout."""
+    import signal
+
+    def _raise(signum, frame):
+        raise _ConfigTimeout(f"config exceeded {seconds}s")
+
+    old = signal.signal(signal.SIGALRM, _raise)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=900):
     import sys
 
     import jax
@@ -372,14 +399,16 @@ def run_suite(compute_dtype="bfloat16", quick=False):
     for name, fn in TRAIN_CONFIGS.items():
         try:
             set_flag("default_compute_dtype", compute_dtype)
-            configs[f"{name}_train"] = fn(peak, **kw)
+            with _deadline(config_timeout):
+                configs[f"{name}_train"] = fn(peak, **kw)
         except Exception as e:  # record the failure, keep the suite going
             configs[f"{name}_train"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] {name} failed: {e}", file=sys.stderr)
     for variant in INFER_VARIANTS:
         try:
-            configs[f"resnet50_infer_{variant}"] = bench_resnet50_infer(
-                peak, variant=variant, **({"iters": 3} if quick else {}))
+            with _deadline(config_timeout):
+                configs[f"resnet50_infer_{variant}"] = bench_resnet50_infer(
+                    peak, variant=variant, **({"iters": 3} if quick else {}))
         except Exception as e:
             configs[f"resnet50_infer_{variant}"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] infer/{variant} failed: {e}", file=sys.stderr)

@@ -209,38 +209,59 @@ class Trainer:
         return self.scope
 
     # ------------------------------------------------------------------
-    def _loss_and_aux(self, params, state, rng, feed):
+    def _ambient_mode(self, flag_desc: str, wanted: bool, axis: str, enter):
+        """Strategy-knob → trace-time ambient plumbing shared by the
+        parallelism modes: returns (active, context). Warns when the
+        knob is set without a usable mesh axis."""
         import contextlib
+        import warnings
 
-        from .framework import pipeline_mode, remat_mode
+        on = (wanted and self.mesh is not None
+              and axis in self.mesh.axis_names and self.mesh.shape[axis] > 1)
+        if wanted and not on:
+            warnings.warn(
+                f"{flag_desc} is set but the mesh "
+                f"{dict(self.mesh.shape) if self.mesh is not None else None} "
+                f"has no '{axis}' axis (size>1); training proceeds WITHOUT it")
+        return on, (enter() if on else contextlib.nullcontext())
+
+    @staticmethod
+    def _warn_unconsumed(flag_desc: str, on: bool, cfg, hint: str):
+        """Silent no-op parallelism (knob set, model never read the
+        context) was a review finding — surface it."""
+        import warnings
+
+        if on and not cfg["consumed"]:
+            warnings.warn(f"{flag_desc} is set but the model never consumed "
+                          f"the context — {hint}")
+
+    def _loss_and_aux(self, params, state, rng, feed):
+        from .framework import pipeline_mode, remat_mode, sp_mode
 
         # strategy.remat (memory_optimize analog) flips the ambient
         # trace-time switch; zoo models wrap their repeated blocks in
         # maybe_remat, so jax.checkpoint lands per block
         pp_m = getattr(self.strategy, "pp_microbatches", 0) if self.strategy else 0
-        pp_on = (pp_m > 0 and self.mesh is not None
-                 and "pp" in self.mesh.axis_names and self.mesh.shape["pp"] > 1)
-        if pp_m > 0 and not pp_on:
-            import warnings
-            warnings.warn(
-                f"DistStrategy.pp_microbatches={pp_m} but the mesh "
-                f"{dict(self.mesh.shape) if self.mesh is not None else None} "
-                f"has no 'pp' axis (size>1); training proceeds WITHOUT "
-                f"pipeline parallelism")
-        pp_ctx = (pipeline_mode(self.mesh, pp_m) if pp_on
-                  else contextlib.nullcontext())
-        with remat_mode(bool(getattr(self.strategy, "remat", False))), pp_ctx as pp_cfg:
+        pp_on, pp_ctx = self._ambient_mode(
+            f"DistStrategy.pp_microbatches={pp_m}", pp_m > 0, "pp",
+            lambda: pipeline_mode(self.mesh, pp_m))
+        sp_on, sp_ctx = self._ambient_mode(
+            "DistStrategy.sequence_parallel",
+            bool(getattr(self.strategy, "sequence_parallel", False)), "sp",
+            lambda: sp_mode(self.mesh))
+        with remat_mode(bool(getattr(self.strategy, "remat", False))), \
+                pp_ctx as pp_cfg, sp_ctx as sp_cfg:
             out, new_state = self.program.apply(params, state, training=True,
                                                 rng=rng, **feed)
-        if pp_on and not pp_cfg["consumed"]:
-            import warnings
-            warnings.warn(
-                "DistStrategy.pp_microbatches is set but the model never "
-                "routed a stacked block stack through the pipeline (no "
-                "layers.stacked.apply_stacked call) — every pp rank is "
-                "redundantly computing the full model. Build the model "
-                "with its stacked/pipeline representation (e.g. "
-                "TransformerConfig(stacked=True)).")
+        self._warn_unconsumed(
+            "DistStrategy.pp_microbatches", pp_on, pp_cfg,
+            "no stacked block stack routed through the pipeline; every pp "
+            "rank redundantly computes the full model. Build the model with "
+            "its stacked representation (e.g. TransformerConfig(stacked=True)).")
+        self._warn_unconsumed(
+            "DistStrategy.sequence_parallel", sp_on, sp_cfg,
+            "attention is NOT ring-parallel. Use an sp-aware model "
+            "(models/gpt.py).")
         if isinstance(out, dict):
             loss = out[self.loss_name]
         else:

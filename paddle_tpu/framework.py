@@ -510,6 +510,37 @@ def pipeline_config() -> Optional[dict]:
     return cfg
 
 
+_sp_mode = threading.local()
+
+
+@contextlib.contextmanager
+def sp_mode(mesh, axis: str = "sp"):
+    """Ambient sequence-parallel switch (trace-time, like
+    :func:`pipeline_mode`). Trainer enters this around ``program.apply``
+    when ``DistStrategy.sequence_parallel`` is set and the mesh has an
+    ``sp`` axis; sp-aware zoo models (models/gpt.py) route their
+    attention through ring attention with the zigzag layout."""
+    old = getattr(_sp_mode, "cfg", None)
+    cfg = {"mesh": mesh, "axis": axis, "consumed": False}
+    _sp_mode.cfg = cfg
+    try:
+        yield cfg
+    finally:
+        _sp_mode.cfg = old
+
+
+def sp_config() -> Optional[dict]:
+    """The active sequence-parallel context, or None (always None during
+    init-mode builds, mirroring :func:`pipeline_config`)."""
+    ctx = current_context()
+    if ctx is not None and ctx.mode == "init":
+        return None
+    cfg = getattr(_sp_mode, "cfg", None)
+    if cfg is not None:
+        cfg["consumed"] = True
+    return cfg
+
+
 def maybe_remat(fn: Callable, enabled: Optional[bool] = None,
                 policy: Optional[Callable] = None) -> Callable:
     """Wrap ``fn`` in ``jax.checkpoint`` when remat is requested — either

@@ -33,7 +33,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..core.errors import enforce
-from ..framework import LayerHelper, cast_compute, pipeline_config
+from ..framework import (LayerHelper, cast_compute, maybe_remat,
+                         pipeline_config, sp_config)
 from .. import initializer as init
 
 NEG_INF = -1e9
@@ -59,8 +60,24 @@ def _ln(x, scale, bias, eps: float = 1e-5):
     return out * scale + bias
 
 
-def _sdpa(q, k, v, key_bias, causal: bool, use_flash: bool):
-    """[b,h,s,hd] attention with an additive [b,s_k] key bias."""
+def _sdpa(q, k, v, key_bias, causal: bool, use_flash: bool, sp_cfg=None):
+    """[b,h,s,hd] attention with an additive [b,s_k] key bias. With an
+    active sequence-parallel context, self-attention runs as ring
+    attention over the mesh's sp axis. The layout comes from the sp
+    context ("natural" unless the MODEL set "zigzag" after permuting its
+    own activations, as models/gpt.py does) — natural-order callers get
+    the numerically-safe per-call gathers, never a silent mismatch."""
+    if sp_cfg is not None:
+        from ..parallel.ring_attention import ring_attention
+        enforce(key_bias is None,
+                "sequence-parallel attention does not take a padding bias "
+                "(pack full sequences; pad-free is the long-context contract)")
+        layout = sp_cfg.get("layout", "natural")
+        return ring_attention(q, k, v, sp_cfg["mesh"], axis_name=sp_cfg["axis"],
+                              causal=causal,
+                              schedule="zigzag" if (causal and layout == "zigzag")
+                              else "auto",
+                              layout=layout)
     if use_flash:
         from ..ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, key_bias=key_bias)
@@ -148,13 +165,14 @@ def decoder_stack_params(num_layers: int, d_model: int, d_inner: int,
 # -- block functions ---------------------------------------------------------
 
 
-def _self_attention(x, p, num_heads, causal, use_flash, key_bias, tp_axis):
+def _self_attention(x, p, num_heads, causal, use_flash, key_bias, tp_axis,
+                    sp_cfg=None):
     head_dim = x.shape[-1] // num_heads  # d_model is replicated across tp
     h = _ln(x, p["ln1/scale"], p["ln1/bias"])
     h, w = cast_compute(h, p["qkv/w"])
     qkv = jnp.einsum("bsd,dke->bske", h, w) + p["qkv/b"].astype(h.dtype)
     q, k, v = (_split_heads(qkv[:, :, i], head_dim) for i in range(3))
-    o = _merge_heads(_sdpa(q, k, v, key_bias, causal, use_flash))
+    o = _merge_heads(_sdpa(q, k, v, key_bias, causal, use_flash, sp_cfg))
     o, ow = cast_compute(o, p["out/w"])
     o = jnp.matmul(o, ow)
     if tp_axis:
@@ -174,14 +192,16 @@ def _ffn(x, p, tp_axis):
 
 def make_encoder_block(num_heads: int, use_flash: bool = False,
                        causal: bool = False,
-                       tp_axis: Optional[str] = None) -> Callable:
+                       tp_axis: Optional[str] = None,
+                       sp_cfg: Optional[dict] = None) -> Callable:
     """layer_fn(x, layer_params, key_bias) for pipeline_apply/scan. When
     ``tp_axis`` is set, attention/ffn heads are tp-local and the output
-    projections psum partial sums (Megatron pattern inside a stage)."""
+    projections psum partial sums (Megatron pattern inside a stage).
+    ``sp_cfg`` routes self-attention through zigzag ring attention."""
 
     def block(x, p, key_bias=None):
         x = _self_attention(x, p, num_heads, causal, use_flash,
-                            key_bias, tp_axis)
+                            key_bias, tp_axis, sp_cfg)
         return _ffn(x, p, tp_axis)
 
     return block
@@ -189,10 +209,15 @@ def make_encoder_block(num_heads: int, use_flash: bool = False,
 
 def make_decoder_block(num_heads: int, use_flash: bool = False,
                        causal: bool = True,
-                       tp_axis: Optional[str] = None) -> Callable:
+                       tp_axis: Optional[str] = None,
+                       sp_cfg: Optional[dict] = None) -> Callable:
     """layer_fn(x, layer_params, extra) with extra = {"enc": encoder
     output [b,s,d], "enc_bias": additive [b,s] padding bias}. Causal
     self-attention + cross attention + FFN."""
+    enforce(sp_cfg is None,
+            "sequence parallelism is wired for the self-attention-only "
+            "stack (models/gpt.py); the encoder-decoder cross-attention "
+            "path does not support it")
 
     def block(x, p, extra):
         head_dim = x.shape[-1] // num_heads
@@ -255,11 +280,14 @@ def apply_stacked(x, stacked: Dict[str, jax.Array], make_block: Callable,
     ``tp`` axis, making dp×tp×pp one call.
     """
     cfg = pipeline_config()
+    sp = sp_config()
+    enforce(not (cfg is not None and sp is not None),
+            "pipeline and sequence parallelism cannot wrap the same stack "
+            "(ring attention's shard_map cannot nest inside the pipeline's)")
     if cfg is None:
         block = make_block(num_heads=num_heads, use_flash=use_flash,
-                           causal=causal, tp_axis=None)
+                           causal=causal, tp_axis=None, sp_cfg=sp)
 
-        from ..framework import maybe_remat
         def scan_body(a, lp):
             fn = (lambda a_, lp_: block(a_, lp_, extras)) if extras is not None \
                 else (lambda a_, lp_: block(a_, lp_))
@@ -277,7 +305,7 @@ def apply_stacked(x, stacked: Dict[str, jax.Array], make_block: Callable,
                 f"stacked blocks with tp={mesh.shape['tp']} need num_heads "
                 f"({num_heads}) divisible by tp")
     block = make_block(num_heads=num_heads, use_flash=use_flash,
-                       causal=causal, tp_axis=tp)
+                       causal=causal, tp_axis=tp, sp_cfg=None)
     layer_fn = block if extras is not None else (lambda a, lp: block(a, lp))
     return pipeline_apply(
         x, stacked, layer_fn, mesh, axis_name=cfg["axis"],

@@ -1,0 +1,113 @@
+"""GPT — decoder-only causal language model.
+
+No reference counterpart (the 2018 reference predates decoder-only LMs;
+its closest config is the transformer benchmark,
+benchmark/fluid/models/machine_translation.py) — this is the modern
+long-context flagship the TPU build adds on top of the capability set,
+and the model family that exercises sequence/context parallelism as a
+TRAINING PATH:
+
+- blocks are the stacked causal self-attention blocks (layers/stacked.py),
+  so pipeline parallelism (DistStrategy.pp_microbatches) works unchanged;
+- with DistStrategy.sequence_parallel on an ``sp`` mesh, the input ids /
+  labels / positions are permuted ONCE into the zigzag order and the
+  whole stack runs in that layout — attention is zigzag ring attention
+  (parallel/ring_attention.py) with shard-local entry/exit, positions
+  travel with their tokens, and the mean loss is permutation-invariant,
+  so nothing is ever permuted back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .. import initializer as init
+from .. import layers as L
+from ..core.errors import enforce
+from ..framework import LayerHelper, name_scope, sp_config
+from ..layers import attention as A
+from ..layers import stacked as S
+from ..ops.fused_ce import chunked_softmax_cross_entropy
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 32000
+    max_len: int = 1024
+    d_model: int = 768
+    d_inner: int = 3072
+    num_heads: int = 12
+    num_layers: int = 12
+    use_flash: bool = True
+    fused_ce: bool = True
+    ce_chunk: int = 4096
+    remat: bool = False
+    dtype: str = "float32"
+
+
+def base_config(**kw) -> GPTConfig:
+    return GPTConfig(**kw)
+
+
+def make_model(cfg: GPTConfig):
+    """Program fn: (ids [b, s], labels [b, s]) -> {"loss", "token_count"}.
+    Next-token CE over non-pad labels (pad id 0)."""
+
+    def gpt(ids, labels):
+        dtype = jnp.dtype(cfg.dtype)
+        s = ids.shape[1]
+        enforce(s <= cfg.max_len, f"seq {s} exceeds max_len {cfg.max_len}")
+        sp = sp_config()
+        if sp is not None:
+            from ..parallel.ring_attention import zigzag_order
+            n = sp["mesh"].shape[sp["axis"]]
+            enforce(s % (2 * n) == 0,
+                    f"sequence parallelism needs seq {s} divisible by 2·sp={2 * n}")
+            order = zigzag_order(s, n)
+            ids = jnp.take(ids, order, axis=1)
+            labels = jnp.take(labels, order, axis=1)
+            positions = order
+            # this model keeps activations in zigzag order end-to-end, so
+            # the ring may skip its per-call entry/exit gathers; models
+            # that do NOT permute get the safe "natural" default
+            sp["layout"] = "zigzag"
+        else:
+            positions = jnp.arange(s)
+
+        with name_scope("tok"):
+            x = L.embedding(ids, size=[cfg.vocab_size, cfg.d_model],
+                            dtype=cfg.dtype)
+        pe = A.positional_encoding(cfg.max_len, cfg.d_model, dtype)
+        x = x + pe[positions][None]
+
+        with name_scope("gpt"):
+            stack = S.encoder_stack_params(cfg.num_layers, cfg.d_model,
+                                           cfg.d_inner)
+            x = S.apply_stacked(x, stack, S.make_encoder_block,
+                                num_heads=cfg.num_heads,
+                                use_flash=cfg.use_flash, causal=True,
+                                remat=cfg.remat)
+            x = L.layer_norm(x, begin_norm_axis=2)
+
+        helper = LayerHelper("lm_head")
+        w = helper.create_parameter("w", (cfg.d_model, cfg.vocab_size), dtype,
+                                    initializer=init.Xavier())
+        lab = labels.astype(jnp.int32)
+        nonpad = (labels != 0).astype(jnp.float32)
+        token_count = jnp.maximum(nonpad.sum(), 1.0)
+        b, t, d = x.shape
+        if cfg.fused_ce:
+            ce = chunked_softmax_cross_entropy(
+                x.reshape(b * t, d), w, None, lab.reshape(-1), 0.0,
+                cfg.ce_chunk).reshape(b, t)
+        else:
+            logits = jnp.matmul(x, w)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            ce = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
+        loss = jnp.sum(ce * nonpad) / token_count
+        return {"loss": loss, "token_count": token_count}
+
+    return gpt

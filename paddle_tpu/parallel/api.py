@@ -67,7 +67,7 @@ def put_batch(mesh: Mesh, rules: Optional[ShardingRules], feed: Dict[str, Any]):
     out = {}
     for k, v in feed.items():
         arr = np.asarray(v) if not isinstance(v, jax.Array) else v
-        spec = rules.batch_spec(mesh, arr.ndim)
+        spec = rules.batch_spec(mesh, arr.ndim, shape=arr.shape)
         ns = NamedSharding(mesh, spec)
         if multiproc:
             global_shape = (arr.shape[0] * jax.process_count(),) + arr.shape[1:]

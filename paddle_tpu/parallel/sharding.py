@@ -41,10 +41,14 @@ class ShardingRules:
 
     def __init__(self, rules: Optional[Sequence[Tuple[str, SpecLike]]] = None,
                  default: SpecLike = None,
-                 batch_axes: Optional[Sequence[str]] = None):
+                 batch_axes: Optional[Sequence[str]] = None,
+                 seq_axis: Optional[str] = None):
         self.rules = [(re.compile(pat), _as_spec(spec)) for pat, spec in (rules or [])]
         self.default = _as_spec(default)
         self.batch_axes = tuple(batch_axes) if batch_axes is not None else None
+        # opt-in: shard feeds' dim 1 (sequence) over this axis — the
+        # input-side of sequence parallelism ([b, s] ids land sharded)
+        self.seq_axis = seq_axis
 
     # ------------------------------------------------------------------
     def spec_for(self, name: str, shape: Tuple[int, ...], mesh: Mesh) -> P:
@@ -53,12 +57,24 @@ class ShardingRules:
                 return _validate(spec, shape, mesh, name)
         return _validate(self.default, shape, mesh, name)
 
-    def batch_spec(self, mesh: Mesh, ndim: int) -> P:
+    def batch_spec(self, mesh: Mesh, ndim: int,
+                   shape: Optional[Tuple[int, ...]] = None) -> P:
         axes = self.batch_axes if self.batch_axes is not None else mesh_lib.data_axis_names(mesh)
         axes = tuple(a for a in axes if a in mesh.axis_names and mesh.shape[a] > 1)
-        if not axes:
+        # seq sharding (dim 1) only applies to feeds that look like
+        # sequences: without the shape we can't tell, and a [b, 1] label
+        # or [b, c, h, w] image must not be sharded on 'sp'
+        seq = None
+        if (self.seq_axis in mesh.axis_names
+                and mesh.shape.get(self.seq_axis, 1) > 1
+                and shape is not None and len(shape) >= 2
+                and shape[1] > 1 and shape[1] % mesh.shape[self.seq_axis] == 0):
+            seq = self.seq_axis
+        if not axes and seq is None:
             return P()
-        return P(axes if len(axes) > 1 else axes[0], *([None] * (ndim - 1)))
+        lead = axes if len(axes) > 1 else (axes[0] if axes else None)
+        rest = [seq] + [None] * (ndim - 2) if ndim >= 2 else []
+        return P(lead, *rest)
 
     def shard_params(self, mesh: Mesh, params: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
         out = {}

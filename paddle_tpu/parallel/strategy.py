@@ -37,3 +37,8 @@ class DistStrategy:
     # has a 'pp' axis). Bubble fraction = (pp-1)/(m+pp-1); see
     # parallel.pipeline.bubble_fraction.
     pp_microbatches: int = 0
+    # sequence/context parallelism: sp-aware zoo models (models/gpt.py)
+    # run their attention as zigzag ring attention over the mesh's 'sp'
+    # axis, activations kept in zigzag layout end-to-end. Mutually
+    # exclusive with pp_microbatches on the same stack.
+    sequence_parallel: bool = False
