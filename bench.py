@@ -210,6 +210,33 @@ def bench_bert(peak, batch_size=32, seq=128, num_masked=20, dtype="bfloat16",
     return _result(batch_size * seq, "tokens/sec", dt_pipe, dt_comp, f, peak)
 
 
+def bench_gpt(peak, batch_size=8, seq=1024, dtype="bfloat16", iters=15):
+    """Decoder-only LM (GPT-base shape, ~124M params): the modern
+    long-context flagship — flash attention + chunked logits-free CE."""
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.core import flops
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.base_config(vocab_size=32000, max_len=seq, d_model=768,
+                          d_inner=3072, num_heads=12, num_layers=12,
+                          use_flash=True, fused_ce=True, dtype=dtype)
+    model = pt.build(gpt.make_model(cfg))
+    rng = np.random.RandomState(0)
+    feeds = []
+    for _ in range(4):
+        ids = rng.randint(3, cfg.vocab_size, (batch_size, seq)).astype(np.int32)
+        labels = np.concatenate([ids[:, 1:], np.full((batch_size, 1), 2)],
+                                axis=1).astype(np.int32)
+        feeds.append({"ids": ids, "labels": labels})
+    trainer = pt.Trainer(model, opt.AdamW(1e-4, weight_decay=0.01),
+                         loss_name="loss", fetch_list=["loss"])
+    trainer.startup(sample_feed=feeds[0])
+    dt_pipe, dt_comp = _time_trainer(trainer, feeds, iters=iters)
+    f = flops.gpt_train_flops(batch_size, seq, cfg)
+    return _result(batch_size * seq, "tokens/sec", dt_pipe, dt_comp, f, peak)
+
+
 def _bench_deepfm_config(peak, batch_size, sparse_feature_dim, iters=20):
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
@@ -351,6 +378,7 @@ TRAIN_CONFIGS = {
     "transformer": bench_transformer,
     "transformer_long": bench_transformer_long,
     "bert": bench_bert,
+    "gpt": bench_gpt,
     "deepfm": bench_deepfm,
     "deepfm_10m": bench_deepfm_10m,
 }

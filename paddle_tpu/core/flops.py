@@ -117,6 +117,17 @@ def transformer_train_flops(bs: int, seq: int, cfg) -> float:
     return f
 
 
+def gpt_train_flops(bs: int, seq: int, cfg) -> float:
+    """Train-step FLOPs of the decoder-only LM (models/gpt.py): causal
+    stack (attention halved) + LM head over every token."""
+    d, di, L = cfg.d_model, cfg.d_inner, cfg.num_layers
+    tokens = bs * seq
+    f = 6.0 * (4 * d * d + 2 * d * di) * tokens * L
+    f += _attn_train_flops(tokens, seq, d, L, causal=True)
+    f += 6.0 * d * cfg.vocab_size * tokens  # lm head
+    return f
+
+
 def bert_train_flops(bs: int, seq: int, num_masked: int, cfg) -> float:
     """Train-step FLOPs of BERT pretraining (models/bert.py): encoder
     stack + MLM head (transform + vocab proj over masked positions) +

@@ -167,17 +167,9 @@ def decoder_stack_params(num_layers: int, d_model: int, d_inner: int,
 
 def _self_attention(x, p, num_heads, causal, use_flash, key_bias, tp_axis,
                     sp_cfg=None):
-    head_dim = x.shape[-1] // num_heads  # d_model is replicated across tp
-    h = _ln(x, p["ln1/scale"], p["ln1/bias"])
-    h, w = cast_compute(h, p["qkv/w"])
-    qkv = jnp.einsum("bsd,dke->bske", h, w) + p["qkv/b"].astype(h.dtype)
-    q, k, v = (_split_heads(qkv[:, :, i], head_dim) for i in range(3))
-    o = _merge_heads(_sdpa(q, k, v, key_bias, causal, use_flash, sp_cfg))
-    o, ow = cast_compute(o, p["out/w"])
-    o = jnp.matmul(o, ow)
-    if tp_axis:
-        o = jax.lax.psum(o, tp_axis)
-    return x + o + p["out/b"].astype(o.dtype)
+    q, k, v = _attn_qkv(x, p, num_heads)
+    return _attn_out(x, p, _sdpa(q, k, v, key_bias, causal, use_flash, sp_cfg),
+                     tp_axis)
 
 
 def _ffn(x, p, tp_axis):
@@ -237,6 +229,53 @@ def make_decoder_block(num_heads: int, use_flash: bool = False,
         return _ffn(x, p, tp_axis)
 
     return block
+
+
+# -- incremental decoding (KV cache over stacked params) ---------------------
+
+
+def _attn_qkv(x, p, num_heads):
+    head_dim = x.shape[-1] // num_heads
+    h = _ln(x, p["ln1/scale"], p["ln1/bias"])
+    h, w = cast_compute(h, p["qkv/w"])
+    qkv = jnp.einsum("bsd,dke->bske", h, w) + p["qkv/b"].astype(h.dtype)
+    return tuple(_split_heads(qkv[:, :, i], head_dim) for i in range(3))
+
+
+def _attn_out(x, p, o, tp_axis=None):
+    o, ow = cast_compute(_merge_heads(o), p["out/w"])
+    o = jnp.matmul(o, ow)
+    if tp_axis:
+        o = jax.lax.psum(o, tp_axis)
+    return x + o + p["out/b"].astype(o.dtype)
+
+
+def prefill_block(x, p, num_heads: int, use_flash: bool = False):
+    """Causal block that also returns its (k, v) for cache seeding —
+    the stacked-layer analog of the transformer decoder's cache path
+    (models/transformer.py make_decoder)."""
+    q, k, v = _attn_qkv(x, p, num_heads)
+    x = _attn_out(x, p, _sdpa(q, k, v, None, True, use_flash))
+    return _ffn(x, p, None), (k, v)
+
+
+def decode_block(x, p, k_cache, v_cache, index, num_heads: int):
+    """One-token step: x [rows, 1, d]; caches [rows, h, T, hd]; attends
+    to cache positions <= index. Returns (x, new_k, new_v)."""
+    q, k1, v1 = _attn_qkv(x, p, num_heads)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k1.astype(k_cache.dtype),
+                                           (0, 0, index, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v1.astype(v_cache.dtype),
+                                           (0, 0, index, 0))
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    pos = jnp.arange(k_cache.shape[2])
+    logits = jnp.where(pos[None, None, None, :] <= index, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v_cache.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", probs, v_cache)
+    x = _attn_out(x, p, o)
+    return _ffn(x, p, None), k_cache, v_cache
 
 
 # -- tensor-parallel specs (non-layer dims, pipeline_apply param_specs) ------

@@ -111,3 +111,118 @@ def make_model(cfg: GPTConfig):
         return {"loss": loss, "token_count": token_count}
 
     return gpt
+
+
+def make_generator(cfg: GPTConfig, max_new_tokens: int, beam_size: int = 1,
+                   bos_id: int = 1, eos_id: int = 2,
+                   length_penalty_alpha: float = 0.0):
+    """Incremental generation program with a KV cache over the stacked
+    params (beam_search_op capability for the decoder-only family; the
+    transformer zoo's make_decoder sibling). Parameter names match
+    make_model's train program, so trained params load directly.
+
+    Returns a program fn: (prompt_ids [b, p]) -> {"ids": [b, max_new]}
+    (greedy) or {"ids": [b, beam, max_new], "scores": [b, beam]} (beam).
+    """
+    from ..layers.beam_search import beam_search, greedy_search
+
+    def generate(prompt_ids):
+        dtype = jnp.dtype(cfg.dtype)
+        b, p = prompt_ids.shape
+        total = p + max_new_tokens
+        enforce(total <= cfg.max_len,
+                f"prompt {p} + max_new {max_new_tokens} exceeds max_len "
+                f"{cfg.max_len}")
+        pe = A.positional_encoding(cfg.max_len, cfg.d_model, dtype)
+
+        # ---- create/fetch every parameter ONCE, with the exact names the
+        # train program uses; the decode loop then closes over the arrays
+        # (no LayerHelper calls inside scan — nothing to re-resolve)
+        with name_scope("tok"):
+            w_emb = LayerHelper("embedding").create_parameter(
+                "w", (cfg.vocab_size, cfg.d_model), dtype,
+                initializer=init.Xavier())
+        with name_scope("gpt"):
+            stack = S.encoder_stack_params(cfg.num_layers, cfg.d_model,
+                                           cfg.d_inner)
+            ln = LayerHelper("layer_norm")
+            ln_scale = ln.create_parameter("scale", (cfg.d_model,), jnp.float32,
+                                           initializer=init.Constant(1.0))
+            ln_bias = ln.create_parameter("bias", (cfg.d_model,), jnp.float32,
+                                          initializer=init.Constant(0.0))
+        w_head = LayerHelper("lm_head").create_parameter(
+            "w", (cfg.d_model, cfg.vocab_size), dtype,
+            initializer=init.Xavier())
+
+        def head(x_last):  # [rows, d] -> log-probs [rows, vocab]
+            h = S._ln(x_last[:, None, :], ln_scale, ln_bias)[:, 0]
+            return jax.nn.log_softmax(
+                jnp.matmul(h, w_head).astype(jnp.float32), axis=-1)
+
+        # ---- prefill: run the prompt causally, capture per-layer k/v
+        x = w_emb[prompt_ids].astype(dtype) + pe[:p][None]
+
+        def pre(a, lp):
+            return S.prefill_block(a, lp, cfg.num_heads, cfg.use_flash)
+
+        x, (ks, vs) = jax.lax.scan(pre, x, stack)
+        logp0 = head(x[:, -1])  # first generated token comes from here
+
+        K = beam_size
+        rows = b * K
+        L = cfg.num_layers
+
+        def grow(a):  # [b, h, p, hd] -> [rows, h, total, hd]
+            a = jnp.repeat(a, K, axis=0) if K > 1 else a
+            pad = jnp.zeros(a.shape[:2] + (total - p, a.shape[3]), a.dtype)
+            return jnp.concatenate([a, pad], axis=2)
+
+        # caches are PER-LAYER lists of [rows, ...] arrays — beam_search
+        # reorders state leaves whose leading dim is batch*beam, so the
+        # layer axis must NOT lead (the transformer decoder's contract,
+        # layers/beam_search.py _gather_beams)
+        state0 = {"k": [grow(ks[i]) for i in range(L)],
+                  "v": [grow(vs[i]) for i in range(L)],
+                  "index": jnp.asarray(p, jnp.int32),
+                  "logp0": jnp.repeat(logp0, K, axis=0) if K > 1 else logp0,
+                  "first": jnp.asarray(True)}
+        layer_params = [jax.tree.map(lambda a, i=i: a[i], stack)
+                        for i in range(L)]
+
+        def step_fn(tokens, state):
+            # the prefill already produced the first step's distribution;
+            # afterwards embed the chosen token and run the cached stack
+            def incremental(_):
+                xt = w_emb[tokens].astype(dtype)[:, None, :] \
+                    + pe[state["index"]][None, None]
+                kn, vn = [], []
+                for lp, kc, vc in zip(layer_params, state["k"], state["v"]):
+                    xt, kc, vc = S.decode_block(
+                        xt, lp, kc, vc, state["index"], cfg.num_heads)
+                    kn.append(kc)
+                    vn.append(vc)
+                return head(xt[:, 0]), kn, vn
+
+            logp, kn, vn = jax.lax.cond(
+                state["first"],
+                lambda _: (state["logp0"], state["k"], state["v"]),
+                incremental, operand=None)
+            # the first step consumes the prefill's distribution without
+            # writing a token; the index advances only once a generated
+            # token has actually been cached (position p holds token 1)
+            new_state = {"k": kn, "v": vn,
+                         "index": jnp.where(state["first"], state["index"],
+                                            state["index"] + 1),
+                         "logp0": state["logp0"],
+                         "first": jnp.asarray(False)}
+            return logp, new_state
+
+        if K > 1:
+            seqs, scores = beam_search(step_fn, state0, b, K, max_new_tokens,
+                                       bos_id=bos_id, eos_id=eos_id,
+                                       length_penalty_alpha=length_penalty_alpha)
+            return {"ids": seqs, "scores": scores}
+        return {"ids": greedy_search(step_fn, state0, rows, max_new_tokens,
+                                     bos_id=bos_id, eos_id=eos_id)}
+
+    return generate

@@ -43,16 +43,19 @@ def test_kill_requeue_resume_composition(tmp_path):
         shards = [f"shard-{i}" for i in range(N_SHARDS)]
         admin.set_tasks(shards)
 
+        # phase 1: the victim drains ALONE so its scripted crash (mid
+        # 3rd task, lease held) cannot be raced away by a faster peer
+        # finishing the queue first (observed under CPU contention)
         victim = _spawn(srv.port, ck_a, KILL_AFTER, "victim")
-        survivor = _spawn(srv.port, ck_b, -1, "survivor")
-
         v_out, v_err = victim.communicate(timeout=300)
         assert victim.returncode == 137, f"victim didn't crash as scripted:\n{v_err[-2000:]}"
         v_ckpts = re.findall(r"CKPT step=(\d+) loss=([\d.]+)", v_out)
         assert len(v_ckpts) == KILL_AFTER  # checkpointed each finished task
         last_step, last_loss = int(v_ckpts[-1][0]), float(v_ckpts[-1][1])
 
-        # restart the victim from its checkpoint; it rejoins the drain
+        # phase 2: a fresh peer and the restarted victim drain the rest,
+        # including the crashed task once its lease times out
+        survivor = _spawn(srv.port, ck_b, -1, "survivor")
         restarted = _spawn(srv.port, ck_a, -1, "victim2")
         r_out, r_err = restarted.communicate(timeout=300)
         s_out, s_err = survivor.communicate(timeout=300)
@@ -77,10 +80,10 @@ def test_kill_requeue_resume_composition(tmp_path):
         assert st["done"] == N_SHARDS and st["todo"] == 0 \
             and st["leased"] == 0 and st["discarded"] == 0, st
 
-        # the shard whose lease died with the victim was re-processed by
-        # a peer — find it: victim's unfinished 3rd task
+        # the crashed task's shard was finished by a phase-2 worker, not
+        # the victim — the requeue actually happened
         v_done = set(re.findall(r"DONE (shard-\d+)", v_out))
-        requeued = set(shards) - v_done - set(re.findall(r"DONE (shard-\d+)", s_out))
-        # (it may have landed on either the survivor or the restarted
-        # victim; the exactly-once assertion above already pins it)
+        assert len(v_done) == KILL_AFTER
+        assert set(shards) - v_done <= set(
+            re.findall(r"DONE (shard-\d+)", r_out + s_out))
         admin.close()

@@ -115,6 +115,53 @@ def test_sp_seq_divisibility_enforced():
         tr.step(tr._put_feed(feed))
 
 
+def test_gpt_generator_continues_overfit_pattern():
+    """Train GPT on a periodic token stream, then the KV-cache
+    incremental generator must continue the period from a prompt —
+    proves cache indexing/positions and train↔generate param-name
+    compatibility in one shot."""
+    cfg = _cfg(vocab_size=16, max_len=48, num_layers=2)
+    prog = pt.build(gpt.make_model(cfg))
+    period = [3, 4, 5, 6]
+    seq = np.array([period[i % 4] for i in range(32)], np.int32)
+    ids = np.tile(seq, (4, 1))
+    labels = np.concatenate([ids[:, 1:], ids[:, :1]], axis=1)
+    feed = {"ids": ids, "labels": labels.astype(np.int32)}
+    tr = pt.Trainer(prog, opt.Adam(1e-2), loss_name="loss")
+    tr.startup(sample_feed=feed)
+    for _ in range(60):
+        out = tr.step(tr._put_feed(feed))
+    assert float(out["loss"]) < 0.1, float(out["loss"])
+
+    gen_prog = pt.build(gpt.make_generator(cfg, max_new_tokens=8))
+    prompt = ids[:2, :8]  # ends with ...3,4,5,6 -> expect 3,4,5,6,3,4,5,6
+    outs, _ = gen_prog.apply(dict(tr.scope.params), {},
+                             jnp.asarray(prompt))
+    got = np.asarray(outs["ids"])[0].tolist()
+    expect = [period[i % 4] for i in range(8)]
+    assert got == expect, (got, expect)
+
+    # beam path: per-layer cache lists obey beam_search's [B*beam, ...]
+    # state contract, so lane reordering reaches the KV caches — the top
+    # beam of the overfit model must equal the greedy continuation
+    beam_prog = pt.build(gpt.make_generator(cfg, max_new_tokens=8,
+                                            beam_size=2))
+    bouts, _ = beam_prog.apply(dict(tr.scope.params), {},
+                               jnp.asarray(prompt))
+    assert np.asarray(bouts["ids"]).shape == (2, 2, 8)
+    assert np.asarray(bouts["ids"])[0, 0].tolist() == expect
+
+
+def test_gpt_generator_param_names_subset_of_train():
+    cfg = _cfg(num_layers=2)
+    train_params, _ = pt.build(gpt.make_model(cfg)).init(
+        jax.random.PRNGKey(0), **_feed(2))
+    gen_params, _ = pt.build(gpt.make_generator(cfg, max_new_tokens=4)).init(
+        jax.random.PRNGKey(0), np.zeros((2, 8), np.int32))
+    assert set(gen_params) == set(train_params), (
+        set(gen_params) ^ set(train_params))
+
+
 def test_sp_and_pp_mutually_exclusive():
     from paddle_tpu.core.errors import EnforceError
 
