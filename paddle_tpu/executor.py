@@ -248,7 +248,8 @@ class Trainer:
         sp_on, sp_ctx = self._ambient_mode(
             "DistStrategy.sequence_parallel",
             bool(getattr(self.strategy, "sequence_parallel", False)), "sp",
-            lambda: sp_mode(self.mesh))
+            lambda: sp_mode(self.mesh,
+                            impl=getattr(self.strategy, "sp_impl", "ring")))
         with remat_mode(bool(getattr(self.strategy, "remat", False))), \
                 pp_ctx as pp_cfg, sp_ctx as sp_cfg:
             out, new_state = self.program.apply(params, state, training=True,

@@ -514,14 +514,17 @@ _sp_mode = threading.local()
 
 
 @contextlib.contextmanager
-def sp_mode(mesh, axis: str = "sp"):
+def sp_mode(mesh, axis: str = "sp", impl: str = "ring"):
     """Ambient sequence-parallel switch (trace-time, like
     :func:`pipeline_mode`). Trainer enters this around ``program.apply``
     when ``DistStrategy.sequence_parallel`` is set and the mesh has an
     ``sp`` axis; sp-aware zoo models (models/gpt.py) route their
-    attention through ring attention with the zigzag layout."""
+    attention through ring attention (``impl="ring"``, zigzag layout) or
+    all-to-all head-sharded attention (``impl="ulysses"``)."""
+    enforce(impl in ("ring", "ulysses"),
+            f"unknown sequence-parallel impl {impl!r} (ring|ulysses)")
     old = getattr(_sp_mode, "cfg", None)
-    cfg = {"mesh": mesh, "axis": axis, "consumed": False}
+    cfg = {"mesh": mesh, "axis": axis, "impl": impl, "consumed": False}
     _sp_mode.cfg = cfg
     try:
         yield cfg

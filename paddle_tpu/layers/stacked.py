@@ -68,10 +68,22 @@ def _sdpa(q, k, v, key_bias, causal: bool, use_flash: bool, sp_cfg=None):
     own activations, as models/gpt.py does) — natural-order callers get
     the numerically-safe per-call gathers, never a silent mismatch."""
     if sp_cfg is not None:
-        from ..parallel.ring_attention import ring_attention
         enforce(key_bias is None,
                 "sequence-parallel attention does not take a padding bias "
                 "(pack full sequences; pad-free is the long-context contract)")
+        if sp_cfg.get("impl", "ring") == "ulysses":
+            from ..parallel.ulysses import ulysses_attention
+
+            def inner(qh, kh, vh, caus):
+                if use_flash:
+                    from ..ops.flash_attention import flash_attention
+                    return flash_attention(qh, kh, vh, causal=caus)
+                return _sdpa(qh, kh, vh, None, caus, False)
+
+            return ulysses_attention(q, k, v, sp_cfg["mesh"],
+                                     axis_name=sp_cfg["axis"], causal=causal,
+                                     attn_fn=inner)
+        from ..parallel.ring_attention import ring_attention
         layout = sp_cfg.get("layout", "natural")
         return ring_attention(q, k, v, sp_cfg["mesh"], axis_name=sp_cfg["axis"],
                               causal=causal,

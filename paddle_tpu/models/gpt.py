@@ -61,7 +61,7 @@ def make_model(cfg: GPTConfig):
         s = ids.shape[1]
         enforce(s <= cfg.max_len, f"seq {s} exceeds max_len {cfg.max_len}")
         sp = sp_config()
-        if sp is not None:
+        if sp is not None and sp.get("impl", "ring") == "ring":
             from ..parallel.ring_attention import zigzag_order
             n = sp["mesh"].shape[sp["axis"]]
             enforce(s % (2 * n) == 0,
@@ -75,6 +75,11 @@ def make_model(cfg: GPTConfig):
             # that do NOT permute get the safe "natural" default
             sp["layout"] = "zigzag"
         else:
+            if sp is not None:  # ulysses: natural order, no permutation
+                n = sp["mesh"].shape[sp["axis"]]
+                enforce(s % n == 0,
+                        f"ulysses sequence parallelism needs seq {s} "
+                        f"divisible by sp={n}")
             positions = jnp.arange(s)
 
         with name_scope("tok"):

@@ -88,6 +88,16 @@ std::string WriteDataset(const char* path) {
 
 // the only python the demo runs: build the model once, expose _step()
 const char* kBootstrap = R"PY(
+import os
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    # honor the env var even where a sitecustomize boot hook force-set
+    # jax_platforms after env parsing (the axon transport would otherwise
+    # be dialed — and block — despite JAX_PLATFORMS=cpu)
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import numpy as np
 import paddle_tpu as pt
 from paddle_tpu import layers, optimizer as opt

@@ -38,7 +38,11 @@ class DistStrategy:
     # parallel.pipeline.bubble_fraction.
     pp_microbatches: int = 0
     # sequence/context parallelism: sp-aware zoo models (models/gpt.py)
-    # run their attention as zigzag ring attention over the mesh's 'sp'
-    # axis, activations kept in zigzag layout end-to-end. Mutually
-    # exclusive with pp_microbatches on the same stack.
+    # run their attention over the mesh's 'sp' axis. Mutually exclusive
+    # with pp_microbatches on the same stack. sp_impl picks the scheme:
+    # 'ring' = zigzag ring attention, activations kept in zigzag layout
+    # end-to-end (no head-count constraint); 'ulysses' = all-to-all
+    # head<->sequence reshard (needs num_heads % sp == 0; full-sequence
+    # inner kernel).
     sequence_parallel: bool = False
+    sp_impl: str = "ring"

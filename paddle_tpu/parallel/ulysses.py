@@ -73,8 +73,12 @@ def ulysses_attention(
     bshard = bspec if len(bspec) > 1 else (bspec[0] if bspec else None)
     spec = P(bshard, None, axis_name, None)
 
+    # check_vma off: inner kernels with custom_vjp (the pallas flash
+    # attention) produce abstract values the static varying-axes analysis
+    # cannot type — same setting the ring attention shard_map uses
     fn = jax.shard_map(
         functools.partial(_ulysses_body, axis_name=axis_name, causal=causal,
                           attn_fn=attn_fn or _plain_attention),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
     return fn(q, k, v)

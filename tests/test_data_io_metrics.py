@@ -262,6 +262,37 @@ def test_persistables_at_sign_in_name(tmp_path):
     assert loaded["real_bf16"].dtype == jnp.bfloat16
 
 
+def test_predictor_clone_under_threads(tmp_path):
+    """Clone-per-thread serving (paddle_inference_api.h:141 Clone
+    semantics): 4 threads hammer clones of one Predictor concurrently;
+    every result must equal the single-threaded answer."""
+    import concurrent.futures
+
+    import jax
+
+    from paddle_tpu.models import mnist
+
+    prog = pt.build(mnist.mlp)
+    rng = np.random.RandomState(0)
+    feeds = [{"image": rng.randn(8, 784).astype(np.float32),
+              "label": rng.randint(0, 10, (8, 1)).astype(np.int64)}
+             for _ in range(4)]
+    params, state = prog.init(jax.random.PRNGKey(0), **feeds[0])
+    pio.save_inference_model(str(tmp_path / "m"), prog, params, state, feeds[0])
+    pred = pio.load_inference_model(str(tmp_path / "m"))
+    expected = [float(pred.run(f)["loss"]) for f in feeds]
+
+    def worker(i):
+        clone = pred.clone()
+        return [float(clone.run(f)["loss"]) for f in feeds for _ in range(5)]
+
+    expected_rep = [e for e in expected for _ in range(5)]
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as ex:
+        results = list(ex.map(worker, range(4)))
+    for got in results:
+        np.testing.assert_allclose(got, expected_rep, rtol=1e-6)
+
+
 def test_predictor_aot_no_retrace(tmp_path):
     """Predictor compiles once at load; run() executes the same compiled
     executable (api_impl.cc:64 Init/Run split) — 100 calls, no tracing."""
