@@ -192,7 +192,8 @@ def bench_bert(peak, batch_size=32, seq=128, num_masked=20, dtype="bfloat16",
     from paddle_tpu.core import flops
     from paddle_tpu.models import bert
 
-    cfg = bert.base_config(dtype=dtype, use_flash=True, max_len=512)
+    cfg = bert.base_config(dtype=dtype, use_flash=True, fused_ce=True,
+                           max_len=512)
     model = pt.build(bert.make_pretrain_model(cfg))
     rng = np.random.RandomState(0)
     feeds = [{

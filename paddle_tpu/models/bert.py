@@ -27,6 +27,12 @@ class BertConfig:
     num_layers: int = 12
     dropout: float = 0.1
     use_flash: bool = False
+    # chunked logits-free CE for the MLM head (ops/fused_ce.py): never
+    # materializes [b, masked, vocab] logits, and sidesteps the
+    # involuntary-remat resharding XLA's partitioner hits on the dense
+    # head's scatter-grad under fsdp
+    fused_ce: bool = False
+    ce_chunk: int = 4096
     # per-block jax.checkpoint over encoder layers (memory_optimize analog)
     remat: bool = False
     dtype: str = "float32"
@@ -82,8 +88,16 @@ def make_pretrain_model(cfg: BertConfig):
                                     initializer=init.Normal(0, 0.02))
         bias = helper.create_parameter("b", (cfg.vocab_size,), dtype,
                                        initializer=init.Constant(0.0))
-        mlm_logits = jnp.matmul(h, w) + bias
-        mlm_loss = L.mean(L.softmax_with_cross_entropy(mlm_logits, mlm_labels))
+        if cfg.fused_ce:
+            from ..ops.fused_ce import chunked_softmax_cross_entropy
+            m = h.shape[1]
+            ce = chunked_softmax_cross_entropy(
+                h.reshape(b * m, cfg.d_model), w, bias,
+                mlm_labels.reshape(-1).astype(jnp.int32), 0.0, cfg.ce_chunk)
+            mlm_loss = jnp.mean(ce)
+        else:
+            mlm_logits = jnp.matmul(h, w) + bias
+            mlm_loss = L.mean(L.softmax_with_cross_entropy(mlm_logits, mlm_labels))
 
         # next-sentence head over [CLS]
         pooled = L.fc(seq[:, 0], cfg.d_model, act="tanh", name="pooler")

@@ -161,6 +161,41 @@ def test_bert_pretrain_step():
     assert float(o1["loss"]) < float(o0["loss"])
 
 
+@pytest.mark.slow
+def test_bert_fused_ce_matches_dense_head():
+    """BERT MLM head with chunked logits-free CE == the dense-logits
+    head on identical params (loss and gradients) — the bench config's
+    path, and the fix for the fsdp scatter-grad resharding."""
+    kw = dict(vocab_size=100, max_len=32, d_model=32, d_inner=64,
+              num_heads=4, num_layers=2, dropout=0.0)
+    rng = np.random.RandomState(0)
+    bs, s, m = 2, 16, 3
+    feed = {
+        "input_ids": rng.randint(0, 100, (bs, s)).astype(np.int32),
+        "token_type_ids": np.zeros((bs, s), np.int32),
+        "mlm_positions": rng.randint(0, s, (bs, m)).astype(np.int32),
+        "mlm_labels": rng.randint(0, 100, (bs, m, 1)).astype(np.int64),
+        "nsp_label": rng.randint(0, 2, (bs, 1)).astype(np.int64),
+    }
+    dense = pt.build(bert.make_pretrain_model(bert.base_config(**kw)))
+    fused = pt.build(bert.make_pretrain_model(
+        bert.base_config(fused_ce=True, ce_chunk=32, **kw)))
+    params, state = dense.init(jax.random.PRNGKey(0), **feed)
+
+    def loss_of(prog):
+        def f(p):
+            out, _ = prog.apply(p, state, **feed)
+            return out["loss"]
+        return f
+
+    ld, gd = jax.value_and_grad(loss_of(dense))(params)
+    lf, gf = jax.value_and_grad(loss_of(fused))(params)
+    np.testing.assert_allclose(float(lf), float(ld), rtol=1e-5)
+    for k in gd:
+        np.testing.assert_allclose(np.asarray(gf[k]), np.asarray(gd[k]),
+                                   atol=1e-5, rtol=1e-4, err_msg=k)
+
+
 def test_word2vec_learns():
     model = pt.build(word2vec.make_model(dict_size=30, emb_dim=8, hidden=32))
     rng = np.random.RandomState(0)
