@@ -30,7 +30,7 @@ from ..core.errors import enforce
 from ..framework import LayerHelper, name_scope, sp_config
 from ..layers import attention as A
 from ..layers import stacked as S
-from ..ops.fused_ce import chunked_softmax_cross_entropy
+from .lm_head import lm_head_loss
 
 
 @dataclasses.dataclass
@@ -97,22 +97,8 @@ def make_model(cfg: GPTConfig):
                                 remat=cfg.remat)
             x = L.layer_norm(x, begin_norm_axis=2)
 
-        helper = LayerHelper("lm_head")
-        w = helper.create_parameter("w", (cfg.d_model, cfg.vocab_size), dtype,
-                                    initializer=init.Xavier())
-        lab = labels.astype(jnp.int32)
-        nonpad = (labels != 0).astype(jnp.float32)
-        token_count = jnp.maximum(nonpad.sum(), 1.0)
-        b, t, d = x.shape
-        if cfg.fused_ce:
-            ce = chunked_softmax_cross_entropy(
-                x.reshape(b * t, d), w, None, lab.reshape(-1), 0.0,
-                cfg.ce_chunk).reshape(b, t)
-        else:
-            logits = jnp.matmul(x, w)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            ce = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
-        loss = jnp.sum(ce * nonpad) / token_count
+        loss, token_count = lm_head_loss(x, labels, cfg.vocab_size, dtype,
+                                         cfg.fused_ce, cfg.ce_chunk)
         return {"loss": loss, "token_count": token_count}
 
     return gpt
