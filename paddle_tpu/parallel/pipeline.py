@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core.errors import enforce
 from .mesh import pvary
 
 
@@ -122,8 +123,8 @@ def pipeline_apply(
       on that microbatch each tick.
     """
     if extras is not None and jax.tree.leaves(extras):
-        assert all(e.shape[0] == x.shape[0] for e in jax.tree.leaves(extras)), \
-            "extras leaves must share x's batch dim"
+        enforce(all(e.shape[0] == x.shape[0] for e in jax.tree.leaves(extras)),
+                "extras leaves must share x's batch dim")
     else:
         extras = None
 
@@ -153,10 +154,20 @@ def pipeline_apply(
 
     p = mesh.shape[axis_name]
     L = jax.tree.leaves(stacked_params)[0].shape[0]
-    assert L % p == 0, f"{L} layers not divisible by pp={p}"
+    enforce(L % p == 0, f"{L} layers not divisible by pp={p}")
     b = x.shape[0]
-    assert b % microbatches == 0, f"batch {b} not divisible by microbatches"
+    enforce(b % microbatches == 0,
+            f"batch {b} not divisible by microbatches={microbatches}")
     mb = b // microbatches
+    dshard = 1
+    for a in batch_axes:
+        if a in mesh.axis_names:
+            dshard *= mesh.shape[a]
+    enforce(mb % dshard == 0,
+            f"microbatch size {mb} (batch {b} / microbatches {microbatches}) "
+            f"must be divisible by the data-shard product {dshard} of axes "
+            f"{tuple(a for a in batch_axes if a in mesh.axis_names)}; lower "
+            f"microbatches or raise the batch")
     xm = x.reshape((microbatches, mb) + x.shape[1:])
     exm = None if extras is None else jax.tree.map(
         lambda e: e.reshape((microbatches, mb) + e.shape[1:]), extras)

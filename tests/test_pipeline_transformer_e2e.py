@@ -218,6 +218,59 @@ def test_stacked_params_sharded_over_pp():
     assert spec[0] == "pp", spec
 
 
+def test_pipeline_composes_with_grad_accumulation():
+    """pp_microbatches × accum_steps: the scan-microbatched feed halves
+    feed the pipeline's own microbatching; parity vs plain single-device
+    accumulation."""
+    feeds = [_feed(16, seed=9)]
+
+    prog_ref = pt.build(transformer.make_model(_cfg()))
+    ref = _run_steps(
+        pt.Trainer(prog_ref, opt.Adam(1e-3), loss_name="loss",
+                   strategy=DistStrategy(accum_steps=2)), feeds)
+
+    mesh = pt.make_mesh({"dp": 2, "pp": 4})
+    prog_pp = pt.build(transformer.make_model(_cfg()))
+    pp = _run_steps(
+        pt.Trainer(prog_pp, opt.Adam(1e-3), loss_name="loss", mesh=mesh,
+                   sharding_rules=transformer_tp_rules(),
+                   strategy=DistStrategy(accum_steps=2, pp_microbatches=4)),
+        feeds)
+    np.testing.assert_allclose(pp, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_pipeline_trained_model_eval_and_reshape_restore(tmp_path):
+    """The pp-sharded stacked model evaluates (no pipeline ctx: scan
+    path over pp-sharded params under plain GSPMD) and its sharded
+    checkpoint restores onto a DIFFERENT mesh factoring with identical
+    losses (the pserver slice/merge analog, io.py:881)."""
+    from paddle_tpu import io as pio
+
+    feed = _feed(16, seed=10)
+    mesh_a = pt.make_mesh({"dp": 2, "pp": 4})
+    prog = pt.build(transformer.make_model(_cfg()))
+    tr_a = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss", mesh=mesh_a,
+                      sharding_rules=transformer_tp_rules(),
+                      strategy=DistStrategy(pp_microbatches=4))
+    tr_a.startup(sample_feed=feed)
+    tr_a.step(feed)
+    ev = float(tr_a.eval(feed)["loss"])
+    assert np.isfinite(ev)
+    pio.save_trainer_sharded(str(tmp_path / "ck"), tr_a, async_save=False)
+
+    mesh_b = pt.make_mesh({"dp": 4, "pp": 2})
+    prog_b = pt.build(transformer.make_model(_cfg()))
+    tr_b = pt.Trainer(prog_b, opt.Adam(1e-3), loss_name="loss", mesh=mesh_b,
+                      sharding_rules=transformer_tp_rules(),
+                      strategy=DistStrategy(pp_microbatches=4))
+    tr_b.startup(sample_feed=feed)
+    pio.load_trainer_sharded(str(tmp_path / "ck"), tr_b)
+    np.testing.assert_allclose(float(tr_b.eval(feed)["loss"]), ev,
+                               atol=1e-5, rtol=1e-5)
+    # and training continues on the new factoring
+    assert np.isfinite(float(tr_b.step(feed)["loss"]))
+
+
 def test_dropout_rejected_with_stacked():
     from paddle_tpu.core.errors import EnforceError
 
