@@ -321,6 +321,41 @@ def bench_lstm(peak, batch_size=64, seq=128, hidden=512, iters=20):
 # -- inference configs -------------------------------------------------------
 
 
+def bench_gpt_decode(peak, batch_size=8, prompt=128, new_tokens=128, iters=5):
+    """Autoregressive serving: KV-cache prefill + greedy decode
+    (models/gpt.make_generator), generated tokens/sec. Decode is
+    memory-bound — expect MFU well below the train configs."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.core import flops
+    from paddle_tpu.core.config import set_flag
+    from paddle_tpu.models import gpt
+
+    # don't inherit whatever dtype the previous config left in the flag
+    set_flag("default_compute_dtype", "bfloat16")
+    cfg = gpt.base_config(vocab_size=32000, max_len=prompt + new_tokens,
+                          d_model=768, d_inner=3072, num_heads=12,
+                          num_layers=12, use_flash=False, dtype="bfloat16")
+    prog = pt.build(gpt.make_generator(cfg, max_new_tokens=new_tokens))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(3, cfg.vocab_size,
+                           (batch_size, prompt)).astype(np.int32)
+               for _ in range(2)]
+    params, state = prog.init(jax.random.PRNGKey(0), prompts[0])
+    run = jax.jit(lambda p, s, ids: prog.apply(p, s, ids)[0]["ids"])
+    out = run(params, state, prompts[0])
+    _sync(out)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = run(params, state, prompts[i % 2])
+    _sync(out)
+    dt = (time.perf_counter() - t0) / iters
+    f = flops.gpt_decode_flops(batch_size, prompt, new_tokens, cfg)
+    res = _result(batch_size * new_tokens, "tokens/sec", dt, dt, f, peak)
+    del res["compute_only"], res["mfu_compute_only"]
+    return res
+
+
 def bench_resnet50_infer(peak, variant="fp32", batch_size=16, image_size=224,
                          iters=50):
     """AOT Predictor serving loop (api_impl.cc Run analog): host numpy →
@@ -441,6 +476,13 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=900):
         except Exception as e:
             configs[f"resnet50_infer_{variant}"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] infer/{variant} failed: {e}", file=sys.stderr)
+    try:
+        with _deadline(config_timeout):
+            configs["gpt_decode"] = bench_gpt_decode(
+                peak, **({"iters": 2, "new_tokens": 16} if quick else {}))
+    except Exception as e:
+        configs["gpt_decode"] = {"error": f"{type(e).__name__}: {e}"}
+        print(f"[bench] gpt_decode failed: {e}", file=sys.stderr)
     set_flag("default_compute_dtype", "float32")
 
     mfus = [c["mfu"] for n, c in configs.items()

@@ -128,6 +128,25 @@ def gpt_train_flops(bs: int, seq: int, cfg) -> float:
     return f
 
 
+def gpt_decode_flops(bs: int, prompt: int, new_tokens: int, cfg) -> float:
+    """Forward-only FLOPs of prefill(prompt) + the incremental decode
+    steps the generator actually runs: the first generated token comes
+    from the prefill's own head eval (no stack step), so only
+    new_tokens-1 incremental stack steps execute, with new_tokens head
+    evals total (fwd only, no ×3; undercount-never-overcount)."""
+    d, di, L = cfg.d_model, cfg.d_inner, cfg.num_layers
+    params = (4 * d * d + 2 * d * di) * L
+    inc = max(new_tokens - 1, 0)
+    tokens = bs * (prompt + inc)
+    f = 2.0 * params * tokens
+    f += 2.0 * d * cfg.vocab_size * bs * new_tokens  # head: prefill + inc steps
+    # prefill causal attention (halved, fwd-only) + per-step cache attention
+    f += _attn_train_flops(bs * prompt, prompt, d, L, causal=True) / 3.0
+    avg_ctx = prompt + inc / 2.0
+    f += 4.0 * L * avg_ctx * d * bs * inc
+    return f
+
+
 def bert_train_flops(bs: int, seq: int, num_masked: int, cfg) -> float:
     """Train-step FLOPs of BERT pretraining (models/bert.py): encoder
     stack + MLM head (transform + vocab proj over masked positions) +

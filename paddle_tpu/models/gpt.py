@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from .. import initializer as init
 from .. import layers as L
 from ..core.errors import enforce
-from ..framework import LayerHelper, name_scope, sp_config
+from ..framework import LayerHelper, cast_compute, name_scope, sp_config
 from ..layers import attention as A
 from ..layers import stacked as S
 from .lm_head import lm_head_loss
@@ -151,7 +151,9 @@ def make_generator(cfg: GPTConfig, max_new_tokens: int, beam_size: int = 1,
                 jnp.matmul(h, w_head).astype(jnp.float32), axis=-1)
 
         # ---- prefill: run the prompt causally, capture per-layer k/v
-        x = w_emb[prompt_ids].astype(dtype) + pe[:p][None]
+        # (cast_compute keeps the scan carry dtype consistent with the
+        # blocks' compute dtype regardless of cfg.dtype)
+        x = cast_compute(w_emb[prompt_ids] + pe[:p][None])
 
         def pre(a, lp):
             return S.prefill_block(a, lp, cfg.num_heads, cfg.use_flash)
@@ -184,8 +186,8 @@ def make_generator(cfg: GPTConfig, max_new_tokens: int, beam_size: int = 1,
             # the prefill already produced the first step's distribution;
             # afterwards embed the chosen token and run the cached stack
             def incremental(_):
-                xt = w_emb[tokens].astype(dtype)[:, None, :] \
-                    + pe[state["index"]][None, None]
+                xt = cast_compute(w_emb[tokens][:, None, :]
+                                  + pe[state["index"]][None, None])
                 kn, vn = [], []
                 for lp, kc, vc in zip(layer_params, state["k"], state["v"]):
                     xt, kc, vc = S.decode_block(
