@@ -220,6 +220,27 @@ def test_gpt_generator_continues_overfit_pattern():
     assert np.asarray(bouts["ids"])[0, 0].tolist() == expect
 
 
+def test_gpt_generator_exports_to_aot_predictor(tmp_path):
+    """The generation program exports through save_inference_model
+    (StableHLO) and serves via the AOT Predictor — the decoder-only
+    serving story end-to-end (api_impl.cc Run analog for LMs)."""
+    from paddle_tpu import io as pio
+
+    cfg = _cfg(num_layers=2)
+    prog = pt.build(gpt.make_generator(cfg, max_new_tokens=8))
+    prompt = np.random.RandomState(0).randint(3, 128, (2, 8)).astype(np.int32)
+    params, state = prog.init(jax.random.PRNGKey(0), prompt)
+    direct, _ = prog.apply(params, state, jnp.asarray(prompt))
+
+    pio.save_inference_model(str(tmp_path / "g"), prog, params, state,
+                             {"prompt_ids": prompt})
+    pred = pio.load_inference_model(str(tmp_path / "g"))
+    assert type(pred._compiled).__name__ == "Compiled"  # AOT, no retrace
+    served = pred.run({"prompt_ids": prompt})
+    np.testing.assert_array_equal(np.asarray(served["ids"]),
+                                  np.asarray(direct["ids"]))
+
+
 def test_gpt_generator_param_names_subset_of_train():
     cfg = _cfg(num_layers=2)
     train_params, _ = pt.build(gpt.make_model(cfg)).init(
