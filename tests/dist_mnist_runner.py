@@ -2,20 +2,31 @@
 (SURVEY §4: model scripts driven by runtime_main in test_dist_base.py).
 
 Launched as subprocesses by test_dist_multiprocess.py:
-    python dist_mnist_runner.py <proc_id> <nprocs> <port> <steps>
-Trains MNIST MLP data-parallel across processes, prints per-step losses
-as `LOSS <step> <value>` lines for the parent to compare."""
+    python dist_mnist_runner.py <proc_id> <nprocs> <port> <steps> [mode]
+mode "dp" (default): pure data parallel, one device per process.
+mode "dp_fsdp": 2 virtual devices per process, mesh {dp: nprocs,
+fsdp: 2} — the data axis rides the cross-process (DCN analog) dimension
+while params/optimizer state shard over each process's local devices
+(ICI analog); the reference's multi-node NCCL2 topology, with param
+slicing. Prints per-step losses as `LOSS <step> <value>`."""
 
 import os
 import sys
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+pid, nprocs, port, steps = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], int(sys.argv[4])
+mode = sys.argv[5] if len(sys.argv) > 5 else "dp"
+if mode not in ("dp", "dp_fsdp"):
+    sys.exit(f"unknown mode {mode!r} (dp|dp_fsdp)")
+local_devices = 2 if mode == "dp_fsdp" else 1
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+_flags.append(f"--xla_force_host_platform_device_count={local_devices}")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-pid, nprocs, port, steps = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], int(sys.argv[4])
 if nprocs > 1:
     jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
                                num_processes=nprocs, process_id=pid)
@@ -38,9 +49,14 @@ def global_batches(step, global_bs=64):
 
 def main():
     prog = pt.build(mnist_models.mlp)
-    mesh = pt.make_mesh({"dp": jax.device_count()})
+    if mode == "dp_fsdp":
+        mesh = pt.make_mesh({"dp": nprocs, "fsdp": local_devices})
+        rules = pt.parallel.fsdp(min_size_to_shard=1)
+    else:
+        mesh = pt.make_mesh({"dp": jax.device_count()})
+        rules = pt.parallel.replicated()
     trainer = pt.Trainer(prog, opt.SGD(0.1), loss_name="loss", mesh=mesh,
-                         sharding_rules=pt.parallel.replicated())
+                         sharding_rules=rules)
     x0, y0 = global_batches(0)
     local = x0.shape[0] // nprocs
     sample = {"image": x0[:local], "label": y0[:local]}

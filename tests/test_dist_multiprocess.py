@@ -21,7 +21,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _run_procs(nprocs, steps, timeout=240):
+def _run_procs(nprocs, steps, timeout=240, mode="dp"):
     port = _free_port()
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
@@ -29,7 +29,8 @@ def _run_procs(nprocs, steps, timeout=240):
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, RUNNER, str(i), str(nprocs), str(port), str(steps)],
+            [sys.executable, RUNNER, str(i), str(nprocs), str(port), str(steps),
+             mode],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True)
         for i in range(nprocs)
     ]
@@ -46,10 +47,17 @@ def _losses(out):
             for m in re.finditer(r"LOSS (\d+) ([\d.]+)", out)}
 
 
+@pytest.fixture(scope="module")
+def single_proc_losses():
+    """The deterministic single-process baseline, computed once for
+    every topology comparison in this module (5 steps covers all)."""
+    return _losses(_run_procs(1, 5)[0])
+
+
 @pytest.mark.slow
-def test_two_process_dp_matches_single_process():
+def test_two_process_dp_matches_single_process(single_proc_losses):
     steps = 5
-    single = _losses(_run_procs(1, steps)[0])
+    single = single_proc_losses
     multi = _run_procs(2, steps)
     l0, l1 = _losses(multi[0]), _losses(multi[1])
     assert len(single) == steps and len(l0) == steps
@@ -59,3 +67,22 @@ def test_two_process_dp_matches_single_process():
         # and it matches the single-process run on the same global batch
         assert abs(l0[s] - single[s]) < 1e-3, (
             f"step {s}: dist {l0[s]} vs local {single[s]}")
+
+
+@pytest.mark.slow
+def test_two_process_dp_fsdp_mesh_matches_single_process(single_proc_losses):
+    """2 processes × 2 local virtual devices, mesh {dp: 2, fsdp: 2}:
+    the data axis rides the cross-process (DCN analog) dimension while
+    params/optimizer state shard over each process's local devices —
+    the reference's multi-node NCCL2 topology plus pserver param
+    slicing, as one mesh. Losses must match the plain single-process
+    run on the same global batches."""
+    steps = 4
+    single = single_proc_losses  # 5-step baseline covers our 4
+    multi = _run_procs(2, steps, mode="dp_fsdp")
+    l0, l1 = _losses(multi[0]), _losses(multi[1])
+    assert len(single) >= steps and len(l0) == steps
+    for s in range(steps):
+        assert abs(l0[s] - l1[s]) < 1e-5
+        assert abs(l0[s] - single[s]) < 1e-3, (
+            f"step {s}: dp×fsdp {l0[s]} vs local {single[s]}")
