@@ -334,9 +334,9 @@ int main(int argc, char** argv) {
   }
   socklen_t alen = sizeof(addr);
   getsockname(srv, reinterpret_cast<sockaddr*>(&addr), &alen);
+  listen(srv, 64);  // before PORT: clients connect the moment they see it
   printf("PORT %d\n", ntohs(addr.sin_port));
   fflush(stdout);
-  listen(srv, 64);
 
   std::thread timeout_thread([&master]() {
     while (true) {

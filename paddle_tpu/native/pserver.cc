@@ -1,0 +1,290 @@
+// Async parameter server — the TPU-native re-expression of the
+// reference's async pserver runtime (listen_and_serv_op.cc:217
+// RunAsyncLoop: per-grad optimize block applied on arrival, no
+// trainer barriers) including DC-ASGD delay compensation
+// (distribute_transpiler.py:1571 _append_dc_asgd_ops: the adjusted
+// gradient g' = g + lambda*g*g*(w - w_bak[trainer]), with w_bak
+// captured per trainer at param-pull time).
+//
+// Design notes (vs the reference): the reference splits the ProgramDesc
+// into trainer/pserver programs and runs gRPC-transported optimize
+// blocks inside the C++ interpreter. Here the dense/sparse update rules
+// ARE the server (SGD / Adagrad / row-wise sparse), the transport is
+// the same line-framed TCP protocol the C++ master uses, and trainers
+// are JAX processes that jit only the gradient computation — the
+// optimizer state lives host-side on the server, which is exactly the
+// pserver placement in the reference (optimizer ops run on the pserver,
+// distribute_transpiler.py:592-837). Sync SPMD training remains the
+// first-class path (parallel/); this server exists for the async-SGD
+// capability row.
+//
+// Build: g++ -O2 -std=c++17 -pthread pserver.cc -o pserver_server
+// Run:   pserver_server <port> <lr> <sgd|adagrad> <dc_asgd 0|1> [lambda]
+//        port 0 picks a free port; prints "PORT <n>" on stdout.
+//
+// Protocol (one request line; binary payloads length-prefixed):
+//   INIT <name> <len>\n<f32 bytes>  -> OK NEW | OK EXISTS  (first writer wins)
+//   PULL <trainer> <name>           -> OK <len>\n<f32 bytes>
+//   PUSH <trainer> <name> <len>\n<f32 bytes>              -> OK <version>
+//   PUSHROWS <trainer> <name> <nrows> <rowdim>\n<i32 ids><f32 vals> -> OK <v>
+//   STATUS                          -> OK params=N pushes=M
+//   QUIT                            -> closes the connection
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum class Opt { kSGD, kAdagrad };
+
+struct Param {
+  std::vector<float> value;
+  std::vector<float> accum;                        // adagrad G += g^2
+  std::map<int, std::vector<float>> bak;           // per-trainer w_bak
+  int64_t version = 0;
+};
+
+class PServer {
+ public:
+  PServer(float lr, Opt opt, bool dc_asgd, float lambda)
+      : lr_(lr), opt_(opt), dc_asgd_(dc_asgd), lambda_(lambda) {}
+
+  std::string Init(const std::string& name, const std::string& bytes) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (bytes.size() % sizeof(float) != 0)
+      return "ERR payload not a multiple of sizeof(float)\n";
+    auto it = params_.find(name);
+    if (it != params_.end()) return "OK EXISTS\n";
+    Param p;
+    p.value.resize(bytes.size() / sizeof(float));
+    memcpy(p.value.data(), bytes.data(), bytes.size());
+    if (opt_ == Opt::kAdagrad) p.accum.assign(p.value.size(), 0.f);
+    params_[name] = std::move(p);
+    return "OK NEW\n";
+  }
+
+  std::string Pull(int trainer, const std::string& name, std::string* payload) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = params_.find(name);
+    if (it == params_.end()) return "ERR unknown param " + name + "\n";
+    Param& p = it->second;
+    payload->assign(reinterpret_cast<const char*>(p.value.data()),
+                    p.value.size() * sizeof(float));
+    // DC-ASGD: the staleness reference point is the param value this
+    // trainer last SAW — capture it at pull (ref_by_trainer_id analog).
+    if (dc_asgd_) p.bak[trainer] = p.value;
+    return "OK " + std::to_string(payload->size()) + "\n";
+  }
+
+  std::string Push(int trainer, const std::string& name,
+                   const std::string& bytes) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = params_.find(name);
+    if (it == params_.end()) return "ERR unknown param " + name + "\n";
+    Param& p = it->second;
+    size_t n = bytes.size() / sizeof(float);
+    if (n != p.value.size()) return "ERR size mismatch\n";
+    const float* grad = reinterpret_cast<const float*>(bytes.data());
+    const float* bak = nullptr;
+    if (dc_asgd_) {
+      auto bit = p.bak.find(trainer);
+      if (bit != p.bak.end() && bit->second.size() == n)
+        bak = bit->second.data();
+    }
+    for (size_t i = 0; i < n; ++i) {
+      float gi = grad[i];
+      if (bak)  // g + lambda*g*g*(w - w_bak): 2nd-order delay compensation
+        gi += lambda_ * gi * gi * (p.value[i] - bak[i]);
+      ApplyOne(&p, i, gi);
+    }
+    ++p.version;
+    ++pushes_;
+    return "OK " + std::to_string(p.version) + "\n";
+  }
+
+  // Sparse rows (distributed-lookup-table update path: pserver-side
+  // row-wise optimize, distribute_transpiler.py:1100-1339). Param is
+  // [total_rows, rowdim] row-major; ids index rows. DC-ASGD is a dense
+  // concept in the reference and is skipped for sparse pushes there too.
+  std::string PushRows(const std::string& name, int64_t nrows, int64_t rowdim,
+                       const std::string& ids_b, const std::string& vals_b) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = params_.find(name);
+    if (it == params_.end()) return "ERR unknown param " + name + "\n";
+    Param& p = it->second;
+    if (nrows < 0 || rowdim <= 0) return "ERR bad nrows/rowdim\n";
+    if (ids_b.size() != size_t(nrows) * sizeof(int32_t) ||
+        vals_b.size() != size_t(nrows) * rowdim * sizeof(float))
+      return "ERR size mismatch\n";
+    const int32_t* ids = reinterpret_cast<const int32_t*>(ids_b.data());
+    const float* vals = reinterpret_cast<const float*>(vals_b.data());
+    int64_t total_rows = int64_t(p.value.size()) / rowdim;
+    // validate every id BEFORE touching the param: a mid-loop ERR would
+    // leave a half-applied update the client will retry (double-apply)
+    for (int64_t r = 0; r < nrows; ++r)
+      if (ids[r] < 0 || ids[r] >= total_rows) return "ERR row id out of range\n";
+    for (int64_t r = 0; r < nrows; ++r)
+      for (int64_t j = 0; j < rowdim; ++j)
+        ApplyOne(&p, size_t(ids[r]) * rowdim + j, vals[r * rowdim + j]);
+    ++p.version;
+    ++pushes_;
+    return "OK " + std::to_string(p.version) + "\n";
+  }
+
+  std::string Status() {
+    std::lock_guard<std::mutex> g(mu_);
+    return "OK params=" + std::to_string(params_.size()) +
+           " pushes=" + std::to_string(pushes_) + "\n";
+  }
+
+ private:
+  void ApplyOne(Param* p, size_t i, float g) {
+    if (opt_ == Opt::kAdagrad) {
+      p->accum[i] += g * g;
+      p->value[i] -= lr_ * g / (std::sqrt(p->accum[i]) + 1e-6f);
+    } else {
+      p->value[i] -= lr_ * g;
+    }
+  }
+
+  std::mutex mu_;
+  std::unordered_map<std::string, Param> params_;
+  int64_t pushes_ = 0;
+  float lr_;
+  Opt opt_;
+  bool dc_asgd_;
+  float lambda_;
+};
+
+// -- line-framed socket IO (shared shape with master.cc) ---------------------
+
+bool ReadLine(int fd, std::string* line) {
+  line->clear();
+  char c;
+  while (true) {
+    ssize_t r = recv(fd, &c, 1, 0);
+    if (r <= 0) return false;
+    if (c == '\n') return true;
+    line->push_back(c);
+    if (line->size() > 1 << 20) return false;
+  }
+}
+
+bool ReadExact(int fd, char* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = recv(fd, buf + got, n - got, 0);
+    if (r <= 0) return false;
+    got += r;
+  }
+  return true;
+}
+
+bool WriteAll(int fd, const char* buf, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    sent += r;
+  }
+  return true;
+}
+
+bool ReadBody(int fd, size_t len, std::string* body) {
+  if (len > (512u << 20)) return false;
+  body->resize(len);
+  return len == 0 || ReadExact(fd, &(*body)[0], len);
+}
+
+void ServeClient(PServer* ps, int fd) {
+  std::string line;
+  while (ReadLine(fd, &line)) {
+    std::string resp, payload;
+    char name[256];
+    long long a = 0, b = 0, c = 0;
+    if (sscanf(line.c_str(), "INIT %255s %lld", name, &a) == 2) {
+      std::string body;
+      if (!ReadBody(fd, a, &body)) break;
+      resp = ps->Init(name, body);
+    } else if (sscanf(line.c_str(), "PULL %lld %255s", &a, name) == 2) {
+      resp = ps->Pull(int(a), name, &payload);
+    } else if (sscanf(line.c_str(), "PUSH %lld %255s %lld", &a, name, &b) == 3) {
+      std::string body;
+      if (!ReadBody(fd, b, &body)) break;
+      resp = ps->Push(int(a), name, body);
+    } else if (sscanf(line.c_str(), "PUSHROWS %lld %255s %lld %lld",
+                      &a, name, &b, &c) == 4) {
+      std::string ids, vals;
+      if (!ReadBody(fd, size_t(b) * sizeof(int32_t), &ids)) break;
+      if (!ReadBody(fd, size_t(b) * size_t(c) * sizeof(float), &vals)) break;
+      resp = ps->PushRows(name, b, c, ids, vals);
+    } else if (line == "STATUS") {
+      resp = ps->Status();
+    } else if (line == "QUIT") {
+      break;
+    } else {
+      resp = "ERR bad command\n";
+    }
+    if (!WriteAll(fd, resp.data(), resp.size())) break;
+    if (!payload.empty() && !WriteAll(fd, payload.data(), payload.size()))
+      break;
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr,
+            "usage: pserver_server <port> <lr> [sgd|adagrad] [dc_asgd 0|1] "
+            "[lambda]\n");
+    return 1;
+  }
+  int port = atoi(argv[1]);
+  float lr = atof(argv[2]);
+  Opt opt = (argc > 3 && std::string(argv[3]) == "adagrad") ? Opt::kAdagrad
+                                                            : Opt::kSGD;
+  bool dc = argc > 4 && atoi(argv[4]) != 0;
+  float lambda = argc > 5 ? atof(argv[5]) : 1.0f;
+
+  PServer ps(lr, opt, dc, lambda);
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(port);
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(srv, reinterpret_cast<sockaddr*>(&addr), &alen);
+  listen(srv, 64);  // before PORT: clients connect the moment they see it
+  printf("PORT %d\n", ntohs(addr.sin_port));
+  fflush(stdout);
+
+  while (true) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(ServeClient, &ps, fd).detach();
+  }
+}

@@ -1,7 +1,8 @@
 """Parallelism over TPU meshes — the reference's ParallelExecutor +
 DistributeTranspiler capabilities re-expressed as sharding (SURVEY §2.2/§7)."""
 
-from . import api, mesh, moe, sharding, strategy, ulysses
+from . import api, async_ps, mesh, moe, sharding, strategy, ulysses
+from .async_ps import AsyncPSTrainer, PSClient, PServerProcess
 from .mesh import DATA_AXES, DP, EP, FSDP, PP, SP, TP, data_parallel_size, initialize, make_mesh
 from .moe import moe_ep_rules
 from .sharding import ShardingRules, fsdp, replicated, transformer_tp_rules
@@ -9,7 +10,8 @@ from .strategy import DistStrategy
 from .ulysses import ulysses_attention
 
 __all__ = [
-    "api", "mesh", "moe", "sharding", "strategy", "ulysses",
+    "api", "async_ps", "mesh", "moe", "sharding", "strategy", "ulysses",
+    "AsyncPSTrainer", "PSClient", "PServerProcess",
     "DATA_AXES", "DP", "EP", "FSDP", "PP", "SP", "TP",
     "data_parallel_size", "initialize", "make_mesh",
     "moe_ep_rules", "ulysses_attention",

@@ -1,0 +1,275 @@
+"""Asynchronous parameter-server training — client + server manager for
+the C++ pserver (native/pserver.cc).
+
+Capability parity with the reference's async-SGD path
+(listen_and_serv_op.cc:217 RunAsyncLoop; distribute_transpiler.py
+sync_mode=False): trainers compute gradients locally and push them to a
+parameter server WITHOUT barriers; the server applies the optimizer
+update per gradient on arrival; trainers pull fresh params on their own
+schedule. DC-ASGD (distribute_transpiler.py:1571) adjusts each pushed
+gradient by second-order delay compensation
+``g + lambda * g*g*(w - w_bak[trainer])`` with ``w_bak`` captured at
+this trainer's last pull.
+
+The TPU division of labor: the jitted part is ONLY the gradient
+computation (value_and_grad of the program, compiled by XLA); the
+optimizer state lives host-side on the server exactly where the
+reference placed it (optimize blocks run on the pserver,
+distribute_transpiler.py:592-837). Synchronous SPMD collectives remain
+the first-class training path — this module exists for the async-SGD /
+DC-ASGD capability rows, which trade gradient staleness for never
+stalling on a straggler.
+
+Typical use (one server process, N trainer processes)::
+
+    srv = PServerProcess(lr=0.05, optimizer="sgd")      # once
+    t = AsyncPSTrainer(prog, srv.addr, trainer_id=k)    # per trainer
+    t.startup(sample_feed=batch)
+    for batch in data:
+        out = t.step(batch)                              # push-grad, no barrier
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.config import get_flag
+from ..core.errors import enforce
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "native")
+_SRC = os.path.join(_NATIVE_DIR, "pserver.cc")
+_BIN = os.path.join(_NATIVE_DIR, "pserver_server")
+
+
+def _build_server() -> str:
+    if (not os.path.exists(_BIN)) or os.path.getmtime(_BIN) < os.path.getmtime(_SRC):
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-pthread", _SRC, "-o", _BIN],
+            check=True, capture_output=True)
+    return _BIN
+
+
+class PServerProcess:
+    """Spawn-and-own a pserver_server process (the listen_and_serv
+    runtime analog; one per param shard group in a real deployment)."""
+
+    def __init__(self, port: int = 0, lr: float = 0.01,
+                 optimizer: str = "sgd", dc_asgd: bool = False,
+                 dc_lambda: float = 1.0):
+        enforce(optimizer in ("sgd", "adagrad"),
+                f"pserver optimizer must be sgd|adagrad, got {optimizer}")
+        binpath = _build_server()
+        self._proc = subprocess.Popen(
+            [binpath, str(port), repr(float(lr)), optimizer,
+             "1" if dc_asgd else "0", repr(float(dc_lambda))],
+            stdout=subprocess.PIPE, text=True)
+        line = self._proc.stdout.readline().strip()
+        if not line.startswith("PORT "):
+            raise RuntimeError(f"pserver_server failed to start: {line!r}")
+        self.port = int(line.split()[1])
+        self.addr = ("127.0.0.1", self.port)
+
+    def stop(self):
+        if self._proc.poll() is None:
+            self._proc.kill()
+            self._proc.wait()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class PSClient:
+    """Socket client for the pserver protocol. Dense params are flat f32
+    buffers keyed by name; sparse pushes update [rows, dim] params
+    row-wise (the distributed-lookup-table update path)."""
+
+    def __init__(self, addr: Tuple[str, int], trainer_id: int = 0,
+                 timeout: float = 30.0):
+        self.addr = tuple(addr)
+        self.trainer_id = int(trainer_id)
+        self._sock = socket.create_connection(self.addr, timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    # -- transport ----------------------------------------------------------
+    def _readline(self) -> str:
+        buf = bytearray()
+        while True:
+            c = self._sock.recv(1)
+            if not c:
+                raise ConnectionError("pserver closed connection")
+            if c == b"\n":
+                return buf.decode()
+            buf += c
+
+    def _read_exact(self, n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n:
+            chunk = self._sock.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("pserver closed connection")
+            out += chunk
+        return bytes(out)
+
+    def _request(self, line: str, payload: bytes = b"") -> str:
+        self._sock.sendall(line.encode() + b"\n" + payload)
+        resp = self._readline()
+        if resp.startswith("ERR"):
+            raise RuntimeError(f"pserver: {resp}")
+        return resp
+
+    def close(self):
+        try:
+            self._sock.sendall(b"QUIT\n")
+        except OSError:
+            pass
+        self._sock.close()
+
+    # -- param API ----------------------------------------------------------
+    def init_param(self, name: str, value: np.ndarray) -> bool:
+        """Register a param (first writer wins). Returns True if this
+        call created it."""
+        data = np.ascontiguousarray(value, dtype=np.float32).tobytes()
+        resp = self._request(f"INIT {name} {len(data)}", data)
+        return resp == "OK NEW"
+
+    def pull(self, name: str, shape, dtype=np.float32) -> np.ndarray:
+        resp = self._request(f"PULL {self.trainer_id} {name}")
+        n = int(resp.split()[1])
+        arr = np.frombuffer(self._read_exact(n), dtype=np.float32)
+        return arr.reshape(shape).astype(dtype, copy=False)
+
+    def push(self, name: str, grad: np.ndarray) -> int:
+        data = np.ascontiguousarray(grad, dtype=np.float32).tobytes()
+        resp = self._request(f"PUSH {self.trainer_id} {name} {len(data)}", data)
+        return int(resp.split()[1])
+
+    def push_rows(self, name: str, row_ids: np.ndarray,
+                  row_grads: np.ndarray) -> int:
+        """Sparse push: ``row_grads[k]`` updates row ``row_ids[k]`` of the
+        [rows, dim] param — SelectedRows send + pserver row-optimize."""
+        ids = np.ascontiguousarray(row_ids, dtype=np.int32)
+        vals = np.ascontiguousarray(row_grads, dtype=np.float32)
+        enforce(vals.ndim == 2 and ids.shape == (vals.shape[0],),
+                "push_rows wants ids [n] and grads [n, dim]")
+        resp = self._request(
+            f"PUSHROWS {self.trainer_id} {name} {vals.shape[0]} {vals.shape[1]}",
+            ids.tobytes() + vals.tobytes())
+        return int(resp.split()[1])
+
+    def status(self) -> Dict[str, int]:
+        resp = self._request("STATUS")
+        return {k: int(v) for k, v in
+                (kv.split("=") for kv in resp[3:].split())}
+
+
+def _named_leaves(tree) -> Sequence[Tuple[str, Any]]:
+    """Stable name per leaf from its pytree path (the send_recv var-name
+    analog)."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+            for k in path)
+        out.append((name.replace(" ", "_") or "root", leaf))
+    return out
+
+
+class AsyncPSTrainer:
+    """Barrier-free trainer: jitted local gradients, server-side updates.
+
+    ``pull_interval`` controls staleness: 1 pulls fresh params before
+    every step (matches plain SGD exactly when training alone); larger
+    values trade staleness for fewer round-trips — the async knob the
+    reference exposes through sync_mode=False.
+    """
+
+    def __init__(self, program, addr: Tuple[str, int], loss_name: str = "loss",
+                 trainer_id: int = 0, pull_interval: int = 1,
+                 fetch_list: Optional[Sequence[str]] = None):
+        import jax
+
+        self.program = program
+        self.loss_name = loss_name
+        self.client = PSClient(addr, trainer_id=trainer_id)
+        self.pull_interval = max(1, int(pull_interval))
+        self.fetch_list = list(fetch_list) if fetch_list is not None else None
+        self.params = None
+        self.state = None
+        self.global_step = 0
+
+        def grad_step(params, state, rng, feed):
+            def loss_fn(p, st, r, f):
+                out, new_state = program.apply(p, st, training=True, rng=r, **f)
+                if isinstance(out, dict):
+                    loss = out[loss_name]
+                else:
+                    loss, out = out, {loss_name: out}
+                if self.fetch_list is not None:
+                    out = {k: out[k] for k in set(self.fetch_list) | {loss_name}}
+                return loss, (out, new_state)
+
+            (_, (out, new_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, state, rng, feed)
+            return grads, out, new_state
+
+        self._grad_fn = jax.jit(grad_step)
+
+    # ------------------------------------------------------------------
+    def startup(self, rng=None, sample_feed: Optional[Dict[str, Any]] = None):
+        import jax
+
+        from ..executor import _abstractify
+
+        if rng is None:
+            rng = jax.random.PRNGKey(get_flag("seed"))
+        feed = {k: _abstractify(v) for k, v in (sample_feed or {}).items()}
+        params, self.state = self.program.init(rng, **feed)
+        # first trainer's init wins server-side; then EVERY trainer pulls,
+        # so all replicas start from the same point regardless of race
+        for name, leaf in _named_leaves(params):
+            self.client.init_param(name, np.asarray(leaf, dtype=np.float32))
+        self.params = self._pull_into(params)
+        return self.params
+
+    def _pull_into(self, params):
+        import jax
+
+        leaves = _named_leaves(params)
+        pulled = [self.client.pull(n, np.shape(l),
+                                   dtype=getattr(l, "dtype", np.float32))
+                  for n, l in leaves]
+        treedef = jax.tree_util.tree_structure(params)
+        return jax.tree_util.tree_unflatten(treedef, pulled)
+
+    # ------------------------------------------------------------------
+    def step(self, feed: Dict[str, Any], rng=None) -> Dict[str, Any]:
+        import jax
+
+        enforce(self.params is not None, "call startup() before step()")
+        if rng is None:
+            rng = jax.random.fold_in(
+                jax.random.PRNGKey(get_flag("seed") + 1), self.global_step)
+        if self.global_step % self.pull_interval == 0:
+            self.params = self._pull_into(self.params)
+        grads, out, self.state = self._grad_fn(self.params, self.state, rng, feed)
+        for name, leaf in _named_leaves(jax.device_get(grads)):
+            self.client.push(name, leaf)
+        self.global_step += 1
+        return out

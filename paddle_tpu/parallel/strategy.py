@@ -46,3 +46,9 @@ class DistStrategy:
     # inner kernel).
     sequence_parallel: bool = False
     sp_impl: str = "ring"
+    # async parameter-server mode (listen_and_serv RunAsyncLoop analog):
+    # barrier-free grad push / param pull through the C++ pserver
+    # (parallel.async_ps) instead of SPMD collectives. Set by
+    # DistributeTranspiler(sync_mode=False); consumed by driver code that
+    # routes the program to AsyncPSTrainer.
+    async_mode: bool = False

@@ -99,10 +99,7 @@ class DistributeTranspiler:
 
     def transpile(self, trainer_id: int, program=None, pservers: str = "",
                   trainers: int = 1, sync_mode: bool = True, startup_program=None):
-        if not sync_mode or not self.config.sync_mode:
-            raise NotImplementedError(
-                "async pserver updates are a documented non-goal on the "
-                "synchronous-collective TPU platform (DESIGN.md §parallelism)")
+        self.sync_mode = bool(sync_mode and self.config.sync_mode)
         self.trainer_id = trainer_id
         self.trainers = trainers
         self._program = program
@@ -113,14 +110,21 @@ class DistributeTranspiler:
         # pserver param slicing capability → shard params+opt state (fsdp)
         if self.config.slice_var_up:
             s.reduce_strategy = "sharded"
+        # async mode (listen_and_serv RunAsyncLoop): barrier-free push/pull
+        # through the C++ pserver (parallel.async_ps) instead of SPMD
+        # collectives — the strategy records it so the driver routes the
+        # program to AsyncPSTrainer
+        s.async_mode = not getattr(self, "sync_mode", True)
         return s
 
     def get_trainer_program(self):
         return self._program, self._strategy()
 
     def get_pserver_program(self, endpoint=None):
-        # param shards are mesh-resident; the 'pserver program' is the same
-        # SPMD step restricted to its fsdp shard — return program+strategy
+        # sync mode: param shards are mesh-resident; the 'pserver program'
+        # is the same SPMD step restricted to its fsdp shard. async mode:
+        # the pserver is the native runtime (parallel.PServerProcess) —
+        # return the strategy that says so.
         return self._program, self._strategy()
 
     def get_startup_program(self, endpoint=None, pserver_program=None):

@@ -25,8 +25,11 @@ def test_distribute_transpiler_shapes_strategy():
     assert strategy.reduce_strategy == "sharded"  # param-slicing capability
     p2, s2 = t.get_pserver_program("h1:6174")
     assert p2 is prog
-    with pytest.raises(NotImplementedError):
-        t.transpile(0, prog, "h1:6174", 2, sync_mode=False)
+    assert not s2.async_mode
+    # sync_mode=False → async pserver capability (parallel.async_ps)
+    t.transpile(0, prog, "h1:6174", 2, sync_mode=False)
+    _, s3 = t.get_trainer_program()
+    assert s3.async_mode
 
 
 def test_ps_dispatchers():
