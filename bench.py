@@ -43,6 +43,20 @@ BASELINES = {
 }
 
 
+def _init_jax():
+    """Make the JAX_PLATFORMS env var authoritative: the axon boot hook
+    force-sets jax_platforms after env parsing, so an explicit
+    JAX_PLATFORMS=cpu (tests / tunnel-down debugging) would otherwise
+    still initialize the remote backend."""
+    import os
+
+    import jax
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        jax.config.update("jax_platforms", want)
+    return jax
+
+
 def _sync(out):
     # device_get of a scalar forces a real sync — block_until_ready alone
     # does not fully synchronize on the experimental axon transport.
@@ -448,42 +462,123 @@ def _deadline(seconds: int):
         signal.signal(signal.SIGALRM, old)
 
 
-def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=900):
+def _suite_names():
+    import os
+
+    names = ([f"{n}" for n in TRAIN_CONFIGS]
+             + [f"resnet50_infer_{v}" for v in INFER_VARIANTS]
+             + ["gpt_decode"])
+    only = os.environ.get("BENCH_ONLY")  # comma-list filter (debug/tests)
+    if only:
+        keep = {s.strip() for s in only.split(",")}
+        names = [n for n in names if n in keep]
+    return names
+
+
+def _result_key(name: str) -> str:
+    return f"{name}_train" if name in TRAIN_CONFIGS else name
+
+
+def _run_one(name: str, peak: float, quick: bool = False, batch_size=None):
+    """Run a single named config in-process."""
+    kw = {}
+    if batch_size:
+        kw["batch_size"] = batch_size
+    if name in TRAIN_CONFIGS:
+        if quick:
+            kw["iters"] = 3
+        return TRAIN_CONFIGS[name](peak, **kw)
+    if name.startswith("resnet50_infer_"):
+        if quick:
+            kw["iters"] = 3
+        return bench_resnet50_infer(peak, variant=name.rsplit("_", 1)[1], **kw)
+    if name == "gpt_decode":
+        if quick:
+            kw.update(iters=2, new_tokens=16)
+        return bench_gpt_decode(peak, **kw)
+    raise ValueError(f"unknown config {name}")
+
+
+def _probe_device(timeout: int = 240) -> Optional[str]:
+    """Run a tiny matmul in a SUBPROCESS with a hard timeout. The axon
+    transport can wedge inside a C call where no in-process guard fires;
+    a dead tunnel must fail the suite fast with a recorded reason, not
+    hang the driver."""
+    import subprocess
     import sys
 
-    import jax
-    from paddle_tpu.core import flops
-    from paddle_tpu.core.config import set_flag
-
-    set_flag("default_compute_dtype", compute_dtype)
-    dev = jax.devices()[0]
-    peak, peak_source = flops.device_peak_flops(dev)
-    configs = {}
-    kw = {"iters": 3} if quick else {}
-    for name, fn in TRAIN_CONFIGS.items():
-        try:
-            set_flag("default_compute_dtype", compute_dtype)
-            with _deadline(config_timeout):
-                configs[f"{name}_train"] = fn(peak, **kw)
-        except Exception as e:  # record the failure, keep the suite going
-            configs[f"{name}_train"] = {"error": f"{type(e).__name__}: {e}"}
-            print(f"[bench] {name} failed: {e}", file=sys.stderr)
-    for variant in INFER_VARIANTS:
-        try:
-            with _deadline(config_timeout):
-                configs[f"resnet50_infer_{variant}"] = bench_resnet50_infer(
-                    peak, variant=variant, **({"iters": 3} if quick else {}))
-        except Exception as e:
-            configs[f"resnet50_infer_{variant}"] = {"error": f"{type(e).__name__}: {e}"}
-            print(f"[bench] infer/{variant} failed: {e}", file=sys.stderr)
+    code = ("import os, jax;"
+            "w = os.environ.get('JAX_PLATFORMS');"
+            "w and jax.config.update('jax_platforms', w);"
+            "import jax.numpy as jnp;"
+            "d = jax.devices()[0];"
+            "x = jnp.ones((256, 256));"
+            "jax.device_get((x @ x).sum());"
+            "print('KIND', getattr(d, 'device_kind', str(d)))")
     try:
-        with _deadline(config_timeout):
-            configs["gpt_decode"] = bench_gpt_decode(
-                peak, **({"iters": 2, "new_tokens": 16} if quick else {}))
-    except Exception as e:
-        configs["gpt_decode"] = {"error": f"{type(e).__name__}: {e}"}
-        print(f"[bench] gpt_decode failed: {e}", file=sys.stderr)
-    set_flag("default_compute_dtype", "float32")
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("KIND "):
+            return line[5:]
+    return None
+
+
+def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
+    """Each config runs in its OWN subprocess under a hard wall-clock
+    timeout: a wedged XLA compile / blocked transfer (uninterruptible in
+    Python) costs one config slot, never the suite record. Child stderr
+    streams through for progress; the one-line JSON comes from child
+    stdout."""
+    import os
+    import subprocess
+    import sys
+
+    kind = _probe_device()
+    if kind is None:
+        return {"metric": "suite", "value": 0.0, "unit": "MFU",
+                "vs_baseline": None,
+                "error": "device probe failed: backend unreachable or wedged "
+                         "(tiny-matmul subprocess timed out)",
+                "compute_dtype": compute_dtype, "configs": {}}
+
+    configs = {}
+    device = peak = peak_source = None
+    for name in _suite_names():
+        key = _result_key(name)
+        print(f"[bench] {name} ...", file=sys.stderr, flush=True)
+        cmd = [sys.executable, os.path.abspath(__file__), "--model", name,
+               "--compute_dtype", compute_dtype, "--emit", "raw",
+               "--config_timeout", str(config_timeout)]
+        if quick:
+            cmd.append("--quick")
+        try:
+            r = subprocess.run(cmd, stdout=subprocess.PIPE, text=True,
+                               timeout=config_timeout)
+        except subprocess.TimeoutExpired:
+            configs[key] = {"error": f"Timeout: config exceeded {config_timeout}s "
+                                     "(subprocess killed)"}
+            print(f"[bench] {name} TIMED OUT", file=sys.stderr, flush=True)
+            continue
+        line = (r.stdout.strip().splitlines() or [""])[-1]
+        try:
+            payload = json.loads(line)
+        except json.JSONDecodeError:
+            payload = {"error": f"rc={r.returncode}, no JSON (crash/OOM?)"}
+        if "error" in payload:
+            configs[key] = {"error": payload["error"]}
+            print(f"[bench] {name} failed: {payload['error']}",
+                  file=sys.stderr, flush=True)
+            continue
+        configs[key] = payload["result"]
+        device = payload.get("device", device)
+        peak = payload.get("peak_flops", peak)
+        peak_source = payload.get("peak_source", peak_source)
+        c = configs[key]
+        print(f"[bench] {name}: {c.get('value')} {c.get('unit')} "
+              f"mfu={c.get('mfu')}", file=sys.stderr, flush=True)
 
     mfus = [c["mfu"] for n, c in configs.items()
             if n.endswith("_train") and "mfu" in c]
@@ -494,7 +589,7 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=900):
         "value": round(headline, 4),
         "unit": "MFU",
         "vs_baseline": rn.get("vs_baseline"),
-        "device": getattr(dev, "device_kind", str(dev)),
+        "device": device or kind,
         "peak_flops": peak,
         "peak_source": peak_source,
         "compute_dtype": compute_dtype,
@@ -505,7 +600,7 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=900):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default=None,
-                   choices=sorted(TRAIN_CONFIGS) + ["suite"],
+                   choices=sorted(_suite_names()) + ["suite"],
                    help="single config (default: full suite)")
     p.add_argument("--batch_size", type=int, default=None)
     p.add_argument("--compute_dtype", default="bfloat16",
@@ -513,29 +608,46 @@ def main():
                    help="mixed-precision compute dtype (master params stay f32)")
     p.add_argument("--quick", action="store_true",
                    help="3 timing iters per config (harness smoke test)")
+    p.add_argument("--config_timeout", type=int, default=1200,
+                   help="hard per-config wall-clock limit in suite mode")
+    p.add_argument("--emit", default="pretty", choices=["pretty", "raw"],
+                   help="raw: suite-internal single-config JSON envelope")
     args = p.parse_args()
 
     if args.model in (None, "suite"):
         if args.batch_size:
             p.error("--batch_size applies to a single --model config, "
                     "not the full suite")
-        print(json.dumps(run_suite(args.compute_dtype, quick=args.quick)))
+        print(json.dumps(run_suite(args.compute_dtype, quick=args.quick,
+                                   config_timeout=args.config_timeout)))
         return
 
-    import jax
+    jax = _init_jax()
     from paddle_tpu.core import flops
     from paddle_tpu.core.config import set_flag
 
     set_flag("default_compute_dtype", args.compute_dtype)
-    peak, peak_source = flops.device_peak_flops(jax.devices()[0])
-    kw = {}
-    if args.batch_size:
-        kw["batch_size"] = args.batch_size
-    if args.quick:
-        kw["iters"] = 3
-    res = TRAIN_CONFIGS[args.model](peak, **kw)
+    dev = jax.devices()[0]
+    peak, peak_source = flops.device_peak_flops(dev)
+    try:
+        with _deadline(args.config_timeout):
+            res = _run_one(args.model, peak, quick=args.quick,
+                           batch_size=args.batch_size)
+    except Exception as e:  # the suite parent records the reason
+        if args.emit == "raw":
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+            return
+        raise
+    if args.emit == "raw":
+        print(json.dumps({
+            "result": res,
+            "device": getattr(dev, "device_kind", str(dev)),
+            "peak_flops": peak,
+            "peak_source": peak_source,
+        }))
+        return
     print(json.dumps({
-        "metric": f"{args.model}_train_throughput_{args.compute_dtype}",
+        "metric": f"{args.model}_throughput_{args.compute_dtype}",
         "peak_source": peak_source,
         **res,
     }))

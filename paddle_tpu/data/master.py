@@ -25,17 +25,11 @@ import subprocess
 import time
 from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
-_NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "native")
-_SRC = os.path.join(_NATIVE_DIR, "master.cc")
-_BIN = os.path.join(_NATIVE_DIR, "master_server")
+from ..native import build_native
 
 
 def _build_server() -> str:
-    if (not os.path.exists(_BIN)) or os.path.getmtime(_BIN) < os.path.getmtime(_SRC):
-        subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-pthread", _SRC, "-o", _BIN],
-            check=True, capture_output=True)
-    return _BIN
+    return build_native("master.cc", "master_server")
 
 
 class MasterServer:

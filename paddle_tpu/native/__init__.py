@@ -1,0 +1,36 @@
+"""Native C++ runtime pieces (RecordIO, master task-queue, async
+pserver, train demo) and the shared on-demand build helper."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Optional, Sequence
+
+_DIR = os.path.dirname(__file__)
+
+
+def build_native(src_name: str, bin_name: str,
+                 extra_flags: Sequence[str] = ("-pthread",),
+                 opt: str = "-O2", libs: Sequence[str] = ()) -> str:
+    """Compile ``native/<src_name>`` to ``native/<bin_name>`` if stale.
+
+    Concurrency-safe: compiles to a pid-unique temp path and atomically
+    renames into place, so two processes racing on a stale mtime (e.g.
+    parallel test workers sharing a checkout) each install a complete
+    binary instead of exec'ing a half-written one.
+    """
+    src = os.path.join(_DIR, src_name)
+    out = os.path.join(_DIR, bin_name)
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    tmp = f"{out}.tmp.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", opt, "-std=c++17", *extra_flags, src, "-o", tmp, *libs],
+            check=True, capture_output=True)
+        os.replace(tmp, out)  # atomic on POSIX
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return out

@@ -236,6 +236,12 @@ void ServeClient(PServer* ps, int fd) {
       resp = ps->Status();
     } else if (line == "QUIT") {
       break;
+    } else if (line.rfind("INIT ", 0) == 0 || line.rfind("PUSH", 0) == 0) {
+      // payload-carrying header that failed to parse (e.g. name >255
+      // chars truncated by %255s): the payload length is unknowable, so
+      // the stream is unrecoverable — close rather than desync into
+      // interpreting raw floats as commands
+      break;
     } else {
       resp = "ERR bad command\n";
     }

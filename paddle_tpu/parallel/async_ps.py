@@ -41,17 +41,11 @@ import numpy as np
 from ..core.config import get_flag
 from ..core.errors import enforce
 
-_NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "native")
-_SRC = os.path.join(_NATIVE_DIR, "pserver.cc")
-_BIN = os.path.join(_NATIVE_DIR, "pserver_server")
+from ..native import build_native
 
 
 def _build_server() -> str:
-    if (not os.path.exists(_BIN)) or os.path.getmtime(_BIN) < os.path.getmtime(_SRC):
-        subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-pthread", _SRC, "-o", _BIN],
-            check=True, capture_output=True)
-    return _BIN
+    return build_native("pserver.cc", "pserver_server")
 
 
 class PServerProcess:
@@ -139,11 +133,21 @@ class PSClient:
         self._sock.close()
 
     # -- param API ----------------------------------------------------------
+    @staticmethod
+    def _check_name(name: str) -> str:
+        # the server parses names with %255s: longer (or
+        # whitespace-bearing) names would truncate, desyncing the framed
+        # payload that follows — reject client-side
+        enforce(0 < len(name) <= 255 and not any(c.isspace() for c in name),
+                f"param name must be 1-255 chars with no whitespace, got "
+                f"{name[:64]!r}... ({len(name)} chars)")
+        return name
+
     def init_param(self, name: str, value: np.ndarray) -> bool:
         """Register a param (first writer wins). Returns True if this
         call created it."""
         data = np.ascontiguousarray(value, dtype=np.float32).tobytes()
-        resp = self._request(f"INIT {name} {len(data)}", data)
+        resp = self._request(f"INIT {self._check_name(name)} {len(data)}", data)
         return resp == "OK NEW"
 
     def pull(self, name: str, shape, dtype=np.float32) -> np.ndarray:
@@ -154,7 +158,8 @@ class PSClient:
 
     def push(self, name: str, grad: np.ndarray) -> int:
         data = np.ascontiguousarray(grad, dtype=np.float32).tobytes()
-        resp = self._request(f"PUSH {self.trainer_id} {name} {len(data)}", data)
+        resp = self._request(
+            f"PUSH {self.trainer_id} {self._check_name(name)} {len(data)}", data)
         return int(resp.split()[1])
 
     def push_rows(self, name: str, row_ids: np.ndarray,
@@ -166,7 +171,8 @@ class PSClient:
         enforce(vals.ndim == 2 and ids.shape == (vals.shape[0],),
                 "push_rows wants ids [n] and grads [n, dim]")
         resp = self._request(
-            f"PUSHROWS {self.trainer_id} {name} {vals.shape[0]} {vals.shape[1]}",
+            f"PUSHROWS {self.trainer_id} {self._check_name(name)} "
+            f"{vals.shape[0]} {vals.shape[1]}",
             ids.tobytes() + vals.tobytes())
         return int(resp.split()[1])
 

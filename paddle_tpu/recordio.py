@@ -16,14 +16,11 @@ import ctypes
 import io
 import os
 import struct
-import subprocess
 from typing import Iterable, Iterator, Sequence, Tuple
 
 import numpy as np
 
-_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
-_SRC = os.path.join(_NATIVE_DIR, "recordio.cc")
-_SO = os.path.join(_NATIVE_DIR, "librecordio.so")
+from .native import build_native
 
 _lib = None
 
@@ -32,11 +29,10 @@ def _load_lib():
     global _lib
     if _lib is not None:
         return _lib
-    if (not os.path.exists(_SO)) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO, "-lz"],
-            check=True, capture_output=True)
-    lib = ctypes.CDLL(_SO)
+    so = build_native("recordio.cc", "librecordio.so",
+                      extra_flags=("-shared", "-fPIC"), opt="-O3",
+                      libs=("-lz",))
+    lib = ctypes.CDLL(so)
     lib.rio_writer_open.restype = ctypes.c_void_p
     lib.rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
     lib.rio_writer_write.restype = ctypes.c_int
