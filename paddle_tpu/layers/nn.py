@@ -25,6 +25,13 @@ from ..framework import LayerHelper, ParamAttr, cast_compute, in_training, next_
 from .. import initializer as init
 from .ops import apply_activation
 
+
+def _quantize():
+    # lazy: keeps the layers package free of package-init order coupling
+    from .. import quantize
+
+    return quantize
+
 Int2 = Union[int, Sequence[int]]
 
 
@@ -68,7 +75,10 @@ def fc(
             attr=param_attr,
         )
         x2, w = cast_compute(x2, w)
-        y = jnp.matmul(x2, w)
+        if _quantize().in_int8_serving():
+            y = _quantize().int8_dynamic_matmul(x2, w)
+        else:
+            y = jnp.matmul(x2, w)
         out = y if out is None else out + y
     if bias_attr is not False:
         b = helper.create_parameter(
@@ -194,11 +204,18 @@ def conv2d(
     # no preferred_element_type: XLA's TPU conv already accumulates bf16
     # in fp32 on the MXU, and an explicit f32 output breaks the conv VJP
     # (transpose rule would mix f32 cotangents with bf16 operands).
-    out = jax.lax.conv_general_dilated(
-        x, w, window_strides=st,
-        padding=[(pd[0], pd[0]), (pd[1], pd[1])],
-        rhs_dilation=dl, dimension_numbers=dn, feature_group_count=groups,
-    )
+    if _quantize().in_int8_serving():
+        out = _quantize().int8_dynamic_conv(
+            x, w, window_strides=st,
+            padding=[(pd[0], pd[0]), (pd[1], pd[1])],
+            rhs_dilation=dl, dimension_numbers=dn,
+            feature_group_count=groups)
+    else:
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=st,
+            padding=[(pd[0], pd[0]), (pd[1], pd[1])],
+            rhs_dilation=dl, dimension_numbers=dn, feature_group_count=groups,
+        )
     if bias_attr is not False:
         b = helper.create_parameter("b", shape=(num_filters,), dtype=jnp.float32,
                                     attr=bias_attr, initializer=init.Constant(0.0))

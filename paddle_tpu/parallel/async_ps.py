@@ -31,7 +31,6 @@ Typical use (one server process, N trainer processes)::
 
 from __future__ import annotations
 
-import os
 import socket
 import subprocess
 from typing import Any, Dict, Optional, Sequence, Tuple
@@ -151,7 +150,7 @@ class PSClient:
         return resp == "OK NEW"
 
     def pull(self, name: str, shape, dtype=np.float32) -> np.ndarray:
-        resp = self._request(f"PULL {self.trainer_id} {name}")
+        resp = self._request(f"PULL {self.trainer_id} {self._check_name(name)}")
         n = int(resp.split()[1])
         arr = np.frombuffer(self._read_exact(n), dtype=np.float32)
         return arr.reshape(shape).astype(dtype, copy=False)

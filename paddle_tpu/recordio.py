@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import ctypes
 import io
-import os
 import struct
 from typing import Iterable, Iterator, Sequence, Tuple
 

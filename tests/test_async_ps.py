@@ -161,6 +161,17 @@ def test_two_trainer_async_converges():
         assert stats["pushes"] == 2 * 15 * stats["params"]
 
 
+def test_param_name_guard():
+    """Names the server's %255s parser would truncate (len>255 or
+    whitespace) are rejected client-side — a truncated name would desync
+    the framed payload that follows."""
+    with pytest.raises(Exception, match="1-255 chars"):
+        PSClient._check_name("x" * 256)
+    with pytest.raises(Exception, match="1-255 chars"):
+        PSClient._check_name("a b")
+    assert PSClient._check_name("layers/fc_0/w") == "layers/fc_0/w"
+
+
 def test_transpiler_async_mode_surface():
     """sync_mode=False no longer refuses: it flags the strategy for the
     async_ps path (the get_pserver_program split collapses into
