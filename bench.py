@@ -36,10 +36,14 @@ BASELINES = {
     # reference numbers from BASELINE.md (images/sec or ms/batch-derived)
     "resnet50": 81.69,        # images/sec, bs=64 (IntelOptimizedPaddle.md:39-45)
     "vgg16": 28.46,           # images/sec, bs=64 VGG-19 row (closest config)
+    "alexnet": 626.53,        # images/sec, bs=256 (IntelOptimizedPaddle.md:59-65)
+    "googlenet": 250.46,      # images/sec, bs=64 (IntelOptimizedPaddle.md:49-55)
     "lstm": 64 / 0.184,       # samples/sec from 184 ms/batch bs=64 K40m
+    "lstm_big": 256 / 1.655,  # bs=256 hid=1280: 1655 ms/batch K40m
     "resnet50_infer_fp32": 217.69,   # images/sec, bs=16 (IntelOptimizedPaddle.md:81-87)
     "resnet50_infer_bf16": 217.69,
     "resnet50_infer_int8": 217.69,
+    "googlenet_infer": 600.94,       # images/sec, bs=16 (IntelOptimizedPaddle.md:91-97)
 }
 
 
@@ -123,43 +127,65 @@ def _result(n_per_step, unit, dt_pipe, dt_comp, flops_per_step, peak, baseline_k
 
 
 def bench_resnet50(peak, batch_size=64, image_size=224, iters=20):
-    import paddle_tpu as pt
-    from paddle_tpu import optimizer as opt
     from paddle_tpu.core import flops
     from paddle_tpu.models import resnet
 
-    model = pt.build(resnet.make_model(depth=50, class_num=1000, image_size=image_size))
-    rng = np.random.RandomState(0)
-    feeds = [{
-        "image": rng.randn(batch_size, 3, image_size, image_size).astype(np.float32),
-        "label": rng.randint(0, 1000, (batch_size, 1)).astype(np.int64),
-    } for _ in range(4)]
-    trainer = pt.Trainer(model, opt.Momentum(0.1, 0.9), loss_name="loss",
-                         fetch_list=["loss"])
-    trainer.startup(sample_feed=feeds[0])
-    dt_pipe, dt_comp = _time_trainer(trainer, feeds, iters=iters)
-    f = flops.convnet_train_flops(flops.resnet_fwd_flops(50, image_size), batch_size)
-    return _result(batch_size, "images/sec", dt_pipe, dt_comp, f, peak, "resnet50")
+    return _bench_convnet(peak,
+                          resnet.make_model(depth=50, class_num=1000,
+                                            image_size=image_size),
+                          flops.resnet_fwd_flops(50, image_size), batch_size,
+                          "resnet50", image_size=image_size, iters=iters,
+                          lr=0.1)
 
 
 def bench_vgg16(peak, batch_size=64, image_size=224, iters=20):
-    import paddle_tpu as pt
-    from paddle_tpu import optimizer as opt
     from paddle_tpu.core import flops
     from paddle_tpu.models import vgg
 
-    model = pt.build(vgg.make_model(depth=16, class_num=1000))
+    return _bench_convnet(peak, vgg.make_model(depth=16, class_num=1000),
+                          flops.vgg_fwd_flops(16, image_size), batch_size,
+                          "vgg16", image_size=image_size, iters=iters)
+
+
+def _bench_convnet(peak, make_model_fn, fwd_flops, batch_size, baseline_key,
+                   image_size=224, iters=20, lr=0.01):
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.core import flops
+
+    model = pt.build(make_model_fn)
     rng = np.random.RandomState(0)
     feeds = [{
         "image": rng.randn(batch_size, 3, image_size, image_size).astype(np.float32),
         "label": rng.randint(0, 1000, (batch_size, 1)).astype(np.int64),
     } for _ in range(4)]
-    trainer = pt.Trainer(model, opt.Momentum(0.01, 0.9), loss_name="loss",
+    trainer = pt.Trainer(model, opt.Momentum(lr, 0.9), loss_name="loss",
                          fetch_list=["loss"])
     trainer.startup(sample_feed=feeds[0])
     dt_pipe, dt_comp = _time_trainer(trainer, feeds, iters=iters)
-    f = flops.convnet_train_flops(flops.vgg_fwd_flops(16, image_size), batch_size)
-    return _result(batch_size, "images/sec", dt_pipe, dt_comp, f, peak, "vgg16")
+    f = flops.convnet_train_flops(fwd_flops, batch_size)
+    return _result(batch_size, "images/sec", dt_pipe, dt_comp, f, peak,
+                   baseline_key)
+
+
+def bench_alexnet(peak, batch_size=256, iters=20):
+    """AlexNet bs=256 (the reference's Xeon MKL-DNN row config)."""
+    from paddle_tpu.core import flops
+    from paddle_tpu.models import convnets
+
+    return _bench_convnet(peak, convnets.make_alexnet(),
+                          flops.alexnet_fwd_flops(), batch_size, "alexnet",
+                          iters=iters)
+
+
+def bench_googlenet(peak, batch_size=64, iters=20):
+    """GoogLeNet v1 bs=64 (the reference's Xeon MKL-DNN row config)."""
+    from paddle_tpu.core import flops
+    from paddle_tpu.models import convnets
+
+    return _bench_convnet(peak, convnets.make_googlenet(),
+                          flops.googlenet_fwd_flops(), batch_size,
+                          "googlenet", iters=iters)
 
 
 def _bench_transformer_config(peak, batch_size, seq, dtype, dropout,
@@ -312,7 +338,8 @@ def bench_mnist_mlp(peak, batch_size=128, iters=50):
     return _result(batch_size, "samples/sec", dt_pipe, dt_comp, f, peak)
 
 
-def bench_lstm(peak, batch_size=64, seq=128, hidden=512, iters=20):
+def bench_lstm(peak, batch_size=64, seq=128, hidden=512, iters=20,
+               baseline_key="lstm"):
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
     from paddle_tpu.core import flops
@@ -329,7 +356,15 @@ def bench_lstm(peak, batch_size=64, seq=128, hidden=512, iters=20):
     trainer.startup(sample_feed=feeds[0])
     dt_pipe, dt_comp = _time_trainer(trainer, feeds, iters=iters)
     f = flops.lstm_train_flops(batch_size, seq, hidden, num_layers=2)
-    return _result(batch_size, "samples/sec", dt_pipe, dt_comp, f, peak, "lstm")
+    return _result(batch_size, "samples/sec", dt_pipe, dt_comp, f, peak,
+                   baseline_key)
+
+
+def bench_lstm_big(peak, batch_size=256, iters=10):
+    """The reference's large text-cls row: bs=256, hidden=1280 (K40m
+    1655 ms/batch)."""
+    return bench_lstm(peak, batch_size=batch_size, hidden=1280, iters=iters,
+                      baseline_key="lstm_big")
 
 
 # -- inference configs -------------------------------------------------------
@@ -370,8 +405,8 @@ def bench_gpt_decode(peak, batch_size=8, prompt=128, new_tokens=128, iters=5):
     return res
 
 
-def bench_resnet50_infer(peak, variant="fp32", batch_size=16, image_size=224,
-                         iters=50):
+def _bench_infer(peak, make_model_fn, fwd_flops_per_image, baseline_key,
+                 variant="bf16", batch_size=16, image_size=224, iters=50):
     """AOT Predictor serving loop (api_impl.cc Run analog): host numpy →
     device → compiled executable, per call. Variants: fp32, bf16 (weights
     + compute cast), int8 (REAL int8 datapath: dynamic int8×int8→int32
@@ -384,21 +419,16 @@ def bench_resnet50_infer(peak, variant="fp32", batch_size=16, image_size=224,
     import jax
     import paddle_tpu as pt
     from paddle_tpu import io as pio, quantize
-    from paddle_tpu.core import flops
     from paddle_tpu.core.config import set_flag
-    from paddle_tpu.models import resnet
 
     set_flag("default_compute_dtype",
              "float32" if variant == "fp32" else "bfloat16")
-    model = pt.build(resnet.make_model(depth=50, class_num=1000,
-                                       image_size=image_size))
+    model = pt.build(make_model_fn)
     rng = np.random.RandomState(0)
     feed = {"image": rng.randn(batch_size, 3, image_size, image_size).astype(np.float32),
             "label": rng.randint(0, 1000, (batch_size, 1)).astype(np.int64)}
     params, state = model.init(jax.random.PRNGKey(0), **feed)
-    if variant == "bf16":
-        params = quantize.cast_params_for_inference(params)
-    elif variant == "int8":
+    if variant in ("bf16", "int8"):
         params = quantize.cast_params_for_inference(params)
     mode = quantize.int8_serving() if variant == "int8" \
         else _ctxlib.nullcontext()
@@ -416,11 +446,37 @@ def bench_resnet50_infer(peak, variant="fp32", batch_size=16, image_size=224,
         out = pred.run(feeds[i % len(feeds)])
     _sync(out)
     dt = (time.perf_counter() - t0) / iters
-    f = flops.resnet_fwd_flops(50, image_size) * batch_size
-    res = _result(batch_size, "images/sec", dt, dt, f, peak,
-                  f"resnet50_infer_{variant}")
+    f = fwd_flops_per_image * batch_size
+    res = _result(batch_size, "images/sec", dt, dt, f, peak, baseline_key)
     del res["compute_only"], res["mfu_compute_only"]  # serving loop has no pre-staged variant
     return res
+
+
+def bench_resnet50_infer(peak, variant="fp32", batch_size=16, image_size=224,
+                         iters=50):
+    from paddle_tpu.core import flops
+    from paddle_tpu.models import resnet
+
+    return _bench_infer(peak,
+                        resnet.make_model(depth=50, class_num=1000,
+                                          image_size=image_size),
+                        flops.resnet_fwd_flops(50, image_size),
+                        f"resnet50_infer_{variant}", variant=variant,
+                        batch_size=batch_size, image_size=image_size,
+                        iters=iters)
+
+
+def bench_googlenet_infer(peak, batch_size=16, image_size=224, iters=50):
+    """GoogLeNet serving loop, bf16 (reference row: 600.94 img/s bs=16,
+    IntelOptimizedPaddle.md:91-97)."""
+    from paddle_tpu.core import flops
+    from paddle_tpu.models import convnets
+
+    return _bench_infer(peak, convnets.make_googlenet(),
+                        flops.googlenet_fwd_flops(image_size),
+                        "googlenet_infer", variant="bf16",
+                        batch_size=batch_size, image_size=image_size,
+                        iters=iters)
 
 
 # -- suite -------------------------------------------------------------------
@@ -429,7 +485,10 @@ TRAIN_CONFIGS = {
     "mnist_mlp": bench_mnist_mlp,
     "resnet50": bench_resnet50,
     "vgg16": bench_vgg16,
+    "alexnet": bench_alexnet,
+    "googlenet": bench_googlenet,
     "lstm": bench_lstm,
+    "lstm_big": bench_lstm_big,
     "transformer": bench_transformer,
     "transformer_long": bench_transformer_long,
     "bert": bench_bert,
@@ -472,7 +531,7 @@ def _suite_names():
 
     names = ([f"{n}" for n in TRAIN_CONFIGS]
              + [f"resnet50_infer_{v}" for v in INFER_VARIANTS]
-             + ["gpt_decode"])
+             + ["googlenet_infer", "gpt_decode"])
     only = os.environ.get("BENCH_ONLY")  # comma-list filter (debug/tests)
     if only:
         keep = {s.strip() for s in only.split(",")}
@@ -497,6 +556,10 @@ def _run_one(name: str, peak: float, quick: bool = False, batch_size=None):
         if quick:
             kw["iters"] = 3
         return bench_resnet50_infer(peak, variant=name.rsplit("_", 1)[1], **kw)
+    if name == "googlenet_infer":
+        if quick:
+            kw["iters"] = 3
+        return bench_googlenet_infer(peak, **kw)
     if name == "gpt_decode":
         if quick:
             kw.update(iters=2, new_tokens=16)

@@ -211,6 +211,55 @@ def vgg_fwd_flops(depth: int = 16, image_size: int = 224,
     return f
 
 
+def alexnet_fwd_flops(image_size: int = 224, class_num: int = 1000) -> float:
+    """Per-image forward FLOPs of AlexNet (models/convnets.make_alexnet).
+    ≈1.4 GFLOPs at 224 (2 FLOPs per MAC; the classic ~720M-MAC figure)."""
+    s = (image_size + 2 * 2 - 11) // 4 + 1          # conv1 k11 s4 p2
+    f = _conv_flops(3, 64, 11, s, s)
+    s = (s - 3) // 2 + 1                             # pool 3/2
+    f += _conv_flops(64, 192, 5, s, s)
+    s = (s - 3) // 2 + 1
+    f += _conv_flops(192, 384, 3, s, s)
+    f += _conv_flops(384, 256, 3, s, s)
+    f += _conv_flops(256, 256, 3, s, s)
+    s = (s - 3) // 2 + 1
+    for dims in ((256 * s * s, 4096), (4096, 4096), (4096, class_num)):
+        f += 2.0 * dims[0] * dims[1]
+    return f
+
+
+# GoogLeNet v1 inception parameter table (models/convnets.make_googlenet):
+# (c1, c3r, c3, c5r, c5, proj) per block, grouped by spatial stage.
+_GOOGLENET_STAGES = (
+    ((64, 96, 128, 16, 32, 32), (128, 128, 192, 32, 96, 64)),
+    ((192, 96, 208, 16, 48, 64), (160, 112, 224, 24, 64, 64),
+     (128, 128, 256, 24, 64, 64), (112, 144, 288, 32, 64, 64),
+     (256, 160, 320, 32, 128, 128)),
+    ((256, 160, 320, 32, 128, 128), (384, 192, 384, 48, 128, 128)),
+)
+
+
+def googlenet_fwd_flops(image_size: int = 224, class_num: int = 1000) -> float:
+    """Per-image forward FLOPs of GoogLeNet v1. ≈3 GFLOPs at 224."""
+    s = image_size // 2                              # stem conv7 s2
+    f = _conv_flops(3, 64, 7, s, s)
+    s = (s + 2 - 3) // 2 + 1                         # pool 3/2 p1
+    f += _conv_flops(64, 64, 1, s, s)
+    f += _conv_flops(64, 192, 3, s, s)
+    s = (s + 2 - 3) // 2 + 1
+    cin = 192
+    for stage in _GOOGLENET_STAGES:
+        for (c1, c3r, c3, c5r, c5, proj) in stage:
+            f += _conv_flops(cin, c1, 1, s, s)
+            f += _conv_flops(cin, c3r, 1, s, s) + _conv_flops(c3r, c3, 3, s, s)
+            f += _conv_flops(cin, c5r, 1, s, s) + _conv_flops(c5r, c5, 5, s, s)
+            f += _conv_flops(cin, proj, 1, s, s)
+            cin = c1 + c3 + c5 + proj
+        s = (s + 2 - 3) // 2 + 1                     # inter-stage pool 3/2 p1
+    f += 2.0 * cin * class_num
+    return f
+
+
 def convnet_train_flops(fwd_flops_per_image: float, bs: int) -> float:
     """Train = fwd + bwd ≈ 3× fwd (bwd does ~2× fwd work)."""
     return 3.0 * fwd_flops_per_image * bs

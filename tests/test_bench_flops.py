@@ -33,6 +33,15 @@ def test_resnet_depths_monotonic():
     assert flops.resnet_fwd_flops(152) > flops.resnet_fwd_flops(101)
 
 
+def test_alexnet_googlenet_fwd_flops_match_known_counts():
+    # AlexNet: ~714 MMACs @ 224 → ~1.43 GFLOPs
+    f = flops.alexnet_fwd_flops(224)
+    assert abs(f - 1.43e9) / 1.43e9 < 0.05
+    # GoogLeNet v1: ~1.6 GMACs @ 224 → ~3.1 GFLOPs
+    g = flops.googlenet_fwd_flops(224)
+    assert abs(g - 3.1e9) / 3.1e9 < 0.05
+
+
 def test_transformer_flops_scaling():
     from paddle_tpu.models.transformer import base_config
 
