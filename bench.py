@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import functools
 import json
 import time
 
@@ -186,6 +187,18 @@ def bench_googlenet(peak, batch_size=64, iters=20):
     return _bench_convnet(peak, convnets.make_googlenet(),
                           flops.googlenet_fwd_flops(), batch_size,
                           "googlenet", iters=iters)
+
+
+def bench_se_resnext(peak, batch_size=32, image_size=224, iters=15):
+    """SE-ResNeXt-50 (benchmark/fluid/models/se_resnext.py is in the
+    reference's benchmark model matrix; no published number)."""
+    from paddle_tpu.core import flops
+    from paddle_tpu.models import convnets
+
+    return _bench_convnet(peak, convnets.make_se_resnext(depth=50),
+                          flops.se_resnext_fwd_flops(50, image_size),
+                          batch_size, "se_resnext", image_size=image_size,
+                          iters=iters)
 
 
 def _bench_transformer_config(peak, batch_size, seq, dtype, dropout,
@@ -487,6 +500,7 @@ TRAIN_CONFIGS = {
     "vgg16": bench_vgg16,
     "alexnet": bench_alexnet,
     "googlenet": bench_googlenet,
+    "se_resnext": bench_se_resnext,
     "lstm": bench_lstm,
     "lstm_big": bench_lstm_big,
     "transformer": bench_transformer,
@@ -498,6 +512,12 @@ TRAIN_CONFIGS = {
 }
 
 INFER_VARIANTS = ("fp32", "bf16", "int8")
+
+INFER_CONFIGS = {
+    **{f"resnet50_infer_{v}": functools.partial(bench_resnet50_infer, variant=v)
+       for v in INFER_VARIANTS},
+    "googlenet_infer": bench_googlenet_infer,
+}
 
 
 class _ConfigTimeout(Exception):
@@ -529,9 +549,7 @@ def _deadline(seconds: int):
 def _suite_names():
     import os
 
-    names = ([f"{n}" for n in TRAIN_CONFIGS]
-             + [f"resnet50_infer_{v}" for v in INFER_VARIANTS]
-             + ["googlenet_infer", "gpt_decode"])
+    names = [*TRAIN_CONFIGS, *INFER_CONFIGS, "gpt_decode"]
     only = os.environ.get("BENCH_ONLY")  # comma-list filter (debug/tests)
     if only:
         keep = {s.strip() for s in only.split(",")}
@@ -552,14 +570,10 @@ def _run_one(name: str, peak: float, quick: bool = False, batch_size=None):
         if quick:
             kw["iters"] = 3
         return TRAIN_CONFIGS[name](peak, **kw)
-    if name.startswith("resnet50_infer_"):
+    if name in INFER_CONFIGS:
         if quick:
             kw["iters"] = 3
-        return bench_resnet50_infer(peak, variant=name.rsplit("_", 1)[1], **kw)
-    if name == "googlenet_infer":
-        if quick:
-            kw["iters"] = 3
-        return bench_googlenet_infer(peak, **kw)
+        return INFER_CONFIGS[name](peak, **kw)
     if name == "gpt_decode":
         if quick:
             kw.update(iters=2, new_tokens=16)

@@ -260,6 +260,37 @@ def googlenet_fwd_flops(image_size: int = 224, class_num: int = 1000) -> float:
     return f
 
 
+def se_resnext_fwd_flops(depth: int = 50, image_size: int = 224,
+                         class_num: int = 1000, cardinality: int = 32,
+                         reduction: int = 16) -> float:
+    """Per-image forward FLOPs of SE-ResNeXt-50/101
+    (models/convnets.make_se_resnext): grouped 3×3 divides that conv's
+    FLOPs by cardinality-groups; SE adds two tiny FCs per block.
+    ≈8.4 GFLOPs for 50/224."""
+    stages = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3)}[depth]
+    s = image_size // 2                       # stem conv7 s2
+    f = _conv_flops(3, 64, 7, s, s)
+    s = (s + 2 - 3) // 2 + 1                  # maxpool 3/2 p1
+    cin = 64
+    for stage, n in enumerate(stages):
+        filters = 128 * (2 ** stage)
+        cout = filters * 2
+        for b in range(n):
+            st = 2 if stage > 0 and b == 0 else 1
+            so = s // st
+            f += _conv_flops(cin, filters, 1, s, s)
+            # grouped conv: in-channels per group × total out-channels
+            f += _conv_flops(filters // cardinality, filters, 3, so, so)
+            f += _conv_flops(filters, cout, 1, so, so)
+            se_mid = max(cout // reduction, 4)
+            f += 2.0 * (cout * se_mid + se_mid * cout)          # SE FCs
+            if cin != cout or st != 1:
+                f += _conv_flops(cin, cout, 1, so, so)          # projection
+            cin, s = cout, so
+    f += 2.0 * cin * class_num
+    return f
+
+
 def convnet_train_flops(fwd_flops_per_image: float, bs: int) -> float:
     """Train = fwd + bwd ≈ 3× fwd (bwd does ~2× fwd work)."""
     return 3.0 * fwd_flops_per_image * bs

@@ -42,6 +42,13 @@ def test_alexnet_googlenet_fwd_flops_match_known_counts():
     assert abs(g - 3.1e9) / 3.1e9 < 0.05
 
 
+def test_se_resnext_fwd_flops_matches_known_count():
+    # SE-ResNeXt-50 32x4d: ~4.25 GMACs @ 224 → ~8.5 GFLOPs
+    f = flops.se_resnext_fwd_flops(50, 224)
+    assert abs(f - 8.5e9) / 8.5e9 < 0.05
+    assert flops.se_resnext_fwd_flops(101) > f
+
+
 def test_transformer_flops_scaling():
     from paddle_tpu.models.transformer import base_config
 
