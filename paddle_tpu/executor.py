@@ -242,9 +242,10 @@ class Trainer:
         # trace-time switch; zoo models wrap their repeated blocks in
         # maybe_remat, so jax.checkpoint lands per block
         pp_m = getattr(self.strategy, "pp_microbatches", 0) if self.strategy else 0
+        pp_v = getattr(self.strategy, "pp_interleave", 1) if self.strategy else 1
         pp_on, pp_ctx = self._ambient_mode(
             f"DistStrategy.pp_microbatches={pp_m}", pp_m > 0, "pp",
-            lambda: pipeline_mode(self.mesh, pp_m))
+            lambda: pipeline_mode(self.mesh, pp_m, interleave=pp_v))
         sp_on, sp_ctx = self._ambient_mode(
             "DistStrategy.sequence_parallel",
             bool(getattr(self.strategy, "sequence_parallel", False)), "sp",

@@ -481,16 +481,18 @@ _pipeline_mode = threading.local()
 
 
 @contextlib.contextmanager
-def pipeline_mode(mesh, microbatches: int, axis: str = "pp"):
+def pipeline_mode(mesh, microbatches: int, axis: str = "pp",
+                  interleave: int = 1):
     """Ambient pipeline-parallel switch (trace-time, like
     :func:`remat_mode`). Trainer enters this around ``program.apply``
     when ``DistStrategy.pp_microbatches`` is set and the mesh has a
     ``pp`` axis; zoo models route their stacked block stacks through
     ``layers.stacked.apply_stacked``, which consumes it and runs
-    ``parallel.pipeline.pipeline_apply`` instead of a sequential scan."""
+    ``parallel.pipeline.pipeline_apply`` instead of a sequential scan.
+    ``interleave`` selects the Megatron virtual-stage schedule (>1)."""
     old = getattr(_pipeline_mode, "cfg", None)
     cfg = {"mesh": mesh, "microbatches": int(microbatches), "axis": axis,
-           "consumed": False}
+           "interleave": max(1, int(interleave)), "consumed": False}
     _pipeline_mode.cfg = cfg
     try:
         yield cfg

@@ -361,5 +361,6 @@ def apply_stacked(x, stacked: Dict[str, jax.Array], make_block: Callable,
     return pipeline_apply(
         x, stacked, layer_fn, mesh, axis_name=cfg["axis"],
         microbatches=cfg["microbatches"],
+        interleave=cfg.get("interleave", 1),
         param_specs=stack_tp_specs(stacked) if tp else None,
         extras=extras)

@@ -3,13 +3,35 @@
 Gap-fill component (SURVEY §2.2: PP is absent in the reference).
 TPU-native design: for repeated-structure models (transformer blocks),
 per-layer parameters are STACKED on a leading [num_layers, ...] axis and
-sharded over ``pp`` — each rank owns a contiguous span of layers. A
-GPipe-style schedule runs M microbatches through the ranks inside one
-``shard_map``: each tick, every rank applies its local layers to the
-activation it holds, then ``ppermute``s the result to the next rank
-(neighbor ICI hop). The loop runs M + P - 1 ticks (the pipeline bubble);
-activations enter at rank 0 and exit at rank P-1, which all-gathers the
-finished microbatches.
+sharded over ``pp``. A schedule runs M microbatches through the ranks
+inside one ``shard_map``: each tick, every rank applies its local
+layer-chunk to the activation it holds, then ``ppermute``s the result to
+the next rank (neighbor ICI hop). Activations enter at rank 0 and exit
+at rank P-1, which all-gathers the finished microbatches.
+
+Two schedules, selected by ``interleave`` (= V, virtual stages/rank):
+
+- V=1 (GPipe): rank r owns one contiguous span of L/P layers; the loop
+  runs M + P - 1 ticks, of which P-1 are fill/drain bubble.
+- V>1 (Megatron interleaved / virtual pipeline): rank r owns V
+  NON-adjacent chunks of L/(P·V) layers (global chunk q lives on rank
+  q mod P), and chunk q of microbatch j runs at tick
+  (j÷P)·VP + (q÷P)·P + (q mod P) + (j mod P). Under this assignment
+  every activation produced at tick t is consumed at tick t+1 by the
+  next ring rank, so the PER-TICK communication structure is identical
+  to GPipe (one ppermute per tick, single holding buffer); the loop
+  runs M·V + P - 1 ticks of 1/V the work each, shrinking the bubble
+  time by V× (see ``bubble_fraction`` for the exact P ∤ M case) at two
+  costs: V× more (pipelined, neighbor-hop) activation traffic, and —
+  because the Trainer stores stacked params contiguously pp-sharded —
+  a once-per-step re-layout of (V-1)/V of the stacked parameter bytes
+  into the chunk-interleaved order (an all-to-all over pp; gradients
+  take the inverse path in backward). Storing params chunk-interleaved
+  at startup (the Megatron layout) would remove that re-layout and is
+  the known follow-up. This is the schedule half of 1F1B: the memory
+  half (depth-bounded live activations) is expressed through
+  per-microbatch rematerialization (``DistStrategy.remat``) instead,
+  because reverse-mode over the scan already frees what remat drops.
 
 Composable with dp/tp: batch stays sharded on dp; stacked layer params
 can additionally shard their weight dims on tp.
@@ -33,24 +55,37 @@ def stack_layer_params(per_layer_params: list) -> Any:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer_params)
 
 
+def _schedule_ticks(m: int, p: int, v: int) -> int:
+    """Total ticks: the last microbatch's last chunk runs at
+    ((m-1)÷p)·vp + (v-1)·p + (p-1) + ((m-1) mod p); +1 for the count.
+    Reduces to m + p - 1 when v=1 or p | m: m·v + p - 1."""
+    return ((m - 1) // p) * v * p + (v - 1) * p + (p - 1) + ((m - 1) % p) + 1
+
+
 def _pp_body(x, stacked, extras, layer_fn, axis_name: str, microbatches: int,
-             layers_per_stage: int, varying_axes: Tuple[str, ...]):
+             interleave: int, varying_axes: Tuple[str, ...]):
     """Per-rank body. x: local microbatch stack [M, ...mb shape...] on
     rank 0's slot (all ranks receive the same x spec; only rank 0's
-    content is used). stacked: this rank's [layers_per_stage, ...] params.
-    extras: pytree of [M, ...] per-microbatch side inputs (masks, encoder
-    outputs) — at tick t rank r works on microbatch t-r, so each rank
-    indexes the extras it needs directly rather than forwarding them."""
+    content is used). stacked: this rank's [V, layers_per_chunk, ...]
+    params — chunk c here is GLOBAL chunk c·P + rank. extras: pytree of
+    [M, ...] per-microbatch side inputs (masks, encoder outputs) — each
+    rank indexes the extras for the microbatch it is processing that
+    tick rather than forwarding them."""
     p = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
-    m = microbatches
+    m, v = microbatches, interleave
 
-    def apply_stage(act, extra):
+    def apply_chunk(act, chunk_idx, extra):
+        chunk = jax.tree.map(
+            lambda leaf: jax.lax.dynamic_index_in_dim(leaf, chunk_idx, 0,
+                                                      keepdims=False),
+            stacked)
+
         def one_layer(a, layer_params):
             if extra is None:
                 return layer_fn(a, layer_params), None
             return layer_fn(a, layer_params, extra), None
-        out, _ = jax.lax.scan(one_layer, act, stacked)
+        out, _ = jax.lax.scan(one_layer, act, chunk)
         return out
 
     mb_shape = x.shape[1:]
@@ -58,21 +93,28 @@ def _pp_body(x, stacked, extras, layer_fn, axis_name: str, microbatches: int,
 
     def tick(carry, t):
         holding, outputs = carry
-        # rank 0 ingests microbatch t (if t < m), others use what arrived
-        inject = jnp.where(t < m, t, m - 1)
-        fresh = x[inject]
-        cur = jnp.where(rank == 0, fresh, holding)
-        mb_idx = jnp.clip(t - rank, 0, m - 1)  # microbatch this rank holds
+        # this rank's position in the interleaved schedule at tick t:
+        # u = t - rank counts its chunk-computations; within a group of
+        # P microbatches it cycles chunk c for mb (g·P + u mod P).
+        groups = -(-m // p)
+        u_glob = jnp.clip(t - rank, 0, groups * v * p - 1)
+        g = u_glob // (v * p)
+        u = u_glob % (v * p)
+        c_local = u // p                       # which of this rank's V chunks
+        mb_idx = jnp.clip(g * p + u % p, 0, m - 1)
+        # rank 0 starting a chunk-0 pass ingests a fresh microbatch;
+        # everything else continues from what arrived on the ring
+        fresh = x[mb_idx]
+        cur = jnp.where((rank == 0) & (c_local == 0), fresh, holding)
         extra = (None if extras is None
                  else jax.tree.map(lambda e: e[mb_idx], extras))
-        done = apply_stage(cur, extra)
-        # last rank records finished microbatch (tick t finishes mb t-p+1)
-        out_idx = t - (p - 1)
-        record = (rank == p - 1) & (out_idx >= 0)
+        done = apply_chunk(cur, c_local, extra)
+        # last rank finishing its last chunk completes microbatch mb_idx
+        record = (rank == p - 1) & (c_local == v - 1) & (t - rank >= 0) \
+            & (g * p + u % p < m)
         outputs = jnp.where(
             record,
-            jax.lax.dynamic_update_index_in_dim(
-                outputs, done, jnp.clip(out_idx, 0, m - 1), axis=0),
+            jax.lax.dynamic_update_index_in_dim(outputs, done, mb_idx, axis=0),
             outputs)
         nxt = jax.lax.ppermute(done, axis_name, perm)
         return (nxt, outputs), None
@@ -80,19 +122,24 @@ def _pp_body(x, stacked, extras, layer_fn, axis_name: str, microbatches: int,
     holding0 = pvary(jnp.zeros(mb_shape, x.dtype), varying_axes)
     outputs0 = pvary(jnp.zeros((m,) + mb_shape, x.dtype), varying_axes)
     (_, outputs), _ = jax.lax.scan(tick, (holding0, outputs0),
-                                   jnp.arange(m + p - 1))
+                                   jnp.arange(_schedule_ticks(m, p, v)))
     # broadcast final outputs from last rank to all (so out spec can be
     # replicated over pp)
     outputs = jnp.where(rank == p - 1, outputs, jnp.zeros_like(outputs))
     return jax.lax.psum(outputs, axis_name)
 
 
-def bubble_fraction(pp: int, microbatches: int) -> float:
-    """GPipe bubble: of the M+P-1 schedule ticks, P-1 are fill/drain —
-    every rank executes its stage each tick (SPMD programs cannot skip
-    compute), so the wasted-FLOP fraction is exactly (P-1)/(M+P-1).
-    At pp=4, m=16: 15.8%; m=64: 4.5%. Raise ``microbatches`` to amortize."""
-    return (pp - 1) / (microbatches + pp - 1)
+def bubble_fraction(pp: int, microbatches: int, interleave: int = 1) -> float:
+    """Exact wasted-tick fraction of the schedule: every rank executes
+    its chunk each tick (SPMD programs cannot skip compute), M·V of the
+    ``_schedule_ticks`` are useful per rank, the rest are fill/drain.
+    (P-1)/(M·V+P-1) when P | M or V=1 — pp=4, m=16: 15.8% (V=1) → 4.5%
+    (V=4) — and LARGER when P ∤ M with V>1 (the last group still spans
+    a full V·P-tick window; e.g. pp=2, m=3, V=2: 25%, not 14%). Raise
+    ``microbatches`` (ideally a multiple of pp) or ``interleave`` to
+    amortize; interleave costs V× more neighbor-hop activation traffic."""
+    t = _schedule_ticks(microbatches, pp, interleave)
+    return (t - microbatches * interleave) / t
 
 
 def pipeline_apply(
@@ -105,12 +152,17 @@ def pipeline_apply(
     batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
     param_specs=None,
     extras=None,
+    interleave: int = 1,
 ):
     """Run ``layer_fn`` over stacked layers pipelined across ``axis_name``.
 
     - x: activations [B, ...]; B divisible by ``microbatches``.
     - stacked_params: pytree with leading [L, ...] axis per leaf, L
-      divisible by the pp size; rank k owns layers [k·L/P, (k+1)·L/P).
+      divisible by pp·interleave. interleave=1: rank k owns the
+      contiguous span [k·L/P, (k+1)·L/P) (GPipe). interleave=V>1: the
+      layers split into V·P chunks and rank k owns chunks {c·P+k}
+      (Megatron virtual stages) — bubble shrinks V×, neighbor-hop
+      activation traffic grows V×.
     - layer_fn(activation, layer_params[, extra]) -> activation.
     - param_specs: optional pytree of PartitionSpecs for each leaf's
       NON-layer dims (tensor parallelism inside a stage): e.g.
@@ -153,8 +205,10 @@ def pipeline_apply(
                                  x, stacked_params, extras)
 
     p = mesh.shape[axis_name]
+    v = max(1, int(interleave))
     L = jax.tree.leaves(stacked_params)[0].shape[0]
-    enforce(L % p == 0, f"{L} layers not divisible by pp={p}")
+    enforce(L % (p * v) == 0,
+            f"{L} layers not divisible by pp·interleave={p}·{v}")
     b = x.shape[0]
     enforce(b % microbatches == 0,
             f"batch {b} not divisible by microbatches={microbatches}")
@@ -172,22 +226,33 @@ def pipeline_apply(
     exm = None if extras is None else jax.tree.map(
         lambda e: e.reshape((microbatches, mb) + e.shape[1:]), extras)
 
+    # chunk layout: [L] → [V, P, Lc] → [P, V, Lc] → [P·V, Lc] so that
+    # sharding the leading dim over pp hands rank r its V chunks
+    # {c·P + r} as a contiguous local [V, Lc, ...] block
+    Lc = L // (p * v)
+    chunked = jax.tree.map(
+        lambda leaf: jnp.moveaxis(
+            leaf.reshape((v, p, Lc) + leaf.shape[1:]), 0, 1
+        ).reshape((p * v, Lc) + leaf.shape[1:]),
+        stacked_params)
+
     bspec = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
     bshard = bspec if len(bspec) > 1 else (bspec[0] if bspec else None)
     x_spec = P(None, bshard, *([None] * (x.ndim - 1)))
     ex_spec = None if exm is None else jax.tree.map(
         lambda e: P(None, bshard, *([None] * (e.ndim - 2))), exm)
     if param_specs is None:
-        param_spec = jax.tree.map(lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))),
-                                  stacked_params)
+        param_spec = jax.tree.map(
+            lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), chunked)
     else:
         param_spec = jax.tree.map(
-            lambda leaf, extra: P(axis_name, *(tuple(extra) + (None,) * (leaf.ndim - 1 - len(extra)))),
-            stacked_params, param_specs)
+            lambda leaf, extra: P(axis_name, None,
+                                  *(tuple(extra) + (None,) * (leaf.ndim - 2 - len(extra)))),
+            chunked, param_specs)
 
     body = functools.partial(
         _pp_body, layer_fn=layer_fn, axis_name=axis_name,
-        microbatches=microbatches, layers_per_stage=L // p,
+        microbatches=microbatches, interleave=v,
         varying_axes=tuple(mesh.axis_names))
     # with in-stage tensor parallelism the carried activation is
     # tp-invariant only because layer_fn psums — beyond the static
@@ -196,5 +261,5 @@ def pipeline_apply(
                         in_specs=(x_spec, param_spec, ex_spec),
                         out_specs=x_spec,
                         check_vma=param_specs is None and extras is None)(
-                            xm, stacked_params, exm)
+                            xm, chunked, exm)
     return out.reshape((b,) + x.shape[1:])

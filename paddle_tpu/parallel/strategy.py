@@ -37,6 +37,12 @@ class DistStrategy:
     # has a 'pp' axis). Bubble fraction = (pp-1)/(m+pp-1); see
     # parallel.pipeline.bubble_fraction.
     pp_microbatches: int = 0
+    # virtual pipeline stages per rank (Megatron interleaved schedule):
+    # >1 splits each rank's layer span into this many non-adjacent
+    # chunks, shrinking the bubble by the same factor at the cost of
+    # proportionally more neighbor-hop activation traffic. Layers must
+    # divide by pp·pp_interleave.
+    pp_interleave: int = 1
     # sequence/context parallelism: sp-aware zoo models (models/gpt.py)
     # run their attention over the mesh's 'sp' axis. Mutually exclusive
     # with pp_microbatches on the same stack. sp_impl picks the scheme:

@@ -72,6 +72,82 @@ def test_pipeline_differentiable():
                                    atol=1e-4, rtol=1e-3)
 
 
+def test_interleaved_matches_sequential():
+    """Megatron virtual-stage schedule (interleave=2): same numerics as
+    the sequential scan, bubble ticks halved per bubble_fraction."""
+    mesh = pt.make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    d = 8
+    stacked = _stacked(8, d)  # 8 layers = pp4 × v2 × 1 layer/chunk
+    x = jnp.asarray(np.random.RandomState(1).randn(16, d).astype(np.float32))
+    out = pipeline_apply(x, stacked, _layer_fn, mesh, microbatches=4,
+                         interleave=2, batch_axes=())
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(x, stacked)),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_interleaved_uneven_microbatch_group():
+    """m not divisible by pp: the last group is partial but the schedule
+    still routes every microbatch through every chunk."""
+    mesh = pt.make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    d = 8
+    stacked = _stacked(8, d, seed=11)  # pp2 × v2 × 2 layers/chunk
+    x = jnp.asarray(np.random.RandomState(12).randn(12, d).astype(np.float32))
+    out = pipeline_apply(x, stacked, _layer_fn, mesh, microbatches=3,
+                         interleave=2, batch_axes=())
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(x, stacked)),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_interleaved_differentiable():
+    mesh = pt.make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    d = 4
+    stacked = _stacked(8, d, seed=6)
+
+    x = jnp.asarray(np.random.RandomState(7).randn(8, d).astype(np.float32))
+    g1 = jax.grad(lambda s: jnp.sum(
+        pipeline_apply(x, s, _layer_fn, mesh, microbatches=4, interleave=2,
+                       batch_axes=()) ** 2))(stacked)
+    g2 = jax.grad(lambda s: jnp.sum(_ref(x, s) ** 2))(stacked)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(g1[k]), np.asarray(g2[k]),
+                                   atol=1e-4, rtol=1e-3)
+
+
+def test_interleaved_with_dp_and_extras():
+    mesh = pt.make_mesh({"dp": 2, "pp": 4})
+    d = 8
+    stacked = _stacked(8, d, seed=2)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(8, d).astype(np.float32))
+    bias = jnp.asarray(rng.randn(8, d).astype(np.float32))
+
+    def layer_with_extra(a, p, e):
+        return jnp.tanh(a @ p["w"] + p["b"]) + 0.1 * e
+
+    out = pipeline_apply(x, stacked, layer_with_extra, mesh, microbatches=2,
+                         interleave=2, extras=bias)
+
+    def one(a, lp):
+        return layer_with_extra(a, lp, bias), None
+    ref, _ = jax.lax.scan(one, x, stacked)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_bubble_fraction_interleave():
+    from paddle_tpu.parallel.pipeline import bubble_fraction
+
+    assert bubble_fraction(4, 16) == pytest.approx(3 / 19)
+    assert bubble_fraction(4, 16, interleave=4) == pytest.approx(3 / 67)
+    # layer-count guard
+    mesh = pt.make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    stacked = _stacked(4, 4)
+    x = jnp.zeros((4, 4), jnp.float32)
+    with pytest.raises(Exception, match="divisible by pp"):
+        pipeline_apply(x, stacked, _layer_fn, mesh, microbatches=2,
+                       interleave=2, batch_axes=())
+
+
 def test_pipeline_3d_dp_tp_pp():
     """dp2 × tp2 × pp2 in one pipeline_apply call: Megatron MLP stage
     (w1 column-sharded, w2 row-sharded, psum over tp) pipelined over
