@@ -20,13 +20,17 @@
 //
 // Build: g++ -O2 -std=c++17 -pthread pserver.cc -o pserver_server
 // Run:   pserver_server <port> <lr> <sgd|adagrad> <dc_asgd 0|1> [lambda]
-//        port 0 picks a free port; prints "PORT <n>" on stdout.
+//                       [snapshot_path]
+//        port 0 picks a free port; prints "PORT <n>" on stdout. With a
+//        snapshot_path, state is recovered from it at startup (the
+//        go/pserver/service.go:346 shard-checkpoint capability).
 //
 // Protocol (one request line; binary payloads length-prefixed):
 //   INIT <name> <len>\n<f32 bytes>  -> OK NEW | OK EXISTS  (first writer wins)
 //   PULL <trainer> <name>           -> OK <len>\n<f32 bytes>
 //   PUSH <trainer> <name> <len>\n<f32 bytes>              -> OK <version>
 //   PUSHROWS <trainer> <name> <nrows> <rowdim>\n<i32 ids><f32 vals> -> OK <v>
+//   SAVE                            -> OK | ERR (atomic snapshot to path)
 //   STATUS                          -> OK params=N pushes=M
 //   QUIT                            -> closes the connection
 
@@ -60,8 +64,12 @@ struct Param {
 
 class PServer {
  public:
-  PServer(float lr, Opt opt, bool dc_asgd, float lambda)
-      : lr_(lr), opt_(opt), dc_asgd_(dc_asgd), lambda_(lambda) {}
+  PServer(float lr, Opt opt, bool dc_asgd, float lambda,
+          std::string snapshot_path)
+      : lr_(lr), opt_(opt), dc_asgd_(dc_asgd), lambda_(lambda),
+        snapshot_path_(std::move(snapshot_path)) {
+    Recover();
+  }
 
   std::string Init(const std::string& name, const std::string& bytes) {
     std::lock_guard<std::mutex> g(mu_);
@@ -151,7 +159,94 @@ class PServer {
            " pushes=" + std::to_string(pushes_) + "\n";
   }
 
+  // Checkpoint of params + optimizer accumulators (pserver shard
+  // checkpoint, go/pserver/service.go:346; per-trainer DC-ASGD baks are
+  // staleness references, meaningless across a restart, so not saved).
+  // State is COPIED under the lock and written outside it, so a slow
+  // disk never stalls trainer push/pull traffic; the rename is atomic
+  // and only happens after every write (incl. fclose flush) succeeded,
+  // so a short write (disk full) cannot clobber the previous snapshot.
+  std::string Save() {
+    if (snapshot_path_.empty()) return "ERR no snapshot path configured\n";
+    std::map<std::string, Param> copy;
+    int64_t pushes;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (auto& kv : params_) {
+        Param p;
+        p.value = kv.second.value;
+        p.accum = kv.second.accum;
+        p.version = kv.second.version;
+        copy[kv.first] = std::move(p);  // baks intentionally dropped
+      }
+      pushes = pushes_;
+    }
+    std::string tmp = snapshot_path_ + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (!f) return "ERR cannot open snapshot tmp\n";
+    bool ok = fprintf(f, "%zu %ld\n", copy.size(),
+                      static_cast<long>(pushes)) > 0;
+    for (auto& kv : copy) {
+      if (!ok) break;
+      const Param& p = kv.second;
+      ok = fprintf(f, "%s %zu %zu %ld\n", kv.first.c_str(), p.value.size(),
+                   p.accum.size(), static_cast<long>(p.version)) > 0 &&
+           fwrite(p.value.data(), sizeof(float), p.value.size(), f) ==
+               p.value.size() &&
+           fwrite(p.accum.data(), sizeof(float), p.accum.size(), f) ==
+               p.accum.size() &&
+           fputc('\n', f) != EOF;
+    }
+    ok = (fclose(f) == 0) && ok;
+    if (!ok) {
+      remove(tmp.c_str());
+      return "ERR snapshot write failed\n";
+    }
+    if (rename(tmp.c_str(), snapshot_path_.c_str()) != 0)
+      return "ERR snapshot rename failed\n";
+    return "OK\n";
+  }
+
  private:
+  void Recover() {
+    if (snapshot_path_.empty()) return;
+    FILE* f = fopen(snapshot_path_.c_str(), "rb");
+    if (!f) return;
+    size_t n = 0;
+    long pushes = 0;
+    if (fscanf(f, "%zu %ld\n", &n, &pushes) != 2) {
+      fclose(f);
+      return;
+    }
+    pushes_ = pushes;
+    // cap matches the protocol's 512MB payload bound: a corrupt size
+    // field must not bad_alloc the server out of existence at startup
+    const size_t kMaxLen = (512u << 20) / sizeof(float);
+    for (size_t i = 0; i < n; ++i) {
+      char name[256];
+      size_t vlen, alen;
+      long version;
+      if (fscanf(f, "%255s %zu %zu %ld\n", name, &vlen, &alen, &version) != 4)
+        break;
+      if (vlen > kMaxLen || alen > kMaxLen) break;  // corrupt header
+      Param p;
+      p.value.resize(vlen);
+      p.accum.resize(alen);
+      p.version = version;
+      if (fread(p.value.data(), sizeof(float), vlen, f) != vlen) break;
+      if (alen && fread(p.accum.data(), sizeof(float), alen, f) != alen) break;
+      fgetc(f);  // trailing newline
+      // re-establish the optimizer invariant Init() guarantees: the
+      // snapshot may come from a server run with a different optimizer
+      // (sgd: empty accum) — ApplyOne indexes accum unconditionally
+      // under adagrad, so a size mismatch would be an OOB write
+      if (opt_ == Opt::kAdagrad && p.accum.size() != p.value.size())
+        p.accum.assign(p.value.size(), 0.f);
+      if (opt_ == Opt::kSGD) p.accum.clear();
+      params_[name] = std::move(p);
+    }
+    fclose(f);
+  }
   void ApplyOne(Param* p, size_t i, float g) {
     if (opt_ == Opt::kAdagrad) {
       p->accum[i] += g * g;
@@ -168,6 +263,7 @@ class PServer {
   Opt opt_;
   bool dc_asgd_;
   float lambda_;
+  std::string snapshot_path_;
 };
 
 // -- line-framed socket IO (shared shape with master.cc) ---------------------
@@ -232,6 +328,8 @@ void ServeClient(PServer* ps, int fd) {
       if (!ReadBody(fd, size_t(b) * sizeof(int32_t), &ids)) break;
       if (!ReadBody(fd, size_t(b) * size_t(c) * sizeof(float), &vals)) break;
       resp = ps->PushRows(name, b, c, ids, vals);
+    } else if (line == "SAVE") {
+      resp = ps->Save();
     } else if (line == "STATUS") {
       resp = ps->Status();
     } else if (line == "QUIT") {
@@ -258,7 +356,7 @@ int main(int argc, char** argv) {
   if (argc < 3) {
     fprintf(stderr,
             "usage: pserver_server <port> <lr> [sgd|adagrad] [dc_asgd 0|1] "
-            "[lambda]\n");
+            "[lambda] [snapshot_path]\n");
     return 1;
   }
   int port = atoi(argv[1]);
@@ -267,8 +365,10 @@ int main(int argc, char** argv) {
                                                             : Opt::kSGD;
   bool dc = argc > 4 && atoi(argv[4]) != 0;
   float lambda = argc > 5 ? atof(argv[5]) : 1.0f;
+  std::string snapshot = argc > 6 ? argv[6] : "";
+  if (snapshot == "-") snapshot.clear();
 
-  PServer ps(lr, opt, dc, lambda);
+  PServer ps(lr, opt, dc, lambda, snapshot);
 
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;

@@ -53,13 +53,14 @@ class PServerProcess:
 
     def __init__(self, port: int = 0, lr: float = 0.01,
                  optimizer: str = "sgd", dc_asgd: bool = False,
-                 dc_lambda: float = 1.0):
+                 dc_lambda: float = 1.0, snapshot_path: Optional[str] = None):
         enforce(optimizer in ("sgd", "adagrad"),
                 f"pserver optimizer must be sgd|adagrad, got {optimizer}")
         binpath = _build_server()
         self._proc = subprocess.Popen(
             [binpath, str(port), repr(float(lr)), optimizer,
-             "1" if dc_asgd else "0", repr(float(dc_lambda))],
+             "1" if dc_asgd else "0", repr(float(dc_lambda)),
+             snapshot_path or "-"],
             stdout=subprocess.PIPE, text=True)
         line = self._proc.stdout.readline().strip()
         if not line.startswith("PORT "):
@@ -174,6 +175,12 @@ class PSClient:
             f"{vals.shape[0]} {vals.shape[1]}",
             ids.tobytes() + vals.tobytes())
         return int(resp.split()[1])
+
+    def save(self) -> None:
+        """Trigger an atomic server-side checkpoint of params + optimizer
+        accumulators (shard-checkpoint capability; the server recovers it
+        at startup when launched with the same snapshot_path)."""
+        self._request("SAVE")
 
     def status(self) -> Dict[str, int]:
         resp = self._request("STATUS")

@@ -161,6 +161,63 @@ def test_two_trainer_async_converges():
         assert stats["pushes"] == 2 * 15 * stats["params"]
 
 
+def test_snapshot_recover_across_restart(tmp_path):
+    """Pserver shard checkpoint (go/pserver/service.go:346 analog): SAVE
+    writes params + optimizer accumulators atomically; a restarted
+    server with the same snapshot path recovers them — including the
+    Adagrad accumulator, so post-restart updates continue the same
+    optimizer trajectory instead of restarting it."""
+    snap = str(tmp_path / "ps.snap")
+    w0 = np.array([2.0, -1.0, 4.0], np.float32)
+    g = np.array([1.0, 2.0, 0.5], np.float32)
+    with PServerProcess(lr=0.5, optimizer="adagrad", snapshot_path=snap) as srv:
+        c = PSClient(srv.addr)
+        c.init_param("w", w0)
+        c.push("w", g)
+        w_after = c.pull("w", (3,))
+        c.save()
+        c.close()
+    with PServerProcess(lr=0.5, optimizer="adagrad", snapshot_path=snap) as srv2:
+        c2 = PSClient(srv2.addr)
+        # recovered value, not re-inited: INIT must report EXISTS
+        assert not c2.init_param("w", w0 * 99)
+        np.testing.assert_allclose(c2.pull("w", (3,)), w_after, rtol=1e-6)
+        # second identical push: with recovered accum G=g^2, step is
+        # lr*g/(sqrt(2 g^2)+eps) — a fresh accumulator would give the
+        # larger lr*g/(sqrt(g^2)+eps) step
+        c2.push("w", g)
+        want = w_after - 0.5 * g / (np.sqrt(2 * g * g) + 1e-6)
+        np.testing.assert_allclose(c2.pull("w", (3,)), want, rtol=1e-5)
+        c2.close()
+
+
+def test_snapshot_recovered_under_different_optimizer(tmp_path):
+    """An sgd-era snapshot (empty accumulators) recovered by an adagrad
+    server must re-establish the accumulator invariant instead of
+    indexing an empty vector on the first push."""
+    snap = str(tmp_path / "ps.snap")
+    w0 = np.array([1.0, 2.0], np.float32)
+    with PServerProcess(lr=0.1, optimizer="sgd", snapshot_path=snap) as srv:
+        c = PSClient(srv.addr)
+        c.init_param("w", w0)
+        c.save()
+        c.close()
+    with PServerProcess(lr=0.5, optimizer="adagrad", snapshot_path=snap) as srv2:
+        c2 = PSClient(srv2.addr)
+        g = np.array([1.0, 2.0], np.float32)
+        c2.push("w", g)  # must not crash; fresh accum G=g^2
+        want = w0 - 0.5 * g / (np.abs(g) + 1e-6)
+        np.testing.assert_allclose(c2.pull("w", (2,)), want, rtol=1e-5)
+        c2.close()
+
+
+def test_save_without_snapshot_path_errors(sgd_server):
+    c = PSClient(sgd_server.addr)
+    with pytest.raises(RuntimeError, match="no snapshot path"):
+        c.save()
+    c.close()
+
+
 def test_param_name_guard():
     """Names the server's %255s parser would truncate (len>255 or
     whitespace) are rejected client-side — a truncated name would desync
