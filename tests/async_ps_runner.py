@@ -1,0 +1,52 @@
+"""Runnable async-PS trainer script, launched as subprocesses by
+test_async_ps.py::test_multiprocess_async_trainers — genuinely
+concurrent barrier-free trainers hammering one C++ pserver (the
+listen_and_serv RunAsyncLoop deployment shape: N trainer processes,
+no synchronization between them).
+
+    python async_ps_runner.py <trainer_id> <ps_port> <steps>
+
+Prints `LOSS <step> <value>` per step and `DONE` at the end.
+"""
+
+import os
+import sys
+
+pid, port, steps = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models import mnist
+from paddle_tpu.parallel import AsyncPSTrainer
+
+
+def batch(rng, n=64):
+    """Learnable synthetic task shared by every trainer: the label is a
+    deterministic function of the image, so stale-gradient training must
+    still reduce loss."""
+    img = rng.randn(n, 784).astype(np.float32)
+    lbl = img[:, :780].reshape(n, 10, 78)[:, :, :4].sum(-1).argmax(1)
+    return {"image": img, "label": lbl.reshape(n, 1).astype(np.int64)}
+
+
+def main():
+    rng = np.random.RandomState(100 + pid)  # each trainer: its own shard
+    feeds = [batch(rng) for _ in range(2)]
+    prog = pt.build(mnist.mlp)
+    t = AsyncPSTrainer(prog, ("127.0.0.1", port), trainer_id=pid,
+                       pull_interval=2, fetch_list=["loss"])
+    t.startup(sample_feed=feeds[0])
+    for s in range(steps):
+        out = t.step(feeds[s % 2])
+        print(f"LOSS {s} {float(out['loss']):.6f}", flush=True)
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()
