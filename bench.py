@@ -697,6 +697,9 @@ def main():
                    help="hard per-config wall-clock limit in suite mode")
     p.add_argument("--emit", default="pretty", choices=["pretty", "raw"],
                    help="raw: suite-internal single-config JSON envelope")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="single --model only: dump a jax.profiler trace "
+                        "(xplane/perfetto) of the run into DIR")
     args = p.parse_args()
 
     if args.model in (None, "suite"):
@@ -714,8 +717,10 @@ def main():
     set_flag("default_compute_dtype", args.compute_dtype)
     dev = jax.devices()[0]
     peak, peak_source = flops.device_peak_flops(dev)
+    prof = (jax.profiler.trace(args.profile) if args.profile
+            else contextlib.nullcontext())
     try:
-        with _deadline(args.config_timeout):
+        with _deadline(args.config_timeout), prof:
             res = _run_one(args.model, peak, quick=args.quick,
                            batch_size=args.batch_size)
     except Exception as e:  # the suite parent records the reason

@@ -202,17 +202,22 @@ class Master {
     if (!f) return;
     size_t n = 0;
     long next_id = 0;
-    if (fscanf(f, "%d %ld %zu\n", &pass_, &next_id, &n) != 3) {
+    if (fscanf(f, "%d %ld %zu", &pass_, &next_id, &n) != 3) {
       fclose(f);
       return;
     }
+    fgetc(f);  // exactly the header newline
     next_id_ = next_id;
     for (size_t i = 0; i < n; ++i) {
       long id;
       int failures, state;
       size_t len;
-      if (fscanf(f, "%ld %d %d %zu\n", &id, &failures, &state, &len) != 4)
+      // no trailing '\n' in the format: scanf's '\n' matches a RUN of
+      // whitespace and would swallow leading payload bytes that happen
+      // to be 0x09-0x0D/0x20, misaligning every later record
+      if (fscanf(f, "%ld %d %d %zu", &id, &failures, &state, &len) != 4)
         break;
+      fgetc(f);  // exactly the header newline; payload starts next byte
       Task t;
       t.id = id;
       t.failures = failures;

@@ -181,6 +181,10 @@ class PServer {
       }
       pushes = pushes_;
     }
+    // serialize concurrent SAVEs: each connection thread calls this, and
+    // two writers sharing one tmp path would interleave into a mangled
+    // file that the rename then installs as "good"
+    std::lock_guard<std::mutex> sg(save_mu_);
     std::string tmp = snapshot_path_ + ".tmp";
     FILE* f = fopen(tmp.c_str(), "wb");
     if (!f) return "ERR cannot open snapshot tmp\n";
@@ -214,28 +218,43 @@ class PServer {
     if (!f) return;
     size_t n = 0;
     long pushes = 0;
-    if (fscanf(f, "%zu %ld\n", &n, &pushes) != 2) {
+    if (fscanf(f, "%zu %ld", &n, &pushes) != 2) {
       fclose(f);
+      fprintf(stderr, "pserver: snapshot header unreadable, starting fresh\n");
       return;
     }
-    pushes_ = pushes;
+    fgetc(f);  // exactly the header newline
     // cap matches the protocol's 512MB payload bound: a corrupt size
     // field must not bad_alloc the server out of existence at startup
     const size_t kMaxLen = (512u << 20) / sizeof(float);
+    // parse into a staging map: recovery is all-or-nothing, matching the
+    // writer's atomicity contract — a half-loaded state (some params
+    // recovered, pushes_ restored) would silently diverge
+    std::unordered_map<std::string, Param> staged;
+    bool complete = true;
     for (size_t i = 0; i < n; ++i) {
       char name[256];
       size_t vlen, alen;
       long version;
-      if (fscanf(f, "%255s %zu %zu %ld\n", name, &vlen, &alen, &version) != 4)
+      // NOTE no trailing '\n' in the format: scanf's '\n' matches a RUN
+      // of whitespace and would swallow leading payload bytes that
+      // happen to be 0x09-0x0D/0x20, misaligning every later record
+      if (fscanf(f, "%255s %zu %zu %ld", name, &vlen, &alen, &version) != 4 ||
+          vlen > kMaxLen || alen > kMaxLen) {
+        complete = false;
         break;
-      if (vlen > kMaxLen || alen > kMaxLen) break;  // corrupt header
+      }
+      fgetc(f);  // exactly the header newline; payload starts next byte
       Param p;
       p.value.resize(vlen);
       p.accum.resize(alen);
       p.version = version;
-      if (fread(p.value.data(), sizeof(float), vlen, f) != vlen) break;
-      if (alen && fread(p.accum.data(), sizeof(float), alen, f) != alen) break;
-      fgetc(f);  // trailing newline
+      if (fread(p.value.data(), sizeof(float), vlen, f) != vlen ||
+          (alen && fread(p.accum.data(), sizeof(float), alen, f) != alen)) {
+        complete = false;
+        break;
+      }
+      fgetc(f);  // trailing newline after the payload
       // re-establish the optimizer invariant Init() guarantees: the
       // snapshot may come from a server run with a different optimizer
       // (sgd: empty accum) — ApplyOne indexes accum unconditionally
@@ -243,9 +262,17 @@ class PServer {
       if (opt_ == Opt::kAdagrad && p.accum.size() != p.value.size())
         p.accum.assign(p.value.size(), 0.f);
       if (opt_ == Opt::kSGD) p.accum.clear();
-      params_[name] = std::move(p);
+      staged[name] = std::move(p);
     }
     fclose(f);
+    if (!complete) {
+      fprintf(stderr,
+              "pserver: snapshot truncated/corrupt (%zu of %zu params "
+              "readable), starting fresh\n", staged.size(), n);
+      return;
+    }
+    params_ = std::move(staged);
+    pushes_ = pushes;
   }
   void ApplyOne(Param* p, size_t i, float g) {
     if (opt_ == Opt::kAdagrad) {
@@ -257,6 +284,7 @@ class PServer {
   }
 
   std::mutex mu_;
+  std::mutex save_mu_;
   std::unordered_map<std::string, Param> params_;
   int64_t pushes_ = 0;
   float lr_;

@@ -191,6 +191,48 @@ def test_snapshot_recover_across_restart(tmp_path):
         c2.close()
 
 
+def test_snapshot_roundtrips_whitespace_leading_payload(tmp_path):
+    """Regression: a param whose first payload byte is whitespace-class
+    (0x09-0x0D/0x20) must survive save/recover byte-exact — a trailing
+    '\\n' in the reader's scanf format would swallow it and misalign
+    every later record."""
+    snap = str(tmp_path / "ps.snap")
+    # float32 values whose little-endian first byte is \n, \t, and space
+    tricky = np.frombuffer(
+        b"\x0a\x00\x00\x41" b"\x09\x00\x80\x40" b"\x20\x00\x00\x3f",
+        dtype="<f4").copy()
+    other = np.arange(6, dtype=np.float32).reshape(2, 3)
+    with PServerProcess(lr=0.1, optimizer="sgd", snapshot_path=snap) as srv:
+        c = PSClient(srv.addr)
+        c.init_param("a_tricky", tricky)
+        c.init_param("b_other", other)
+        c.save()
+        c.close()
+    with PServerProcess(lr=0.1, optimizer="sgd", snapshot_path=snap) as srv2:
+        c2 = PSClient(srv2.addr)
+        np.testing.assert_array_equal(c2.pull("a_tricky", (3,)), tricky)
+        np.testing.assert_array_equal(c2.pull("b_other", (2, 3)), other)
+        c2.close()
+
+
+def test_corrupt_snapshot_starts_fresh(tmp_path):
+    """All-or-nothing recovery: a truncated snapshot is discarded whole
+    (the server boots empty) rather than half-loaded."""
+    snap = str(tmp_path / "ps.snap")
+    with PServerProcess(lr=0.1, optimizer="sgd", snapshot_path=snap) as srv:
+        c = PSClient(srv.addr)
+        c.init_param("w", np.ones(64, np.float32))
+        c.init_param("v", np.ones(64, np.float32))
+        c.save()
+        c.close()
+    data = open(snap, "rb").read()
+    open(snap, "wb").write(data[:len(data) - 40])  # truncate mid-payload
+    with PServerProcess(lr=0.1, optimizer="sgd", snapshot_path=snap) as srv2:
+        c2 = PSClient(srv2.addr)
+        assert c2.status()["params"] == 0  # fresh, not half-recovered
+        c2.close()
+
+
 def test_snapshot_recovered_under_different_optimizer(tmp_path):
     """An sgd-era snapshot (empty accumulators) recovered by an adagrad
     server must re-establish the accumulator invariant instead of
@@ -227,6 +269,45 @@ def test_param_name_guard():
     with pytest.raises(Exception, match="1-255 chars"):
         PSClient._check_name("a b")
     assert PSClient._check_name("layers/fc_0/w") == "layers/fc_0/w"
+
+
+@pytest.mark.slow
+def test_multiprocess_async_trainers():
+    """The real deployment shape: 2 trainer PROCESSES push concurrently
+    into one pserver with no barriers (exercising the server's
+    per-connection threads under true concurrency). Both trainers'
+    losses must drop despite stale gradients, and the push count must
+    account for every step of both."""
+    import os
+    import re
+    import subprocess
+    import sys
+
+    here = os.path.dirname(__file__)
+    steps = 12
+    with PServerProcess(lr=0.1, optimizer="sgd") as srv:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (os.path.dirname(here) + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.join(here, "async_ps_runner.py"),
+             str(i), str(srv.port), str(steps)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            text=True) for i in range(2)]
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, f"trainer failed:\n{err[-3000:]}"
+            assert "DONE" in out
+            outs.append(out)
+        stats = PSClient(srv.addr).status()
+    for out in outs:
+        losses = {int(m.group(1)): float(m.group(2))
+                  for m in re.finditer(r"LOSS (\d+) ([\d.]+)", out)}
+        assert len(losses) == steps
+        assert losses[steps - 1] < losses[0] * 0.6, losses
+    # every step of both trainers pushed one grad per param leaf
+    assert stats["pushes"] == 2 * steps * stats["params"]
 
 
 def test_transpiler_async_mode_surface():
