@@ -706,6 +706,9 @@ def main():
         if args.batch_size:
             p.error("--batch_size applies to a single --model config, "
                     "not the full suite")
+        if args.profile:
+            p.error("--profile applies to a single --model config, "
+                    "not the full suite")
         print(json.dumps(run_suite(args.compute_dtype, quick=args.quick,
                                    config_timeout=args.config_timeout)))
         return

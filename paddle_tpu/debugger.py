@@ -236,7 +236,14 @@ def collective_report(trainer, feed) -> Dict[str, Any]:
     bytes using ring formulas (all-reduce 2·S·(n-1)/n; all-gather /
     reduce-scatter / all-to-all S·(n-1)/n; collective-permute S), with n
     the replica-group size. Numbers are for the current scope + feed
-    shapes on the trainer's mesh."""
+    shapes on the trainer's mesh.
+
+    Known limitation: the walk is static, so a collective inside a
+    while/scan BODY (e.g. the pipeline schedule's per-tick ppermute, or
+    ring attention's per-step exchange) is counted once, not multiplied
+    by the trip count — for those, multiply by the schedule length
+    (``parallel.pipeline._schedule_ticks`` / the sp ring size) when
+    budgeting wire bytes."""
     hlo = _lower_step(trainer, feed).compile().as_text()
     n_dev = (trainer.mesh.devices.size if trainer.mesh is not None
              else jax.device_count())

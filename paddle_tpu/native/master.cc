@@ -207,7 +207,12 @@ class Master {
       return;
     }
     fgetc(f);  // exactly the header newline
-    next_id_ = next_id;
+    // staged all-or-nothing parse (matches pserver.cc Recover): a
+    // truncated/corrupt snapshot must not leave a silently partial task
+    // set, and a corrupt len field must not bad_alloc the master away
+    const size_t kMaxLen = 100u << 20;  // matches the ADD payload cap
+    std::map<int64_t, Task> staged;
+    bool complete = true;
     for (size_t i = 0; i < n; ++i) {
       long id;
       int failures, state;
@@ -215,22 +220,39 @@ class Master {
       // no trailing '\n' in the format: scanf's '\n' matches a RUN of
       // whitespace and would swallow leading payload bytes that happen
       // to be 0x09-0x0D/0x20, misaligning every later record
-      if (fscanf(f, "%ld %d %d %zu", &id, &failures, &state, &len) != 4)
+      if (fscanf(f, "%ld %d %d %zu", &id, &failures, &state, &len) != 4 ||
+          len > kMaxLen) {
+        complete = false;
         break;
+      }
       fgetc(f);  // exactly the header newline; payload starts next byte
       Task t;
       t.id = id;
       t.failures = failures;
       t.state = static_cast<TaskState>(state);
       t.payload.resize(len);
-      if (fread(&t.payload[0], 1, len, f) != len) break;
+      if (len && fread(&t.payload[0], 1, len, f) != len) {
+        complete = false;
+        break;
+      }
       fgetc(f);  // trailing newline
       // leases do not survive a master restart: requeue them
       if (t.state == TaskState::kLeased) t.state = TaskState::kTodo;
-      if (t.state == TaskState::kTodo) todo_.push_back(t.id);
-      tasks_[t.id] = std::move(t);
+      staged[t.id] = std::move(t);
     }
     fclose(f);
+    if (!complete) {
+      fprintf(stderr,
+              "master: snapshot truncated/corrupt (%zu of %zu tasks "
+              "readable), starting fresh\n", staged.size(), n);
+      pass_ = 0;
+      next_id_ = 0;
+      return;
+    }
+    next_id_ = next_id;
+    for (auto& kv : staged)
+      if (kv.second.state == TaskState::kTodo) todo_.push_back(kv.first);
+    tasks_ = std::move(staged);
   }
 
   std::mutex mu_;

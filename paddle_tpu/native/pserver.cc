@@ -168,6 +168,11 @@ class PServer {
   // so a short write (disk full) cannot clobber the previous snapshot.
   std::string Save() {
     if (snapshot_path_.empty()) return "ERR no snapshot path configured\n";
+    // serialize concurrent SAVEs BEFORE copying: if the copy happened
+    // outside save_mu_, a later-copied (newer) snapshot could be
+    // renamed first and then overwritten by an earlier stale copy — an
+    // OK'd save would silently lose acknowledged durability
+    std::lock_guard<std::mutex> sg(save_mu_);
     std::map<std::string, Param> copy;
     int64_t pushes;
     {
@@ -181,10 +186,6 @@ class PServer {
       }
       pushes = pushes_;
     }
-    // serialize concurrent SAVEs: each connection thread calls this, and
-    // two writers sharing one tmp path would interleave into a mangled
-    // file that the rename then installs as "good"
-    std::lock_guard<std::mutex> sg(save_mu_);
     std::string tmp = snapshot_path_ + ".tmp";
     FILE* f = fopen(tmp.c_str(), "wb");
     if (!f) return "ERR cannot open snapshot tmp\n";

@@ -95,6 +95,50 @@ def test_collective_report_dp_sees_grad_allreduce():
     assert rep["mesh"] == {"dp": 8}
 
 
+def test_collective_report_interleave_traffic_tradeoff():
+    """The interleaved pipeline's documented cost is V× more
+    collective-permute traffic: M·V+P-1 ticks of ring hops vs M+P-1.
+    collective_report's static walk counts the in-scan ppermute ONCE
+    (documented limitation), so the evidence is structural: the permute
+    is present in the inventory, and the tick-scan length in the traced
+    program grows exactly per _schedule_ticks."""
+    import re
+
+    from paddle_tpu.parallel import DistStrategy
+    from paddle_tpu.parallel.pipeline import _schedule_ticks
+
+    def _pp_trainer(interleave):
+        cfg = transformer.base_config(src_vocab=64, trg_vocab=64, d_model=32,
+                                      d_inner=64, num_heads=4,
+                                      num_encoder_layers=4,
+                                      num_decoder_layers=4, dropout=0.0,
+                                      stacked=True)
+        prog = pt.build(transformer.make_model(cfg))
+        rng = np.random.RandomState(0)
+        feed = {"src_ids": rng.randint(3, 64, (8, 16)).astype(np.int32),
+                "trg_ids": rng.randint(3, 64, (8, 16)).astype(np.int32),
+                "labels": rng.randint(3, 64, (8, 16)).astype(np.int32)}
+        mesh = pt.make_mesh({"pp": 2}, devices=jax.devices()[:2])
+        tr = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss", mesh=mesh,
+                        sharding_rules=transformer_tp_rules(),
+                        strategy=DistStrategy(pp_microbatches=4,
+                                              pp_interleave=interleave))
+        tr.startup(sample_feed=feed)
+        return tr, feed
+
+    for v in (1, 2):
+        tr, feed = _pp_trainer(v)
+        rep = debugger.collective_report(tr, feed)
+        assert "collective-permute" in rep["collectives"], rep
+        jaxpr = str(jax.make_jaxpr(
+            lambda p, o, s, r, f, ls: tr._loss_and_aux(p, s, r, f))(
+                tr.scope.params, tr.scope.opt_state, tr.scope.state,
+                jax.random.PRNGKey(0), feed, {}))
+        lengths = {int(m.group(1)) for m in re.finditer(r"length=(\d+)", jaxpr)}
+        want = _schedule_ticks(4, 2, v)   # m=4, p=2: 5 ticks at v=1, 9 at v=2
+        assert want in lengths, (v, want, sorted(lengths))
+
+
 def test_collective_report_3d_mesh_shows_sharding_collectives():
     """dp×fsdp×tp: fsdp adds param all-gathers, tp adds activation
     collectives — the report must show more collective KINDS than pure

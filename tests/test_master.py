@@ -90,6 +90,25 @@ def test_snapshot_recover(tmp_path):
     c2.close(); srv2.stop()
 
 
+def test_corrupt_snapshot_starts_fresh(tmp_path):
+    """All-or-nothing recovery (mirrors the pserver): a truncated
+    snapshot is discarded whole — the master boots empty rather than
+    resuming with a silently partial task set."""
+    snap = str(tmp_path / "master.snap")
+    srv = MasterServer(snapshot_path=snap)
+    c = MasterClient(srv.addr)
+    c.set_tasks(["x" * 200, "y" * 200, "z" * 200])
+    c.close()
+    srv.stop()
+    data = open(snap, "rb").read()
+    open(snap, "wb").write(data[:len(data) - 120])  # truncate mid-payload
+
+    srv2 = MasterServer(snapshot_path=snap)
+    c2 = MasterClient(srv2.addr)
+    assert c2.status()["total"] == 0  # fresh, not half-recovered
+    c2.close(); srv2.stop()
+
+
 def test_reset_pass():
     with MasterServer() as srv:
         c = MasterClient(srv.addr)
