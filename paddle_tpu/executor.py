@@ -161,6 +161,11 @@ class Trainer:
         self.place = place or default_place()
         self.mesh = mesh
         self.sharding_rules = sharding_rules
+        enforce(not getattr(strategy, "async_mode", False),
+                "DistStrategy.async_mode (DistributeTranspiler sync_mode="
+                "False) selects barrier-free parameter-server training — "
+                "use parallel.AsyncPSTrainer with a parallel.PServerProcess "
+                "instead of the SPMD Trainer")
         self.strategy = strategy
         self.donate = donate
         # fetch_list prunes the per-step outputs INSIDE jit (executor.py

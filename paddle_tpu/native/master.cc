@@ -204,6 +204,7 @@ class Master {
     long next_id = 0;
     if (fscanf(f, "%d %ld %zu", &pass_, &next_id, &n) != 3) {
       fclose(f);
+      pass_ = 0;  // fscanf may have written a partial header into it
       return;
     }
     fgetc(f);  // exactly the header newline
@@ -240,6 +241,9 @@ class Master {
       if (t.state == TaskState::kLeased) t.state = TaskState::kTodo;
       staged[t.id] = std::move(t);
     }
+    // an undersized header count (corrupted digit) would parse cleanly
+    // and silently drop the tail — the file must be fully consumed
+    if (complete && fgetc(f) != EOF) complete = false;
     fclose(f);
     if (!complete) {
       fprintf(stderr,
