@@ -238,34 +238,6 @@ def bench_transformer_long(peak, batch_size=4, seq=4096, dtype="bfloat16", iters
                                      max_len=seq, iters=iters)
 
 
-def bench_gpt_32k(peak, batch_size=1, seq=32768, dtype="bfloat16", iters=3):
-    """Long-context flagship at seq 32k: decoder-only GPT train step
-    through the streamed-K/V flash kernel + chunked logits-free CE —
-    the single-chip end of the ring/Ulysses sequence-parallel story."""
-    import paddle_tpu as pt
-    from paddle_tpu import optimizer as opt
-    from paddle_tpu.core import flops
-    from paddle_tpu.models import gpt
-
-    cfg = gpt.base_config(vocab_size=32000, max_len=seq, d_model=768,
-                          d_inner=3072, num_heads=12, num_layers=12,
-                          use_flash=True, fused_ce=True, dtype=dtype)
-    model = pt.build(gpt.make_model(cfg))
-    rng = np.random.RandomState(0)
-    feeds = []
-    for _ in range(2):
-        ids = rng.randint(3, cfg.vocab_size, (batch_size, seq)).astype(np.int32)
-        labels = np.concatenate([ids[:, 1:], np.full((batch_size, 1), 2)],
-                                axis=1).astype(np.int32)
-        feeds.append({"ids": ids, "labels": labels})
-    trainer = pt.Trainer(model, opt.AdamW(1e-4, weight_decay=0.01),
-                         loss_name="loss", fetch_list=["loss"])
-    trainer.startup(sample_feed=feeds[0])
-    dt_pipe, dt_comp = _time_trainer(trainer, feeds, warmup=1, iters=iters)
-    f = flops.gpt_train_flops(batch_size, seq, cfg)
-    return _result(batch_size * seq, "tokens/sec", dt_pipe, dt_comp, f, peak)
-
-
 def bench_bert(peak, batch_size=32, seq=128, num_masked=20, dtype="bfloat16",
                iters=20):
     import paddle_tpu as pt
@@ -292,9 +264,12 @@ def bench_bert(peak, batch_size=32, seq=128, num_masked=20, dtype="bfloat16",
     return _result(batch_size * seq, "tokens/sec", dt_pipe, dt_comp, f, peak)
 
 
-def bench_gpt(peak, batch_size=8, seq=1024, dtype="bfloat16", iters=15):
+def bench_gpt(peak, batch_size=8, seq=1024, dtype="bfloat16", iters=15,
+              warmup=3, n_feeds=4):
     """Decoder-only LM (GPT-base shape, ~124M params): the modern
-    long-context flagship — flash attention + chunked logits-free CE."""
+    long-context flagship — flash attention + chunked logits-free CE.
+    The seq-32k variant (gpt_32k) is this config at batch 1 with the
+    streamed-K/V flash kernel doing the heavy lifting."""
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
     from paddle_tpu.core import flops
@@ -306,7 +281,7 @@ def bench_gpt(peak, batch_size=8, seq=1024, dtype="bfloat16", iters=15):
     model = pt.build(gpt.make_model(cfg))
     rng = np.random.RandomState(0)
     feeds = []
-    for _ in range(4):
+    for _ in range(n_feeds):
         ids = rng.randint(3, cfg.vocab_size, (batch_size, seq)).astype(np.int32)
         labels = np.concatenate([ids[:, 1:], np.full((batch_size, 1), 2)],
                                 axis=1).astype(np.int32)
@@ -314,9 +289,16 @@ def bench_gpt(peak, batch_size=8, seq=1024, dtype="bfloat16", iters=15):
     trainer = pt.Trainer(model, opt.AdamW(1e-4, weight_decay=0.01),
                          loss_name="loss", fetch_list=["loss"])
     trainer.startup(sample_feed=feeds[0])
-    dt_pipe, dt_comp = _time_trainer(trainer, feeds, iters=iters)
+    dt_pipe, dt_comp = _time_trainer(trainer, feeds, warmup=warmup,
+                                     iters=iters)
     f = flops.gpt_train_flops(batch_size, seq, cfg)
     return _result(batch_size * seq, "tokens/sec", dt_pipe, dt_comp, f, peak)
+
+
+# seq-32k long-context variant of the GPT config (streamed-K/V flash
+# kernel + chunked CE; ~81 TFLOPs/step analytic)
+bench_gpt_32k = functools.partial(bench_gpt, batch_size=1, seq=32768,
+                                  iters=3, warmup=1, n_feeds=2)
 
 
 def _bench_deepfm_config(peak, batch_size, sparse_feature_dim, iters=20):
@@ -493,7 +475,10 @@ def _bench_infer(peak, make_model_fn, fwd_flops_per_image, baseline_key,
     res = _result(batch_size, "images/sec", dt, dt, f, peak, baseline_key)
     del res["compute_only"], res["mfu_compute_only"]  # serving loop has no pre-staged variant
     res["latency_ms_p50"] = round(float(np.percentile(lat, 50)) * 1e3, 3)
-    res["latency_ms_p99"] = round(float(np.percentile(lat, 99)) * 1e3, 3)
+    if len(lat) >= 20:  # a p99 from a 3-sample quick run is just the max
+        res["latency_ms_p99"] = round(float(np.percentile(lat, 99)) * 1e3, 3)
+    else:
+        res["latency_ms_max"] = round(float(max(lat)) * 1e3, 3)
     return res
 
 
@@ -583,6 +568,14 @@ def _suite_names():
     import os
 
     names = [*TRAIN_CONFIGS, *INFER_CONFIGS, "gpt_decode"]
+    # the BASELINE five first, then the reference's headline serving
+    # rows, then gpt — a driver that kills the suite early (the partial
+    # SIGTERM record) still captures the configs that matter most
+    priority = ["mnist_mlp", "resnet50", "transformer", "bert", "deepfm",
+                "resnet50_infer_bf16", "resnet50_infer_int8",
+                "resnet50_infer_fp32", "gpt"]
+    names.sort(key=lambda n: priority.index(n) if n in priority
+               else len(priority))  # stable: non-priority keep their order
     only = os.environ.get("BENCH_ONLY")  # comma-list filter (debug/tests)
     if only:
         keep = {s.strip() for s in only.split(",")}
@@ -594,6 +587,11 @@ def _result_key(name: str) -> str:
     return f"{name}_train" if name in TRAIN_CONFIGS else name
 
 
+# quick mode shrinks iters everywhere; configs whose COMPILE dominates
+# also shrink their shape so the harness smoke test stays a smoke test
+QUICK_OVERRIDES = {"gpt_32k": {"seq": 2048, "iters": 2}}
+
+
 def _run_one(name: str, peak: float, quick: bool = False, batch_size=None):
     """Run a single named config in-process."""
     kw = {}
@@ -602,6 +600,7 @@ def _run_one(name: str, peak: float, quick: bool = False, batch_size=None):
     if name in TRAIN_CONFIGS:
         if quick:
             kw["iters"] = 3
+            kw.update(QUICK_OVERRIDES.get(name, {}))
         return TRAIN_CONFIGS[name](peak, **kw)
     if name in INFER_CONFIGS:
         if quick:
@@ -661,43 +660,87 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
 
     configs = {}
     device = peak = peak_source = None
-    for name in _suite_names():
-        key = _result_key(name)
-        print(f"[bench] {name} ...", file=sys.stderr, flush=True)
-        cmd = [sys.executable, os.path.abspath(__file__), "--model", name,
-               "--compute_dtype", compute_dtype, "--emit", "raw",
-               "--config_timeout", str(config_timeout)]
-        if quick:
-            cmd.append("--quick")
+    child = [None]  # the in-flight config subprocess, for the handler
+
+    def _die_with_parent():
+        # PR_SET_PDEATHSIG: the kernel kills the child whenever the suite
+        # parent exits — closes the race where a signal lands between one
+        # child's cleanup and the next Popen's assignment, which would
+        # otherwise orphan a device-holding benchmark process
+        import ctypes
         try:
+            ctypes.CDLL("libc.so.6", use_errno=True).prctl(1, 9)  # SIGKILL
+        except OSError:
+            pass
+
+    def _partial(signum, frame):
+        # a driver timeout must not lose the record: kill the in-flight
+        # child (it holds the device), emit whatever completed
+        # (priority-ordered, so the BASELINE configs are in), exit 0 so
+        # the one JSON line is recorded as the run's output
+        if child[0] is not None and child[0].poll() is None:
+            child[0].kill()
+        res = _assemble(configs, device or kind, peak, peak_source,
+                        compute_dtype)
+        res["partial"] = f"suite interrupted by signal {signum}"
+        print(json.dumps(res), flush=True)
+        os._exit(0)
+
+    import signal
+    old_term = signal.signal(signal.SIGTERM, _partial)
+    old_int = signal.signal(signal.SIGINT, _partial)
+    try:
+        for name in _suite_names():
+            key = _result_key(name)
+            print(f"[bench] {name} ...", file=sys.stderr, flush=True)
+            cmd = [sys.executable, os.path.abspath(__file__), "--model", name,
+                   "--compute_dtype", compute_dtype, "--emit", "raw",
+                   "--config_timeout", str(config_timeout)]
+            if quick:
+                cmd.append("--quick")
             # +180s startup slack: the child's own _deadline(config_timeout)
             # wraps only _run_one; the parent clock also covers jax import
             # and backend connect, which must not eat the config's budget
-            r = subprocess.run(cmd, stdout=subprocess.PIPE, text=True,
-                               timeout=config_timeout + 180)
-        except subprocess.TimeoutExpired:
-            configs[key] = {"error": f"Timeout: config exceeded {config_timeout}s "
-                                     "(subprocess killed)"}
-            print(f"[bench] {name} TIMED OUT", file=sys.stderr, flush=True)
-            continue
-        line = (r.stdout.strip().splitlines() or [""])[-1]
-        try:
-            payload = json.loads(line)
-        except json.JSONDecodeError:
-            payload = {"error": f"rc={r.returncode}, no JSON (crash/OOM?)"}
-        if "error" in payload:
-            configs[key] = {"error": payload["error"]}
-            print(f"[bench] {name} failed: {payload['error']}",
-                  file=sys.stderr, flush=True)
-            continue
-        configs[key] = payload["result"]
-        device = payload.get("device", device)
-        peak = payload.get("peak_flops", peak)
-        peak_source = payload.get("peak_source", peak_source)
-        c = configs[key]
-        print(f"[bench] {name}: {c.get('value')} {c.get('unit')} "
-              f"mfu={c.get('mfu')}", file=sys.stderr, flush=True)
+            child[0] = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                                        preexec_fn=_die_with_parent)
+            try:
+                stdout, _ = child[0].communicate(timeout=config_timeout + 180)
+                rc = child[0].returncode
+            except subprocess.TimeoutExpired:
+                child[0].kill()
+                child[0].communicate()
+                configs[key] = {"error": f"Timeout: config exceeded "
+                                         f"{config_timeout}s (subprocess killed)"}
+                print(f"[bench] {name} TIMED OUT", file=sys.stderr, flush=True)
+                continue
+            finally:
+                child[0] = None
+            line = (stdout.strip().splitlines() or [""])[-1]
+            try:
+                payload = json.loads(line)
+            except json.JSONDecodeError:
+                payload = {"error": f"rc={rc}, no JSON (crash/OOM?)"}
+            if "error" in payload:
+                configs[key] = {"error": payload["error"]}
+                print(f"[bench] {name} failed: {payload['error']}",
+                      file=sys.stderr, flush=True)
+                continue
+            configs[key] = payload["result"]
+            device = payload.get("device", device)
+            peak = payload.get("peak_flops", peak)
+            peak_source = payload.get("peak_source", peak_source)
+            c = configs[key]
+            print(f"[bench] {name}: {c.get('value')} {c.get('unit')} "
+                  f"mfu={c.get('mfu')}", file=sys.stderr, flush=True)
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
 
+    return _assemble(configs, device or kind, peak, peak_source,
+                     compute_dtype)
+
+
+def _assemble(configs, device, peak, peak_source, compute_dtype):
     mfus = [c["mfu"] for n, c in configs.items()
             if n.endswith("_train") and "mfu" in c]
     headline = max(mfus) if mfus else 0.0
@@ -707,7 +750,7 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
         "value": round(headline, 4),
         "unit": "MFU",
         "vs_baseline": rn.get("vs_baseline"),
-        "device": device or kind,
+        "device": device,
         "peak_flops": peak,
         "peak_source": peak_source,
         "compute_dtype": compute_dtype,
