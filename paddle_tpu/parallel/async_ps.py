@@ -162,6 +162,19 @@ class PSClient:
             f"PUSH {self.trainer_id} {self._check_name(name)} {len(data)}", data)
         return int(resp.split()[1])
 
+    def push_quantized(self, name: str, grad: np.ndarray) -> int:
+        """Int8-quantized dense push (abs-max symmetric, one f32 scale):
+        4× less wire than :meth:`push`, dequantized server-side before
+        the identical update path — the quantized-collective technique
+        (EQuARX lineage) applied to the trainer→pserver hop."""
+        g = np.ascontiguousarray(grad, dtype=np.float32).reshape(-1)
+        scale = float(max(np.max(np.abs(g)), 1e-30))
+        q = np.clip(np.round(g / scale * 127.0), -127, 127).astype(np.int8)
+        resp = self._request(
+            f"PUSHQ {self.trainer_id} {self._check_name(name)} {q.size} "
+            f"{scale!r}", q.tobytes())
+        return int(resp.split()[1])
+
     def push_rows(self, name: str, row_ids: np.ndarray,
                   row_grads: np.ndarray) -> int:
         """Sparse push: ``row_grads[k]`` updates row ``row_ids[k]`` of the
@@ -214,13 +227,15 @@ class AsyncPSTrainer:
 
     def __init__(self, program, addr: Tuple[str, int], loss_name: str = "loss",
                  trainer_id: int = 0, pull_interval: int = 1,
-                 fetch_list: Optional[Sequence[str]] = None):
+                 fetch_list: Optional[Sequence[str]] = None,
+                 compress_grads: bool = False):
         import jax
 
         self.program = program
         self.loss_name = loss_name
         self.client = PSClient(addr, trainer_id=trainer_id)
         self.pull_interval = max(1, int(pull_interval))
+        self.compress_grads = bool(compress_grads)
         self.fetch_list = list(fetch_list) if fetch_list is not None else None
         self.params = None
         self.state = None
@@ -281,7 +296,9 @@ class AsyncPSTrainer:
         if self.global_step % self.pull_interval == 0:
             self.params = self._pull_into(self.params)
         grads, out, self.state = self._grad_fn(self.params, self.state, rng, feed)
+        send = (self.client.push_quantized if self.compress_grads
+                else self.client.push)
         for name, leaf in _named_leaves(jax.device_get(grads)):
-            self.client.push(name, leaf)
+            send(name, leaf)
         self.global_step += 1
         return out

@@ -191,6 +191,50 @@ def test_snapshot_recover_across_restart(tmp_path):
         c2.close()
 
 
+def test_push_quantized_math(sgd_server):
+    """PUSHQ: server applies g = q*scale/127 through the same update
+    path; result within int8 quantization error of the exact push."""
+    c = PSClient(sgd_server.addr)
+    rng = np.random.RandomState(5)
+    w0 = rng.randn(64).astype(np.float32)
+    g = rng.randn(64).astype(np.float32)
+    c.init_param("wq", w0)
+    c.push_quantized("wq", g)
+    got = c.pull("wq", (64,))
+    want = w0 - 0.1 * g            # sgd_server lr=0.1
+    # per-element error bounded by lr * scale/127 (half-step rounding)
+    tol = 0.1 * float(np.abs(g).max()) / 127.0 + 1e-7
+    assert float(np.max(np.abs(got - want))) <= tol
+    with pytest.raises(RuntimeError, match="size mismatch"):
+        c.push_quantized("wq", np.ones(65, np.float32))
+    c.close()
+
+
+@pytest.mark.slow
+def test_compressed_async_training_converges():
+    """compress_grads=True: int8 gradient pushes, same learnable task —
+    must still learn despite quantized updates."""
+    prog = pt.build(mnist.mlp)
+    rng = np.random.RandomState(7)
+    def shard(n=64):
+        img = rng.randn(n, 784).astype(np.float32)
+        lbl = img[:, :780].reshape(n, 10, 78)[:, :, :5].sum(-1).argmax(1)
+        return {"image": img, "label": lbl.reshape(n, 1).astype(np.int64)}
+
+    feeds = [shard(), shard()]
+    with PServerProcess(lr=0.1, optimizer="sgd") as srv:
+        t = AsyncPSTrainer(prog, srv.addr, fetch_list=["loss"],
+                           compress_grads=True)
+        t.startup(sample_feed=feeds[0])
+        first = float(t.step(feeds[0])["loss"])
+        for s in range(1, 15):
+            out = t.step(feeds[s % 2])
+        assert float(out["loss"]) < first * 0.5, (first, float(out["loss"]))
+        stats = PSClient(srv.addr).status()
+        # the quantized route was genuinely taken for EVERY push
+        assert stats["qpushes"] == stats["pushes"] > 0, stats
+
+
 def test_snapshot_roundtrips_whitespace_leading_payload(tmp_path):
     """Regression: a param whose first payload byte is whitespace-class
     (0x09-0x0D/0x20) must survive save/recover byte-exact — a trailing
