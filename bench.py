@@ -1,10 +1,15 @@
 """Benchmark driver — fluid_benchmark.py analog (benchmark/fluid/).
 
-Default (no args — the driver's command) runs the FULL suite: every
-BASELINE config (MNIST MLP, ResNet-50, Transformer-base, BERT-base,
-DeepFM) plus VGG-16, LSTM, long-context transformer, the 10M-row
-sharded-embedding DeepFM, and the inference configs (ResNet-50 bs=16
-fp32/bf16/int8-PTQ-weights). Prints ONE JSON line:
+Default (no args — the driver's command) runs the FULL suite in
+priority order: the five BASELINE configs (MNIST MLP, ResNet-50,
+Transformer-base, BERT-base, DeepFM) and the ResNet-50 serving rows
+first, then GPT, VGG-16, AlexNet, GoogLeNet, SE-ResNeXt-50, LSTM
+(512/1280-hidden), long-context transformer (seq 4096), GPT at seq
+32k, the 10M-row sharded-embedding DeepFM, GoogLeNet serving, and
+KV-cache GPT decode. The int8 serving variant runs the REAL int8
+datapath (quantize.int8_serving). Each config runs in its own
+subprocess under a hard timeout; on SIGTERM the suite emits the partial
+record instead of losing the run. Prints ONE JSON line:
 
   {"metric": "suite", "value": <headline train MFU>, "unit": "MFU",
    "vs_baseline": <resnet50 imgs/sec ratio vs reference>,
