@@ -1,8 +1,9 @@
 """Parallelism over TPU meshes — the reference's ParallelExecutor +
 DistributeTranspiler capabilities re-expressed as sharding (SURVEY §2.2/§7)."""
 
-from . import api, async_ps, mesh, moe, sharding, strategy, ulysses
+from . import api, async_ps, mesh, moe, quantized_collectives, sharding, strategy, ulysses
 from .async_ps import AsyncPSTrainer, PSClient, PServerProcess
+from .quantized_collectives import quantized_pmean, quantized_psum
 from .mesh import DATA_AXES, DP, EP, FSDP, PP, SP, TP, data_parallel_size, initialize, make_mesh
 from .moe import moe_ep_rules
 from .sharding import ShardingRules, fsdp, replicated, transformer_tp_rules
@@ -10,8 +11,10 @@ from .strategy import DistStrategy
 from .ulysses import ulysses_attention
 
 __all__ = [
-    "api", "async_ps", "mesh", "moe", "sharding", "strategy", "ulysses",
+    "api", "async_ps", "mesh", "moe", "quantized_collectives", "sharding",
+    "strategy", "ulysses",
     "AsyncPSTrainer", "PSClient", "PServerProcess",
+    "quantized_pmean", "quantized_psum",
     "DATA_AXES", "DP", "EP", "FSDP", "PP", "SP", "TP",
     "data_parallel_size", "initialize", "make_mesh",
     "moe_ep_rules", "ulysses_attention",

@@ -1,0 +1,98 @@
+"""Quantized ring collectives — int8-compressed all-reduce.
+
+EQuARX-inspired (PAPERS.md: "Efficient Quantized AllReduce in XLA"):
+a ring all-reduce whose every hop carries int8 payloads with one f32
+abs-max scale per chunk instead of f32/bf16 — ~4× less wire at ~1%-of-
+max per-hop quantization error. XLA's native collectives (what GSPMD
+inserts for the rule-table shardings) remain the default everywhere;
+this exists for custom ``shard_map`` training loops on bandwidth-
+limited axes — the DCN data axis of a multi-host mesh, where the
+reference's gRPC pserver transport was the analogous bottleneck
+(grpc_bytebuffer_stream.cc zero-copy serde solved transport overhead;
+quantization attacks the byte count itself).
+
+Usage (inside shard_map, like lax.psum)::
+
+    grads = quantized_psum(local_grads, "dp")
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..quantize import _quant_dynamic
+
+
+def _quantize(v):
+    q, scale = _quant_dynamic(v, axes=tuple(range(v.ndim)))
+    return q, scale.reshape(())
+
+
+def _dequantize(q, scale, qmax=127.0):
+    return q.astype(jnp.float32) * (scale / qmax)
+
+
+def quantized_psum(x, axis_name: str):
+    """Ring all-reduce of ``x`` over ``axis_name`` with int8-quantized
+    hops. Drop-in for ``lax.psum`` inside ``shard_map`` when wire bytes
+    matter more than exactness; accumulation stays f32, each of the
+    2(P-1) hops quantizes its payload (error per hop ≤ max/127 of the
+    partial being carried).
+
+    Ring schedule (reduce-scatter then all-gather, one neighbor
+    ppermute per step): rank r first forwards chunk (r+1)%P, adds its
+    own contribution to the partial arriving at step k (chunk
+    (r-k+1)%P), and after P-1 steps owns fully-reduced chunk (r+2)%P;
+    the all-gather phase circulates the reduced chunks back around.
+    """
+    p = jax.lax.axis_size(axis_name)
+    if p == 1:
+        return x
+    r = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    chunk = -(-n // p)
+    flat = jnp.pad(flat, (0, chunk * p - n))
+    chunks = flat.reshape(p, chunk)
+
+    def take(idx):
+        return jax.lax.dynamic_index_in_dim(chunks, idx, 0, keepdims=False)
+
+    def hop(v):
+        q, s = _quantize(v)
+        q = jax.lax.ppermute(q, axis_name, perm)
+        s = jax.lax.ppermute(s, axis_name, perm)
+        return _dequantize(q, s)
+
+    # reduce-scatter: after the loop `carry` is chunk (r+2)%p summed
+    # over every rank
+    carry = take((r + 1) % p)
+    for k in range(1, p):
+        carry = hop(carry) + take((r - k + 1) % p)
+
+    # all-gather: circulate the reduced chunks; rank r receives chunk
+    # owned by rank r-k, i.e. ((r-k)+2)%p, at step k. The OWNER also
+    # stores the quantized roundtrip of its chunk, not the exact f32:
+    # abs-max quantization is idempotent (the max maps to exactly ±127,
+    # so every further hop re-encodes to the same codes), which makes
+    # the final result BITWISE IDENTICAL on every rank — the all-reduce
+    # contract DP replicas rely on to not drift.
+    carry = _dequantize(*_quantize(carry))
+    out = jnp.zeros_like(chunks)
+    out = jax.lax.dynamic_update_index_in_dim(out, carry, (r + 2) % p, 0)
+    recv = carry
+    for k in range(1, p):
+        recv = hop(recv)
+        out = jax.lax.dynamic_update_index_in_dim(out, recv, (r - k + 2) % p, 0)
+
+    return out.reshape(-1)[:n].reshape(orig_shape).astype(orig_dtype)
+
+
+def quantized_pmean(x, axis_name: str):
+    """Mean-reduction sibling of :func:`quantized_psum` (the gradient
+    averaging form data-parallel training actually uses)."""
+    return quantized_psum(x, axis_name) / jax.lax.axis_size(axis_name)
