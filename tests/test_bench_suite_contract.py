@@ -1,0 +1,75 @@
+"""The bench suite's driver contract (bench.py): priority ordering,
+config registry consistency, result assembly, and quick-mode overrides
+— pure-Python, no device. The driver records BENCH_r{N}.json from this
+machinery; a silent drift here loses the round's record."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import bench
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_filter(monkeypatch):
+    # a leaked BENCH_ONLY debug setting must not skew the contract tests
+    monkeypatch.delenv("BENCH_ONLY", raising=False)
+
+
+def test_priority_order_leads_with_baseline_configs():
+    names = bench._suite_names()
+    assert names[:5] == ["mnist_mlp", "resnet50", "transformer", "bert",
+                         "deepfm"]
+    assert names[5:8] == ["resnet50_infer_bf16", "resnet50_infer_int8",
+                          "resnet50_infer_fp32"]
+    assert names[8] == "gpt"
+    # every registered config appears exactly once
+    expect = set(bench.TRAIN_CONFIGS) | set(bench.INFER_CONFIGS) | {"gpt_decode"}
+    assert set(names) == expect and len(names) == len(expect)
+
+
+def test_bench_only_filter(monkeypatch):
+    monkeypatch.setenv("BENCH_ONLY", "bert, gpt_decode")
+    assert bench._suite_names() == ["bert", "gpt_decode"]
+
+
+def test_result_key_mapping():
+    assert bench._result_key("bert") == "bert_train"
+    assert bench._result_key("resnet50_infer_int8") == "resnet50_infer_int8"
+    assert bench._result_key("gpt_decode") == "gpt_decode"
+
+
+def test_run_one_rejects_unknown_and_applies_quick_overrides(monkeypatch):
+    with pytest.raises(ValueError, match="unknown config"):
+        bench._run_one("nope", 1.0)
+    seen = {}
+    monkeypatch.setitem(bench.TRAIN_CONFIGS, "gpt_32k",
+                        lambda peak, **kw: seen.update(kw) or {"v": 1})
+    bench._run_one("gpt_32k", 1.0, quick=True)
+    assert seen == {"iters": 2, "seq": 2048}  # QUICK_OVERRIDES applied
+
+
+def test_assemble_headline_and_partial_shape():
+    configs = {
+        "mnist_mlp_train": {"mfu": 0.4, "value": 1.0},
+        "bert_train": {"mfu": 0.55, "value": 2.0},
+        "resnet50_train": {"mfu": 0.5, "value": 3.0, "vs_baseline": 24.0},
+        "resnet50_infer_bf16": {"mfu": 0.9, "value": 4.0},  # infer: no headline
+        "broken_train": {"error": "Timeout"},
+    }
+    res = bench._assemble(configs, "TPU v5 lite", 197e12, "table", "bfloat16")
+    assert res["metric"] == "suite"
+    assert res["value"] == 0.55          # max TRAIN mfu only
+    assert res["vs_baseline"] == 24.0    # resnet50 ratio carried up
+    assert res["device"] == "TPU v5 lite"
+    assert res["configs"] is configs
+
+
+def test_baselines_match_baseline_md_rows():
+    # the ratios the suite reports are anchored to these exact numbers
+    assert bench.BASELINES["resnet50"] == 81.69
+    assert bench.BASELINES["resnet50_infer_fp32"] == 217.69
+    assert bench.BASELINES["googlenet_infer"] == 600.94
+    assert abs(bench.BASELINES["lstm_big"] - 256 / 1.655) < 1e-9
