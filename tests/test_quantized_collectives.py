@@ -6,6 +6,7 @@ Numerics vs exact lax.psum on the 8-device CPU mesh + wire evidence
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 import paddle_tpu as pt
@@ -37,6 +38,7 @@ def test_exact_when_quantization_grid_is_stable():
         np.testing.assert_allclose(got[r], want, rtol=0, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_close_to_exact_psum_on_random_data():
     rng = np.random.RandomState(1)
     per_rank = [rng.randn(1000).astype(np.float32) for _ in range(8)]
@@ -48,6 +50,7 @@ def test_close_to_exact_psum_on_random_data():
         assert err < 0.05, err
 
 
+@pytest.mark.slow
 def test_padding_and_dtype_roundtrip():
     """Sizes not divisible by the ring size pad internally; bf16 in →
     bf16 out."""
@@ -61,6 +64,7 @@ def test_padding_and_dtype_roundtrip():
     np.testing.assert_allclose(got[0], want, rtol=0.1, atol=0.1)
 
 
+@pytest.mark.slow
 def test_pmean_averages():
     per_rank = [np.full((8,), float(r), np.float32) for r in range(8)]
     got = np.asarray(_run(quantized_pmean, per_rank)).reshape(8, 8)
