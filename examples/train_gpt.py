@@ -91,7 +91,8 @@ def main():
         if i % max(1, args.steps // 10) == 0:
             print(f"step {trainer.global_step:5d}  loss {loss:.4f}")
 
-    print(f"loss {first:.4f} -> {last:.4f} over {args.steps} steps")
+    if first is not None:
+        print(f"loss {first:.4f} -> {last:.4f} over {args.steps} steps")
     if args.ckpt:
         io.save_trainer(args.ckpt, trainer)
         print(f"checkpoint saved to {args.ckpt}")

@@ -101,7 +101,7 @@ def test_all_ranks_bitwise_identical():
     Deliberately the ONE numeric ring test in the smoke tier (each of
     these costs ~20s of 8-device shard_map compile): bitwise identity
     catches both schedule and divergence regressions, and the cheap
-    jaxpr test below pins the wire structure; the remaining numeric
+    jaxpr test above pins the wire structure; the remaining numeric
     variants run in the full tier."""
     rng = np.random.RandomState(4)
     per_rank = [rng.randn(96).astype(np.float32) for _ in range(8)]
