@@ -1,0 +1,40 @@
+"""The runnable examples stay runnable (book-chapter rot guard):
+each is executed as a real subprocess at tiny settings."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(__file__)
+EXAMPLES = os.path.join(HERE, os.pardir, "examples")
+
+
+def _run(script, *args, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, os.path.join(EXAMPLES, script), *args],
+                       capture_output=True, text=True, env=env,
+                       timeout=timeout)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return r.stdout
+
+
+@pytest.mark.slow
+def test_train_gpt_example_runs_and_resumes(tmp_path):
+    ck = str(tmp_path / "ck")
+    out = _run("train_gpt.py", "--steps", "6", "--d_model", "64",
+               "--layers", "1", "--batch", "8", "--ckpt", ck)
+    assert "checkpoint saved" in out
+    out2 = _run("train_gpt.py", "--steps", "2", "--d_model", "64",
+                "--layers", "1", "--batch", "8", "--ckpt", ck)
+    assert "resumed from" in out2 and "at step 6" in out2
+
+
+@pytest.mark.slow
+def test_serve_classifier_example_runs_int8():
+    out = _run("serve_classifier.py", "--train_steps", "8", "--calls", "3",
+               "--threads", "2", "--int8")
+    assert "int8 datapath" in out
+    assert "served accuracy" in out
