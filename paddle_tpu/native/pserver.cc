@@ -293,11 +293,21 @@ class PServer {
       if (opt_ == Opt::kSGD) p.accum.clear();
       staged[name] = std::move(p);
     }
+    // full-consumption check (mirrors master.cc's snapshot loader): a
+    // header whose param-count was corrupted to a SMALLER value parses
+    // cleanly above but leaves tail params unread — that is a silent
+    // partial load, which the all-or-nothing contract forbids
+    bool trailing = complete && fgetc(f) != EOF;
     fclose(f);
-    if (!complete) {
-      fprintf(stderr,
-              "pserver: snapshot truncated/corrupt (%zu of %zu params "
-              "readable), starting fresh\n", staged.size(), n);
+    if (!complete || trailing) {
+      if (trailing)
+        fprintf(stderr,
+                "pserver: snapshot has unconsumed bytes after %zu params "
+                "(header count corrupted?), starting fresh\n", n);
+      else
+        fprintf(stderr,
+                "pserver: snapshot truncated/corrupt (%zu of %zu params "
+                "readable), starting fresh\n", staged.size(), n);
       return;
     }
     params_ = std::move(staged);
@@ -388,6 +398,14 @@ void ServeClient(PServer* ps, int fd) {
       resp = ps->PushQuantized(int(a), name, b, scale, body);
     } else if (sscanf(line.c_str(), "PUSHROWS %lld %255s %lld %lld",
                       &a, name, &b, &c) == 4) {
+      // reject before the size_t casts: a huge b or c would wrap the
+      // b*c*sizeof(float) product past 2^64 to a tiny length, slipping
+      // under the 512MB ReadBody cap while PushRows later indexes far
+      // out of bounds. Bounding each factor by the payload cap keeps
+      // every product below 2^64. b == 0 stays legal (PushRows permits
+      // an empty sparse gradient and replies OK).
+      const long long kMaxElems = (512ll << 20) / int(sizeof(float));
+      if (b < 0 || c <= 0 || b > kMaxElems || c > kMaxElems) break;
       std::string ids, vals;
       if (!ReadBody(fd, size_t(b) * sizeof(int32_t), &ids)) break;
       if (!ReadBody(fd, size_t(b) * size_t(c) * sizeof(float), &vals)) break;
