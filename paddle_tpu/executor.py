@@ -160,6 +160,12 @@ class Trainer:
         self.loss_name = loss_name
         self.place = place or default_place()
         self.mesh = mesh
+        # adapt preset rule tables to the declared mesh once, up front:
+        # axes the mesh doesn't have are dropped silently here (the
+        # user's declared intent) instead of tripping the _validate
+        # replication warning on every spec lookup
+        if sharding_rules is not None and mesh is not None:
+            sharding_rules = sharding_rules.adapted_to(mesh)
         self.sharding_rules = sharding_rules
         enforce(not getattr(strategy, "async_mode", False),
                 "DistStrategy.async_mode (DistributeTranspiler sync_mode="

@@ -18,8 +18,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .sharding import ShardingRules, replicated
 
 
-def _rules(rules: Optional[ShardingRules]) -> ShardingRules:
-    return rules if rules is not None else replicated()
+def _rules(rules: Optional[ShardingRules], mesh: Optional[Mesh] = None) -> ShardingRules:
+    """Default to replicated; with a mesh in hand, adapt preset tables
+    that name axes the mesh doesn't have (dropping them is the declared
+    intent here, not the _validate mis-sharding fallback)."""
+    rules = rules if rules is not None else replicated()
+    return rules.adapted_to(mesh) if mesh is not None else rules
 
 
 def shard_scope(mesh: Mesh, rules: Optional[ShardingRules], params, state, opt_state):
@@ -30,7 +34,7 @@ def shard_scope(mesh: Mesh, rules: Optional[ShardingRules], params, state, opt_s
     with its param shard). This is the BCastParamsToDevices analog
     (parallel_executor.cc:180) — replication or sharding by annotation.
     """
-    rules = _rules(rules)
+    rules = _rules(rules, mesh)
     sharded_params = rules.shard_params(mesh, params)
 
     repl = NamedSharding(mesh, P())
@@ -62,7 +66,7 @@ def put_batch(mesh: Mesh, rules: Optional[ShardingRules], feed: Dict[str, Any]):
     num_trainers/trainer_id data split of the reference
     (distribute_transpiler trainer-side), without program surgery.
     """
-    rules = _rules(rules)
+    rules = _rules(rules, mesh)
     multiproc = jax.process_count() > 1
     out = {}
     for k, v in feed.items():

@@ -51,6 +51,54 @@ class ShardingRules:
         self.seq_axis = seq_axis
 
     # ------------------------------------------------------------------
+    def adapted_to(self, mesh: Mesh) -> "ShardingRules":
+        """Return a copy with axes absent from ``mesh`` removed from
+        every spec — the intentional way to run a preset rule table
+        (which names the full dp/fsdp/tp/pp/sp/ep axis vocabulary) on a
+        smaller mesh. Unlike the ``_validate`` fallback, dropping a
+        *canonical* axis here is silent: the caller is declaring the
+        mesh, so shedding preset vocabulary is the requested adaptation.
+        Dropping a NON-canonical axis still warns — that's a typo in a
+        hand-written rule, not preset adaptation. ``Trainer`` and
+        ``parallel.api`` apply this automatically; results are memoized
+        per mesh axis-set, so per-step callers (put_batch) pay nothing.
+        """
+        names = tuple(mesh.axis_names)
+        if getattr(self, "_adapted_for", None) == names:
+            return self
+        cache = self.__dict__.setdefault("_adapted_cache", {})
+        if names in cache:
+            return cache[names]
+        nameset = set(names)
+
+        def adapt(spec: P) -> P:
+            out = []
+            for entry in spec:
+                keep, dropped = _filter_axes(entry, nameset)
+                for a in dropped:
+                    if a not in CANONICAL_AXES:
+                        _warn_drop(("adapt-typo", a),
+                                   f"adapted_to: rule axis {a!r} is neither in the "
+                                   f"mesh {names} nor a canonical axis name "
+                                   f"{sorted(CANONICAL_AXES)} — likely a typo; "
+                                   f"that dim will be replicated")
+                out.append(keep)
+            return P(*out)
+
+        adapted = ShardingRules.__new__(type(self))
+        adapted.__dict__.update(self.__dict__)
+        adapted.rules = [(pat, adapt(spec)) for pat, spec in self.rules]
+        adapted.default = adapt(self.default)
+        if self.batch_axes is not None:
+            adapted.batch_axes = tuple(a for a in self.batch_axes if a in nameset)
+        if self.seq_axis is not None and self.seq_axis not in nameset:
+            adapted.seq_axis = None
+        adapted.__dict__["_adapted_for"] = names
+        adapted.__dict__["_adapted_cache"] = {}
+        cache[names] = adapted
+        return adapted
+
+    # ------------------------------------------------------------------
     def spec_for(self, name: str, shape: Tuple[int, ...], mesh: Mesh) -> P:
         for pat, spec in self.rules:
             if pat.search(name):
@@ -84,12 +132,29 @@ class ShardingRules:
         return out
 
 
+CANONICAL_AXES = frozenset((mesh_lib.DP, mesh_lib.FSDP, mesh_lib.TP,
+                            mesh_lib.SP, mesh_lib.PP, mesh_lib.EP))
+
+
 def _as_spec(spec: SpecLike) -> P:
     if spec is None:
         return P()
     if isinstance(spec, P):
         return spec
     return P(*spec)
+
+
+def _filter_axes(entry, nameset):
+    """Split one PartitionSpec entry into (kept-entry, dropped-axes) by
+    mesh membership — the single normalization shared by ``adapted_to``
+    and ``_validate`` (entry → axis tuple → keep-in-mesh → collapse back
+    to scalar/tuple/None)."""
+    if entry is None:
+        return None, ()
+    axes = entry if isinstance(entry, tuple) else (entry,)
+    keep = tuple(a for a in axes if a in nameset)
+    dropped = tuple(a for a in axes if a not in nameset)
+    return (keep if len(keep) > 1 else (keep[0] if keep else None)), dropped
 
 
 _warned_drops = set()
@@ -109,25 +174,24 @@ def _validate(spec: P, shape: Tuple[int, ...], mesh: Mesh, name: str) -> P:
     permissive like GSPMD so preset rule tables degrade gracefully on
     smaller meshes, but each drop warns once (size-1 mesh axes excepted:
     dropping those is a no-op)."""
+    nameset = set(mesh.axis_names)
     out = []
     for i, entry in enumerate(spec):
         if entry is None:
             out.append(None)
             continue
-        axes = entry if isinstance(entry, tuple) else (entry,)
-        keep = []
+        kept, dropped = _filter_axes(entry, nameset)
+        for a in dropped:
+            # once per (axis, mesh shape): presets legitimately run on
+            # smaller meshes, so per-param warnings would flood
+            _warn_drop(("missing", a, tuple(mesh.shape.items())),
+                       f"sharding rule for {name!r} names axis {a!r} which is "
+                       f"not in the mesh {dict(mesh.shape)}; replicating that "
+                       f"dim (warned once per axis and mesh shape)")
+        keep = [] if kept is None else list(kept if isinstance(kept, tuple) else (kept,))
         size = 1
-        for a in axes:
-            if a in mesh.axis_names:
-                keep.append(a)
-                size *= mesh.shape[a]
-            else:
-                # once per (axis, mesh shape): presets legitimately run on
-                # smaller meshes, so per-param warnings would flood
-                _warn_drop(("missing", a, tuple(mesh.shape.items())),
-                           f"sharding rule for {name!r} names axis {a!r} which is "
-                           f"not in the mesh {dict(mesh.shape)}; replicating that "
-                           f"dim (warned once per axis and mesh shape)")
+        for a in keep:
+            size *= mesh.shape[a]
         if i >= len(shape):
             if keep and size > 1:
                 _warn_drop(("rank", name, i),
@@ -144,7 +208,7 @@ def _validate(spec: P, shape: Tuple[int, ...], mesh: Mesh, name: str) -> P:
                            f"replicating that dim")
             out.append(None)
         else:
-            out.append(tuple(keep) if len(keep) > 1 else keep[0])
+            out.append(kept)
     out = out[:len(shape)]
     return P(*out)
 

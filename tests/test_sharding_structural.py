@@ -107,6 +107,119 @@ def test_non_divisible_dim_warns(tp_mesh):
     assert len(msgs) == 1, msgs  # ...but loudly
 
 
+DRYRUN_MESHES = [
+    {"dp": 2, "fsdp": 2, "tp": 2},   # _dryrun_trainer
+    {"dp": 2, "tp": 2, "pp": 2},     # _dryrun_pipeline (the r3 warning mesh)
+    {"dp": 2, "sp": 4},              # _dryrun_sp
+    {"dp": 2, "ep": 4},              # _dryrun_moe
+    {"dp": 8},                       # degenerate single-axis
+]
+
+
+def _dryrun_rule_sets():
+    yield "tp", pt.parallel.transformer_tp_rules()
+    yield "tp+moe", pt.parallel.transformer_tp_rules(
+        extra=list(pt.parallel.moe_ep_rules()))
+    yield "moe", pt.parallel.ShardingRules(
+        list(pt.parallel.moe_ep_rules()), default=None)
+    yield "sp", pt.parallel.ShardingRules(seq_axis="sp")
+    yield "fsdp", pt.parallel.fsdp(min_size_to_shard=64)
+
+
+@pytest.mark.parametrize("axes", DRYRUN_MESHES,
+                         ids=lambda a: "x".join(a))
+def test_adapted_rules_warning_free_on_dryrun_meshes(axes):
+    """MULTICHIP r3 regression: preset rule tables adapted to each
+    driver-dryrun mesh must resolve every zoo param without tripping the
+    _validate replication warning (VERDICT r3 next-round #4)."""
+    mesh = pt.make_mesh(axes)
+    params = _transformer_params()
+    sharding._warned_drops.clear()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        for _, rules in _dryrun_rule_sets():
+            adapted = rules.adapted_to(mesh)
+            for name, v in params.items():
+                adapted.spec_for(name, v.shape, mesh)
+            adapted.batch_spec(mesh, 2, shape=(16, 16))
+    drops = [w for w in rec if "sharding rule" in str(w.message)]
+    assert not drops, [str(w.message) for w in drops]
+
+
+def test_adapted_to_drops_foreign_axes_only():
+    mesh = pt.make_mesh({"dp": 2, "tp": 2, "pp": 2})
+    rules = pt.parallel.transformer_tp_rules()
+    adapted = rules.adapted_to(mesh)
+    # fsdp dropped, tp kept, on the exact rule the r3 dryrun warned about
+    assert adapted.spec_for("logits_proj_0/w", (16, 64), mesh) == P(None, None)
+    assert adapted.spec_for("enc/mha_0/q_proj/w", (16, 16), mesh) == P(None, "tp")
+    # original table untouched (adapted_to returns a copy)
+    full = pt.make_mesh({"fsdp": 4, "tp": 2})
+    assert rules.spec_for("enc/mha_0/q_proj/w", (16, 16), full) == P("fsdp", "tp")
+
+
+def test_adapted_to_preserves_fsdp_subclass_and_seq_axis():
+    mesh_nofsdp = pt.make_mesh({"dp": 8})
+    f = pt.parallel.fsdp(min_size_to_shard=64).adapted_to(mesh_nofsdp)
+    assert f.spec_for("x/w", (128, 64), mesh_nofsdp) == P()  # subclass logic intact
+    mesh_fsdp = pt.make_mesh({"fsdp": 8})
+    f2 = pt.parallel.fsdp(min_size_to_shard=64).adapted_to(mesh_fsdp)
+    assert f2.spec_for("x/w", (128, 64), mesh_fsdp) == P("fsdp", None)
+    sp = pt.parallel.ShardingRules(seq_axis="sp")
+    assert sp.adapted_to(mesh_nofsdp).seq_axis is None
+    assert sp.adapted_to(pt.make_mesh({"sp": 8})).seq_axis == "sp"
+
+
+def test_adapted_to_warns_on_noncanonical_axis_typo():
+    """adapted_to silently sheds canonical preset vocabulary, but a
+    hand-written rule with a typo'd axis must still warn at adapt time."""
+    mesh = pt.make_mesh({"dp": 2, "tp": 2, "pp": 2})
+    sharding._warned_drops.clear()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        pt.parallel.ShardingRules([(r".*/w", P("fdsp", "tp"))]).adapted_to(mesh)
+    msgs = [str(w.message) for w in rec if "likely a typo" in str(w.message)]
+    assert len(msgs) == 1 and "'fdsp'" in msgs[0], msgs
+    # canonical axes stay silent
+    sharding._warned_drops.clear()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        pt.parallel.transformer_tp_rules().adapted_to(mesh)
+    assert not [w for w in rec if "sharding" in str(w.message).lower()]
+
+
+def test_adapted_to_memoized_and_idempotent():
+    mesh = pt.make_mesh({"dp": 2, "tp": 2, "pp": 2})
+    rules = pt.parallel.transformer_tp_rules()
+    a1 = rules.adapted_to(mesh)
+    assert rules.adapted_to(mesh) is a1          # memoized on the source
+    assert a1.adapted_to(mesh) is a1             # already-adapted short-circuits
+    other = pt.make_mesh({"dp": 4, "fsdp": 2})
+    assert a1.adapted_to(other) is not a1        # different axis set re-adapts
+
+
+def test_trainer_adapts_rules_at_construction():
+    """Trainer(mesh=..., sharding_rules=preset) must not rely on the
+    warning fallback: its stored rules are pre-adapted to the mesh."""
+    from paddle_tpu import optimizer as opt
+    cfg = transformer.base_config(src_vocab=64, trg_vocab=64, d_model=16,
+                                  d_inner=32, num_heads=2,
+                                  num_encoder_layers=1, num_decoder_layers=1,
+                                  dropout=0.0)
+    prog = pt.build(transformer.make_model(cfg))
+    mesh = pt.make_mesh({"dp": 2, "tp": 2, "pp": 2})
+    tr = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss", mesh=mesh,
+                    sharding_rules=pt.parallel.transformer_tp_rules())
+    sharding._warned_drops.clear()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        params = _transformer_params()
+        for name, v in params.items():
+            tr.sharding_rules.spec_for(name, v.shape, mesh)
+    drops = [w for w in rec if "sharding rule" in str(w.message)]
+    assert not drops, [str(w.message) for w in drops]
+
+
 def test_executor_jit_cache_keyed_on_program_object():
     """A dead Program's id must not alias a new Program's cache entry."""
     import gc
