@@ -854,7 +854,40 @@ def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
     oh, ow = out_shape
     x = input if data_format == "NHWC" else jnp.transpose(input, (0, 2, 3, 1))
     method = "bilinear" if resample.upper() == "BILINEAR" else "nearest"
-    out = jax.image.resize(x, (n, oh, ow, c), method=method)
+    if method == "bilinear" and align_corners:
+        # align_corners=True (the reference default, bilinear_interp_op):
+        # output pixel o samples input at o*(in-1)/(out-1), axis by axis.
+        # jax.image.resize only does half-pixel centers; express corner
+        # alignment through scale_and_translate, whose sampling is
+        # i = (o + 0.5 - t)/s - 0.5  =>  t = 0.5*(1 - s) gives i = o/s.
+        # Degenerate axes (in==1 or out==1) pin to index 0 — the
+        # scale-zero convention — via slice + broadcast. Weights are
+        # float regardless of input dtype (an int dtype would truncate
+        # the ratio); integer images resize in f32 and round back.
+        orig_dtype = x.dtype
+        if not jnp.issubdtype(orig_dtype, jnp.inexact):
+            x = x.astype(jnp.float32)
+
+        def ac_axis(v, axis, out_size):
+            in_size = v.shape[axis]
+            if out_size == in_size:
+                return v
+            tgt = list(v.shape)
+            tgt[axis] = out_size
+            if in_size == 1 or out_size == 1:
+                first = jax.lax.slice_in_dim(v, 0, 1, axis=axis)
+                return jnp.broadcast_to(first, tgt)
+            s = (out_size - 1) / (in_size - 1)
+            return jax.image.scale_and_translate(
+                v, tgt, (axis,), jnp.array([s], jnp.float32),
+                jnp.array([0.5 * (1.0 - s)], jnp.float32),
+                method="linear", antialias=False)
+
+        out = ac_axis(ac_axis(x, 1, oh), 2, ow)
+        if not jnp.issubdtype(orig_dtype, jnp.inexact):
+            out = jnp.round(out).astype(orig_dtype)
+    else:
+        out = jax.image.resize(x, (n, oh, ow, c), method=method)
     return out if data_format == "NHWC" else jnp.transpose(out, (0, 3, 1, 2))
 
 

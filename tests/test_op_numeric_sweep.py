@@ -1,0 +1,1200 @@
+"""Numeric sweep over the FULL public layer surface (VERDICT r4 #2).
+
+The reference tests every op numerically (op_test.py:131 check_output
+against a python/numpy reference; op_test.py:43 finite-difference grad
+checks; 311 test files). This file is the auditable closure of that
+discipline over our 204-name surface (tests/test_layers_parity.py):
+
+    every name is EXACTLY ONE of
+      * CASES[name]      — a numeric assertion executed here,
+      * COVERED[name]    — a pointer to the suite that already asserts
+                           its numerics (meta-checked to mention it),
+      * EXEMPT[name]     — non-array infrastructure, with the reason.
+
+``test_surface_partitioned`` enforces the partition, so adding a layer
+without numeric coverage fails CI, and GRAD_OPS runs finite-difference
+gradient checks (op_test.check_grad) over a representative set of the
+differentiable ops.
+
+Refs are written from the reference op semantics (layers docstrings cite
+file:line), computed in numpy — or torch (CPU) where an independent
+oracle exists (lrn, conv, softmax-CE).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+
+from op_test import check_grad
+from test_layers_parity import REFERENCE_LAYERS_ALL
+
+rs = np.random.RandomState  # fresh, seeded per case
+
+
+def J(x):
+    return jnp.asarray(x)
+
+
+def A(x):
+    return np.asarray(x)
+
+
+def allclose(got, want, rtol=1e-5, atol=1e-5):
+    np.testing.assert_allclose(A(got), np.asarray(want), rtol=rtol, atol=atol)
+
+
+def build_run(fn, *inputs, **kw):
+    """OpTest single-op-program pattern for parameterized layers."""
+    prog = pt.build(lambda *a: fn(*a, **kw))
+    params, state = prog.init(jax.random.PRNGKey(0), *inputs)
+    out, _ = prog.apply(params, state, *inputs, training=False)
+    return out, {k: A(v) for k, v in params.items()}
+
+
+CASES = {}
+
+
+def case(name):
+    def deco(f):
+        assert name not in CASES, name
+        CASES[name] = f
+        return f
+    return deco
+
+
+# --- activations / elementwise math (ops.py generated + explicit) ---------
+
+X1 = rs(0).randn(3, 4).astype(np.float32)
+
+
+@case("relu")
+def _():
+    allclose(L.relu(J(X1)), np.maximum(X1, 0))
+
+
+@case("relu6")
+def _():
+    allclose(L.relu6(J(X1 * 4)), np.clip(X1 * 4, 0, 6))
+
+
+@case("leaky_relu")
+def _():
+    allclose(L.leaky_relu(J(X1), alpha=0.1), np.where(X1 > 0, X1, 0.1 * X1))
+
+
+@case("elu")
+def _():
+    allclose(L.elu(J(X1), alpha=0.5),
+             np.where(X1 > 0, X1, 0.5 * (np.exp(X1) - 1)), rtol=1e-4)
+
+
+@case("brelu")
+def _():
+    allclose(L.brelu(J(X1 * 10), t_min=-2.0, t_max=5.0), np.clip(X1 * 10, -2, 5))
+
+
+@case("soft_relu")
+def _():
+    allclose(L.soft_relu(J(X1), threshold=40.0), np.log1p(np.exp(X1)), rtol=1e-4)
+
+
+@case("stanh")
+def _():
+    allclose(L.stanh(J(X1), 0.5, 1.2), 1.2 * np.tanh(0.5 * X1), rtol=1e-4)
+
+
+@case("hard_sigmoid")
+def _():
+    allclose(L.hard_sigmoid(J(X1 * 5), slope=0.3, offset=0.4),
+             np.clip(0.3 * X1 * 5 + 0.4, 0, 1))
+
+
+@case("swish")
+def _():
+    allclose(L.swish(J(X1), beta=2.0), X1 / (1 + np.exp(-2.0 * X1)), rtol=1e-4)
+
+
+@case("pow")
+def _():
+    allclose(L.pow(J(np.abs(X1) + 0.5), factor=2.5), (np.abs(X1) + 0.5) ** 2.5,
+             rtol=1e-4)
+
+
+@case("log")
+def _():
+    allclose(L.log(J(np.abs(X1) + 0.5), ), np.log(np.abs(X1) + 0.5), rtol=1e-5)
+
+
+@case("maxout")
+def _():
+    x = rs(1).randn(2, 6, 2, 2).astype(np.float32)
+    want = x.reshape(2, 3, 2, 2, 2).max(axis=2)
+    allclose(L.maxout(J(x), groups=2), want)
+
+
+@case("prelu")
+def _():
+    out, params = build_run(L.prelu, X1, mode="all")
+    alpha = list(params.values())[0].reshape(())
+    allclose(out, np.where(X1 > 0, X1, alpha * X1))
+
+
+# --- elementwise binary with paddle axis-broadcast ------------------------
+
+Y1 = rs(2).randn(3, 4).astype(np.float32)
+YROW = rs(3).randn(4).astype(np.float32)
+
+
+@case("elementwise_add")
+def _():
+    allclose(L.elementwise_add(J(X1), J(Y1)), X1 + Y1)
+    allclose(L.elementwise_add(J(X1), J(YROW), axis=1), X1 + YROW)
+
+
+@case("elementwise_sub")
+def _():
+    allclose(L.elementwise_sub(J(X1), J(Y1)), X1 - Y1)
+
+
+@case("elementwise_mul")
+def _():
+    allclose(L.elementwise_mul(J(X1), J(Y1)), X1 * Y1)
+
+
+@case("elementwise_div")
+def _():
+    allclose(L.elementwise_div(J(X1), J(np.abs(Y1) + 1)), X1 / (np.abs(Y1) + 1),
+             rtol=1e-4)
+
+
+@case("elementwise_max")
+def _():
+    allclose(L.elementwise_max(J(X1), J(Y1)), np.maximum(X1, Y1))
+
+
+@case("elementwise_min")
+def _():
+    allclose(L.elementwise_min(J(X1), J(Y1)), np.minimum(X1, Y1))
+
+
+@case("elementwise_pow")
+def _():
+    allclose(L.elementwise_pow(J(np.abs(X1) + 0.5), J(np.abs(Y1))),
+             (np.abs(X1) + 0.5) ** np.abs(Y1), rtol=1e-4)
+
+
+# --- comparisons / logicals / predicates ----------------------------------
+
+
+@case("equal")
+def _():
+    a = np.array([1, 2, 3]); b = np.array([1, 5, 3])
+    allclose(L.equal(J(a), J(b)).astype(jnp.int32), (a == b).astype(np.int32))
+
+
+@case("less_than")
+def _():
+    a = np.array([1.0, 2.0]); b = np.array([2.0, 1.0])
+    allclose(L.less_than(J(a), J(b)).astype(jnp.int32), [1, 0])
+
+
+@case("logical_and")
+def _():
+    a = np.array([True, True, False]); b = np.array([True, False, False])
+    allclose(L.logical_and(J(a), J(b)).astype(jnp.int32), a & b)
+
+
+@case("logical_or")
+def _():
+    a = np.array([True, False]); b = np.array([False, False])
+    allclose(L.logical_or(J(a), J(b)).astype(jnp.int32), a | b)
+
+
+@case("logical_xor")
+def _():
+    a = np.array([True, False]); b = np.array([True, True])
+    allclose(L.logical_xor(J(a), J(b)).astype(jnp.int32), a ^ b)
+
+
+@case("logical_not")
+def _():
+    a = np.array([True, False])
+    allclose(L.logical_not(J(a)).astype(jnp.int32), ~a)
+
+
+@case("has_nan")
+def _():
+    assert bool(L.has_nan(J(np.array([1.0, np.nan])))) is True
+    assert bool(L.has_nan(J(X1))) is False
+
+
+@case("has_inf")
+def _():
+    assert bool(L.has_inf(J(np.array([1.0, np.inf])))) is True
+    assert bool(L.has_inf(J(X1))) is False
+
+
+@case("isfinite")
+def _():
+    assert bool(L.isfinite(J(X1))) is True
+    assert bool(L.isfinite(J(np.array([np.inf, 1.0])))) is False
+
+
+@case("is_empty")
+def _():
+    assert bool(L.is_empty(J(np.zeros((0, 3))))) is True
+    assert bool(L.is_empty(J(X1))) is False
+
+
+# --- reductions / arg ops / topk ------------------------------------------
+
+
+@case("reduce_sum")
+def _():
+    allclose(L.reduce_sum(J(X1)), X1.sum(), rtol=1e-5)
+    allclose(L.reduce_sum(J(X1), dim=1, keep_dim=True), X1.sum(1, keepdims=True),
+             rtol=1e-5)
+
+
+@case("reduce_mean")
+def _():
+    allclose(L.reduce_mean(J(X1), dim=0), X1.mean(0), rtol=1e-5)
+
+
+@case("reduce_max")
+def _():
+    allclose(L.reduce_max(J(X1), dim=1), X1.max(1))
+
+
+@case("reduce_min")
+def _():
+    allclose(L.reduce_min(J(X1)), X1.min())
+
+
+@case("reduce_prod")
+def _():
+    allclose(L.reduce_prod(J(X1), dim=1), X1.prod(1), rtol=1e-4)
+
+
+@case("mean")
+def _():
+    allclose(L.mean(J(X1)), X1.mean(), rtol=1e-5)
+
+
+@case("argmax")
+def _():
+    allclose(L.argmax(J(X1), axis=1), X1.argmax(1))
+
+
+@case("argmin")
+def _():
+    allclose(L.argmin(J(X1), axis=0), X1.argmin(0))
+
+
+@case("argsort")
+def _():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+    out = L.argsort(J(x), axis=1)
+    vals, idx = (out if isinstance(out, (tuple, list)) else (None, out))
+    if vals is not None:
+        allclose(vals, np.sort(x, 1))
+    allclose(idx, np.argsort(x, 1))
+
+
+@case("topk")
+def _():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+    vals, idx = L.topk(J(x), k=2)
+    allclose(vals, [[3.0, 2.0], [5.0, 4.0]])
+    allclose(idx, [[0, 2], [1, 2]])
+
+
+@case("sum")
+def _():
+    allclose(L.sum([J(X1), J(Y1), J(X1)]), X1 + Y1 + X1, rtol=1e-5)
+
+
+# --- tensor manipulation ---------------------------------------------------
+
+
+@case("concat")
+def _():
+    allclose(L.concat([J(X1), J(Y1)], axis=1), np.concatenate([X1, Y1], 1))
+
+
+@case("split")
+def _():
+    outs = L.split(J(X1), 2, dim=1)
+    for g, w in zip(outs, np.split(X1, 2, 1)):
+        allclose(g, w)
+    outs = L.split(J(X1), [1, 3], dim=1)
+    allclose(outs[0], X1[:, :1]); allclose(outs[1], X1[:, 1:])
+
+
+@case("reshape")
+def _():
+    allclose(L.reshape(J(X1), shape=[2, 6]), X1.reshape(2, 6))
+
+
+@case("squeeze")
+def _():
+    x = X1[:, None, :, None]
+    allclose(L.squeeze(J(x), axes=[1, 3]), X1)
+
+
+@case("unsqueeze")
+def _():
+    allclose(L.unsqueeze(J(X1), axes=[1]), X1[:, None, :])
+
+
+@case("stack")
+def _():
+    allclose(L.stack([J(X1), J(Y1)], axis=1), np.stack([X1, Y1], 1))
+
+
+@case("unstack")
+def _():
+    outs = L.unstack(J(X1), axis=0)
+    for g, w in zip(outs, X1):
+        allclose(g, w)
+
+
+@case("transpose")
+def _():
+    x = rs(4).randn(2, 3, 4).astype(np.float32)
+    allclose(L.transpose(J(x), perm=[2, 0, 1]), x.transpose(2, 0, 1))
+
+
+@case("reverse")
+def _():
+    allclose(L.reverse(J(X1), axis=1), X1[:, ::-1])
+
+
+@case("expand")
+def _():
+    allclose(L.expand(J(X1), expand_times=[2, 3]), np.tile(X1, (2, 3)))
+
+
+@case("slice")
+def _():
+    x = rs(5).randn(4, 5, 6).astype(np.float32)
+    allclose(L.slice(J(x), axes=[0, 2], starts=[1, 2], ends=[3, 5]),
+             x[1:3, :, 2:5])
+
+
+@case("gather")
+def _():
+    idx = np.array([2, 0, 1])
+    allclose(L.gather(J(X1), J(idx), axis=0), X1[idx])
+    allclose(L.gather(J(X1), J(idx), axis=1), X1[:, idx])
+
+
+@case("scatter")
+def _():
+    x = np.zeros((4, 3), np.float32)
+    upd = rs(6).randn(2, 3).astype(np.float32)
+    idx = np.array([3, 1])
+    want = x.copy(); want[idx] = upd
+    allclose(L.scatter(J(x), J(idx), J(upd), overwrite=True), want)
+    want2 = x.copy(); np.add.at(want2, idx, upd)
+    allclose(L.scatter(J(x), J(idx), J(upd), overwrite=False), want2)
+
+
+@case("pad")
+def _():
+    allclose(L.pad(J(X1), paddings=[1, 0, 0, 2], pad_value=7.0),
+             np.pad(X1, [(1, 0), (0, 2)], constant_values=7.0))
+
+
+@case("pad2d")
+def _():
+    x = rs(7).randn(1, 2, 3, 3).astype(np.float32)
+    want = np.pad(x, [(0, 0), (0, 0), (1, 2), (0, 1)])
+    allclose(L.pad2d(J(x), paddings=(1, 2, 0, 1)), want)
+
+
+@case("pad_constant_like")
+def _():
+    big = np.zeros((3, 4), np.float32)
+    small = rs(8).randn(2, 3).astype(np.float32)
+    want = np.pad(small, [(0, 1), (0, 1)], constant_values=5.0)
+    allclose(L.pad_constant_like(J(big), J(small), pad_value=5.0), want)
+
+
+@case("flatten")
+def _():
+    x = rs(9).randn(2, 3, 4, 5).astype(np.float32)
+    allclose(L.flatten(J(x), axis=2), x.reshape(6, 20))
+
+
+@case("assign")
+def _():
+    allclose(L.assign(J(X1)), X1)
+
+
+@case("cast")
+def _():
+    out = L.cast(J(X1), "int32")
+    assert A(out).dtype == np.int32
+    allclose(out, X1.astype(np.int32))
+
+
+@case("one_hot")
+def _():
+    ids = np.array([[1], [0], [2]], np.int64)
+    want = np.eye(4, dtype=np.float32)[ids[:, 0]]
+    allclose(L.one_hot(J(ids), depth=4), want)
+
+
+@case("increment")
+def _():
+    allclose(L.increment(J(np.array([3.0], np.float32)), value=2.5), [5.5])
+
+
+@case("shape")
+def _():
+    allclose(L.shape(J(np.zeros((2, 5, 3)))), [2, 5, 3])
+
+
+@case("fill_constant")
+def _():
+    allclose(L.fill_constant([2, 3], "float32", 2.5), np.full((2, 3), 2.5))
+
+
+@case("fill_constant_batch_size_like")
+def _():
+    out = L.fill_constant_batch_size_like(J(X1), [7, 4], "float32", 1.5)
+    allclose(out, np.full((3, 4), 1.5))  # dim 0 taken from input
+
+
+@case("ones")
+def _():
+    allclose(L.ones([2, 2]), np.ones((2, 2)))
+
+
+@case("zeros")
+def _():
+    allclose(L.zeros([3]), np.zeros(3))
+
+
+@case("multiplex")
+def _():
+    idx = np.array([[1], [0], [1]], np.int64)
+    want = np.where(idx == 1, Y1, X1)
+    allclose(L.multiplex([J(X1), J(Y1)], J(idx)), want)
+
+
+# --- matmul family ---------------------------------------------------------
+
+
+@case("matmul")
+def _():
+    a = rs(10).randn(2, 3, 4).astype(np.float32)
+    b = rs(11).randn(2, 5, 4).astype(np.float32)
+    allclose(L.matmul(J(a), J(b), transpose_y=True, alpha=0.5),
+             0.5 * a @ b.transpose(0, 2, 1), rtol=1e-4)
+
+
+@case("mul")
+def _():
+    a = rs(12).randn(2, 3, 4).astype(np.float32)
+    b = rs(13).randn(4, 5).astype(np.float32)
+    # x_num_col_dims=2: flatten x to [6, 4]; output regains [2, 3, 5]
+    allclose(L.mul(J(a), J(b), x_num_col_dims=2),
+             (a.reshape(6, 4) @ b).reshape(2, 3, 5), rtol=1e-4)
+
+
+@case("l2_normalize")
+def _():
+    want = X1 / np.sqrt((X1 * X1).sum(-1, keepdims=True))
+    allclose(L.l2_normalize(J(X1), axis=-1), want, rtol=1e-4)
+
+
+@case("cos_sim")
+def _():
+    want = (X1 * Y1).sum(-1, keepdims=True) / (
+        np.linalg.norm(X1, axis=-1, keepdims=True)
+        * np.linalg.norm(Y1, axis=-1, keepdims=True))
+    allclose(L.cos_sim(J(X1), J(Y1)), want, rtol=1e-4)
+
+
+@case("clip")
+def _():
+    allclose(L.clip(J(X1), min=-0.5, max=0.5), np.clip(X1, -0.5, 0.5))
+
+
+@case("clip_by_norm")
+def _():
+    n = np.linalg.norm(X1)
+    allclose(L.clip_by_norm(J(X1), max_norm=1.0), X1 / max(n, 1.0), rtol=1e-4)
+    allclose(L.clip_by_norm(J(X1 * 1e-3), max_norm=1.0), X1 * 1e-3, rtol=1e-4)
+
+
+@case("scale")
+def _():
+    allclose(L.scale(J(X1), scale=2.0, bias=1.0, bias_after_scale=True),
+             2 * X1 + 1)
+    allclose(L.scale(J(X1), scale=2.0, bias=1.0, bias_after_scale=False),
+             2 * (X1 + 1))
+
+
+# --- losses ----------------------------------------------------------------
+
+
+@case("cross_entropy")
+def _():
+    p = np.array([[0.2, 0.8], [0.6, 0.4]], np.float32)
+    lab = np.array([[1], [0]], np.int64)
+    allclose(L.cross_entropy(J(p), J(lab)),
+             -np.log([[0.8], [0.6]]), rtol=1e-4)
+    soft = np.array([[0.3, 0.7], [0.5, 0.5]], np.float32)
+    allclose(L.cross_entropy(J(p), J(soft), soft_label=True),
+             -(soft * np.log(p)).sum(-1, keepdims=True), rtol=1e-4)
+
+
+@case("softmax_with_cross_entropy")
+def _():
+    import torch
+    import torch.nn.functional as F
+    logits = rs(14).randn(4, 5).astype(np.float32)
+    lab = np.array([[0], [3], [2], [1]], np.int64)
+    ref = F.cross_entropy(torch.tensor(logits), torch.tensor(lab[:, 0]),
+                          reduction="none").numpy()[:, None]
+    allclose(L.softmax_with_cross_entropy(J(logits), J(lab)), ref, rtol=1e-4)
+
+
+@case("sigmoid_cross_entropy_with_logits")
+def _():
+    import torch
+    import torch.nn.functional as F
+    x = rs(15).randn(3, 4).astype(np.float32)
+    lab = rs(16).rand(3, 4).astype(np.float32)
+    ref = F.binary_cross_entropy_with_logits(
+        torch.tensor(x), torch.tensor(lab), reduction="none").numpy()
+    allclose(L.sigmoid_cross_entropy_with_logits(J(x), J(lab)), ref, rtol=1e-4)
+
+
+@case("square_error_cost")
+def _():
+    allclose(L.square_error_cost(J(X1), J(Y1)), (X1 - Y1) ** 2, rtol=1e-5)
+
+
+@case("log_loss")
+def _():
+    p = rs(17).rand(4, 1).astype(np.float32)
+    lab = (rs(18).rand(4, 1) > 0.5).astype(np.float32)
+    eps = 1e-4
+    want = -lab * np.log(p + eps) - (1 - lab) * np.log(1 - p + eps)
+    allclose(L.log_loss(J(p), J(lab)), want, rtol=1e-4)
+
+
+@case("smooth_l1")
+def _():
+    x = rs(19).randn(3, 4).astype(np.float32)
+    y = rs(20).randn(3, 4).astype(np.float32)
+    sigma = 2.0
+    d = x - y
+    elem = np.where(np.abs(d) < 1 / sigma**2, 0.5 * sigma**2 * d * d,
+                    np.abs(d) - 0.5 / sigma**2)
+    allclose(L.smooth_l1(J(x), J(y), sigma=sigma),
+             elem.sum(1, keepdims=True), rtol=1e-4)
+
+
+@case("rank_loss")
+def _():
+    lab = np.array([[1.0], [0.0]], np.float32)
+    left = np.array([[0.2], [0.8]], np.float32)
+    right = np.array([[0.5], [0.1]], np.float32)
+    o = left - right
+    want = np.log1p(np.exp(o)) - lab * o
+    allclose(L.rank_loss(J(lab), J(left), J(right)), want, rtol=1e-4)
+
+
+@case("margin_rank_loss")
+def _():
+    lab = np.array([[1.0], [-1.0]], np.float32)
+    left = np.array([[0.2], [0.8]], np.float32)
+    right = np.array([[0.5], [0.1]], np.float32)
+    want = np.maximum(0, -lab * (left - right) + 0.1)
+    allclose(L.margin_rank_loss(J(lab), J(left), J(right)), want, rtol=1e-4)
+
+
+@case("dice_loss")
+def _():
+    p = rs(21).rand(2, 4).astype(np.float32)
+    lab = np.array([[1], [3]], np.int64)
+    oh = np.eye(4, dtype=np.float32)[lab[:, 0]]
+    inter = (p * oh).sum(-1)
+    want = np.mean(1 - 2 * inter / (p.sum(-1) + oh.sum(-1) + 1e-5))
+    allclose(L.dice_loss(J(p), J(lab), epsilon=1e-5), want, rtol=1e-4)
+
+
+@case("label_smooth")
+def _():
+    lab = np.eye(3, dtype=np.float32)[[0, 2]]
+    want = (1 - 0.1) * lab + 0.1 / 3
+    allclose(L.label_smooth(J(lab), epsilon=0.1), want, rtol=1e-4)
+
+
+# --- metrics-as-layers -----------------------------------------------------
+
+
+@case("accuracy")
+def _():
+    probs = np.array([[0.9, 0.1, 0.0], [0.2, 0.5, 0.3], [0.5, 0.3, 0.2]],
+                     np.float32)
+    lab = np.array([[0], [2], [1]], np.int64)
+    allclose(L.accuracy(J(probs), J(lab), k=1), 1.0 / 3)
+    allclose(L.accuracy(J(probs), J(lab), k=2), 1.0)
+
+
+@case("mean_iou")
+def _():
+    pred = np.array([0, 0, 1, 1], np.int64)
+    lab = np.array([0, 1, 1, 1], np.int64)
+    # class0: inter 1, union 2 -> 0.5 ; class1: inter 2, union 3 -> 2/3
+    out = L.mean_iou(J(pred), J(lab), num_classes=2)
+    miou = out[0] if isinstance(out, (tuple, list)) else out
+    allclose(miou, (0.5 + 2 / 3) / 2, rtol=1e-4)
+
+
+# --- lr schedules ----------------------------------------------------------
+
+
+def _sched_val(s, step):
+    v = s(step) if callable(s) else s.value(step)
+    return float(A(v))
+
+
+@case("exponential_decay")
+def _():
+    s = L.exponential_decay(0.1, decay_steps=10, decay_rate=0.5)
+    allclose(_sched_val(s, 20), 0.1 * 0.5 ** 2.0, rtol=1e-5)
+    st = L.exponential_decay(0.1, 10, 0.5, staircase=True)
+    allclose(_sched_val(st, 25), 0.1 * 0.5 ** 2, rtol=1e-5)
+
+
+@case("natural_exp_decay")
+def _():
+    s = L.natural_exp_decay(0.1, 10, 0.5)
+    allclose(_sched_val(s, 20), 0.1 * np.exp(-0.5 * 2.0), rtol=1e-5)
+
+
+@case("inverse_time_decay")
+def _():
+    s = L.inverse_time_decay(0.1, 10, 0.5)
+    allclose(_sched_val(s, 20), 0.1 / (1 + 0.5 * 2.0), rtol=1e-5)
+
+
+@case("polynomial_decay")
+def _():
+    s = L.polynomial_decay(0.1, 10, end_learning_rate=0.01, power=2.0)
+    frac = 1 - 5 / 10
+    allclose(_sched_val(s, 5), (0.1 - 0.01) * frac ** 2 + 0.01, rtol=1e-5)
+    allclose(_sched_val(s, 100), 0.01, rtol=1e-5)  # clamps past decay_steps
+
+
+@case("piecewise_decay")
+def _():
+    s = L.piecewise_decay([10, 20], [0.1, 0.05, 0.01])
+    for step, want in [(5, 0.1), (15, 0.05), (25, 0.01)]:
+        allclose(_sched_val(s, step), want, rtol=1e-6)
+
+
+@case("noam_decay")
+def _():
+    s = L.noam_decay(d_model=64, warmup_steps=100)
+    want = 64 ** -0.5 * min(7 * 100 ** -1.5, 7 ** -0.5)
+    allclose(_sched_val(s, 7), want, rtol=1e-5)
+
+
+# --- detection -------------------------------------------------------------
+
+
+@case("iou_similarity")
+def _():
+    a = np.array([[0, 0, 2, 2]], np.float32)
+    b = np.array([[1, 1, 3, 3], [0, 0, 2, 2], [4, 4, 5, 5]], np.float32)
+    want = np.array([[1 / 7, 1.0, 0.0]], np.float32)
+    allclose(L.iou_similarity(J(a), J(b)), want, rtol=1e-4)
+
+
+@case("box_coder")
+def _():
+    prior = np.array([[0., 0., 2., 2.]], np.float32)     # w=2 h=2 c=(1,1)
+    var = np.array([[0.1, 0.1, 0.2, 0.2]], np.float32)
+    gt = np.array([[1., 1., 3., 3.]], np.float32)        # w=2 h=2 c=(2,2)
+    enc = L.box_coder(J(prior), J(var), J(gt), code_type="encode_center_size")
+    want = np.array([(2 - 1) / 2 / 0.1, (2 - 1) / 2 / 0.1,
+                     np.log(2 / 2) / 0.2, np.log(2 / 2) / 0.2], np.float32)
+    allclose(np.ravel(A(enc)), want, rtol=1e-4)
+    dec = L.box_coder(J(prior), J(var), enc,
+                      code_type="decode_center_size")
+    allclose(np.ravel(A(dec)), np.ravel(gt), rtol=1e-4)
+
+
+@case("bipartite_match")
+def _():
+    # row 0 best for col 0 (0.9); then row 2 best remaining for col 1 (0.3)
+    dist = np.array([[0.9, 0.1], [0.4, 0.2], [0.2, 0.3]], np.float32)
+    out = L.bipartite_match(J(dist))
+    idx = A(out[0] if isinstance(out, (tuple, list)) else out).ravel()
+    assert idx[0] == 0 and idx[1] == 2, idx
+
+
+@case("prior_box")
+def _():
+    boxes, vars_ = L.prior_box((1, 1), (10, 10), min_sizes=[4.0],
+                               aspect_ratios=[1.0], steps=(10.0, 10.0))
+    b = A(boxes).reshape(-1, 4)
+    # center (5,5), box 4x4 -> normalized [0.3, 0.3, 0.7, 0.7]
+    allclose(b[0], [0.3, 0.3, 0.7, 0.7], rtol=1e-4)
+    v = A(vars_).reshape(-1, 4)
+    allclose(v[0], [0.1, 0.1, 0.2, 0.2], rtol=1e-5)
+
+
+@case("ssd_loss")
+def _():
+    # one location, perfectly matched: loc loss 0; conf = softmax CE
+    loc = np.zeros((1, 1, 4), np.float32)
+    conf = np.array([[[0.0, 4.0]]], np.float32)
+    gt_off = np.zeros((1, 1, 4), np.float32)
+    gt_lab = np.array([[1]], np.int64)
+    match = np.ones((1, 1), np.float32)
+    out = L.ssd_loss(J(loc), J(conf), J(gt_off), J(gt_lab), J(match),
+                     conf_weight=1.0, loc_weight=1.0)
+    ce = -np.log(np.exp(4.0) / (1 + np.exp(4.0)))
+    total = float(np.sum(A(out)))
+    np.testing.assert_allclose(total, ce, rtol=1e-3, atol=1e-3)
+
+
+# --- sequence / misc -------------------------------------------------------
+
+
+@case("sequence_mask")
+def _():
+    allclose(L.sequence_mask(J(np.array([1, 3])), maxlen=4),
+             [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+
+@case("sequence_first_step")
+def _():
+    packed = np.arange(10, dtype=np.float32).reshape(5, 2)  # seqs [3, 2]
+    seg = np.array([0, 0, 0, 1, 1], np.int32)
+    allclose(L.sequence_first_step(J(packed), J(seg), num_seqs=2),
+             packed[[0, 3]])
+
+
+@case("sequence_last_step")
+def _():
+    packed = np.arange(10, dtype=np.float32).reshape(5, 2)  # seqs [3, 2]
+    seg = np.array([0, 0, 0, 1, 1], np.int32)
+    allclose(L.sequence_last_step(J(packed), J(seg), num_seqs=2),
+             packed[[2, 4]])
+
+
+@case("hash")
+def _():
+    ids = np.array([[1], [2], [1]], np.int64)
+    out1 = A(L.hash(J(ids), hash_size=100))
+    out2 = A(L.hash(J(ids), hash_size=100))
+    np.testing.assert_array_equal(out1, out2)       # deterministic
+    assert out1.min() >= 0 and out1.max() < 100      # in range
+    np.testing.assert_array_equal(out1[0], out1[2])  # same id -> same hash
+
+
+@case("edit_distance")
+def _():
+    # kitten -> sitting = 3 (as int sequences)
+    a = np.array([[1, 2, 3, 3, 4, 5]], np.int64)       # "kitten"
+    b = np.array([[6, 2, 3, 3, 2, 5, 7]], np.int64)    # "sitting"
+    d = L.edit_distance(J(a), J(b), normalized=False)
+    allclose(np.ravel(A(d if not isinstance(d, (tuple, list)) else d[0]))[:1],
+             [3.0], rtol=1e-5)
+
+
+@case("chunk_eval")
+def _():
+    hyp = [[(0, 1, "A"), (2, 3, "B")]]   # 2 predicted chunks
+    ref = [[(0, 1, "A"), (4, 5, "B")]]   # 1 of them correct
+    p, r, f1 = L.chunk_eval(hyp, ref)
+    allclose(p, 0.5, rtol=1e-6)
+    allclose(r, 0.5, rtol=1e-6)
+    allclose(f1, 0.5, rtol=1e-6)
+
+
+@case("auc")
+def _():
+    probs = np.array([[0.2, 0.8], [0.7, 0.3], [0.4, 0.6], [0.9, 0.1]],
+                     np.float32)
+    lab = np.array([[1], [0], [1], [0]], np.int64)
+    out, _ = build_run(L.auc, probs, lab, num_thresholds=200)
+    val = out[0] if isinstance(out, (tuple, list)) else out
+    allclose(val, 1.0, atol=0.02)  # perfectly separable ranking
+
+
+@case("Print")
+def _():
+    allclose(L.Print(J(X1), message="sweep"), X1)  # identity data-path
+
+
+# --- randomness (statistical / determinism contracts) ---------------------
+
+
+@case("gaussian_random")
+def _():
+    x = A(L.gaussian_random([2000], mean=1.0, std=2.0, seed=7))
+    assert abs(x.mean() - 1.0) < 0.2 and abs(x.std() - 2.0) < 0.2
+    y = A(L.gaussian_random([2000], mean=1.0, std=2.0, seed=7))
+    np.testing.assert_array_equal(x, y)  # seeded determinism
+
+
+@case("uniform_random_batch_size_like")
+def _():
+    ref = np.zeros((500, 3), np.float32)
+    x = A(L.uniform_random_batch_size_like(J(ref), [7, 4], min=-2.0, max=2.0,
+                                           seed=5))
+    assert x.shape[0] == 500
+    assert x.min() >= -2.0 and x.max() <= 2.0 and abs(x.mean()) < 0.2
+
+
+@case("sampling_id")
+def _():
+    probs = np.array([[0.0, 1.0, 0.0]] * 8, np.float32)
+    ids = A(L.sampling_id(J(probs), seed=3))
+    np.testing.assert_array_equal(np.ravel(ids), np.ones(8))  # degenerate dist
+
+
+# --- RNN steps over padded batches ----------------------------------------
+
+
+def _lstm_ref(x, w_x, w_h, b, forget_bias=0.0):
+    bsz, t, d = x.shape
+    size = w_h.shape[0]
+    h = np.zeros((bsz, size), np.float32)
+    c = np.zeros((bsz, size), np.float32)
+    outs = []
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for k in range(t):
+        g = x[:, k] @ w_x + h @ w_h + b
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c = sig(f + forget_bias) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs, 1), h, c
+
+
+@case("dynamic_lstm")
+def _():
+    x = rs(23).randn(2, 3, 4).astype(np.float32)
+    (outs, (h, c)), params = build_run(L.dynamic_lstm, x, size=5,
+                                       forget_bias=1.0)
+    w_x = params["lstm_0/w_x"]; w_h = params["lstm_0/w_h"]; b = params["lstm_0/b"]
+    ro, rh, rc = _lstm_ref(x, w_x, w_h, b, forget_bias=1.0)
+    allclose(outs, ro, rtol=1e-4, atol=1e-4)
+    allclose(h, rh, rtol=1e-4, atol=1e-4)
+    allclose(c, rc, rtol=1e-4, atol=1e-4)
+
+
+def _gru_ref(x, w_x, w_h, b):
+    bsz, t, d = x.shape
+    size = w_h.shape[0]
+    h = np.zeros((bsz, size), np.float32)
+    outs = []
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for k in range(t):
+        xp = x[:, k] @ w_x + b
+        zr = sig(xp[:, :2 * size] + h @ w_h[:, :2 * size])
+        z, r = zr[:, :size], zr[:, size:]
+        cand = np.tanh(xp[:, 2 * size:] + (r * h) @ w_h[:, 2 * size:])
+        h = (1 - z) * h + z * cand
+        outs.append(h)
+    return np.stack(outs, 1)
+
+
+@case("dynamic_gru")
+def _():
+    x = rs(24).randn(2, 3, 4).astype(np.float32)
+    outs, params = build_run(L.dynamic_gru, x, size=5)
+    ro = _gru_ref(x, params["gru_0/w_x"], params["gru_0/w_h"], params["gru_0/b"])
+    allclose(outs, ro, rtol=1e-4, atol=1e-4)
+
+
+# --- convs / norms via independent oracle (torch) -------------------------
+
+
+@case("conv3d")
+def _():
+    # 1x1x1 conv == channel matmul (same oracle style as test_conv2d)
+    x = rs(25).randn(1, 3, 2, 4, 4).astype(np.float32)
+    out, params = build_run(L.conv3d, x, num_filters=2, filter_size=1,
+                            bias_attr=False)
+    w = params["conv3d_0/w"].reshape(2, 3)
+    allclose(out, np.einsum("ncdhw,oc->nodhw", x, w), rtol=1e-4, atol=1e-4)
+
+
+@case("lrn")
+def _():
+    import torch
+    import torch.nn.functional as F
+    x = rs(26).randn(1, 6, 3, 3).astype(np.float32)
+    # paddle lrn: sums over the window WITHOUT torch's averaging -> torch
+    # alpha is per-element, paddle's is per-window: alpha_torch = alpha * n
+    ref = F.local_response_norm(torch.tensor(x), size=5, alpha=1e-4 * 5,
+                                beta=0.75, k=1.0).numpy()
+    allclose(L.lrn(J(x), n=5, k=1.0, alpha=1e-4, beta=0.75), ref,
+             rtol=1e-4, atol=1e-5)
+
+
+@case("image_resize")
+def _():
+    import torch
+    import torch.nn.functional as F
+    x = rs(27).randn(1, 2, 4, 4).astype(np.float32)
+    ref = F.interpolate(torch.tensor(x), size=(8, 8), mode="bilinear",
+                        align_corners=True).numpy()
+    allclose(L.image_resize(J(x), out_shape=(8, 8), align_corners=True), ref,
+             rtol=1e-4, atol=1e-5)
+
+
+@case("resize_bilinear")
+def _():
+    import torch
+    import torch.nn.functional as F
+    x = rs(28).randn(1, 2, 3, 5).astype(np.float32)
+    ref = F.interpolate(torch.tensor(x), size=(6, 10), mode="bilinear",
+                        align_corners=True).numpy()
+    allclose(L.resize_bilinear(J(x), out_shape=(6, 10)), ref,
+             rtol=1e-4, atol=1e-5)
+
+
+# --- array/TensorArray ops -------------------------------------------------
+
+
+@case("create_array")
+def _():
+    arr = L.create_array(capacity=3, element_shape=(2,))
+    arr = L.array_write(arr, 0, J(np.array([1.0, 2.0], np.float32)))
+    arr = L.array_write(arr, 1, J(np.array([3.0, 4.0], np.float32)))
+    allclose(L.array_read(arr, 1), [3.0, 4.0])
+    allclose(L.array_read(arr, 0), [1.0, 2.0])
+    allclose(L.array_read(arr, 2), [0.0, 0.0])  # unwritten slot stays zero
+    # static-capacity TensorArray: length is the preallocated capacity
+    assert int(A(L.array_length(arr))) == 3
+
+
+@case("array_write")
+def _():
+    CASES["create_array"]()  # same round-trip exercises write
+
+
+@case("array_read")
+def _():
+    CASES["create_array"]()
+
+
+@case("array_length")
+def _():
+    CASES["create_array"]()
+
+
+@case("create_parameter")
+def _():
+    from paddle_tpu import initializer as init
+
+    def net(x):
+        w = L.create_parameter((4, 2), "float32", name="cp",
+                               initializer=init.Constant(1.5))
+        return x @ w
+
+    prog = pt.build(net)
+    params, state = prog.init(jax.random.PRNGKey(0), J(X1))
+    (wname, wval), = params.items()
+    allclose(wval, np.full((4, 2), 1.5))
+    out, _ = prog.apply(params, state, J(X1))
+    allclose(out, X1 @ np.full((4, 2), 1.5), rtol=1e-5)
+
+
+@case("create_tensor")
+def _():
+    t = L.create_tensor(dtype="float32")
+    assert A(t).dtype == np.float32
+
+
+# --------------------------------------------------------------------------
+# Names whose numerics are already asserted by a dedicated suite.
+# The meta-test checks the file actually mentions the op.
+COVERED = {
+    # test_layers.py — core op numerics (fc/conv/norm/pool/softmax/...)
+    "fc": "test_layers.py", "embedding": "test_layers.py",
+    "conv2d": "test_layers.py", "conv2d_transpose": "test_layers.py",
+    "pool2d": "test_layers.py", "batch_norm": "test_layers.py",
+    "layer_norm": "test_layers.py", "softmax": "test_layers.py",
+    "dropout": "test_layers.py", "nce": "test_ctc_sampled.py",
+    "hsigmoid": "test_ctc_sampled.py", "grid_sampler": "test_layers_extended.py",
+    "affine_grid": "test_layers_extended.py",
+    # test_layers_extended.py
+    "affine_channel": "test_layers_extended.py",
+    "crop": "test_layers_extended.py",
+    "random_crop": "test_layers_extended.py",
+    "add_position_encoding": "test_layers_extended.py",
+    "pool3d": "test_layers_extended.py",
+    "conv3d_transpose": "test_layers_extended.py",
+    "im2sequence": "test_layers_extended.py",
+    "row_conv": "test_layers_extended.py",
+    "image_resize_short": "test_layers_extended.py",
+    "gaussian_random_batch_size_like": "test_layers_extended.py",
+    "sequence_conv": "test_layers_extended.py",
+    "lstm_unit": "test_layers_extended.py",
+    "gru_unit": "test_layers_extended.py",
+    "dynamic_lstmp": "test_layers_extended.py",
+    "create_global_var": "test_layers_extended.py",
+    "autoincreased_step_counter": "test_layers_extended.py",
+    "sums": "test_layers_extended.py",
+    "append_LARS": "test_layers_extended.py",
+    "roi_pool": "test_layers_extended.py",
+    "roi_align": "test_layers_extended.py",
+    "roi_perspective_transform": "test_layers_extended.py",
+    "anchor_generator": "test_layers_extended.py",
+    "generate_proposals": "test_layers_extended.py",
+    "generate_proposal_labels": "test_layers_extended.py",
+    "rpn_target_assign": "test_layers_extended.py",
+    "target_assign": "test_layers_extended.py",
+    "polygon_box_transform": "test_layers_extended.py",
+    "detection_output": "test_layers_extended.py",
+    "detection_map": "test_layers_extended.py",
+    "multi_box_head": "test_layers_extended.py",
+    "While": "test_layers_extended.py",
+    "IfElse": "test_layers_extended.py",
+    "Switch": "test_layers_extended.py",
+    "StaticRNN": "test_layers_extended.py",
+    "DynamicRNN": "test_layers_extended.py",
+    # dedicated suites
+    "linear_chain_crf": "test_crf.py",
+    "crf_decoding": "test_crf.py",
+    "warpctc": "test_ctc_sampled.py",
+    "ctc_greedy_decoder": "test_ctc_sampled.py",
+    "beam_search": "test_beam_search.py",
+    "beam_search_decode": "test_layers_extended.py",
+    "sequence_pool": "test_sequence_ops.py",
+    "sequence_softmax": "test_sequence_ops.py",
+    "sequence_pad": "test_sequence_ops.py",
+    "sequence_unpad": "test_sequence_ops.py",
+    "sequence_expand": "test_sequence_ops.py",
+    "sequence_expand_as": "test_layers_extended.py",
+    "sequence_reshape": "test_layers_extended.py",
+    "sequence_scatter": "test_layers_extended.py",
+    "sequence_reverse": "test_sequence_ops.py",
+    "sequence_concat": "test_sequence_ops.py",
+    "sequence_enumerate": "test_sequence_ops.py",
+    "sequence_slice": "test_sequence_ops.py",
+    "lod_reset": "test_layers_extended.py",
+    "reorder_lod_tensor_by_rank": "test_layers_extended.py",
+    "data": "test_layers_extended.py",
+    "py_reader": "test_layers_extended.py",
+    "batch": "test_layers_extended.py",
+    "shuffle": "test_layers_extended.py",
+    "double_buffer": "test_layers_extended.py",
+    "read_file": "test_layers_extended.py",
+    "random_data_generator": "test_layers_extended.py",
+    "Preprocessor": "test_layers_extended.py",
+}
+
+# Non-array infrastructure: nothing numeric to assert.
+EXEMPT = {
+    "autodoc": "doc decorator — attaches a docstring, no computation",
+    "templatedoc": "doc decorator — no computation",
+    "deprecated": "deprecation-warning decorator — no computation",
+    "generate_layer_fn": "codegen helper producing the elementwise wrappers "
+                         "whose numerics CASES tests (relu/exp/...)",
+    "generate_layer_fn_noattr": "codegen helper — see generate_layer_fn",
+    "load": "parameter-file loader; artifact IO round-trips are covered by "
+            "io save/load tests (test_e2e_mnist, test_recordio_quantize)",
+    "open_files": "file-reader constructor over recordio artifacts; the "
+                  "native reader datapath is covered by test_recordio_quantize",
+}
+
+
+# --------------------------------------------------------------------------
+
+
+def test_surface_partitioned():
+    """Every public layer name has exactly one coverage disposition."""
+    surface = set(REFERENCE_LAYERS_ALL)
+    cased, covered, exempt = set(CASES), set(COVERED), set(EXEMPT)
+    assert not (cased & covered), cased & covered
+    assert not (cased & exempt), cased & exempt
+    assert not (covered & exempt), covered & exempt
+    union = cased | covered | exempt
+    missing = sorted(surface - union)
+    extra = sorted(union - surface)
+    assert not missing, f"layers with NO numeric coverage: {missing}"
+    assert not extra, f"sweep names not on the surface: {extra}"
+
+
+def test_covered_pointers_valid():
+    import os
+    here = os.path.dirname(__file__)
+    by_file = {}
+    for name, fname in COVERED.items():
+        by_file.setdefault(fname, []).append(name)
+    for fname, names in by_file.items():
+        path = os.path.join(here, fname)
+        assert os.path.exists(path), fname
+        src = open(path).read()
+        for n in names:
+            assert n in src, f"{fname} does not mention {n!r}"
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_numeric(name):
+    CASES[name]()
+
+
+# --- finite-difference grad checks (op_test.py:43 discipline) -------------
+
+GRAD_OPS = {
+    "elu": lambda x: L.elu(x, alpha=0.5),
+    "swish": lambda x: L.swish(x, beta=1.5),
+    "stanh": lambda x: L.stanh(x),
+    "soft_relu": lambda x: L.soft_relu(x),
+    "l2_normalize": lambda x: L.l2_normalize(x, axis=-1),
+    "log_loss_input": lambda p: L.log_loss(p, jnp.asarray([[1.0], [0.0]])),
+    "smooth_l1": lambda x: L.smooth_l1(x, jnp.zeros_like(x), sigma=1.5),
+    "rank_loss": lambda left: L.rank_loss(
+        jnp.asarray([[1.0], [0.0]]), left, jnp.asarray([[0.3], [0.4]])),
+    "cos_sim": lambda x: L.cos_sim(x, jnp.asarray(Y1[:2, :3])),
+    "maxout": lambda x: L.maxout(x, groups=2, axis=1),
+    "lrn": lambda x: L.lrn(x, n=3),
+    "reduce_prod": lambda x: L.reduce_prod(x, dim=1),
+    "clip_by_norm": lambda x: L.clip_by_norm(x, max_norm=0.8),
+    "sigmoid_ce": lambda x: L.sigmoid_cross_entropy_with_logits(
+        x, jnp.asarray((rs(30).rand(2, 3) > 0.5).astype(np.float32))),
+    "softmax_ce": lambda x: L.softmax_with_cross_entropy(
+        x, jnp.asarray(np.array([[1], [0]], np.int64))),
+    "dice_loss": lambda x: L.dice_loss(
+        jax.nn.softmax(x, axis=-1), jnp.asarray(np.array([[0], [2]],
+                                                         np.int64))),
+}
+
+GRAD_INPUTS = {
+    "log_loss_input": lambda: rs(32).rand(2, 1).astype(np.float32) * 0.8 + 0.1,
+    "smooth_l1": lambda: rs(33).randn(2, 3).astype(np.float32),
+    "rank_loss": lambda: rs(34).randn(2, 1).astype(np.float32),
+    "cos_sim": lambda: rs(35).randn(2, 3).astype(np.float32) + 0.5,
+    "maxout": lambda: rs(36).randn(1, 4, 2, 2).astype(np.float32),
+    "lrn": lambda: rs(37).randn(1, 4, 2, 2).astype(np.float32),
+    "softmax_ce": lambda: rs(38).randn(2, 4).astype(np.float32),
+}
+
+
+@pytest.mark.parametrize("name", sorted(GRAD_OPS))
+def test_fd_grad(name):
+    make = GRAD_INPUTS.get(name, lambda: rs(29).randn(2, 3)
+                           .astype(np.float32) * 0.5)
+    x = make()
+    check_grad(GRAD_OPS[name], [x], atol=5e-2, rtol=5e-2)
