@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .core.errors import enforce
+
 SEP = "||"  # path separator for nested pytree keys (param names use '/')
 
 # numpy's npz format stores ml_dtypes extension types (bfloat16, fp8) as
@@ -58,6 +60,33 @@ def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
             out[f"{prefix}@raw"] = arr
         else:
             out[prefix] = arr
+    return out
+
+
+def _flat_leaves_in_tree_order(tree: Any, prefix: str = ""):
+    """(npz_key, value) pairs in jax's pytree flatten order (per-level
+    sorted ORIGINAL keys, depth-first) — NOT sorted mangled npz keys,
+    which diverge ('a2' vs 'a||x' sorts differently than 'a' vs 'a2';
+    '@bfloat16' suffixes shift order). Used by save_inference_model to
+    bind npz members to executable argument positions; npz key mangling
+    mirrors _flatten exactly."""
+    out = []
+    if isinstance(tree, dict):
+        for k in sorted(tree, key=str):
+            out += _flat_leaves_in_tree_order(
+                tree[k], f"{prefix}{SEP}{k}" if prefix else str(k))
+    elif tree is None:
+        pass
+    else:
+        arr = np.asarray(tree)
+        if arr.dtype.name in _EXOTIC_DTYPES:
+            out.append((f"{prefix}@{arr.dtype.name}", arr))
+        elif (prefix.endswith("@raw")
+              or any(prefix.endswith(f"@{dt}") and arr.dtype == enc
+                     for dt, enc in _EXOTIC_DTYPES.items())):
+            out.append((f"{prefix}@raw", arr))
+        else:
+            out.append((prefix, arr))
     return out
 
 
@@ -192,14 +221,49 @@ def save_inference_model(dirname: str, program, params: Dict[str, jax.Array],
         return out
 
     example_vals = [jnp.asarray(np.asarray(example_feed[k])) for k in feed_names]
+    host_params, host_state = jax.device_get(params), jax.device_get(state)
     exported = jax.export.export(jax.jit(infer_fn))(
-        jax.device_get(params), jax.device_get(state), *example_vals)
+        host_params, host_state, *example_vals)
     with open(os.path.join(dirname, "model.stablehlo"), "wb") as f:
         f.write(exported.serialize())
-    np.savez(os.path.join(dirname, "params.npz"), **_flatten(jax.device_get(params)))
-    np.savez(os.path.join(dirname, "state.npz"), **_flatten(jax.device_get(state)))
+    np.savez(os.path.join(dirname, "params.npz"), **_flatten(host_params))
+    np.savez(os.path.join(dirname, "state.npz"), **_flatten(host_state))
+    # Python-free deployment artifact (inference/io.h:35 analog): the raw
+    # StableHLO bytecode plus the flat call signature, so native/
+    # predictor.cc can compile+run through the PJRT C API with no
+    # libpython. Inputs are the flattened (params, state, *feeds) leaves
+    # in exported.in_avals order; "source" tells the C++ loader which
+    # npz member (or feed) supplies each argument.
+    with open(os.path.join(dirname, "model.mlir"), "wb") as f:
+        f.write(exported.mlir_module_serialized)
+    param_leaves = _flat_leaves_in_tree_order(host_params)
+    state_leaves = _flat_leaves_in_tree_order(host_state)
+    flat_sources = ([("params.npz", k) for k, _ in param_leaves]
+                    + [("state.npz", k) for k, _ in state_leaves]
+                    + [("feed", k) for k in feed_names])
+    flat_vals = ([v for _, v in param_leaves] + [v for _, v in state_leaves]
+                 + [np.asarray(example_feed[k]) for k in feed_names])
+    enforce(len(flat_sources) == len(exported.in_avals),
+            f"export signature mismatch: {len(flat_sources)} leaves vs "
+            f"{len(exported.in_avals)} in_avals")
+    for (src, name), val, av in zip(flat_sources, flat_vals, exported.in_avals):
+        enforce(tuple(val.shape) == tuple(av.shape),
+                f"export arg order broke: {src}:{name} has shape {val.shape}, "
+                f"aval expects {av.shape}")
+        # npz members store exotic dtypes as integer views ('@bfloat16'
+        # suffix); the ORIGINAL dtype must still match the aval
+        if src != "feed" and "@" not in name:
+            enforce(val.dtype.name == str(av.dtype),
+                    f"export arg order broke: {src}:{name} is {val.dtype.name},"
+                    f" aval expects {av.dtype}")
+    in_spec = [{"source": src, "name": name,
+                "dtype": str(av.dtype), "shape": list(av.shape)}
+               for (src, name), av in zip(flat_sources, exported.in_avals)]
+    out_spec = [{"dtype": str(av.dtype), "shape": list(av.shape)}
+                for av in exported.out_avals]
     with open(os.path.join(dirname, "meta.json"), "w") as f:
-        json.dump({"feed_names": feed_names}, f)
+        json.dump({"feed_names": feed_names, "inputs": in_spec,
+                   "outputs": out_spec}, f)
 
 
 class Predictor:

@@ -854,6 +854,18 @@ def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
     oh, ow = out_shape
     x = input if data_format == "NHWC" else jnp.transpose(input, (0, 2, 3, 1))
     method = "bilinear" if resample.upper() == "BILINEAR" else "nearest"
+    if method == "nearest" and align_corners:
+        # nearest_interp_op with align_corners: index round(o*(in-1)/(out-1))
+        # per axis (half-pixel jax.image.resize picks different pixels)
+        def nn_idx(in_size, out_size):
+            if out_size == 1 or in_size == 1:
+                return jnp.zeros((out_size,), jnp.int32)
+            r = (in_size - 1) / (out_size - 1)
+            return jnp.round(jnp.arange(out_size) * r).astype(jnp.int32)
+
+        out = jnp.take(jnp.take(x, nn_idx(h, oh), axis=1),
+                       nn_idx(w, ow), axis=2)
+        return out if data_format == "NHWC" else jnp.transpose(out, (0, 3, 1, 2))
     if method == "bilinear" and align_corners:
         # align_corners=True (the reference default, bilinear_interp_op):
         # output pixel o samples input at o*(in-1)/(out-1), axis by axis.

@@ -1,0 +1,511 @@
+// Python-free native predictor over the PJRT C API.
+//
+// Capability parity with the reference's C++ inference entry
+// (inference/io.h:35 LoadInferenceModel; api_impl.cc:64
+// NativePaddlePredictor::Init — load a saved model + params and run it
+// from C++ with no Python in the process). Our export artifact
+// (io.py save_inference_model) is:
+//   model.mlir   — raw StableHLO bytecode of the inference function
+//   params.npz / state.npz — weights (uncompressed zip of .npy members)
+//   meta.json    — ordered flat input signature: which npz member (or
+//                  runtime feed) supplies each executable argument
+// This binary dlopens a PJRT plugin (libtpu.so on TPU hosts; any
+// GetPjrtApi-exporting .so), compiles the StableHLO, stages weights and
+// feeds as device buffers, executes, and prints per-output checksums.
+//
+//   predictor <artifact_dir> <plugin.so> [--probe]
+//
+// --probe stops after the Python-free half that needs no accelerator:
+// plugin dlopen + PJRT version handshake + full artifact load/validation
+// (meta.json vs npz shapes/dtypes/sizes). The full run requires a local
+// device for the plugin (the CI box reaches its TPU through an IFRT
+// proxy tunnel, which is not a PJRT C API endpoint — see
+// DESIGN.md "native predictor").
+//
+// Build (test_native_predictor.py does this):
+//   g++ -O2 -std=c++17 -I$TF_INCLUDE predictor.cc -o predictor -ldl
+
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+
+#include <cmath>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+[[noreturn]] void Die(const std::string& msg) {
+  fprintf(stderr, "predictor: %s\n", msg.c_str());
+  exit(1);
+}
+
+std::string ReadFileOrDie(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) Die("cannot open " + path);
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::string out(size_t(n), '\0');
+  if (fread(out.data(), 1, size_t(n), f) != size_t(n)) Die("short read " + path);
+  fclose(f);
+  return out;
+}
+
+// ---- npz (uncompressed zip of .npy) -------------------------------------
+
+struct Array {
+  std::string dtype;          // numpy descr without byte order, e.g. "f4"
+  std::vector<int64_t> shape;
+  const char* data = nullptr; // points into the owning zip blob
+  size_t nbytes = 0;
+};
+
+uint32_t rd32(const char* p) { uint32_t v; memcpy(&v, p, 4); return v; }
+uint16_t rd16(const char* p) { uint16_t v; memcpy(&v, p, 2); return v; }
+
+// Parse one .npy payload (v1/v2 header) into an Array.
+Array ParseNpy(const char* p, size_t n, const std::string& ctx) {
+  if (n < 10 || memcmp(p, "\x93NUMPY", 6) != 0) Die("bad npy magic in " + ctx);
+  int major = p[6];
+  size_t hlen, hoff;
+  if (major == 1) { hlen = rd16(p + 8); hoff = 10; }
+  else { hlen = rd32(p + 8); hoff = 12; }
+  if (hoff + hlen > n) Die("npy header overruns member in " + ctx);
+  std::string hdr(p + hoff, hlen);
+  Array a;
+  // descr: '<f4' etc. — reject non-little-endian; '|' (byte-order-less)
+  // covers bool/int8
+  size_t dp = hdr.find("'descr':");
+  if (dp == std::string::npos) Die("npy header missing descr in " + ctx);
+  size_t q1 = hdr.find('\'', dp + 8), q2 = hdr.find('\'', q1 + 1);
+  std::string descr = hdr.substr(q1 + 1, q2 - q1 - 1);
+  if (descr[0] == '>') Die("big-endian npy unsupported: " + ctx);
+  a.dtype = (descr[0] == '<' || descr[0] == '|' || descr[0] == '=')
+                ? descr.substr(1) : descr;
+  if (hdr.find("'fortran_order': False") == std::string::npos)
+    Die("fortran-order npy unsupported: " + ctx);
+  size_t sp = hdr.find("'shape':");
+  size_t o1 = hdr.find('(', sp), o2 = hdr.find(')', o1);
+  std::string dims = hdr.substr(o1 + 1, o2 - o1 - 1);
+  size_t elems = 1;
+  for (size_t i = 0; i < dims.size();) {
+    while (i < dims.size() && (dims[i] == ' ' || dims[i] == ',')) ++i;
+    if (i >= dims.size()) break;
+    int64_t d = strtoll(dims.c_str() + i, nullptr, 10);
+    a.shape.push_back(d);
+    elems *= size_t(d);
+    while (i < dims.size() && dims[i] != ',') ++i;
+  }
+  size_t esize = strtoull(a.dtype.c_str() + 1, nullptr, 10);
+  if (esize == 0) Die("npy dtype " + a.dtype + " has no size in " + ctx);
+  a.data = p + hoff + hlen;
+  a.nbytes = elems * esize;
+  if (hoff + hlen + a.nbytes > n) Die("npy data overruns member in " + ctx);
+  return a;
+}
+
+// np.savez writes STORED (method 0) members; walk local file headers.
+std::map<std::string, Array> ParseNpz(const std::string& blob,
+                                      const std::string& ctx) {
+  std::map<std::string, Array> out;
+  size_t off = 0;
+  while (off + 30 <= blob.size() && rd32(blob.data() + off) == 0x04034b50) {
+    const char* h = blob.data() + off;
+    uint16_t method = rd16(h + 8);
+    uint16_t flags = rd16(h + 6);
+    uint64_t csize = rd32(h + 18);
+    uint16_t nlen = rd16(h + 26), xlen = rd16(h + 28);
+    std::string name(h + 30, nlen);
+    const char* data = h + 30 + nlen + xlen;
+    if (csize == 0xffffffffu) {
+      // numpy writes zip64 members: real sizes live in extra field 0x0001
+      // as two u64s (uncompressed, then compressed)
+      const char* x = h + 30 + nlen;
+      const char* xe = x + xlen;
+      csize = SIZE_MAX;
+      while (x + 4 <= xe) {
+        uint16_t id = rd16(x), sz = rd16(x + 2);
+        if (id == 0x0001 && sz >= 16) {
+          memcpy(&csize, x + 4 + 8, 8);  // second u64 = compressed size
+          break;
+        }
+        x += 4 + sz;
+      }
+      if (csize == SIZE_MAX) Die("zip64 member without size extra in " + ctx);
+    }
+    if (flags & 0x8) Die("zip data-descriptor members unsupported: " + ctx);
+    if (method != 0) Die("compressed npz member " + name + " in " + ctx +
+                         " (np.savez_compressed unsupported)");
+    if (name.size() > 4 && name.substr(name.size() - 4) == ".npy")
+      out[name.substr(0, name.size() - 4)] =
+          ParseNpy(data, csize, ctx + ":" + name);
+    off = size_t(data - blob.data()) + csize;
+  }
+  if (out.empty()) Die("no npy members found in " + ctx);
+  return out;
+}
+
+// ---- meta.json (our own generator's fixed structure) --------------------
+
+struct InputSpec {
+  std::string source;  // "params.npz" | "state.npz" | "feed"
+  std::string name;
+  std::string dtype;   // numpy name, e.g. "float32"
+  std::vector<int64_t> shape;
+};
+
+std::string JStr(const std::string& s, size_t& i) {
+  if (s[i] != '"') Die("meta.json parse error (expected string)");
+  size_t j = s.find('"', i + 1);
+  std::string out = s.substr(i + 1, j - i - 1);
+  i = j + 1;
+  return out;
+}
+
+// Minimal parser for the exact meta.json shape io.py writes. Tolerates
+// whitespace; dies loudly on anything structurally unexpected.
+std::vector<InputSpec> ParseMetaInputs(const std::string& js) {
+  std::vector<InputSpec> specs;
+  size_t p = js.find("\"inputs\"");
+  if (p == std::string::npos)
+    Die("meta.json has no \"inputs\" — re-export with the current "
+        "save_inference_model (older artifacts lack the native signature)");
+  p = js.find('[', p);
+  size_t end = p;
+  int depth = 0;
+  for (size_t i = p; i < js.size(); ++i) {
+    if (js[i] == '[') ++depth;
+    if (js[i] == ']' && --depth == 0) { end = i; break; }
+  }
+  size_t i = p + 1;
+  while (true) {
+    size_t ob = js.find('{', i);
+    if (ob == std::string::npos || ob > end) break;
+    size_t cb = js.find('}', ob);
+    std::string obj = js.substr(ob, cb - ob + 1);
+    InputSpec sp;
+    for (const char* key : {"source", "name", "dtype"}) {
+      size_t kp = obj.find(std::string("\"") + key + "\"");
+      if (kp == std::string::npos) Die(std::string("meta input missing ") + key);
+      size_t vp = obj.find(':', kp) + 1;
+      while (obj[vp] == ' ') ++vp;
+      std::string val = JStr(obj, vp);
+      if (!strcmp(key, "source")) sp.source = val;
+      else if (!strcmp(key, "name")) sp.name = val;
+      else sp.dtype = val;
+    }
+    size_t shp = obj.find("\"shape\"");
+    size_t sb = obj.find('[', shp), se = obj.find(']', sb);
+    std::string dims = obj.substr(sb + 1, se - sb - 1);
+    for (size_t k = 0; k < dims.size();) {
+      while (k < dims.size() && (dims[k] == ' ' || dims[k] == ',')) ++k;
+      if (k >= dims.size()) break;
+      sp.shape.push_back(strtoll(dims.c_str() + k, nullptr, 10));
+      while (k < dims.size() && dims[k] != ',') ++k;
+    }
+    specs.push_back(std::move(sp));
+    i = cb + 1;
+  }
+  if (specs.empty()) Die("meta.json inputs empty");
+  return specs;
+}
+
+// ---- dtype mapping ------------------------------------------------------
+
+struct DType {
+  PJRT_Buffer_Type pjrt;
+  size_t size;
+  const char* npy;  // descr suffix ("f4")
+};
+
+DType DtypeOrDie(const std::string& numpy_name) {
+  if (numpy_name == "float32") return {PJRT_Buffer_Type_F32, 4, "f4"};
+  if (numpy_name == "float64") return {PJRT_Buffer_Type_F64, 8, "f8"};
+  // io._flatten stores bfloat16 npz members as uint16 views ("u2",
+  // '@bfloat16' name suffix); the device buffer is still BF16
+  if (numpy_name == "bfloat16") return {PJRT_Buffer_Type_BF16, 2, "u2"};
+  if (numpy_name == "float16") return {PJRT_Buffer_Type_F16, 2, "f2"};
+  if (numpy_name == "int64") return {PJRT_Buffer_Type_S64, 8, "i8"};
+  if (numpy_name == "int32") return {PJRT_Buffer_Type_S32, 4, "i4"};
+  if (numpy_name == "int16") return {PJRT_Buffer_Type_S16, 2, "i2"};
+  if (numpy_name == "int8") return {PJRT_Buffer_Type_S8, 1, "i1"};
+  if (numpy_name == "uint8") return {PJRT_Buffer_Type_U8, 1, "u1"};
+  if (numpy_name == "uint32") return {PJRT_Buffer_Type_U32, 4, "u4"};
+  if (numpy_name == "bool") return {PJRT_Buffer_Type_PRED, 1, "b1"};
+  Die("unsupported dtype " + numpy_name);
+}
+
+// ---- PJRT plumbing ------------------------------------------------------
+
+const PJRT_Api* g_api = nullptr;
+
+void Check(PJRT_Error* err, const char* what) {
+  if (!err) return;
+  PJRT_Error_Message_Args m;
+  memset(&m, 0, sizeof m);
+  m.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  m.error = err;
+  g_api->PJRT_Error_Message(&m);
+  std::string msg(m.message, m.message_size);
+  PJRT_Error_Destroy_Args d;
+  memset(&d, 0, sizeof d);
+  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  d.error = err;
+  g_api->PJRT_Error_Destroy(&d);
+  Die(std::string(what) + ": " + msg);
+}
+
+void AwaitAndDestroy(PJRT_Event* ev, const char* what) {
+  PJRT_Event_Await_Args aw;
+  memset(&aw, 0, sizeof aw);
+  aw.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  aw.event = ev;
+  Check(g_api->PJRT_Event_Await(&aw), what);
+  PJRT_Event_Destroy_Args ed;
+  memset(&ed, 0, sizeof ed);
+  ed.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  ed.event = ev;
+  Check(g_api->PJRT_Event_Destroy(&ed), "event destroy");
+}
+
+// Minimal serialized xla.CompileOptionsProto:
+//   field 3 (executable_build_options) {
+//     field 4 (num_replicas) = 1; field 5 (num_partitions) = 1; }
+// Hand-encoded: protoc isn't needed for two varints.
+std::string MinimalCompileOptions() {
+  const char inner[] = {0x20, 0x01, 0x28, 0x01};        // 4:1, 5:1
+  std::string opts;
+  opts.push_back(0x1a);                                  // field 3, wire 2
+  opts.push_back(char(sizeof inner));
+  opts.append(inner, sizeof inner);
+  return opts;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr,
+            "usage: predictor <artifact_dir> <pjrt_plugin.so> [--probe]\n");
+    return 2;
+  }
+  std::string dir = argv[1], plugin = argv[2];
+  bool probe = argc > 3 && std::string(argv[3]) == "--probe";
+
+  // ---- artifact load + validation (no accelerator needed) ---------------
+  std::string mlir = ReadFileOrDie(dir + "/model.mlir");
+  std::string meta = ReadFileOrDie(dir + "/meta.json");
+  std::string params_blob = ReadFileOrDie(dir + "/params.npz");
+  std::string state_blob = ReadFileOrDie(dir + "/state.npz");
+  auto params = ParseNpz(params_blob, "params.npz");
+  std::map<std::string, Array> state;
+  if (state_blob.size() > 4 && rd32(state_blob.data()) == 0x04034b50)
+    state = ParseNpz(state_blob, "state.npz");
+  auto inputs = ParseMetaInputs(meta);
+
+  size_t feed_args = 0, weight_bytes = 0;
+  for (const auto& sp : inputs) {
+    DType dt = DtypeOrDie(sp.dtype);
+    size_t want = dt.size;
+    for (int64_t d : sp.shape) want *= size_t(d);
+    if (sp.source == "feed") { ++feed_args; continue; }
+    auto& table = sp.source == "params.npz" ? params : state;
+    auto it = table.find(sp.name);
+    if (it == table.end()) Die("meta input " + sp.name + " missing from " +
+                               sp.source);
+    const Array& got = it->second;
+    if (got.nbytes != want)
+      Die("weight " + sp.name + " is " + std::to_string(got.nbytes) +
+          " bytes, signature expects " + std::to_string(want));
+    if (got.dtype != dt.npy)
+      Die("weight " + sp.name + " stored as npy '" + got.dtype +
+          "', signature expects '" + dt.npy + "' (" + sp.dtype + ")");
+    if (got.shape != sp.shape) {
+      std::string g, w;
+      for (int64_t v : got.shape) g += std::to_string(v) + ",";
+      for (int64_t v : sp.shape) w += std::to_string(v) + ",";
+      Die("weight " + sp.name + " has shape [" + g +
+          "], signature expects [" + w + "]");
+    }
+    weight_bytes += want;
+  }
+  fprintf(stderr,
+          "predictor: artifact ok — %zu args (%zu weights %.1f MB, %zu feeds), "
+          "stablehlo %zu bytes\n",
+          inputs.size(), inputs.size() - feed_args,
+          weight_bytes / 1048576.0, feed_args, mlir.size());
+
+  // ---- plugin handshake -------------------------------------------------
+  void* lib = dlopen(plugin.c_str(), RTLD_NOW | RTLD_LOCAL);
+  if (!lib) Die(std::string("dlopen failed: ") + dlerror());
+  auto get_api = reinterpret_cast<const PJRT_Api* (*)()>(
+      dlsym(lib, "GetPjrtApi"));
+  if (!get_api) Die("plugin has no GetPjrtApi symbol");
+  g_api = get_api();
+  if (!g_api) Die("GetPjrtApi returned null");
+  fprintf(stderr, "predictor: plugin PJRT API v%d.%d (header v%d.%d)\n",
+          g_api->pjrt_api_version.major_version,
+          g_api->pjrt_api_version.minor_version, PJRT_API_MAJOR,
+          PJRT_API_MINOR);
+  if (g_api->pjrt_api_version.major_version != PJRT_API_MAJOR)
+    Die("PJRT major version mismatch");
+
+  if (probe) {
+    printf("PROBE OK\n");
+    return 0;
+  }
+
+  PJRT_Plugin_Initialize_Args pi;
+  memset(&pi, 0, sizeof pi);
+  pi.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  Check(g_api->PJRT_Plugin_Initialize(&pi), "plugin init");
+
+  PJRT_Client_Create_Args cc;
+  memset(&cc, 0, sizeof cc);
+  cc.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  Check(g_api->PJRT_Client_Create(&cc), "client create");
+  PJRT_Client* client = cc.client;
+
+  PJRT_Client_AddressableDevices_Args ad;
+  memset(&ad, 0, sizeof ad);
+  ad.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  ad.client = client;
+  Check(g_api->PJRT_Client_AddressableDevices(&ad), "devices");
+  if (ad.num_addressable_devices == 0) Die("no addressable devices");
+  PJRT_Device* dev = ad.addressable_devices[0];
+  fprintf(stderr, "predictor: %zu addressable device(s)\n",
+          ad.num_addressable_devices);
+
+  // ---- compile ----------------------------------------------------------
+  PJRT_Program prog;
+  memset(&prog, 0, sizeof prog);
+  prog.struct_size = PJRT_Program_STRUCT_SIZE;
+  prog.code = mlir.data();
+  prog.code_size = mlir.size();
+  static const char kFmt[] = "mlir";
+  prog.format = kFmt;
+  prog.format_size = 4;
+  std::string copts = MinimalCompileOptions();
+  PJRT_Client_Compile_Args comp;
+  memset(&comp, 0, sizeof comp);
+  comp.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  comp.client = client;
+  comp.program = &prog;
+  comp.compile_options = copts.data();
+  comp.compile_options_size = copts.size();
+  Check(g_api->PJRT_Client_Compile(&comp), "compile");
+  fprintf(stderr, "predictor: stablehlo compiled\n");
+
+  // ---- stage inputs (weights from npz; feeds zero-filled or from
+  //      <dir>/feed_<name>.npy if present) --------------------------------
+  std::vector<PJRT_Buffer*> arg_bufs;
+  std::vector<std::string> feed_storage;
+  for (const auto& sp : inputs) {
+    DType dt = DtypeOrDie(sp.dtype);
+    size_t nbytes = dt.size;
+    for (int64_t d : sp.shape) nbytes *= size_t(d);
+    const char* data;
+    if (sp.source == "feed") {
+      std::string path = dir + "/feed_" + sp.name + ".npy";
+      FILE* f = fopen(path.c_str(), "rb");
+      if (f) {
+        fclose(f);
+        std::string blob = ReadFileOrDie(path);
+        feed_storage.push_back(std::move(blob));
+        Array a = ParseNpy(feed_storage.back().data(),
+                           feed_storage.back().size(), path);
+        if (a.nbytes != nbytes) Die("feed " + sp.name + " wrong size");
+        if (a.dtype != dt.npy)
+          Die("feed " + sp.name + " is npy '" + a.dtype + "', signature "
+              "expects '" + dt.npy + "' (" + sp.dtype + ")");
+        if (a.shape != sp.shape) Die("feed " + sp.name + " wrong shape");
+        data = a.data;
+      } else {
+        feed_storage.emplace_back(nbytes, '\0');
+        data = feed_storage.back().data();
+      }
+    } else {
+      auto& table = sp.source == "params.npz" ? params : state;
+      data = table.at(sp.name).data;
+    }
+    PJRT_Client_BufferFromHostBuffer_Args hb;
+    memset(&hb, 0, sizeof hb);
+    hb.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    hb.client = client;
+    hb.data = data;
+    hb.type = dt.pjrt;
+    hb.dims = sp.shape.data();
+    hb.num_dims = sp.shape.size();
+    hb.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    hb.device = dev;
+    Check(g_api->PJRT_Client_BufferFromHostBuffer(&hb),
+          ("h2d " + sp.name).c_str());
+    AwaitAndDestroy(hb.done_with_host_buffer, "h2d done");
+    arg_bufs.push_back(hb.buffer);
+  }
+
+  // ---- execute ----------------------------------------------------------
+  PJRT_LoadedExecutable_GetExecutable_Args ge;
+  memset(&ge, 0, sizeof ge);
+  ge.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  ge.loaded_executable = comp.executable;
+  Check(g_api->PJRT_LoadedExecutable_GetExecutable(&ge), "get executable");
+  PJRT_Executable_NumOutputs_Args no;
+  memset(&no, 0, sizeof no);
+  no.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  no.executable = ge.executable;
+  Check(g_api->PJRT_Executable_NumOutputs(&no), "num outputs");
+
+  std::vector<PJRT_Buffer*> outs(no.num_outputs, nullptr);
+  PJRT_Buffer** out_list = outs.data();
+  PJRT_Buffer* const* arg_list = arg_bufs.data();
+  PJRT_ExecuteOptions eo;
+  memset(&eo, 0, sizeof eo);
+  eo.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+  PJRT_Event* done = nullptr;
+  PJRT_LoadedExecutable_Execute_Args ex;
+  memset(&ex, 0, sizeof ex);
+  ex.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ex.executable = comp.executable;
+  ex.options = &eo;
+  ex.argument_lists = &arg_list;
+  ex.num_devices = 1;
+  ex.num_args = arg_bufs.size();
+  ex.output_lists = &out_list;
+  ex.device_complete_events = &done;
+  ex.execute_device = dev;
+  Check(g_api->PJRT_LoadedExecutable_Execute(&ex), "execute");
+  AwaitAndDestroy(done, "execute done");
+
+  // ---- fetch outputs, print checksums ------------------------------------
+  for (size_t i = 0; i < outs.size(); ++i) {
+    PJRT_Buffer_ToHostBuffer_Args th;
+    memset(&th, 0, sizeof th);
+    th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    th.src = outs[i];
+    Check(g_api->PJRT_Buffer_ToHostBuffer(&th), "d2h size query");
+    std::vector<char> host(th.dst_size);
+    th.dst = host.data();
+    Check(g_api->PJRT_Buffer_ToHostBuffer(&th), "d2h");
+    AwaitAndDestroy(th.event, "d2h done");
+    PJRT_Buffer_ElementType_Args et;
+    memset(&et, 0, sizeof et);
+    et.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
+    et.buffer = outs[i];
+    Check(g_api->PJRT_Buffer_ElementType(&et), "element type");
+    double sum = 0;
+    if (et.type == PJRT_Buffer_Type_F32) {
+      const float* v = reinterpret_cast<const float*>(host.data());
+      for (size_t k = 0; k < host.size() / 4; ++k) sum += v[k];
+    }
+    printf("OUTPUT %zu bytes=%zu f32sum=%.6f\n", i, host.size(), sum);
+  }
+  printf("RUN OK\n");
+  return 0;
+}

@@ -95,6 +95,7 @@ def test_collective_report_dp_sees_grad_allreduce():
     assert rep["mesh"] == {"dp": 8}
 
 
+@pytest.mark.slow
 def test_collective_report_interleave_traffic_tradeoff():
     """The interleaved pipeline's documented cost is V× more
     collective-permute traffic: M·V+P-1 ticks of ring hops vs M+P-1.

@@ -34,6 +34,7 @@ def _img_feed(bs=2, size=64, classes=10):
             "label": rng.randint(0, classes, (bs, 1)).astype(np.int64)}
 
 
+@pytest.mark.slow
 def test_alexnet_step():
     model = pt.build(convnets.make_alexnet(class_num=10))
     feed = _img_feed(size=224)

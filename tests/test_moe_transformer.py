@@ -29,6 +29,7 @@ def _feed(bs, seq=16, vocab=64, seed=0):
     return {"ids": ids, "labels": labels}
 
 
+@pytest.mark.slow
 def test_moe_lm_trains_dense():
     prog = pt.build(moe_transformer.make_model(_cfg()))
     feed = _feed(4)
@@ -42,6 +43,7 @@ def test_moe_lm_trains_dense():
     assert float(out["aux_loss"]) > 0  # routing actually happened
 
 
+@pytest.mark.slow
 def test_moe_lm_ep_mesh_parity_with_dense():
     """dp2×ep4 expert-parallel training == dense single-device training
     step for step (aux off, ample capacity → identical routing)."""

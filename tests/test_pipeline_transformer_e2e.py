@@ -182,6 +182,7 @@ def test_pipeline_transformer_e2e_loss_parity():
     np.testing.assert_allclose(pp_losses, ref_losses, atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_transformer_3d_dp_tp_pp():
     """dp2×tp2×pp2: stacked blocks tp-shard heads inside each stage and
     psum the projections; losses stay parity with single-device."""
@@ -202,6 +203,7 @@ def test_pipeline_transformer_3d_dp_tp_pp():
     np.testing.assert_allclose(pp_losses, ref_losses, atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_transformer_interleaved_loss_parity():
     """dp2×pp2 with pp_interleave=2 (Megatron virtual stages): each rank
     holds two non-adjacent block chunks; losses stay parity with
@@ -240,6 +242,7 @@ def test_stacked_params_sharded_over_pp():
     assert spec[0] == "pp", spec
 
 
+@pytest.mark.slow
 def test_pipeline_composes_with_grad_accumulation():
     """pp_microbatches × accum_steps: the scan-microbatched feed halves
     feed the pipeline's own microbatching; parity vs plain single-device
@@ -261,6 +264,7 @@ def test_pipeline_composes_with_grad_accumulation():
     np.testing.assert_allclose(pp, ref, atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_trained_model_eval_and_reshape_restore(tmp_path):
     """The pp-sharded stacked model evaluates (no pipeline ctx: scan
     path over pp-sharded params under plain GSPMD) and its sharded

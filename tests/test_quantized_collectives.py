@@ -93,6 +93,7 @@ def test_hops_carry_int8_on_the_wire():
     assert len(out_types) == 2 * 7 * 2, out_types
 
 
+@pytest.mark.slow
 def test_all_ranks_bitwise_identical():
     """The all-reduce contract DP replicas rely on: every rank must end
     with the SAME array, bit for bit — including the chunk each rank

@@ -107,6 +107,7 @@ def test_model_config_remat_equivalent_numerics():
                                    atol=1e-5, rtol=1e-4, err_msg=k)
 
 
+@pytest.mark.slow
 def test_bert_remat_flag():
     from paddle_tpu.models import bert
 
