@@ -39,6 +39,24 @@ _EXOTIC_DTYPES = {"bfloat16": np.uint16,
 # -- pytree <-> flat dict ----------------------------------------------------
 
 
+def _mangle_leaf(prefix: str, arr: np.ndarray):
+    """Single source of truth for leaf-key mangling: the npz member name
+    written by _flatten and the meta.json name written by
+    _flat_leaves_in_tree_order must stay byte-identical (the native
+    predictor looks meta names up in the npz table)."""
+    if arr.dtype.name in _EXOTIC_DTYPES:
+        return f"{prefix}@{arr.dtype.name}", arr.view(_EXOTIC_DTYPES[arr.dtype.name])
+    if (prefix.endswith("@raw")
+            or any(prefix.endswith(f"@{dt}") and arr.dtype == enc
+                   for dt, enc in _EXOTIC_DTYPES.items())):
+        # a genuine integer param whose NAME ends in '@bfloat16' etc.
+        # (or '@raw' itself) would be indistinguishable from our
+        # encoding on load — escape with a '@raw' marker (load strips
+        # exactly one suffix, so escaping nests safely)
+        return f"{prefix}@raw", arr
+    return prefix, arr
+
+
 def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
     out: Dict[str, np.ndarray] = {}
     if isinstance(tree, dict):
@@ -47,19 +65,8 @@ def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
     elif tree is None:
         pass
     else:
-        arr = np.asarray(tree)
-        if arr.dtype.name in _EXOTIC_DTYPES:
-            out[f"{prefix}@{arr.dtype.name}"] = arr.view(_EXOTIC_DTYPES[arr.dtype.name])
-        elif (prefix.endswith("@raw")
-              or any(prefix.endswith(f"@{dt}") and arr.dtype == enc
-                     for dt, enc in _EXOTIC_DTYPES.items())):
-            # a genuine integer param whose NAME ends in '@bfloat16' etc.
-            # (or '@raw' itself) would be indistinguishable from our
-            # encoding on load — escape with a '@raw' marker (load strips
-            # exactly one suffix, so escaping nests safely)
-            out[f"{prefix}@raw"] = arr
-        else:
-            out[prefix] = arr
+        key, val = _mangle_leaf(prefix, np.asarray(tree))
+        out[key] = val
     return out
 
 
@@ -68,8 +75,7 @@ def _flat_leaves_in_tree_order(tree: Any, prefix: str = ""):
     sorted ORIGINAL keys, depth-first) — NOT sorted mangled npz keys,
     which diverge ('a2' vs 'a||x' sorts differently than 'a' vs 'a2';
     '@bfloat16' suffixes shift order). Used by save_inference_model to
-    bind npz members to executable argument positions; npz key mangling
-    mirrors _flatten exactly."""
+    bind npz members to executable argument positions."""
     out = []
     if isinstance(tree, dict):
         for k in sorted(tree, key=str):
@@ -78,15 +84,7 @@ def _flat_leaves_in_tree_order(tree: Any, prefix: str = ""):
     elif tree is None:
         pass
     else:
-        arr = np.asarray(tree)
-        if arr.dtype.name in _EXOTIC_DTYPES:
-            out.append((f"{prefix}@{arr.dtype.name}", arr))
-        elif (prefix.endswith("@raw")
-              or any(prefix.endswith(f"@{dt}") and arr.dtype == enc
-                     for dt, enc in _EXOTIC_DTYPES.items())):
-            out.append((f"{prefix}@raw", arr))
-        else:
-            out.append((prefix, arr))
+        out.append(_mangle_leaf(prefix, np.asarray(tree)))
     return out
 
 

@@ -7,7 +7,7 @@ the claim cannot drift."""
 
 from . import attention, beam_search, control_flow, crf, ctc, detection
 from . import io, layer_function_generator, nn, ops, rnn, sequence, tensor
-from .beam_search import beam_search_decode
+from .beam_search import beam_search_decode, beam_search_decode_lod
 from .control_flow import (
     DynamicRNN,
     IfElse,
@@ -82,6 +82,9 @@ from .rnn import (
     rnn as rnn_scan,
 )
 from .sequence import (
+    LoDTensor,
+    create_lod_tensor,
+    create_random_int_lodtensor,
     lod_reset,
     reorder_lod_tensor_by_rank,
     sequence_concat,

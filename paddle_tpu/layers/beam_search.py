@@ -138,3 +138,40 @@ def beam_search_decode(step_ids, step_parents, end_id: int = 2, name=None):
     ended_before = jnp.cumsum((seqs == end_id).astype(jnp.int32), axis=-1) \
         - (seqs == end_id).astype(jnp.int32)
     return seqs, ended_before == 0
+
+
+def beam_search_decode_lod(seqs, valid, scores=None):
+    """Package decoded beams as the reference's 2-level LoD output
+    (beam_search_decode_op.cc): level 0 groups hypotheses per source
+    sentence, level 1 gives each hypothesis's token count — the
+    (sentence-level, token-level) nested structure the book
+    machine-translation demo consumes.
+
+    seqs/valid: [B, K, T] from :func:`beam_search_decode` (or
+    :func:`beam_search` with valid = token-mask up to first EOS).
+    Returns an ``LoDTensor`` of token ids with
+    ``recursive_seq_lens = [[K]*B, per-hypothesis lengths]``; with
+    ``scores`` [B, K], also returns a matching 2-level LoDTensor whose
+    innermost lengths are 1 per hypothesis (the sentenceScores output).
+
+    Runs on host after the device scan — the reference computes this op
+    on CPU too (it is pure ragged bookkeeping, no FLOPs).
+    """
+    import numpy as np
+    from .sequence import LoDTensor
+
+    seqs = np.asarray(seqs)
+    valid = np.asarray(valid).astype(bool)
+    b, k, t = seqs.shape
+    tokens, hyp_lens = [], []
+    for i in range(b):
+        for j in range(k):
+            toks = seqs[i, j][valid[i, j]]
+            tokens.append(toks)
+            hyp_lens.append(len(toks))
+    flat = np.concatenate(tokens) if tokens else np.zeros((0,), seqs.dtype)
+    ids = LoDTensor(flat.astype(np.int32), [[k] * b, hyp_lens])
+    if scores is None:
+        return ids
+    sc = np.asarray(scores).reshape(b * k)
+    return ids, LoDTensor(sc, [[k] * b, [1] * (b * k)])

@@ -855,13 +855,15 @@ def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
     x = input if data_format == "NHWC" else jnp.transpose(input, (0, 2, 3, 1))
     method = "bilinear" if resample.upper() == "BILINEAR" else "nearest"
     if method == "nearest" and align_corners:
-        # nearest_interp_op with align_corners: index round(o*(in-1)/(out-1))
-        # per axis (half-pixel jax.image.resize picks different pixels)
+        # nearest_interp_op with align_corners: index int(o*(in-1)/(out-1)
+        # + 0.5) per axis — round-half-UP, not jnp.round's half-to-even
+        # (exact .5 midpoints must pick the higher pixel to match);
+        # half-pixel jax.image.resize picks different pixels entirely
         def nn_idx(in_size, out_size):
             if out_size == 1 or in_size == 1:
                 return jnp.zeros((out_size,), jnp.int32)
             r = (in_size - 1) / (out_size - 1)
-            return jnp.round(jnp.arange(out_size) * r).astype(jnp.int32)
+            return jnp.floor(jnp.arange(out_size) * r + 0.5).astype(jnp.int32)
 
         out = jnp.take(jnp.take(x, nn_idx(h, oh), axis=1),
                        nn_idx(w, ow), axis=2)

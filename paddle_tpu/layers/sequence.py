@@ -16,6 +16,18 @@ static-shape representations:
 Conversions (= sequence_pad/unpad ops) are provided, plus lod-offset
 (row_splits) helpers matching the reference's recursive_sequence_lengths
 API. All ops are jit-safe: shapes depend only on statics.
+
+Multi-level (nested) LoD — lod_tensor.h:58-110 stores a *vector* of
+levels so a tensor can be e.g. paragraphs→sentences→words — is carried
+by :class:`LoDTensor`: packed device values + the per-level length
+lists as host metadata (exactly where the reference keeps LoD: on the
+CPU side of the tensor, never on device). Level views project any
+level down to row-granular segment ids, so every packed op here works
+at any level; `sequence_expand(..., ref_level=)` and
+`LoDTensor.pool(level=)` give the reference's level-selecting ops, and
+`beam_search_decode_lod` emits the reference's 2-level
+(source-sentence → hypothesis → token) decode output
+(beam_search_decode_op.cc).
 """
 
 from __future__ import annotations
@@ -138,10 +150,21 @@ def sequence_softmax(packed, segment_ids, num_seqs: int):
     return e / jnp.maximum(denom[segment_ids], 1e-30)
 
 
-def sequence_expand(x, ref_lengths, axis_total: int):
+def sequence_expand(x, ref_lengths, axis_total: int = None, ref_level: int = -1):
     """Repeat each row x[i] ref_lengths[i] times (sequence_expand_op.cc
     analog). ``axis_total`` = static output length (= padded capacity of
-    sum(ref_lengths))."""
+    sum(ref_lengths)). ``ref_lengths`` may be an :class:`LoDTensor`, in
+    which case ``ref_level`` selects which of its LoD levels supplies the
+    repeat counts — the op's ref_level attribute: level i's lengths count
+    units of level i+1, so expanding by an outer level repeats x rows by
+    sub-sequence counts, not token counts."""
+    if isinstance(ref_lengths, LoDTensor):
+        lens = ref_lengths.seq_lens[ref_lengths._level(ref_level)]
+        if axis_total is None:
+            axis_total = int(sum(lens))
+        ref_lengths = jnp.asarray(lens, jnp.int32)
+    enforce(axis_total is not None,
+            "sequence_expand: axis_total required for array lengths")
     seg = lengths_to_segment_ids(ref_lengths, axis_total)
     seg = jnp.clip(seg, 0, x.shape[0] - 1)
     return x[seg]
@@ -294,28 +317,146 @@ def reorder_lod_tensor_by_rank(padded, lengths):
     return padded[perm], lengths[perm], perm
 
 
+class LoDTensor:
+    """Packed values + nested LoD metadata (lod_tensor.h:58-110).
+
+    ``recursive_seq_lens`` is a list of levels, outermost first; each
+    level's entries count units of the next level (the innermost level
+    counts value rows) — the reference's recursive_sequence_lengths()
+    view of its offset vector-of-levels. Lengths are host python ints
+    (static at trace time), values a device array: on TPU ragged
+    structure must be static, and the reference itself keeps LoD on the
+    host side of the tensor.
+
+    Iterates as the classic single-level ``(values, lengths,
+    segment_ids)`` triple (innermost level) so single-level callers are
+    unchanged.
+    """
+
+    def __init__(self, values, recursive_seq_lens):
+        import numpy as np
+        enforce(len(recursive_seq_lens) > 0,
+                "LoDTensor: recursive_seq_lens must have at least one level")
+        if not isinstance(recursive_seq_lens[0], (list, tuple, np.ndarray)):
+            recursive_seq_lens = [list(recursive_seq_lens)]
+        self.values = jnp.asarray(values)
+        self.seq_lens = [[int(v) for v in level] for level in recursive_seq_lens]
+        for li in range(len(self.seq_lens) - 1):
+            enforce(sum(self.seq_lens[li]) == len(self.seq_lens[li + 1]),
+                    f"LoDTensor: level {li} lengths must sum to the number of "
+                    f"level-{li + 1} sequences "
+                    f"({sum(self.seq_lens[li])} != {len(self.seq_lens[li + 1])})")
+        if self.seq_lens:
+            enforce(sum(self.seq_lens[-1]) == int(self.values.shape[0]),
+                    "LoDTensor: innermost lengths must sum to data rows")
+
+    # -- reference API surface (lod_tensor.h accessors) --
+    @property
+    def lod_level(self) -> int:
+        return len(self.seq_lens)
+
+    def recursive_sequence_lengths(self):
+        return [list(level) for level in self.seq_lens]
+
+    def lod(self):
+        """Offset form: each level's offsets index units of the next
+        level (rows for the innermost) — LoD in lod_tensor.h:58."""
+        out = []
+        for level in self.seq_lens:
+            offs, acc = [0], 0
+            for n in level:
+                acc += n
+                offs.append(acc)
+            out.append(offs)
+        return out
+
+    # -- level views --
+    def _level(self, level: int) -> int:
+        """Normalize a python-style level index, rejecting out-of-range
+        values loudly (the reference op bound-checks its ref_level attr)
+        instead of silently wrapping to the wrong level."""
+        enforce(-self.lod_level <= level < self.lod_level,
+                f"LoD level {level} out of range for lod_level={self.lod_level}")
+        return level % self.lod_level
+
+    def num_seqs(self, level: int = 0) -> int:
+        return len(self.seq_lens[self._level(level)])
+
+    def row_lengths(self, level: int = -1):
+        """Lengths at ``level`` measured in value rows: compose every
+        level below it. For lod [[2,1],[3,2,4]], row_lengths(0) = [5,4]."""
+        level = self._level(level)
+        lens = list(self.seq_lens[-1])
+        for li in range(self.lod_level - 2, level - 1, -1):
+            grouped, pos = [], 0
+            for n in self.seq_lens[li]:
+                grouped.append(sum(lens[pos:pos + n]))
+                pos += n
+            lens = grouped
+        return lens
+
+    def segment_ids(self, level: int = -1):
+        """Row-granular segment ids mapping each value row to its
+        ``level`` sequence — the projection that lets every packed op in
+        this module operate at any LoD level."""
+        lens = jnp.asarray(self.row_lengths(level), jnp.int32)
+        return lengths_to_segment_ids(lens, int(self.values.shape[0]))
+
+    def pool(self, pool_type: str = "average", level: int = -1):
+        """sequence_pool at ``level``. Pooling the innermost level keeps
+        the outer levels (each inner sequence becomes one row), matching
+        the reference where sequence_pool consumes the last LoD level;
+        pooling an outer level collapses everything below it in one
+        segment reduction. Returns an LoDTensor while levels remain,
+        else the plain pooled array."""
+        level = self._level(level)
+        pooled = sequence_pool(self.values, self.segment_ids(level),
+                               self.num_seqs(level), pool_type)
+        if level == 0:
+            return pooled
+        return LoDTensor(pooled, self.seq_lens[:level])
+
+    def sequences(self, level: int = -1):
+        """Host-side ragged view: nested python lists of numpy rows,
+        split at ``level`` (and below) — the to-python escape hatch the
+        reference's LoDTensor array interface provides."""
+        import numpy as np
+        vals = np.asarray(self.values)
+        flat = np.split(vals, np.cumsum(self.row_lengths(-1))[:-1])
+        level = self._level(level)
+        for li in range(self.lod_level - 2, level - 1, -1):
+            grouped, pos = [], 0
+            for n in self.seq_lens[li]:
+                grouped.append(flat[pos:pos + n])
+                pos += n
+            flat = grouped
+        return flat
+
+    def __iter__(self):
+        lens = jnp.asarray(self.row_lengths(-1), jnp.int32)
+        return iter((self.values, lens, self.segment_ids(-1)))
+
+    def __repr__(self):
+        return (f"LoDTensor(shape={tuple(self.values.shape)}, "
+                f"lod={self.recursive_sequence_lengths()})")
+
+
 def create_lod_tensor(data, recursive_seq_lens, place=None):
-    """lod_tensor.py create_lod_tensor analog: build the packed
-    (values, lengths, segment_ids) triple from per-sequence lengths.
-    Only one LoD level (the overwhelmingly common case); nested levels
-    flatten to their innermost lengths."""
-    import numpy as np
-    lens = recursive_seq_lens[-1] if isinstance(recursive_seq_lens[0], (list, tuple)) \
-        else recursive_seq_lens
-    lens = jnp.asarray(np.asarray(lens, np.int32))
-    values = jnp.asarray(data)
-    enforce(int(lens.sum()) == values.shape[0],
-            "create_lod_tensor: lengths must sum to data rows")
-    seg = lengths_to_segment_ids(lens, values.shape[0])
-    return values, lens, seg
+    """lod_tensor.py create_lod_tensor analog. Returns an
+    :class:`LoDTensor` carrying the FULL nested structure
+    (lod_tensor.h:58 vector-of-levels); unpacking it as ``values, lens,
+    seg`` yields the innermost-level triple, so one-level callers (the
+    overwhelmingly common case) read exactly as before."""
+    return LoDTensor(data, recursive_seq_lens)
 
 
 def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
                                 low: int = 0, high: int = 1):
     """lod_tensor.py create_random_int_lodtensor analog."""
     import numpy as np
-    lens = recursive_seq_lens[-1] if isinstance(recursive_seq_lens[0], (list, tuple)) \
-        else recursive_seq_lens
+    lens = recursive_seq_lens
+    while isinstance(lens[0], (list, tuple)):
+        lens = lens[-1]
     total = int(np.sum(lens))
     data = np.random.randint(low, high + 1, (total,) + tuple(base_shape)).astype(np.int32)
     return create_lod_tensor(data, recursive_seq_lens, place)

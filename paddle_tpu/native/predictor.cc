@@ -74,7 +74,8 @@ Array ParseNpy(const char* p, size_t n, const std::string& ctx) {
   int major = p[6];
   size_t hlen, hoff;
   if (major == 1) { hlen = rd16(p + 8); hoff = 10; }
-  else { hlen = rd32(p + 8); hoff = 12; }
+  else if (n >= 12) { hlen = rd32(p + 8); hoff = 12; }
+  else Die("truncated npy v2 header in " + ctx);
   if (hoff + hlen > n) Die("npy header overruns member in " + ctx);
   std::string hdr(p + hoff, hlen);
   Array a;
@@ -120,6 +121,8 @@ std::map<std::string, Array> ParseNpz(const std::string& blob,
     uint16_t flags = rd16(h + 6);
     uint64_t csize = rd32(h + 18);
     uint16_t nlen = rd16(h + 26), xlen = rd16(h + 28);
+    if (off + 30 + size_t(nlen) + size_t(xlen) > blob.size())
+      Die("npz member header overruns archive in " + ctx);
     std::string name(h + 30, nlen);
     const char* data = h + 30 + nlen + xlen;
     if (csize == 0xffffffffu) {
@@ -141,6 +144,8 @@ std::map<std::string, Array> ParseNpz(const std::string& blob,
     if (flags & 0x8) Die("zip data-descriptor members unsupported: " + ctx);
     if (method != 0) Die("compressed npz member " + name + " in " + ctx +
                          " (np.savez_compressed unsupported)");
+    if (csize > blob.size() - (size_t(data - blob.data())))
+      Die("npz member " + name + " payload overruns archive in " + ctx);
     if (name.size() > 4 && name.substr(name.size() - 4) == ".npy")
       out[name.substr(0, name.size() - 4)] =
           ParseNpy(data, csize, ctx + ":" + name);

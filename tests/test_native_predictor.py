@@ -33,8 +33,9 @@ TF_INCLUDE = "/opt/venv/lib/python3.12/site-packages/tensorflow/include"
 LIBTPU = "/opt/venv/lib/python3.12/site-packages/libtpu/libtpu.so"
 
 pytestmark = pytest.mark.skipif(
-    not os.path.exists(os.path.join(TF_INCLUDE, "xla/pjrt/c/pjrt_c_api.h")),
-    reason="PJRT C API header not vendored in this image")
+    not os.path.exists(os.path.join(TF_INCLUDE, "xla/pjrt/c/pjrt_c_api.h"))
+    or not os.path.exists(LIBTPU),
+    reason="PJRT C API header or libtpu plugin not present in this image")
 
 
 def _build():

@@ -972,6 +972,18 @@ def _():
              rtol=1e-4, atol=1e-5)
 
 
+@case("resize_nearest")
+def _():
+    # nearest_interp_op align_corners rounds HALF-UP: int(o*ratio + 0.5).
+    # 3x3 -> 5x5 has ratio 0.5, so positions [0,.5,1,1.5,2] must map to
+    # source indices [0,1,1,2,2] (half-to-even would give [0,0,1,2,2]).
+    x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+    out = np.asarray(L.resize_nearest(J(x), out_shape=(5, 5)))
+    idx = np.array([0, 1, 1, 2, 2])
+    ref = x[0, 0][np.ix_(idx, idx)]
+    allclose(out[0, 0], ref)
+
+
 # --- array/TensorArray ops -------------------------------------------------
 
 
