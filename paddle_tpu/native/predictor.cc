@@ -98,12 +98,16 @@ Array ParseNpy(const char* p, size_t n, const std::string& ctx) {
     while (i < dims.size() && (dims[i] == ' ' || dims[i] == ',')) ++i;
     if (i >= dims.size()) break;
     int64_t d = strtoll(dims.c_str() + i, nullptr, 10);
+    if (d < 0) Die("negative npy dim in " + ctx);
     a.shape.push_back(d);
+    if (d != 0 && elems > SIZE_MAX / size_t(d))
+      Die("npy shape overflows size_t in " + ctx);
     elems *= size_t(d);
     while (i < dims.size() && dims[i] != ',') ++i;
   }
   size_t esize = strtoull(a.dtype.c_str() + 1, nullptr, 10);
   if (esize == 0) Die("npy dtype " + a.dtype + " has no size in " + ctx);
+  if (elems > SIZE_MAX / esize) Die("npy size overflows size_t in " + ctx);
   a.data = p + hoff + hlen;
   a.nbytes = elems * esize;
   if (hoff + hlen + a.nbytes > n) Die("npy data overruns member in " + ctx);
@@ -133,6 +137,7 @@ std::map<std::string, Array> ParseNpz(const std::string& blob,
       csize = SIZE_MAX;
       while (x + 4 <= xe) {
         uint16_t id = rd16(x), sz = rd16(x + 2);
+        if (x + 4 + sz > xe) break;  // field claims more than the extra area holds
         if (id == 0x0001 && sz >= 16) {
           memcpy(&csize, x + 4 + 8, 8);  // second u64 = compressed size
           break;
