@@ -208,9 +208,13 @@ def ctr(split: str = "train", num_sparse_fields: int = 26, sparse_dim: int = 100
     (dense[13], sparse_ids[26], label)."""
 
     def reader():
+        # ground-truth weights are split-INDEPENDENT (fixed seed): train
+        # and test must follow the same labeling rule or generalization
+        # is impossible; only the samples differ per split
+        wrng = np.random.RandomState(42)
+        w_d = wrng.randn(num_dense).astype(np.float32)
+        w_s = wrng.randn(num_sparse_fields, sparse_dim).astype(np.float32) * 0.5
         rng = np.random.RandomState(10 if split == "train" else 11)
-        w_d = rng.randn(num_dense).astype(np.float32)
-        w_s = rng.randn(num_sparse_fields, sparse_dim).astype(np.float32) * 0.1
         for _ in range(synthetic_size):
             dense = rng.randn(num_dense).astype(np.float32)
             sparse = rng.randint(0, sparse_dim, num_sparse_fields).astype(np.int64)

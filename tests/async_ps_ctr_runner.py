@@ -1,0 +1,70 @@
+"""DeepFM CTR trainer over the async parameter server — the dist_ctr.py
+workload shape (reference: tests/unittests/dist_ctr.py driven by
+test_dist_base.py): N barrier-free trainer processes, each on its own
+data shard, pushing sparse-model gradients into one C++ pserver.
+
+    python async_ps_ctr_runner.py <trainer_id> <ps_port> <epochs> [--compress]
+
+Importable by the convergence test for the shared model/data config
+(CFG/DATA) and batch helper, so the sync baseline trains the identical
+model on the identical rows.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+# tiny DeepFM: every structural piece of the BASELINE config (sparse FM
+# first/second order, deep tower, dense linear) at test scale
+CFG = dict(num_sparse_fields=6, sparse_feature_dim=50, embedding_size=8,
+           num_dense=13, hidden_dims=(32, 32))
+DATA = dict(num_sparse_fields=6, sparse_dim=50, synthetic_size=1536)
+LR = 0.3
+BS = 64
+
+
+def make_prog():
+    import paddle_tpu as pt
+    from paddle_tpu.models import deepfm
+    return pt.build(deepfm.make_model(**CFG))
+
+
+def ctr_batches(split, shard=0, nshards=1):
+    """Materialized feed dicts for one shard of the ctr reader."""
+    from paddle_tpu.data import datasets
+    rows = list(datasets.ctr(split, **DATA)())[shard::nshards]
+    out = []
+    for i in range(0, len(rows) - BS + 1, BS):
+        chunk = rows[i:i + BS]
+        out.append({
+            "dense": np.stack([r[0] for r in chunk]),
+            "sparse_ids": np.stack([r[1] for r in chunk]),
+            "label": np.stack([r[2] for r in chunk]).reshape(-1, 1),
+        })
+    return out
+
+
+def main():
+    pid, port, epochs = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    compress = "--compress" in sys.argv
+    from paddle_tpu.parallel import AsyncPSTrainer
+
+    prog = make_prog()
+    feeds = ctr_batches("train", shard=pid, nshards=2)
+    t = AsyncPSTrainer(prog, ("127.0.0.1", port), trainer_id=pid,
+                       pull_interval=2, fetch_list=["loss"],
+                       compress_grads=compress)
+    t.startup(sample_feed=feeds[0])
+    for e in range(epochs):
+        for b in feeds:
+            out = t.step(b)
+        print(f"LOSS {e} {float(out['loss']):.6f}", flush=True)
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    main()
