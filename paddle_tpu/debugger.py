@@ -87,19 +87,34 @@ def _walk_jaxprs(jx, visit):
                         _walk_jaxprs(u.jaxpr, visit)
 
 
-def op_frequence(program, params, state, *args, **kwargs) -> Dict[str, int]:
-    """tools/op_frequence.py analog: histogram of primitive ops in the
-    traced program (jaxpr = ProgramDesc), including nested bodies."""
+def op_frequence(program, params, state, *args, with_adjacent: bool = False,
+                 **kwargs) -> Dict[str, int]:
+    """contrib/op_frequence.py op_freq_statistic analog: histogram of
+    primitive ops in the traced program (jaxpr = ProgramDesc), including
+    nested bodies. With ``with_adjacent=True`` also returns the
+    two-adjacent-op frequency — how often op B consumes a value produced
+    by op A, keyed "a,b" like the reference's adj_2_op_freq — and the
+    result is the (uni, adj) pair the reference returns."""
     from collections import Counter
 
     jaxpr = program.desc(params, state, *args, **kwargs)
     counts: Counter = Counter()
+    adj: Counter = Counter()
 
     def visit(jx):
+        producer = {}
         for eqn in jx.eqns:
             counts[eqn.primitive.name] += 1
+            for iv in eqn.invars:
+                src = producer.get(id(iv))
+                if src is not None:
+                    adj[f"{src},{eqn.primitive.name}"] += 1
+            for ov in eqn.outvars:
+                producer[id(ov)] = eqn.primitive.name
 
     _walk_jaxprs(jaxpr.jaxpr, visit)
+    if with_adjacent:
+        return dict(counts.most_common()), dict(adj.most_common())
     return dict(counts.most_common())
 
 

@@ -405,6 +405,12 @@ def test_op_frequence_and_memory_usage():
     params, state = prog.init(jax.random.PRNGKey(0), x)
     freq = debugger.op_frequence(prog, params, state, x)
     assert freq.get("dot_general", 0) >= 1
+    uni, adj = debugger.op_frequence(prog, params, state, x,
+                                     with_adjacent=True)
+    assert uni == freq
+    # fc = dot + bias-add + relu: the add must consume the dot's output
+    assert any(k.startswith("dot_general,") for k in adj), adj
+    assert all(v >= 1 for v in adj.values())
     mem = debugger.memory_usage(prog, params, state, x)
     assert mem["param_mb"] > 0 and mem["activation_sum_mb"] > 0
     assert mem["param_with_optimizer_mb"] == pytest.approx(3 * mem["param_mb"])
