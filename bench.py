@@ -618,31 +618,47 @@ def _run_one(name: str, peak: float, quick: bool = False, batch_size=None):
     raise ValueError(f"unknown config {name}")
 
 
-def _probe_device(timeout: int = 240) -> Optional[str]:
+def _probe_device(timeout: int = 240):
     """Run a tiny matmul in a SUBPROCESS with a hard timeout. The axon
     transport can wedge inside a C call where no in-process guard fires;
     a dead tunnel must fail the suite fast with a recorded reason, not
-    hang the driver."""
+    hang the driver.
+
+    Also measures host→device transfer bandwidth (16 MB device_put,
+    best of 2): with-pipeline throughput is feed-bound when the tunnel
+    degrades, and recording the day's link speed in the suite record is
+    what lets a reader tell a framework regression from a bad tunnel.
+    Returns (device_kind, mbps) — (None, None) on a dead tunnel."""
     import subprocess
     import sys
 
-    code = ("import os, jax;"
+    code = ("import os, time, jax, numpy as np;"
             "w = os.environ.get('JAX_PLATFORMS');"
             "w and jax.config.update('jax_platforms', w);"
             "import jax.numpy as jnp;"
             "d = jax.devices()[0];"
             "x = jnp.ones((256, 256));"
             "jax.device_get((x @ x).sum());"
-            "print('KIND', getattr(d, 'device_kind', str(d)))")
+            "print('KIND', getattr(d, 'device_kind', str(d)));"
+            "h = np.ones((4 * 1024 * 1024,), np.float32);"
+            "ts = [];\n"
+            "for _ in range(2):\n"
+            "    t0 = time.perf_counter()\n"
+            "    jax.block_until_ready(jax.device_put(h))\n"
+            "    ts.append(time.perf_counter() - t0)\n"
+            "print('XFER', round(16.0 / min(ts), 1))")
     try:
         r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                            text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
-        return None
+        return None, None
+    kind = mbps = None
     for line in r.stdout.splitlines():
         if line.startswith("KIND "):
-            return line[5:]
-    return None
+            kind = line[5:]
+        elif line.startswith("XFER "):
+            mbps = float(line[5:])
+    return kind, mbps
 
 
 def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
@@ -655,7 +671,7 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
     import subprocess
     import sys
 
-    kind = _probe_device()
+    kind, h2d_mbps = _probe_device()
     if kind is None:
         return {"metric": "suite", "value": 0.0, "unit": "MFU",
                 "vs_baseline": None,
@@ -686,7 +702,7 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
         if child[0] is not None and child[0].poll() is None:
             child[0].kill()
         res = _assemble(configs, device or kind, peak, peak_source,
-                        compute_dtype)
+                        compute_dtype, h2d_mbps)
         res["partial"] = f"suite interrupted by signal {signum}"
         print(json.dumps(res), flush=True)
         os._exit(0)
@@ -742,10 +758,11 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
         signal.signal(signal.SIGINT, old_int)
 
     return _assemble(configs, device or kind, peak, peak_source,
-                     compute_dtype)
+                     compute_dtype, h2d_mbps)
 
 
-def _assemble(configs, device, peak, peak_source, compute_dtype):
+def _assemble(configs, device, peak, peak_source, compute_dtype,
+              h2d_mbps=None):
     mfus = [c["mfu"] for n, c in configs.items()
             if n.endswith("_train") and "mfu" in c]
     headline = max(mfus) if mfus else 0.0
@@ -759,6 +776,7 @@ def _assemble(configs, device, peak, peak_source, compute_dtype):
         "peak_flops": peak,
         "peak_source": peak_source,
         "compute_dtype": compute_dtype,
+        "host_to_device_mbps": h2d_mbps,
         "configs": configs,
     }
 
