@@ -132,16 +132,21 @@ def _result(n_per_step, unit, dt_pipe, dt_comp, flops_per_step, peak, baseline_k
 # -- train configs -----------------------------------------------------------
 
 
-def bench_resnet50(peak, batch_size=64, image_size=224, iters=20):
+def bench_resnet50(peak, batch_size=64, image_size=224, iters=20,
+                   data_format="NHWC"):
+    """NHWC by default: the TPU-native conv layout (XLA tiles NHWC conv
+    operands straight onto the MXU; NCHW graphs pay layout-assignment
+    transposes). The reference's NCHW remains a model option."""
     from paddle_tpu.core import flops
     from paddle_tpu.models import resnet
 
     return _bench_convnet(peak,
                           resnet.make_model(depth=50, class_num=1000,
-                                            image_size=image_size),
+                                            image_size=image_size,
+                                            data_format=data_format),
                           flops.resnet_fwd_flops(50, image_size), batch_size,
                           "resnet50", image_size=image_size, iters=iters,
-                          lr=0.1)
+                          lr=0.1, data_format=data_format)
 
 
 def bench_vgg16(peak, batch_size=64, image_size=224, iters=20):
@@ -154,15 +159,18 @@ def bench_vgg16(peak, batch_size=64, image_size=224, iters=20):
 
 
 def _bench_convnet(peak, make_model_fn, fwd_flops, batch_size, baseline_key,
-                   image_size=224, iters=20, lr=0.01):
+                   image_size=224, iters=20, lr=0.01, data_format="NCHW"):
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
     from paddle_tpu.core import flops
 
     model = pt.build(make_model_fn)
     rng = np.random.RandomState(0)
+    img_shape = ((batch_size, 3, image_size, image_size)
+                 if data_format == "NCHW"
+                 else (batch_size, image_size, image_size, 3))
     feeds = [{
-        "image": rng.randn(batch_size, 3, image_size, image_size).astype(np.float32),
+        "image": rng.randn(*img_shape).astype(np.float32),
         "label": rng.randint(0, 1000, (batch_size, 1)).astype(np.int64),
     } for _ in range(4)]
     trainer = pt.Trainer(model, opt.Momentum(lr, 0.9), loss_name="loss",

@@ -108,3 +108,37 @@ define_flag("benchmark", False, "Synchronize after each step and log timings (FL
 define_flag("deterministic", False, "Force deterministic reductions (FLAGS_cpu_deterministic)")
 define_flag("default_compute_dtype", "float32", "Compute dtype for layers ('bfloat16' on TPU for MXU)")
 define_flag("seed", 0, "Global random seed (startup-program seed analog)")
+define_flag("rng_impl", "auto",
+            "PRNG key impl: auto|threefry2x32|rbg. 'auto' picks XLA's "
+            "native RngBitGenerator on TPU (threefry synthesizes random "
+            "bits from many VPU ops and can dominate dropout-heavy "
+            "steps) and threefry elsewhere / under determinism")
+
+
+def default_rng_impl() -> str:
+    """Resolve the ``rng_impl`` flag. Determinism forces threefry: RBG
+    bit-streams are backend/partitioning-dependent, threefry's are not
+    (with jax_threefry_partitionable, see enable_determinism)."""
+    impl = get_flag("rng_impl")
+    if impl != "auto":
+        return impl
+    if get_flag("deterministic"):
+        return "threefry2x32"
+    import jax
+    try:
+        d = jax.devices()[0]
+        desc = ((getattr(d, "platform", "") or "")
+                + " " + (getattr(d, "device_kind", "") or "")).lower()
+    except Exception:
+        return "threefry2x32"
+    return "rbg" if "tpu" in desc else "threefry2x32"
+
+
+def make_prng_key(seed: int):
+    """PRNGKey under the resolved ``rng_impl`` — the one key-construction
+    point the executor/trainer path uses, so the whole step's dropout/
+    init randomness follows the flag. TYPED keys (jax.random.key): a raw
+    u32 key array loses its impl at the first jit boundary and gets
+    reinterpreted as threefry; the typed dtype carries it."""
+    import jax
+    return jax.random.key(seed, impl=default_rng_impl())

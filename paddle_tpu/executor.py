@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .core import profiler
-from .core.config import get_flag
+from .core.config import get_flag, make_prng_key
 from .core.errors import enforce
 from .core.place import Place, default_place
 from .framework import Program
@@ -77,7 +77,7 @@ class Executor:
         """Run the startup-program analog: initialize params/state into
         the scope."""
         if rng is None:
-            rng = jax.random.PRNGKey(get_flag("seed"))
+            rng = make_prng_key(get_flag("seed"))
         params, state = program.init(rng, *example_args, **example_kwargs)
         dev = self.place.device()
         self.scope.params = jax.device_put(params, dev)
@@ -194,7 +194,7 @@ class Trainer:
     # ------------------------------------------------------------------
     def startup(self, rng: Optional[jax.Array] = None, sample_feed: Optional[Feed] = None):
         if rng is None:
-            rng = jax.random.PRNGKey(get_flag("seed"))
+            rng = make_prng_key(get_flag("seed"))
         feed = {k: _abstractify(v) for k, v in (sample_feed or {}).items()}
         params, state = self.program.init(rng, **feed)
         opt_state = self.optimizer.init(params)
@@ -356,7 +356,7 @@ class Trainer:
         """One optimization step; returns the program's fetch dict."""
         enforce(self._step_fn is not None, "call startup() before step()")
         if rng is None:
-            rng = jax.random.fold_in(jax.random.PRNGKey(get_flag("seed") + 1), self.global_step)
+            rng = jax.random.fold_in(make_prng_key(get_flag("seed") + 1), self.global_step)
         feed = self._put_feed(feed)
         ls = getattr(self.scope, "loss_scale_state", None) or {}
         with profiler.record_event("trainer.step"):

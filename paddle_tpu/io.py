@@ -205,6 +205,21 @@ def load_trainer(dirname: str, trainer) -> None:
 # -- inference model (save/load_inference_model analog) ----------------------
 
 
+def _in_spec(flat_sources, exported):
+    """Flat (source, name) binding -> the ordered input spec native
+    drivers consume. ONE emission point for both artifact kinds
+    (save_inference_model / save_train_artifact): the invariant that
+    spec names stay byte-identical to npz member names (via
+    _mangle_leaf) and positionally aligned to exported.in_avals must
+    not fork."""
+    enforce(len(flat_sources) == len(exported.in_avals),
+            f"export signature mismatch: {len(flat_sources)} leaves vs "
+            f"{len(exported.in_avals)} in_avals")
+    return [{"source": src, "name": name,
+             "dtype": str(av.dtype), "shape": list(av.shape)}
+            for (src, name), av in zip(flat_sources, exported.in_avals)]
+
+
 def save_inference_model(dirname: str, program, params: Dict[str, jax.Array],
                          state: Dict[str, jax.Array], example_feed: Dict[str, Any]) -> None:
     """Export program.apply (inference mode, params baked as inputs) as a
@@ -241,9 +256,7 @@ def save_inference_model(dirname: str, program, params: Dict[str, jax.Array],
                     + [("feed", k) for k in feed_names])
     flat_vals = ([v for _, v in param_leaves] + [v for _, v in state_leaves]
                  + [np.asarray(example_feed[k]) for k in feed_names])
-    enforce(len(flat_sources) == len(exported.in_avals),
-            f"export signature mismatch: {len(flat_sources)} leaves vs "
-            f"{len(exported.in_avals)} in_avals")
+    in_spec = _in_spec(flat_sources, exported)
     for (src, name), val, av in zip(flat_sources, flat_vals, exported.in_avals):
         enforce(tuple(val.shape) == tuple(av.shape),
                 f"export arg order broke: {src}:{name} has shape {val.shape}, "
@@ -254,14 +267,103 @@ def save_inference_model(dirname: str, program, params: Dict[str, jax.Array],
             enforce(val.dtype.name == str(av.dtype),
                     f"export arg order broke: {src}:{name} is {val.dtype.name},"
                     f" aval expects {av.dtype}")
-    in_spec = [{"source": src, "name": name,
-                "dtype": str(av.dtype), "shape": list(av.shape)}
-               for (src, name), av in zip(flat_sources, exported.in_avals)]
     out_spec = [{"dtype": str(av.dtype), "shape": list(av.shape)}
                 for av in exported.out_avals]
     with open(os.path.join(dirname, "meta.json"), "w") as f:
         json.dump({"feed_names": feed_names, "inputs": in_spec,
                    "outputs": out_spec}, f)
+
+
+def save_train_artifact(dirname: str, trainer, example_feed: Dict[str, Any]) -> None:
+    """Export ONE optimizer step of a started Trainer as a StableHLO
+    artifact the Python-free native trainer (native/trainer.cc) can
+    drive — train/demo/demo_trainer.cc parity, where the reference saves
+    a ProgramDesc its C++ Executor replays.
+
+    The exported function is
+        step(params, opt_state, state, seed, *feeds)
+          -> (params', opt_state', state', loss)
+    with params/opt_state/state flattened in sorted-key order on BOTH
+    sides, so output i is input i's next value for i < num_carry — the
+    C++ loop swaps buffers positionally with no name resolution. The
+    per-step RNG enters as a u32 scalar seed (PRNGKey built inside the
+    traced step: threefry, so the artifact is backend-portable); the
+    C++ driver feeds the step index.
+    """
+    program, optimizer = trainer.program, trainer.optimizer
+    enforce(trainer.scope.params is not None, "save_train_artifact: call "
+            "trainer.startup() first")
+    enforce(getattr(trainer, "loss_scaler", None) is None,
+            "save_train_artifact: dynamic loss scaling not supported in the "
+            "native step (export a bfloat16/float32 trainer)")
+    enforce(getattr(trainer, "mesh", None) is None,
+            "save_train_artifact: single-device export only")
+    loss_name = trainer.loss_name
+    os.makedirs(dirname, exist_ok=True)
+    feed_names = sorted(example_feed)
+
+    def step(params_, opt_state_, state_, seed, *feed_vals):
+        feed = dict(zip(feed_names, feed_vals))
+        rng = jax.random.PRNGKey(seed)
+
+        def loss_fn(p, st):
+            out, new_state = program.apply(p, st, training=True, rng=rng,
+                                           **feed)
+            loss = out[loss_name] if isinstance(out, dict) else out
+            return loss, new_state
+
+        (loss, new_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params_, state_)
+        new_params, new_opt = optimizer.update(grads, opt_state_, params_,
+                                               program.param_info)
+        return new_params, new_opt, new_state, loss.astype(jnp.float32)
+
+    host = jax.device_get((trainer.scope.params, trainer.scope.opt_state,
+                           trainer.scope.state))
+    host_params, host_opt, host_state = host
+    example_vals = [jnp.asarray(np.asarray(example_feed[k]))
+                    for k in feed_names]
+    exported = jax.export.export(jax.jit(step))(
+        host_params, host_opt, host_state, np.uint32(0), *example_vals)
+    with open(os.path.join(dirname, "train_step.mlir"), "wb") as f:
+        f.write(exported.mlir_module_serialized)
+    # the jax-side serialization as well (save_inference_model's
+    # model.stablehlo analog): lets a Python process deserialize and
+    # replay the IDENTICAL artifact (tests do), not a re-trace
+    with open(os.path.join(dirname, "train_step.jaxexp"), "wb") as f:
+        f.write(exported.serialize())
+    np.savez(os.path.join(dirname, "params.npz"), **_flatten(host_params))
+    np.savez(os.path.join(dirname, "opt.npz"), **_flatten(host_opt))
+    np.savez(os.path.join(dirname, "state.npz"), **_flatten(host_state))
+
+    param_leaves = _flat_leaves_in_tree_order(host_params)
+    opt_leaves = _flat_leaves_in_tree_order(host_opt)
+    state_leaves = _flat_leaves_in_tree_order(host_state)
+    flat_sources = ([("params.npz", k) for k, _ in param_leaves]
+                    + [("opt.npz", k) for k, _ in opt_leaves]
+                    + [("state.npz", k) for k, _ in state_leaves]
+                    + [("seed", "seed")]
+                    + [("feed", k) for k in feed_names])
+    num_carry = len(param_leaves) + len(opt_leaves) + len(state_leaves)
+    enforce(len(exported.out_avals) == num_carry + 1,
+            "train export must emit carry + loss")
+    for (src, name), in_av, out_av in zip(
+            flat_sources[:num_carry], exported.in_avals[:num_carry],
+            exported.out_avals[:num_carry]):
+        enforce(tuple(in_av.shape) == tuple(out_av.shape)
+                and in_av.dtype == out_av.dtype,
+                f"carry leaf {src}:{name} not shape/dtype-stable across the "
+                f"step ({in_av} vs {out_av})")
+    # feed .npy files must carry the CANONICALIZED aval dtype (e.g. an
+    # int64 label feed traces as int32 with x64 off) or the native
+    # driver's dtype check rejects them at staging time
+    for k, av in zip(feed_names, exported.in_avals[num_carry + 1:]):
+        np.save(os.path.join(dirname, f"feed_{k}.npy"),
+                np.asarray(example_feed[k]).astype(av.dtype))
+    in_spec = _in_spec(flat_sources, exported)
+    with open(os.path.join(dirname, "meta_train.json"), "w") as f:
+        json.dump({"feed_names": feed_names, "num_carry": num_carry,
+                   "inputs": in_spec}, f)
 
 
 class Predictor:

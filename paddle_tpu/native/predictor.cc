@@ -25,280 +25,10 @@
 // Build (test_native_predictor.py does this):
 //   g++ -O2 -std=c++17 -I$TF_INCLUDE predictor.cc -o predictor -ldl
 
-#include <dlfcn.h>
-#include <stdint.h>
-#include <stdio.h>
-#include <string.h>
-
-#include <cmath>
-#include <map>
-#include <string>
-#include <vector>
-
-#include "xla/pjrt/c/pjrt_c_api.h"
-
-namespace {
-
-[[noreturn]] void Die(const std::string& msg) {
-  fprintf(stderr, "predictor: %s\n", msg.c_str());
-  exit(1);
-}
-
-std::string ReadFileOrDie(const std::string& path) {
-  FILE* f = fopen(path.c_str(), "rb");
-  if (!f) Die("cannot open " + path);
-  fseek(f, 0, SEEK_END);
-  long n = ftell(f);
-  fseek(f, 0, SEEK_SET);
-  std::string out(size_t(n), '\0');
-  if (fread(out.data(), 1, size_t(n), f) != size_t(n)) Die("short read " + path);
-  fclose(f);
-  return out;
-}
-
-// ---- npz (uncompressed zip of .npy) -------------------------------------
-
-struct Array {
-  std::string dtype;          // numpy descr without byte order, e.g. "f4"
-  std::vector<int64_t> shape;
-  const char* data = nullptr; // points into the owning zip blob
-  size_t nbytes = 0;
-};
-
-uint32_t rd32(const char* p) { uint32_t v; memcpy(&v, p, 4); return v; }
-uint16_t rd16(const char* p) { uint16_t v; memcpy(&v, p, 2); return v; }
-
-// Parse one .npy payload (v1/v2 header) into an Array.
-Array ParseNpy(const char* p, size_t n, const std::string& ctx) {
-  if (n < 10 || memcmp(p, "\x93NUMPY", 6) != 0) Die("bad npy magic in " + ctx);
-  int major = p[6];
-  size_t hlen, hoff;
-  if (major == 1) { hlen = rd16(p + 8); hoff = 10; }
-  else if (n >= 12) { hlen = rd32(p + 8); hoff = 12; }
-  else Die("truncated npy v2 header in " + ctx);
-  if (hoff + hlen > n) Die("npy header overruns member in " + ctx);
-  std::string hdr(p + hoff, hlen);
-  Array a;
-  // descr: '<f4' etc. — reject non-little-endian; '|' (byte-order-less)
-  // covers bool/int8
-  size_t dp = hdr.find("'descr':");
-  if (dp == std::string::npos) Die("npy header missing descr in " + ctx);
-  size_t q1 = hdr.find('\'', dp + 8), q2 = hdr.find('\'', q1 + 1);
-  std::string descr = hdr.substr(q1 + 1, q2 - q1 - 1);
-  if (descr[0] == '>') Die("big-endian npy unsupported: " + ctx);
-  a.dtype = (descr[0] == '<' || descr[0] == '|' || descr[0] == '=')
-                ? descr.substr(1) : descr;
-  if (hdr.find("'fortran_order': False") == std::string::npos)
-    Die("fortran-order npy unsupported: " + ctx);
-  size_t sp = hdr.find("'shape':");
-  size_t o1 = hdr.find('(', sp), o2 = hdr.find(')', o1);
-  std::string dims = hdr.substr(o1 + 1, o2 - o1 - 1);
-  size_t elems = 1;
-  for (size_t i = 0; i < dims.size();) {
-    while (i < dims.size() && (dims[i] == ' ' || dims[i] == ',')) ++i;
-    if (i >= dims.size()) break;
-    int64_t d = strtoll(dims.c_str() + i, nullptr, 10);
-    if (d < 0) Die("negative npy dim in " + ctx);
-    a.shape.push_back(d);
-    if (d != 0 && elems > SIZE_MAX / size_t(d))
-      Die("npy shape overflows size_t in " + ctx);
-    elems *= size_t(d);
-    while (i < dims.size() && dims[i] != ',') ++i;
-  }
-  size_t esize = strtoull(a.dtype.c_str() + 1, nullptr, 10);
-  if (esize == 0) Die("npy dtype " + a.dtype + " has no size in " + ctx);
-  if (elems > SIZE_MAX / esize) Die("npy size overflows size_t in " + ctx);
-  a.data = p + hoff + hlen;
-  a.nbytes = elems * esize;
-  if (hoff + hlen + a.nbytes > n) Die("npy data overruns member in " + ctx);
-  return a;
-}
-
-// np.savez writes STORED (method 0) members; walk local file headers.
-std::map<std::string, Array> ParseNpz(const std::string& blob,
-                                      const std::string& ctx) {
-  std::map<std::string, Array> out;
-  size_t off = 0;
-  while (off + 30 <= blob.size() && rd32(blob.data() + off) == 0x04034b50) {
-    const char* h = blob.data() + off;
-    uint16_t method = rd16(h + 8);
-    uint16_t flags = rd16(h + 6);
-    uint64_t csize = rd32(h + 18);
-    uint16_t nlen = rd16(h + 26), xlen = rd16(h + 28);
-    if (off + 30 + size_t(nlen) + size_t(xlen) > blob.size())
-      Die("npz member header overruns archive in " + ctx);
-    std::string name(h + 30, nlen);
-    const char* data = h + 30 + nlen + xlen;
-    if (csize == 0xffffffffu) {
-      // numpy writes zip64 members: real sizes live in extra field 0x0001
-      // as two u64s (uncompressed, then compressed)
-      const char* x = h + 30 + nlen;
-      const char* xe = x + xlen;
-      csize = SIZE_MAX;
-      while (x + 4 <= xe) {
-        uint16_t id = rd16(x), sz = rd16(x + 2);
-        if (x + 4 + sz > xe) break;  // field claims more than the extra area holds
-        if (id == 0x0001 && sz >= 16) {
-          memcpy(&csize, x + 4 + 8, 8);  // second u64 = compressed size
-          break;
-        }
-        x += 4 + sz;
-      }
-      if (csize == SIZE_MAX) Die("zip64 member without size extra in " + ctx);
-    }
-    if (flags & 0x8) Die("zip data-descriptor members unsupported: " + ctx);
-    if (method != 0) Die("compressed npz member " + name + " in " + ctx +
-                         " (np.savez_compressed unsupported)");
-    if (csize > blob.size() - (size_t(data - blob.data())))
-      Die("npz member " + name + " payload overruns archive in " + ctx);
-    if (name.size() > 4 && name.substr(name.size() - 4) == ".npy")
-      out[name.substr(0, name.size() - 4)] =
-          ParseNpy(data, csize, ctx + ":" + name);
-    off = size_t(data - blob.data()) + csize;
-  }
-  if (out.empty()) Die("no npy members found in " + ctx);
-  return out;
-}
-
-// ---- meta.json (our own generator's fixed structure) --------------------
-
-struct InputSpec {
-  std::string source;  // "params.npz" | "state.npz" | "feed"
-  std::string name;
-  std::string dtype;   // numpy name, e.g. "float32"
-  std::vector<int64_t> shape;
-};
-
-std::string JStr(const std::string& s, size_t& i) {
-  if (s[i] != '"') Die("meta.json parse error (expected string)");
-  size_t j = s.find('"', i + 1);
-  std::string out = s.substr(i + 1, j - i - 1);
-  i = j + 1;
-  return out;
-}
-
-// Minimal parser for the exact meta.json shape io.py writes. Tolerates
-// whitespace; dies loudly on anything structurally unexpected.
-std::vector<InputSpec> ParseMetaInputs(const std::string& js) {
-  std::vector<InputSpec> specs;
-  size_t p = js.find("\"inputs\"");
-  if (p == std::string::npos)
-    Die("meta.json has no \"inputs\" — re-export with the current "
-        "save_inference_model (older artifacts lack the native signature)");
-  p = js.find('[', p);
-  size_t end = p;
-  int depth = 0;
-  for (size_t i = p; i < js.size(); ++i) {
-    if (js[i] == '[') ++depth;
-    if (js[i] == ']' && --depth == 0) { end = i; break; }
-  }
-  size_t i = p + 1;
-  while (true) {
-    size_t ob = js.find('{', i);
-    if (ob == std::string::npos || ob > end) break;
-    size_t cb = js.find('}', ob);
-    std::string obj = js.substr(ob, cb - ob + 1);
-    InputSpec sp;
-    for (const char* key : {"source", "name", "dtype"}) {
-      size_t kp = obj.find(std::string("\"") + key + "\"");
-      if (kp == std::string::npos) Die(std::string("meta input missing ") + key);
-      size_t vp = obj.find(':', kp) + 1;
-      while (obj[vp] == ' ') ++vp;
-      std::string val = JStr(obj, vp);
-      if (!strcmp(key, "source")) sp.source = val;
-      else if (!strcmp(key, "name")) sp.name = val;
-      else sp.dtype = val;
-    }
-    size_t shp = obj.find("\"shape\"");
-    size_t sb = obj.find('[', shp), se = obj.find(']', sb);
-    std::string dims = obj.substr(sb + 1, se - sb - 1);
-    for (size_t k = 0; k < dims.size();) {
-      while (k < dims.size() && (dims[k] == ' ' || dims[k] == ',')) ++k;
-      if (k >= dims.size()) break;
-      sp.shape.push_back(strtoll(dims.c_str() + k, nullptr, 10));
-      while (k < dims.size() && dims[k] != ',') ++k;
-    }
-    specs.push_back(std::move(sp));
-    i = cb + 1;
-  }
-  if (specs.empty()) Die("meta.json inputs empty");
-  return specs;
-}
-
-// ---- dtype mapping ------------------------------------------------------
-
-struct DType {
-  PJRT_Buffer_Type pjrt;
-  size_t size;
-  const char* npy;  // descr suffix ("f4")
-};
-
-DType DtypeOrDie(const std::string& numpy_name) {
-  if (numpy_name == "float32") return {PJRT_Buffer_Type_F32, 4, "f4"};
-  if (numpy_name == "float64") return {PJRT_Buffer_Type_F64, 8, "f8"};
-  // io._flatten stores bfloat16 npz members as uint16 views ("u2",
-  // '@bfloat16' name suffix); the device buffer is still BF16
-  if (numpy_name == "bfloat16") return {PJRT_Buffer_Type_BF16, 2, "u2"};
-  if (numpy_name == "float16") return {PJRT_Buffer_Type_F16, 2, "f2"};
-  if (numpy_name == "int64") return {PJRT_Buffer_Type_S64, 8, "i8"};
-  if (numpy_name == "int32") return {PJRT_Buffer_Type_S32, 4, "i4"};
-  if (numpy_name == "int16") return {PJRT_Buffer_Type_S16, 2, "i2"};
-  if (numpy_name == "int8") return {PJRT_Buffer_Type_S8, 1, "i1"};
-  if (numpy_name == "uint8") return {PJRT_Buffer_Type_U8, 1, "u1"};
-  if (numpy_name == "uint32") return {PJRT_Buffer_Type_U32, 4, "u4"};
-  if (numpy_name == "bool") return {PJRT_Buffer_Type_PRED, 1, "b1"};
-  Die("unsupported dtype " + numpy_name);
-}
-
-// ---- PJRT plumbing ------------------------------------------------------
-
-const PJRT_Api* g_api = nullptr;
-
-void Check(PJRT_Error* err, const char* what) {
-  if (!err) return;
-  PJRT_Error_Message_Args m;
-  memset(&m, 0, sizeof m);
-  m.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
-  m.error = err;
-  g_api->PJRT_Error_Message(&m);
-  std::string msg(m.message, m.message_size);
-  PJRT_Error_Destroy_Args d;
-  memset(&d, 0, sizeof d);
-  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-  d.error = err;
-  g_api->PJRT_Error_Destroy(&d);
-  Die(std::string(what) + ": " + msg);
-}
-
-void AwaitAndDestroy(PJRT_Event* ev, const char* what) {
-  PJRT_Event_Await_Args aw;
-  memset(&aw, 0, sizeof aw);
-  aw.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
-  aw.event = ev;
-  Check(g_api->PJRT_Event_Await(&aw), what);
-  PJRT_Event_Destroy_Args ed;
-  memset(&ed, 0, sizeof ed);
-  ed.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
-  ed.event = ev;
-  Check(g_api->PJRT_Event_Destroy(&ed), "event destroy");
-}
-
-// Minimal serialized xla.CompileOptionsProto:
-//   field 3 (executable_build_options) {
-//     field 4 (num_replicas) = 1; field 5 (num_partitions) = 1; }
-// Hand-encoded: protoc isn't needed for two varints.
-std::string MinimalCompileOptions() {
-  const char inner[] = {0x20, 0x01, 0x28, 0x01};        // 4:1, 5:1
-  std::string opts;
-  opts.push_back(0x1a);                                  // field 3, wire 2
-  opts.push_back(char(sizeof inner));
-  opts.append(inner, sizeof inner);
-  return opts;
-}
-
-}  // namespace
+#include "pjrt_common.h"
 
 int main(int argc, char** argv) {
+  g_tool = "predictor";
   if (argc < 3) {
     fprintf(stderr,
             "usage: predictor <artifact_dir> <pjrt_plugin.so> [--probe]\n");

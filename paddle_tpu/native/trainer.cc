@@ -1,0 +1,309 @@
+// Python-free native trainer over the PJRT C API.
+//
+// Full capability parity with the reference's C++ training entry
+// (train/demo/demo_trainer.cc: load a saved program + params, drive the
+// epoch loop, track loss — no Python in the process; the reference's
+// demo loads a ProgramDesc into its C++ Executor). Our training
+// artifact (io.py save_train_artifact) is one jitted optimizer STEP
+// exported as StableHLO:
+//
+//   step(params..., opt_state..., state..., seed, feeds...)
+//       -> (params'..., opt_state'..., state'..., loss)
+//
+// with the first num_carry outputs positionally aligned to the first
+// num_carry inputs (both flatten dicts in sorted-key order), so the
+// C++ loop is pure buffer plumbing: execute, swap the carry buffers to
+// the outputs, restage the seed scalar, repeat. The training loop,
+// batch feeding, loss tracking, and the convergence check all live
+// here; XLA owns the math.
+//
+//   trainer <artifact_dir> <pjrt_plugin.so> [--probe] [--steps N]
+//
+// --probe stops after the accelerator-free half: artifact
+// load/validation (meta_train.json vs npz shapes/dtypes + carry
+// alignment) and the plugin version handshake. The full run trains on
+// the exported example batch (feed_<name>.npy) until the loss drops —
+// overfitting one batch is the convergence check that needs no
+// task-specific data generator and works for ANY exported program.
+//
+// Build (test_native_trainer.py does this):
+//   g++ -O2 -std=c++17 -I$TF_INCLUDE trainer.cc -o trainer -ldl
+
+#include "pjrt_common.h"
+
+namespace {
+
+// meta_train.json is the predictor meta plus {"num_carry": N}; pull the
+// integer out with the same minimal scanning ParseMetaInputs uses.
+size_t ParseNumCarry(const std::string& js) {
+  size_t k = js.find("\"num_carry\"");
+  if (k == std::string::npos) Die("meta_train.json missing num_carry");
+  k = js.find(':', k);
+  return strtoull(js.c_str() + k + 1, nullptr, 10);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  g_tool = "trainer";
+  if (argc < 3) {
+    fprintf(stderr,
+            "usage: trainer <artifact_dir> <pjrt_plugin.so> [--probe] "
+            "[--steps N]\n");
+    return 2;
+  }
+  std::string dir = argv[1], plugin = argv[2];
+  bool probe = false;
+  long steps = 30;
+  for (int i = 3; i < argc; ++i) {
+    if (std::string(argv[i]) == "--probe") probe = true;
+    if (std::string(argv[i]) == "--steps" && i + 1 < argc)
+      steps = strtol(argv[++i], nullptr, 10);
+  }
+
+  // ---- artifact load + validation (no accelerator needed) ---------------
+  std::string mlir = ReadFileOrDie(dir + "/train_step.mlir");
+  std::string meta = ReadFileOrDie(dir + "/meta_train.json");
+  std::string params_blob = ReadFileOrDie(dir + "/params.npz");
+  std::string opt_blob = ReadFileOrDie(dir + "/opt.npz");
+  std::string state_blob = ReadFileOrDie(dir + "/state.npz");
+  auto params = ParseNpz(params_blob, "params.npz");
+  auto opt = ParseNpz(opt_blob, "opt.npz");
+  std::map<std::string, Array> state;
+  if (state_blob.size() > 4 && rd32(state_blob.data()) == 0x04034b50)
+    state = ParseNpz(state_blob, "state.npz");
+  auto inputs = ParseMetaInputs(meta);
+  size_t num_carry = ParseNumCarry(meta);
+  if (num_carry == 0 || num_carry >= inputs.size())
+    Die("num_carry " + std::to_string(num_carry) + " out of range for " +
+        std::to_string(inputs.size()) + " inputs");
+
+  size_t feed_args = 0, weight_bytes = 0;
+  bool saw_seed = false;
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    const auto& sp = inputs[i];
+    DType dt = DtypeOrDie(sp.dtype);
+    size_t want = dt.size;
+    for (int64_t d : sp.shape) want *= size_t(d);
+    if (sp.source == "seed") {
+      if (i != num_carry) Die("seed input must sit right after the carry");
+      saw_seed = true;
+      continue;
+    }
+    if (sp.source == "feed") {
+      if (i < num_carry) Die("feed input inside the carry prefix");
+      ++feed_args;
+      continue;
+    }
+    if (i >= num_carry) Die("weight input past the carry prefix: " + sp.name);
+    auto& table = sp.source == "params.npz" ? params
+                  : sp.source == "opt.npz"  ? opt
+                                            : state;
+    auto it = table.find(sp.name);
+    if (it == table.end())
+      Die("meta input " + sp.name + " missing from " + sp.source);
+    const Array& got = it->second;
+    if (got.nbytes != want || got.dtype != dt.npy || got.shape != sp.shape)
+      Die("weight " + sp.name + " does not match the exported signature");
+    weight_bytes += want;
+  }
+  if (!saw_seed) Die("meta_train.json has no seed input");
+  fprintf(stderr,
+          "trainer: artifact ok — %zu args (%zu carry %.1f MB, %zu feeds), "
+          "stablehlo %zu bytes\n",
+          inputs.size(), num_carry, weight_bytes / 1048576.0, feed_args,
+          mlir.size());
+
+  // ---- plugin handshake -------------------------------------------------
+  void* lib = dlopen(plugin.c_str(), RTLD_NOW | RTLD_LOCAL);
+  if (!lib) Die(std::string("dlopen failed: ") + dlerror());
+  auto get_api =
+      reinterpret_cast<const PJRT_Api* (*)()>(dlsym(lib, "GetPjrtApi"));
+  if (!get_api) Die("plugin has no GetPjrtApi symbol");
+  g_api = get_api();
+  if (!g_api) Die("GetPjrtApi returned null");
+  fprintf(stderr, "trainer: plugin PJRT API v%d.%d (header v%d.%d)\n",
+          g_api->pjrt_api_version.major_version,
+          g_api->pjrt_api_version.minor_version, PJRT_API_MAJOR,
+          PJRT_API_MINOR);
+  if (g_api->pjrt_api_version.major_version != PJRT_API_MAJOR)
+    Die("PJRT major version mismatch");
+
+  if (probe) {
+    printf("PROBE OK\n");
+    return 0;
+  }
+
+  PJRT_Plugin_Initialize_Args pi;
+  memset(&pi, 0, sizeof pi);
+  pi.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  Check(g_api->PJRT_Plugin_Initialize(&pi), "plugin init");
+
+  PJRT_Client_Create_Args cc;
+  memset(&cc, 0, sizeof cc);
+  cc.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  Check(g_api->PJRT_Client_Create(&cc), "client create");
+  PJRT_Client* client = cc.client;
+
+  PJRT_Client_AddressableDevices_Args ad;
+  memset(&ad, 0, sizeof ad);
+  ad.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  ad.client = client;
+  Check(g_api->PJRT_Client_AddressableDevices(&ad), "devices");
+  if (ad.num_addressable_devices == 0) Die("no addressable devices");
+  PJRT_Device* dev = ad.addressable_devices[0];
+
+  // ---- compile ----------------------------------------------------------
+  PJRT_Program prog;
+  memset(&prog, 0, sizeof prog);
+  prog.struct_size = PJRT_Program_STRUCT_SIZE;
+  prog.code = mlir.data();
+  prog.code_size = mlir.size();
+  static const char kFmt[] = "mlir";
+  prog.format = kFmt;
+  prog.format_size = 4;
+  std::string copts = MinimalCompileOptions();
+  PJRT_Client_Compile_Args comp;
+  memset(&comp, 0, sizeof comp);
+  comp.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  comp.client = client;
+  comp.program = &prog;
+  comp.compile_options = copts.data();
+  comp.compile_options_size = copts.size();
+  Check(g_api->PJRT_Client_Compile(&comp), "compile");
+  fprintf(stderr, "trainer: train step compiled\n");
+
+  auto stage = [&](const char* data, const InputSpec& sp) -> PJRT_Buffer* {
+    DType dt = DtypeOrDie(sp.dtype);
+    PJRT_Client_BufferFromHostBuffer_Args hb;
+    memset(&hb, 0, sizeof hb);
+    hb.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    hb.client = client;
+    hb.data = data;
+    hb.type = dt.pjrt;
+    hb.dims = sp.shape.data();
+    hb.num_dims = sp.shape.size();
+    hb.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    hb.device = dev;
+    Check(g_api->PJRT_Client_BufferFromHostBuffer(&hb),
+          ("h2d " + sp.name).c_str());
+    AwaitAndDestroy(hb.done_with_host_buffer, "h2d done");
+    return hb.buffer;
+  };
+
+  // ---- stage initial carry + fixed feeds --------------------------------
+  std::vector<PJRT_Buffer*> args(inputs.size(), nullptr);
+  std::vector<std::string> feed_storage;
+  uint32_t seed_host = 0;
+  size_t seed_idx = num_carry;
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    const auto& sp = inputs[i];
+    if (sp.source == "seed") {
+      args[i] = stage(reinterpret_cast<const char*>(&seed_host), sp);
+    } else if (sp.source == "feed") {
+      std::string path = dir + "/feed_" + sp.name + ".npy";
+      std::string blob = ReadFileOrDie(path);
+      feed_storage.push_back(std::move(blob));
+      Array a = ParseNpy(feed_storage.back().data(),
+                         feed_storage.back().size(), path);
+      DType dt = DtypeOrDie(sp.dtype);
+      if (a.dtype != dt.npy || a.shape != sp.shape)
+        Die("feed " + sp.name + " does not match the exported signature");
+      args[i] = stage(a.data, sp);
+    } else {
+      auto& table = sp.source == "params.npz" ? params
+                    : sp.source == "opt.npz"  ? opt
+                                              : state;
+      args[i] = stage(table.at(sp.name).data, sp);
+    }
+  }
+
+  // ---- the training loop (demo_trainer.cc's epoch loop) -----------------
+  PJRT_LoadedExecutable_GetExecutable_Args ge;
+  memset(&ge, 0, sizeof ge);
+  ge.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  ge.loaded_executable = comp.executable;
+  Check(g_api->PJRT_LoadedExecutable_GetExecutable(&ge), "get executable");
+  PJRT_Executable_NumOutputs_Args no;
+  memset(&no, 0, sizeof no);
+  no.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  no.executable = ge.executable;
+  Check(g_api->PJRT_Executable_NumOutputs(&no), "num outputs");
+  if (no.num_outputs != num_carry + 1)
+    Die("executable has " + std::to_string(no.num_outputs) +
+        " outputs, expected carry+loss = " + std::to_string(num_carry + 1));
+
+  double first_loss = 0, last_loss = 0;
+  for (long step = 0; step < steps; ++step) {
+    std::vector<PJRT_Buffer*> outs(no.num_outputs, nullptr);
+    PJRT_Buffer** out_list = outs.data();
+    PJRT_Buffer* const* arg_list = args.data();
+    PJRT_ExecuteOptions eo;
+    memset(&eo, 0, sizeof eo);
+    eo.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+    PJRT_Event* done = nullptr;
+    PJRT_LoadedExecutable_Execute_Args ex;
+    memset(&ex, 0, sizeof ex);
+    ex.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    ex.executable = comp.executable;
+    ex.options = &eo;
+    ex.argument_lists = &arg_list;
+    ex.num_devices = 1;
+    ex.num_args = args.size();
+    ex.output_lists = &out_list;
+    ex.device_complete_events = &done;
+    ex.execute_device = dev;
+    Check(g_api->PJRT_LoadedExecutable_Execute(&ex), "execute");
+    AwaitAndDestroy(done, "execute done");
+
+    // loss is the final output — a f32 scalar
+    PJRT_Buffer_ToHostBuffer_Args th;
+    memset(&th, 0, sizeof th);
+    th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    th.src = outs[num_carry];
+    Check(g_api->PJRT_Buffer_ToHostBuffer(&th), "d2h size query");
+    std::vector<char> host(th.dst_size);
+    th.dst = host.data();
+    Check(g_api->PJRT_Buffer_ToHostBuffer(&th), "d2h");
+    AwaitAndDestroy(th.event, "d2h done");
+    float loss = 0;
+    if (host.size() >= 4) memcpy(&loss, host.data(), 4);
+    if (step == 0) first_loss = loss;
+    last_loss = loss;
+    printf("STEP %ld LOSS %.6f\n", step, loss);
+
+    // swap: outputs become next step's carry; old carry buffers retire
+    for (size_t i = 0; i < num_carry; ++i) {
+      PJRT_Buffer_Destroy_Args bd;
+      memset(&bd, 0, sizeof bd);
+      bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      bd.buffer = args[i];
+      Check(g_api->PJRT_Buffer_Destroy(&bd), "carry destroy");
+      args[i] = outs[i];
+    }
+    PJRT_Buffer_Destroy_Args ld;
+    memset(&ld, 0, sizeof ld);
+    ld.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    ld.buffer = outs[num_carry];
+    Check(g_api->PJRT_Buffer_Destroy(&ld), "loss destroy");
+
+    // restage the per-step RNG seed
+    PJRT_Buffer_Destroy_Args sd;
+    memset(&sd, 0, sizeof sd);
+    sd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    sd.buffer = args[seed_idx];
+    Check(g_api->PJRT_Buffer_Destroy(&sd), "seed destroy");
+    seed_host = uint32_t(step + 1);
+    args[seed_idx] = stage(reinterpret_cast<const char*>(&seed_host),
+                           inputs[seed_idx]);
+  }
+
+  if (!(last_loss < first_loss)) {
+    fprintf(stderr, "trainer: loss did not drop (%.6f -> %.6f)\n", first_loss,
+            last_loss);
+    return 1;
+  }
+  printf("TRAIN OK first=%.6f last=%.6f\n", first_loss, last_loss);
+  return 0;
+}

@@ -208,3 +208,32 @@ def test_word2vec_learns():
     assert "shared_emb/w" in trainer.scope.params
     losses = [float(trainer.step(feed)["loss"]) for _ in range(60)]
     assert losses[-1] < losses[0] * 0.5
+
+
+@pytest.mark.slow
+def test_resnet_nhwc_matches_nchw():
+    """NHWC (the TPU-native conv layout the benchmark runs) computes the
+    same function as the reference's NCHW: identical loss/logits for the
+    transposed input with identically-seeded params."""
+    import jax
+
+    def tiny(df):
+        return resnet.make_model(depth=50, class_num=7, image_size=24,
+                                 data_format=df)
+
+    x = np.random.randn(2, 3, 24, 24).astype(np.float32)
+    y = np.random.randint(0, 7, (2, 1)).astype(np.int64)
+    m_nchw = pt.build(tiny("NCHW"))
+    m_nhwc = pt.build(tiny("NHWC"))
+    feed_c = {"image": x, "label": y}
+    feed_h = {"image": x.transpose(0, 2, 3, 1), "label": y}
+    p_c, s_c = m_nchw.init(jax.random.PRNGKey(0), **feed_c)
+    p_h, s_h = m_nhwc.init(jax.random.PRNGKey(0), **feed_h)
+    # same param tree (conv weights stay OIHW in both layouts)
+    assert {k: v.shape for k, v in p_c.items()} \
+        == {k: v.shape for k, v in p_h.items()}
+    out_c, _ = m_nchw.apply(p_c, s_c, training=False, **feed_c)
+    out_h, _ = m_nhwc.apply(p_c, s_h, training=False, **feed_h)
+    np.testing.assert_allclose(np.asarray(out_h["logits"]),
+                               np.asarray(out_c["logits"]),
+                               rtol=2e-4, atol=2e-4)

@@ -58,10 +58,21 @@ def build_run(fn, *inputs, **kw):
 CASES = {}
 
 
-def case(name):
+_SUFFIXED = set()
+
+
+def case(name, suffix=""):
+    """Register a numeric case for a surface name. ``suffix`` registers
+    an additional case for an already-covered name (the surface
+    accounting counts the base name once)."""
     def deco(f):
-        assert name not in CASES, name
-        CASES[name] = f
+        key = name + suffix
+        assert key not in CASES, key
+        assert not (suffix and name not in CASES), \
+            f"suffix case {key} needs a base case for {name}"
+        if suffix:
+            _SUFFIXED.add(key)
+        CASES[key] = f
         return f
     return deco
 
@@ -972,7 +983,7 @@ def _():
              rtol=1e-4, atol=1e-5)
 
 
-@case("resize_nearest")
+@case("image_resize", suffix="_nearest_half_up")
 def _():
     # nearest_interp_op align_corners rounds HALF-UP: int(o*ratio + 0.5).
     # 3x3 -> 5x5 has ratio 0.5, so positions [0,.5,1,1.5,2] must map to
@@ -1137,7 +1148,7 @@ EXEMPT = {
 def test_surface_partitioned():
     """Every public layer name has exactly one coverage disposition."""
     surface = set(REFERENCE_LAYERS_ALL)
-    cased, covered, exempt = set(CASES), set(COVERED), set(EXEMPT)
+    cased, covered, exempt = set(CASES) - _SUFFIXED, set(COVERED), set(EXEMPT)
     assert not (cased & covered), cased & covered
     assert not (cased & exempt), cased & exempt
     assert not (covered & exempt), covered & exempt
