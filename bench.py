@@ -159,12 +159,18 @@ def bench_vgg16(peak, batch_size=64, image_size=224, iters=20):
 
 
 def _bench_convnet(peak, make_model_fn, fwd_flops, batch_size, baseline_key,
-                   image_size=224, iters=20, lr=0.01, data_format="NCHW"):
+                   image_size=224, iters=20, lr=0.01, data_format="NHWC"):
+    """All conv benches run NHWC by default — the TPU-native layout (the
+    ambient framework.layout_mode is captured at build time, so the
+    whole zoo needs no per-model threading); the models still default
+    to the reference's NCHW outside the bench."""
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
     from paddle_tpu.core import flops
+    from paddle_tpu.framework import layout_mode
 
-    model = pt.build(make_model_fn)
+    with layout_mode(data_format):
+        model = pt.build(make_model_fn)
     rng = np.random.RandomState(0)
     img_shape = ((batch_size, 3, image_size, image_size)
                  if data_format == "NCHW"
@@ -457,11 +463,14 @@ def _bench_infer(peak, make_model_fn, fwd_flops_per_image, baseline_key,
     from paddle_tpu import io as pio, quantize
     from paddle_tpu.core.config import set_flag
 
+    from paddle_tpu.framework import layout_mode
+
     set_flag("default_compute_dtype",
              "float32" if variant == "fp32" else "bfloat16")
-    model = pt.build(make_model_fn)
+    with layout_mode("NHWC"):  # serving runs the TPU-native layout too
+        model = pt.build(make_model_fn)
     rng = np.random.RandomState(0)
-    feed = {"image": rng.randn(batch_size, 3, image_size, image_size).astype(np.float32),
+    feed = {"image": rng.randn(batch_size, image_size, image_size, 3).astype(np.float32),
             "label": rng.randint(0, 1000, (batch_size, 1)).astype(np.int64)}
     params, state = model.init(jax.random.PRNGKey(0), **feed)
     if variant in ("bf16", "int8"):

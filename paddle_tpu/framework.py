@@ -379,6 +379,13 @@ class Program:
         self.fn = fn
         self.name = name or getattr(fn, "__name__", "program")
         self.param_info: Dict[str, ParamInfo] = {}
+        # capture the EFFECTIVE image layout at BUILD time and re-enter
+        # it for every trace: pt.build(model) under layout_mode("NHWC")
+        # pins the whole program to the TPU-native layout even though
+        # tracing happens later (init / jitted apply / export). Programs
+        # built outside any layout_mode pin NCHW — an ambient context
+        # active at trace time must not leak in.
+        self.layout = current_layout()
 
     # ------------------------------------------------------------------
     def init(self, rng: jax.Array, *args, **kwargs) -> Tuple[Params, State]:
@@ -393,7 +400,7 @@ class Program:
                           param_info=self.param_info)
 
         def _run(*a, **kw):
-            with _use_ctx(ctx):
+            with _use_ctx(ctx), layout_mode(self.layout):
                 self.fn(*a, **kw)
             return 0
 
@@ -416,7 +423,7 @@ class Program:
         ctx = BuildContext(
             "apply", params, state or {}, rng, training, dict(self.param_info)
         )
-        with _use_ctx(ctx):
+        with _use_ctx(ctx), layout_mode(self.layout):
             out = self.fn(*args, **kwargs)
         new_state = dict(ctx.state)
         new_state.update(ctx.new_state)
@@ -455,6 +462,36 @@ def build(fn: Callable, name: Optional[str] = None) -> Program:
 # --------------------------------------------------------------------------
 
 _remat_mode = threading.local()
+
+
+_layout_mode = threading.local()
+
+
+@contextlib.contextmanager
+def layout_mode(data_format: str = "NHWC"):
+    """Ambient image-layout switch. TPU's MXU wants NHWC convolutions
+    (channels on the 128-lane minor axis — NCHW graphs pay XLA
+    layout-assignment transposes), but the reference API's default and
+    most user model code say NCHW. Under ``layout_mode("NHWC")`` every
+    conv/pool/BN layer whose ``data_format`` is left unspecified, and
+    every zoo model's channel-axis bookkeeping (via
+    :func:`current_layout`), follows the ambient layout — the whole
+    model zoo runs TPU-native without per-model threading."""
+    assert data_format in ("NCHW", "NHWC"), data_format
+    old = getattr(_layout_mode, "fmt", None)
+    _layout_mode.fmt = data_format
+    try:
+        yield
+    finally:
+        _layout_mode.fmt = old
+
+
+def current_layout(explicit=None) -> str:
+    """Resolve a layer's data_format: explicit argument wins, then the
+    ambient :func:`layout_mode`, then the reference default NCHW."""
+    if explicit is not None:
+        return explicit
+    return getattr(_layout_mode, "fmt", None) or "NCHW"
 
 
 @contextlib.contextmanager

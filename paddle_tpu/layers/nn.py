@@ -21,7 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.errors import enforce
-from ..framework import LayerHelper, ParamAttr, cast_compute, in_training, next_rng_key
+from ..framework import (LayerHelper, ParamAttr, cast_compute, current_layout,
+                         in_training, next_rng_key)
 from .. import initializer as init
 from .ops import apply_activation
 
@@ -181,11 +182,14 @@ def conv2d(
     param_attr=None,
     bias_attr=None,
     act: Optional[str] = None,
-    data_format: str = "NCHW",
+    data_format: str = None,
     name: Optional[str] = None,
     use_cudnn: bool = True,  # accepted for API parity; XLA picks the algo
 ):
-    """2-D convolution (conv_op.cc / conv_cudnn_op.cu.cc analog)."""
+    """2-D convolution (conv_op.cc / conv_cudnn_op.cu.cc analog).
+    ``data_format=None`` resolves via the ambient framework.layout_mode
+    (NHWC under layout_mode("NHWC"), the TPU-native conv layout)."""
+    data_format = current_layout(data_format)
     helper = LayerHelper("conv2d", name=name)
     fs, st, pd, dl = _pair(filter_size), _pair(stride), _pair(padding), _pair(dilation)
     c_axis = 1 if data_format == "NCHW" else 3
@@ -235,12 +239,13 @@ def conv2d_transpose(
     param_attr=None,
     bias_attr=None,
     act: Optional[str] = None,
-    data_format: str = "NCHW",
+    data_format: str = None,
     name: Optional[str] = None,
     output_size=None,
     use_cudnn: bool = True,
 ):
     """conv2d_transpose_op analog (gradient of conv wrt input)."""
+    data_format = current_layout(data_format)
     helper = LayerHelper("conv2d_transpose", name=name)
     fs, st, pd, dl = _pair(filter_size), _pair(stride), _pair(padding), _pair(dilation)
     c_axis = 1 if data_format == "NCHW" else 3
@@ -324,11 +329,12 @@ def pool2d(
     global_pooling: bool = False,
     ceil_mode: bool = False,
     exclusive: bool = True,
-    data_format: str = "NCHW",
+    data_format: str = None,
     name=None,
     use_cudnn: bool = True,
 ):
     """pool2d (pool_op.cc analog) via lax.reduce_window."""
+    data_format = current_layout(data_format)
     spatial = (2, 3) if data_format == "NCHW" else (1, 2)
     if global_pooling:
         ps = tuple(input.shape[a] for a in spatial)
@@ -368,6 +374,8 @@ def pool2d(
 
 def adaptive_pool2d(input, pool_size, pool_type="avg", name=None):
     """adaptive_pool2d analog (NCHW): output spatial dims = pool_size."""
+    enforce(current_layout() == "NCHW",
+            "adaptive_pool2d: NCHW only (pass images NCHW or exit layout_mode)")
     oh, ow = _pair(pool_size)
     n, c, h, w = input.shape
     enforce(h % oh == 0 and w % ow == 0,
@@ -391,7 +399,7 @@ def batch_norm(
     epsilon: float = 1e-5,
     param_attr=None,
     bias_attr=None,
-    data_layout: str = "NCHW",
+    data_layout: str = None,
     name: Optional[str] = None,
     moving_mean_name=None,
     moving_variance_name=None,
@@ -405,6 +413,7 @@ def batch_norm(
     context's training flag, mirroring the reference's is_test attr set
     by Program.clone(for_test=True).
     """
+    data_layout = current_layout(data_layout)
     helper = LayerHelper("batch_norm", name=name)
     c_axis = 1 if data_layout == "NCHW" else input.ndim - 1
     c = input.shape[c_axis]
@@ -475,8 +484,10 @@ def layer_norm(
 
 
 def group_norm(input, groups: int, epsilon: float = 1e-5, param_attr=None,
-               bias_attr=None, act=None, data_layout="NCHW", name=None):
-    """group_norm_op analog (NCHW)."""
+               bias_attr=None, act=None, data_layout=None, name=None):
+    """group_norm_op analog."""
+    data_layout = current_layout(data_layout)
+    enforce(data_layout == "NCHW", "group_norm: NCHW only")
     helper = LayerHelper("group_norm", name=name)
     n, c = input.shape[0], input.shape[1]
     enforce(c % groups == 0, "channels %d not divisible by groups %d", c, groups)
@@ -497,6 +508,8 @@ def group_norm(input, groups: int, epsilon: float = 1e-5, param_attr=None,
 
 def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
     """Local response normalization (lrn_op.cc analog, NCHW)."""
+    enforce(current_layout() == "NCHW",
+            "lrn: NCHW only (channel-axis window; exit layout_mode first)")
     sq = jnp.square(input)
     half = n // 2
     pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
@@ -826,8 +839,21 @@ def pad(x, paddings: Sequence[int], pad_value: float = 0.0, name=None):
     return jnp.pad(x, cfg, constant_values=pad_value)
 
 
+def to_chw_order(x):
+    """Layout-canonical feature order for the conv->fc boundary: under
+    the ambient NHWC layout, transpose an image tensor back to NCHW so
+    a downstream flatten/fc sees the C,H,W order that NCHW-trained
+    weights (and the reference's checkpoints) expect — keeping ONE
+    weight layout across both activation layouts. Identity under NCHW
+    (XLA folds the transpose into the adjacent reshape)."""
+    if current_layout() == "NHWC" and x.ndim == 4:
+        return jnp.transpose(x, (0, 3, 1, 2))
+    return x
+
+
 def pad2d(x, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
-          data_format="NCHW", name=None):
+          data_format=None, name=None):
+    data_format = current_layout(data_format)
     t, b, l, r = paddings
     if data_format == "NCHW":
         cfg = [(0, 0), (0, 0), (t, b), (l, r)]
@@ -845,8 +871,9 @@ def pad_constant_like(x, y, pad_value: float = 0.0, name=None):
 
 
 def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
-                 align_corners=True, data_format="NCHW", name=None):
+                 align_corners=True, data_format=None, name=None):
     """interpolate (bilinear/nearest) — bilinear_interp_op analog."""
+    data_format = current_layout(data_format)
     n, c, h, w = input.shape if data_format == "NCHW" else (
         input.shape[0], input.shape[3], input.shape[1], input.shape[2])
     if out_shape is None:
@@ -1110,9 +1137,10 @@ def hsigmoid(
 # ---------------------------------------------------------------------------
 
 
-def affine_channel(x, scale=None, bias=None, data_layout: str = "NCHW", name=None):
+def affine_channel(x, scale=None, bias=None, data_layout: str = None, name=None):
     """Per-channel affine: out = scale*x + bias (affine_channel_op.cc).
     Used to freeze BN for detection fine-tuning."""
+    data_layout = current_layout(data_layout)
     c_axis = 1 if data_layout == "NCHW" else x.ndim - 1
     shape = [1] * x.ndim
     shape[c_axis] = x.shape[c_axis]

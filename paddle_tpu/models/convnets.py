@@ -7,7 +7,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from .. import layers as L
-from ..framework import name_scope
+from ..framework import current_layout, name_scope
 from ..metrics import accuracy
 from .resnet import conv_bn_layer
 
@@ -24,7 +24,7 @@ def make_alexnet(class_num=1000):
         x = L.conv2d(x, 256, 3, padding=1, act="relu")
         x = L.conv2d(x, 256, 3, padding=1, act="relu")
         x = L.pool2d(x, 3, "max", 2)
-        x = L.flatten(x, axis=1)
+        x = L.flatten(L.to_chw_order(x), axis=1)
         x = L.dropout(x, 0.5)
         x = L.fc(x, 4096, act="relu")
         x = L.dropout(x, 0.5)
@@ -41,7 +41,8 @@ def _inception(x, c1, c3r, c3, c5r, c5, proj):
     b2 = L.conv2d(L.conv2d(x, c3r, 1, act="relu"), c3, 3, padding=1, act="relu")
     b3 = L.conv2d(L.conv2d(x, c5r, 1, act="relu"), c5, 5, padding=2, act="relu")
     b4 = L.conv2d(L.pool2d(x, 3, "max", 1, 1), proj, 1, act="relu")
-    return L.concat([b1, b2, b3, b4], axis=1)
+    return L.concat([b1, b2, b3, b4],
+                    axis=1 if current_layout() == "NCHW" else 3)
 
 
 def make_googlenet(class_num=1000):
@@ -74,11 +75,12 @@ def make_googlenet(class_num=1000):
 
 
 def _squeeze_excite(x, reduction=16):
-    c = x.shape[1]
+    c_axis = 1 if current_layout() == "NCHW" else 3
+    c = x.shape[c_axis]
     s = L.pool2d(x, pool_type="avg", global_pooling=True)
     s = L.fc(L.flatten(s, axis=1), max(c // reduction, 4), act="relu")
     s = L.fc(s, c, act="sigmoid")
-    return x * s[:, :, None, None]
+    return x * (s[:, :, None, None] if c_axis == 1 else s[:, None, None, :])
 
 
 def make_se_resnext(depth=50, class_num=1000, cardinality=32, reduction=16):
@@ -91,7 +93,8 @@ def make_se_resnext(depth=50, class_num=1000, cardinality=32, reduction=16):
                           groups=cardinality)
         h = conv_bn_layer(h, filters * 2, 1)
         h = _squeeze_excite(h, reduction)
-        if x.shape[1] != filters * 2 or stride != 1:
+        if x.shape[1 if current_layout() == "NCHW" else 3] != filters * 2 \
+                or stride != 1:
             x = conv_bn_layer(x, filters * 2, 1, stride=stride)
         return L.relu(h + x)
 

@@ -11,22 +11,22 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from .. import layers as L
-from ..framework import name_scope
+from ..framework import current_layout, name_scope
 from ..metrics import accuracy
 
 DEPTH_CFG = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
 
 
 def conv_bn_layer(x, num_filters, filter_size, stride=1, act=None, groups=1,
-                  data_format="NCHW"):
+                  data_format=None):
     x = L.conv2d(x, num_filters, filter_size, stride=stride,
                  padding=(filter_size - 1) // 2, groups=groups, bias_attr=False,
                  data_format=data_format)
     return L.batch_norm(x, act=act, data_layout=data_format)
 
 
-def bottleneck_block(x, num_filters, stride, data_format="NCHW"):
-    c_axis = 1 if data_format == "NCHW" else 3
+def bottleneck_block(x, num_filters, stride, data_format=None):
+    c_axis = 1 if current_layout(data_format) == "NCHW" else 3
     h = conv_bn_layer(x, num_filters, 1, act="relu", data_format=data_format)
     h = conv_bn_layer(h, num_filters, 3, stride=stride, act="relu",
                       data_format=data_format)
@@ -37,7 +37,7 @@ def bottleneck_block(x, num_filters, stride, data_format="NCHW"):
     return L.relu(h + x)
 
 
-def backbone(image, depth=50, data_format="NCHW"):
+def backbone(image, depth=50, data_format=None):
     """image: [b, 3, H, W] (NCHW) or [b, H, W, 3] (NHWC) -> pooled
     features [b, 2048]."""
     stages = DEPTH_CFG[depth]
@@ -57,7 +57,7 @@ def backbone(image, depth=50, data_format="NCHW"):
     return L.flatten(x, axis=1)
 
 
-def make_model(depth=50, class_num=1000, image_size=224, data_format="NCHW"):
+def make_model(depth=50, class_num=1000, image_size=224, data_format=None):
     def resnet(image, label):
         feats = backbone(image, depth, data_format=data_format)
         logits = L.fc(feats, class_num)

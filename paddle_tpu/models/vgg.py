@@ -25,7 +25,7 @@ def make_model(depth=16, class_num=10, fc_dim=512):
         for i, (nf, g) in enumerate(zip((64, 128, 256, 512, 512), groups)):
             with name_scope(f"block{i}"):
                 x = conv_block(x, nf, g)
-        x = L.flatten(x, axis=1)
+        x = L.flatten(L.to_chw_order(x), axis=1)
         x = L.dropout(x, 0.5)
         x = L.fc(x, fc_dim, act=None)
         x = L.batch_norm(x, act="relu")
