@@ -222,14 +222,21 @@ def bench_se_resnext(peak, batch_size=32, image_size=224, iters=15):
 
 def _bench_transformer_config(peak, batch_size, seq, dtype, dropout,
                               max_len=256, iters=20):
+    import os
+
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
     from paddle_tpu.core import flops
     from paddle_tpu.models import transformer
 
+    # BENCH_USE_FLASH=0: A/B the pallas flash kernel against XLA's fused
+    # dense attention (at short seq the dense path can win — the profile
+    # decides, not the assumption)
+    use_flash = os.environ.get("BENCH_USE_FLASH", "1") != "0"
     cfg = transformer.base_config(src_vocab=32000, trg_vocab=32000,
                                   dropout=dropout, max_len=max_len,
-                                  dtype=dtype, use_flash=True, fused_ce=True)
+                                  dtype=dtype, use_flash=use_flash,
+                                  fused_ce=True)
     model = pt.build(transformer.make_model(cfg))
     rng = np.random.RandomState(0)
     feeds = [{
