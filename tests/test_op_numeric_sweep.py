@@ -1202,6 +1202,61 @@ GRAD_OPS = {
     "dice_loss": lambda x: L.dice_loss(
         jax.nn.softmax(x, axis=-1), jnp.asarray(np.array([[0], [2]],
                                                          np.int64))),
+    # round-4 widening (VERDICT r3 weak #5): every differentiable
+    # compound op gets an FD check — wrong-formula bugs in a loss or a
+    # windowed op survive check_output's single point far more easily
+    # than they survive its gradient field
+    "kldiv_loss": lambda x: L.kldiv_loss(
+        jax.nn.log_softmax(x, axis=-1),
+        jax.nn.softmax(jnp.asarray(Y1[:2, :3]), axis=-1), reduction="mean"),
+    "margin_rank_loss": lambda left: L.margin_rank_loss(
+        jnp.asarray([[1.0], [-1.0]]), left, jnp.asarray([[0.2], [0.7]]),
+        margin=0.3),
+    "huber_loss": lambda x: L.huber_loss(x, jnp.zeros_like(x), delta=0.7),
+    "square_error_cost": lambda x: L.square_error_cost(
+        x, jnp.asarray(Y1[:2, :3])),
+    "mse_loss": lambda x: L.mse_loss(x, jnp.asarray(Y1[:2, :3])),
+    "cross_entropy_soft": lambda x: L.cross_entropy(
+        jax.nn.softmax(x, axis=-1),
+        jax.nn.softmax(jnp.asarray(Y1[:2, :3]), axis=-1), soft_label=True),
+    "selu": lambda x: L.selu(x),
+    "gelu": lambda x: L.gelu(x),
+    "erf": lambda x: L.erf(x),
+    "hard_sigmoid": lambda x: L.hard_sigmoid(x * 0.1),  # inside the ramp
+    "hard_swish": lambda x: L.hard_swish(x * 0.1),
+    "leaky_relu": lambda x: L.leaky_relu(x + 0.05, alpha=0.2),
+    "softshrink": lambda x: L.softshrink(x * 3.0, alpha=0.5),
+    "logsigmoid": lambda x: L.logsigmoid(x),
+    "softplus": lambda x: L.softplus(x),
+    "softsign": lambda x: L.softsign(x),
+    "relu6": lambda x: L.relu6(x + 0.2),
+    "brelu": lambda x: L.brelu(x, t_min=-0.8, t_max=0.8),
+    "tanh_shrink": lambda x: L.tanh_shrink(x),
+    "thresholded_relu": lambda x: L.thresholded_relu(x, threshold=0.1),
+    "elementwise_div": lambda x: L.elementwise_div(
+        x, jnp.abs(jnp.asarray(Y1[:2, :3])) + 1.0),
+    "elementwise_max": lambda x: L.elementwise_max(
+        x, jnp.asarray(Y1[:2, :3]) + 0.3),  # ties measure-zero at offset
+    "pow_op": lambda x: L.pow(jnp.abs(x) + 0.5, factor=1.7),
+    "scale_op": lambda x: L.scale(x, scale=2.5, bias=0.3),
+    "pool2d_avg": lambda x: L.pool2d(x, 2, "avg", 2),
+    "pool2d_max": lambda x: L.pool2d(x, 2, "max", 2),
+    "image_resize_bilinear": lambda x: L.image_resize(
+        x, out_shape=(5, 7), align_corners=True),
+    "pad_op": lambda x: L.pad(x, [0, 0, 1, 2, 2, 1]),
+    "pad_constant_like": lambda x: L.pad_constant_like(
+        jnp.zeros((1, 6, 8, 8), jnp.float32), x, pad_value=0.0),
+    "gather_op": lambda x: L.gather(x, jnp.asarray([1, 0, 1], jnp.int32)),
+    "expand_op": lambda x: L.expand(x, [2, 1]),
+    "squeeze_grad": lambda x: L.squeeze(x[:, None], axes=[1]),
+    "pixel_shuffle": lambda x: L.pixel_shuffle(x, upscale_factor=2),
+    "temporal_shift": lambda x: L.temporal_shift(x, seg_num=2,
+                                                 shift_ratio=0.25),
+    "shuffle_channel": lambda x: L.shuffle_channel(x, group=2),
+    "unfold": lambda x: L.unfold(x, kernel_sizes=[2, 2], strides=[1, 1]),
+    "grid_sampler": lambda x: L.grid_sampler(
+        x, jnp.asarray(rs(41).uniform(-0.7, 0.7, (1, 4, 4, 2))
+                       .astype(np.float32))),
 }
 
 GRAD_INPUTS = {
@@ -1212,6 +1267,19 @@ GRAD_INPUTS = {
     "maxout": lambda: rs(36).randn(1, 4, 2, 2).astype(np.float32),
     "lrn": lambda: rs(37).randn(1, 4, 2, 2).astype(np.float32),
     "softmax_ce": lambda: rs(38).randn(2, 4).astype(np.float32),
+    "margin_rank_loss": lambda: rs(39).randn(2, 1).astype(np.float32),
+    "pool2d_avg": lambda: rs(40).randn(1, 3, 6, 6).astype(np.float32),
+    "pool2d_max": lambda: rs(40).randn(1, 3, 6, 6).astype(np.float32),
+    "image_resize_bilinear": lambda: rs(42).randn(1, 2, 4, 6)
+        .astype(np.float32),
+    "pad_op": lambda: rs(43).randn(2, 3, 4).astype(np.float32),
+    "pad_constant_like": lambda: rs(44).randn(1, 6, 5, 4).astype(np.float32),
+    "gather_op": lambda: rs(45).randn(4, 3).astype(np.float32),
+    "pixel_shuffle": lambda: rs(46).randn(1, 8, 3, 3).astype(np.float32),
+    "temporal_shift": lambda: rs(47).randn(4, 6, 3, 3).astype(np.float32),
+    "shuffle_channel": lambda: rs(48).randn(1, 6, 3, 3).astype(np.float32),
+    "unfold": lambda: rs(49).randn(1, 2, 4, 4).astype(np.float32),
+    "grid_sampler": lambda: rs(50).randn(1, 2, 5, 5).astype(np.float32),
 }
 
 
