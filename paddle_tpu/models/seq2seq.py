@@ -1,7 +1,15 @@
 """RNN encoder-decoder with attention — the book
 rnn_encoder_decoder / machine_translation configs (test_machine_
 translation.py; GRU encoder + attention decoder, the reference's only
-in-tree attention, built from primitive ops)."""
+in-tree attention, built from primitive ops).
+
+``make_model`` is the teacher-forced training program; ``make_decoder``
+is the generation program (beam/greedy over the same attention cell),
+sharing parameter names with training — the reference's
+machine-translation round trip trains, then decodes with
+beam_search/beam_search_decode into the 2-level LoD output (pair with
+``layers.beam_search_decode_lod``).
+"""
 
 from __future__ import annotations
 
@@ -14,61 +22,83 @@ from ..layers.rnn import dynamic_gru, gru_cell_step
 from .. import initializer as init
 
 
+def _forward(src_ids, trg_ids, src_lengths, src_vocab, trg_vocab, emb_dim,
+             hidden):
+    """Shared builder: encoder + all decoder parameters + teacher-forced
+    decode of ``trg_ids``. Returns (logits, aux) where aux carries the
+    attention cell and the raw tensors generation needs. Parameter
+    CREATION ORDER is identical for train and decode programs, so their
+    names agree and a trained scope loads directly into the decoder."""
+    helper = LayerHelper("seq2seq")
+    # --- encoder: bi-GRU ---
+    src_emb = L.embedding(src_ids, size=[src_vocab, emb_dim])
+    fwd = dynamic_gru(src_emb, hidden, sequence_length=src_lengths)
+    bwd = dynamic_gru(src_emb, hidden, sequence_length=src_lengths,
+                      is_reverse=True)
+    enc = jnp.concatenate([fwd, bwd], axis=-1)  # [b, s, 2h]
+    src_mask = (jnp.arange(src_ids.shape[1])[None, :]
+                < src_lengths[:, None])  # [b, s]
+
+    # --- decoder parameters (explicit trg table so generation can step
+    # token-by-token over it) ---
+    trg_table = helper.create_parameter("trg_emb/w", (trg_vocab, emb_dim),
+                                        jnp.float32,
+                                        initializer=init.Xavier())
+    w_att_enc = helper.create_parameter("att_enc/w", (2 * hidden, hidden),
+                                        jnp.float32, initializer=init.Xavier())
+    w_att_dec = helper.create_parameter("att_dec/w", (hidden, hidden),
+                                        jnp.float32, initializer=init.Xavier())
+    v_att = helper.create_parameter("att_v/w", (hidden, 1), jnp.float32,
+                                    initializer=init.Xavier())
+    w_x = helper.create_parameter("dec_gru_x/w", (emb_dim + 2 * hidden, 3 * hidden),
+                                  jnp.float32, initializer=init.Xavier())
+    w_h = helper.create_parameter("dec_gru_h/w", (hidden, 3 * hidden),
+                                  jnp.float32, initializer=init.Xavier())
+    b_g = helper.create_parameter("dec_gru/b", (3 * hidden,), jnp.float32,
+                                  initializer=init.Constant(0.0))
+    w_out = helper.create_parameter("dec_out/w", (hidden, trg_vocab), jnp.float32,
+                                    initializer=init.Xavier())
+
+    h0 = jnp.tanh(L.fc(jnp.concatenate([fwd[:, -1], bwd[:, 0]], axis=-1),
+                       hidden, name="init_state"))
+
+    def cell(h, x_t, enc_t, enc_att_t, mask_t):
+        """One decoder step: additive attention over ``enc_t`` + GRU.
+        Takes the encoder tensors explicitly so generation can tile
+        them per beam."""
+        q = jnp.matmul(h, w_att_dec)[:, None, :]                 # [r,1,h]
+        e = jnp.matmul(jnp.tanh(enc_att_t + q), v_att)[..., 0]   # [r,s]
+        e = jnp.where(mask_t, e, -1e9)
+        a = jax.nn.softmax(e, axis=-1)
+        ctx = jnp.einsum("bs,bsd->bd", a, enc_t)                 # [r,2h]
+        inp = jnp.concatenate([x_t, ctx], axis=-1)
+        x_proj = jnp.matmul(inp, w_x) + b_g
+        return gru_cell_step(x_proj, h, w_h)
+
+    enc_att = jnp.matmul(enc, w_att_enc)  # precompute [b, s, h]
+
+    def step(h, x_t):
+        h_new = cell(h, x_t, enc, enc_att, src_mask)
+        return h_new, h_new
+
+    trg_emb = jnp.take(trg_table, trg_ids.astype(jnp.int32), axis=0)
+    xs = jnp.swapaxes(trg_emb, 0, 1)
+    _, hs = jax.lax.scan(step, h0, xs)
+    hs = jnp.swapaxes(hs, 0, 1)  # [b, t, h]
+    logits = jnp.matmul(hs, w_out)
+    aux = {"cell": cell, "enc": enc, "enc_att": enc_att,
+           "src_mask": src_mask, "h0": h0, "trg_table": trg_table,
+           "w_out": w_out}
+    return logits, aux
+
+
 def make_model(src_vocab=2000, trg_vocab=2000, emb_dim=128, hidden=256):
     """Program fn: (src_ids [b,s], trg_ids [b,t], labels [b,t],
     src_lengths [b]) -> dict with token-mean CE loss."""
 
     def seq2seq(src_ids, trg_ids, labels, src_lengths):
-        helper = LayerHelper("seq2seq")
-        # --- encoder: bi-GRU ---
-        src_emb = L.embedding(src_ids, size=[src_vocab, emb_dim])
-        fwd = dynamic_gru(src_emb, hidden, sequence_length=src_lengths)
-        bwd = dynamic_gru(src_emb, hidden, sequence_length=src_lengths,
-                          is_reverse=True)
-        enc = jnp.concatenate([fwd, bwd], axis=-1)  # [b, s, 2h]
-        src_mask = (jnp.arange(src_ids.shape[1])[None, :]
-                    < src_lengths[:, None])  # [b, s]
-
-        # --- decoder: GRU with additive attention over enc ---
-        b, t = trg_ids.shape
-        trg_emb = L.embedding(trg_ids, size=[trg_vocab, emb_dim])
-
-        w_att_enc = helper.create_parameter("att_enc/w", (2 * hidden, hidden),
-                                            jnp.float32, initializer=init.Xavier())
-        w_att_dec = helper.create_parameter("att_dec/w", (hidden, hidden),
-                                            jnp.float32, initializer=init.Xavier())
-        v_att = helper.create_parameter("att_v/w", (hidden, 1), jnp.float32,
-                                        initializer=init.Xavier())
-        w_x = helper.create_parameter("dec_gru_x/w", (emb_dim + 2 * hidden, 3 * hidden),
-                                      jnp.float32, initializer=init.Xavier())
-        w_h = helper.create_parameter("dec_gru_h/w", (hidden, 3 * hidden),
-                                      jnp.float32, initializer=init.Xavier())
-        b_g = helper.create_parameter("dec_gru/b", (3 * hidden,), jnp.float32,
-                                      initializer=init.Constant(0.0))
-        w_out = helper.create_parameter("dec_out/w", (hidden, trg_vocab), jnp.float32,
-                                        initializer=init.Xavier())
-
-        enc_att = jnp.matmul(enc, w_att_enc)  # precompute [b, s, h]
-
-        def step(h, x_t):
-            # additive attention
-            q = jnp.matmul(h, w_att_dec)[:, None, :]           # [b,1,h]
-            e = jnp.matmul(jnp.tanh(enc_att + q), v_att)[..., 0]  # [b,s]
-            e = jnp.where(src_mask, e, -1e9)
-            a = jax.nn.softmax(e, axis=-1)
-            ctx = jnp.einsum("bs,bsd->bd", a, enc)             # [b,2h]
-            inp = jnp.concatenate([x_t, ctx], axis=-1)
-            x_proj = jnp.matmul(inp, w_x) + b_g
-            h_new = gru_cell_step(x_proj, h, w_h)
-            return h_new, h_new
-
-        h0 = jnp.tanh(L.fc(jnp.concatenate([fwd[:, -1], bwd[:, 0]], axis=-1),
-                           hidden, name="init_state"))
-        xs = jnp.swapaxes(trg_emb, 0, 1)
-        _, hs = jax.lax.scan(step, h0, xs)
-        hs = jnp.swapaxes(hs, 0, 1)  # [b, t, h]
-        logits = jnp.matmul(hs, w_out)
-
+        logits, _ = _forward(src_ids, trg_ids, src_lengths, src_vocab,
+                             trg_vocab, emb_dim, hidden)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, labels.astype(jnp.int32)[..., None],
                                    axis=-1)[..., 0]
@@ -77,3 +107,41 @@ def make_model(src_vocab=2000, trg_vocab=2000, emb_dim=128, hidden=256):
         return {"loss": loss, "logits": logits}
 
     return seq2seq
+
+
+def make_decoder(src_vocab=2000, trg_vocab=2000, emb_dim=128, hidden=256,
+                 max_len=20, beam_size=1, bos_id=1, eos_id=2):
+    """Generation program (the book machine-translation decode half):
+    (src_ids [b,s], src_lengths [b]) -> {"ids" [b,K,max_len],
+    "scores" [b,K]} best-first. Shares parameter names with
+    ``make_model`` — apply it with a trained Trainer's params. Package
+    the result as the reference's 2-level LoD with
+    ``layers.beam_search_decode_lod(ids, valid, scores)``."""
+    from ..layers.beam_search import beam_search
+
+    def decode_program(src_ids, src_lengths):
+        b = src_ids.shape[0]
+        K = beam_size
+        # identical layer-call sequence as training (dummy 1-token trg)
+        # materializes every parameter under its training name
+        dummy = jnp.full((b, 1), bos_id, jnp.int32)
+        _, aux = _forward(src_ids, dummy, src_lengths, src_vocab, trg_vocab,
+                          emb_dim, hidden)
+        enc = jnp.repeat(aux["enc"], K, axis=0)
+        enc_att = jnp.repeat(aux["enc_att"], K, axis=0)
+        mask = jnp.repeat(aux["src_mask"], K, axis=0)
+        h0 = jnp.repeat(aux["h0"], K, axis=0)
+        cell, table, w_out = aux["cell"], aux["trg_table"], aux["w_out"]
+
+        def step_fn(tokens, h):
+            x_t = jnp.take(table, tokens, axis=0)
+            h_new = cell(h, x_t, enc, enc_att, mask)
+            logits = jnp.matmul(h_new, w_out).astype(jnp.float32)
+            return jax.nn.log_softmax(logits, axis=-1), h_new
+
+        seqs, scores = beam_search(step_fn, h0, batch_size=b, beam_size=K,
+                                   max_len=max_len, bos_id=bos_id,
+                                   eos_id=eos_id)
+        return {"ids": seqs, "scores": scores}
+
+    return decode_program

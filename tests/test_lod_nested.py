@@ -175,3 +175,65 @@ def test_backtrack_decode_to_lod_round_trip():
     firsts = [grp[0].ravel()[0] for grp in ids.sequences(0)]
     np.testing.assert_array_equal(
         firsts, np.asarray(seqs)[:, 0, 0])
+
+
+# ---------------------------------------------------------------------------
+# the full book machine-translation round trip: train -> beam decode ->
+# 2-level LoD -> consume (test_machine_translation.py analog)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_seq2seq_train_decode_lod_round_trip():
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models import seq2seq
+
+    V, E, H, S = 15, 16, 32, 5
+    model = pt.build(seq2seq.make_model(src_vocab=V, trg_vocab=V, emb_dim=E,
+                                        hidden=H))
+    rng = np.random.RandomState(0)
+
+    def batch(bs=16):
+        src = rng.randint(3, V, (bs, S)).astype(np.int64)
+        trg = np.zeros_like(src)
+        trg[:, 0] = 1
+        trg[:, 1:] = src[:, :-1]
+        labels = np.concatenate([trg[:, 1:], np.full((bs, 1), 2)],
+                                axis=1).astype(np.int64)
+        return {"src_ids": src, "trg_ids": trg, "labels": labels,
+                "src_lengths": np.full((bs,), S, np.int64)}
+
+    trainer = pt.Trainer(model, opt.Adam(5e-3), loss_name="loss")
+    trainer.startup(sample_feed=batch())
+    for _ in range(120):
+        out = trainer.step(batch())
+    assert float(out["loss"]) < 1.0, float(out["loss"])
+
+    # decode with the TRAINED params through the shared-name program
+    K, T = 2, S + 2
+    dec = pt.build(seq2seq.make_decoder(src_vocab=V, trg_vocab=V, emb_dim=E,
+                                        hidden=H, max_len=T, beam_size=K))
+    feed = batch(bs=4)
+    out, _ = dec.apply(trainer.scope.params, trainer.scope.state,
+                       jnp.asarray(feed["src_ids"]),
+                       jnp.asarray(feed["src_lengths"]))
+    seqs, scores = np.asarray(out["ids"]), np.asarray(out["scores"])
+    assert seqs.shape == (4, K, T)
+
+    # package as the reference's 2-level LoD decode output
+    valid = (np.cumsum(seqs == 2, axis=-1) - (seqs == 2)) == 0
+    ids, sc = beam_search_decode_lod(seqs, valid, scores=scores)
+    assert ids.recursive_sequence_lengths()[0] == [K] * 4
+    assert sc.recursive_sequence_lengths() == [[K] * 4, [1] * (4 * K)]
+
+    # consume the nested output like the book demo: best hypothesis per
+    # source sentence should mostly reproduce the copy task
+    hits = total = 0
+    for b, grp in enumerate(ids.sequences(0)):
+        best = grp[0].ravel()
+        want = feed["src_ids"][b][: len(best)]
+        n = min(len(best), S)
+        hits += (best[:n] == want[:n]).sum()
+        total += n
+    assert total > 0 and hits / total > 0.5, f"decode acc {hits}/{total}"
