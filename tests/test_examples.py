@@ -38,3 +38,14 @@ def test_serve_classifier_example_runs_int8():
                "--threads", "2", "--int8")
     assert "int8 datapath" in out
     assert "served accuracy" in out
+
+
+@pytest.mark.slow
+def test_translate_example_decodes_reversal():
+    out = _run("translate.py", "--steps", "120", "--seq", "5", "--beam", "2")
+    assert "decode LoD:" in out
+    assert "best-hypothesis token accuracy:" in out
+    # trained attention model should reverse most tokens
+    frac = out.rsplit("accuracy:", 1)[1].strip()
+    hits, total = (int(v) for v in frac.split("/"))
+    assert total > 0 and hits / total > 0.6, frac
