@@ -25,6 +25,31 @@ jax.config.update("jax_compilation_cache_dir",
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
+# jaxlib 0.9's CPU runtime cannot reliably RELOAD serialized
+# multi-device executables: cpu_aot_loader rejects the cached machine
+# features ("+prefer-no-scatter ... not supported on the host"), one
+# partition thread dies, and the surviving threads deadlock at the
+# collective rendezvous until its 40s termination timeout aborts the
+# whole process ("Fatal Python error: Aborted" at an array fetch).
+# Fresh compiles are fine — only the disk->executable round trip is
+# broken — so gate persistent-cache READS to single-device programs:
+# sharded tests recompile once per process (they are small models),
+# every other program keeps the cache.
+from jax._src import compiler as _jax_compiler
+
+_orig_cache_read = _jax_compiler._cache_read
+
+
+def _single_device_cache_read(module_name, cache_key, compile_options,
+                              backend, executable_devices):
+    if len(executable_devices) > 1:
+        return None, None
+    return _orig_cache_read(module_name, cache_key, compile_options,
+                            backend, executable_devices)
+
+
+_jax_compiler._cache_read = _single_device_cache_read
+
 import numpy as np
 import pytest
 
