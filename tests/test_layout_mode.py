@@ -100,3 +100,35 @@ def test_alexnet_and_vgg_nhwc_match_nchw():
     got_c, got_h = _logits_pair(lambda: vgg.make_model(depth=16, class_num=5),
                                 (32, 32))
     np.testing.assert_allclose(got_h, got_c, rtol=2e-4, atol=2e-4)
+
+
+def test_nhwc_model_exports_and_serves(tmp_path):
+    """save_inference_model of an NHWC-built program: the build-time
+    layout must govern the export trace (which runs OUTSIDE the
+    layout_mode block), and the AOT Predictor must reproduce the NCHW
+    export's outputs on the transposed input."""
+    from paddle_tpu import io as pio
+
+    def net(image):
+        h = L.conv2d(image, 4, 3, padding=1, bias_attr=False, name="c")
+        h = L.batch_norm(h, act="relu", name="bn")
+        return {"y": L.fc(L.to_chw_order(h), 3, name="out")}
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+
+    m_c = pt.build(net)
+    with layout_mode("NHWC"):
+        m_h = pt.build(net)
+    p, s = m_c.init(jax.random.PRNGKey(0), image=x)
+    _, s_h = m_h.init(jax.random.PRNGKey(0), image=x.transpose(0, 2, 3, 1))
+
+    d_c, d_h = str(tmp_path / "nchw"), str(tmp_path / "nhwc")
+    pio.save_inference_model(d_c, m_c, p, s, {"image": x})
+    pio.save_inference_model(d_h, m_h, p, s_h,
+                             {"image": x.transpose(0, 2, 3, 1)})
+    out_c = pio.load_inference_model(d_c).run({"image": x})
+    out_h = pio.load_inference_model(d_h).run(
+        {"image": x.transpose(0, 2, 3, 1)})
+    np.testing.assert_allclose(np.asarray(out_h["y"]),
+                               np.asarray(out_c["y"]), rtol=2e-5, atol=2e-5)
