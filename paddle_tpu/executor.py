@@ -262,7 +262,8 @@ class Trainer:
             bool(getattr(self.strategy, "sequence_parallel", False)), "sp",
             lambda: sp_mode(self.mesh,
                             impl=getattr(self.strategy, "sp_impl", "ring")))
-        with remat_mode(bool(getattr(self.strategy, "remat", False))), \
+        with remat_mode(bool(getattr(self.strategy, "remat", False)),
+                        policy=getattr(self.strategy, "remat_policy", None)), \
                 pp_ctx as pp_cfg, sp_ctx as sp_cfg:
             out, new_state = self.program.apply(params, state, training=True,
                                                 rng=rng, **feed)

@@ -495,23 +495,53 @@ def current_layout(explicit=None) -> str:
 
 
 @contextlib.contextmanager
-def remat_mode(enabled: bool = True):
+def remat_mode(enabled: bool = True, policy=None):
     """Ambient rematerialization switch (memory_optimization_transpiler
     analog, consumed at trace time). Trainer enters this around
     ``program.apply`` when ``DistStrategy.remat`` is set; zoo models
     check it via :func:`maybe_remat` around their repeated blocks, so
     ``memory_optimize()`` turns on per-block ``jax.checkpoint`` without
-    the model config having to opt in."""
-    old = getattr(_remat_mode, "on", False)
+    the model config having to opt in.
+
+    ``policy`` (a jax.checkpoint_policies callable or one of the names
+    :func:`resolve_remat_policy` knows) tunes WHAT the checkpointed
+    blocks keep: e.g. ``"dots"`` saves matmul outputs — skipping their
+    MXU recompute in the backward pass while still dropping the cheap
+    elementwise intermediates — the standard long-context middle ground
+    between full remat and no remat."""
+    old = (getattr(_remat_mode, "on", False),
+           getattr(_remat_mode, "policy", None))
     _remat_mode.on = bool(enabled)
+    _remat_mode.policy = resolve_remat_policy(policy)
     try:
         yield
     finally:
-        _remat_mode.on = old
+        _remat_mode.on, _remat_mode.policy = old
 
 
 def remat_enabled() -> bool:
     return getattr(_remat_mode, "on", False)
+
+
+def remat_policy():
+    return getattr(_remat_mode, "policy", None)
+
+
+def resolve_remat_policy(policy):
+    """Map a friendly name to a jax.checkpoint_policies callable (pass
+    callables through, None means save-nothing — full recompute)."""
+    if policy is None or callable(policy):
+        return policy
+    table = {
+        "nothing": jax.checkpoint_policies.nothing_saveable,
+        "everything": jax.checkpoint_policies.everything_saveable,
+        "dots": jax.checkpoint_policies.dots_saveable,
+        "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    enforce(policy in table,
+            f"unknown remat policy {policy!r}; options: {sorted(table)}"
+            " or any jax.checkpoint_policies callable")
+    return table[policy]
 
 
 _pipeline_mode = threading.local()
@@ -600,7 +630,8 @@ def maybe_remat(fn: Callable, enabled: Optional[bool] = None,
     if ctx is not None and ctx.mode == "init":
         return fn
     if enabled or (enabled is None and remat_enabled()):
-        return jax.checkpoint(fn, policy=policy)
+        return jax.checkpoint(
+            fn, policy=resolve_remat_policy(policy) or remat_policy())
     return fn
 
 

@@ -8,7 +8,7 @@ details/build_strategy.h:34) and DistributeTranspilerConfig
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Any, Optional
 
 
 @dataclasses.dataclass
@@ -23,6 +23,11 @@ class DistStrategy:
     # models' maybe_remat blocks become per-block jax.checkpoint.
     donate_buffers: bool = True
     remat: bool = False
+    # what checkpointed blocks KEEP: None/'nothing' = full recompute,
+    # 'dots' = save matmul outputs (skip MXU recompute, drop elementwise
+    # intermediates), 'dots_no_batch', 'everything', or a
+    # jax.checkpoint_policies callable
+    remat_policy: Any = None
     # loss scaling for mixed precision: a float enables scaling at that
     # initial value; dynamic_loss_scale grows/shrinks it from overflow
     # history (non-finite grads always skip the step when enabled).

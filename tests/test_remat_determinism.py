@@ -143,3 +143,29 @@ def test_deterministic_flag_wires_jax_config():
     assert jax.config.jax_default_matmul_precision == old_prec
     assert jax.config.jax_threefry_partitionable == old_threefry
     assert cfg.get_flag("deterministic") is False
+
+
+@pytest.mark.parametrize("policy", ["dots", "dots_no_batch", "everything"])
+def test_remat_policy_numerics_unchanged(policy):
+    """Checkpoint policies change WHAT is saved (memory/recompute), not
+    the computed values: per-step losses must equal the no-remat run."""
+    from paddle_tpu.parallel import DistStrategy
+
+    feeds = [_feed() for _ in range(2)]
+    ref = _trainer()
+    ref.startup(sample_feed=feeds[0])
+    ref_losses = [float(ref.step(f)["loss"]) for f in feeds]
+    tr = _trainer(DistStrategy(remat=True, remat_policy=policy))
+    tr.startup(sample_feed=feeds[0])
+    losses = [float(tr.step(f)["loss"]) for f in feeds]
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5, atol=1e-6)
+
+
+def test_remat_policy_unknown_name_rejected():
+    from paddle_tpu.framework import resolve_remat_policy
+    with pytest.raises(Exception, match="unknown remat policy"):
+        resolve_remat_policy("keep_the_good_bits")
+    assert resolve_remat_policy(None) is None
+    assert resolve_remat_policy("dots") is jax.checkpoint_policies.dots_saveable
+    fn = lambda *a, **k: False  # noqa: E731
+    assert resolve_remat_policy(fn) is fn
