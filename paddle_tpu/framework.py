@@ -509,10 +509,11 @@ def remat_mode(enabled: bool = True, policy=None):
     MXU recompute in the backward pass while still dropping the cheap
     elementwise intermediates — the standard long-context middle ground
     between full remat and no remat."""
-    old = (getattr(_remat_mode, "on", False),
+    resolved = resolve_remat_policy(policy)  # may raise: BEFORE any
+    old = (getattr(_remat_mode, "on", False),     # thread-local writes
            getattr(_remat_mode, "policy", None))
     _remat_mode.on = bool(enabled)
-    _remat_mode.policy = resolve_remat_policy(policy)
+    _remat_mode.policy = resolved
     try:
         yield
     finally:

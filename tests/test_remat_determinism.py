@@ -145,16 +145,21 @@ def test_deterministic_flag_wires_jax_config():
     assert cfg.get_flag("deterministic") is False
 
 
+@pytest.fixture(scope="module")
+def _no_remat_losses():
+    feeds = [_feed() for _ in range(2)]
+    ref = _trainer()
+    ref.startup(sample_feed=feeds[0])
+    return feeds, [float(ref.step(f)["loss"]) for f in feeds]
+
+
 @pytest.mark.parametrize("policy", ["dots", "dots_no_batch", "everything"])
-def test_remat_policy_numerics_unchanged(policy):
+def test_remat_policy_numerics_unchanged(policy, _no_remat_losses):
     """Checkpoint policies change WHAT is saved (memory/recompute), not
     the computed values: per-step losses must equal the no-remat run."""
     from paddle_tpu.parallel import DistStrategy
 
-    feeds = [_feed() for _ in range(2)]
-    ref = _trainer()
-    ref.startup(sample_feed=feeds[0])
-    ref_losses = [float(ref.step(f)["loss"]) for f in feeds]
+    feeds, ref_losses = _no_remat_losses
     tr = _trainer(DistStrategy(remat=True, remat_policy=policy))
     tr.startup(sample_feed=feeds[0])
     losses = [float(tr.step(f)["loss"]) for f in feeds]
