@@ -717,6 +717,15 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
                 "error": "device probe failed: backend unreachable or wedged "
                          "(tiny-matmul subprocess timed out)",
                 "compute_dtype": compute_dtype, "configs": {}}
+    if h2d_mbps is not None and h2d_mbps < 50:
+        # degraded link (healthy tunnels measure hundreds of MB/s): configs
+        # that wedge would eat the caller's whole window at the full
+        # timeout — shrink it so more configs get a chance to record, and
+        # the per-config records say why the numbers look link-bound
+        config_timeout = min(config_timeout, 600)
+        print(f"[bench] degraded h2d link ({h2d_mbps} MB/s): "
+              f"per-config timeout capped at {config_timeout}s",
+              file=sys.stderr, flush=True)
 
     configs = {}
     device = peak = peak_source = None
