@@ -74,11 +74,41 @@ def put_batch(mesh: Mesh, rules: Optional[ShardingRules], feed: Dict[str, Any]):
         spec = rules.batch_spec(mesh, arr.ndim, shape=arr.shape)
         ns = NamedSharding(mesh, spec)
         if multiproc:
-            global_shape = (arr.shape[0] * jax.process_count(),) + arr.shape[1:]
+            # contract: each process feeds its LOCAL slice of the batch
+            # dim and the FULL extent of every other dim. The batch dim's
+            # global size is local × the number of process groups its
+            # mesh axes span — 1 when the batch axes live inside each
+            # process (e.g. an {"sp": n} mesh replicates the batch and
+            # shards seq: every process feeds the same full batch, and
+            # the runtime slices each host's addressable seq shards).
+            span = _procs_spanning(mesh, spec[0] if len(spec) else None)
+            global_shape = (arr.shape[0] * span,) + arr.shape[1:]
             out[k] = jax.make_array_from_process_local_data(ns, arr, global_shape)
         else:
             out[k] = jax.device_put(arr, ns)
     return out
+
+
+def _procs_spanning(mesh: Mesh, axes) -> int:
+    """How many process groups partition the mesh ``axes``: total axis
+    extent over the extent addressable by one process. 1 when ``axes``
+    is empty/None or lives entirely inside each process."""
+    if axes is None or axes == ():
+        return 1
+    axs = (axes,) if isinstance(axes, str) else tuple(a for a in axes if a)
+    if not axs:
+        return 1
+    total = 1
+    for a in axs:
+        total *= mesh.shape[a]
+    names = list(mesh.axis_names)
+    idxs = [names.index(a) for a in axs]
+    me = jax.process_index()
+    coords = set()
+    for idx, dev in np.ndenumerate(mesh.devices):
+        if dev.process_index == me:
+            coords.add(tuple(idx[i] for i in idxs))
+    return total // max(len(coords), 1)
 
 
 def jit_sharded_step(mesh: Mesh, rules: Optional[ShardingRules], fn, donate_argnums=(),

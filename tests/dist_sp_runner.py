@@ -1,0 +1,74 @@
+"""Runnable multi-process SEQUENCE-PARALLEL trainer: the ring-attention
+ring spanning a process boundary — the long-context multi-host shape
+(cross-host ring SP over DCN, reference's multi-node NCCL2 analog for
+the sequence dimension).
+
+    python dist_sp_runner.py <proc_id> <nprocs> <port> <steps>
+
+Each process owns 4 virtual devices; the mesh is one {"sp": nprocs*4}
+axis, so zigzag ring attention's permute hops cross the process
+boundary. Every process feeds the identical global batch (seq is the
+sharded dim; the runtime slices each process's addressable shards).
+With nprocs=1 and a single device the same script is the dense
+baseline. Prints `LOSS <step> <value>` per step.
+"""
+
+import os
+import sys
+
+pid, nprocs, port, steps = (int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
+                            int(sys.argv[4]))
+local_devices = 4 if nprocs > 1 else 1
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+_flags.append(f"--xla_force_host_platform_device_count={local_devices}")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+if nprocs > 1:
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nprocs, process_id=pid)
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import optimizer as opt
+from paddle_tpu.models import gpt
+from paddle_tpu.parallel import DistStrategy
+from paddle_tpu.parallel.sharding import ShardingRules
+
+VOCAB, SEQ = 64, 32
+
+
+def batch(step, bs=8):
+    rng = np.random.RandomState(500 + step)
+    ids = rng.randint(3, VOCAB, (bs, SEQ)).astype(np.int32)
+    labels = np.concatenate([ids[:, 1:], np.full((bs, 1), 2)],
+                            axis=1).astype(np.int32)
+    return {"ids": ids, "labels": labels}
+
+
+def main():
+    cfg = gpt.base_config(vocab_size=VOCAB, max_len=SEQ, d_model=32,
+                          d_inner=64, num_heads=4, num_layers=2,
+                          use_flash=False, fused_ce=False)
+    prog = pt.build(gpt.make_model(cfg))
+    if nprocs > 1:
+        mesh = pt.make_mesh({"sp": jax.device_count()})
+        trainer = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss",
+                             mesh=mesh,
+                             sharding_rules=ShardingRules(seq_axis="sp"),
+                             strategy=DistStrategy(sequence_parallel=True))
+    else:
+        trainer = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss")
+    trainer.startup(rng=jax.random.PRNGKey(7), sample_feed=batch(0))
+    for s in range(steps):
+        out = trainer.step(batch(s), rng=jax.random.PRNGKey(100 + s))
+        print(f"LOSS {s} {float(out['loss']):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

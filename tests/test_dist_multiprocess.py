@@ -86,3 +86,38 @@ def test_two_process_dp_fsdp_mesh_matches_single_process(single_proc_losses):
         assert abs(l0[s] - l1[s]) < 1e-5
         assert abs(l0[s] - single[s]) < 1e-3, (
             f"step {s}: dp×fsdp {l0[s]} vs local {single[s]}")
+
+
+@pytest.mark.slow
+def test_two_process_ring_sp_matches_single_process():
+    """Cross-PROCESS ring attention: 2 processes x 4 devices, one
+    {"sp": 8} axis, so the zigzag ring's permute hops cross the process
+    (DCN-analog) boundary — the long-context multi-host shape. Per-step
+    losses must match dense single-device training."""
+    sp_runner = os.path.join(HERE, "dist_sp_runner.py")
+
+    def run(nprocs, steps=3, timeout=420):
+        port = _free_port()
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["PYTHONPATH"] = (os.path.dirname(HERE) + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        procs = [subprocess.Popen(
+            [sys.executable, sp_runner, str(i), str(nprocs), str(port),
+             str(steps)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            text=True) for i in range(nprocs)]
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, f"sp trainer failed:\n{err[-3000:]}"
+            outs.append(out)
+        return outs
+
+    ref = _losses(run(1)[0])
+    outs = run(2)
+    for out in outs:
+        got = _losses(out)
+        assert got.keys() == ref.keys()
+        for s in ref:
+            np.testing.assert_allclose(got[s], ref[s], rtol=3e-4, atol=3e-4)
