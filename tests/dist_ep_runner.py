@@ -1,0 +1,75 @@
+"""Runnable multi-process EXPERT-PARALLEL trainer: the MoE all-to-all
+token dispatch crossing a process boundary — the multi-host MoE shape
+(experts sharded over hosts; cross-host all-to-all over the
+DCN-analog axis).
+
+    python dist_ep_runner.py <proc_id> <nprocs> <port> <steps>
+
+Each process owns 4 virtual devices; the mesh is one {"ep": nprocs*4}
+axis, so half the experts live on each process and every routed token
+may hop processes through the dispatch all-to-all. With
+nprocs=1 the same script (single device, no mesh) is the dense
+baseline. Aux loss off + ample capacity so routing is identical and
+losses match dense exactly. Prints `LOSS <step> <value>` per step.
+"""
+
+import os
+import sys
+
+pid, nprocs, port, steps = (int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
+                            int(sys.argv[4]))
+local_devices = 4 if nprocs > 1 else 1
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+_flags.append(f"--xla_force_host_platform_device_count={local_devices}")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+if nprocs > 1:
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nprocs, process_id=pid)
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import optimizer as opt
+from paddle_tpu.models import moe_transformer
+from paddle_tpu.parallel import moe_ep_rules
+from paddle_tpu.parallel.sharding import ShardingRules
+
+VOCAB, SEQ = 64, 16
+
+
+def batch(step, bs=8):
+    rng = np.random.RandomState(900 + step)
+    ids = rng.randint(3, VOCAB, (bs, SEQ)).astype(np.int32)
+    labels = np.concatenate([ids[:, 1:], np.full((bs, 1), 2)],
+                            axis=1).astype(np.int32)
+    return {"ids": ids, "labels": labels}
+
+
+def main():
+    cfg = moe_transformer.base_config(
+        vocab_size=VOCAB, max_len=SEQ, d_model=32, d_expert=64, num_heads=4,
+        num_layers=2, num_experts=8, top_k=2, moe_every=2, fused_ce=False,
+        aux_weight=0.0, capacity_factor=4.0)
+    if nprocs > 1:
+        mesh = pt.make_mesh({"ep": jax.device_count()})
+        prog = pt.build(moe_transformer.make_model(cfg, mesh=mesh))
+        trainer = pt.Trainer(
+            prog, opt.Adam(1e-3), loss_name="loss", mesh=mesh,
+            sharding_rules=ShardingRules(list(moe_ep_rules()), default=None))
+    else:
+        prog = pt.build(moe_transformer.make_model(cfg))
+        trainer = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss")
+    trainer.startup(rng=jax.random.PRNGKey(11), sample_feed=batch(0))
+    for s in range(steps):
+        out = trainer.step(batch(s), rng=jax.random.PRNGKey(200 + s))
+        print(f"LOSS {s} {float(out['loss']):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -121,3 +121,38 @@ def test_two_process_ring_sp_matches_single_process():
         assert got.keys() == ref.keys()
         for s in ref:
             np.testing.assert_allclose(got[s], ref[s], rtol=3e-4, atol=3e-4)
+
+
+@pytest.mark.slow
+def test_two_process_moe_ep_matches_single_process():
+    """Cross-PROCESS expert parallelism: 2 processes x 4 devices, one
+    {"ep": 8} axis — half the experts per process, the MoE dispatch
+    all-to-all hops the process (DCN-analog) boundary. Per-step losses
+    must match dense single-device training (aux off, ample capacity)."""
+    ep_runner = os.path.join(HERE, "dist_ep_runner.py")
+
+    def run(nprocs, steps=3, timeout=420):
+        port = _free_port()
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["PYTHONPATH"] = (os.path.dirname(HERE) + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        procs = [subprocess.Popen(
+            [sys.executable, ep_runner, str(i), str(nprocs), str(port),
+             str(steps)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            text=True) for i in range(nprocs)]
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, f"ep trainer failed:\n{err[-3000:]}"
+            outs.append(out)
+        return outs
+
+    ref = _losses(run(1)[0])
+    outs = run(2)
+    for out in outs:
+        got = _losses(out)
+        assert got.keys() == ref.keys()
+        for s in ref:
+            np.testing.assert_allclose(got[s], ref[s], rtol=3e-4, atol=3e-4)
