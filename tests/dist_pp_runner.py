@@ -1,0 +1,76 @@
+"""Runnable multi-process PIPELINE-PARALLEL trainer: transformer
+stages split ACROSS processes — the multi-host pipeline shape (stage
+boundary activations hop the DCN-analog link each microbatch).
+
+    python dist_pp_runner.py <proc_id> <nprocs> <port> <steps>
+
+Each process owns 2 virtual devices; the mesh is {"dp": 2,
+"pp": nprocs} with the pp axis laid across processes, so every
+stage-to-stage transfer crosses the process boundary while dp rides
+inside each process. With nprocs=1 the same script (single device, no
+mesh) is the reference. Prints `LOSS <step> <value>` per step.
+"""
+
+import os
+import sys
+
+pid, nprocs, port, steps = (int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
+                            int(sys.argv[4]))
+local_devices = 2 if nprocs > 1 else 1
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+_flags.append(f"--xla_force_host_platform_device_count={local_devices}")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+if nprocs > 1:
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nprocs, process_id=pid)
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import optimizer as opt
+from paddle_tpu.models import transformer
+from paddle_tpu.parallel import DistStrategy, transformer_tp_rules
+
+VOCAB, SEQ = 64, 12
+
+
+def batch(step, bs=8):
+    rng = np.random.RandomState(700 + step)
+    src = rng.randint(3, VOCAB, (bs, SEQ)).astype(np.int32)
+    trg = np.roll(src, 1, axis=1)
+    trg[:, 0] = 1
+    labels = np.concatenate([trg[:, 1:], np.full((bs, 1), 2)],
+                            axis=1).astype(np.int32)
+    return {"src_ids": src, "trg_ids": trg, "labels": labels}
+
+
+def main():
+    cfg = transformer.base_config(src_vocab=VOCAB, trg_vocab=VOCAB,
+                                  d_model=32, d_inner=64, num_heads=4,
+                                  num_encoder_layers=4, num_decoder_layers=4,
+                                  dropout=0.0, stacked=True)
+    prog = pt.build(transformer.make_model(cfg))
+    if nprocs > 1:
+        # pp OUTERMOST so its axis spans processes; dp lives inside each
+        # process (mesh axes are laid out major-to-minor over devices)
+        mesh = pt.make_mesh({"pp": nprocs, "dp": local_devices})
+        trainer = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss",
+                             mesh=mesh,
+                             sharding_rules=transformer_tp_rules(),
+                             strategy=DistStrategy(pp_microbatches=2))
+    else:
+        trainer = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss")
+    trainer.startup(rng=jax.random.PRNGKey(3), sample_feed=batch(0))
+    for s in range(steps):
+        out = trainer.step(batch(s), rng=jax.random.PRNGKey(300 + s))
+        print(f"LOSS {s} {float(out['loss']):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

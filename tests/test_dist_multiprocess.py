@@ -156,3 +156,38 @@ def test_two_process_moe_ep_matches_single_process():
         assert got.keys() == ref.keys()
         for s in ref:
             np.testing.assert_allclose(got[s], ref[s], rtol=3e-4, atol=3e-4)
+
+
+@pytest.mark.slow
+def test_two_process_pipeline_matches_single_process():
+    """Cross-PROCESS pipeline parallelism: {"pp": 2, "dp": 2} with the
+    pp axis laid across 2 processes, so stage-boundary activations hop
+    the process (DCN-analog) link every microbatch. Per-step losses
+    must match single-device training."""
+    pp_runner = os.path.join(HERE, "dist_pp_runner.py")
+
+    def run(nprocs, steps=3, timeout=420):
+        port = _free_port()
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["PYTHONPATH"] = (os.path.dirname(HERE) + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        procs = [subprocess.Popen(
+            [sys.executable, pp_runner, str(i), str(nprocs), str(port),
+             str(steps)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            text=True) for i in range(nprocs)]
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, f"pp trainer failed:\n{err[-3000:]}"
+            outs.append(out)
+        return outs
+
+    ref = _losses(run(1)[0])
+    outs = run(2)
+    for out in outs:
+        got = _losses(out)
+        assert got.keys() == ref.keys()
+        for s in ref:
+            np.testing.assert_allclose(got[s], ref[s], rtol=3e-4, atol=3e-4)
