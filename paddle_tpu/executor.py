@@ -197,6 +197,9 @@ class Trainer:
             rng = make_prng_key(get_flag("seed"))
         feed = {k: _abstractify(v) for k, v in (sample_feed or {}).items()}
         params, state = self.program.init(rng, **feed)
+        sd = getattr(self.strategy, "opt_state_dtype", None) if self.strategy else None
+        if sd is not None:
+            self.optimizer.set_state_dtype(sd)
         opt_state = self.optimizer.init(params)
         if self.mesh is not None:
             from .parallel import api as par_api

@@ -31,13 +31,43 @@ OptState = Dict[str, Any]
 
 
 class Optimizer:
-    """Base optimizer (optimizer.py:41 Optimizer)."""
+    """Base optimizer (optimizer.py:41 Optimizer).
+
+    ``state_dtype`` (set via :meth:`set_state_dtype` or
+    ``DistStrategy.opt_state_dtype``) stores float accumulators (Adam
+    moments etc.) in a reduced dtype — bfloat16 halves optimizer HBM,
+    the big slice of training memory once params/grads are sharded.
+    Update MATH always runs in float32: accumulators are upcast before
+    ``_apply_dense`` and cast back after, so only storage precision
+    changes.
+    """
+
+    state_dtype = None  # class default: keep accumulators in float32
 
     def __init__(self, learning_rate, regularization=None, grad_clip=None, name=None):
         self._lr = learning_rate
         self.regularization = regularization
         self.grad_clip = grad_clip
         self.name = name
+
+    def set_state_dtype(self, dtype) -> "Optimizer":
+        """Store float accumulators as ``dtype`` (None restores f32)."""
+        self.state_dtype = jnp.dtype(dtype) if dtype is not None else None
+        return self
+
+    def _store_acc(self, acc):
+        if self.state_dtype is None:
+            return acc
+        return {k: (v.astype(self.state_dtype)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for k, v in acc.items()}
+
+    def _compute_acc(self, acc):
+        if self.state_dtype is None:
+            return acc
+        return {k: (v.astype(jnp.float32)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for k, v in acc.items()}
 
     # -- subclass interface -------------------------------------------------
     def _create_accumulators(self, param: jax.Array) -> Dict[str, jax.Array]:
@@ -59,7 +89,8 @@ class Optimizer:
         return {
             "step": jnp.zeros((), jnp.int32),
             "global": self._init_global(),
-            "accums": {k: self._create_accumulators(v) for k, v in params.items()},
+            "accums": {k: self._store_acc(self._create_accumulators(v))
+                       for k, v in params.items()},
         }
 
     def learning_rate(self, step) -> jax.Array:
@@ -110,9 +141,10 @@ class Optimizer:
             plr = lr * (info.learning_rate if info is not None else 1.0)
             state_for_param = {"step": step, "global": opt_state["global"]}
             np_, nacc = self._apply_dense(plr, p, g.astype(jnp.float32),
-                                          opt_state["accums"][k], state_for_param)
+                                          self._compute_acc(opt_state["accums"][k]),
+                                          state_for_param)
             new_params[k] = np_.astype(p.dtype)
-            new_state["accums"][k] = nacc
+            new_state["accums"][k] = self._store_acc(nacc)
         return new_params, new_state
 
     # convenience: apply to a (params, opt_state) pair

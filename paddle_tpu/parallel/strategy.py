@@ -28,6 +28,9 @@ class DistStrategy:
     # intermediates), 'dots_no_batch', 'everything', or a
     # jax.checkpoint_policies callable
     remat_policy: Any = None
+    # store float optimizer accumulators (Adam moments etc.) in this
+    # dtype ('bfloat16' halves optimizer HBM); update math stays f32
+    opt_state_dtype: Optional[str] = None
     # loss scaling for mixed precision: a float enables scaling at that
     # initial value; dynamic_loss_scale grows/shrinks it from overflow
     # history (non-finite grads always skip the step when enabled).
