@@ -236,7 +236,7 @@ def bench_se_resnext(peak, batch_size=32, image_size=224, iters=15):
 
 
 def _bench_transformer_config(peak, batch_size, seq, dtype, dropout,
-                              max_len=256, iters=20):
+                              max_len=256, iters=20, fuse_qkv=None):
     import os
 
     import paddle_tpu as pt
@@ -246,12 +246,15 @@ def _bench_transformer_config(peak, batch_size, seq, dtype, dropout,
 
     # BENCH_USE_FLASH=0: A/B the pallas flash kernel against XLA's fused
     # dense attention (at short seq the dense path can win — the profile
-    # decides, not the assumption)
+    # decides, not the assumption). BENCH_FUSE_QKV=0 likewise A/Bs the
+    # fused [d,3,d] projection against the r0[1-3] three-matmul layout.
     use_flash = os.environ.get("BENCH_USE_FLASH", "1") != "0"
+    if fuse_qkv is None:
+        fuse_qkv = os.environ.get("BENCH_FUSE_QKV", "1") != "0"
     cfg = transformer.base_config(src_vocab=32000, trg_vocab=32000,
                                   dropout=dropout, max_len=max_len,
                                   dtype=dtype, use_flash=use_flash,
-                                  fused_ce=True)
+                                  fused_ce=True, fuse_qkv=fuse_qkv)
     model = pt.build(transformer.make_model(cfg))
     rng = np.random.RandomState(0)
     feeds = [{
@@ -281,12 +284,15 @@ def bench_transformer_long(peak, batch_size=4, seq=4096, dtype="bfloat16", iters
 
 def bench_bert(peak, batch_size=32, seq=128, num_masked=20, dtype="bfloat16",
                iters=20):
+    import os
+
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
     from paddle_tpu.core import flops
     from paddle_tpu.models import bert
 
     cfg = bert.base_config(dtype=dtype, use_flash=True, fused_ce=True,
+                           fuse_qkv=os.environ.get("BENCH_FUSE_QKV", "1") != "0",
                            max_len=512)
     model = pt.build(bert.make_pretrain_model(cfg))
     rng = np.random.RandomState(0)

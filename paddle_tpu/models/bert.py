@@ -27,6 +27,8 @@ class BertConfig:
     num_layers: int = 12
     dropout: float = 0.1
     use_flash: bool = False
+    # fused [d,3,d] QKV projection (layers/attention.py fuse_qkv)
+    fuse_qkv: bool = False
     # chunked logits-free CE for the MLM head (ops/fused_ce.py): never
     # materializes [b, masked, vocab] logits, and sidesteps the
     # involuntary-remat resharding XLA's partitioner hits on the dense
@@ -59,7 +61,8 @@ def encode(input_ids, token_type_ids, cfg: BertConfig):
     mask = A.padding_mask(input_ids)
     tcfg = TransformerConfig(d_model=cfg.d_model, d_inner=cfg.d_inner,
                              num_heads=cfg.num_heads, dropout=cfg.dropout,
-                             use_flash=cfg.use_flash, dtype=cfg.dtype)
+                             use_flash=cfg.use_flash, fuse_qkv=cfg.fuse_qkv,
+                             dtype=cfg.dtype)
     with name_scope("encoder"):
         for _ in range(cfg.num_layers):
             # fresh wrapper per layer (jax.checkpoint caches per fn object)

@@ -36,6 +36,9 @@ class TransformerConfig:
     dropout: float = 0.1
     label_smooth_eps: float = 0.1
     use_flash: bool = False
+    # one [d,3,d] (self) / [d,2,d] (cross K/V) projection matmul per
+    # attention instead of three — see layers/attention.py fuse_qkv
+    fuse_qkv: bool = False
     # chunked logits-free CE (ops/fused_ce.py); chunk = vocab tile width
     fused_ce: bool = False
     ce_chunk: int = 4096
@@ -65,7 +68,8 @@ def _embed(ids, vocab, d_model, dtype, scope_name):
 def encoder_layer(x, cfg: TransformerConfig, mask):
     h = L.layer_norm(x, begin_norm_axis=2)
     h = A.multi_head_attention(h, num_heads=cfg.num_heads, attn_mask=mask,
-                               dropout_rate=cfg.dropout, use_flash=cfg.use_flash)
+                               dropout_rate=cfg.dropout, use_flash=cfg.use_flash,
+                               fuse_qkv=cfg.fuse_qkv)
     x = x + L.dropout(h, cfg.dropout, dropout_implementation="upscale_in_train")
     h = L.layer_norm(x, begin_norm_axis=2)
     h = A.ffn(h, cfg.d_inner, dropout_rate=cfg.dropout)
@@ -77,15 +81,17 @@ def decoder_layer(x, enc_out, cfg: TransformerConfig, self_mask, cross_mask,
     h = L.layer_norm(x, begin_norm_axis=2)
     if cache is not None:
         h, cache = A.multi_head_attention(h, num_heads=cfg.num_heads, causal=False,
-                                          dropout_rate=0.0, cache=cache)
+                                          dropout_rate=0.0, cache=cache,
+                                          fuse_qkv=cfg.fuse_qkv)
     else:
         h = A.multi_head_attention(h, num_heads=cfg.num_heads, causal=True,
                                    attn_mask=self_mask, dropout_rate=cfg.dropout,
-                                   use_flash=cfg.use_flash)
+                                   use_flash=cfg.use_flash, fuse_qkv=cfg.fuse_qkv)
     x = x + L.dropout(h, cfg.dropout, dropout_implementation="upscale_in_train")
     h = L.layer_norm(x, begin_norm_axis=2)
     h = A.multi_head_attention(h, keys=enc_out, num_heads=cfg.num_heads,
-                               attn_mask=cross_mask, dropout_rate=cfg.dropout)
+                               attn_mask=cross_mask, dropout_rate=cfg.dropout,
+                               fuse_qkv=cfg.fuse_qkv)
     x = x + L.dropout(h, cfg.dropout, dropout_implementation="upscale_in_train")
     h = L.layer_norm(x, begin_norm_axis=2)
     h = A.ffn(h, cfg.d_inner, dropout_rate=cfg.dropout)

@@ -268,8 +268,13 @@ def transformer_tp_rules(extra: Sequence[Tuple[str, SpecLike]] = ()) -> Sharding
         (r".*_stack/ffn_out/w$", P("pp", "tp", None)),
         (r".*_stack/", P("pp")),
     ] + [
-        (r".*(q_proj|k_proj|v_proj|qkv_proj)/w$", P("fsdp", "tp")),
-        (r".*(q_proj|k_proj|v_proj|qkv_proj)/b$", P("tp")),
+        # fused projections are [d_in, 3|2, d_model] / [3|2, d_model]
+        # (layers/attention.py fuse_qkv): tp on the LAST axis so the
+        # per-sub-projection split needs no GSPMD resharding
+        (r".*(qkv_proj|kv_proj)/w$", P("fsdp", None, "tp")),
+        (r".*(qkv_proj|kv_proj)/b$", P(None, "tp")),
+        (r".*(q_proj|k_proj|v_proj)/w$", P("fsdp", "tp")),
+        (r".*(q_proj|k_proj|v_proj)/b$", P("tp")),
         (r".*out_proj/w$", P("tp", "fsdp")),
         (r".*ffn_in/w$", P("fsdp", "tp")),
         (r".*ffn_in/b$", P("tp")),

@@ -107,6 +107,83 @@ def test_transformer_flash_matches_xla():
                                rtol=1e-4)
 
 
+def _fuse_param_tree(unfused, fused_names):
+    """Stitch an unfused q/k/v param tree into the fuse_qkv layout:
+    qkv_proj/w = stack([q,k,v], axis=1), kv_proj/w = stack([k,v], 1)."""
+    import numpy as _np
+    out = {}
+    for fname in fused_names:
+        for tag, parts in (("qkv_proj", "qkv"), ("kv_proj", "kv")):
+            if f"{tag}/w" in fname or f"{tag}/b" in fname:
+                leaf = "w" if fname.endswith("/w") else "b"
+                prefix = fname[: fname.index(tag)]
+                out[fname] = _np.stack(
+                    [unfused[f"{prefix}{p}_proj/{leaf}"] for p in parts], axis=-2)
+                break
+        else:
+            out[fname] = unfused[fname]
+    return out
+
+
+def test_transformer_fused_qkv_matches_unfused():
+    """fuse_qkv is one [d,3,d] (self) / [d,2,d] (cross) matmul; with
+    tied weights the math is identical to three separate projections."""
+    feed = _translation_batch(bs=2, s=16)
+    m_u = pt.build(transformer.make_model(_tiny_transformer_cfg()))
+    m_f = pt.build(transformer.make_model(_tiny_transformer_cfg(fuse_qkv=True)))
+    p_u, s_u = m_u.init(jax.random.PRNGKey(0), **feed)
+    p_f, s_f = m_f.init(jax.random.PRNGKey(0), **feed)
+    assert any(k.endswith("qkv_proj/w") for k in p_f), sorted(p_f)[:5]
+    assert any(k.endswith("kv_proj/w") for k in p_f)  # decoder cross-attn
+    p_f2 = _fuse_param_tree(p_u, list(p_f))
+    out_u, _ = m_u.apply(p_u, s_u, **feed)
+    out_f, _ = m_f.apply(p_f2, s_f, **feed)
+    np.testing.assert_allclose(float(out_u["loss"]), float(out_f["loss"]),
+                               rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_transformer_fused_qkv_decode_matches():
+    """The incremental-decode (KV cache) path honors fuse_qkv and its
+    param names round-trip from a trained scope."""
+    cfg = _tiny_transformer_cfg(fuse_qkv=True)
+    model = pt.build(transformer.make_model(cfg))
+    feed = _translation_batch(bs=2, s=8)
+    trainer = pt.Trainer(model, opt.Adam(1e-3), loss_name="loss")
+    trainer.startup(sample_feed=feed)
+    trainer.step(feed)
+    dec = pt.build(transformer.make_decoder(cfg, max_len=8))
+    out = dec.apply(trainer.scope.params, trainer.scope.state,
+                    feed["src_ids"])[0]
+    ids = np.asarray(out["ids"])
+    assert ids.shape == (2, 8)
+
+
+@pytest.mark.slow
+def test_transformer_fused_qkv_tp_sharding():
+    """Fused [d,3,d] params shard on the last axis over tp with no
+    resharding warnings."""
+    import warnings
+    from paddle_tpu.parallel import sharding as _sh
+    mesh = pt.make_mesh({"dp": 2, "tp": 4})
+    cfg = _tiny_transformer_cfg(fuse_qkv=True)
+    model = pt.build(transformer.make_model(cfg))
+    feed = _translation_batch(bs=4)
+    trainer = pt.Trainer(model, opt.Adam(1e-3), loss_name="loss", mesh=mesh,
+                         sharding_rules=pt.parallel.transformer_tp_rules())
+    # the one-shot warning dedup would let an earlier test consume the
+    # warning this test asserts against — reset it first
+    _sh._warned_drops.clear()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        trainer.startup(sample_feed=feed)
+    qkvw = [k for k in trainer.scope.params if k.endswith("qkv_proj/w")][0]
+    spec = trainer.scope.params[qkvw].sharding.spec
+    assert spec[-1] == "tp", f"qkv_proj/w last axis not tp: {spec}"
+    out = trainer.step(feed)
+    assert np.isfinite(float(out["loss"]))
+
+
 def test_transformer_tp_sharding_compiles():
     """TP+DP mesh on 8 virtual devices — the multi-chip path at toy size."""
     mesh = pt.make_mesh({"dp": 2, "tp": 4})
