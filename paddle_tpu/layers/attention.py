@@ -12,6 +12,7 @@ sharding rules (parallel.sharding.transformer_tp_rules).
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional
 
@@ -23,6 +24,37 @@ from .. import initializer as init
 from .nn import dropout as _dropout
 
 NEG_INF = -1e9  # matches the additive-mask convention (finite to stay bf16-safe)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _scores_mxu(q, k, scale: float):
+    """QK^T·scale with f32 accumulation AND bf16 backward matmuls.
+
+    Default autodiff of an (bf16, bf16)→f32 einsum computes dq/dk as
+    (f32 cotangent)×(f32-upcast operand) dots — f32×f32 runs at ~1/8
+    MXU rate. Casting the score cotangent to the input dtype first
+    (after folding in the scale, in f32) keeps both backward dots
+    bf16×bf16→f32, the same rounding the flash kernels apply. No-op
+    numerically for f32 inputs."""
+    return jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def _scores_fwd(q, k, scale):
+    return _scores_mxu(q, k, scale), (q, k)
+
+
+def _scores_bwd(scale, res, ct):
+    q, k = res
+    ct = (ct * scale).astype(q.dtype)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ct, k,
+                    preferred_element_type=jnp.float32)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ct, q,
+                    preferred_element_type=jnp.float32)
+    return dq.astype(q.dtype), dk.astype(k.dtype)
+
+
+_scores_mxu.defvjp(_scores_fwd, _scores_bwd)
 
 
 def scaled_dot_product_attention(
@@ -47,8 +79,7 @@ def scaled_dot_product_attention(
 
     head_dim = q.shape[-1]
     scale = 1.0 / math.sqrt(head_dim)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
+    logits = _scores_mxu(q, k, scale)
     if attn_mask is not None:
         logits = logits + attn_mask
     if causal:
@@ -122,6 +153,11 @@ def multi_head_attention(
         return tuple(out[:, :, i] for i in range(n_out))
 
     if fuse_qkv and self_attn:
+        from ..core.errors import enforce
+        enforce(values is queries,
+                "fuse_qkv self-attention reads Q/K/V from the same "
+                "source; a distinct values tensor would be silently "
+                "dropped — pass fuse_qkv=False")
         q, k, v = fused_proj(queries, "qkv_proj", 3)
     elif fuse_qkv:
         # cross-attention: the fused layout needs K and V to read the

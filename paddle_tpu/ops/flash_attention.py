@@ -543,8 +543,9 @@ def flash_attention(
 
 
 def _mask_fallback(q, k, v, attn_mask, causal):
+    from ..layers.attention import _scores_mxu
     scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    s = _scores_mxu(q, k, scale)
     s = s + attn_mask
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]

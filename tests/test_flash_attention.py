@@ -236,3 +236,56 @@ def test_dense_mask_fallback_keeps_bias_and_segments():
     out = fa.flash_attention(q, k, v, attn_mask=dense, key_bias=bias)
     ref = _ref(q, k, v, key_bias=bias)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+# -- mixed-precision backward of the dense (XLA) attention path --------------
+
+
+def test_scores_mxu_bf16_grads_close_to_f32():
+    """The bf16-cotangent backward (layers/attention._scores_mxu) must
+    stay within bf16 rounding of the exact f32 gradient."""
+    from paddle_tpu.layers.attention import _scores_mxu
+
+    q, k, v = _rand(b=2, h=2, s=32, d=16, seed=3)
+
+    def loss_via(score_fn, q, k):
+        s = score_fn(q, k)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v) ** 2)
+
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    exact = lambda q, k: jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    mxu = lambda q, k: _scores_mxu(q, k, scale)
+
+    qb, kb = q.astype(jnp.bfloat16), k.astype(jnp.bfloat16)
+    gq_ref, gk_ref = jax.grad(lambda a, b: loss_via(exact, a, b), (0, 1))(q, k)
+    gq, gk = jax.grad(lambda a, b: loss_via(mxu, a, b), (0, 1))(qb, kb)
+    np.testing.assert_allclose(np.asarray(gq, np.float32), np.asarray(gq_ref),
+                               rtol=0.05, atol=0.05)
+    np.testing.assert_allclose(np.asarray(gk, np.float32), np.asarray(gk_ref),
+                               rtol=0.05, atol=0.05)
+    # f32 inputs take the same path with zero rounding change
+    gq32, gk32 = jax.grad(lambda a, b: loss_via(mxu, a, b), (0, 1))(q, k)
+    np.testing.assert_allclose(np.asarray(gq32), np.asarray(gq_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gk32), np.asarray(gk_ref), rtol=1e-5)
+
+
+def test_dense_attention_backward_has_no_f32_dots():
+    """Regression pin for the MXU-rate bug the custom VJP fixes: a bf16
+    SDPA train step must lower with every dot's inputs in bf16."""
+    import re
+
+    from paddle_tpu.layers.attention import scaled_dot_product_attention
+
+    q, k, v = _rand(b=2, h=2, s=32, d=16)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    def loss(q, k, v):
+        return jnp.sum(scaled_dot_product_attention(q, k, v, causal=True) ** 2)
+
+    txt = jax.jit(jax.grad(loss, (0, 1, 2))).lower(qb, kb, vb).as_text()
+    pat = re.compile(r'dot_general[^\n]*:\s*\(tensor<([^>]+)>,\s*tensor<([^>]+)>\)')
+    bad = [m.groups() for m in pat.finditer(txt)
+           if m.group(1).endswith('f32') and m.group(2).endswith('f32')]
+    assert not bad, f"f32xf32 dots in attention backward: {bad}"
