@@ -12,7 +12,7 @@ sharding rules (parallel.sharding.transformer_tp_rules).
 
 from __future__ import annotations
 
-import functools
+
 import math
 from typing import Optional
 
@@ -23,38 +23,9 @@ from ..framework import LayerHelper, cast_compute, in_training
 from .. import initializer as init
 from .nn import dropout as _dropout
 
+from ..ops.attention_scores import scores_mxu as _scores_mxu
+
 NEG_INF = -1e9  # matches the additive-mask convention (finite to stay bf16-safe)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def _scores_mxu(q, k, scale: float):
-    """QK^T·scale with f32 accumulation AND bf16 backward matmuls.
-
-    Default autodiff of an (bf16, bf16)→f32 einsum computes dq/dk as
-    (f32 cotangent)×(f32-upcast operand) dots — f32×f32 runs at ~1/8
-    MXU rate. Casting the score cotangent to the input dtype first
-    (after folding in the scale, in f32) keeps both backward dots
-    bf16×bf16→f32, the same rounding the flash kernels apply. No-op
-    numerically for f32 inputs."""
-    return jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                      preferred_element_type=jnp.float32) * scale
-
-
-def _scores_fwd(q, k, scale):
-    return _scores_mxu(q, k, scale), (q, k)
-
-
-def _scores_bwd(scale, res, ct):
-    q, k = res
-    ct = (ct * scale).astype(q.dtype)
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ct, k,
-                    preferred_element_type=jnp.float32)
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ct, q,
-                    preferred_element_type=jnp.float32)
-    return dq.astype(q.dtype), dk.astype(k.dtype)
-
-
-_scores_mxu.defvjp(_scores_fwd, _scores_bwd)
 
 
 def scaled_dot_product_attention(

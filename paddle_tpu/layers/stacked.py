@@ -93,9 +93,9 @@ def _sdpa(q, k, v, key_bias, causal: bool, use_flash: bool, sp_cfg=None):
     if use_flash:
         from ..ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, key_bias=key_bias)
-    from .attention import _scores_mxu
+    from ..ops.attention_scores import scores_mxu
     scale = 1.0 / math.sqrt(q.shape[-1])
-    logits = _scores_mxu(q, k, scale)
+    logits = scores_mxu(q, k, scale)
     if key_bias is not None:
         logits = logits + key_bias[:, None, None, :]
     if causal:

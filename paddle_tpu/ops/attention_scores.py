@@ -1,0 +1,47 @@
+"""Mixed-precision QK^T scores for the dense (XLA) attention path.
+
+Companion to the flash kernels (ops/flash_attention.py): default
+autodiff of an (bf16, bf16)→f32 score einsum computes dq/dk as
+(f32 cotangent)×(f32-upcast operand) dots — f32×f32 runs at ~1/8 MXU
+rate, and the dense attention path pays it at every site. ``scores_mxu``
+is a custom-VJP QK^T·scale that folds the scale into the f32 cotangent
+and casts it to the input dtype before the backward einsums — the same
+rounding the flash kernels apply in-kernel. Numerically a no-op for
+f32 inputs.
+
+Lives in ``ops`` (below ``layers``) so layers/attention.py and
+layers/stacked.py import downward, keeping the ops←layers dependency
+direction clean.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def scores_mxu(q, k, scale: float):
+    """QK^T·scale over [b, h, s, d] with f32 accumulation and
+    input-dtype backward matmuls."""
+    return jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def _scores_fwd(q, k, scale):
+    return scores_mxu(q, k, scale), (q, k)
+
+
+def _scores_bwd(scale, res, ct):
+    q, k = res
+    ct = (ct * scale).astype(q.dtype)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ct, k,
+                    preferred_element_type=jnp.float32)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ct, q,
+                    preferred_element_type=jnp.float32)
+    return dq.astype(q.dtype), dk.astype(k.dtype)
+
+
+scores_mxu.defvjp(_scores_fwd, _scores_bwd)

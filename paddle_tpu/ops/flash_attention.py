@@ -61,11 +61,18 @@ def _block_scores(q_ref, k_ref, bias_ref, segq_ref, segk_ref, qi, kj, *,
                   scale, causal, block_q, block_k, causal_offset):
     """Shared score assembly for the fwd/dq/dkv kernels: q·kᵀ (scaled),
     additive key bias, segment mask, causal mask — one definition so the
-    three kernels can never desynchronize."""
-    q = q_ref[0].astype(jnp.float32) * scale
-    kb = k_ref[0].astype(jnp.float32)
+    three kernels can never desynchronize.
+
+    The dot operands stay in the INPUT dtype (bf16 in → one MXU-native
+    bf16×bf16 pass with f32 accumulation; the previous f32 upcast ran
+    every kernel matmul at the ~1/8-rate f32 MXU path and capped the
+    whole kernel at ~17% MFU). Softmax state and masks are f32. The
+    scale is applied to the f32 scores, not the bf16 operand. Returns
+    (q, k) UNSCALED in their native dtype plus the scaled f32 scores."""
+    q = q_ref[0]
+    kb = k_ref[0]
     s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=jnp.float32) * scale
     if bias_ref is not None:
         s = s + bias_ref[0, 0, :][None, :]
     if segq_ref is not None:
@@ -117,7 +124,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
                                 qi, kj, scale=scale, causal=causal,
                                 block_q=block_q, block_k=block_k,
                                 causal_offset=causal_offset)
-        vb = v_ref[0].astype(jnp.float32)
+        vb = v_ref[0]
         m_prev = m_scr[:, 0]
         l_prev = l_scr[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -126,8 +133,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
         l_new = l_prev * alpha + jnp.sum(p, axis=1)
         m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+        # p rounded to the input dtype for the MXU pass; accumulator f32
         acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(kj == num_k_blocks - 1)
     def _finalize():
@@ -259,8 +268,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
                                  qi, kj, scale=scale, causal=causal,
                                  block_q=block_q, block_k=block_k,
                                  causal_offset=causal_offset)
-        vb = v_ref[0].astype(jnp.float32)
-        g = g_ref[0].astype(jnp.float32)
+        vb = v_ref[0]
+        g = g_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
         p = _zero_masked(jnp.exp(s - lse[:, None]), s)
@@ -268,7 +277,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
         dq_scr[...] += jax.lax.dot_general(
-            ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(kj == num_k_blocks - 1)
     def _finalize():
@@ -295,20 +305,23 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
                                 qi, kj, scale=scale, causal=causal,
                                 block_q=block_q, block_k=block_k,
                                 causal_offset=causal_offset)
-        vb = v_ref[0].astype(jnp.float32)
-        g = g_ref[0].astype(jnp.float32)
+        vb = v_ref[0]
+        g = g_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
         p = _zero_masked(jnp.exp(s - lse[:, None]), s)  # [bq, bk]
         # dv += p^T g
         dv_scr[...] += jax.lax.dot_general(
-            p, g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(g, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])  # [bq, bk]
-        # dk += ds^T (q*scale)  (q already scaled; ds carries no scale yet)
+        # ds carries the scale here (q is unscaled); rounded to the
+        # input dtype for the dk MXU pass
+        ds = p * (dp - delta[:, None]) * scale  # [bq, bk]
         dk_scr[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(qi == num_q_blocks - 1)
     def _finalize():
@@ -543,9 +556,9 @@ def flash_attention(
 
 
 def _mask_fallback(q, k, v, attn_mask, causal):
-    from ..layers.attention import _scores_mxu
+    from .attention_scores import scores_mxu
     scale = 1.0 / math.sqrt(q.shape[-1])
-    s = _scores_mxu(q, k, scale)
+    s = scores_mxu(q, k, scale)
     s = s + attn_mask
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]

@@ -242,9 +242,9 @@ def test_dense_mask_fallback_keeps_bias_and_segments():
 
 
 def test_scores_mxu_bf16_grads_close_to_f32():
-    """The bf16-cotangent backward (layers/attention._scores_mxu) must
-    stay within bf16 rounding of the exact f32 gradient."""
-    from paddle_tpu.layers.attention import _scores_mxu
+    """The bf16-cotangent backward (ops/attention_scores.scores_mxu)
+    must stay within bf16 rounding of the exact f32 gradient."""
+    from paddle_tpu.ops.attention_scores import scores_mxu as _scores_mxu
 
     q, k, v = _rand(b=2, h=2, s=32, d=16, seed=3)
 
@@ -286,6 +286,32 @@ def test_dense_attention_backward_has_no_f32_dots():
 
     txt = jax.jit(jax.grad(loss, (0, 1, 2))).lower(qb, kb, vb).as_text()
     pat = re.compile(r'dot_general[^\n]*:\s*\(tensor<([^>]+)>,\s*tensor<([^>]+)>\)')
-    bad = [m.groups() for m in pat.finditer(txt)
-           if m.group(1).endswith('f32') and m.group(2).endswith('f32')]
+    dots = [m.groups() for m in pat.finditer(txt)]
+    assert len(dots) >= 4, f"regex no longer matches dot_general ops: {len(dots)}"
+    bad = [d for d in dots if d[0].endswith('f32') and d[1].endswith('f32')]
     assert not bad, f"f32xf32 dots in attention backward: {bad}"
+
+
+def test_bf16_kernel_close_to_f32_reference():
+    """bf16 operands now feed the kernel dots directly (MXU-native);
+    fwd and grads must stay within bf16 rounding of the f32 reference."""
+    q, k, v = _rand(b=1, h=2, s=96, d=32, seed=7)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    out = fa.flash_attention(qb, kb, vb, causal=True, block_q=32, block_k=32)
+    ref = _ref(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=0.05, atol=0.05)
+
+    def loss_f(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, causal=True,
+                                          block_q=32, block_k=32) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(_ref(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_f, (0, 1, 2))(qb, kb, vb)
+    gr = jax.grad(loss_r, (0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b),
+                                   rtol=0.1, atol=0.1)
