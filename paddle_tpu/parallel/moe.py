@@ -74,13 +74,17 @@ def _aux_loss(probs, mask):
 
 
 def _expert_ffn(xe, w1, b1, w2, b2, act):
-    """Batched expert FFN: xe [E_local, C', d] through per-expert weights."""
+    """Batched expert FFN: xe [E_local, C', d] through per-expert weights.
+
+    Plain compute-dtype einsums (no f32 preferred_element_type): XLA's
+    TPU matmul accumulates bf16 in f32 regardless, and an f32-output
+    einsum over bf16 operands makes autodiff compute the backward dots
+    as f32×f32 — the ~1/8-rate MXU path (same trap the attention
+    scores custom-VJP fixes)."""
     xe, w1, w2 = cast_compute(xe, w1, w2)
-    h = jnp.einsum("ecd,edf->ecf", xe, w1,
-                   preferred_element_type=jnp.float32) + b1[:, None, :]
-    h = act(h).astype(xe.dtype)
-    y = jnp.einsum("ecf,efd->ecd", h, w2,
-                   preferred_element_type=jnp.float32) + b2[:, None, :]
+    h = jnp.einsum("ecd,edf->ecf", xe, w1) + b1[:, None, :].astype(xe.dtype)
+    h = act(h)
+    y = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :].astype(xe.dtype)
     return y
 
 
@@ -89,11 +93,16 @@ def _route_compute(xt, wg, w1, b1, w2, b2, *, top_k, capacity, act,
     """Shared router→dispatch→experts→combine over tokens [t, d].
     ``exchange(x, inverse)`` wraps the expert compute with the EP
     token↔expert reshard; None on the dense path."""
+    # router stays f32 (gate correctness); everything sized by tokens —
+    # dispatch/combine one-hot einsums and the expert bank — runs in the
+    # compute dtype (the dispatch einsum's t·E·C·d flops rival the
+    # expert FFN's at real capacity factors)
     logits = jnp.matmul(xt.astype(jnp.float32), wg)
     probs = jax.nn.softmax(logits, axis=-1)
     dispatch, combine, mask = _topk_dispatch(probs, top_k, capacity, normalize_gates)
     aux = _aux_loss(probs, mask)
-    xe = jnp.einsum("tec,td->ecd", dispatch.astype(xt.dtype), xt)   # [E, C, d]
+    xt_c = cast_compute(xt)
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(xt_c.dtype), xt_c)  # [E, C, d]
     if exchange is not None:
         xe = exchange(xe, inverse=False)
     ye = _expert_ffn(xe, w1, b1, w2, b2, act)

@@ -1,0 +1,115 @@
+"""MXU dtype regression pins: no f32×f32 matmuls in bf16 train steps.
+
+The bug class: any (bf16, bf16)→f32 dot (``preferred_element_type``)
+makes default autodiff compute its backward dots as (f32 cotangent) ×
+(f32-upcast operand) — and f32×f32 runs at ~1/8 MXU rate on TPU. Found
+three times in round 4 (dense attention backward, flash kernels' f32
+operand upcast, MoE expert/dispatch einsums); these lowering-level pins
+keep the whole class from regressing anywhere in the bench-path model
+zoo. Router/gating dots are exempted by a whitelist of tiny shapes.
+
+Reference analog: the reference pinned kernel dtypes per-op in its
+op_test harness (op_test.py:43); XLA owns our kernels, so the pin
+moves to the lowered HLO.
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.config import set_flag
+
+_DOT = re.compile(
+    r'(dot_general|convolution)[^\n]*:\s*\(tensor<([^>]+)>,\s*tensor<([^>]+)>\)'
+    r'\s*->\s*tensor<([^>]+)>')
+
+
+def _f32_dots(model, feed, min_dots=4, allow_trailing=()):
+    """Lower grad(loss) and return f32×f32 dots.
+
+    ``allow_trailing``: dims that mark a dot as part of the (legitimate
+    f32) gating path — MoE router/dispatch-table dots always carry the
+    num_experts or top_k axis as a trailing dim of an operand or the
+    output; expert-bank matmuls never do (their trailing dims are
+    d_model/d_ff/capacity)."""
+    p, s = model.init(jax.random.PRNGKey(0), **feed)
+
+    def loss_fn(p, s, feed):
+        out, _ = model.apply(p, s, **feed)
+        return out["loss"]
+
+    txt = jax.jit(jax.grad(loss_fn)).lower(p, s, feed).as_text()
+    dots = [m.groups()[1:] for m in _DOT.finditer(txt)]
+    assert len(dots) >= min_dots, f"HLO regex matched too few dots: {len(dots)}"
+
+    def gating(dot):
+        return any(int(t.split('x')[-2]) in allow_trailing
+                   for t in dot if 'x' in t)
+
+    return [d for d in dots
+            if d[0].endswith('f32') and d[1].endswith('f32')
+            and not (allow_trailing and gating(d))]
+
+
+@pytest.fixture(autouse=True)
+def _bf16_flag():
+    set_flag("default_compute_dtype", "bfloat16")
+    yield
+    set_flag("default_compute_dtype", "float32")
+
+
+def test_gpt_train_step_mxu_clean():
+    from paddle_tpu.models import gpt
+    rng = np.random.RandomState(0)
+    cfg = gpt.base_config(vocab_size=128, d_model=64, d_inner=128, num_heads=4,
+                          num_layers=1, max_len=32, use_flash=False,
+                          fused_ce=True, dtype="bfloat16")
+    ids = rng.randint(3, 128, (2, 32)).astype(np.int32)
+    bad = _f32_dots(pt.build(gpt.make_model(cfg)),
+                    {"ids": ids, "labels": np.roll(ids, -1, 1).astype(np.int32)})
+    assert not bad, f"f32xf32 dots in GPT train step: {bad}"
+
+
+@pytest.mark.slow
+def test_transformer_train_step_mxu_clean():
+    from paddle_tpu.models import transformer
+    rng = np.random.RandomState(0)
+    cfg = transformer.base_config(
+        src_vocab=128, trg_vocab=128, d_model=64, d_inner=128, num_heads=4,
+        num_encoder_layers=1, num_decoder_layers=1, dropout=0.1,
+        dtype="bfloat16", fused_ce=True, fuse_qkv=True)
+    feed = {"src_ids": rng.randint(3, 128, (2, 16)).astype(np.int32),
+            "trg_ids": rng.randint(3, 128, (2, 16)).astype(np.int32),
+            "labels": rng.randint(3, 128, (2, 16)).astype(np.int32)}
+    bad = _f32_dots(pt.build(transformer.make_model(cfg)), feed)
+    assert not bad, f"f32xf32 dots in transformer train step: {bad}"
+
+
+@pytest.mark.slow
+def test_moe_train_step_mxu_clean():
+    from paddle_tpu.models import moe_transformer as mt
+    rng = np.random.RandomState(0)
+    cfg = mt.base_config(vocab_size=128, d_model=64, num_heads=4,
+                         num_layers=2, num_experts=4, max_len=32,
+                         dtype="bfloat16")
+    ids = rng.randint(3, 128, (2, 32)).astype(np.int32)
+    bad = _f32_dots(pt.build(mt.make_model(cfg)),
+                    {"ids": ids, "labels": np.roll(ids, -1, 1).astype(np.int32)},
+                    allow_trailing=(cfg.num_experts, cfg.top_k))
+    assert not bad, f"f32xf32 dots in MoE train step: {bad}"
+
+
+@pytest.mark.slow
+def test_resnet_train_step_mxu_clean():
+    from paddle_tpu.framework import layout_mode
+    from paddle_tpu.models import resnet
+    rng = np.random.RandomState(0)
+    with layout_mode("NHWC"):
+        model = pt.build(resnet.make_model(depth=50, class_num=10, image_size=32))
+    feed = {"image": rng.randn(2, 32, 32, 3).astype(np.float32),
+            "label": rng.randint(0, 10, (2, 1)).astype(np.int64)}
+    bad = _f32_dots(model, feed, min_dots=2)
+    assert not bad, f"f32xf32 dots/convs in ResNet train step: {bad}"
