@@ -56,9 +56,9 @@ def _f32_dots(model, feed, min_dots=4, allow_trailing=()):
 
 @pytest.fixture(autouse=True)
 def _bf16_flag():
-    set_flag("default_compute_dtype", "bfloat16")
-    yield
-    set_flag("default_compute_dtype", "float32")
+    from paddle_tpu.framework import amp_guard
+    with amp_guard("bfloat16"):
+        yield
 
 
 def test_gpt_train_step_mxu_clean():
@@ -100,6 +100,53 @@ def test_moe_train_step_mxu_clean():
                     {"ids": ids, "labels": np.roll(ids, -1, 1).astype(np.int32)},
                     allow_trailing=(cfg.num_experts, cfg.top_k))
     assert not bad, f"f32xf32 dots in MoE train step: {bad}"
+
+
+def _jaxpr_dots(closed):
+    """All dot_general eqns reachable from a jaxpr, descending into
+    sub-jaxprs (pallas_call kernel bodies, scan/cond/custom-vjp)."""
+    out = []
+    seen = set()
+
+    def walk(jx):
+        if id(jx) in seen:
+            return
+        seen.add(id(jx))
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "dot_general":
+                out.append(tuple(str(v.aval.dtype) for v in eqn.invars)
+                           + (str(eqn.outvars[0].aval.dtype),))
+            for p in eqn.params.values():
+                for cand in (p if isinstance(p, (list, tuple)) else (p,)):
+                    if hasattr(cand, "eqns"):
+                        walk(cand)
+                    elif hasattr(cand, "jaxpr") and hasattr(cand.jaxpr, "eqns"):
+                        walk(cand.jaxpr)
+
+    walk(closed.jaxpr)
+    return out
+
+
+def test_flash_kernels_dot_operands_stay_bf16():
+    """The pallas kernels' dots are invisible to the HLO pins (they
+    lower as custom_call); pin their operand dtypes at the jaxpr level.
+    A regression to the round-4 f32-operand upcast (every kernel matmul
+    at ~1/8 MXU rate) must fail here."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import flash_attention as fa
+
+    q = jnp.ones((1, 2, 128, 32), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, causal=True,
+                                          block_q=64, block_k=64) ** 2)
+
+    dots = _jaxpr_dots(jax.make_jaxpr(jax.grad(loss, (0, 1, 2)))(q, q, q))
+    # fwd kernel: s, pv; dq kernel: dp, dq; dkv kernel: dv, dp, dk
+    assert len(dots) >= 7, f"expected fwd+dq+dkv kernel dots, got {dots}"
+    bad = [d for d in dots if d[0] == "float32" and d[1] == "float32"]
+    assert not bad, f"f32-operand dots inside flash kernels: {bad}"
 
 
 @pytest.mark.slow

@@ -162,6 +162,7 @@ def test_stacked_decoder_is_causal():
                            np.asarray(out2["logits"])[:, 6:], atol=1e-3)
 
 
+@pytest.mark.slow
 def test_pipeline_transformer_e2e_loss_parity():
     """dp2×pp4 pipelined training == single-device training, step for
     step (same seed → same stacked init → same losses)."""

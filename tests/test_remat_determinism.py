@@ -91,6 +91,7 @@ def test_remat_reduces_memory_on_tpu():
     assert m_remat["temp_mb"] < 0.5 * m_plain["temp_mb"], (m_plain, m_remat)
 
 
+@pytest.mark.slow
 def test_model_config_remat_equivalent_numerics():
     feed = _feed()
     p0 = pt.build(transformer.make_model(_cfg()))
@@ -153,7 +154,11 @@ def _no_remat_losses():
     return feeds, [float(ref.step(f)["loss"]) for f in feeds]
 
 
-@pytest.mark.parametrize("policy", ["dots", "dots_no_batch", "everything"])
+@pytest.mark.parametrize("policy", [
+    "dots",
+    pytest.param("dots_no_batch", marks=pytest.mark.slow),
+    pytest.param("everything", marks=pytest.mark.slow),
+])
 def test_remat_policy_numerics_unchanged(policy, _no_remat_losses):
     """Checkpoint policies change WHAT is saved (memory/recompute), not
     the computed values: per-step losses must equal the no-remat run."""
