@@ -67,6 +67,28 @@ def test_assemble_headline_and_partial_shape():
     assert res["configs"] is configs
 
 
+def test_assemble_degraded_link_uses_compute_only():
+    """Below LINK_DEGRADED_MBPS the pipelined numbers measure the dev
+    tunnel, not the framework: the headline must switch to the
+    compute-only variant, say so in the unit, and flag the record."""
+    configs = {
+        "bert_train": {"mfu": 0.01, "mfu_compute_only": 0.55, "value": 2.0},
+        "resnet50_train": {"mfu": 0.002, "mfu_compute_only": 0.3, "value": 3.0,
+                           "compute_only": 2000.0, "vs_baseline": 0.2},
+    }
+    res = bench._assemble(configs, "TPU v5 lite", 197e12, "table", "bfloat16",
+                          h2d_mbps=12.0)
+    assert res["link_degraded"] is True
+    assert res["value"] == 0.55
+    assert "compute-only" in res["unit"]
+    assert res["vs_baseline"] == round(2000.0 / bench.BASELINES["resnet50"], 2)
+    # healthy link: pipelined headline, no flag
+    res2 = bench._assemble(configs, "TPU v5 lite", 197e12, "table", "bfloat16",
+                           h2d_mbps=8000.0)
+    assert "link_degraded" not in res2 and res2["value"] == 0.01
+    assert res2["unit"] == "MFU"
+
+
 def test_baselines_match_baseline_md_rows():
     # the ratios the suite reports are anchored to these exact numbers
     assert bench.BASELINES["resnet50"] == 81.69
