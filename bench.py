@@ -723,11 +723,44 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
     import sys
 
     kind, h2d_mbps = _probe_device()
+    # load once up-front: the SIGTERM partial handler must not do fresh
+    # file I/O between the signal and emitting the one JSON line, and
+    # carried rows only make sense under the same measurement settings
+    # (quick mode uses 3-iter smoke shapes; a different compute_dtype is
+    # a different measurement)
+    mid = None if quick else _load_mid_round()
+    if mid and mid.get("compute_dtype", compute_dtype) != compute_dtype:
+        mid = None
+    # backfill scope: only configs this run was asked to measure
+    # (respects BENCH_ONLY) — applies to the wholesale fallback below too
+    scheduled = {_result_key(n) for n in _suite_names()}
     if kind is None:
+        # the tunnel is down at suite time — fall back to the committed
+        # mid-round on-chip capture (tools/chip_queue.py merges rows into
+        # BENCH_mid_r*.json whenever a link window opens) so the round
+        # record preserves every measurement actually taken, instead of
+        # recording nothing the way round 3 did; one carry policy for
+        # both paths: the helper fills the (here: all) holes
+        mid_configs = {}
+        _backfill_from_mid_round(mid_configs, scheduled=scheduled, mid=mid)
+        if mid_configs:
+            # a failed probe means there is no usable link right now, so
+            # the compute-only headline applies regardless of what (if
+            # anything) the mid-round run measured for h2d bandwidth:
+            # always pass 0.0 and restore the mid record's value after
+            res = _assemble(mid_configs, mid.get("device"),
+                            mid.get("peak_flops"), mid.get("peak_source"),
+                            mid.get("compute_dtype", compute_dtype), 0.0)
+            res["host_to_device_mbps"] = mid.get("host_to_device_mbps")
+            res["link_down_at_suite_time"] = True
+            res["probe_error"] = (PROBE_FAILED_MSG +
+                                  "; nothing was measured in THIS run")
+            res["note"] = ("configs are the committed mid-round on-chip "
+                           "capture "
+                           f"({mid.get('_source', 'BENCH_mid record')})")
+            return res
         return {"metric": "suite", "value": 0.0, "unit": "MFU",
-                "vs_baseline": None,
-                "error": "device probe failed: backend unreachable or wedged "
-                         "(tiny-matmul subprocess timed out)",
+                "vs_baseline": None, "error": PROBE_FAILED_MSG,
                 "compute_dtype": compute_dtype, "configs": {}}
     if h2d_mbps is not None and h2d_mbps < LINK_DEGRADED_MBPS:
         # same threshold _assemble uses for the headline switch: below
@@ -762,6 +795,7 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
         # the one JSON line is recorded as the run's output
         if child[0] is not None and child[0].poll() is None:
             child[0].kill()
+        _backfill_from_mid_round(configs, scheduled=scheduled, mid=mid)
         res = _assemble(configs, device or kind, peak, peak_source,
                         compute_dtype, h2d_mbps)
         res["partial"] = f"suite interrupted by signal {signum}"
@@ -818,6 +852,7 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
         signal.signal(signal.SIGTERM, old_term)
         signal.signal(signal.SIGINT, old_int)
 
+    _backfill_from_mid_round(configs, scheduled=scheduled, mid=mid)
     return _assemble(configs, device or kind, peak, peak_source,
                      compute_dtype, h2d_mbps)
 
@@ -829,22 +864,110 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
 # way; this only selects which one the one-line headline summarizes.
 LINK_DEGRADED_MBPS = 500.0
 
+# one string for both the hard-error record and the fallback's
+# probe_error field — they must never drift apart
+PROBE_FAILED_MSG = ("device probe failed: backend unreachable or wedged "
+                    "(tiny-matmul subprocess timed out)")
+
+
+def _load_mid_round(root=None):
+    """Latest committed mid-round capture (BENCH_mid_r*.json), or None.
+
+    tools/chip_queue.py appends on-chip rows to this record during link
+    windows; the suite uses it two ways: wholesale when the device probe
+    fails outright, and per-config to backfill rows the live run lost to
+    a timeout/crash that an earlier window captured successfully."""
+    import glob
+    import os
+    import re
+
+    def _round_no(path):
+        m = re.search(r"BENCH_mid_r(\d+)\.json$", path)
+        return int(m.group(1)) if m else -1
+
+    here = root or os.path.dirname(os.path.abspath(__file__))
+    # numeric round order, not lexicographic: r100 must beat r99
+    paths = sorted(glob.glob(os.path.join(here, "BENCH_mid_r*.json")),
+                   key=_round_no)
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(rec, dict) and rec.get("configs"):
+            rec["_source"] = os.path.basename(path)
+            return rec
+    return None
+
+
+_UNSET = object()
+
+
+def _backfill_from_mid_round(configs, scheduled=None, mid=_UNSET):
+    """Replace errored/missing live rows with mid-round on-chip rows.
+
+    Only fills holes — a live measurement (even a worse one) always wins
+    over a carried row, because it reflects the code being judged — and
+    only for configs the caller scheduled this run (a BENCH_ONLY debug
+    run must not sprout rows it never attempted). Carried rows are
+    marked per-config and never drive the headline (_assemble skips
+    them unless NO live train row exists at all). Pass mid explicitly
+    to avoid file I/O at call time (the SIGTERM handler must not read
+    files between the signal and emitting the record)."""
+    if mid is _UNSET:
+        mid = _load_mid_round()
+    if not mid or not mid.get("configs"):
+        return
+    for key, row in mid["configs"].items():
+        if not isinstance(row, dict) or "error" in row:
+            continue
+        # A/B variant rows (chip_queue's "bert_train@no_flash") ride with
+        # their base config's scheduling
+        if scheduled is not None and key.split("@")[0] not in scheduled:
+            continue
+        live = configs.get(key)
+        if live is None or "error" in live:
+            carried = dict(row)
+            carried["carried_from_mid_round"] = True
+            if live is not None and "error" in live:
+                carried["live_error"] = live["error"]
+            configs[key] = carried
+
 
 def _assemble(configs, device, peak, peak_source, compute_dtype,
               h2d_mbps=None):
     degraded = h2d_mbps is not None and h2d_mbps < LINK_DEGRADED_MBPS
     key = "mfu_compute_only" if degraded else "mfu"
-    mfus = [c[key] for n, c in configs.items()
-            if n.endswith("_train") and key in c]
+    carried = sorted(n for n, c in configs.items()
+                     if isinstance(c, dict) and c.get("carried_from_mid_round"))
+    # the headline must reflect the code under test: carried rows (old
+    # measurements backfilled for provenance) count only when this run
+    # measured NO train row at all — and then the unit says so
+    live_mfus = [c[key] for n, c in configs.items()
+                 if n.endswith("_train") and key in c
+                 and n not in carried]
+    all_mfus = [c[key] for n, c in configs.items()
+                if n.endswith("_train") and key in c]
+    headline_carried = not live_mfus and bool(all_mfus)
+    mfus = live_mfus or all_mfus
     headline = max(mfus) if mfus else 0.0
     rn = configs.get("resnet50_train", {})
+    # a carried resnet row may only feed the top-level ratio when the
+    # whole headline is carried (and the unit discloses it); a live
+    # headline must not sit next to an old-code vs_baseline
+    if rn.get("carried_from_mid_round") and not headline_carried:
+        rn = {}
     vs = rn.get("vs_baseline")
     if degraded and rn.get("compute_only") and BASELINES.get("resnet50"):
         vs = round(rn["compute_only"] / BASELINES["resnet50"], 2)
+    unit = "MFU (compute-only; link degraded)" if degraded else "MFU"
+    if headline_carried:
+        unit += "; carried from mid-round capture"
     out = {
         "metric": "suite",
         "value": round(headline, 4),
-        "unit": "MFU (compute-only; link degraded)" if degraded else "MFU",
+        "unit": unit,
         "vs_baseline": vs,
         "device": device,
         "peak_flops": peak,
@@ -855,6 +978,8 @@ def _assemble(configs, device, peak, peak_source, compute_dtype,
     }
     if degraded:
         out["link_degraded"] = True
+    if carried:
+        out["carried_configs"] = carried
     return out
 
 

@@ -95,3 +95,137 @@ def test_baselines_match_baseline_md_rows():
     assert bench.BASELINES["resnet50_infer_fp32"] == 217.69
     assert bench.BASELINES["googlenet_infer"] == 600.94
     assert abs(bench.BASELINES["lstm_big"] - 256 / 1.655) < 1e-9
+
+
+def test_load_mid_round_picks_latest_valid(tmp_path):
+    import json
+    (tmp_path / "BENCH_mid_r03.json").write_text(json.dumps(
+        {"configs": {"a_train": {"mfu": 0.1, "value": 1.0}}}))
+    (tmp_path / "BENCH_mid_r04.json").write_text(json.dumps(
+        {"configs": {"b_train": {"mfu": 0.2, "value": 2.0}}}))
+    rec = bench._load_mid_round(root=str(tmp_path))
+    assert "b_train" in rec["configs"]
+    assert rec["_source"] == "BENCH_mid_r04.json"
+    # a corrupt latest file falls through to the previous one
+    (tmp_path / "BENCH_mid_r05.json").write_text("{not json")
+    rec = bench._load_mid_round(root=str(tmp_path))
+    assert rec["_source"] == "BENCH_mid_r04.json"
+    assert bench._load_mid_round(root=str(tmp_path / "empty")) is None
+
+
+def test_backfill_fills_only_holes(monkeypatch):
+    """A live row (even a slow one) beats a carried row; errored and
+    missing rows are backfilled from the mid-round record with the
+    provenance marker so the judge can tell which is which."""
+    mid = {"configs": {
+        "resnet50_train": {"mfu": 0.3, "value": 2000.0},
+        "bert_train": {"mfu": 0.4, "value": 5.0},
+        "gpt_train": {"error": "timeout 600s"},   # errored mid rows never carry
+    }}
+    monkeypatch.setattr(bench, "_load_mid_round", lambda root=None: mid)
+    configs = {
+        "resnet50_train": {"mfu": 0.1, "value": 900.0},  # live wins
+        "bert_train": {"error": "Timeout: config exceeded 600s"},
+    }
+    bench._backfill_from_mid_round(configs)
+    assert configs["resnet50_train"]["value"] == 900.0
+    assert "carried_from_mid_round" not in configs["resnet50_train"]
+    assert configs["bert_train"]["value"] == 5.0
+    assert configs["bert_train"]["carried_from_mid_round"] is True
+    assert "exceeded 600s" in configs["bert_train"]["live_error"]
+    assert "gpt_train" not in configs
+    # mid record untouched (backfill must copy, not alias)
+    assert "carried_from_mid_round" not in mid["configs"]["bert_train"]
+
+
+def test_probe_fail_falls_back_to_mid_round(monkeypatch):
+    # h2d None (the mid-round probe died before the bandwidth read) must
+    # still force the compute-only headline: a failed probe IS a dead link
+    mid = {"configs": {"bert_train": {"mfu": 0.01, "mfu_compute_only": 0.5,
+                                      "value": 5.0}},
+           "device": "TPU v5 lite", "peak_flops": 197e12,
+           "peak_source": "table", "host_to_device_mbps": None,
+           "_source": "BENCH_mid_r04.json"}
+    monkeypatch.setattr(bench, "_probe_device", lambda *a, **k: (None, None))
+    monkeypatch.setattr(bench, "_load_mid_round", lambda root=None: mid)
+    res = bench.run_suite()
+    assert res["link_down_at_suite_time"] is True
+    assert res["value"] == 0.5            # dead link -> compute-only
+    assert "compute-only" in res["unit"]
+    assert res["host_to_device_mbps"] is None
+    assert res["configs"]["bert_train"]["carried_from_mid_round"] is True
+    assert "mid-round" in res["note"]
+    # no mid record at all: the old explicit-error record
+    monkeypatch.setattr(bench, "_load_mid_round", lambda root=None: None)
+    res = bench.run_suite()
+    assert "device probe failed" in res["error"]
+
+
+def test_backfill_respects_scheduled_scope(monkeypatch):
+    """BENCH_ONLY debug runs must not sprout rows they never attempted."""
+    mid = {"configs": {"resnet50_train": {"mfu": 0.3, "value": 2000.0},
+                       "bert_train": {"mfu": 0.4, "value": 5.0}}}
+    monkeypatch.setattr(bench, "_load_mid_round", lambda root=None: mid)
+    configs = {"mnist_mlp_train": {"mfu": 0.0, "value": 8000.0}}
+    bench._backfill_from_mid_round(configs, scheduled={"mnist_mlp_train"})
+    assert set(configs) == {"mnist_mlp_train"}
+
+
+def test_assemble_carried_rows_never_drive_headline():
+    """The one-line headline reflects the code under test: carried
+    (prior-capture) rows are excluded from the max unless NO live train
+    row was measured at all — and then the unit discloses it."""
+    configs = {
+        "bert_train": {"mfu": 0.9, "value": 5.0,
+                       "carried_from_mid_round": True},
+        "transformer_train": {"mfu": 0.2, "value": 2.0},
+    }
+    res = bench._assemble(configs, "TPU v5 lite", 197e12, "table", "bfloat16")
+    assert res["value"] == 0.2                     # live row wins
+    assert res["unit"] == "MFU"
+    assert res["carried_configs"] == ["bert_train"]
+    # all rows carried: headline falls back to them, unit says so
+    res2 = bench._assemble(
+        {"bert_train": configs["bert_train"]},
+        "TPU v5 lite", 197e12, "table", "bfloat16")
+    assert res2["value"] == 0.9
+    assert "carried from mid-round" in res2["unit"]
+
+
+def test_all_error_mid_record_yields_explicit_error(monkeypatch):
+    """A mid record whose rows are ALL errors must not produce a
+    success-shaped empty record on probe failure."""
+    mid = {"configs": {"bert_train": {"error": "timeout"}},
+           "_source": "BENCH_mid_r04.json"}
+    monkeypatch.setattr(bench, "_probe_device", lambda *a, **k: (None, None))
+    monkeypatch.setattr(bench, "_load_mid_round", lambda root=None: mid)
+    res = bench.run_suite()
+    assert "error" in res and res["value"] == 0.0
+
+
+def test_mid_record_dtype_and_quick_gating(monkeypatch):
+    """Carried rows only make sense under the same measurement settings:
+    quick mode and a different compute_dtype both disable the fallback."""
+    mid = {"configs": {"bert_train": {"mfu": 0.5, "mfu_compute_only": 0.5,
+                                      "value": 5.0}},
+           "compute_dtype": "bfloat16", "_source": "BENCH_mid_r04.json"}
+    monkeypatch.setattr(bench, "_probe_device", lambda *a, **k: (None, None))
+    monkeypatch.setattr(bench, "_load_mid_round", lambda root=None: mid)
+    assert "error" in bench.run_suite(compute_dtype="float32")
+    assert "error" in bench.run_suite(quick=True)
+    assert "error" not in bench.run_suite()   # matching settings: fallback
+
+
+def test_assemble_live_headline_drops_carried_vs_baseline():
+    configs = {
+        "resnet50_train": {"mfu": 0.3, "value": 2000.0, "vs_baseline": 24.0,
+                           "carried_from_mid_round": True},
+        "transformer_train": {"mfu": 0.2, "value": 2.0},
+    }
+    res = bench._assemble(configs, "TPU v5 lite", 197e12, "table", "bfloat16")
+    assert res["value"] == 0.2 and res["vs_baseline"] is None
+    # fully-carried record: the ratio is allowed (unit already discloses)
+    res2 = bench._assemble(
+        {"resnet50_train": configs["resnet50_train"]},
+        "TPU v5 lite", 197e12, "table", "bfloat16")
+    assert res2["vs_baseline"] == 24.0
