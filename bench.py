@@ -729,7 +729,9 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
     # (quick mode uses 3-iter smoke shapes; a different compute_dtype is
     # a different measurement)
     mid = None if quick else _load_mid_round()
-    if mid and mid.get("compute_dtype", compute_dtype) != compute_dtype:
+    # an unstamped record is a mismatch too: rows of unknown dtype must
+    # not be presented as this run's compute_dtype
+    if mid and mid.get("compute_dtype") != compute_dtype:
         mid = None
     # backfill scope: only configs this run was asked to measure
     # (respects BENCH_ONLY) — applies to the wholesale fallback below too

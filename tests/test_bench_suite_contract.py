@@ -145,7 +145,7 @@ def test_probe_fail_falls_back_to_mid_round(monkeypatch):
                                       "value": 5.0}},
            "device": "TPU v5 lite", "peak_flops": 197e12,
            "peak_source": "table", "host_to_device_mbps": None,
-           "_source": "BENCH_mid_r04.json"}
+           "compute_dtype": "bfloat16", "_source": "BENCH_mid_r04.json"}
     monkeypatch.setattr(bench, "_probe_device", lambda *a, **k: (None, None))
     monkeypatch.setattr(bench, "_load_mid_round", lambda root=None: mid)
     res = bench.run_suite()
@@ -196,7 +196,7 @@ def test_all_error_mid_record_yields_explicit_error(monkeypatch):
     """A mid record whose rows are ALL errors must not produce a
     success-shaped empty record on probe failure."""
     mid = {"configs": {"bert_train": {"error": "timeout"}},
-           "_source": "BENCH_mid_r04.json"}
+           "compute_dtype": "bfloat16", "_source": "BENCH_mid_r04.json"}
     monkeypatch.setattr(bench, "_probe_device", lambda *a, **k: (None, None))
     monkeypatch.setattr(bench, "_load_mid_round", lambda root=None: mid)
     res = bench.run_suite()
@@ -229,3 +229,14 @@ def test_assemble_live_headline_drops_carried_vs_baseline():
         {"resnet50_train": configs["resnet50_train"]},
         "TPU v5 lite", 197e12, "table", "bfloat16")
     assert res2["vs_baseline"] == 24.0
+
+
+def test_unstamped_mid_record_rejected(monkeypatch):
+    """A mid record with no compute_dtype field is a mismatch: rows of
+    unknown dtype must not be presented as this run's compute_dtype."""
+    mid = {"configs": {"bert_train": {"mfu": 0.5, "mfu_compute_only": 0.5,
+                                      "value": 5.0}},
+           "_source": "BENCH_mid_r04.json"}
+    monkeypatch.setattr(bench, "_probe_device", lambda *a, **k: (None, None))
+    monkeypatch.setattr(bench, "_load_mid_round", lambda root=None: mid)
+    assert "error" in bench.run_suite()

@@ -160,3 +160,59 @@ def test_resnet_train_step_mxu_clean():
             "label": rng.randint(0, 10, (2, 1)).astype(np.int64)}
     bad = _f32_dots(model, feed, min_dots=2)
     assert not bad, f"f32xf32 dots/convs in ResNet train step: {bad}"
+
+
+@pytest.mark.slow
+def test_bert_train_step_mxu_clean():
+    """BERT pretrain step (attention + pooler + fused-CE MLM head +
+    NSP head): the masked-LM gather and the two heads are paths the
+    GPT pin does not cover."""
+    from paddle_tpu.models import bert
+    rng = np.random.RandomState(0)
+    cfg = bert.base_config(vocab_size=128, d_model=64, d_inner=128,
+                           num_heads=4, num_layers=1, max_len=32,
+                           dropout=0.0, use_flash=False, fuse_qkv=True,
+                           fused_ce=True, ce_chunk=64, dtype="bfloat16")
+    ids = rng.randint(3, 128, (2, 16)).astype(np.int32)
+    feed = {
+        "input_ids": ids,
+        "token_type_ids": np.zeros((2, 16), np.int32),
+        "mlm_positions": rng.randint(0, 16, (2, 4)).astype(np.int32),
+        "mlm_labels": rng.randint(0, 128, (2, 4, 1)).astype(np.int64),
+        "nsp_label": rng.randint(0, 2, (2, 1)).astype(np.int64),
+    }
+    bad = _f32_dots(pt.build(bert.make_pretrain_model(cfg)), feed)
+    assert not bad, f"f32xf32 dots in BERT train step: {bad}"
+
+
+@pytest.mark.slow
+def test_lstm_train_step_mxu_clean():
+    """Fused-gate LSTM backward runs through lax.scan: a f32 carry or
+    cotangent upcast would put every per-step gate matmul on the slow
+    MXU path — invisible to the transformer pins."""
+    from paddle_tpu.models import lstm
+    rng = np.random.RandomState(0)
+    model = pt.build(lstm.make_model(vocab_size=64, emb_dim=32,
+                                     hidden_dim=32, num_layers=2))
+    feed = {"word_ids": rng.randint(0, 64, (2, 8)).astype(np.int64),
+            "label": rng.randint(0, 2, (2, 1)).astype(np.int64),
+            "sequence_length": np.full((2,), 8, np.int64)}
+    bad = _f32_dots(model, feed, min_dots=2)
+    assert not bad, f"f32xf32 dots in LSTM train step: {bad}"
+
+
+@pytest.mark.slow
+def test_deepfm_train_step_mxu_clean():
+    """DeepFM: FM pairwise interactions + the DNN tower. The FM part is
+    einsum-heavy and was never covered by the transformer/conv pins."""
+    from paddle_tpu.models import deepfm
+    rng = np.random.RandomState(0)
+    model = pt.build(deepfm.make_model(num_sparse_fields=5,
+                                       sparse_feature_dim=64,
+                                       embedding_size=8, num_dense=4,
+                                       hidden_dims=(16, 16)))
+    feed = {"dense": rng.randn(2, 4).astype(np.float32),
+            "sparse_ids": rng.randint(0, 64, (2, 5)).astype(np.int32),
+            "label": rng.randint(0, 2, (2, 1)).astype(np.int64)}
+    bad = _f32_dots(model, feed, min_dots=2)
+    assert not bad, f"f32xf32 dots in DeepFM train step: {bad}"
