@@ -1282,6 +1282,59 @@ GRAD_INPUTS = {
     "grid_sampler": lambda: rs(50).randn(1, 2, 5, 5).astype(np.float32),
 }
 
+# Second widening pass toward the reference's every-differentiable-op
+# FD discipline (op_test.py:43): elementwise/matmul/shape/reduction/
+# selection ops whose gradients the first pass left to check_output
+# alone. Evaluation points dodge kinks (offsets at ties/boundaries);
+# parameterized layers (prelu, conv*, dynamic_*) stay out — their
+# gradients are exercised end-to-end by the model learning tests.
+GRAD_OPS.update({
+    "elementwise_add": lambda x: L.elementwise_add(x, J(Y1[:2, :3])),
+    "elementwise_sub": lambda x: L.elementwise_sub(x, J(Y1[:2, :3])),
+    "elementwise_mul": lambda x: L.elementwise_mul(x, J(Y1[:2, :3])),
+    "elementwise_min": lambda x: L.elementwise_min(
+        x, J(Y1[:2, :3]) + 0.3),
+    "elementwise_pow": lambda x: L.elementwise_pow(
+        jnp.abs(x) + 0.5, jnp.full((2, 3), 1.3, jnp.float32)),
+    "matmul_op": lambda x: L.matmul(x, J(Y1[:3, :4].T), transpose_y=True),
+    "mul_grad": lambda x: L.mul(x, J(Y1[:3, :2])),
+    "concat_op": lambda x: L.concat([x, J(Y1[:2, :3])], axis=0),
+    "split_op": lambda x: sum(L.split(x, 3, dim=1)),
+    "stack_op": lambda x: L.stack([x, x * 2.0], axis=0),
+    "unstack_op": lambda x: sum(L.unstack(x, axis=0)),
+    "reverse_op": lambda x: L.reverse(x, axis=[1]) * J(Y1[:2, :3]),
+    "transpose_op": lambda x: L.transpose(x, [1, 0]) * J(Y1[:3, :2]),
+    "reshape_op": lambda x: L.reshape(x, [3, 2]) * J(Y1[:3, :2]),
+    "flatten_op": lambda x: L.flatten(x[:, None], axis=1) * J(Y1[:2, :3]),
+    "unsqueeze_op": lambda x: L.unsqueeze(x, axes=[1]) * 1.7,
+    "slice_op": lambda x: L.slice(x, axes=[1], starts=[1], ends=[3]),
+    "pad2d_op": lambda x: L.pad2d(x[None, None], paddings=(1, 0, 2, 1),
+                                  mode="constant", pad_value=0.0),
+    "pad2d_reflect": lambda x: L.pad2d(x[None, None], paddings=(1, 1, 1, 1),
+                                       mode="reflect"),
+    "clip_op": lambda x: L.clip(x * 2.0, min=-0.6, max=0.6),
+    "label_smooth_grad": lambda x: L.label_smooth(
+        jax.nn.softmax(x, axis=-1), epsilon=0.15),
+    "cross_entropy_hard": lambda x: L.cross_entropy(
+        jax.nn.softmax(x, axis=-1), J(np.array([[1], [0]], np.int64))),
+    "log_op": lambda x: L.log(jnp.abs(x) + 0.5),
+    "mean_op": lambda x: L.mean(x),
+    "sum_op": lambda x: L.sum([x, x * 0.5]),
+    "reduce_sum_grad": lambda x: L.reduce_sum(x, dim=1),
+    "reduce_mean_grad": lambda x: L.reduce_mean(x, dim=0, keep_dim=True),
+    "reduce_max_grad": lambda x: L.reduce_max(x, dim=1),
+    "reduce_min_grad": lambda x: L.reduce_min(x, dim=0),
+    "topk_grad": lambda x: L.topk(x, k=2)[0],
+    "scatter_op": lambda x: L.scatter(
+        x, J(np.array([1], np.int32)), J(Y1[:1, :3])),
+    "multiplex_op": lambda x: L.multiplex(
+        [x, x * 3.0], J(np.array([[0], [1]], np.int32))),
+    "sequence_first_step_grad": lambda x: L.sequence_first_step(
+        x.reshape(6, 1), J(np.array([0, 0, 0, 1, 1, 1], np.int32)), 2),
+    "sequence_last_step_grad": lambda x: L.sequence_last_step(
+        x.reshape(6, 1), J(np.array([0, 0, 0, 1, 1, 1], np.int32)), 2),
+})
+
 
 @pytest.mark.parametrize("name", sorted(GRAD_OPS))
 def test_fd_grad(name):
