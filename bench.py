@@ -913,6 +913,13 @@ def _load_mid_round(root=None):
         except (OSError, json.JSONDecodeError):
             continue
         if isinstance(rec, dict) and rec.get("configs"):
+            for k, c in rec["configs"].items():
+                # normalize rows a pre-fix chip_queue stored in raw-
+                # envelope shape ({"result": {...}, "device": ...}) —
+                # the writer migrates too, but this reader is also the
+                # tunnel-down path where the writer never runs
+                if isinstance(c, dict) and isinstance(c.get("result"), dict):
+                    rec["configs"][k] = c["result"]
             rec["_source"] = os.path.basename(path)
             return rec
     return None

@@ -240,3 +240,12 @@ def test_unstamped_mid_record_rejected(monkeypatch):
     monkeypatch.setattr(bench, "_probe_device", lambda *a, **k: (None, None))
     monkeypatch.setattr(bench, "_load_mid_round", lambda root=None: mid)
     assert "error" in bench.run_suite()
+
+
+def test_load_mid_round_normalizes_envelope_rows(tmp_path):
+    import json
+    (tmp_path / "BENCH_mid_r04.json").write_text(json.dumps(
+        {"configs": {"bert_train": {"result": {"mfu": 0.4, "value": 7.0},
+                                    "device": "TPU v5 lite"}}}))
+    rec = bench._load_mid_round(root=str(tmp_path))
+    assert rec["configs"]["bert_train"] == {"mfu": 0.4, "value": 7.0}
