@@ -72,3 +72,18 @@ def check_grad(fn: Callable, inputs: Sequence[np.ndarray], wrt: int = 0,
     analytic = np.asarray(jax.grad(loss)(jinputs[wrt]))
     numeric = numeric_grad(fn, inputs, wrt, eps)
     np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol)
+
+
+# Shared StableHLO scraper for the lowering-level dtype pins
+# (test_mxu_dtypes, test_int8_serving, test_flash_attention): one copy,
+# so an MLIR printer format change is fixed in one place. Returns
+# (op_kind, lhs_type, rhs_type, out_type) tuples.
+import re as _re
+
+STABLEHLO_DOT_RE = _re.compile(
+    r'(dot_general|convolution)[^\n]*:\s*\(tensor<([^>]+)>,\s*'
+    r'tensor<([^>]+)>\)\s*->\s*tensor<([^>]+)>')
+
+
+def find_dots(stablehlo_text: str):
+    return [m.groups() for m in STABLEHLO_DOT_RE.finditer(stablehlo_text)]

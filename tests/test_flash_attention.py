@@ -274,8 +274,7 @@ def test_scores_mxu_bf16_grads_close_to_f32():
 def test_dense_attention_backward_has_no_f32_dots():
     """Regression pin for the MXU-rate bug the custom VJP fixes: a bf16
     SDPA train step must lower with every dot's inputs in bf16."""
-    import re
-
+    from op_test import find_dots
     from paddle_tpu.layers.attention import scaled_dot_product_attention
 
     q, k, v = _rand(b=2, h=2, s=32, d=16)
@@ -285,8 +284,7 @@ def test_dense_attention_backward_has_no_f32_dots():
         return jnp.sum(scaled_dot_product_attention(q, k, v, causal=True) ** 2)
 
     txt = jax.jit(jax.grad(loss, (0, 1, 2))).lower(qb, kb, vb).as_text()
-    pat = re.compile(r'dot_general[^\n]*:\s*\(tensor<([^>]+)>,\s*tensor<([^>]+)>\)')
-    dots = [m.groups() for m in pat.finditer(txt)]
+    dots = [d[1:3] for d in find_dots(txt) if d[0] == "dot_general"]
     assert len(dots) >= 4, f"regex no longer matches dot_general ops: {len(dots)}"
     bad = [d for d in dots if d[0].endswith('f32') and d[1].endswith('f32')]
     assert not bad, f"f32xf32 dots in attention backward: {bad}"

@@ -13,8 +13,6 @@ op_test harness (op_test.py:43); XLA owns our kernels, so the pin
 moves to the lowered HLO.
 """
 
-import re
-
 import jax
 import numpy as np
 import pytest
@@ -22,9 +20,7 @@ import pytest
 import paddle_tpu as pt
 from paddle_tpu.core.config import set_flag
 
-_DOT = re.compile(
-    r'(dot_general|convolution)[^\n]*:\s*\(tensor<([^>]+)>,\s*tensor<([^>]+)>\)'
-    r'\s*->\s*tensor<([^>]+)>')
+from op_test import find_dots
 
 
 def _f32_dots(model, feed, min_dots=4, allow_trailing=()):
@@ -42,7 +38,7 @@ def _f32_dots(model, feed, min_dots=4, allow_trailing=()):
         return out["loss"]
 
     txt = jax.jit(jax.grad(loss_fn)).lower(p, s, feed).as_text()
-    dots = [m.groups()[1:] for m in _DOT.finditer(txt)]
+    dots = [d[1:] for d in find_dots(txt)]
     assert len(dots) >= min_dots, f"HLO regex matched too few dots: {len(dots)}"
 
     def gating(dot):
