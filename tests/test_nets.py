@@ -1,0 +1,102 @@
+"""Numeric tests for the composite nets (paddle_tpu/nets.py —
+python/paddle/fluid/nets.py analog): each helper against a hand
+composition or closed-form reference.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L, nets
+
+
+def _run(fn, **feed):
+    prog = pt.build(fn)
+    params, state = prog.init(jax.random.PRNGKey(0), **feed)
+    out, _ = prog.apply(params, state, training=False, **feed)
+    return out, params
+
+
+def test_glu_closed_form():
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    got = np.asarray(nets.glu(jnp.asarray(x), dim=-1))
+    a, b = x[:, :3], x[:, 3:]
+    np.testing.assert_allclose(got, a / (1 + np.exp(-b)), rtol=1e-5, atol=1e-6)
+
+
+def test_simple_img_conv_pool_equals_manual_composition():
+    rng = np.random.RandomState(1)
+    img = rng.randn(2, 3, 8, 8).astype(np.float32)
+
+    def net(image):
+        return {"y": nets.simple_img_conv_pool(image, num_filters=4,
+                                               filter_size=3, pool_size=2,
+                                               pool_stride=2, act="relu")}
+
+    def manual(image):
+        h = L.conv2d(image, 4, 3, act="relu")
+        return {"y": L.pool2d(h, pool_size=2, pool_type="max", pool_stride=2)}
+
+    got, p1 = _run(net, image=img)
+    want, p2 = _run(manual, image=img)
+    assert sorted(v.shape for v in p1.values()) == \
+        sorted(v.shape for v in p2.values())
+    # same parameter shapes + same init seed => identical outputs
+    np.testing.assert_allclose(np.asarray(got["y"]), np.asarray(want["y"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_img_conv_group_shapes_and_bn_branch():
+    rng = np.random.RandomState(2)
+    img = rng.randn(2, 3, 8, 8).astype(np.float32)
+
+    def net(image):
+        return {"y": nets.img_conv_group(image, conv_num_filter=(4, 4),
+                                         pool_size=2, pool_stride=2,
+                                         conv_with_batchnorm=True)}
+
+    got, params = _run(net, image=img)
+    assert got["y"].shape == (2, 4, 4, 4)
+    # two convs and two BN scale/bias sets were created
+    assert sum("conv2d" in k for k in params) >= 2
+    assert sum("batch_norm" in k for k in params) >= 2
+
+
+def test_sequence_conv_pool_masks_padding():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 5, 6).astype(np.float32)
+    lengths = np.array([3, 5], np.int32)
+
+    def net(x, lengths):
+        return {"y": nets.sequence_conv_pool(x, lengths, num_filters=4,
+                                             filter_size=3, pool_type="max")}
+
+    got, _ = _run(net, x=x, lengths=lengths)
+    # poison the part of sequence 0's padded tail that no VALID output
+    # position can see: with a width-3 same-pad window, valid positions
+    # 0..2 read x[0..3], so x[4] only feeds masked positions 3..4 — a
+    # working mask must leave BOTH rows of the pooled output unchanged
+    x2 = x.copy()
+    x2[0, 4:] = 100.0
+    got2, _ = _run(net, x=x2, lengths=lengths)
+    np.testing.assert_allclose(np.asarray(got["y"]),
+                               np.asarray(got2["y"]), rtol=1e-5)
+
+
+def test_nets_sdpa_matches_layer_sdpa():
+    from paddle_tpu.layers.attention import scaled_dot_product_attention
+    rng = np.random.RandomState(4)
+    q = rng.randn(2, 5, 8).astype(np.float32)
+    k = rng.randn(2, 7, 8).astype(np.float32)
+    v = rng.randn(2, 7, 8).astype(np.float32)
+    got = np.asarray(nets.scaled_dot_product_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), num_heads=2))
+    qh = q.reshape(2, 5, 2, 4).transpose(0, 2, 1, 3)
+    kh = k.reshape(2, 7, 2, 4).transpose(0, 2, 1, 3)
+    vh = v.reshape(2, 7, 2, 4).transpose(0, 2, 1, 3)
+    want = np.asarray(scaled_dot_product_attention(
+        jnp.asarray(qh), jnp.asarray(kh), jnp.asarray(vh)))
+    want = want.transpose(0, 2, 1, 3).reshape(2, 5, 8)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
