@@ -457,6 +457,39 @@ def bench_lstm_big(peak, batch_size=256, iters=10):
                       baseline_key="lstm_big")
 
 
+def bench_seq2seq(peak, batch_size=128, seq=30, emb_dim=512, hidden=512,
+                  vocab=30000, iters=20):
+    """GRU seq2seq with additive attention — the benchmark/fluid
+    machine_translation model (WMT16-ish dims: vocab 30k, hidden 512,
+    ~30-token sentences). Completes the reference benchmark-matrix
+    parity: mnist/resnet/se_resnext/vgg/lstm rows all exist, this was
+    the remaining model family."""
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.core import flops
+    from paddle_tpu.models import seq2seq
+
+    model = pt.build(seq2seq.make_model(src_vocab=vocab, trg_vocab=vocab,
+                                        emb_dim=emb_dim, hidden=hidden))
+    rng = np.random.RandomState(0)
+    feeds = []
+    for _ in range(4):
+        src = rng.randint(3, vocab, (batch_size, seq)).astype(np.int64)
+        trg = np.zeros_like(src)
+        trg[:, 0] = 1
+        trg[:, 1:] = src[:, :-1]
+        labels = np.concatenate([trg[:, 1:], np.full((batch_size, 1), 2)],
+                                axis=1).astype(np.int64)
+        feeds.append({"src_ids": src, "trg_ids": trg, "labels": labels,
+                      "src_lengths": np.full((batch_size,), seq, np.int64)})
+    trainer = pt.Trainer(model, opt.Adam(1e-3), loss_name="loss",
+                         fetch_list=["loss"])
+    trainer.startup(sample_feed=feeds[0])
+    dt_pipe, dt_comp = _time_trainer(trainer, feeds, iters=iters)
+    f = flops.seq2seq_train_flops(batch_size, seq, seq, emb_dim, hidden, vocab)
+    return _result(batch_size * seq, "tokens/sec", dt_pipe, dt_comp, f, peak)
+
+
 # -- inference configs -------------------------------------------------------
 
 
@@ -590,6 +623,7 @@ TRAIN_CONFIGS = {
     "se_resnext": bench_se_resnext,
     "lstm": bench_lstm,
     "lstm_big": bench_lstm_big,
+    "seq2seq": bench_seq2seq,
     "transformer": bench_transformer,
     "transformer_long": bench_transformer_long,
     "bert": bench_bert,

@@ -315,6 +315,31 @@ def lstm_train_flops(bs: int, seq: int, hidden: int, num_layers: int,
     return f
 
 
+def seq2seq_train_flops(bs: int, src_len: int, trg_len: int, emb_dim: int,
+                        hidden: int, trg_vocab: int) -> float:
+    """GRU seq2seq with additive attention (models/seq2seq.py — the
+    book machine-translation model; benchmark/fluid machine_translation
+    analog). Counts the gate/attention/output matmuls at the train
+    factor 6 (fwd + 2x bwd); embedding gathers, softmaxes, and
+    elementwise attention math are excluded (undercounts, never
+    inflates)."""
+    f = 0.0
+    # bi-GRU encoder: 2 directions x 3 gates x h x (emb + h) per token
+    f += 2 * 6.0 * (3 * hidden * (emb_dim + hidden)) * bs * src_len
+    # encoder attention projection [2h -> h] per source token
+    f += 6.0 * (2 * hidden * hidden) * bs * src_len
+    # decoder per target step: query proj [h->h], score dot [s x h],
+    # context einsum [s x 2h], GRU x-proj [(emb+2h) -> 3h], h-proj
+    f += 6.0 * (hidden * hidden) * bs * trg_len
+    f += 6.0 * (src_len * hidden) * bs * trg_len
+    f += 6.0 * (src_len * 2 * hidden) * bs * trg_len
+    f += 6.0 * (3 * hidden * (emb_dim + 2 * hidden)) * bs * trg_len
+    f += 6.0 * (3 * hidden * hidden) * bs * trg_len
+    # output projection [h -> V]
+    f += 6.0 * (hidden * trg_vocab) * bs * trg_len
+    return f
+
+
 def deepfm_train_flops(bs: int, num_fields: int, emb_size: int, num_dense: int,
                        hidden_dims: Sequence[int]) -> float:
     """MLP tower + linear heads; embedding gathers/FM interactions are

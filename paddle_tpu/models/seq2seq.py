@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import layers as L
-from ..framework import LayerHelper
+from ..framework import LayerHelper, cast_compute
 from ..layers.rnn import dynamic_gru, gru_cell_step
 from .. import initializer as init
 
@@ -59,23 +59,31 @@ def _forward(src_ids, trg_ids, src_lengths, src_vocab, trg_vocab, emb_dim,
     w_out = helper.create_parameter("dec_out/w", (hidden, trg_vocab), jnp.float32,
                                     initializer=init.Xavier())
 
-    h0 = jnp.tanh(L.fc(jnp.concatenate([fwd[:, -1], bwd[:, 0]], axis=-1),
-                       hidden, name="init_state"))
+    # compute-dtype carry: gru_cell_step returns the compute dtype, so
+    # the scan carry must start there too
+    h0 = cast_compute(jnp.tanh(L.fc(jnp.concatenate([fwd[:, -1], bwd[:, 0]],
+                                                    axis=-1),
+                                    hidden, name="init_state")))
 
     def cell(h, x_t, enc_t, enc_att_t, mask_t):
         """One decoder step: additive attention over ``enc_t`` + GRU.
         Takes the encoder tensors explicitly so generation can tile
-        them per beam."""
-        q = jnp.matmul(h, w_att_dec)[:, None, :]                 # [r,1,h]
-        e = jnp.matmul(jnp.tanh(enc_att_t + q), v_att)[..., 0]   # [r,s]
-        e = jnp.where(mask_t, e, -1e9)
-        a = jax.nn.softmax(e, axis=-1)
+        them per beam. Every matmul runs in the ambient compute dtype
+        (the f32 weights would otherwise promote the bf16 scan carry
+        and put the gate/attention dots on the slow f32 MXU path);
+        attention scores soft-max in f32."""
+        h, x_t, enc_t, enc_att_t, wad, va, wx, bg = cast_compute(
+            h, x_t, enc_t, enc_att_t, w_att_dec, v_att, w_x, b_g)
+        q = jnp.matmul(h, wad)[:, None, :]                       # [r,1,h]
+        e = jnp.matmul(jnp.tanh(enc_att_t + q), va)[..., 0]      # [r,s]
+        e = jnp.where(mask_t, e.astype(jnp.float32), -1e9)
+        a = jax.nn.softmax(e, axis=-1).astype(enc_t.dtype)
         ctx = jnp.einsum("bs,bsd->bd", a, enc_t)                 # [r,2h]
         inp = jnp.concatenate([x_t, ctx], axis=-1)
-        x_proj = jnp.matmul(inp, w_x) + b_g
-        return gru_cell_step(x_proj, h, w_h)
+        x_proj = jnp.matmul(inp, wx) + bg
+        return gru_cell_step(x_proj, h, cast_compute(w_h))
 
-    enc_att = jnp.matmul(enc, w_att_enc)  # precompute [b, s, h]
+    enc_att = jnp.matmul(enc, cast_compute(w_att_enc))  # precompute [b, s, h]
 
     def step(h, x_t):
         h_new = cell(h, x_t, enc, enc_att, src_mask)
@@ -85,7 +93,7 @@ def _forward(src_ids, trg_ids, src_lengths, src_vocab, trg_vocab, emb_dim,
     xs = jnp.swapaxes(trg_emb, 0, 1)
     _, hs = jax.lax.scan(step, h0, xs)
     hs = jnp.swapaxes(hs, 0, 1)  # [b, t, h]
-    logits = jnp.matmul(hs, w_out)
+    logits = jnp.matmul(hs, cast_compute(w_out))
     aux = {"cell": cell, "enc": enc, "enc_att": enc_att,
            "src_mask": src_mask, "h0": h0, "trg_table": trg_table,
            "w_out": w_out}
@@ -136,7 +144,9 @@ def make_decoder(src_vocab=2000, trg_vocab=2000, emb_dim=128, hidden=256,
         def step_fn(tokens, h):
             x_t = jnp.take(table, tokens, axis=0)
             h_new = cell(h, x_t, enc, enc_att, mask)
-            logits = jnp.matmul(h_new, w_out).astype(jnp.float32)
+            # compute-dtype head (mirrors the train program): the
+            # [r,h]x[h,V] dot is the largest matmul per decode step
+            logits = jnp.matmul(h_new, cast_compute(w_out)).astype(jnp.float32)
             return jax.nn.log_softmax(logits, axis=-1), h_new
 
         seqs, scores = beam_search(step_fn, h0, batch_size=b, beam_size=K,

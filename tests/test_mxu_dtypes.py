@@ -212,3 +212,23 @@ def test_deepfm_train_step_mxu_clean():
             "label": rng.randint(0, 2, (2, 1)).astype(np.int64)}
     bad = _f32_dots(model, feed, min_dots=2)
     assert not bad, f"f32xf32 dots in DeepFM train step: {bad}"
+
+
+@pytest.mark.slow
+def test_seq2seq_train_step_mxu_clean():
+    """GRU seq2seq with additive attention (the machine-translation
+    bench config): the hand-rolled decoder scan cell casts its own
+    weights, a path no other pin exercises. The attention-score
+    softmax runs f32 by design but feeds no f32 dot (the cast-back
+    sits between it and every matmul), so no whitelist is needed."""
+    from paddle_tpu.models import seq2seq
+    rng = np.random.RandomState(0)
+    model = pt.build(seq2seq.make_model(src_vocab=64, trg_vocab=64,
+                                        emb_dim=16, hidden=16))
+    src = rng.randint(3, 64, (2, 6)).astype(np.int64)
+    trg = np.zeros_like(src); trg[:, 0] = 1; trg[:, 1:] = src[:, :-1]
+    labels = np.concatenate([trg[:, 1:], np.full((2, 1), 2)], 1).astype(np.int64)
+    feed = {"src_ids": src, "trg_ids": trg, "labels": labels,
+            "src_lengths": np.full((2,), 6, np.int64)}
+    bad = _f32_dots(model, feed, min_dots=2)
+    assert not bad, f"f32xf32 dots in seq2seq train step: {bad}"
