@@ -38,6 +38,7 @@ QUEUE = [
     ("resnet50_infer_int8", "resnet50_infer_int8", {}),
     ("resnet50_infer_fp32", "resnet50_infer_fp32", {}),
     ("gpt_train", "gpt", {}),
+    ("seq2seq_train", "seq2seq", {}),
     ("vgg16_train", "vgg16", {}),
     ("googlenet_train", "googlenet", {}),
     ("alexnet_train", "alexnet", {}),
