@@ -271,10 +271,16 @@ def _bench_transformer_config(peak, batch_size, seq, dtype, dropout,
     use_flash = os.environ.get("BENCH_USE_FLASH", "1") != "0"
     if fuse_qkv is None:
         fuse_qkv = os.environ.get("BENCH_FUSE_QKV", "1") != "0"
+    # BENCH_STACKED=1: scan-compiled stacked blocks (one traced layer
+    # body; per-layer dropout via rng_fold) — identical math, ~L x less
+    # code to compile. A/B knob until the on-chip compile-time and
+    # step-time deltas are measured.
+    stacked = os.environ.get("BENCH_STACKED", "0") == "1"
     cfg = transformer.base_config(src_vocab=32000, trg_vocab=32000,
                                   dropout=dropout, max_len=max_len,
                                   dtype=dtype, use_flash=use_flash,
-                                  fused_ce=True, fuse_qkv=fuse_qkv)
+                                  fused_ce=True, fuse_qkv=fuse_qkv,
+                                  stacked=stacked)
     model = pt.build(transformer.make_model(cfg))
     rng = np.random.RandomState(0)
     feeds = [{

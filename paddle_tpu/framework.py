@@ -244,6 +244,30 @@ def next_rng_key() -> jax.Array:
     return _ctx().next_rng_key()
 
 
+@contextlib.contextmanager
+def rng_fold(tag):
+    """Fold ``tag`` (python int or traced int32) into the ambient rng
+    stream for the duration of the block.
+
+    The per-call counter in :meth:`BuildContext.next_rng_key` is a
+    PYTHON int fixed at trace time, so a body traced once and executed
+    many times — a ``lax.scan`` over stacked layers — would hand every
+    iteration the same dropout keys. Wrapping each iteration in
+    ``rng_fold(layer_index)`` decorrelates them (fold_in accepts traced
+    operands). No-op when no build context / rng is active, so pure
+    inference paths need no guard."""
+    ctx = current_context()
+    if ctx is None or ctx.rng is None:
+        yield
+        return
+    old = ctx.rng
+    ctx.rng = jax.random.fold_in(old, tag)
+    try:
+        yield
+    finally:
+        ctx.rng = old
+
+
 # --------------------------------------------------------------------------
 # Parameter / variable creation — the LayerHelper primitives
 # --------------------------------------------------------------------------

@@ -44,7 +44,10 @@ def scaled_dot_product_attention(
     """
     if use_flash is None:
         use_flash = False
-    if use_flash and dropout_rate == 0.0:
+    # the flash kernel has no dropout, but dropout is a no-op outside
+    # training — eval/serving traces of a dropout>0 model keep the
+    # kernel instead of paying the dense O(s^2) path
+    if use_flash and (dropout_rate == 0.0 or not in_training()):
         from ..ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, attn_mask=attn_mask)
 

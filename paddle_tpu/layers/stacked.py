@@ -18,9 +18,14 @@ params). The stack is applied either
 
 Blocks are pure functions of ``(activation, layer_params, extra)`` — no
 LayerHelper calls inside, so they trace safely under scan and shard_map.
-Dropout is intentionally unsupported inside stacked blocks (a scan-traced
-RNG fold-in would reuse one key across layers); stacked configs train
-with dropout 0, as the long-context/pp configs do anyway.
+Dropout IS supported on the scan path: the naive scan-traced rng would
+reuse one key across every layer (the per-call counter is a Python int
+fixed at trace time), so ``apply_stacked`` folds the traced layer index
+into the ambient rng stream per iteration (:func:`framework.rng_fold`),
+giving each layer independent masks at the same four sites as the
+unrolled transformer layer (attention softmax, two residuals, ffn
+inner). The pipeline path still requires dropout 0 (cross-stage rng
+threading is not wired).
 """
 
 from __future__ import annotations
@@ -33,8 +38,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..core.errors import enforce
-from ..framework import (LayerHelper, cast_compute, maybe_remat,
-                         pipeline_config, sp_config)
+from ..framework import (LayerHelper, cast_compute, in_training as _in_training,
+                         maybe_remat, pipeline_config, rng_fold, sp_config)
 from .. import initializer as init
 
 NEG_INF = -1e9
@@ -60,7 +65,17 @@ def _ln(x, scale, bias, eps: float = 1e-5):
     return out * scale + bias
 
 
-def _sdpa(q, k, v, key_bias, causal: bool, use_flash: bool, sp_cfg=None):
+def _drop(x, rate: float):
+    """Residual/inner dropout (upscale_in_train, matching the unrolled
+    transformer layer); no-op at rate 0 or outside training."""
+    if rate == 0.0:
+        return x
+    from .nn import dropout
+    return dropout(x, rate, dropout_implementation="upscale_in_train")
+
+
+def _sdpa(q, k, v, key_bias, causal: bool, use_flash: bool, sp_cfg=None,
+          dropout_rate: float = 0.0):
     """[b,h,s,hd] attention with an additive [b,s_k] key bias. With an
     active sequence-parallel context, self-attention runs as ring
     attention over the mesh's sp axis. The layout comes from the sp
@@ -71,6 +86,9 @@ def _sdpa(q, k, v, key_bias, causal: bool, use_flash: bool, sp_cfg=None):
         enforce(key_bias is None,
                 "sequence-parallel attention does not take a padding bias "
                 "(pack full sequences; pad-free is the long-context contract)")
+        enforce(dropout_rate == 0.0 or not _in_training(),
+                "sequence-parallel attention has no softmax-dropout site "
+                "(ring/ulysses kernels); train sp stacks with dropout 0")
         if sp_cfg.get("impl", "ring") == "ulysses":
             from ..parallel.ulysses import ulysses_attention
 
@@ -90,7 +108,11 @@ def _sdpa(q, k, v, key_bias, causal: bool, use_flash: bool, sp_cfg=None):
                               schedule="zigzag" if (causal and layout == "zigzag")
                               else "auto",
                               layout=layout)
-    if use_flash:
+    if use_flash and (dropout_rate == 0.0 or not _in_training()):
+        # same gate as layers/attention.py: the flash kernel has no
+        # dropout; rate > 0 falls to the dense path with softmax dropout
+        # during training, while eval/serving traces (dropout no-op)
+        # keep the kernel
         from ..ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, key_bias=key_bias)
     from ..ops.attention_scores import scores_mxu
@@ -103,6 +125,7 @@ def _sdpa(q, k, v, key_bias, causal: bool, use_flash: bool, sp_cfg=None):
         cm = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
         logits = jnp.where(cm, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    probs = _drop(probs, dropout_rate)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
@@ -178,35 +201,41 @@ def decoder_stack_params(num_layers: int, d_model: int, d_inner: int,
 
 
 def _self_attention(x, p, num_heads, causal, use_flash, key_bias, tp_axis,
-                    sp_cfg=None):
+                    sp_cfg=None, dropout_rate: float = 0.0):
     q, k, v = _attn_qkv(x, p, num_heads)
-    return _attn_out(x, p, _sdpa(q, k, v, key_bias, causal, use_flash, sp_cfg),
-                     tp_axis)
+    return _attn_out(x, p, _sdpa(q, k, v, key_bias, causal, use_flash, sp_cfg,
+                                 dropout_rate=dropout_rate),
+                     tp_axis, dropout_rate=dropout_rate)
 
 
-def _ffn(x, p, tp_axis):
+def _ffn(x, p, tp_axis, dropout_rate: float = 0.0):
     h = _ln(x, p["ln2/scale"], p["ln2/bias"])
     h, w1, w2 = cast_compute(h, p["ffn_in/w"], p["ffn_out/w"])
     h = jax.nn.relu(jnp.matmul(h, w1) + p["ffn_in/b"].astype(h.dtype))
+    h = _drop(h, dropout_rate)
     h = jnp.matmul(h, w2)
     if tp_axis:
         h = jax.lax.psum(h, tp_axis)
-    return x + h + p["ffn_out/b"].astype(h.dtype)
+    return x + _drop(h + p["ffn_out/b"].astype(h.dtype), dropout_rate)
 
 
 def make_encoder_block(num_heads: int, use_flash: bool = False,
                        causal: bool = False,
                        tp_axis: Optional[str] = None,
-                       sp_cfg: Optional[dict] = None) -> Callable:
+                       sp_cfg: Optional[dict] = None,
+                       dropout_rate: float = 0.0) -> Callable:
     """layer_fn(x, layer_params, key_bias) for pipeline_apply/scan. When
     ``tp_axis`` is set, attention/ffn heads are tp-local and the output
     projections psum partial sums (Megatron pattern inside a stage).
-    ``sp_cfg`` routes self-attention through zigzag ring attention."""
+    ``sp_cfg`` routes self-attention through zigzag ring attention.
+    ``dropout_rate`` mirrors the unrolled layer's four dropout sites;
+    the scan path decorrelates layers via rng_fold (see module doc)."""
 
     def block(x, p, key_bias=None):
         x = _self_attention(x, p, num_heads, causal, use_flash,
-                            key_bias, tp_axis, sp_cfg)
-        return _ffn(x, p, tp_axis)
+                            key_bias, tp_axis, sp_cfg,
+                            dropout_rate=dropout_rate)
+        return _ffn(x, p, tp_axis, dropout_rate=dropout_rate)
 
     return block
 
@@ -214,7 +243,8 @@ def make_encoder_block(num_heads: int, use_flash: bool = False,
 def make_decoder_block(num_heads: int, use_flash: bool = False,
                        causal: bool = True,
                        tp_axis: Optional[str] = None,
-                       sp_cfg: Optional[dict] = None) -> Callable:
+                       sp_cfg: Optional[dict] = None,
+                       dropout_rate: float = 0.0) -> Callable:
     """layer_fn(x, layer_params, extra) with extra = {"enc": encoder
     output [b,s,d], "enc_bias": additive [b,s] padding bias}. Causal
     self-attention + cross attention + FFN."""
@@ -225,20 +255,22 @@ def make_decoder_block(num_heads: int, use_flash: bool = False,
 
     def block(x, p, extra):
         head_dim = x.shape[-1] // num_heads
-        x = _self_attention(x, p, num_heads, causal, use_flash, None, tp_axis)
+        x = _self_attention(x, p, num_heads, causal, use_flash, None, tp_axis,
+                            dropout_rate=dropout_rate)
         h = _ln(x, p["lnx/scale"], p["lnx/bias"])
         h, wq, wkv, enc = cast_compute(h, p["xq/w"], p["xkv/w"], extra["enc"])
         q = jnp.matmul(h, wq) + p["xq/b"].astype(h.dtype)
         kv = jnp.einsum("bsd,dke->bske", enc, wkv) + p["xkv/b"].astype(h.dtype)
         q = _split_heads(q, head_dim)
         k, v = (_split_heads(kv[:, :, i], head_dim) for i in range(2))
-        o = _merge_heads(_sdpa(q, k, v, extra.get("enc_bias"), False, use_flash))
+        o = _merge_heads(_sdpa(q, k, v, extra.get("enc_bias"), False, use_flash,
+                               dropout_rate=dropout_rate))
         o, ow = cast_compute(o, p["xout/w"])
         o = jnp.matmul(o, ow)
         if tp_axis:
             o = jax.lax.psum(o, tp_axis)
-        x = x + o + p["xout/b"].astype(o.dtype)
-        return _ffn(x, p, tp_axis)
+        x = x + _drop(o + p["xout/b"].astype(o.dtype), dropout_rate)
+        return _ffn(x, p, tp_axis, dropout_rate=dropout_rate)
 
     return block
 
@@ -254,12 +286,12 @@ def _attn_qkv(x, p, num_heads):
     return tuple(_split_heads(qkv[:, :, i], head_dim) for i in range(3))
 
 
-def _attn_out(x, p, o, tp_axis=None):
+def _attn_out(x, p, o, tp_axis=None, dropout_rate: float = 0.0):
     o, ow = cast_compute(_merge_heads(o), p["out/w"])
     o = jnp.matmul(o, ow)
     if tp_axis:
         o = jax.lax.psum(o, tp_axis)
-    return x + o + p["out/b"].astype(o.dtype)
+    return x + _drop(o + p["out/b"].astype(o.dtype), dropout_rate)
 
 
 def prefill_block(x, p, num_heads: int, use_flash: bool = False):
@@ -319,7 +351,8 @@ def stack_tp_specs(stacked: Dict[str, Any]) -> Dict[str, Any]:
 
 def apply_stacked(x, stacked: Dict[str, jax.Array], make_block: Callable,
                   extras=None, num_heads: int = 8, use_flash: bool = False,
-                  causal: bool = False, remat: bool = False):
+                  causal: bool = False, remat: bool = False,
+                  dropout_rate: float = 0.0):
     """Run a parameter stack over ``x``: pipelined across the ``pp`` mesh
     axis when the Trainer has entered :func:`framework.pipeline_mode`
     (DistStrategy.pp_microbatches — the BuildStrategy-knob analog),
@@ -337,18 +370,31 @@ def apply_stacked(x, stacked: Dict[str, jax.Array], make_block: Callable,
             "(ring attention's shard_map cannot nest inside the pipeline's)")
     if cfg is None:
         block = make_block(num_heads=num_heads, use_flash=use_flash,
-                           causal=causal, tp_axis=None, sp_cfg=sp)
+                           causal=causal, tp_axis=None, sp_cfg=sp,
+                           dropout_rate=dropout_rate)
+        num_layers = next(iter(stacked.values())).shape[0]
 
-        def scan_body(a, lp):
-            fn = (lambda a_, lp_: block(a_, lp_, extras)) if extras is not None \
-                else (lambda a_, lp_: block(a_, lp_))
+        def scan_body(a, xs):
+            lp, idx = xs
+
+            def fn(a_, lp_):
+                # per-layer rng: the traced layer index folds into the
+                # ambient stream so dropout masks decorrelate across
+                # scan iterations (the body is traced ONCE)
+                with rng_fold(idx):
+                    return block(a_, lp_, extras) if extras is not None \
+                        else block(a_, lp_)
             # remat=True forces per-layer checkpointing (cfg.remat);
             # False defers to the ambient strategy.remat switch
             return maybe_remat(fn, enabled=remat or None)(a, lp), None
-        out, _ = jax.lax.scan(scan_body, x, stacked)
+        out, _ = jax.lax.scan(scan_body, x,
+                              (stacked, jnp.arange(num_layers)))
         return out
 
     from ..parallel.pipeline import pipeline_apply
+    enforce(dropout_rate == 0.0,
+            "pipelined stacks require dropout 0 (cross-stage rng "
+            "threading is not wired); the scan path supports dropout")
     mesh = cfg["mesh"]
     tp = "tp" if ("tp" in mesh.axis_names and mesh.shape["tp"] > 1) else None
     if tp:

@@ -45,6 +45,10 @@ class GPTConfig:
     fused_ce: bool = True
     ce_chunk: int = 4096
     remat: bool = False
+    # residual/softmax/ffn dropout inside the stacked blocks (per-layer
+    # rng via framework.rng_fold; rate > 0 disables the flash kernel the
+    # same way the unrolled attention layer does)
+    dropout: float = 0.0
     dtype: str = "float32"
 
 
@@ -94,7 +98,8 @@ def make_model(cfg: GPTConfig):
             x = S.apply_stacked(x, stack, S.make_encoder_block,
                                 num_heads=cfg.num_heads,
                                 use_flash=cfg.use_flash, causal=True,
-                                remat=cfg.remat)
+                                remat=cfg.remat,
+                                dropout_rate=cfg.dropout)
             x = L.layer_norm(x, begin_norm_axis=2)
 
         loss, token_count = lm_head_loss(x, labels, cfg.vocab_size, dtype,

@@ -48,8 +48,10 @@ class TransformerConfig:
     remat: bool = False
     # stacked-block representation (layers.stacked): per-layer params on
     # a leading [L, ...] axis — required for pipeline parallelism
-    # (DistStrategy.pp_microbatches) and scan-compiled on a single chip.
-    # Needs dropout == 0 (see layers/stacked.py docstring).
+    # (DistStrategy.pp_microbatches) and scan-compiled on a single chip
+    # (one traced layer body instead of L unrolled copies: ~L x faster
+    # compiles). Dropout works on the scan path (per-layer rng_fold);
+    # the pipeline path still needs dropout == 0.
     stacked: bool = False
     dtype: str = "float32"
 
@@ -99,13 +101,6 @@ def decoder_layer(x, enc_out, cfg: TransformerConfig, self_mask, cross_mask,
     return (x, cache) if cache is not None else x
 
 
-def _check_stacked(cfg):
-    from ..core.errors import enforce
-    enforce(cfg.dropout == 0.0,
-            "cfg.stacked requires dropout == 0 (stacked blocks are pure "
-            "functions; see layers/stacked.py)")
-
-
 def encode(src_ids, cfg: TransformerConfig):
     dtype = jnp.dtype(cfg.dtype)
     x = _embed(src_ids, cfg.src_vocab, cfg.d_model, dtype, "src")
@@ -114,14 +109,14 @@ def encode(src_ids, cfg: TransformerConfig):
     mask = A.padding_mask(src_ids)
     with name_scope("encoder"):
         if cfg.stacked:
-            _check_stacked(cfg)
             from ..layers import stacked as S
             stack = S.encoder_stack_params(cfg.num_encoder_layers,
                                            cfg.d_model, cfg.d_inner)
             key_bias = mask[:, 0, 0, :]  # additive [b, s]
             x = S.apply_stacked(x, stack, S.make_encoder_block,
                                 extras=key_bias, num_heads=cfg.num_heads,
-                                use_flash=cfg.use_flash, remat=cfg.remat)
+                                use_flash=cfg.use_flash, remat=cfg.remat,
+                                dropout_rate=cfg.dropout)
         else:
             for _ in range(cfg.num_encoder_layers):
                 # fresh wrapper per layer: jax.checkpoint caches the traced
@@ -142,7 +137,6 @@ def decode_hidden(trg_ids, enc_out, cross_mask, cfg: TransformerConfig):
     x = L.dropout(x, cfg.dropout, dropout_implementation="upscale_in_train")
     with name_scope("decoder"):
         if cfg.stacked:
-            _check_stacked(cfg)
             from ..layers import stacked as S
             stack = S.decoder_stack_params(cfg.num_decoder_layers,
                                            cfg.d_model, cfg.d_inner)
@@ -150,7 +144,7 @@ def decode_hidden(trg_ids, enc_out, cross_mask, cfg: TransformerConfig):
             x = S.apply_stacked(x, stack, S.make_decoder_block,
                                 extras=extras, num_heads=cfg.num_heads,
                                 use_flash=cfg.use_flash, causal=True,
-                                remat=cfg.remat)
+                                remat=cfg.remat, dropout_rate=cfg.dropout)
         else:
             for _ in range(cfg.num_decoder_layers):
                 x = maybe_remat(lambda a, e, cm: decoder_layer(a, e, cfg, None, cm),

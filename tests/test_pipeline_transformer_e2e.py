@@ -298,12 +298,72 @@ def test_pipeline_trained_model_eval_and_reshape_restore(tmp_path):
     assert np.isfinite(float(tr_b.step(feed)["loss"]))
 
 
-def test_dropout_rejected_with_stacked():
-    from paddle_tpu.core.errors import EnforceError
+def test_stacked_dropout_trains_and_infers():
+    """Dropout now works on the scan path (per-layer rng_fold): training
+    produces a finite stochastic loss, inference is deterministic and
+    matches the dropout-0 program exactly (same params)."""
+    prog = pt.build(transformer.make_model(_cfg(dropout=0.3)))
+    feed = _feed(4)
+    params, state = prog.init(jax.random.PRNGKey(0), **feed)
+    out1, _ = prog.apply(params, state, rng=jax.random.PRNGKey(1),
+                         training=True, **feed)
+    out2, _ = prog.apply(params, state, rng=jax.random.PRNGKey(2),
+                         training=True, **feed)
+    assert np.isfinite(float(out1["loss"]))
+    # different rng -> different dropout masks -> different loss
+    assert float(out1["loss"]) != float(out2["loss"])
+    # inference: dropout is a no-op, so the dropout-0 program agrees
+    ref = pt.build(transformer.make_model(_cfg(dropout=0.0)))
+    out_inf, _ = prog.apply(params, state, training=False, **feed)
+    ref_inf, _ = ref.apply(params, state, training=False, **feed)
+    np.testing.assert_allclose(float(out_inf["loss"]),
+                               float(ref_inf["loss"]), rtol=1e-6)
 
+
+def test_stacked_dropout_masks_decorrelate_across_layers():
+    """The scan body is traced once; without rng_fold every layer would
+    get the SAME dropout mask. Statistical pin: an L-layer stack of
+    dropout-only blocks keeps ~p^L of elements with independent masks
+    vs ~p with a shared mask."""
+    from paddle_tpu.layers import stacked as S
+
+    p_keep = 0.5
+    L, n = 2, 20000
+
+    def make_drop_block(num_heads, use_flash, causal, tp_axis, sp_cfg,
+                        dropout_rate=0.0):
+        def block(x, lp):
+            return S._drop(x, dropout_rate)
+        return block
+
+    def net(x):
+        stack = {"dummy": jnp.zeros((L, 1))}
+        return {"y": S.apply_stacked(x, stack, make_drop_block,
+                                     dropout_rate=1 - p_keep)}
+
+    prog = pt.build(net)
+    x = np.ones((1, n), np.float32)
+    params, state = prog.init(jax.random.PRNGKey(0), x=x)
+    out, _ = prog.apply(params, state, rng=jax.random.PRNGKey(3),
+                        training=True, x=x)
+    frac = float((np.asarray(out["y"]) != 0).mean())
+    # independent masks: E[frac]=0.25, sd~0.003; shared mask: 0.5
+    assert abs(frac - p_keep ** L) < 0.03,         f"kept {frac:.3f}; shared-mask reuse would keep ~{p_keep}"
+
+
+def test_dropout_rejected_on_pipeline_path():
+    from paddle_tpu.core.errors import EnforceError
+    from paddle_tpu.framework import pipeline_mode
+
+    devs = jax.devices("cpu")[:2]
+    mesh = jax.sharding.Mesh(np.array(devs).reshape(2), ("pp",))
     prog = pt.build(transformer.make_model(_cfg(dropout=0.1)))
-    with pytest.raises(EnforceError):
-        prog.init(jax.random.PRNGKey(0), **_feed(4))
+    feed = _feed(4)
+    params, state = prog.init(jax.random.PRNGKey(0), **feed)
+    with pipeline_mode(mesh, microbatches=2):
+        with pytest.raises(EnforceError, match="dropout 0"):
+            prog.apply(params, state, rng=jax.random.PRNGKey(1),
+                       training=True, **feed)
 
 
 def test_bubble_fraction():

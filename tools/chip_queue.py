@@ -30,6 +30,8 @@ QUEUE = [
     ("transformer_train", "transformer", {}),                # rbg keys now
     ("transformer_train@no_flash", "transformer",
      {"BENCH_USE_FLASH": "0"}),                              # dense attn A/B
+    ("transformer_train@stacked", "transformer",
+     {"BENCH_STACKED": "1"}),                                # scan-compiled A/B
     ("resnet50_train@uint8_feed", "resnet50",
      {"BENCH_FEED_DTYPE": "uint8"}),                         # link-bound A/B
     ("bert_train", "bert", {}),
