@@ -392,9 +392,10 @@ def apply_stacked(x, stacked: Dict[str, jax.Array], make_block: Callable,
         return out
 
     from ..parallel.pipeline import pipeline_apply
-    enforce(dropout_rate == 0.0,
-            "pipelined stacks require dropout 0 (cross-stage rng "
-            "threading is not wired); the scan path supports dropout")
+    enforce(dropout_rate == 0.0 or not _in_training(),
+            "pipelined stacks require dropout 0 in training (cross-stage "
+            "rng threading is not wired); the scan path supports dropout, "
+            "and eval traces are fine (dropout is a no-op there)")
     mesh = cfg["mesh"]
     tp = "tp" if ("tp" in mesh.axis_names and mesh.shape["tp"] > 1) else None
     if tp:

@@ -74,6 +74,51 @@ def check_grad(fn: Callable, inputs: Sequence[np.ndarray], wrt: int = 0,
     np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol)
 
 
+def check_grad_built(layer_fn, feed, wrt, eps: float = 1e-3,
+                     atol: float = 1e-2, rtol: float = 1e-2):
+    """FD gradcheck for PARAMETERIZED layers (conv/fc/norms — anything
+    that creates weights through LayerHelper): builds the single-op
+    program, inits params once, then checks jax.grad of sum(outputs)
+    against central differences w.r.t. one feed input OR one parameter
+    (``wrt="param:<name>"``). The parameterized analog of check_grad —
+    op_test.py:400 gradchecks ops with weights the same way."""
+    import paddle_tpu as pt
+
+    names = sorted(feed)
+    prog = pt.build(lambda **kw: {"out": layer_fn(**kw)})
+    params, state = prog.init(jax.random.PRNGKey(0), **feed)
+
+    if wrt.startswith("param:"):
+        pname = wrt[len("param:"):]
+        if pname not in params:  # unique-suffix match ("w", "scale", ...)
+            cand = [k for k in params if k.endswith(pname)]
+            assert len(cand) == 1, (pname, sorted(params))
+            pname = cand[0]
+
+        def fn(v):
+            p2 = dict(params, **{pname: v})
+            out, _ = prog.apply(p2, state, training=True, **feed)
+            return out["out"]
+
+        x0 = np.asarray(params[pname], np.float64)
+    else:
+        assert wrt in feed, (wrt, names)
+
+        def fn(v):
+            f2 = dict(feed, **{wrt: v})
+            out, _ = prog.apply(params, state, training=True, **f2)
+            return out["out"]
+
+        x0 = np.asarray(feed[wrt], np.float64)
+
+    def loss(v):
+        return jnp.sum(fn(v).astype(jnp.float32))
+
+    analytic = np.asarray(jax.grad(loss)(jnp.asarray(x0, jnp.float32)))
+    numeric = numeric_grad(lambda v: fn(v), [x0], wrt=0, eps=eps)
+    np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol)
+
+
 # Shared StableHLO scraper for the lowering-level dtype pins
 # (test_mxu_dtypes, test_int8_serving, test_flash_attention): one copy,
 # so an MLIR printer format change is fixed in one place. Returns

@@ -1342,3 +1342,58 @@ def test_fd_grad(name):
                            .astype(np.float32) * 0.5)
     x = make()
     check_grad(GRAD_OPS[name], [x], atol=5e-2, rtol=5e-2)
+
+
+# --- parameterized-layer FD gradchecks (op_test.check_grad_built) ---------
+# The reference gradchecks ops WITH weights the same way as pure ops
+# (conv2d/fc/layer_norm tests under op_test.py:400). These check
+# jax.grad against central differences w.r.t. an input AND a parameter
+# for the core parameterized families the pure-op sweep cannot reach.
+
+from op_test import check_grad_built  # noqa: E402
+
+
+def _img(n=1, c=2, h=4, w=4, seed=60):
+    return rs(seed).randn(n, c, h, w).astype(np.float32) * 0.5
+
+
+PARAM_GRAD_CASES = {
+    "conv2d_input": (lambda image: L.conv2d(image, 3, 3, padding=1),
+                     {"image": _img()}, "image"),
+    "conv2d_weight": (lambda image: L.conv2d(image, 3, 3, padding=1),
+                      {"image": _img()}, "param:w"),
+    "conv2d_transpose_input": (
+        lambda image: L.conv2d_transpose(image, 2, filter_size=2, stride=2),
+        {"image": _img(h=3, w=3)}, "image"),
+    "fc_input": (lambda x: L.fc(x, 4, act="tanh"),
+                 {"x": rs(61).randn(2, 5).astype(np.float32)}, "x"),
+    "fc_weight": (lambda x: L.fc(x, 4, act="tanh"),
+                  {"x": rs(61).randn(2, 5).astype(np.float32)}, "param:w"),
+    "layer_norm_input": (lambda x: L.layer_norm(x, begin_norm_axis=1),
+                         {"x": rs(62).randn(2, 6).astype(np.float32)}, "x"),
+    "layer_norm_scale": (lambda x: L.layer_norm(x, begin_norm_axis=1),
+                         {"x": rs(62).randn(2, 6).astype(np.float32)},
+                         "param:scale"),
+    "group_norm_input": (lambda x: L.group_norm(x, groups=2),
+                         {"x": _img(c=4, seed=63)}, "x"),
+    "prelu_alpha": (lambda x: L.prelu(x, mode="all"),
+                    {"x": rs(64).randn(2, 5).astype(np.float32)},
+                    "param:alpha"),
+    "embedding_table": (
+        lambda ids: L.embedding(ids, size=[8, 4]),
+        {"ids": rs(65).randint(0, 8, (2, 3)).astype(np.int64)}, "param:w"),
+    "sequence_conv_input": (
+        lambda x: L.sequence_conv(
+            x, jnp.asarray(np.array([0, 0, 0, 1, 1], np.int32)),
+            num_filters=3, filter_size=3),
+        {"x": rs(66).randn(5, 4).astype(np.float32)}, "x"),
+    "row_conv_input": (
+        lambda x: L.row_conv(x, future_context_size=2),
+        {"x": rs(67).randn(1, 5, 4).astype(np.float32)}, "x"),
+}
+
+
+@pytest.mark.parametrize("name", sorted(PARAM_GRAD_CASES))
+def test_fd_grad_parameterized(name):
+    layer_fn, feed, wrt = PARAM_GRAD_CASES[name]
+    check_grad_built(layer_fn, feed, wrt, atol=5e-2, rtol=5e-2)

@@ -364,6 +364,9 @@ def test_dropout_rejected_on_pipeline_path():
         with pytest.raises(EnforceError, match="dropout 0"):
             prog.apply(params, state, rng=jax.random.PRNGKey(1),
                        training=True, **feed)
+        # eval is fine under the pipeline (dropout is a no-op there)
+        out, _ = prog.apply(params, state, training=False, **feed)
+        assert np.isfinite(float(out["loss"]))
 
 
 def test_bubble_fraction():
