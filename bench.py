@@ -859,52 +859,67 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
         print(json.dumps(res), flush=True)
         os._exit(0)
 
+    def _run_config(name):
+        nonlocal device, peak, peak_source
+        key = _result_key(name)
+        print(f"[bench] {name} ...", file=sys.stderr, flush=True)
+        cmd = [sys.executable, os.path.abspath(__file__), "--model", name,
+               "--compute_dtype", compute_dtype, "--emit", "raw",
+               "--config_timeout", str(config_timeout)]
+        if quick:
+            cmd.append("--quick")
+        # +180s startup slack: the child's own _deadline(config_timeout)
+        # wraps only _run_one; the parent clock also covers jax import
+        # and backend connect, which must not eat the config's budget
+        child[0] = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                                    preexec_fn=_die_with_parent)
+        try:
+            stdout, _ = child[0].communicate(timeout=config_timeout + 180)
+            rc = child[0].returncode
+        except subprocess.TimeoutExpired:
+            child[0].kill()
+            child[0].communicate()
+            configs[key] = {"error": f"Timeout: config exceeded "
+                                     f"{config_timeout}s (subprocess killed)",
+                            "timed_out": True}
+            print(f"[bench] {name} TIMED OUT", file=sys.stderr, flush=True)
+            return
+        finally:
+            child[0] = None
+        line = (stdout.strip().splitlines() or [""])[-1]
+        try:
+            payload = json.loads(line)
+        except json.JSONDecodeError:
+            payload = {"error": f"rc={rc}, no JSON (crash/OOM?)"}
+        if "error" in payload:
+            configs[key] = {"error": payload["error"]}
+            print(f"[bench] {name} failed: {payload['error']}",
+                  file=sys.stderr, flush=True)
+            return
+        configs[key] = payload["result"]
+        device = payload.get("device", device)
+        peak = payload.get("peak_flops", peak)
+        peak_source = payload.get("peak_source", peak_source)
+        c = configs[key]
+        print(f"[bench] {name}: {c.get('value')} {c.get('unit')} "
+              f"mfu={c.get('mfu')}", file=sys.stderr, flush=True)
+
     import signal
     old_term = signal.signal(signal.SIGTERM, _partial)
     old_int = signal.signal(signal.SIGINT, _partial)
     try:
         for name in _suite_names():
-            key = _result_key(name)
-            print(f"[bench] {name} ...", file=sys.stderr, flush=True)
-            cmd = [sys.executable, os.path.abspath(__file__), "--model", name,
-                   "--compute_dtype", compute_dtype, "--emit", "raw",
-                   "--config_timeout", str(config_timeout)]
-            if quick:
-                cmd.append("--quick")
-            # +180s startup slack: the child's own _deadline(config_timeout)
-            # wraps only _run_one; the parent clock also covers jax import
-            # and backend connect, which must not eat the config's budget
-            child[0] = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
-                                        preexec_fn=_die_with_parent)
-            try:
-                stdout, _ = child[0].communicate(timeout=config_timeout + 180)
-                rc = child[0].returncode
-            except subprocess.TimeoutExpired:
-                child[0].kill()
-                child[0].communicate()
-                configs[key] = {"error": f"Timeout: config exceeded "
-                                         f"{config_timeout}s (subprocess killed)"}
-                print(f"[bench] {name} TIMED OUT", file=sys.stderr, flush=True)
-                continue
-            finally:
-                child[0] = None
-            line = (stdout.strip().splitlines() or [""])[-1]
-            try:
-                payload = json.loads(line)
-            except json.JSONDecodeError:
-                payload = {"error": f"rc={rc}, no JSON (crash/OOM?)"}
-            if "error" in payload:
-                configs[key] = {"error": payload["error"]}
-                print(f"[bench] {name} failed: {payload['error']}",
-                      file=sys.stderr, flush=True)
-                continue
-            configs[key] = payload["result"]
-            device = payload.get("device", device)
-            peak = payload.get("peak_flops", peak)
-            peak_source = payload.get("peak_source", peak_source)
-            c = configs[key]
-            print(f"[bench] {name}: {c.get('value')} {c.get('unit')} "
-                  f"mfu={c.get('mfu')}", file=sys.stderr, flush=True)
+            _run_config(name)
+        # second chance for timed-out configs: the persistent compile
+        # cache means attempt 1's compile work is NOT lost — attempt 2
+        # typically skips straight to the timed steps, which is exactly
+        # what rescues the big rows inside the degraded-link 600 s cap
+        retry = [n for n in _suite_names()
+                 if configs.get(_result_key(n), {}).get("timed_out")]
+        for name in retry:
+            print(f"[bench] retrying {name} (compile now cached)",
+                  file=sys.stderr, flush=True)
+            _run_config(name)
     finally:
         signal.signal(signal.SIGTERM, old_term)
         signal.signal(signal.SIGINT, old_int)
@@ -1001,6 +1016,13 @@ def _backfill_from_mid_round(configs, scheduled=None, mid=_UNSET):
 
 def _assemble(configs, device, peak, peak_source, compute_dtype,
               h2d_mbps=None):
+    # run_suite's internal retry marker must not ship in the record (a
+    # double-timeout row would carry it, a timeout-then-crash row would
+    # not — meaningless downstream); _assemble is the single choke point
+    # both the normal and the SIGTERM-partial paths go through
+    for c in configs.values():
+        if isinstance(c, dict):
+            c.pop("timed_out", None)
     degraded = h2d_mbps is not None and h2d_mbps < LINK_DEGRADED_MBPS
     key = "mfu_compute_only" if degraded else "mfu"
     carried = sorted(n for n, c in configs.items()

@@ -249,3 +249,58 @@ def test_load_mid_round_normalizes_envelope_rows(tmp_path):
                                     "device": "TPU v5 lite"}}}))
     rec = bench._load_mid_round(root=str(tmp_path))
     assert rec["configs"]["bert_train"] == {"mfu": 0.4, "value": 7.0}
+
+
+def test_timed_out_configs_get_one_retry(monkeypatch):
+    """The persistent compile cache makes attempt 1's compile reusable,
+    so the suite retries each timed-out config once; a successful retry
+    replaces the timeout row."""
+    import subprocess as sp
+
+    monkeypatch.setenv("BENCH_ONLY", "mnist_mlp")
+    monkeypatch.setattr(bench, "_probe_device",
+                        lambda *a, **k: ("TPU v5 lite", 9000.0))
+    monkeypatch.setattr(bench, "_load_mid_round", lambda root=None: None)
+    calls = []
+
+    class FakeChild:
+        def __init__(self, attempt):
+            self.attempt = attempt
+            self.returncode = 0
+
+        def communicate(self, timeout=None):
+            if self.attempt == 0 and timeout is not None:
+                # the post-kill reap calls communicate() with no timeout
+                raise sp.TimeoutExpired("cmd", timeout)
+            if self.attempt == 0:
+                return ("", "")
+            import json
+            return (json.dumps({"result": {"value": 1.0, "unit": "u",
+                                           "mfu": 0.5},
+                                "device": "TPU v5 lite",
+                                "peak_flops": 197e12,
+                                "peak_source": "table"}) + "\n", "")
+
+        def poll(self):
+            return self.returncode
+
+        def kill(self):
+            pass
+
+    def fake_popen(cmd, **kw):
+        child = FakeChild(len(calls))
+        calls.append(cmd)
+        return child
+
+    monkeypatch.setattr(sp, "Popen", fake_popen)
+    res = bench.run_suite()
+    assert len(calls) == 2                     # attempt + one retry
+    assert res["configs"]["mnist_mlp_train"]["mfu"] == 0.5
+    assert "timed_out" not in res["configs"]["mnist_mlp_train"]
+    assert res["value"] == 0.5
+
+
+def test_assemble_strips_retry_marker():
+    configs = {"bert_train": {"error": "Timeout: ...", "timed_out": True}}
+    res = bench._assemble(configs, "TPU", 197e12, "table", "bfloat16")
+    assert "timed_out" not in res["configs"]["bert_train"]
