@@ -859,13 +859,14 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
         print(json.dumps(res), flush=True)
         os._exit(0)
 
-    def _run_config(name):
+    def _run_config(name, timeout=None):
         nonlocal device, peak, peak_source
+        timeout = timeout or config_timeout
         key = _result_key(name)
         print(f"[bench] {name} ...", file=sys.stderr, flush=True)
         cmd = [sys.executable, os.path.abspath(__file__), "--model", name,
                "--compute_dtype", compute_dtype, "--emit", "raw",
-               "--config_timeout", str(config_timeout)]
+               "--config_timeout", str(timeout)]
         if quick:
             cmd.append("--quick")
         # +180s startup slack: the child's own _deadline(config_timeout)
@@ -874,13 +875,13 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
         child[0] = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
                                     preexec_fn=_die_with_parent)
         try:
-            stdout, _ = child[0].communicate(timeout=config_timeout + 180)
+            stdout, _ = child[0].communicate(timeout=timeout + 180)
             rc = child[0].returncode
         except subprocess.TimeoutExpired:
             child[0].kill()
             child[0].communicate()
             configs[key] = {"error": f"Timeout: config exceeded "
-                                     f"{config_timeout}s (subprocess killed)",
+                                     f"{timeout}s (subprocess killed)",
                             "timed_out": True}
             print(f"[bench] {name} TIMED OUT", file=sys.stderr, flush=True)
             return
@@ -917,9 +918,16 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
         retry = [n for n in _suite_names()
                  if configs.get(_result_key(n), {}).get("timed_out")]
         for name in retry:
-            print(f"[bench] retrying {name} (compile now cached)",
+            # doubled budget: if attempt 1 was SIGKILLed mid-compile the
+            # cache has nothing to reuse, and the end-of-pass retry only
+            # re-runs the few configs that actually failed
+            print(f"[bench] retrying {name} (compile cached or 2x budget)",
                   file=sys.stderr, flush=True)
-            _run_config(name)
+            # never LESS than attempt 1's budget (a caller may pass
+            # --config_timeout above the 1800 cap)
+            _run_config(name,
+                        timeout=max(config_timeout,
+                                    min(config_timeout * 2, 1800)))
     finally:
         signal.signal(signal.SIGTERM, old_term)
         signal.signal(signal.SIGINT, old_int)
