@@ -153,12 +153,18 @@ def _result(n_per_step, unit, dt_pipe, dt_comp, flops_per_step, peak, baseline_k
 
 
 def bench_resnet50(peak, batch_size=64, image_size=224, iters=20,
-                   data_format="NHWC"):
+                   data_format=None):
     """NHWC by default: the TPU-native conv layout (XLA tiles NHWC conv
     operands straight onto the MXU; NCHW graphs pay layout-assignment
-    transposes). The reference's NCHW remains a model option."""
+    transposes). BENCH_DATA_FORMAT=NCHW A/Bs the reference's layout to
+    quantify the lever on chip."""
+    import os
+
     from paddle_tpu.core import flops
     from paddle_tpu.models import resnet
+
+    if data_format is None:
+        data_format = os.environ.get("BENCH_DATA_FORMAT", "NHWC")
 
     return _bench_convnet(peak,
                           resnet.make_model(depth=50, class_num=1000,
@@ -894,6 +900,11 @@ def run_suite(compute_dtype="bfloat16", quick=False, config_timeout=1200):
             payload = {"error": f"rc={rc}, no JSON (crash/OOM?)"}
         if "error" in payload:
             configs[key] = {"error": payload["error"]}
+            if "_ConfigTimeout" in payload["error"]:
+                # the child's own SIGALRM deadline fired — same rescue
+                # case as a parent-level kill: mark it so the retry
+                # pass (cached compile + doubled budget) picks it up
+                configs[key]["timed_out"] = True
             print(f"[bench] {name} failed: {payload['error']}",
                   file=sys.stderr, flush=True)
             return

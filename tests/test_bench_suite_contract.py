@@ -304,3 +304,44 @@ def test_assemble_strips_retry_marker():
     configs = {"bert_train": {"error": "Timeout: ...", "timed_out": True}}
     res = bench._assemble(configs, "TPU", 197e12, "table", "bfloat16")
     assert "timed_out" not in res["configs"]["bert_train"]
+
+
+def test_child_deadline_timeouts_also_retry(monkeypatch):
+    """A child-side _ConfigTimeout (SIGALRM deadline inside the config
+    subprocess) is the same rescue case as a parent-level kill: the
+    retry pass must pick it up."""
+    import json as _json
+    import subprocess as sp
+
+    monkeypatch.setenv("BENCH_ONLY", "mnist_mlp")
+    monkeypatch.setattr(bench, "_probe_device",
+                        lambda *a, **k: ("TPU v5 lite", 9000.0))
+    monkeypatch.setattr(bench, "_load_mid_round", lambda root=None: None)
+    calls = []
+
+    class FakeChild:
+        def __init__(self, attempt):
+            self.attempt = attempt
+            self.returncode = 0
+
+        def communicate(self, timeout=None):
+            if self.attempt == 0:
+                return (_json.dumps(
+                    {"error": "_ConfigTimeout: config exceeded 1200s"}), "")
+            return (_json.dumps({"result": {"value": 2.0, "unit": "u",
+                                            "mfu": 0.4},
+                                 "device": "TPU", "peak_flops": 197e12,
+                                 "peak_source": "table"}), "")
+
+        def poll(self):
+            return 0
+
+        def kill(self):
+            pass
+
+    monkeypatch.setattr(sp, "Popen",
+                        lambda cmd, **kw: (calls.append(cmd),
+                                           FakeChild(len(calls) - 1))[1])
+    res = bench.run_suite()
+    assert len(calls) == 2
+    assert res["configs"]["mnist_mlp_train"]["mfu"] == 0.4

@@ -34,6 +34,8 @@ QUEUE = [
      {"BENCH_STACKED": "1"}),                                # scan-compiled A/B
     ("resnet50_train@uint8_feed", "resnet50",
      {"BENCH_FEED_DTYPE": "uint8"}),                         # link-bound A/B
+    ("resnet50_train@nchw", "resnet50",
+     {"BENCH_DATA_FORMAT": "NCHW"}),                         # layout-lever A/B
     ("bert_train", "bert", {}),
     ("deepfm_train", "deepfm", {}),
     ("resnet50_infer_bf16", "resnet50_infer_bf16", {}),
