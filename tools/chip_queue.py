@@ -22,7 +22,10 @@ import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-RECORD = os.path.join(ROOT, "BENCH_mid_r04.json")
+# CHIP_QUEUE_RECORD overrides the target for dress rehearsals (pair
+# with CHIP_QUEUE_ALLOW_CPU=1 on a JAX_PLATFORMS=cpu backend)
+RECORD = (os.environ.get("CHIP_QUEUE_RECORD")
+          or os.path.join(ROOT, "BENCH_mid_r04.json"))
 
 # (result_key, bench config name, extra env)
 QUEUE = [
@@ -68,6 +71,17 @@ def main():
         print("device probe failed — tunnel still down, nothing recorded")
         return 1
     print(f"device {kind}, h2d {mbps} MB/s")
+    cpu_backend = "cpu" in str(kind).lower()
+    default_record = RECORD == os.path.join(ROOT, "BENCH_mid_r04.json")
+    if cpu_backend and (default_record
+                        or not os.environ.get("CHIP_QUEUE_ALLOW_CPU")):
+        # a JAX_PLATFORMS=cpu dress rehearsal must never pollute the
+        # on-chip record (device kind, h2d, or rows). Rehearse with BOTH
+        # CHIP_QUEUE_ALLOW_CPU=1 AND CHIP_QUEUE_RECORD=<scratch path> —
+        # the allow flag alone is refused while RECORD is the default
+        print("probed device is CPU — refusing to touch the on-chip record "
+              "(set CHIP_QUEUE_ALLOW_CPU=1 and CHIP_QUEUE_RECORD=<scratch>)")
+        return 1
 
     # compute_dtype is stamped because bench.py's suite fallback refuses
     # records measured under a different dtype (bfloat16 is bench.py's
@@ -86,6 +100,10 @@ def main():
     record.setdefault("configs", {})
 
     only = set(args.only.split(",")) if args.only else None
+    if only:
+        unknown = only - {k for k, _, _ in QUEUE}
+        if unknown:
+            print(f"warning: --only keys not in the queue: {sorted(unknown)}")
     for key, cfg, env_extra in QUEUE:
         if only and key not in only:
             continue
