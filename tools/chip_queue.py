@@ -24,8 +24,8 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # CHIP_QUEUE_RECORD overrides the target for dress rehearsals (pair
 # with CHIP_QUEUE_ALLOW_CPU=1 on a JAX_PLATFORMS=cpu backend)
-RECORD = (os.environ.get("CHIP_QUEUE_RECORD")
-          or os.path.join(ROOT, "BENCH_mid_r04.json"))
+DEFAULT_RECORD = os.path.join(ROOT, "BENCH_mid_r04.json")
+RECORD = os.environ.get("CHIP_QUEUE_RECORD") or DEFAULT_RECORD
 
 # (result_key, bench config name, extra env)
 QUEUE = [
@@ -72,7 +72,8 @@ def main():
         return 1
     print(f"device {kind}, h2d {mbps} MB/s")
     cpu_backend = "cpu" in str(kind).lower()
-    default_record = RECORD == os.path.join(ROOT, "BENCH_mid_r04.json")
+    default_record = (os.path.realpath(RECORD)
+                      == os.path.realpath(DEFAULT_RECORD))
     if cpu_backend and (default_record
                         or not os.environ.get("CHIP_QUEUE_ALLOW_CPU")):
         # a JAX_PLATFORMS=cpu dress rehearsal must never pollute the

@@ -1,0 +1,78 @@
+"""Summarize a jax.profiler trace captured by `bench.py --profile DIR`.
+
+    python tools/trace_summary.py DIR [--top 25] [--lane SUBSTR]
+
+Reads the newest */*.trace.json.gz under DIR (the perfetto-format trace
+jax.profiler writes next to the xplane proto) with stdlib only — no
+tensorboard plugin needed — and prints, per process lane, the ops
+ranked by total duration. On a TPU capture the device lanes carry HLO
+op names: the top rows of the busiest device lane ARE the "exact HLO
+blocking it" answer the perf log asks for (DESIGN.md round-4 queue).
+Python host frames ($-prefixed) are aggregated into one line so device
+time is not drowned out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+
+
+def load_trace(dirname: str) -> dict:
+    paths = sorted(glob.glob(os.path.join(dirname, "**", "*.trace.json.gz"),
+                             recursive=True), key=os.path.getmtime)
+    if not paths:
+        raise SystemExit(f"no *.trace.json.gz under {dirname} — "
+                         "run bench.py --profile first")
+    with gzip.open(paths[-1]) as f:
+        return json.load(f)
+
+
+def summarize(trace: dict, top: int = 25, lane_filter: str | None = None):
+    events = trace.get("traceEvents", [])
+    # pid -> process name from metadata events
+    pnames: dict = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pnames[e.get("pid")] = e.get("args", {}).get("name", "?")
+
+    lanes: dict = collections.defaultdict(lambda: collections.Counter())
+    totals: collections.Counter = collections.Counter()
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        lane = pnames.get(e.get("pid"), str(e.get("pid")))
+        if lane_filter and lane_filter.lower() not in lane.lower():
+            continue
+        name = e.get("name", "?")
+        if name.startswith("$"):  # python host frame: one bucket
+            name = "[python host frames]"
+        lanes[lane][name] += e["dur"]
+        totals[lane] += e["dur"]
+
+    for lane, _ in totals.most_common():
+        ops = lanes[lane]
+        print(f"\n=== lane: {lane} — {totals[lane] / 1e3:.1f} ms total "
+              f"({len(ops)} distinct ops) ===")
+        for name, d in ops.most_common(top):
+            pct = 100.0 * d / max(totals[lane], 1)
+            print(f"  {d / 1e3:10.2f} ms  {pct:5.1f}%  {name[:90]}")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("dir")
+    p.add_argument("--top", type=int, default=25)
+    p.add_argument("--lane", default=None,
+                   help="only lanes whose name contains this substring "
+                        "(e.g. 'tpu' or 'device')")
+    args = p.parse_args()
+    summarize(load_trace(args.dir), top=args.top, lane_filter=args.lane)
+
+
+if __name__ == "__main__":
+    main()
