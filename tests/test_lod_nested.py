@@ -237,3 +237,68 @@ def test_seq2seq_train_decode_lod_round_trip():
         hits += (best[:n] == want[:n]).sum()
         total += n
     assert total > 0 and hits / total > 0.5, f"decode acc {hits}/{total}"
+
+
+# ---------------------------------------------------------------------------
+# property tests: structure invariants over random nested shapes
+# ---------------------------------------------------------------------------
+
+hypothesis = pytest.importorskip("hypothesis")  # optional dependency
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+
+@st.composite
+def nested_lod(draw, min_len=0):
+    """Random 2- or 3-level recursive_seq_lens (consistent by
+    construction) + matching packed values."""
+    levels = draw(st.integers(2, 3))
+    top = draw(st.lists(st.integers(1, 3), min_size=1, max_size=3))
+    lens = [top]
+    for _ in range(levels - 1):
+        n_units = sum(lens[-1])
+        lens.append([draw(st.integers(min_len, 3)) for _ in range(n_units)])
+    rows = sum(lens[-1])
+    return lens, np.arange(rows * 2, dtype=np.float32).reshape(rows, 2)
+
+
+@settings(max_examples=40, deadline=None)
+@given(nested_lod())
+def test_lod_structure_invariants(case):
+    lens, values = case
+    t = LoDTensor(values, lens)
+    assert t.recursive_sequence_lengths() == [list(l) for l in lens]
+    lod = t.lod()
+    # offsets: monotone, start 0, each level's last offset counts the
+    # units of the next level (rows for the innermost)
+    for li, offs in enumerate(lod):
+        assert offs[0] == 0 and all(a <= b for a, b in zip(offs, offs[1:]))
+        nxt = len(lens[li + 1]) if li + 1 < len(lens) else values.shape[0]
+        assert offs[-1] == nxt
+    # row_lengths at EVERY level sums to the total rows, and has one
+    # entry per sequence of that level
+    for level in range(t.lod_level):
+        rl = t.row_lengths(level)
+        assert sum(rl) == values.shape[0]
+        assert len(rl) == len(lens[level])
+
+
+@settings(max_examples=40, deadline=None)
+@given(nested_lod(min_len=1))
+def test_lod_pool_composition_property(case):
+    """sum-pool at the innermost level then sum-pooling the pooled rows
+    at the outer level == sum-pooling level 0 directly — for ANY
+    consistent nested structure (generalizes the one-case test above)."""
+    lens, values = case
+    t = LoDTensor(values, lens)
+    inner = L.sequence_pool(t.values, t.segment_ids(-1),
+                            t.num_seqs(-1), "sum")
+    # group the innermost pooled rows by the composed outer structure
+    outer_lens = lens[0] if t.lod_level == 2 else [
+        sum(lens[1][pos:pos + n])
+        for pos, n in zip(np.cumsum([0] + lens[0][:-1]), lens[0])]
+    seg = np.repeat(np.arange(len(outer_lens)), outer_lens)
+    direct = L.sequence_pool(t.values, t.segment_ids(0), t.num_seqs(0), "sum")
+    via_inner = L.sequence_pool(inner, jnp.asarray(seg, jnp.int32),
+                                len(outer_lens), "sum")
+    np.testing.assert_allclose(np.asarray(via_inner), np.asarray(direct),
+                               rtol=1e-5, atol=1e-5)
