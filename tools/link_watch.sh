@@ -1,0 +1,34 @@
+#!/bin/bash
+# Watch for TPU link windows and capture bench rows the moment one
+# opens. Run from the repo root, ideally at session/round start:
+#
+#     nohup tools/link_watch.sh >/dev/null 2>&1 &
+#     tail -f /tmp/chip_loop.log
+#
+# Pass 1 re-measures the flagship rows (--force; chip_queue never
+# overwrites a good row with a failed attempt). Pass 2 fills every
+# remaining hole. Pass 3 grabs profiler traces once per model for
+# tools/trace_summary.py. Results merge into BENCH_mid_r*.json, which
+# bench.py's suite mode carries into the round record when the link is
+# down at judge time.
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p profiles
+LOG=${LINK_WATCH_LOG:-/tmp/chip_loop.log}
+for i in $(seq 1 200); do
+  echo "=== attempt $i $(date) ===" >> "$LOG"
+  timeout 4000 python tools/chip_queue.py --timeout 1500 --force \
+      --only resnet50_train,transformer_train >> "$LOG" 2>&1
+  rc1=$?
+  timeout 14000 python tools/chip_queue.py --timeout 1500 >> "$LOG" 2>&1
+  rc2=$?
+  if [ $rc1 -eq 0 ]; then
+    for m in transformer resnet50; do
+      if [ ! -d "profiles/$m" ]; then
+        timeout 1800 python bench.py --model $m --profile "profiles/$m" \
+            >> "$LOG" 2>&1 && echo "profiled $m" >> "$LOG"
+      fi
+    done
+  fi
+  echo "=== rc1=$rc1 rc2=$rc2 cache_entries=$(ls .jax_cache_bench 2>/dev/null | wc -l) $(date) ===" >> "$LOG"
+  sleep 540
+done
