@@ -29,6 +29,7 @@ RECORD = os.environ.get("CHIP_QUEUE_RECORD") or DEFAULT_RECORD
 
 # (result_key, bench config name, extra env)
 QUEUE = [
+    ("mnist_mlp_train", "mnist_mlp", {}),                    # cheap canary
     ("resnet50_train", "resnet50", {}),                      # NHWC now
     ("transformer_train", "transformer", {}),                # rbg keys now
     ("transformer_train@no_flash", "transformer",

@@ -23,9 +23,14 @@ for i in $(seq 1 200); do
   rc2=$?
   if [ $rc1 -eq 0 ]; then
     for m in transformer resnet50; do
-      if [ ! -d "profiles/$m" ]; then
+      # success marker, not directory presence: jax.profiler creates
+      # the dir at trace START, so a crashed/killed attempt would
+      # otherwise permanently suppress retries of this model
+      if [ ! -f "profiles/$m/.complete" ]; then
         timeout 1800 python bench.py --model $m --profile "profiles/$m" \
-            >> "$LOG" 2>&1 && echo "profiled $m" >> "$LOG"
+            >> "$LOG" 2>&1 \
+          && touch "profiles/$m/.complete" \
+          && echo "profiled $m" >> "$LOG"
       fi
     done
   fi
