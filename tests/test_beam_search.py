@@ -104,3 +104,40 @@ def test_transformer_beam_decode_runs_and_beats_or_ties_greedy():
                            jnp.asarray(feed["src_ids"]))
     assert out_b["ids"].shape == (2, 3, 6)
     assert np.all(np.asarray(out_b["scores"])[:, 0] >= np.asarray(out_b["scores"])[:, 1] - 1e-5)
+
+
+def test_exhaustive_beam_equals_brute_force_enumeration():
+    """With beam_size >= vocab^max_len every prefix survives each top-k
+    selection, so beam search IS exhaustive enumeration: the returned
+    best sequence and score must equal the brute-force argmax over all
+    vocab^max_len sequences — an exact oracle for score accumulation.
+    Randomized Markov tables, eos unreachable."""
+    import itertools
+
+    vocab, max_len = 3, 3
+    rng = np.random.RandomState(7)
+    for trial in range(5):
+        table = rng.randn(vocab + 3, vocab + 3).astype(np.float32)
+        table[:, 2] = -100.0  # eos never competitive
+        logp_np = np.asarray(jax.nn.log_softmax(jnp.asarray(table), axis=-1))
+
+        def step_fn(tokens, state, _t=jnp.asarray(logp_np)):
+            return jnp.take(_t, tokens, axis=0), state
+
+        K = (vocab + 3) ** max_len  # 216 beams: exhaustive
+        seqs, scores = beam_search(step_fn, {"d": jnp.zeros((K,))},
+                                   batch_size=1, beam_size=K,
+                                   max_len=max_len, bos_id=1, eos_id=2)
+        # brute force over all candidate sequences from bos
+        best_score, best_seq = -np.inf, None
+        for cand in itertools.product(range(vocab + 3), repeat=max_len):
+            s, prev = 0.0, 1
+            for tok in cand:
+                s += logp_np[prev, tok]
+                prev = tok
+            if s > best_score:
+                best_score, best_seq = s, cand
+        np.testing.assert_allclose(float(scores[0, 0]), best_score,
+                                   rtol=1e-5)
+        assert tuple(np.asarray(seqs)[0, 0]) == best_seq, \
+            (trial, tuple(np.asarray(seqs)[0, 0]), best_seq)

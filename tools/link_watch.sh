@@ -25,8 +25,12 @@ for i in $(seq 1 200); do
     for m in transformer resnet50; do
       # success marker, not directory presence: jax.profiler creates
       # the dir at trace START, so a crashed/killed attempt would
-      # otherwise permanently suppress retries of this model
-      if [ ! -f "profiles/$m/.complete" ]; then
+      # otherwise permanently suppress retries. Attempts are capped at
+      # 3 so a deterministic failure can't burn ~30 min of every cycle
+      attempts=$(cat "profiles/$m/.attempts" 2>/dev/null || echo 0)
+      if [ ! -f "profiles/$m/.complete" ] && [ "$attempts" -lt 3 ]; then
+        mkdir -p "profiles/$m"
+        echo $((attempts + 1)) > "profiles/$m/.attempts"
         timeout 1800 python bench.py --model $m --profile "profiles/$m" \
             >> "$LOG" 2>&1 \
           && touch "profiles/$m/.complete" \
