@@ -82,3 +82,50 @@ def test_yolo_box_shapes():
                                class_num=2)
     assert boxes.shape == (1, 48, 4)
     assert scores.shape == (1, 48, 2)
+
+# -- property oracles (random boxes; supersede the fixed-seed cases above) --
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+
+
+@st.composite
+def boxes(draw, n_max=5):
+    n = draw(st.integers(1, n_max))
+    rng = np.random.RandomState(draw(st.integers(0, 2 ** 16)))
+    x1y1 = rng.rand(n, 2).astype(np.float32) * 0.5
+    wh = rng.rand(n, 2).astype(np.float32) * 0.4 + 0.05
+    return np.concatenate([x1y1, x1y1 + wh], axis=1)
+
+
+@settings(max_examples=30, deadline=None)
+@given(boxes(), boxes())
+def test_iou_similarity_matches_scalar_oracle(a, b):
+    got = np.asarray(D.iou_similarity(jnp.asarray(a), jnp.asarray(b)))
+    for i in range(a.shape[0]):
+        for j in range(b.shape[0]):
+            ix1, iy1 = max(a[i, 0], b[j, 0]), max(a[i, 1], b[j, 1])
+            ix2, iy2 = min(a[i, 2], b[j, 2]), min(a[i, 3], b[j, 3])
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            area = lambda bx: (bx[2] - bx[0]) * (bx[3] - bx[1])
+            want = inter / (area(a[i]) + area(b[j]) - inter + 1e-10)
+            np.testing.assert_allclose(got[i, j], want, rtol=1e-4, atol=1e-5)
+    assert (got >= -1e-6).all() and (got <= 1 + 1e-6).all()
+
+
+@settings(max_examples=30, deadline=None)
+@given(boxes())
+def test_box_coder_encode_decode_roundtrip(gt):
+    """decode(encode(gt, prior), prior) == gt for ANY boxes/priors/vars
+    — the property the SSD loss depends on."""
+    rng = np.random.RandomState(int(abs(gt).sum() * 1e4) % 2 ** 31)
+    n = gt.shape[0]
+    prior = np.concatenate([rng.rand(n, 2) * 0.5,
+                            rng.rand(n, 2) * 0.4 + 0.55], 1).astype(np.float32)
+    var = (rng.rand(n, 4).astype(np.float32) * 0.2 + 0.05)
+    enc = D.box_coder(jnp.asarray(prior), jnp.asarray(var), jnp.asarray(gt),
+                      code_type="encode_center_size")
+    dec = D.box_coder(jnp.asarray(prior), jnp.asarray(var), enc,
+                      code_type="decode_center_size")
+    np.testing.assert_allclose(np.asarray(dec), gt, rtol=1e-3, atol=1e-4)
