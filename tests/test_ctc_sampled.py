@@ -206,3 +206,36 @@ def test_hsigmoid_power_of_two_code_path():
 
     expect = np.array([ref_loss(feat[i], labels[i]) for i in range(len(labels))])
     np.testing.assert_allclose(np.asarray(out["per"])[:, 0], expect, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("seed,b,t,c,lmax", [
+    (11, 1, 6, 3, 2),    # tiny: single batch, near-minimal alphabet
+    (12, 4, 20, 8, 6),   # mid
+    (13, 2, 9, 4, 3),    # labels close to the CTC length bound
+    (14, 5, 16, 12, 2),  # wide alphabet, short labels
+    (15, 3, 25, 5, 8),   # long sequences, long labels
+])
+def test_warpctc_matches_torch_across_shapes(seed, b, t, c, lmax):
+    """Randomized shape sweep against the torch oracle: repeated labels,
+    ragged logit/label lengths, and near-bound cases are where CTC
+    recursions break first."""
+    import torch
+    rng = np.random.RandomState(seed)
+    logits, labels, logit_len, label_len = _rand_ctc_case(
+        rng, b=b, t=t, c=c, lmax=lmax)
+    # CTC feasibility: a label with consecutive repeats needs
+    # T >= label_len + #repeats (a blank between each repeated pair);
+    # clamp so no seed can draw an infeasible sample (torch -> inf,
+    # warpctc -> NEG_INF clamp — a spurious mismatch, not a bug)
+    for i in range(b):
+        lab = labels[i, :label_len[i]]
+        repeats = int((lab[1:] == lab[:-1]).sum())
+        logit_len[i] = max(logit_len[i], label_len[i] + repeats)
+    assert logit_len.max() <= t
+    loss = ctc.warpctc(logits, labels, logit_len, label_len, blank=0)
+    lp = torch.log_softmax(torch.tensor(logits).permute(1, 0, 2), dim=-1)
+    ref = torch.nn.functional.ctc_loss(
+        lp, torch.tensor(labels), torch.tensor(logit_len),
+        torch.tensor(label_len), blank=0, reduction="none")
+    np.testing.assert_allclose(np.asarray(loss)[:, 0], ref.numpy(),
+                               rtol=5e-4, atol=5e-4)

@@ -83,7 +83,8 @@ def test_yolo_box_shapes():
     assert boxes.shape == (1, 48, 4)
     assert scores.shape == (1, 48, 2)
 
-# -- property oracles (random boxes; supersede the fixed-seed cases above) --
+# -- property oracles (random boxes; COMPLEMENT the fixed-seed cases
+# above — those pin exact IoU=1/0 boundaries this strategy can't hit) --
 
 hypothesis = pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st  # noqa: E402
@@ -115,11 +116,12 @@ def test_iou_similarity_matches_scalar_oracle(a, b):
 
 
 @settings(max_examples=30, deadline=None)
-@given(boxes())
-def test_box_coder_encode_decode_roundtrip(gt):
-    """decode(encode(gt, prior), prior) == gt for ANY boxes/priors/vars
-    — the property the SSD loss depends on."""
-    rng = np.random.RandomState(int(abs(gt).sum() * 1e4) % 2 ** 31)
+@given(boxes(), st.integers(0, 2 ** 16))
+def test_box_coder_encode_decode_roundtrip(gt, prior_seed):
+    """decode(encode(gt, prior), prior) == gt — the property the SSD
+    loss depends on. Priors/vars draw their own hypothesis seed so they
+    vary (and shrink) independently of the target boxes."""
+    rng = np.random.RandomState(prior_seed)
     n = gt.shape[0]
     prior = np.concatenate([rng.rand(n, 2) * 0.5,
                             rng.rand(n, 2) * 0.4 + 0.55], 1).astype(np.float32)
