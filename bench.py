@@ -1020,9 +1020,13 @@ def _backfill_from_mid_round(configs, scheduled=None, mid=_UNSET):
     for key, row in mid["configs"].items():
         if not isinstance(row, dict) or "error" in row:
             continue
-        # A/B variant rows (chip_queue's "bert_train@no_flash") ride with
-        # their base config's scheduling
-        if scheduled is not None and key.split("@")[0] not in scheduled:
+        # A/B variant rows (chip_queue's "transformer_train@no_flash")
+        # stay in the mid record for the judge but do NOT carry into
+        # suite records: the suite never measures variant keys itself,
+        # so carrying them just accumulates stale historical rows
+        if "@" in key:
+            continue
+        if scheduled is not None and key not in scheduled:
             continue
         live = configs.get(key)
         if live is None or "error" in live:

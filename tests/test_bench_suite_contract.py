@@ -171,6 +171,25 @@ def test_backfill_respects_scheduled_scope(monkeypatch):
     assert set(configs) == {"mnist_mlp_train"}
 
 
+def test_backfill_never_carries_ab_variant_rows(monkeypatch):
+    """chip_queue's A/B rows (key@variant) live in the mid record for
+    the judge but must not leak into suite records: the suite never
+    measures variant keys, so a carried one would persist forever."""
+    mid = {"configs": {
+        "transformer_train": {"mfu": 0.3, "value": 2000.0},
+        "transformer_train@no_flash": {"mfu": 0.2, "value": 1500.0},
+    }}
+    monkeypatch.setattr(bench, "_load_mid_round", lambda root=None: mid)
+    configs = {}
+    bench._backfill_from_mid_round(configs,
+                                   scheduled={"transformer_train"})
+    assert set(configs) == {"transformer_train"}
+    # unscoped (signal-handler) path skips variants too
+    configs = {}
+    bench._backfill_from_mid_round(configs)
+    assert set(configs) == {"transformer_train"}
+
+
 def test_assemble_carried_rows_never_drive_headline():
     """The one-line headline reflects the code under test: carried
     (prior-capture) rows are excluded from the max unless NO live train

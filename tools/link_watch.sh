@@ -22,12 +22,16 @@ for i in $(seq 1 200); do
   timeout 14000 python tools/chip_queue.py --timeout 1500 >> "$LOG" 2>&1
   rc2=$?
   if [ $rc1 -eq 0 ]; then
-    for m in transformer resnet50; do
+    for m in transformer resnet50 gpt bert; do
       # success marker, not directory presence: jax.profiler creates
       # the dir at trace START, so a crashed/killed attempt would
       # otherwise permanently suppress retries. Attempts are capped at
-      # 3 so a deterministic failure can't burn ~30 min of every cycle
-      attempts=$(cat "profiles/$m/.attempts" 2>/dev/null || echo 0)
+      # 3 so a deterministic failure can't burn ~30 min of every cycle.
+      # tr -cd digits + forced base-10: garbage in .attempts (including
+      # leading-zero strings, invalid octal to $(( ))) must degrade to
+      # 0, not kill the [ -lt ] test and silently disable profiling
+      av=$(cat "profiles/$m/.attempts" 2>/dev/null | tr -cd '0-9' | cut -c1-4)
+      attempts=$((10#${av:-0}))
       if [ ! -f "profiles/$m/.complete" ] && [ "$attempts" -lt 3 ]; then
         mkdir -p "profiles/$m"
         echo $((attempts + 1)) > "profiles/$m/.attempts"
