@@ -197,6 +197,7 @@ class Trainer:
             rng = make_prng_key(get_flag("seed"))
         feed = {k: _abstractify(v) for k, v in (sample_feed or {}).items()}
         params, state = self.program.init(rng, **feed)
+        params = self._interleave_stacked_params(params)
         sd = getattr(self.strategy, "opt_state_dtype", None) if self.strategy else None
         if sd is not None:
             self.optimizer.set_state_dtype(sd)
@@ -221,6 +222,74 @@ class Trainer:
             self.scope.loss_scale_state = ls
         self._build_step()
         return self.scope
+
+    # ------------------------------------------------------------------
+    def _pp_settings(self):
+        pp_m = getattr(self.strategy, "pp_microbatches", 0) if self.strategy else 0
+        pp_v = getattr(self.strategy, "pp_interleave", 1) if self.strategy else 1
+        return pp_m, max(1, int(pp_v))
+
+    def _interleave_stacked_params(self, params):
+        """Megatron rest layout for the interleaved pipeline: permute
+        each pp-sharded stacked leaf's layer rows into rank-major chunk
+        order ONCE at startup (parallel.pipeline.interleave_perm), so
+        the per-step schedule re-chunks with a free local reshape
+        instead of an all-to-all over pp of (V-1)/V of the parameter
+        bytes. Checkpoints stay in logical order: io.save/load_trainer*
+        round-trip through stacked_to_logical/_from_logical."""
+        self._pp_perm = {}
+        pp_m, pp_v = self._pp_settings()
+        if (pp_m <= 0 or pp_v <= 1 or self.mesh is None
+                or "pp" not in self.mesh.axis_names
+                or self.mesh.shape["pp"] <= 1
+                or self.sharding_rules is None):
+            return params
+        from .parallel.pipeline import interleave_perm
+        p = self.mesh.shape["pp"]
+        for name, leaf in params.items():
+            spec = self.sharding_rules.spec_for(name, leaf.shape, self.mesh)
+            lead = spec[0] if len(spec) > 0 else None
+            if not (lead == "pp" or (isinstance(lead, tuple) and "pp" in lead)):
+                continue
+            if leaf.ndim < 1 or leaf.shape[0] % (p * pp_v) != 0:
+                continue
+            perm = interleave_perm(leaf.shape[0], p, pp_v)
+            params[name] = jnp.asarray(leaf)[perm]
+            self._pp_perm[name] = perm
+        return params
+
+    def _apply_row_perm(self, params, opt_state, index_of):
+        """Apply a per-name row permutation (``index_of(perm)`` chooses
+        direction) to params and matching optimizer accumulator slots."""
+        perms = getattr(self, "_pp_perm", None) or {}
+        if not perms:
+            return params, opt_state
+        params = dict(params)
+        if opt_state is not None:
+            # shallow-copy the touched levels: callers pass live scope
+            # trees (save path) that must not be reordered in place
+            opt_state = dict(opt_state)
+            opt_state["accums"] = {k: dict(v) for k, v in
+                                   opt_state.get("accums", {}).items()}
+        for name, perm in perms.items():
+            idx = index_of(perm)
+            if name in params:
+                params[name] = jnp.asarray(params[name])[idx]
+            accums = (opt_state or {}).get("accums", {})
+            for slot, arr in list(accums.get(name, {}).items()):
+                if getattr(arr, "ndim", 0) >= 1 and arr.shape[0] == len(perm):
+                    accums[name][slot] = jnp.asarray(arr)[idx]
+        return params, opt_state
+
+    def stacked_to_logical(self, params, opt_state=None):
+        """Undo the interleaved rest layout (checkpoint/export order)."""
+        return self._apply_row_perm(params, opt_state,
+                                    lambda perm: np.argsort(perm))
+
+    def stacked_from_logical(self, params, opt_state=None):
+        """Re-apply the interleaved rest layout to logical-order arrays
+        (checkpoint restore into a running interleaved trainer)."""
+        return self._apply_row_perm(params, opt_state, lambda perm: perm)
 
     # ------------------------------------------------------------------
     def _ambient_mode(self, flag_desc: str, wanted: bool, axis: str, enter):
@@ -255,11 +324,13 @@ class Trainer:
         # strategy.remat (memory_optimize analog) flips the ambient
         # trace-time switch; zoo models wrap their repeated blocks in
         # maybe_remat, so jax.checkpoint lands per block
-        pp_m = getattr(self.strategy, "pp_microbatches", 0) if self.strategy else 0
-        pp_v = getattr(self.strategy, "pp_interleave", 1) if self.strategy else 1
+        pp_m, pp_v = self._pp_settings()
+        pp_layout = ("interleaved" if getattr(self, "_pp_perm", None)
+                     else "stacked")
         pp_on, pp_ctx = self._ambient_mode(
             f"DistStrategy.pp_microbatches={pp_m}", pp_m > 0, "pp",
-            lambda: pipeline_mode(self.mesh, pp_m, interleave=pp_v))
+            lambda: pipeline_mode(self.mesh, pp_m, interleave=pp_v,
+                                  param_layout=pp_layout))
         sp_on, sp_ctx = self._ambient_mode(
             "DistStrategy.sequence_parallel",
             bool(getattr(self.strategy, "sequence_parallel", False)), "sp",
@@ -350,7 +421,22 @@ class Trainer:
             self._step_fn = jax.jit(train_step, donate_argnums=donate)
 
         def eval_step(params, state, feed):
-            out, _ = self.program.apply(params, state, training=False, **feed)
+            # With the interleaved rest layout (pp_interleave>1) the
+            # stacked rows are only meaningful through the pipeline
+            # schedule, so eval must enter the same pipeline ctx as
+            # training (its feeds then share the train step's
+            # microbatch-divisibility requirement). Plain-pp trainers
+            # keep the old scan-path eval: logical row order is intact
+            # and any batch size works.
+            from .framework import pipeline_mode
+            pp_m, pp_v = self._pp_settings()
+            ctx = (pipeline_mode(self.mesh, pp_m, interleave=pp_v,
+                                 param_layout="interleaved")
+                   if getattr(self, "_pp_perm", None)
+                   else contextlib.nullcontext())
+            with ctx:
+                out, _ = self.program.apply(params, state, training=False,
+                                            **feed)
             return out
 
         self._eval_fn = jax.jit(eval_step)

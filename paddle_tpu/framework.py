@@ -268,6 +268,28 @@ def rng_fold(tag):
         ctx.rng = old
 
 
+@contextlib.contextmanager
+def rng_scope(key):
+    """REPLACE the ambient rng stream with ``key`` for the block.
+
+    Where :func:`rng_fold` derives from the ambient key, this installs
+    an explicitly-threaded one — the pipeline schedule needs it because
+    its body runs under ``shard_map``, where the ambient key must enter
+    as a replicated argument and be re-derived per (layer, microbatch,
+    data-shard) inside the body. No-op when ``key`` is None or no build
+    context is active."""
+    ctx = current_context()
+    if ctx is None or key is None:
+        yield
+        return
+    old = ctx.rng
+    ctx.rng = key
+    try:
+        yield
+    finally:
+        ctx.rng = old
+
+
 # --------------------------------------------------------------------------
 # Parameter / variable creation — the LayerHelper primitives
 # --------------------------------------------------------------------------
@@ -574,17 +596,22 @@ _pipeline_mode = threading.local()
 
 @contextlib.contextmanager
 def pipeline_mode(mesh, microbatches: int, axis: str = "pp",
-                  interleave: int = 1):
+                  interleave: int = 1, param_layout: str = "stacked"):
     """Ambient pipeline-parallel switch (trace-time, like
     :func:`remat_mode`). Trainer enters this around ``program.apply``
     when ``DistStrategy.pp_microbatches`` is set and the mesh has a
     ``pp`` axis; zoo models route their stacked block stacks through
     ``layers.stacked.apply_stacked``, which consumes it and runs
     ``parallel.pipeline.pipeline_apply`` instead of a sequential scan.
-    ``interleave`` selects the Megatron virtual-stage schedule (>1)."""
+    ``interleave`` selects the Megatron virtual-stage schedule (>1).
+    ``param_layout="interleaved"`` declares that stacked param rows are
+    ALREADY stored in the rank-major chunk order (Trainer.startup's
+    Megatron layout, ``parallel.pipeline.interleave_perm``), so the
+    schedule needs no per-step re-layout collective."""
     old = getattr(_pipeline_mode, "cfg", None)
     cfg = {"mesh": mesh, "microbatches": int(microbatches), "axis": axis,
-           "interleave": max(1, int(interleave)), "consumed": False}
+           "interleave": max(1, int(interleave)),
+           "param_layout": param_layout, "consumed": False}
     _pipeline_mode.cfg = cfg
     try:
         yield cfg

@@ -165,8 +165,12 @@ def save_trainer(dirname: str, trainer) -> None:
     ls = getattr(trainer.scope, "loss_scale_state", None)
     if ls:
         meta["loss_scale_state"] = {k: float(v) for k, v in ls.items()}
-    save_persistables(dirname, trainer.scope.params, trainer.scope.state,
-                      trainer.scope.opt_state, meta=meta)
+    # checkpoints always store logical layer order: undo the trainer's
+    # interleaved pipeline rest layout (no-op otherwise)
+    params, opt_state = trainer.stacked_to_logical(
+        trainer.scope.params, trainer.scope.opt_state)
+    save_persistables(dirname, params, trainer.scope.state,
+                      opt_state, meta=meta)
 
 
 def load_trainer(dirname: str, trainer) -> None:
@@ -178,6 +182,10 @@ def load_trainer(dirname: str, trainer) -> None:
         # flatten to nothing on save — restore the per-param keys
         for k in params:
             opt_state["accums"].setdefault(k, {})
+    # checkpoints are logical layer order; a trainer running the
+    # interleaved pipeline layout re-permutes on the way in (no-op
+    # otherwise)
+    params, opt_state = trainer.stacked_from_logical(params, opt_state)
     if trainer.mesh is not None:
         from .parallel import api as par_api
         params, state, opt_state = par_api.shard_scope(
@@ -500,10 +508,15 @@ def wait_for_checkpoints():
 def save_trainer_sharded(dirname: str, trainer, async_save: bool = True):
     """Orbax-backed Trainer checkpoint (async by default): params, state,
     opt_state, step — each host writing its own shards."""
+    # logical layer order on disk (matches save_trainer): the device-
+    # side de-permute is one gather per stacked leaf per checkpoint —
+    # noise next to the write itself
+    params, opt_state = trainer.stacked_to_logical(
+        trainer.scope.params, trainer.scope.opt_state or {})
     tree = {
-        "params": trainer.scope.params,
+        "params": params,
         "state": trainer.scope.state,
-        "opt_state": trainer.scope.opt_state or {},
+        "opt_state": opt_state,
         "meta": {"global_step": trainer.global_step},
     }
     ls = getattr(trainer.scope, "loss_scale_state", None)
@@ -534,9 +547,11 @@ def load_trainer_sharded(dirname: str, trainer) -> None:
                                             "good_steps": jnp.int32(0),
                                             "overflows": jnp.int32(0)}
     restored = load_sharded(dirname, target=target)
-    trainer.scope.params = restored["params"]
+    params, opt_state = trainer.stacked_from_logical(
+        restored["params"], restored["opt_state"])
+    trainer.scope.params = params
     trainer.scope.state = restored["state"]
-    trainer.scope.opt_state = restored["opt_state"] or None
+    trainer.scope.opt_state = opt_state or None
     trainer.global_step = int(restored["meta"]["global_step"])
     # only adopt scaler state if this trainer actually runs a scaler —
     # step() donates the buffer and only a scaler refreshes it, so a

@@ -24,8 +24,10 @@ fixed at trace time), so ``apply_stacked`` folds the traced layer index
 into the ambient rng stream per iteration (:func:`framework.rng_fold`),
 giving each layer independent masks at the same four sites as the
 unrolled transformer layer (attention softmax, two residuals, ffn
-inner). The pipeline path still requires dropout 0 (cross-stage rng
-threading is not wired).
+inner). The pipeline path supports dropout too: a per-step key is
+threaded into the schedule and folded per (layer, microbatch,
+data-shard) inside the shard_map body (parallel/pipeline.py module doc
+covers the tp-axis caveat).
 """
 
 from __future__ import annotations
@@ -391,11 +393,8 @@ def apply_stacked(x, stacked: Dict[str, jax.Array], make_block: Callable,
                               (stacked, jnp.arange(num_layers)))
         return out
 
+    from ..framework import next_rng_key
     from ..parallel.pipeline import pipeline_apply
-    enforce(dropout_rate == 0.0 or not _in_training(),
-            "pipelined stacks require dropout 0 in training (cross-stage "
-            "rng threading is not wired); the scan path supports dropout, "
-            "and eval traces are fine (dropout is a no-op there)")
     mesh = cfg["mesh"]
     tp = "tp" if ("tp" in mesh.axis_names and mesh.shape["tp"] > 1) else None
     if tp:
@@ -403,11 +402,20 @@ def apply_stacked(x, stacked: Dict[str, jax.Array], make_block: Callable,
                 f"stacked blocks with tp={mesh.shape['tp']} need num_heads "
                 f"({num_heads}) divisible by tp")
     block = make_block(num_heads=num_heads, use_flash=use_flash,
-                       causal=causal, tp_axis=tp, sp_cfg=None)
+                       causal=causal, tp_axis=tp, sp_cfg=None,
+                       dropout_rate=dropout_rate)
     layer_fn = block if extras is not None else (lambda a, lp: block(a, lp))
+    # dropout in the pipeline: thread one per-step key into the schedule
+    # (the body runs under shard_map, where the ambient stream is not
+    # addressable); pipeline_apply folds it per (layer, microbatch,
+    # data-shard). Eval traces pass None — dropout is a no-op there.
+    rng_key = (next_rng_key()
+               if dropout_rate > 0.0 and _in_training() else None)
     return pipeline_apply(
         x, stacked, layer_fn, mesh, axis_name=cfg["axis"],
         microbatches=cfg["microbatches"],
         interleave=cfg.get("interleave", 1),
         param_specs=stack_tp_specs(stacked) if tp else None,
-        extras=extras)
+        extras=extras,
+        param_layout=cfg.get("param_layout", "stacked"),
+        rng_key=rng_key)

@@ -21,17 +21,32 @@ Two schedules, selected by ``interleave`` (= V, virtual stages/rank):
   next ring rank, so the PER-TICK communication structure is identical
   to GPipe (one ppermute per tick, single holding buffer); the loop
   runs M·V + P - 1 ticks of 1/V the work each, shrinking the bubble
-  time by V× (see ``bubble_fraction`` for the exact P ∤ M case) at two
-  costs: V× more (pipelined, neighbor-hop) activation traffic, and —
-  because the Trainer stores stacked params contiguously pp-sharded —
-  a once-per-step re-layout of (V-1)/V of the stacked parameter bytes
-  into the chunk-interleaved order (an all-to-all over pp; gradients
-  take the inverse path in backward). Storing params chunk-interleaved
-  at startup (the Megatron layout) would remove that re-layout and is
-  the known follow-up. This is the schedule half of 1F1B: the memory
-  half (depth-bounded live activations) is expressed through
-  per-microbatch rematerialization (``DistStrategy.remat``) instead,
-  because reverse-mode over the scan already frees what remat drops.
+  time by V× (see ``bubble_fraction`` for the exact P ∤ M case) at the
+  cost of V× more (pipelined, neighbor-hop) activation traffic. With
+  ``param_layout="stacked"`` (logical layer order at rest) the schedule
+  additionally pays a once-per-step re-layout of (V-1)/V of the stacked
+  parameter bytes into chunk-interleaved order (an all-to-all over pp;
+  gradients take the inverse path in backward); the Trainer avoids it
+  by storing stacked rows chunk-interleaved at startup — the Megatron
+  layout, :func:`interleave_perm` — and passing
+  ``param_layout="interleaved"``, under which the re-chunk is a local
+  reshape and the step's only collectives are the activation ppermutes
+  (pinned by tests/test_pipeline.py's HLO structural test). This is
+  the schedule half of 1F1B: the memory half (depth-bounded live
+  activations) is expressed through per-microbatch rematerialization
+  (``DistStrategy.remat``) instead, because reverse-mode over the scan
+  already frees what remat drops.
+
+Dropout: the schedule threads an explicit rng key (``rng_key``), folded
+per (global layer, microbatch, data-shard position) inside the body, so
+masks decorrelate across layers/microbatches/data shards and every
+(layer, microbatch) application — computed on exactly one rank at one
+tick — is deterministic given the step key. The tp axis is deliberately
+NOT folded: post-psum residual masks must agree across tp ranks (they
+apply to replicated activations); the pre-psum sites (attention probs,
+ffn inner) then reuse one mask pattern across a layer's tp-local head/
+hidden blocks — still valid per-element Bernoulli, just block-
+correlated, matching what the masks' shared key implies.
 
 Composable with dp/tp: batch stays sharded on dp; stacked layer params
 can additionally shard their weight dims on tp.
@@ -55,6 +70,32 @@ def stack_layer_params(per_layer_params: list) -> Any:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer_params)
 
 
+def interleave_perm(L: int, pp: int, v: int):
+    """Row permutation taking logical layer order to the rank-major
+    chunk-interleaved rest layout (Megatron virtual-stage storage).
+
+    Row j of the interleaved layout holds logical layer ``perm[j]``:
+    rank r's V local chunks live contiguously at rows [r·V·Lc,
+    (r+1)·V·Lc), local chunk c being GLOBAL chunk c·P + r (layers
+    [(c·P+r)·Lc, (c·P+r+1)·Lc)). Sharding the leading dim over pp then
+    hands each rank exactly its chunks with no data movement; the
+    inverse layout is ``np.argsort(interleave_perm(...))``."""
+    import numpy as np
+
+    enforce(L % (pp * v) == 0,
+            f"{L} layers not divisible by pp·interleave={pp}·{v}")
+    Lc = L // (pp * v)
+    perm = np.empty(L, dtype=np.int64)
+    j = 0
+    for r in range(pp):
+        for c in range(v):
+            g = c * pp + r
+            for i in range(Lc):
+                perm[j] = g * Lc + i
+                j += 1
+    return perm
+
+
 def _schedule_ticks(m: int, p: int, v: int) -> int:
     """Total ticks: the last microbatch's last chunk runs at
     ((m-1)÷p)·vp + (v-1)·p + (p-1) + ((m-1) mod p); +1 for the count.
@@ -62,30 +103,46 @@ def _schedule_ticks(m: int, p: int, v: int) -> int:
     return ((m - 1) // p) * v * p + (v - 1) * p + (p - 1) + ((m - 1) % p) + 1
 
 
-def _pp_body(x, stacked, extras, layer_fn, axis_name: str, microbatches: int,
-             interleave: int, varying_axes: Tuple[str, ...]):
+def _pp_body(x, stacked, extras, rng_key, layer_fn, axis_name: str,
+             microbatches: int, interleave: int,
+             varying_axes: Tuple[str, ...],
+             data_axes: Tuple[str, ...] = ()):
     """Per-rank body. x: local microbatch stack [M, ...mb shape...] on
     rank 0's slot (all ranks receive the same x spec; only rank 0's
     content is used). stacked: this rank's [V, layers_per_chunk, ...]
     params — chunk c here is GLOBAL chunk c·P + rank. extras: pytree of
     [M, ...] per-microbatch side inputs (masks, encoder outputs) — each
     rank indexes the extras for the microbatch it is processing that
-    tick rather than forwarding them."""
+    tick rather than forwarding them. rng_key: replicated per-step key,
+    or None when the blocks draw no randomness; folded per (global
+    layer, microbatch, data-shard) before each layer_fn call so dropout
+    masks decorrelate (tp deliberately excluded — see module doc)."""
+    from ..framework import rng_scope
+
     p = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     m, v = microbatches, interleave
+    Lc = jax.tree.leaves(stacked)[0].shape[1]
+    if rng_key is not None:
+        for a in data_axes:
+            rng_key = jax.random.fold_in(rng_key, jax.lax.axis_index(a))
 
-    def apply_chunk(act, chunk_idx, extra):
+    def apply_chunk(act, chunk_idx, extra, mb_idx):
         chunk = jax.tree.map(
             lambda leaf: jax.lax.dynamic_index_in_dim(leaf, chunk_idx, 0,
                                                       keepdims=False),
             stacked)
+        chunk_base = (chunk_idx * p + rank) * Lc  # first global layer
 
-        def one_layer(a, layer_params):
-            if extra is None:
-                return layer_fn(a, layer_params), None
-            return layer_fn(a, layer_params, extra), None
-        out, _ = jax.lax.scan(one_layer, act, chunk)
+        def one_layer(a, xs):
+            layer_params, li = xs
+            key = None if rng_key is None else jax.random.fold_in(
+                jax.random.fold_in(rng_key, chunk_base + li), mb_idx)
+            with rng_scope(key):
+                if extra is None:
+                    return layer_fn(a, layer_params), None
+                return layer_fn(a, layer_params, extra), None
+        out, _ = jax.lax.scan(one_layer, act, (chunk, jnp.arange(Lc)))
         return out
 
     mb_shape = x.shape[1:]
@@ -108,7 +165,7 @@ def _pp_body(x, stacked, extras, layer_fn, axis_name: str, microbatches: int,
         cur = jnp.where((rank == 0) & (c_local == 0), fresh, holding)
         extra = (None if extras is None
                  else jax.tree.map(lambda e: e[mb_idx], extras))
-        done = apply_chunk(cur, c_local, extra)
+        done = apply_chunk(cur, c_local, extra, mb_idx)
         # last rank finishing its last chunk completes microbatch mb_idx
         record = (rank == p - 1) & (c_local == v - 1) & (t - rank >= 0) \
             & (g * p + u % p < m)
@@ -153,6 +210,8 @@ def pipeline_apply(
     param_specs=None,
     extras=None,
     interleave: int = 1,
+    param_layout: str = "stacked",
+    rng_key=None,
 ):
     """Run ``layer_fn`` over stacked layers pipelined across ``axis_name``.
 
@@ -173,6 +232,13 @@ def pipeline_apply(
       layers (attention masks, encoder outputs for cross-attention);
       microbatched like ``x`` and delivered to whichever rank is working
       on that microbatch each tick.
+    - param_layout: "stacked" (leaves in logical layer order; V>1 pays
+      a per-step all-to-all re-layout) or "interleaved" (leaves already
+      row-permuted by :func:`interleave_perm`, as Trainer.startup
+      stores them; the re-chunk is then a free local reshape).
+    - rng_key: per-step PRNG key threaded into the schedule when the
+      blocks use dropout in training; folded per (layer, microbatch,
+      data-shard) inside the body. None for deterministic blocks.
     """
     if extras is not None and jax.tree.leaves(extras):
         enforce(all(e.shape[0] == x.shape[0] for e in jax.tree.leaves(extras)),
@@ -180,18 +246,37 @@ def pipeline_apply(
     else:
         extras = None
 
+    from ..framework import rng_fold, rng_scope
+
     if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
-        def _seq(xv, sp, ex):
-            def one(a, lp):
-                out = layer_fn(a, lp) if ex is None else layer_fn(a, lp, ex)
+        enforce(param_layout == "stacked",
+                "interleaved param storage requires a pp axis (size>1) in "
+                "the mesh — the Trainer only permutes rows when one exists")
+        bspec = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+
+        def _seq(xv, sp, ex, key):
+            if key is not None:
+                for a in bspec:
+                    key = jax.random.fold_in(key, jax.lax.axis_index(a))
+
+            def one(a, xs):
+                lp, li = xs
+                # per-layer rng: the scan body is traced once, so without
+                # the fold every layer would reuse one dropout key
+                k = None if key is None else jax.random.fold_in(key, li)
+                with rng_scope(k) if k is not None else rng_fold(li):
+                    out = layer_fn(a, lp) if ex is None else layer_fn(a, lp, ex)
                 return out, None
-            out, _ = jax.lax.scan(one, xv, sp)
+            L_ = jax.tree.leaves(sp)[0].shape[0]
+            out, _ = jax.lax.scan(one, xv, (sp, jnp.arange(L_)))
             return out
         if param_specs is None:
-            return _seq(x, stacked_params, extras)
+            # plain GSPMD trace: the ambient rng is visible, masks shard
+            # globally — rng_fold(layer) is all that is needed
+            return _seq(x, stacked_params, extras, None)
         # degenerate pipeline but tp-parallel stages: layer_fn uses mesh
-        # collectives, so it still needs to run under shard_map
-        bspec = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+        # collectives, so it still needs to run under shard_map; rng (if
+        # any) must be threaded in explicitly and folded per data shard
         bshard = bspec if len(bspec) > 1 else (bspec[0] if bspec else None)
         x_spec = P(bshard, *([None] * (x.ndim - 1)))
         param_spec = jax.tree.map(
@@ -199,10 +284,11 @@ def pipeline_apply(
             stacked_params, param_specs)
         ex_spec = None if extras is None else jax.tree.map(
             lambda e: P(bshard, *([None] * (e.ndim - 1))), extras)
+        key_spec = None if rng_key is None else P()
         return jax.shard_map(_seq, mesh=mesh,
-                             in_specs=(x_spec, param_spec, ex_spec),
+                             in_specs=(x_spec, param_spec, ex_spec, key_spec),
                              out_specs=x_spec, check_vma=False)(
-                                 x, stacked_params, extras)
+                                 x, stacked_params, extras, rng_key)
 
     p = mesh.shape[axis_name]
     v = max(1, int(interleave))
@@ -226,15 +312,26 @@ def pipeline_apply(
     exm = None if extras is None else jax.tree.map(
         lambda e: e.reshape((microbatches, mb) + e.shape[1:]), extras)
 
-    # chunk layout: [L] → [V, P, Lc] → [P, V, Lc] → [P·V, Lc] so that
-    # sharding the leading dim over pp hands rank r its V chunks
-    # {c·P + r} as a contiguous local [V, Lc, ...] block
+    # chunk layout: rank r must hold rows [r·V, (r+1)·V) of a [P·V, Lc]
+    # view, row r·V + c being global chunk c·P + r. "interleaved" rest
+    # layout (Trainer startup, interleave_perm) already has rows in that
+    # order, so the re-chunk is a free local reshape; "stacked" (logical
+    # order) needs [L] → [V, P, Lc] → [P, V, Lc] — a real re-layout that
+    # GSPMD lowers to a per-step all-to-all over pp when V > 1
     Lc = L // (p * v)
-    chunked = jax.tree.map(
-        lambda leaf: jnp.moveaxis(
-            leaf.reshape((v, p, Lc) + leaf.shape[1:]), 0, 1
-        ).reshape((p * v, Lc) + leaf.shape[1:]),
-        stacked_params)
+    if param_layout == "interleaved":
+        chunked = jax.tree.map(
+            lambda leaf: leaf.reshape((p * v, Lc) + leaf.shape[1:]),
+            stacked_params)
+    else:
+        enforce(param_layout == "stacked",
+                f"unknown param_layout {param_layout!r} "
+                "('stacked'|'interleaved')")
+        chunked = jax.tree.map(
+            lambda leaf: jnp.moveaxis(
+                leaf.reshape((v, p, Lc) + leaf.shape[1:]), 0, 1
+            ).reshape((p * v, Lc) + leaf.shape[1:]),
+            stacked_params)
 
     bspec = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
     bshard = bspec if len(bspec) > 1 else (bspec[0] if bspec else None)
@@ -253,13 +350,19 @@ def pipeline_apply(
     body = functools.partial(
         _pp_body, layer_fn=layer_fn, axis_name=axis_name,
         microbatches=microbatches, interleave=v,
-        varying_axes=tuple(mesh.axis_names))
+        varying_axes=tuple(mesh.axis_names),
+        data_axes=tuple(a for a in batch_axes if a in mesh.axis_names
+                        and mesh.shape[a] > 1))
+    key_spec = None if rng_key is None else P()
     # with in-stage tensor parallelism the carried activation is
     # tp-invariant only because layer_fn psums — beyond the static
-    # varying-axes analysis, so drop the VMA check in that case
+    # varying-axes analysis, so drop the VMA check in that case; the
+    # threaded rng (device-varying after the data-axis folds) is also
+    # outside what the static analysis can see
     out = jax.shard_map(body, mesh=mesh,
-                        in_specs=(x_spec, param_spec, ex_spec),
+                        in_specs=(x_spec, param_spec, ex_spec, key_spec),
                         out_specs=x_spec,
-                        check_vma=param_specs is None and extras is None)(
-                            xm, chunked, exm)
+                        check_vma=(param_specs is None and extras is None
+                                   and rng_key is None))(
+                            xm, chunked, exm, rng_key)
     return out.reshape((b,) + x.shape[1:])

@@ -2,13 +2,19 @@
 stages split ACROSS processes — the multi-host pipeline shape (stage
 boundary activations hop the DCN-analog link each microbatch).
 
-    python dist_pp_runner.py <proc_id> <nprocs> <port> <steps>
+    python dist_pp_runner.py <proc_id> <nprocs> <port> <steps> \
+        [dropout] [samemesh]
 
 Each process owns 2 virtual devices; the mesh is {"dp": 2,
 "pp": nprocs} with the pp axis laid across processes, so every
 stage-to-stage transfer crosses the process boundary while dp rides
 inside each process. With nprocs=1 the same script (single device, no
-mesh) is the reference. Prints `LOSS <step> <value>` per step.
+mesh) is the reference. With nprocs=1 and samemesh=1 it instead builds
+the SAME {"pp": 2, "dp": 2} mesh on 4 local devices — the reference
+for dropout runs, where per-step parity requires identical mesh
+positions (the pipeline folds rng per (layer, microbatch, data-shard),
+so only an identical global mesh draws identical masks). Prints
+`LOSS <step> <value>` per step.
 """
 
 import os
@@ -16,7 +22,9 @@ import sys
 
 pid, nprocs, port, steps = (int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
                             int(sys.argv[4]))
-local_devices = 2 if nprocs > 1 else 1
+dropout = float(sys.argv[5]) if len(sys.argv) > 5 else 0.0
+samemesh = len(sys.argv) > 6 and sys.argv[6] == "1"
+local_devices = 2 if nprocs > 1 else (4 if samemesh else 1)
 _flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
           if "xla_force_host_platform_device_count" not in f]
 _flags.append(f"--xla_force_host_platform_device_count={local_devices}")
@@ -54,12 +62,13 @@ def main():
     cfg = transformer.base_config(src_vocab=VOCAB, trg_vocab=VOCAB,
                                   d_model=32, d_inner=64, num_heads=4,
                                   num_encoder_layers=4, num_decoder_layers=4,
-                                  dropout=0.0, stacked=True)
+                                  dropout=dropout, stacked=True)
     prog = pt.build(transformer.make_model(cfg))
-    if nprocs > 1:
+    if nprocs > 1 or samemesh:
         # pp OUTERMOST so its axis spans processes; dp lives inside each
         # process (mesh axes are laid out major-to-minor over devices)
-        mesh = pt.make_mesh({"pp": nprocs, "dp": local_devices})
+        mesh = pt.make_mesh({"pp": 2 if samemesh else nprocs,
+                             "dp": 2 if samemesh else local_devices})
         trainer = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss",
                              mesh=mesh,
                              sharding_rules=transformer_tp_rules(),

@@ -164,28 +164,45 @@ def test_two_process_pipeline_matches_single_process():
     pp axis laid across 2 processes, so stage-boundary activations hop
     the process (DCN-analog) link every microbatch. Per-step losses
     must match single-device training."""
+    ref = _losses(_run_pp(1)[0])
+    outs = _run_pp(2)
+    for out in outs:
+        got = _losses(out)
+        assert got.keys() == ref.keys()
+        for s in ref:
+            np.testing.assert_allclose(got[s], ref[s], rtol=3e-4, atol=3e-4)
+
+
+def _run_pp(nprocs, steps=3, timeout=420, extra=()):
     pp_runner = os.path.join(HERE, "dist_pp_runner.py")
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = (os.path.dirname(HERE) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen(
+        [sys.executable, pp_runner, str(i), str(nprocs), str(port),
+         str(steps), *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        text=True) for i in range(nprocs)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, f"pp trainer failed:\n{err[-3000:]}"
+        outs.append(out)
+    return outs
 
-    def run(nprocs, steps=3, timeout=420):
-        port = _free_port()
-        env = dict(os.environ)
-        env.pop("JAX_PLATFORMS", None)
-        env["PYTHONPATH"] = (os.path.dirname(HERE) + os.pathsep
-                             + env.get("PYTHONPATH", ""))
-        procs = [subprocess.Popen(
-            [sys.executable, pp_runner, str(i), str(nprocs), str(port),
-             str(steps)],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
-            text=True) for i in range(nprocs)]
-        outs = []
-        for p in procs:
-            out, err = p.communicate(timeout=timeout)
-            assert p.returncode == 0, f"pp trainer failed:\n{err[-3000:]}"
-            outs.append(out)
-        return outs
 
-    ref = _losses(run(1)[0])
-    outs = run(2)
+@pytest.mark.slow
+def test_two_process_pipeline_dropout_matches_single_process():
+    """Pipeline dropout across PROCESS boundaries: rng folds per
+    (layer, microbatch, data-shard), all derived from mesh position —
+    so a 2-process {"pp": 2, "dp": 2} run must draw the exact same
+    masks as a 1-process run over the SAME global mesh (samemesh mode),
+    giving per-step loss parity with dropout > 0 (round-4 verdict #5)."""
+    ref = _losses(_run_pp(1, extra=("0.2", "1"))[0])
+    outs = _run_pp(2, extra=("0.2",))
+    assert ref, "reference produced no losses"
     for out in outs:
         got = _losses(out)
         assert got.keys() == ref.keys()

@@ -134,6 +134,67 @@ def test_interleaved_with_dp_and_extras():
                                atol=1e-5, rtol=1e-5)
 
 
+def test_interleave_perm_roundtrip_and_correctness():
+    """param_layout="interleaved": rows pre-permuted by interleave_perm
+    give the same result with no in-step re-layout; argsort inverts."""
+    from paddle_tpu.parallel.pipeline import interleave_perm
+
+    L, p, v = 8, 4, 2
+    perm = interleave_perm(L, p, v)
+    assert sorted(perm) == list(range(L))
+    # row r·v + c (chunk c of rank r) holds global chunk c·p + r
+    Lc = L // (p * v)
+    for r in range(p):
+        for c in range(v):
+            assert perm[(r * v + c) * Lc] == (c * p + r) * Lc
+    inv = np.argsort(perm)
+    mesh = pt.make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    d = 8
+    stacked = _stacked(L, d)
+    x = jnp.asarray(np.random.RandomState(1).randn(16, d).astype(np.float32))
+    pre = jax.tree.map(lambda leaf: leaf[perm], stacked)
+    out = pipeline_apply(x, pre, _layer_fn, mesh, microbatches=4,
+                         interleave=v, batch_axes=(),
+                         param_layout="interleaved")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(x, stacked)),
+                               atol=1e-5, rtol=1e-5)
+    # and the inverse permutation restores logical order
+    np.testing.assert_array_equal(np.asarray(pre["w"][inv]),
+                                  np.asarray(stacked["w"]))
+
+
+def test_interleaved_layout_step_has_no_param_relayout_collective():
+    """round-4 verdict #6 Done-criterion: with the Megatron rest layout
+    the compiled interleaved step contains NO all-to-all — the stacked-
+    layout step pays one per leaf (re-layout fwd) plus the inverse in
+    backward. Activation ppermutes remain in both."""
+    from paddle_tpu.parallel.pipeline import interleave_perm
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = pt.make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    L, d, v = 8, 8, 2
+    stacked = _stacked(L, d)
+    x = jnp.asarray(np.random.RandomState(1).randn(16, d).astype(np.float32))
+
+    def hlo(params, layout):
+        params = jax.tree.map(
+            lambda leaf: jax.device_put(leaf, NamedSharding(mesh, P("pp"))),
+            params)
+
+        def loss(s, xv):
+            return jnp.sum(pipeline_apply(
+                xv, s, _layer_fn, mesh, microbatches=4, interleave=v,
+                batch_axes=(), param_layout=layout) ** 2)
+        return jax.jit(jax.grad(loss)).lower(params, x).compile().as_text()
+
+    h_inter = hlo(jax.tree.map(
+        lambda leaf: leaf[interleave_perm(L, 4, v)], stacked), "interleaved")
+    h_stack = hlo(stacked, "stacked")
+    assert "all-to-all" not in h_inter, "param re-layout survived"
+    assert "collective-permute" in h_inter  # activation ring still there
+    assert "all-to-all" in h_stack  # the cost the new layout removes
+
+
 def test_bubble_fraction_interleave():
     from paddle_tpu.parallel.pipeline import bubble_fraction
 

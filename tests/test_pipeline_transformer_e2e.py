@@ -244,6 +244,46 @@ def test_stacked_params_sharded_over_pp():
 
 
 @pytest.mark.slow
+def test_interleaved_rest_layout_checkpoints_logical(tmp_path):
+    """Trainer with pp_interleave=2 stores stacked rows chunk-
+    interleaved at rest (Megatron layout, no per-step re-layout), but
+    checkpoints in LOGICAL order: a single-device trainer restores the
+    npz directly and matches eval; the interleaved trainer restores its
+    own checkpoint and keeps training."""
+    from paddle_tpu import io as pio
+
+    feed = _feed(8, seed=13)
+    mesh = pt.make_mesh({"dp": 2, "pp": 2}, devices=jax.devices()[:4])
+    prog = pt.build(transformer.make_model(_cfg()))
+    tr = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss", mesh=mesh,
+                    sharding_rules=transformer_tp_rules(),
+                    strategy=DistStrategy(pp_microbatches=4,
+                                          pp_interleave=2))
+    tr.startup(sample_feed=feed)
+    assert tr._pp_perm, "interleaved trainer should have permuted leaves"
+    tr.step(feed)
+    ev = float(tr.eval(feed)["loss"])
+    pio.save_trainer(str(tmp_path / "ck"), tr)
+
+    # logical on disk: a pp-less trainer restores and agrees
+    prog_s = pt.build(transformer.make_model(_cfg()))
+    tr_s = pt.Trainer(prog_s, opt.Adam(1e-3), loss_name="loss")
+    tr_s.startup(sample_feed=feed)
+    pio.load_trainer(str(tmp_path / "ck"), tr_s)
+    np.testing.assert_allclose(float(tr_s.eval(feed)["loss"]), ev,
+                               atol=2e-4, rtol=2e-4)
+
+    # and the interleaved trainer round-trips its own checkpoint
+    before = {k: np.asarray(v) for k, v in tr.scope.params.items()
+              if k in tr._pp_perm}
+    pio.load_trainer(str(tmp_path / "ck"), tr)
+    for k, v in before.items():
+        np.testing.assert_allclose(np.asarray(tr.scope.params[k]), v,
+                                   atol=1e-6)
+    assert np.isfinite(float(tr.step(feed)["loss"]))
+
+
+@pytest.mark.slow
 def test_pipeline_composes_with_grad_accumulation():
     """pp_microbatches × accum_steps: the scan-microbatched feed halves
     feed the pipeline's own microbatching; parity vs plain single-device
@@ -267,10 +307,11 @@ def test_pipeline_composes_with_grad_accumulation():
 
 @pytest.mark.slow
 def test_pipeline_trained_model_eval_and_reshape_restore(tmp_path):
-    """The pp-sharded stacked model evaluates (no pipeline ctx: scan
-    path over pp-sharded params under plain GSPMD) and its sharded
-    checkpoint restores onto a DIFFERENT mesh factoring with identical
-    losses (the pserver slice/merge analog, io.py:881)."""
+    """The pp-sharded stacked model evaluates (eval enters the same
+    pipeline ctx as training, so its collectives ride the same mesh
+    axes) and its sharded checkpoint restores onto a DIFFERENT mesh
+    factoring with identical losses (the pserver slice/merge analog,
+    io.py:881)."""
     from paddle_tpu import io as pio
 
     feed = _feed(16, seed=10)
@@ -351,22 +392,79 @@ def test_stacked_dropout_masks_decorrelate_across_layers():
     assert abs(frac - p_keep ** L) < 0.03,         f"kept {frac:.3f}; shared-mask reuse would keep ~{p_keep}"
 
 
-def test_dropout_rejected_on_pipeline_path():
-    from paddle_tpu.core.errors import EnforceError
+def test_dropout_on_pipeline_path():
+    """The pipeline schedule threads rng per (layer, microbatch,
+    data-shard): training under pp with dropout>0 yields finite,
+    step-deterministic, rng-sensitive losses; eval stays deterministic
+    (round-4 verdict #5, closing layers/stacked.py's old TODO)."""
     from paddle_tpu.framework import pipeline_mode
 
     devs = jax.devices("cpu")[:2]
     mesh = jax.sharding.Mesh(np.array(devs).reshape(2), ("pp",))
-    prog = pt.build(transformer.make_model(_cfg(dropout=0.1)))
+    prog = pt.build(transformer.make_model(_cfg(dropout=0.3)))
     feed = _feed(4)
     params, state = prog.init(jax.random.PRNGKey(0), **feed)
     with pipeline_mode(mesh, microbatches=2):
-        with pytest.raises(EnforceError, match="dropout 0"):
-            prog.apply(params, state, rng=jax.random.PRNGKey(1),
-                       training=True, **feed)
-        # eval is fine under the pipeline (dropout is a no-op there)
-        out, _ = prog.apply(params, state, training=False, **feed)
-        assert np.isfinite(float(out["loss"]))
+        o1, _ = prog.apply(params, state, rng=jax.random.PRNGKey(1),
+                           training=True, **feed)
+        o1b, _ = prog.apply(params, state, rng=jax.random.PRNGKey(1),
+                            training=True, **feed)
+        o2, _ = prog.apply(params, state, rng=jax.random.PRNGKey(2),
+                           training=True, **feed)
+        # same key → same masks; different key → different masks
+        np.testing.assert_allclose(float(o1["loss"]), float(o1b["loss"]),
+                                   rtol=1e-6)
+        assert abs(float(o1["loss"]) - float(o2["loss"])) > 1e-6
+        # eval is deterministic (dropout no-op) and matches the scan
+        # path bit-for-bit outside the pipeline ctx
+        ev, _ = prog.apply(params, state, training=False, **feed)
+    ev_scan, _ = prog.apply(params, state, training=False, **feed)
+    np.testing.assert_allclose(np.asarray(ev["loss"]),
+                               np.asarray(ev_scan["loss"]),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_pipeline_dropout_masks_decorrelate():
+    """Distinct dropout masks per (layer, microbatch): a pp run of an
+    identity stack with dropout must not reuse one mask across layers
+    or across microbatches (the pre-fix failure mode: the scheduled
+    body is traced once, so an unfolded key would repeat)."""
+    from paddle_tpu.framework import pipeline_mode
+    from paddle_tpu.layers.stacked import apply_stacked
+
+    devs = jax.devices("cpu")[:2]
+    mesh = jax.sharding.Mesh(np.array(devs).reshape(2), ("pp",))
+    L, B, D = 2, 4, 64
+    stacked = {"w": jnp.ones((L, 1), jnp.float32)}
+
+    def make_block(num_heads, use_flash, causal, tp_axis, sp_cfg,
+                   dropout_rate=0.0):
+        def block(x, lp):
+            from paddle_tpu.layers.nn import dropout
+            return dropout(x * lp["w"][0], dropout_rate,
+                           dropout_implementation="upscale_in_train")
+        return block
+
+    def net(x):
+        h = apply_stacked(x, stacked, make_block, num_heads=1,
+                          dropout_rate=0.5)
+        return {"out": h, "loss": jnp.mean(h)}
+
+    prog = pt.build(net)
+    x = np.ones((B, D), np.float32)
+    params, state = prog.init(jax.random.PRNGKey(0), x=x)
+    with pipeline_mode(mesh, microbatches=2):
+        out, _ = prog.apply(params, state, rng=jax.random.PRNGKey(7),
+                            training=True, x=x)
+    kept = np.asarray(out["out"]) != 0.0
+    # microbatch 0 = rows [0,2), microbatch 1 = rows [2,4): the two
+    # microbatches must see different composite masks
+    assert not np.array_equal(kept[:2], kept[2:])
+    # and the composite keep-rate of two layers of 0.5-dropout is ~0.25:
+    # a single shared mask across layers would leave ~0.5 — distinguish
+    rate = kept.mean()
+    assert 0.1 < rate < 0.4, rate
 
 
 def test_bubble_fraction():
