@@ -1,0 +1,195 @@
+"""Flash-attention kernel microbench + block-shape sweep (round-4
+verdict #2: re-measure post-dtype-pins, then retune; target >=40% MFU
+at 32k bf16 — kernel ceiling was 33/42 TFLOP/s fwd/bwd pre-pins).
+
+    python tools/flash_microbench.py                    # default sweep
+    python tools/flash_microbench.py --seq 32768 --sweep 1024x1024,512x2048
+
+Times the repo kernel (ops/flash_attention.py) fwd and fwd+bwd at the
+flagship long-context shape over a grid of (block_q, block_k), plus —
+when the jax pallas reference kernel is importable — the same shape
+through jax.experimental.pallas.ops.tpu.flash_attention as an
+independent ceiling probe (comparison only; nothing is vendored).
+Appends one JSON line per measurement to profiles/flash_microbench.jsonl
+so link_watch can fire it opportunistically and partial sweeps still
+land. MFU is against the measured-matmul peak (core.flops), matching
+bench.py's accounting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from bench import _init_jax  # one copy of the axon/cache workarounds
+
+
+def attn_flops(b, h, sq, sk, d, causal):
+    """MXU flops of one attention fwd: qk^T + pv = 2 * 2*sq*sk*d per
+    (b,h); causal halves the score rectangle."""
+    f = 4.0 * b * h * sq * sk * d
+    return f / 2 if causal else f
+
+
+def _time(fn, args, iters, jax):
+    # two warmups (compile + first dispatch), then a blocked timing loop;
+    # device_get of a leaf forces a real sync on the axon transport
+    for _ in range(2):
+        r = fn(*args)
+    jax.device_get(jax.tree.leaves(r)[0].ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(*args)
+    jax.device_get(jax.tree.leaves(r)[0].ravel()[0])
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=32768)
+    ap.add_argument("--head_dim", type=int, default=64)
+    ap.add_argument("--causal", type=int, default=1)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--sweep", default="1024x1024,512x1024,1024x512,"
+                                       "512x2048,2048x512,512x512")
+    ap.add_argument("--bwd", type=int, default=1)
+    ap.add_argument("--reference", type=int, default=1,
+                    help="also time the jax pallas reference kernel")
+    ap.add_argument("--out", default=os.path.join(
+        ROOT, "profiles", "flash_microbench.jsonl"))
+    args = ap.parse_args()
+
+    jax = _init_jax()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.core import flops as F
+    from paddle_tpu.ops.flash_attention import flash_attention
+
+    dev = jax.devices()[0]
+    on_cpu = dev.platform == "cpu"
+    peak, peak_src = F.device_peak_flops(dev)
+    b, h, s, d = args.batch, args.heads, args.seq, args.head_dim
+    causal = bool(args.causal)
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16)
+    fwd_f = attn_flops(b, h, s, s, d, causal)
+    # bwd: dq(qk^T+dsk) + dkv(p^T g + g v^T + ds^T q) ~= 2.5x fwd MXU work
+    bwd_f = fwd_f * 2.5
+
+    outdir = os.path.dirname(args.out)
+    if outdir:
+        os.makedirs(outdir, exist_ok=True)
+    shape_key = {"b": b, "h": h, "seq": s, "d": d, "causal": causal}
+    # resume: a killed sweep (link_watch runs under timeout) must not
+    # re-measure what already landed — prior good rows for this exact
+    # shape are skipped so retries spend the window on the tail
+    done = set()
+    if os.path.exists(args.out):
+        with open(args.out) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if r.get("shape") == shape_key and "error" not in r:
+                    done.add((r.get("kernel"), r.get("pass"),
+                              r.get("block_q"), r.get("block_k")))
+    rows = []
+
+    def record(row):
+        row.update({"device": getattr(dev, "device_kind", str(dev)),
+                    "peak_flops": peak, "peak_source": peak_src,
+                    "shape": {"b": b, "h": h, "seq": s, "d": d,
+                              "causal": causal},
+                    "ts": time.time()})
+        rows.append(row)
+        with open(args.out, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        print(json.dumps(row))
+
+    for spec in args.sweep.split(","):
+        bq, bk = (int(x) for x in spec.strip().split("x"))
+
+        @jax.jit
+        def fwd(q, k, v, bq=bq, bk=bk):
+            return flash_attention(q, k, v, causal=causal,
+                                   block_q=bq, block_k=bk)
+
+        if ("repo", "fwd", bq, bk) in done:
+            print(f"# skip fwd {bq}x{bk} (already recorded)")
+        else:
+            try:
+                dt = _time(fwd, (q, k, v), args.iters, jax)
+                record({"kernel": "repo", "pass": "fwd", "block_q": bq,
+                        "block_k": bk, "ms": round(dt * 1e3, 3),
+                        "tflops": round(fwd_f / dt / 1e12, 2),
+                        "mfu": round(fwd_f / dt / peak, 4)})
+            except Exception as e:
+                record({"kernel": "repo", "pass": "fwd", "block_q": bq,
+                        "block_k": bk,
+                        "error": f"{type(e).__name__}: {e}"[:200]})
+                continue
+        if args.bwd and ("repo", "fwd+bwd", bq, bk) in done:
+            print(f"# skip fwd+bwd {bq}x{bk} (already recorded)")
+        elif args.bwd:
+            @jax.jit
+            def both(q, k, v, bq=bq, bk=bk):
+                def loss(q, k, v):
+                    return flash_attention(
+                        q, k, v, causal=causal, block_q=bq,
+                        block_k=bk).astype(jnp.float32).sum()
+                return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+            try:
+                dt = _time(both, (q, k, v), max(2, args.iters // 2), jax)
+                record({"kernel": "repo", "pass": "fwd+bwd", "block_q": bq,
+                        "block_k": bk, "ms": round(dt * 1e3, 3),
+                        "tflops": round((fwd_f + bwd_f) / dt / 1e12, 2),
+                        "mfu": round((fwd_f + bwd_f) / dt / peak, 4)})
+            except Exception as e:
+                record({"kernel": "repo", "pass": "fwd+bwd", "block_q": bq,
+                        "block_k": bk,
+                        "error": f"{type(e).__name__}: {e}"[:200]})
+
+    if args.reference and not on_cpu and \
+            ("jax_reference", "fwd", None, None) not in done:
+        # independent ceiling probe: the public jax pallas TPU kernel
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention as jref)
+
+            @jax.jit
+            def ref_fwd(q, k, v):
+                return jref(q, k, v, causal=causal)
+
+            dt = _time(ref_fwd, (q, k, v), args.iters, jax)
+            record({"kernel": "jax_reference", "pass": "fwd",
+                    "ms": round(dt * 1e3, 3),
+                    "tflops": round(fwd_f / dt / 1e12, 2),
+                    "mfu": round(fwd_f / dt / peak, 4)})
+        except Exception as e:
+            record({"kernel": "jax_reference", "pass": "fwd",
+                    "error": f"{type(e).__name__}: {e}"[:200]})
+
+    good = [r for r in rows if r.get("pass") == "fwd" and "mfu" in r
+            and r["kernel"] == "repo"]
+    if good:
+        best = max(good, key=lambda r: r["mfu"])
+        print(f"# best fwd: {best['block_q']}x{best['block_k']} "
+              f"{best['tflops']} TFLOP/s ({best['mfu']:.1%} MFU)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
