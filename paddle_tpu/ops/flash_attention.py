@@ -98,6 +98,38 @@ def _pad_seq(x, target, axis, value=0.0):
     return jnp.pad(x, widths, constant_values=value)
 
 
+def _kj_clamp(causal, block_q, block_k, nk, offset):
+    """Index clamp for K/V-side blocks in causal kernels: iterations
+    past a q-row's last useful key block keep requesting the SAME block
+    index, and Pallas's pipelining skips the HBM→VMEM DMA when the
+    index does not change — the compute for those iterations is already
+    gated off by ``run``, so without this the skipped upper-triangle
+    tiles still paid their (dominant) K/V fetch bandwidth. Last useful
+    kj for q row qi: floor(((qi+1)·bq + offset − 1)/bk), clamped to
+    [0, nk−1]."""
+    if not causal:
+        return lambda kk, j: kk
+
+    def clamp(kk, j):
+        last = ((j + 1) * block_q + offset - 1) // block_k
+        return jnp.minimum(kk, jnp.clip(last, 0, nk - 1))
+    return clamp
+
+
+def _qi_clamp(causal, block_q, block_k, nq, offset):
+    """Mirror of :func:`_kj_clamp` for the dkv kernel's Q-side blocks:
+    iterations before a key block's first useful q row re-request the
+    first useful block. First useful qi for key block kj:
+    max(0, floor((kj·bk − offset)/bq))."""
+    if not causal:
+        return lambda kk, j: kk
+
+    def clamp(kk, j):
+        first = jnp.clip((j * block_k - offset) // block_q, 0, nq - 1)
+        return jnp.maximum(kk, first)
+    return clamp
+
+
 # ---------------------------------------------------------------------------
 # forward
 
@@ -185,23 +217,26 @@ def _flash_fwd(q, k, v, bias, seg_q, seg_k, causal: bool,
     k_r = k.reshape(bh, sk, d)
     v_r = v.reshape(bh, sk, d)
 
+    ck = _kj_clamp(causal, block_q, block_k, nk, sk_orig - sq_orig)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
-        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, ck(kk, j), 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, ck(kk, j), 0)),
     ]
     args = [q_r, k_r, v_r]
     have_bias = bias is not None
     have_seg = seg_q is not None
     if have_bias:
         bias_r = jnp.broadcast_to(bias[:, None, :], (b, h, sk)).reshape(bh, 1, sk)
-        in_specs.append(pl.BlockSpec((1, 1, block_k), lambda i, j, kk: (i, 0, kk)))
+        in_specs.append(pl.BlockSpec((1, 1, block_k),
+                                     lambda i, j, kk: (i, 0, ck(kk, j))))
         args.append(bias_r.astype(jnp.float32))
     if have_seg:
         segq_r = jnp.broadcast_to(seg_q[:, None, :], (b, h, sq)).reshape(bh, sq)
         segk_r = jnp.broadcast_to(seg_k[:, None, :], (b, h, sk)).reshape(bh, sk)
         in_specs.append(pl.BlockSpec((1, block_q), lambda i, j, kk: (i, j)))
-        in_specs.append(pl.BlockSpec((1, block_k), lambda i, j, kk: (i, kk)))
+        in_specs.append(pl.BlockSpec((1, block_k),
+                                     lambda i, j, kk: (i, ck(kk, j))))
         args += [segq_r.astype(jnp.int32), segk_r.astype(jnp.int32)]
 
     def kernel(*refs):
@@ -370,19 +405,24 @@ def _flash_bwd(q, k, v, bias, seg_q, seg_k, causal, out, lse, g,
         segk_r = jnp.broadcast_to(seg_k[:, None, :], (b, h, sk)) \
             .reshape(bh, sk).astype(jnp.int32)
 
-    # ---- dq pass: grid (bh, nq, nk), K/V streamed on the inner dim
+    # ---- dq pass: grid (bh, nq, nk), K/V streamed on the inner dim;
+    # causal iterations past the diagonal re-request the same block so
+    # their DMA is skipped (see _kj_clamp)
+    ck = _kj_clamp(causal, block_q, block_k, nk, causal_offset)
     dq_specs = [
         pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
-        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, kk, 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, ck(kk, j), 0)),
+        pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, ck(kk, j), 0)),
     ]
     dq_args = [q_r, k_r, v_r]
     if have_bias:
-        dq_specs.append(pl.BlockSpec((1, 1, block_k), lambda i, j, kk: (i, 0, kk)))
+        dq_specs.append(pl.BlockSpec((1, 1, block_k),
+                                     lambda i, j, kk: (i, 0, ck(kk, j))))
         dq_args.append(bias_r)
     if have_seg:
         dq_specs.append(pl.BlockSpec((1, block_q), lambda i, j, kk: (i, j)))
-        dq_specs.append(pl.BlockSpec((1, block_k), lambda i, j, kk: (i, kk)))
+        dq_specs.append(pl.BlockSpec((1, block_k),
+                                     lambda i, j, kk: (i, ck(kk, j))))
         dq_args += [segq_r, segk_r]
     dq_specs += [
         pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, j, 0)),
@@ -413,9 +453,12 @@ def _flash_bwd(q, k, v, bias, seg_q, seg_k, causal, out, lse, g,
         interpret=interpret,
     )(*dq_args)
 
-    # ---- dk/dv pass: grid (bh, nk, nq), Q/dO streamed on the inner dim
+    # ---- dk/dv pass: grid (bh, nk, nq), Q/dO streamed on the inner
+    # dim; causal iterations before a key block's first useful q row
+    # re-request that first block (DMA skipped, see _qi_clamp)
+    cq = _qi_clamp(causal, block_q, block_k, nq, causal_offset)
     dkv_specs = [
-        pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, kk, 0)),
+        pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, cq(kk, j), 0)),
         pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda i, j, kk: (i, j, 0)),
     ]
@@ -424,13 +467,14 @@ def _flash_bwd(q, k, v, bias, seg_q, seg_k, causal, out, lse, g,
         dkv_specs.append(pl.BlockSpec((1, 1, block_k), lambda i, j, kk: (i, 0, j)))
         dkv_args.append(bias_r)
     if have_seg:
-        dkv_specs.append(pl.BlockSpec((1, block_q), lambda i, j, kk: (i, kk)))
+        dkv_specs.append(pl.BlockSpec((1, block_q),
+                                      lambda i, j, kk: (i, cq(kk, j))))
         dkv_specs.append(pl.BlockSpec((1, block_k), lambda i, j, kk: (i, j)))
         dkv_args += [segq_r, segk_r]
     dkv_specs += [
-        pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, kk, 0)),
-        pl.BlockSpec((1, block_q), lambda i, j, kk: (i, kk)),
-        pl.BlockSpec((1, block_q), lambda i, j, kk: (i, kk)),
+        pl.BlockSpec((1, block_q, d), lambda i, j, kk: (i, cq(kk, j), 0)),
+        pl.BlockSpec((1, block_q), lambda i, j, kk: (i, cq(kk, j))),
+        pl.BlockSpec((1, block_q), lambda i, j, kk: (i, cq(kk, j))),
     ]
     dkv_args += [g_r, lse_r, delta_r]
 
