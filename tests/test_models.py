@@ -96,6 +96,7 @@ def test_transformer_learns_copy_task():
     assert losses[-1] < losses[0] * 0.5, f"{losses[0]} -> {losses[-1]}"
 
 
+@pytest.mark.slow  # >20s on the 1-core host (smoke budget, r5 #9)
 def test_transformer_flash_matches_xla():
     feed = _translation_batch(bs=2, s=32)
     m_x = pt.build(transformer.make_model(_tiny_transformer_cfg(use_flash=False)))
@@ -184,6 +185,7 @@ def test_transformer_fused_qkv_tp_sharding():
     assert np.isfinite(float(out["loss"]))
 
 
+@pytest.mark.slow  # >20s on the 1-core host (smoke budget, r5 #9)
 def test_transformer_tp_sharding_compiles():
     """TP+DP mesh on 8 virtual devices — the multi-chip path at toy size."""
     mesh = pt.make_mesh({"dp": 2, "tp": 4})

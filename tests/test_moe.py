@@ -23,6 +23,7 @@ def _input(b=8, s=4, d=16, seed=0):
     return rng.randn(b, s, d).astype(np.float32)
 
 
+@pytest.mark.slow  # >20s on the 1-core host (smoke budget, r5 #9)
 def test_ep_matches_dense():
     x = _input()
     dense = _build(None)
@@ -41,6 +42,7 @@ def test_ep_matches_dense():
     assert np.isfinite(float(out_e["aux"])) and float(out_e["aux"]) >= 1.0 - 1e-5
 
 
+@pytest.mark.slow  # >20s on the 1-core host (smoke budget, r5 #9)
 def test_ep_with_dp_axis():
     x = _input(b=8)
     dense = _build(None)

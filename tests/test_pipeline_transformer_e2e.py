@@ -392,6 +392,7 @@ def test_stacked_dropout_masks_decorrelate_across_layers():
     assert abs(frac - p_keep ** L) < 0.03,         f"kept {frac:.3f}; shared-mask reuse would keep ~{p_keep}"
 
 
+@pytest.mark.slow  # >20s on the 1-core host (smoke budget, r5 #9)
 def test_dropout_on_pipeline_path():
     """The pipeline schedule threads rng per (layer, microbatch,
     data-shard): training under pp with dropout>0 yields finite,

@@ -1,0 +1,68 @@
+"""Smoke-tier budget checker (VERDICT r4 #9): the tier must stay under
+its wall-clock budget and no single smoke test may exceed the per-test
+cap — otherwise it silently drifts back past the 10-minute goal the
+way rounds 3→4 showed.
+
+    python -m pytest tests/ -m "not slow" -q     # writes the record
+    python tools/smoke_budget.py                 # checks it
+
+Reads tests/.last_run_durations.json (written by the conftest
+pytest_terminal_summary hook on any ≥100-test run) and exits non-zero
+when the budget is violated, printing the offenders to demote with
+@pytest.mark.slow.
+
+Both budgets are on SUMMED per-test call seconds — the serial cost of
+the tier, which is what drifts as tests accumulate and equals wall
+time on the 1-core build host (parallel CI runners finish sooner but
+the serial cost is still the thing to keep bounded). A record from a
+partial tier run (aborted, or a file subset) is refused via the
+MIN_TESTS floor rather than silently passing the wrong data.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RECORD = os.path.join(ROOT, "tests", ".last_run_durations.json")
+
+PER_TEST_CAP_S = 20.0
+TIER_BUDGET_S = 900.0  # summed call seconds (~wall on the 1-core host)
+MIN_TESTS = 600        # the tier is ~680 tests; fewer = partial record
+
+
+def main():
+    if not os.path.exists(RECORD):
+        print(f"no record at {RECORD} — run the smoke tier first "
+              "(python -m pytest tests/ -m 'not slow' -q)")
+        return 2
+    rec = json.load(open(RECORD))
+    if "not slow" not in rec.get("markexpr", ""):
+        print(f"last recorded run used markexpr={rec.get('markexpr')!r}, "
+              "not the smoke tier — re-run with -m 'not slow'")
+        return 2
+    if rec.get("num_tests", 0) < MIN_TESTS:
+        print(f"record holds only {rec.get('num_tests')} tests "
+              f"(< {MIN_TESTS}) — a partial/aborted run; re-run the full "
+              "tier (python -m pytest tests/ -m 'not slow' -q)")
+        return 2
+    over = {k: v for k, v in rec["durations"].items() if v > PER_TEST_CAP_S}
+    total = rec["total_s"]
+    print(f"smoke tier: {rec['num_tests']} tests, {total:.0f}s summed call "
+          f"time (budget {TIER_BUDGET_S:.0f}s), "
+          f"{len(over)} over the {PER_TEST_CAP_S:.0f}s per-test cap")
+    rc = 0
+    for k, v in sorted(over.items(), key=lambda kv: -kv[1]):
+        print(f"  DEMOTE to @pytest.mark.slow: {v:7.1f}s  {k}")
+        rc = 1
+    if total > TIER_BUDGET_S:
+        print(f"  TIER OVER BUDGET by {total - TIER_BUDGET_S:.0f}s — demote "
+              "the slowest tests above or split compile-heavy cases")
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
