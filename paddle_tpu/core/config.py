@@ -113,6 +113,14 @@ define_flag("rng_impl", "auto",
             "native RngBitGenerator on TPU (threefry synthesizes random "
             "bits from many VPU ops and can dominate dropout-heavy "
             "steps) and threefry elsewhere / under determinism")
+define_flag("flash_block_q", 0,
+            "flash-attention q-block rows; 0 = kernel default "
+            "(ops/flash_attention.DEFAULT_BLOCK_Q). Env "
+            "PDTPU_FLASH_BLOCK_Q lets an on-chip sweep winner "
+            "(tools/flash_microbench.py) apply without a code edit")
+define_flag("flash_block_k", 0,
+            "flash-attention k-block rows; 0 = kernel default "
+            "(see flash_block_q)")
 
 
 def default_rng_impl() -> str:

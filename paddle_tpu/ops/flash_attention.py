@@ -39,6 +39,30 @@ from jax.experimental.pallas import tpu as pltpu
 # 42 TFLOP/s bwd vs 19/29 at (512, 512); 2048 blocks exceed the 16MB VMEM
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
+
+
+def resolve_block_shapes(block_q, block_k):
+    """Resolve block sizes: explicit args win; None falls to the
+    ``flash_block_q``/``flash_block_k`` config flags (env
+    ``PDTPU_FLASH_BLOCK_Q``/``_K`` — a microbench sweep winner applies
+    without a code edit), flag 0 to the chip-tuned module defaults.
+    Validated here so a typo'd env value fails naming the flag instead
+    of as a Mosaic tiling error deep in kernel lowering. NOTE: like all
+    shape-affecting knobs this is read at TRACE time — set the flag
+    (or env) before the first jit compilation of the calling step;
+    already-cached executables keep their block shapes."""
+    from ..core.config import get_flag
+    from ..core.errors import enforce
+
+    if block_q is None:
+        block_q = get_flag("flash_block_q") or DEFAULT_BLOCK_Q
+    if block_k is None:
+        block_k = get_flag("flash_block_k") or DEFAULT_BLOCK_K
+    for name, val in (("flash_block_q", block_q), ("flash_block_k", block_k)):
+        enforce(isinstance(val, int) and val > 0 and val % 8 == 0,
+                f"{name}: block size must be a positive multiple of 8 "
+                f"(TPU sublane tiling), got {val!r}")
+    return block_q, block_k
 NEG_INF = -1e30
 LANES = 128  # lane width for 1-d-per-row scratch (m/l/lse/delta)
 
@@ -550,8 +574,8 @@ def flash_attention(
     key_bias: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     return_lse: bool = False,
 ):
@@ -564,11 +588,15 @@ def flash_attention(
       attention).
     - ``attn_mask``: a [b,1,1,s_k] additive mask is converted to a key
       bias; any other dense mask falls back to the XLA composition.
+    - ``block_q``/``block_k``: None resolves the ``flash_block_q``/``_k``
+      config flags then the chip-tuned defaults — see
+      :func:`resolve_block_shapes` (read at trace time).
     - ``return_lse``: also return the per-query logsumexp [b, h, s_q]
       (forward only — used by ring attention to merge shards).
     """
     from ..core.errors import enforce
 
+    block_q, block_k = resolve_block_shapes(block_q, block_k)
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
     enforce(kv_segment_ids is None or segment_ids is not None,

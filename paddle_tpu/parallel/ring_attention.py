@@ -324,8 +324,8 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = False,
     batch_axes: Optional[tuple] = ("dp", "fsdp"),
-    block_q: int = fa.DEFAULT_BLOCK_Q,
-    block_k: int = fa.DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     schedule: str = "auto",
     layout: str = "natural",
 ):
@@ -333,7 +333,9 @@ def ring_attention(
 
     Batch may additionally be sharded over ``batch_axes``; heads stay
     unsharded here (combine with TP by sharding h outside via shard_map
-    composition).
+    composition). ``block_q``/``block_k`` default through the same
+    flag resolution as :func:`flash_attention` (flash_block_q/_k), so
+    a tuned block shape reaches the ring schedules too.
 
     ``schedule``: "auto" picks the load-balanced "zigzag" for causal
     attention (falling back to "ring" when s is not divisible by 2n) and
@@ -348,6 +350,7 @@ def ring_attention(
             f"unknown schedule {schedule!r} (auto|ring|zigzag)")
     enforce(layout in ("natural", "zigzag"),
             f"unknown layout {layout!r} (natural|zigzag)")
+    block_q, block_k = fa.resolve_block_shapes(block_q, block_k)
     if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
         # degenerate ring: single-shard flash attention
         return fa.flash_attention(q, k, v, causal=causal,

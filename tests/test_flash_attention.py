@@ -313,3 +313,36 @@ def test_bf16_kernel_close_to_f32_reference():
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b),
                                    rtol=0.1, atol=0.1)
+
+
+def test_block_shape_flags_resolve():
+    """block_q/block_k=None resolve the flash_block_* config flags (a
+    microbench sweep winner applies via PDTPU_FLASH_BLOCK_* without a
+    code edit); 0 means the chip-tuned defaults; explicit args always
+    win. Asserts the RESOLVED values (output is block-size-invariant,
+    so numerics alone cannot catch the flags being ignored)."""
+    from paddle_tpu.core.config import get_flag, set_flag
+    from paddle_tpu.core.errors import EnforceError
+
+    assert fa.resolve_block_shapes(None, None) == (fa.DEFAULT_BLOCK_Q,
+                                                   fa.DEFAULT_BLOCK_K)
+    assert fa.resolve_block_shapes(256, None) == (256, fa.DEFAULT_BLOCK_K)
+    old_q, old_k = get_flag("flash_block_q"), get_flag("flash_block_k")
+    try:
+        set_flag("flash_block_q", 64)
+        set_flag("flash_block_k", 64)
+        assert fa.resolve_block_shapes(None, None) == (64, 64)
+        assert fa.resolve_block_shapes(128, 128) == (128, 128)  # args win
+        # a typo'd value fails loudly, naming the flag
+        set_flag("flash_block_k", 100)
+        with pytest.raises(EnforceError, match="flash_block_k"):
+            fa.resolve_block_shapes(None, None)
+        # and the end-to-end path consumes the flag (numerics unchanged)
+        set_flag("flash_block_k", 64)
+        q, k, v = _rand(s=128)
+        np.testing.assert_allclose(np.asarray(fa.flash_attention(q, k, v)),
+                                   np.asarray(_ref(q, k, v)),
+                                   atol=2e-5, rtol=2e-5)
+    finally:
+        set_flag("flash_block_q", old_q)
+        set_flag("flash_block_k", old_k)
