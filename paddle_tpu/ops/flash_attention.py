@@ -102,7 +102,16 @@ def _block_scores(q_ref, k_ref, bias_ref, segq_ref, segk_ref, qi, kj, *,
     if segq_ref is not None:
         s = _segment_mask(s, segq_ref[0], segk_ref[0])
     if causal:
-        s = _causal_mask(s, qi, kj, block_q, block_k, causal_offset)
+        # only diagonal-crossing tiles pay the mask's iota/compare/
+        # select VPU work; a tile is fully visible when its last key
+        # index is within the FIRST query row's allowance. The kernel
+        # is VPU-bound (exp + reductions), so shaving mask ops off the
+        # interior tiles is real time, not noise.
+        fully_visible = (kj + 1) * block_k - 1 <= qi * block_q + causal_offset
+        s = jax.lax.cond(
+            fully_visible, lambda t: t,
+            lambda t: _causal_mask(t, qi, kj, block_q, block_k,
+                                   causal_offset), s)
     return q, kb, s
 
 
