@@ -64,7 +64,7 @@ def test_reduce_scatter_wire_is_result_times_n_minus_1():
     assert _wire_factor("all-reduce", 4) == pytest.approx(1.5)
 
 
-def _trainer(mesh, rules):
+def _trainer(mesh, rules, strategy=None):
     cfg = transformer.base_config(src_vocab=64, trg_vocab=64, d_model=32,
                                   d_inner=64, num_heads=4, num_encoder_layers=2,
                                   num_decoder_layers=2, dropout=0.0)
@@ -74,7 +74,7 @@ def _trainer(mesh, rules):
             "trg_ids": rng.randint(3, 64, (8, 16)).astype(np.int32),
             "labels": rng.randint(3, 64, (8, 16)).astype(np.int32)}
     tr = pt.Trainer(prog, opt.Adam(1e-3), loss_name="loss", mesh=mesh,
-                    sharding_rules=rules)
+                    sharding_rules=rules, strategy=strategy)
     tr.startup(sample_feed=feed)
     return tr, feed
 
@@ -153,3 +153,25 @@ def test_collective_report_3d_mesh_shows_sharding_collectives():
     assert "all-gather" in kinds_3d, rep_3d  # fsdp param gathers
     assert len(kinds_3d) > 1, rep_3d  # not just the grad all-reduce
     assert rep_3d["est_wire_mb_per_device"] > 0
+
+
+@pytest.mark.slow
+def test_accum_steps_do_not_multiply_grad_allreduce():
+    """SCALING.md's accumulation lever rests on this: accum_steps=N
+    microbatches inside the step exchange gradients ONCE per optimizer
+    step (the scan accumulates locally; XLA hoists the all-reduce out),
+    so comm per exchange is constant while compute scales N-fold."""
+    from paddle_tpu.parallel import DistStrategy
+
+    mesh = pt.make_mesh({"dp": 8})
+    reps = {}
+    for accum in (1, 4):
+        tr, feed = _trainer(mesh, pt.parallel.replicated(),
+                            strategy=DistStrategy(accum_steps=accum))
+        reps[accum] = debugger.collective_report(tr, feed)["collectives"]
+    ar1 = reps[1]["all-reduce"]
+    ar4 = reps[4]["all-reduce"]
+    # static-walk counts: the in-scan microbatch loop must not multiply
+    # the grad exchange; payloads stay on the same order
+    assert ar4["count"] <= ar1["count"] + 2, (ar1, ar4)
+    assert ar4["payload_mb"] < ar1["payload_mb"] * 1.5, (ar1, ar4)
