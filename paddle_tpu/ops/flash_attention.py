@@ -92,27 +92,48 @@ def _block_scores(q_ref, k_ref, bias_ref, segq_ref, segk_ref, qi, kj, *,
     every kernel matmul at the ~1/8-rate f32 MXU path and capped the
     whole kernel at ~17% MFU). Softmax state and masks are f32. The
     scale is applied to the f32 scores, not the bf16 operand. Returns
-    (q, k) UNSCALED in their native dtype plus the scaled f32 scores."""
+    (q, k) UNSCALED in their native dtype, the scaled f32 scores, and
+    ``masked`` — a (possibly traced) bool: can this tile contain
+    NEG_INF scores? The kernels gate :func:`_zero_masked`'s per-element
+    compare/select on it, and the causal mask itself runs only on
+    diagonal-crossing tiles (a tile is fully visible when its last key
+    index is within the FIRST query row's allowance). The kernel is
+    VPU-bound (exp + reductions), so shaving mask ops off interior
+    tiles is real time, not noise."""
     q = q_ref[0]
     kb = k_ref[0]
     s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+    masked = bias_ref is not None or segq_ref is not None
     if bias_ref is not None:
         s = s + bias_ref[0, 0, :][None, :]
     if segq_ref is not None:
         s = _segment_mask(s, segq_ref[0], segk_ref[0])
     if causal:
-        # only diagonal-crossing tiles pay the mask's iota/compare/
-        # select VPU work; a tile is fully visible when its last key
-        # index is within the FIRST query row's allowance. The kernel
-        # is VPU-bound (exp + reductions), so shaving mask ops off the
-        # interior tiles is real time, not noise.
         fully_visible = (kj + 1) * block_k - 1 <= qi * block_q + causal_offset
         s = jax.lax.cond(
             fully_visible, lambda t: t,
             lambda t: _causal_mask(t, qi, kj, block_q, block_k,
                                    causal_offset), s)
-    return q, kb, s
+        if not masked:  # keep python True static; only upgrade False
+            masked = jnp.logical_not(fully_visible)
+    return q, kb, s, masked
+
+
+def _maybe_zero_masked(p, s, masked):
+    """Apply :func:`_zero_masked` only when the tile can actually hold
+    masked scores. Three cases, two static: ``masked`` is python False
+    for unmasked dense attention (no select at all) and python True
+    when a bias/segment mask is statically present without causal
+    (unconditional select, no dead cond); a traced bool on the causal
+    path (cond skips the per-element compare/select on interior
+    tiles)."""
+    if masked is False:
+        return p
+    if masked is True:
+        return _zero_masked(p, s)
+    return jax.lax.cond(masked, lambda t: _zero_masked(t, s),
+                        lambda t: t, p)
 
 
 def _zero_masked(p, s):
@@ -185,15 +206,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
 
     @pl.when(run)
     def _step():
-        _, _, s = _block_scores(q_ref, k_ref, bias_ref, segq_ref, segk_ref,
-                                qi, kj, scale=scale, causal=causal,
-                                block_q=block_q, block_k=block_k,
-                                causal_offset=causal_offset)
+        _, _, s, masked = _block_scores(
+            q_ref, k_ref, bias_ref, segq_ref, segk_ref,
+            qi, kj, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+            causal_offset=causal_offset)
         vb = v_ref[0]
         m_prev = m_scr[:, 0]
         l_prev = l_scr[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        p = _zero_masked(jnp.exp(s - m_new[:, None]), s)
+        p = _maybe_zero_masked(jnp.exp(s - m_new[:, None]), s, masked)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=1)
         m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
@@ -332,15 +354,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
 
     @pl.when(run)
     def _step():
-        _, kb, s = _block_scores(q_ref, k_ref, bias_ref, segq_ref, segk_ref,
-                                 qi, kj, scale=scale, causal=causal,
-                                 block_q=block_q, block_k=block_k,
-                                 causal_offset=causal_offset)
+        _, kb, s, masked = _block_scores(
+            q_ref, k_ref, bias_ref, segq_ref, segk_ref,
+            qi, kj, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+            causal_offset=causal_offset)
         vb = v_ref[0]
         g = g_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
-        p = _zero_masked(jnp.exp(s - lse[:, None]), s)
+        p = _maybe_zero_masked(jnp.exp(s - lse[:, None]), s, masked)
         dp = jax.lax.dot_general(g, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
@@ -369,15 +392,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, segq_ref, segk_ref,
 
     @pl.when(run)
     def _step():
-        q, _, s = _block_scores(q_ref, k_ref, bias_ref, segq_ref, segk_ref,
-                                qi, kj, scale=scale, causal=causal,
-                                block_q=block_q, block_k=block_k,
-                                causal_offset=causal_offset)
+        q, _, s, masked = _block_scores(
+            q_ref, k_ref, bias_ref, segq_ref, segk_ref,
+            qi, kj, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+            causal_offset=causal_offset)
         vb = v_ref[0]
         g = g_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
-        p = _zero_masked(jnp.exp(s - lse[:, None]), s)  # [bq, bk]
+        p = _maybe_zero_masked(jnp.exp(s - lse[:, None]), s, masked)  # [bq, bk]
         # dv += p^T g
         dv_scr[...] += jax.lax.dot_general(
             p.astype(g.dtype), g, (((0,), (0,)), ((), ())),

@@ -40,14 +40,14 @@ def test_project_algebra_exact():
 
 
 def test_levers_monotonic_and_model_shards():
-    """The 4x levers can only help and compose; tp·pp model shards
-    shrink the dp ring bytes."""
+    """The levers can only help and compose; tp·pp model shards shrink
+    the dp ring bytes."""
     full = {"flops": 5e12, "grad_bytes": 440e6}
     row = scaling_model.project("nosuch", full)
     naive = row["efficiency_at_256"]
-    one = row["efficiency_at_256_one_lever_4x"]
-    both = row["efficiency_at_256_int8_accum4"]
-    assert naive <= one <= both
+    i8 = row["efficiency_at_256_int8"]
+    both = row["efficiency_at_256_int8_2x_batch"]
+    assert naive <= i8 <= both
     assert both >= 0.7, "BERT-shaped config must clear the target"
     sharded = scaling_model.project("nosuch",
                                     dict(full, model_shards=4))
@@ -66,13 +66,17 @@ def test_committed_record_structure():
         assert row["collectives"], name
         pj = row["projection_v5e_256"]
         assert 0.0 < pj["efficiency_at_256"] <= 1.0
-        assert (pj["efficiency_at_256_int8_accum4"]
-                >= pj["efficiency_at_256_one_lever_4x"]
+        assert (pj["efficiency_at_256_int8_2x_batch"]
+                >= pj["efficiency_at_256_int8"]
                 >= pj["efficiency_at_256"])
-    # the >=70% commitment of SCALING.md §2, for the pod-scale configs
-    for name in ("resnet50", "transformer", "bert", "deepfm"):
+    # the >=70% commitment of SCALING.md §2: the three throughput
+    # configs clear it with shipped levers; deepfm's committed answer
+    # is the async PS (sync roofline honestly below target)
+    for name in ("resnet50", "transformer", "bert"):
         pj = rec["configs"][name]["projection_v5e_256"]
-        assert pj["efficiency_at_256_int8_accum4"] >= 0.7, name
+        assert pj["efficiency_at_256_int8_2x_batch"] >= 0.7, name
+    assert rec["configs"]["deepfm"]["projection_v5e_256"][
+        "efficiency_at_256_int8_2x_batch"] < 0.7  # keeps the doc honest
     assert rec["configs"]["resnet50"]["projection_v5e_256"][
         "assumed_mfu"] == scaling_model.MEASURED_MFU["resnet50"]
     # grad bytes come from the real models, not the tiny probes
