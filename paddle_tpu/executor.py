@@ -359,9 +359,128 @@ class Trainer:
             out = {k: out[k] for k in set(self.fetch_list) | {self.loss_name}}
         return loss, (out, new_state)
 
+    def _hoisted_accum_axes(self):
+        """Validate and resolve DistStrategy.accum_exchange="hoisted":
+        the shard_map-local accumulation that exchanges gradients ONCE
+        per optimizer step (the wire lever SCALING.md §2 names as the
+        follow-up to the measured in-loop GSPMD exchange). Only sound
+        when the model trace is collective-free per shard, so every
+        precondition is enforced loudly rather than silently computing
+        something else."""
+        enforce(self.mesh is not None,
+                "accum_exchange='hoisted' needs a mesh (it is the "
+                "cross-shard exchange policy)")
+        axes = tuple(a for a in ("dp", "fsdp") if a in self.mesh.axis_names
+                     and self.mesh.shape[a] > 1)
+        enforce(axes, "accum_exchange='hoisted': mesh has no data axis")
+        pp_m, _ = self._pp_settings()
+        enforce(pp_m == 0 and not getattr(self.strategy, "sequence_parallel",
+                                          False),
+                "accum_exchange='hoisted' composes only with pure data "
+                "parallelism (no pp/sp: their shard_map schedules cannot "
+                "nest inside the local accumulation)")
+        enforce(not self.scope.state,
+                "accum_exchange='hoisted' requires stateless models: "
+                "per-shard mutable state (e.g. BN running stats) would "
+                "silently diverge across shards")
+        from jax.sharding import PartitionSpec
+        for name, leaf in self.scope.params.items():
+            spec = (self.sharding_rules.spec_for(name, leaf.shape, self.mesh)
+                    if self.sharding_rules is not None else PartitionSpec())
+            enforce(all(e is None for e in spec),
+                    f"accum_exchange='hoisted' requires fully replicated "
+                    f"params; {name} is sharded {spec} (use fsdp/tp with "
+                    "the default gspmd exchange instead)")
+        return axes
+
+    def _hoisted_accum(self, loss_and_aux, axes, accum_steps, params,
+                       state, rng, feed):
+        """shard_map-local gradient accumulation: each data shard scans
+        its accum_steps microbatches with NO cross-shard traffic, then
+        the summed gradients are pmean'd ONCE — the hoisted exchange
+        GSPMD will not produce on its own (SCALING.md §2). Params enter
+        replicated (enforced), the model trace is collective-free per
+        shard, float outputs are pmean'd to match the GSPMD path's
+        global means."""
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        dshard = 1
+        for a in axes:
+            dshard *= mesh.shape[a]
+        b = jax.tree.leaves(feed)[0].shape[0]
+        enforce(b % (accum_steps * dshard) == 0,
+                f"batch {b} must divide accum_steps*data shards "
+                f"({accum_steps}*{dshard}) for hoisted accumulation")
+        bshard = axes if len(axes) > 1 else axes[0]
+
+        def body(p, f, r):
+            # per-shard rng: fold the shard position in so dropout
+            # masks decorrelate across shards (same-in-distribution as
+            # the GSPMD path's globally-sharded masks)
+            for a in axes:
+                r = jax.random.fold_in(r, jax.lax.axis_index(a))
+            rngs = jax.random.split(r, accum_steps)
+            f_m = jax.tree.map(
+                lambda x: x.reshape((accum_steps,
+                                     x.shape[0] // accum_steps)
+                                    + x.shape[1:]), f)
+            zero = jax.tree.map(lambda q: jnp.zeros(q.shape, jnp.float32), p)
+
+            def micro(acc, mb):
+                (_, (out, _)), grads = jax.value_and_grad(
+                    loss_and_aux, has_aux=True)(p, {}, mb["rng"],
+                                                mb["feed"])
+                return jax.tree.map(jnp.add, acc, grads), out
+
+            gsum, outs = jax.lax.scan(micro, zero,
+                                      {"rng": rngs, "feed": f_m})
+            pmean_all = functools.partial(
+                functools.reduce, lambda v, a: jax.lax.pmean(v, a), axes)
+            grads = jax.tree.map(
+                lambda g: pmean_all(g / accum_steps), gsum)
+            # outputs leave the shard_map replicated (out_specs=P()), so
+            # only FLOAT SCALARS are sound: a pmean of per-sample arrays
+            # (logits) would average across shards' DIFFERENT samples,
+            # and non-float leaves have no cross-shard combine at all.
+            # Models returning more must prune with Trainer(fetch_list=)
+            for path, leaf in jax.tree_util.tree_flatten_with_path(outs)[0]:
+                keys = jax.tree_util.keystr(path)
+                enforce(jnp.issubdtype(leaf.dtype, jnp.floating)
+                        and leaf.ndim == 1,  # (accum_steps,) of scalars
+                        f"accum_exchange='hoisted': output {keys} is "
+                        f"{leaf.dtype}{leaf.shape[1:]} per microbatch — "
+                        "only float scalar outputs (loss/metrics) can be "
+                        "replicated across shards; pass fetch_list=[...] "
+                        "to prune per-sample or integer outputs")
+            out = jax.tree.map(
+                lambda x: pmean_all(jnp.mean(x, axis=0)), outs)
+            return grads, out
+
+        feed_specs = jax.tree.map(
+            lambda x: P(bshard, *([None] * (x.ndim - 1))), feed)
+        grads, out = jax.shard_map(
+            body, mesh=mesh, in_specs=(P(), feed_specs, P()),
+            out_specs=P(), check_vma=False)(params, feed, rng)
+        return grads, out, state
+
     def _build_step(self):
         accum_steps = getattr(self.strategy, "accum_steps", 1) if self.strategy else 1
         scaler = self.loss_scaler
+        # validate the exchange mode UNCONDITIONALLY: a typo'd or
+        # inapplicable knob must fail loudly, never silently no-op
+        # (the _warn_unconsumed lesson)
+        mode = (getattr(self.strategy, "accum_exchange", "gspmd")
+                if self.strategy else "gspmd")
+        enforce(mode in ("gspmd", "hoisted"),
+                f"DistStrategy.accum_exchange={mode!r} (gspmd|hoisted)")
+        enforce(mode == "gspmd" or accum_steps > 1,
+                "accum_exchange='hoisted' without accum_steps>1 is a "
+                "misconfiguration (there is no loop to hoist out of)")
+        hoist_axes = (self._hoisted_accum_axes() if mode == "hoisted"
+                      else None)
 
         def train_step(params, opt_state, state, rng, feed, ls):
             def loss_and_aux(p, st, r, f):
@@ -370,9 +489,16 @@ class Trainer:
                     loss = scaler.scale_loss(loss, ls)
                 return loss, aux
 
-            if accum_steps > 1:
+            if accum_steps > 1 and hoist_axes is not None:
+                grads, out, new_state = self._hoisted_accum(
+                    loss_and_aux, hoist_axes, accum_steps, params, state,
+                    rng, feed)
+            elif accum_steps > 1:
                 # gradient accumulation (multi_batch_merge_pass analog):
                 # microbatch over the leading feed axis with lax.scan.
+                # NOTE the grad exchange rides inside this loop under
+                # GSPMD (SCALING.md §2); accum_exchange="hoisted" is
+                # the once-per-step alternative.
                 def micro(carry, mb):
                     acc, st = carry
                     (loss, (out, new_st)), grads = jax.value_and_grad(

@@ -15,6 +15,21 @@ from typing import Any, Optional
 class DistStrategy:
     # multi_batch_merge_pass analog: microbatch gradient accumulation.
     accum_steps: int = 1
+    # how accumulated gradients are exchanged across the data axes:
+    # - "gspmd" (default): the model runs under GSPMD inside the
+    #   microbatch scan; the partitioner reduces EVERY microbatch's
+    #   gradients (it does not hoist the exchange past the accumulator
+    #   — measured, see SCALING.md §2), so accumulation is a memory
+    #   lever only. Fully general (any sharding rules, stateful
+    #   models).
+    # - "hoisted": the microbatch loop runs shard_map-LOCAL per data
+    #   shard and the summed gradients are pmean'd ONCE per optimizer
+    #   step — accum_steps becomes a wire lever (the DCN-scaling
+    #   recipe). Requires fully replicated params (no fsdp/tp/pp/sp),
+    #   stateless models (no BN running stats), and divisible batches;
+    #   dropout masks decorrelate per shard via axis-index rng folds
+    #   (same-in-distribution as GSPMD, not bitwise).
+    accum_exchange: str = "gspmd"
     # kAllReduce vs kReduce (build_strategy.h:55): 'allreduce' replicates
     # params; 'sharded' (fsdp) shards params+optimizer state.
     reduce_strategy: str = "allreduce"

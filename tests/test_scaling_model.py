@@ -69,14 +69,21 @@ def test_committed_record_structure():
         assert (pj["efficiency_at_256_int8_2x_batch"]
                 >= pj["efficiency_at_256_int8"]
                 >= pj["efficiency_at_256"])
-    # the >=70% commitment of SCALING.md §2: the three throughput
-    # configs clear it with shipped levers; deepfm's committed answer
-    # is the async PS (sync roofline honestly below target)
+    # the >=70% commitment of SCALING.md §2, each config via its
+    # committed lever set
     for name in ("resnet50", "transformer", "bert"):
         pj = rec["configs"][name]["projection_v5e_256"]
         assert pj["efficiency_at_256_int8_2x_batch"] >= 0.7, name
-    assert rec["configs"]["deepfm"]["projection_v5e_256"][
-        "efficiency_at_256_int8_2x_batch"] < 0.7  # keeps the doc honest
+    # deepfm: below target on sync levers alone (keeps the doc honest),
+    # over it with int8 + hoisted accumulation (pure-dp only)
+    dpj = rec["configs"]["deepfm"]["projection_v5e_256"]
+    assert dpj["efficiency_at_256_int8_2x_batch"] < 0.7
+    assert dpj["efficiency_at_256_int8_hoisted_accum4"] >= 0.7
+    # hoisted accumulation is only claimed where it applies
+    assert rec["configs"]["bert"]["projection_v5e_256"][
+        "efficiency_at_256_int8_hoisted_accum4"] is None
+    assert rec["configs"]["transformer"]["projection_v5e_256"][
+        "efficiency_at_256_int8_hoisted_accum4"] is None
     assert rec["configs"]["resnet50"]["projection_v5e_256"][
         "assumed_mfu"] == scaling_model.MEASURED_MFU["resnet50"]
     # grad bytes come from the real models, not the tiny probes

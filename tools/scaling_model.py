@@ -101,7 +101,7 @@ def _configs():
         prog = pt.build(mnist.mlp)
         feed = {"image": np.zeros((128, 784), np.float32),
                 "label": np.zeros((128, 1), np.int64)}
-        return {"grad_bytes": _param_bytes(prog, feed),
+        return {"grad_bytes": _param_bytes(prog, feed), "pure_dp": True,
                 "flops": flops.mlp_train_flops(128, (784, 200, 200, 10))}
 
     def resnet_probe():
@@ -122,7 +122,7 @@ def _configs():
                                           data_format="NHWC"))
         feed = {"image": np.zeros((64, 224, 224, 3), np.float32),
                 "label": np.zeros((64, 1), np.int64)}
-        return {"grad_bytes": _param_bytes(prog, feed),
+        return {"grad_bytes": _param_bytes(prog, feed), "pure_dp": True,
                 "flops": flops.convnet_train_flops(
                     flops.resnet_fwd_flops(50, 224), 64)}
 
@@ -210,7 +210,7 @@ def _configs():
         feed = {"dense": rng.randn(2048, 13).astype(np.float32),
                 "sparse_ids": rng.randint(0, 1000, (2048, 26)).astype(np.int32),
                 "label": rng.randint(0, 2, (2048, 1)).astype(np.float32)}
-        return {"grad_bytes": _param_bytes(prog, feed),
+        return {"grad_bytes": _param_bytes(prog, feed), "pure_dp": True,
                 "flops": flops.deepfm_train_flops(2048, 26, 16, 13,
                                                   (400, 400, 400))}
 
@@ -237,14 +237,16 @@ def project(name, full, n_chips=256):
     p = full["grad_bytes"] / full.get("model_shards", 1)
 
     def eff_with(p_bytes, compute_scale=1):
-        # compute_scale > 1 models a larger per-chip batch (more compute
-        # per exchange). NOTE deliberately NOT an accum_steps model: the
-        # compiled step's grad all-reduce sits INSIDE the microbatch
-        # loop under GSPMD (measured structurally, pinned by
-        # tests/test_collective_report.py::
-        # test_accum_grad_exchange_is_per_microbatch), so accumulation
-        # does not reduce exchange frequency today — hoisting it needs
-        # a shard_map-level formulation (known follow-up)
+        # compute_scale > 1 models more compute per exchange: a larger
+        # per-chip batch, or accum_steps under
+        # DistStrategy(accum_exchange="hoisted") — the shard_map-local
+        # accumulation that exchanges once per optimizer step
+        # (tests/test_hoisted_accum.py). The DEFAULT gspmd accumulation
+        # does NOT qualify: its all-reduce rides inside the microbatch
+        # loop (pinned by tests/test_collective_report.py::
+        # test_accum_grad_exchange_is_per_microbatch), which is why the
+        # hoisted projection below is emitted only for the pure-dp
+        # configs where the hoisted mode applies
         tc = t_comp * compute_scale
         ti = 2 * p_bytes * (CHIPS_PER_HOST - 1) / CHIPS_PER_HOST / ICI_BW
         td = (2 * p_bytes * (n_hosts - 1) / n_hosts / DCN_BW
@@ -269,7 +271,16 @@ def project(name, full, n_chips=256):
             # regime) doubles compute per exchange; they compose
             "efficiency_at_256_int8": eff_with(p / 4),
             "efficiency_at_256_int8_2x_batch": eff_with(p / 4,
-                                                        compute_scale=2)}
+                                                        compute_scale=2),
+            # pure-dp replicated stateless configs can additionally run
+            # DistStrategy(accum_exchange="hoisted"): the shard_map-
+            # local accumulation exchanges once per optimizer step
+            # (parity- and HLO-structure-tested, tests/
+            # test_hoisted_accum.py), making accum_steps=4 a real 4x
+            # compute-per-exchange lever
+            "efficiency_at_256_int8_hoisted_accum4": (
+                eff_with(p / 4, compute_scale=4)
+                if full.get("pure_dp") else None)}
 
 
 def main():
