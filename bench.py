@@ -515,11 +515,17 @@ def bench_gpt_decode(peak, batch_size=8, prompt=128, new_tokens=128, iters=5):
     from paddle_tpu.core.config import set_flag
     from paddle_tpu.models import gpt
 
+    import os
+
     # don't inherit whatever dtype the previous config left in the flag
     set_flag("default_compute_dtype", "bfloat16")
+    # BENCH_KV_DTYPE=int8: A/B the int8 KV cache (half the bf16 cache
+    # bytes on the HBM-bound decode read; layers/stacked.quantize_kv)
+    kv = os.environ.get("BENCH_KV_DTYPE", "compute")
     cfg = gpt.base_config(vocab_size=32000, max_len=prompt + new_tokens,
                           d_model=768, d_inner=3072, num_heads=12,
-                          num_layers=12, use_flash=False, dtype="bfloat16")
+                          num_layers=12, use_flash=False, dtype="bfloat16",
+                          kv_cache_dtype=kv)
     prog = pt.build(gpt.make_generator(cfg, max_new_tokens=new_tokens))
     rng = np.random.RandomState(0)
     prompts = [rng.randint(3, cfg.vocab_size,

@@ -305,6 +305,51 @@ def prefill_block(x, p, num_heads: int, use_flash: bool = False):
     return _ffn(x, p, None), (k, v)
 
 
+def quantize_kv(x):
+    """Symmetric per-vector int8 quantization of a cache entry over
+    the head_dim axis. One quantizer for the whole repo: delegates to
+    quantize._quant_dynamic and converts its absmax scale convention
+    (dequant = q/qmax·scale) to the multiply-direct one the decode
+    matmuls factor out (dequant = q·scale), so the two can never
+    drift. Scale shape [..., 1] float32; zero vectors dequantize to
+    exact 0."""
+    from ..quantize import _quant_dynamic
+
+    q, scale = _quant_dynamic(x, axes=(-1,))
+    return q, scale / 127.0
+
+
+def decode_block_q8(x, p, k_q, k_s, v_q, v_s, index, num_heads: int):
+    """decode_block with an int8 KV cache: k_q/v_q int8 [rows, h, T,
+    hd] plus per-position scales k_s/v_s [rows, h, T, 1]. Decode is
+    HBM-bound — the cache read dominates — so halving (vs bf16) or
+    quartering (vs f32) the cache bytes is direct serving throughput.
+    The scales FACTOR OUT of both attention matmuls (score[t] ∝ k_s[t],
+    out ∝ probs∘v_s), so no dequantized cache array is ever
+    materialized: the int8→compute-dtype convert feeds the dot
+    operands directly. Returns (x, k_q, k_s, v_q, v_s)."""
+    q, k1, v1 = _attn_qkv(x, p, num_heads)
+    k1q, k1s = quantize_kv(k1)
+    v1q, v1s = quantize_kv(v1)
+    k_q = jax.lax.dynamic_update_slice(k_q, k1q, (0, 0, index, 0))
+    k_s = jax.lax.dynamic_update_slice(k_s, k1s.astype(k_s.dtype),
+                                       (0, 0, index, 0))
+    v_q = jax.lax.dynamic_update_slice(v_q, v1q, (0, 0, index, 0))
+    v_s = jax.lax.dynamic_update_slice(v_s, v1s.astype(v_s.dtype),
+                                       (0, 0, index, 0))
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_q.astype(q.dtype),
+                        preferred_element_type=jnp.float32)
+    logits = logits * k_s[..., 0][:, :, None, :] * scale
+    pos = jnp.arange(k_q.shape[2])
+    logits = jnp.where(pos[None, None, None, :] <= index, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    pv = (probs * v_s[..., 0][:, :, None, :]).astype(q.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", pv, v_q.astype(q.dtype))
+    x = _attn_out(x, p, o)
+    return _ffn(x, p, None), k_q, k_s, v_q, v_s
+
+
 def decode_block(x, p, k_cache, v_cache, index, num_heads: int):
     """One-token step: x [rows, 1, d]; caches [rows, h, T, hd]; attends
     to cache positions <= index. Returns (x, new_k, new_v)."""

@@ -50,6 +50,12 @@ class GPTConfig:
     # same way the unrolled attention layer does)
     dropout: float = 0.0
     dtype: str = "float32"
+    # KV cache storage for the incremental generator: "compute" keeps
+    # the compute dtype; "int8" stores symmetric per-vector int8 with
+    # f32 scales (layers/stacked.quantize_kv) — half the bf16 cache
+    # bytes on the HBM-bound decode read, scales factored out of both
+    # attention matmuls so nothing is dequantized into memory
+    kv_cache_dtype: str = "compute"
 
 
 def base_config(**kw) -> GPTConfig:
@@ -179,13 +185,28 @@ def make_generator(cfg: GPTConfig, max_new_tokens: int, beam_size: int = 1,
         # reorders state leaves whose leading dim is batch*beam, so the
         # layer axis must NOT lead (the transformer decoder's contract,
         # layers/beam_search.py _gather_beams)
-        state0 = {"k": [grow(ks[i]) for i in range(L)],
-                  "v": [grow(vs[i]) for i in range(L)],
-                  "index": jnp.asarray(p, jnp.int32),
-                  "logp0": jnp.repeat(logp0, K, axis=0) if K > 1 else logp0,
-                  "first": jnp.asarray(True)}
+        enforce(cfg.kv_cache_dtype in ("compute", "int8"),
+                f"kv_cache_dtype={cfg.kv_cache_dtype!r} (compute|int8)")
+        int8_kv = cfg.kv_cache_dtype == "int8"
+        if int8_kv:
+            # quantize the prefix BEFORE growing: padded tail positions
+            # get int8 zeros with zero scales (dequantize to exact 0)
+            kq, ksc = zip(*(S.quantize_kv(ks[i]) for i in range(L)))
+            vq, vsc = zip(*(S.quantize_kv(vs[i]) for i in range(L)))
+            state0 = {"kq": [grow(a) for a in kq],
+                      "ks": [grow(a) for a in ksc],
+                      "vq": [grow(a) for a in vq],
+                      "vs": [grow(a) for a in vsc]}
+        else:
+            state0 = {"k": [grow(ks[i]) for i in range(L)],
+                      "v": [grow(vs[i]) for i in range(L)]}
+        state0.update(
+            index=jnp.asarray(p, jnp.int32),
+            logp0=jnp.repeat(logp0, K, axis=0) if K > 1 else logp0,
+            first=jnp.asarray(True))
         layer_params = [jax.tree.map(lambda a, i=i: a[i], stack)
                         for i in range(L)]
+        cache_keys = ("kq", "ks", "vq", "vs") if int8_kv else ("k", "v")
 
         def step_fn(tokens, state):
             # the prefill already produced the first step's distribution;
@@ -193,26 +214,32 @@ def make_generator(cfg: GPTConfig, max_new_tokens: int, beam_size: int = 1,
             def incremental(_):
                 xt = cast_compute(w_emb[tokens][:, None, :]
                                   + pe[state["index"]][None, None])
-                kn, vn = [], []
-                for lp, kc, vc in zip(layer_params, state["k"], state["v"]):
-                    xt, kc, vc = S.decode_block(
-                        xt, lp, kc, vc, state["index"], cfg.num_heads)
-                    kn.append(kc)
-                    vn.append(vc)
-                return head(xt[:, 0]), kn, vn
+                new = tuple([] for _ in cache_keys)
+                for i, lp in enumerate(layer_params):
+                    caches = tuple(state[k][i] for k in cache_keys)
+                    if int8_kv:
+                        xt, *caches = S.decode_block_q8(
+                            xt, lp, *caches, state["index"], cfg.num_heads)
+                    else:
+                        xt, *caches = S.decode_block(
+                            xt, lp, *caches, state["index"], cfg.num_heads)
+                    for dst, c in zip(new, caches):
+                        dst.append(c)
+                return (head(xt[:, 0]),) + new
 
-            logp, kn, vn = jax.lax.cond(
+            logp, *new = jax.lax.cond(
                 state["first"],
-                lambda _: (state["logp0"], state["k"], state["v"]),
+                lambda _: ((state["logp0"],)
+                           + tuple(state[k] for k in cache_keys)),
                 incremental, operand=None)
             # the first step consumes the prefill's distribution without
             # writing a token; the index advances only once a generated
             # token has actually been cached (position p holds token 1)
-            new_state = {"k": kn, "v": vn,
-                         "index": jnp.where(state["first"], state["index"],
-                                            state["index"] + 1),
-                         "logp0": state["logp0"],
-                         "first": jnp.asarray(False)}
+            new_state = dict(zip(cache_keys, new))
+            new_state.update(
+                index=jnp.where(state["first"], state["index"],
+                                state["index"] + 1),
+                logp0=state["logp0"], first=jnp.asarray(False))
             return logp, new_state
 
         if K > 1:

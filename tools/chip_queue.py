@@ -60,6 +60,8 @@ QUEUE = [
     ("lstm_train", "lstm", {}),
     ("transformer_long_train", "transformer_long", {}),
     ("gpt_decode", "gpt_decode", {}),
+    ("gpt_decode@kv_int8", "gpt_decode",
+     {"BENCH_KV_DTYPE": "int8"}),                        # int8 KV cache A/B
 ]
 
 
