@@ -8,16 +8,22 @@ mode "dp_fsdp": 2 virtual devices per process, mesh {dp: nprocs,
 fsdp: 2} — the data axis rides the cross-process (DCN analog) dimension
 while params/optimizer state shard over each process's local devices
 (ICI analog); the reference's multi-node NCCL2 topology, with param
-slicing. Prints per-step losses as `LOSS <step> <value>`."""
+slicing.
+mode "dp_hoisted": dp=2 with DistStrategy(accum_steps=2,
+accum_exchange="hoisted") — the shard_map-local accumulation whose ONE
+pmean per optimizer step crosses the process (DCN analog) boundary;
+with nprocs=1 the same global mesh lives on 2 local devices (the
+parity reference). Prints per-step losses as `LOSS <step> <value>`."""
 
 import os
 import sys
 
 pid, nprocs, port, steps = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], int(sys.argv[4])
 mode = sys.argv[5] if len(sys.argv) > 5 else "dp"
-if mode not in ("dp", "dp_fsdp"):
-    sys.exit(f"unknown mode {mode!r} (dp|dp_fsdp)")
-local_devices = 2 if mode == "dp_fsdp" else 1
+if mode not in ("dp", "dp_fsdp", "dp_hoisted"):
+    sys.exit(f"unknown mode {mode!r} (dp|dp_fsdp|dp_hoisted)")
+local_devices = (2 if mode == "dp_fsdp"
+                 else 2 if (mode == "dp_hoisted" and nprocs == 1) else 1)
 _flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
           if "xla_force_host_platform_device_count" not in f]
 _flags.append(f"--xla_force_host_platform_device_count={local_devices}")
@@ -49,14 +55,21 @@ def global_batches(step, global_bs=64):
 
 def main():
     prog = pt.build(mnist_models.mlp)
+    strategy = None
+    fetch = None
     if mode == "dp_fsdp":
         mesh = pt.make_mesh({"dp": nprocs, "fsdp": local_devices})
         rules = pt.parallel.fsdp(min_size_to_shard=1)
     else:
         mesh = pt.make_mesh({"dp": jax.device_count()})
         rules = pt.parallel.replicated()
+    if mode == "dp_hoisted":
+        from paddle_tpu.parallel import DistStrategy
+        strategy = DistStrategy(accum_steps=2, accum_exchange="hoisted")
+        fetch = ["loss"]  # logits are per-sample: prune for the hoisted path
     trainer = pt.Trainer(prog, opt.SGD(0.1), loss_name="loss", mesh=mesh,
-                         sharding_rules=rules)
+                         sharding_rules=rules, strategy=strategy,
+                         fetch_list=fetch)
     x0, y0 = global_batches(0)
     local = x0.shape[0] // nprocs
     sample = {"image": x0[:local], "label": y0[:local]}

@@ -89,6 +89,27 @@ def test_two_process_dp_fsdp_mesh_matches_single_process(single_proc_losses):
 
 
 @pytest.mark.slow
+def test_two_process_hoisted_accum_matches_single_process():
+    """Cross-PROCESS hoisted accumulation: 2 processes × 1 device each,
+    mesh {dp: 2}, DistStrategy(accum_steps=2, accum_exchange="hoisted")
+    — each process scans its microbatches collective-free and the ONE
+    pmean per optimizer step crosses the process (DCN analog) boundary,
+    which is exactly the wire pattern SCALING.md §2's projection
+    charges. Per-step losses must match a single process holding the
+    same global mesh on 2 local devices."""
+    steps = 4
+    single = _losses(_run_procs(1, steps, mode="dp_hoisted")[0])
+    multi = _run_procs(2, steps, mode="dp_hoisted")
+    l0, l1 = _losses(multi[0]), _losses(multi[1])
+    assert len(single) == steps and len(l0) == steps
+    for s in range(steps):
+        assert abs(l0[s] - l1[s]) < 1e-5
+        assert abs(l0[s] - single[s]) < 1e-3, (
+            f"step {s}: hoisted 2-proc {l0[s]} vs same-mesh 1-proc "
+            f"{single[s]}")
+
+
+@pytest.mark.slow
 def test_two_process_ring_sp_matches_single_process():
     """Cross-PROCESS ring attention: 2 processes x 4 devices, one
     {"sp": 8} axis, so the zigzag ring's permute hops cross the process
