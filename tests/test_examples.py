@@ -33,6 +33,18 @@ def test_train_gpt_example_runs_and_resumes(tmp_path):
 
 
 @pytest.mark.slow
+def test_train_gpt_example_hoisted_accum_and_int8_generate():
+    """The round-5 features end to end in the runnable example: dp +
+    hoisted accumulation trains, and the trained weights decode a
+    continuation through the int8 KV cache."""
+    out = _run("train_gpt.py", "--steps", "6", "--d_model", "64",
+               "--layers", "1", "--batch", "16", "--dp", "--accum", "2",
+               "--hoisted", "--generate", "4", "--int8-kv")
+    assert "hoisted: one exchange/step" in out
+    assert "continuation (int8 KV cache)" in out
+
+
+@pytest.mark.slow
 def test_serve_classifier_example_runs_int8():
     out = _run("serve_classifier.py", "--train_steps", "8", "--calls", "3",
                "--threads", "2", "--int8")
