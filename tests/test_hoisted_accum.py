@@ -114,3 +114,23 @@ def test_hoisted_accum_preconditions_enforced():
                       pt.make_mesh({"dp": 8}), pt.parallel.replicated(),
                       fetch_list=None)
         tr.step(_feed(16))
+
+
+@pytest.mark.slow
+def test_hoisted_accum_composes_with_loss_scaling():
+    """bf16 AMP + dynamic loss scaling over the hoisted path: the
+    scaled loss is computed inside the shard_map microbatch loop (ls
+    enters via closure), grads unscale outside, and the overflow-skip
+    machinery sees the pmean'd grads — training stays finite and the
+    scale is reported."""
+    feeds = [_feed(16, seed=i) for i in range(4)]
+    mesh = pt.make_mesh({"dp": 8})
+    with pt.amp_guard("bfloat16"):
+        tr = _trainer(DistStrategy(accum_steps=2,
+                                   accum_exchange="hoisted",
+                                   dynamic_loss_scale=True),
+                      mesh, pt.parallel.replicated())
+        losses = [float(tr.step(f)["loss"]) for f in feeds]
+    assert all(np.isfinite(l) for l in losses), losses
+    out = tr.step(feeds[0])
+    assert "loss_scale" in out and float(out["loss_scale"]) > 0
