@@ -9,7 +9,7 @@ profiling, quantization, RecordIO data format (C++ core), beam-search
 decoding, and a StableHLO inference/export path.
 """
 
-from . import backward, clip, core, data, debugger, evaluator, framework, initializer
+from . import analysis, backward, clip, core, data, debugger, evaluator, framework, initializer
 from . import io, layers, lr_scheduler, metrics, models, nets, optimizer
 from . import parallel, quantize, regularizer, sparse, transpiler
 from .core import CPUPlace, CUDAPlace, Place, TPUPlace, default_place

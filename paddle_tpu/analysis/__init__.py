@@ -1,0 +1,42 @@
+"""paddle_tpu.analysis — jaxpr-level static program checker.
+
+The IR-pass layer of the framework (graph_viz_pass / memory_usage_calc /
+ProgramDesc-validator analog, SURVEY §3): a walker over ``Program.desc``
+— the jaxpr IS the ProgramDesc here — that produces a structured
+:class:`LintReport` before anything compiles. Five rule families:
+
+1. collective placement — reduction collectives inside scan/while
+   bodies (the unhoisted-accumulation hazard) with per-step comm-byte
+   estimates, plus config-level detection of the per-microbatch GSPMD
+   gradient exchange;
+2. dtype flow — f32 MXU ops surviving under an amp compute dtype, f64
+   leaks, no-op cast round-trips;
+3. whole-program sharding audit — rules matching no parameter, spec
+   axes that don't divide shapes, large params left replicated on an
+   fsdp mesh (placement-time ``_validate`` only sees one name at a
+   time);
+4. dead / frozen parameters — initialized-but-never-read params and
+   trainable params with structurally-zero gradients;
+5. recompilation hazards — weak python scalars and unhashable objects
+   in the traced argument signature.
+
+Three front doors: programmatic :func:`check` / :func:`check_trainer`,
+``Trainer.startup(lint="warn"|"error")``, and the CLI
+``python -m paddle_tpu.analysis --model mnist`` (also
+``tools/lint_program.py``).
+"""
+
+from .check import check, check_trainer
+from .report import (Finding, LintError, LintReport, LintWarning,
+                     active_report, collect_into)
+from .walker import (COLLECTIVES, PERMUTE_COLLECTIVES,
+                     REDUCTION_COLLECTIVES, aval_bytes, eqn_subjaxprs,
+                     iter_eqns, walk_jaxprs)
+
+__all__ = [
+    "check", "check_trainer",
+    "Finding", "LintError", "LintReport", "LintWarning",
+    "active_report", "collect_into",
+    "COLLECTIVES", "PERMUTE_COLLECTIVES", "REDUCTION_COLLECTIVES",
+    "aval_bytes", "eqn_subjaxprs", "iter_eqns", "walk_jaxprs",
+]

@@ -1,0 +1,78 @@
+"""CLI: lint a zoo model's program before it ever compiles.
+
+    python -m paddle_tpu.analysis --model mnist
+    python -m paddle_tpu.analysis --model moe_transformer --amp bfloat16 \
+        --mesh fsdp=8 --rules fsdp --fail-on warning --format json
+
+Exit status: 0 when the report is clean at ``--fail-on`` (default
+``warning``), 1 otherwise — CI-greppable like any linter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _parse_mesh(spec: str):
+    from ..parallel import make_mesh
+    axes = {}
+    for part in spec.split(","):
+        k, _, v = part.partition("=")
+        axes[k.strip()] = int(v)
+    return make_mesh(axes)
+
+
+def _parse_rules(name: str):
+    from ..parallel import fsdp, replicated, transformer_tp_rules
+    table = {"replicated": replicated, "fsdp": fsdp,
+             "tp": transformer_tp_rules}
+    if name not in table:
+        raise SystemExit(f"--rules must be one of {sorted(table)}")
+    return table[name]()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="static jaxpr-level lint of a model-zoo program")
+    ap.add_argument("--model", required=True,
+                    help="zoo model: mnist | transformer | moe_transformer | gpt")
+    ap.add_argument("--variant", default="",
+                    help="model variant (mnist: mlp|conv)")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=16)
+    ap.add_argument("--mesh", default="",
+                    help='mesh axes, e.g. "dp=4,tp=2" (needs that many devices)')
+    ap.add_argument("--rules", default="",
+                    help="sharding preset: replicated | fsdp | tp")
+    ap.add_argument("--amp", default="",
+                    help="lint under this compute dtype (e.g. bfloat16)")
+    ap.add_argument("--loss-name", default="loss")
+    ap.add_argument("--fail-on", default="warning",
+                    choices=("info", "warning", "error"),
+                    help="exit 1 when findings at/above this severity exist")
+    ap.add_argument("--level", default="info",
+                    choices=("info", "warning", "error"),
+                    help="minimum severity to print")
+    ap.add_argument("--format", default="text", choices=("text", "json"))
+    args = ap.parse_args(argv)
+
+    from . import check
+    from .zoo import build_model
+
+    program, feed = build_model(args.model, args.variant, args.batch, args.seq)
+    mesh = _parse_mesh(args.mesh) if args.mesh else None
+    rules = _parse_rules(args.rules) if args.rules else None
+    report = check(program, feed, mesh=mesh, rules=rules,
+                   amp=args.amp or None, loss_name=args.loss_name)
+    if args.format == "json":
+        print(json.dumps(report.to_dict(), indent=1, default=str))
+    else:
+        print(report.render(args.level))
+    return 0 if report.ok(args.fail_on) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,175 @@
+"""Entry points of the static program checker.
+
+``check(program, ...)`` lints a built :class:`~paddle_tpu.framework.Program`
+— the jaxpr (ProgramDesc analog) plus its parameter scope — against the
+five rule families in :mod:`.rules`. ``check_trainer`` additionally
+traces the Trainer's *compiled step function* (microbatch scan, loss
+scaling, optimizer update included), which is where collective-placement
+hazards actually live.
+
+Usage::
+
+    report = analysis.check(program, sample_feed={"ids": ids, "labels": labels},
+                            mesh=mesh, rules=pt.parallel.fsdp())
+    print(report.render())
+    report.enforce_clean("warning")   # raise LintError on findings
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..core.config import get_flag, make_prng_key
+from ..core.errors import enforce
+from . import rules as _rules
+from .report import LintReport
+
+
+def _traceable(v) -> bool:
+    """Can ``v`` enter a trace as an array? (Non-array objects are left
+    to the retrace-hazard rule and excluded from the example feed.)"""
+    try:
+        return np.asarray(v).dtype != np.dtype(object)
+    except Exception:
+        return False
+
+
+def _concrete_feed(feed: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    out = {}
+    for k, v in (feed or {}).items():
+        if isinstance(v, jax.ShapeDtypeStruct):
+            out[k] = jax.numpy.zeros(v.shape,
+                                     jax.dtypes.canonicalize_dtype(v.dtype))
+        else:
+            out[k] = v
+    return out
+
+
+def check(
+    program,
+    sample_feed: Optional[Dict[str, Any]] = None,
+    *,
+    params: Optional[Dict[str, Any]] = None,
+    state: Optional[Dict[str, Any]] = None,
+    mesh=None,
+    rules=None,
+    strategy=None,
+    rng: Optional[jax.Array] = None,
+    amp: Optional[str] = None,
+    loss_name: str = "loss",
+    large_param_bytes: int = 1 << 20,
+    select: Optional[set] = None,
+) -> LintReport:
+    """Statically lint ``program``. ``sample_feed`` supplies example
+    inputs (arrays or ShapeDtypeStructs) keyed by the program fn's arg
+    names; ``params``/``state`` default to a fresh ``Program.init``.
+    ``mesh``+``rules`` enable the sharding audit, ``strategy`` the
+    config-level collective checks, ``amp`` re-traces under
+    ``amp_guard(amp)`` so the dtype-flow rules see the mixed-precision
+    graph. ``select`` restricts to a subset of rule families
+    ({"collective", "dtype", "sharding", "params", "retrace"})."""
+    from ..framework import amp_guard
+    import contextlib
+
+    report = LintReport(subject=program.name)
+    feed = _concrete_feed(sample_feed)
+    fam = (lambda f: select is None or f in select)
+
+    # 5. retrace hazards: inspect BEFORE abstractification loses the
+    # python types (this is the raw user-facing call signature)
+    if fam("retrace"):
+        _rules.check_signature(program.arg_signature(**(sample_feed or {})),
+                               report)
+
+    dropped = sorted(k for k, v in feed.items() if not _traceable(v))
+    feed = {k: v for k, v in feed.items() if _traceable(v)}
+    amp_ctx = amp_guard(amp) if amp else contextlib.nullcontext()
+    with amp_ctx:
+        closed = invar_names = None
+        try:
+            if params is None:
+                params, state = program.init(
+                    rng if rng is not None else make_prng_key(get_flag("seed")),
+                    **feed)
+            state = state or {}
+            if fam("collective") or fam("dtype") or fam("params"):
+                closed, invar_names = program.desc_flat(params, state, **feed)
+        except Exception as e:
+            # a trace that can't run (e.g. a required arg was dropped as
+            # untraceable — already reported by the retrace family) must
+            # degrade to a finding, not crash the lint
+            report.add(
+                "analysis:trace-failed", "info",
+                f"could not trace the program for the jaxpr-level rules "
+                f"({type(e).__name__}: {e})"
+                + (f"; untraceable feed entries dropped: {dropped}"
+                   if dropped else ""))
+        if fam("collective"):
+            if closed is not None:
+                _rules.check_collectives(closed, report, mesh=mesh)
+            _rules.check_accum_exchange(strategy, mesh, params or {}, report)
+        if fam("dtype") and closed is not None:
+            from ..framework import compute_dtype
+            cd = compute_dtype() if amp else None
+            _rules.check_dtypes(closed, report, compute_dtype=cd,
+                                feed=sample_feed)
+        if fam("params") and closed is not None:
+            _rules.check_params(program, params, state, (), feed, report,
+                                loss_name=loss_name, closed_flat=closed,
+                                invar_names=invar_names)
+    if fam("sharding"):
+        _rules.check_sharding(params, mesh, rules, report,
+                              param_info=getattr(program, "param_info", None),
+                              large_param_bytes=large_param_bytes)
+    return report
+
+
+def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
+                  **kwargs) -> LintReport:
+    """Lint a started Trainer: the program-level rules over its scope +
+    rule table, plus collective/dtype rules over the jaxpr of the
+    *compiled train step* — the microbatch scan and every shard_map the
+    model routed through are visible there, which is exactly where the
+    unhoisted-accum class of hazard sits."""
+    enforce(trainer._step_fn is not None,
+            "check_trainer: call Trainer.startup() first (the lint walks "
+            "the built step function)")
+    select = kwargs.pop("select", None)
+    want_coll = select is None or "collective" in select
+    # the collective family runs over the STEP jaxpr below (the program
+    # jaxpr is a subset of it — walking both would double-report)
+    inner_select = ({"dtype", "sharding", "params", "retrace"}
+                    if select is None else set(select) - {"collective"})
+    # the PRE-adaptation rule table: typo'd axes only exist there
+    # (Trainer.__init__ adapts its working copy, stripping them)
+    rules = getattr(trainer, "sharding_rules_raw", None) or trainer.sharding_rules
+    report = check(
+        trainer.program, sample_feed,
+        params=trainer.scope.params, state=trainer.scope.state,
+        mesh=trainer.mesh, rules=rules,
+        strategy=trainer.strategy, loss_name=trainer.loss_name,
+        select=inner_select, **kwargs)
+    report.subject = f"trainer({trainer.program.name})"
+    if not want_coll:
+        return report
+
+    _rules.check_accum_exchange(trainer.strategy, trainer.mesh,
+                                trainer.scope.params, report)
+    if sample_feed is None:
+        return report
+    feed = _concrete_feed(sample_feed)
+    ls = getattr(trainer.scope, "loss_scale_state", None) or {}
+    try:
+        step_jaxpr = jax.make_jaxpr(trainer._step_fn)(
+            trainer.scope.params, trainer.scope.opt_state,
+            trainer.scope.state, jax.random.PRNGKey(0), feed, ls)
+    except Exception as e:
+        report.add("collective:step-trace-failed", "info",
+                   f"could not trace the compiled step for collective "
+                   f"placement ({type(e).__name__}: {e})")
+    else:
+        _rules.check_collectives(step_jaxpr, report, mesh=trainer.mesh)
+    return report

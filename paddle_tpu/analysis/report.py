@@ -1,0 +1,166 @@
+"""Lint findings and reports.
+
+The structured output of the static program checker — the analog of the
+reference's pass-level diagnostics (graph_viz_pass annotations, the
+ProgramDesc validators' error strings) made machine-readable: each
+:class:`Finding` carries a ``family:rule`` code, a severity, a message,
+and the program location (param name / eqn / argument) it anchors to.
+
+A :class:`LintReport` is also a *collector*: while one is installed via
+:func:`collect_into`, cooperating subsystems (``parallel.sharding``'s
+rule-drop warnings) append findings instead of emitting ad-hoc
+``warnings.warn`` calls, so a single ``analysis.check`` run gathers
+everything the trace touched.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import warnings
+from typing import Any, Dict, List, Optional
+
+from ..core.errors import EnforceError
+
+SEVERITIES = ("info", "warning", "error")
+_SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+
+class LintError(EnforceError):
+    """Raised by :meth:`LintReport.enforce_clean` (Trainer ``lint="error"``)."""
+
+    def __init__(self, report: "LintReport", level: str):
+        self.report = report
+        super().__init__(
+            f"program lint failed at level {level!r}:\n{report.render()}")
+
+
+class LintWarning(UserWarning):
+    """Category for findings surfaced through the warnings module
+    (Trainer ``lint="warn"``)."""
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnostic: ``code`` is ``family:rule`` (e.g.
+    ``"collective:in-scan"``), ``where`` names the anchor (parameter,
+    equation, feed key), ``data`` holds rule-specific measurements
+    (comm-byte estimates, shapes)."""
+
+    code: str
+    severity: str
+    message: str
+    where: str = ""
+    data: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        assert self.severity in SEVERITIES, self.severity
+
+    def __str__(self) -> str:
+        loc = f" [{self.where}]" if self.where else ""
+        return f"{self.severity.upper():<8} {self.code:<28}{loc} {self.message}"
+
+
+class LintReport:
+    """Ordered collection of findings for one checked program."""
+
+    def __init__(self, subject: str = "program"):
+        self.subject = subject
+        self.findings: List[Finding] = []
+
+    # -- building ----------------------------------------------------------
+    def add(self, code: str, severity: str, message: str, where: str = "",
+            **data) -> Finding:
+        f = Finding(code=code, severity=severity, message=message,
+                    where=where, data=dict(data))
+        self.findings.append(f)
+        return f
+
+    def extend(self, other: "LintReport") -> "LintReport":
+        self.findings.extend(other.findings)
+        return self
+
+    # -- querying ----------------------------------------------------------
+    def codes(self) -> set:
+        return {f.code for f in self.findings}
+
+    def by_code(self, code: str) -> List[Finding]:
+        return [f for f in self.findings if f.code == code]
+
+    def counts(self) -> Dict[str, int]:
+        out = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            out[f.severity] += 1
+        return out
+
+    def at_least(self, level: str) -> List[Finding]:
+        rank = _SEV_RANK[level]
+        return [f for f in self.findings if _SEV_RANK[f.severity] >= rank]
+
+    def ok(self, level: str = "warning") -> bool:
+        """Clean at ``level``: no findings of that severity or above."""
+        return not self.at_least(level)
+
+    # -- output ------------------------------------------------------------
+    def render(self, level: str = "info") -> str:
+        shown = self.at_least(level)
+        if not shown:
+            return f"{self.subject}: clean (no findings at level >= {level})"
+        c = self.counts()
+        head = (f"{self.subject}: {len(self.findings)} finding(s) "
+                f"({c['error']} error, {c['warning']} warning, {c['info']} info)")
+        return "\n".join([head] + [f"  {f}" for f in shown])
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "subject": self.subject,
+            "counts": self.counts(),
+            "findings": [dataclasses.asdict(f) for f in self.findings],
+        }
+
+    def enforce_clean(self, level: str = "warning") -> "LintReport":
+        """Raise :class:`LintError` unless :meth:`ok` at ``level``."""
+        if not self.ok(level):
+            raise LintError(self, level)
+        return self
+
+    def emit_warnings(self, level: str = "warning") -> "LintReport":
+        """Surface findings at/above ``level`` as :class:`LintWarning`."""
+        for f in self.at_least(level):
+            warnings.warn(str(f), LintWarning, stacklevel=2)
+        return self
+
+    def __len__(self) -> int:
+        return len(self.findings)
+
+    def __repr__(self) -> str:
+        return f"<LintReport {self.subject!r}: {self.counts()}>"
+
+
+# --------------------------------------------------------------------------
+# collector context — lets non-analysis subsystems contribute findings
+# --------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+def active_report() -> Optional[LintReport]:
+    """The innermost report installed by :func:`collect_into`, or None."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def collect_into(report: LintReport):
+    """Route cooperating subsystems' diagnostics (e.g.
+    ``parallel.sharding._warn_drop``) into ``report`` for the duration
+    of the block instead of the warnings module."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(report)
+    try:
+        yield report
+    finally:
+        stack.pop()

@@ -1,0 +1,403 @@
+"""The lint rule families of the static program checker.
+
+Each rule takes the artifacts ``analysis.check`` prepared (jaxpr, param
+scope, mesh/rules, example arguments) and appends :class:`Finding`s to a
+:class:`LintReport`. Codes are ``family:rule``:
+
+- ``collective:*`` — collective-placement hazards (the unhoisted-accum
+  class of bug pinned by SCALING.md §2): reduction collectives nested in
+  loop bodies multiply their wire bytes by the trip count.
+- ``dtype:*``      — mixed-precision flow: f32 MXU ops surviving under
+  an amp compute dtype, f64 leaks, no-op cast round-trips.
+- ``sharding:*``   — whole-program audit of the rule table against the
+  actual parameter scope (per-param ``_validate`` only sees one name at
+  placement time; this sees rules that match nothing and large params
+  left replicated).
+- ``params:*``     — dead parameters (initialized, never read) and
+  trainable parameters with structurally-zero gradients.
+- ``retrace:*``    — recompilation hazards in the traced arg signature
+  (weak python scalars, unhashable objects).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .report import LintReport, collect_into
+from .walker import (COLLECTIVES, LOOP_PRIMS, PERMUTE_COLLECTIVES,
+                     REDUCTION_COLLECTIVES, aval_bytes, eqn_out_bytes,
+                     eqn_subjaxprs, in_loop, is_structural_zero, iter_eqns,
+                     producer_map, used_var_ids)
+
+# --------------------------------------------------------------------------
+# 1. collective placement
+# --------------------------------------------------------------------------
+
+
+def _walk_with_trips(jaxpr, path=(), trips=1):
+    """iter_eqns plus the product of enclosing loop trip counts (None
+    once a loop with unknowable count — e.g. while — intervenes).
+    Loop-primitive membership comes from walker.LOOP_PRIMS so the two
+    walks can never disagree about what counts as a loop."""
+    for eqn in jaxpr.eqns:
+        yield eqn, path, trips
+        name = eqn.primitive.name
+        sub_trips = trips
+        if name in LOOP_PRIMS:
+            length = eqn.params.get("length")  # scan carries it; while: None
+            sub_trips = (None if trips is None or length is None
+                         else trips * int(length))
+        for sub in eqn_subjaxprs(eqn):
+            yield from _walk_with_trips(sub, path + (name,), sub_trips)
+
+
+def _group_size(eqn, mesh) -> int:
+    axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    n = 1
+    for a in axes:
+        if mesh is not None and a in getattr(mesh, "axis_names", ()):
+            n *= mesh.shape[a]
+    return n
+
+
+def check_collectives(closed_jaxpr, report: LintReport, mesh=None) -> None:
+    """Flag reduction collectives (psum / all_gather / all_to_all /
+    psum_scatter) nested inside scan/while bodies: each loop iteration
+    pays the exchange, the hoisted-accumulation hazard. Neighbor
+    permutes (ppermute) inside loops are the *deliberate* structure of
+    ring/pipeline schedules, so they are reported at info severity with
+    the same byte accounting rather than warned."""
+    for eqn, path, trips in _walk_with_trips(closed_jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if name not in COLLECTIVES:
+            continue
+        if not in_loop(path):
+            continue
+        payload = eqn_out_bytes(eqn)
+        n = _group_size(eqn, mesh)
+        per_step = None if trips is None else payload * trips
+        loop_desc = "while" if trips is None else f"×{trips} scan iterations"
+        if name in REDUCTION_COLLECTIVES:
+            report.add(
+                "collective:in-scan", "warning",
+                f"{name} inside a loop body ({' > '.join(path)}): the "
+                f"exchange ({payload / 1e6:.3f} MB result"
+                + (f", ~{per_step / 1e6:.3f} MB {loop_desc} per step"
+                   if per_step is not None else f", {loop_desc}")
+                + ") runs every iteration — hoist it out of the loop if it "
+                "does not depend on the loop carry (the per-microbatch "
+                "allreduce hazard; see DistStrategy.accum_exchange='hoisted')",
+                where=name, payload_bytes=payload, trips=trips,
+                per_step_bytes=per_step, group_size=n, path=list(path))
+        else:
+            report.add(
+                "collective:permute-in-scan", "info",
+                f"{name} inside a loop body ({' > '.join(path)}): "
+                f"{payload / 1e6:.3f} MB neighbor-hop per iteration"
+                + (f" (~{per_step / 1e6:.3f} MB per step)"
+                   if per_step is not None else "")
+                + " — expected for ring/pipeline schedules",
+                where=name, payload_bytes=payload, trips=trips,
+                per_step_bytes=per_step, group_size=n, path=list(path))
+
+
+def check_accum_exchange(strategy, mesh, params, report: LintReport) -> None:
+    """Config-level collective placement: ``accum_steps>1`` with the
+    default GSPMD exchange on a data-parallel mesh rides one full
+    gradient all-reduce INSIDE the microbatch scan per iteration (the
+    collective is inserted by the SPMD partitioner, so it is invisible
+    to the jaxpr walk — this rule reasons from the config, the way
+    SCALING.md §2 measured it)."""
+    accum = int(getattr(strategy, "accum_steps", 1) or 1) if strategy else 1
+    mode = getattr(strategy, "accum_exchange", "gspmd") if strategy else "gspmd"
+    if accum <= 1 or mode != "gspmd" or mesh is None:
+        return
+    data_n = 1
+    for a in ("dp", "fsdp"):
+        if a in mesh.axis_names:
+            data_n *= mesh.shape[a]
+    if data_n <= 1:
+        return
+    grad_bytes = sum(int(np.prod(v.shape)) * 4
+                     for v in jax.tree.leaves(params))  # f32 grads
+    wire = 2.0 * (data_n - 1) / data_n * grad_bytes
+    report.add(
+        "collective:microbatch-exchange", "warning",
+        f"accum_steps={accum} with accum_exchange='gspmd' on a "
+        f"{data_n}-way data mesh exchanges gradients once per microbatch "
+        f"(~{accum * wire / 1e6:.1f} MB wire/device/step vs "
+        f"{wire / 1e6:.1f} MB hoisted) — set "
+        "DistStrategy.accum_exchange='hoisted' when params are replicated",
+        where="DistStrategy.accum_steps",
+        accum_steps=accum, data_shards=data_n,
+        per_step_bytes=accum * wire, hoisted_bytes=wire)
+
+
+# --------------------------------------------------------------------------
+# 2. dtype flow
+# --------------------------------------------------------------------------
+
+_MXU_PRIMS = frozenset({"dot_general", "conv_general_dilated"})
+
+
+def check_dtypes(closed_jaxpr, report: LintReport,
+                 compute_dtype=None, feed: Optional[Dict[str, Any]] = None) -> None:
+    """Mixed-precision flow over the whole jaxpr:
+
+    - ``dtype:amp-f32-matmul`` — a matmul/conv whose operands stayed f32
+      while the ambient compute dtype is reduced (bf16/f16): the layer
+      bypassed ``cast_compute`` and its MXU op runs at 1/2 the
+      throughput the amp_guard asked for.
+    - ``dtype:f64-leak`` — any f64 aval (TPU has no f64 MXU path), plus
+      f64 feed arrays that x64-off mode will silently truncate.
+    - ``dtype:cast-roundtrip`` — convert chains that return to the
+      source dtype (x→b→x): a no-op pair that usually marks a missing
+      dtype plumb-through.
+    """
+    cd = np.dtype(compute_dtype) if compute_dtype is not None else None
+    reduced = cd is not None and cd.itemsize < 4 and cd.kind in ("f", "V")
+    for k, v in (feed or {}).items():
+        try:
+            dt = v.dtype if hasattr(v, "dtype") else np.asarray(v).dtype
+        except Exception:
+            continue  # untraceable value: the retrace family owns it
+        if np.dtype(dt) == np.float64:
+            report.add("dtype:f64-leak", "warning",
+                       f"feed {k!r} is float64 — under the default x64-off "
+                       "config it is silently truncated to float32 at "
+                       "device_put; cast at the data layer",
+                       where=k)
+
+    def visit(jaxpr):
+        producers = producer_map(jaxpr)
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            avals = [getattr(v, "aval", None) for v in eqn.invars]
+            out_avals = [getattr(v, "aval", None) for v in eqn.outvars]
+            for av in out_avals:
+                if getattr(av, "dtype", None) is not None and \
+                        np.dtype(av.dtype) == np.float64:
+                    report.add("dtype:f64-leak", "warning",
+                               f"{name} produces float64 {av.shape} — no "
+                               "f64 MXU path on TPU; cast to f32",
+                               where=name)
+                    break
+            if reduced and name in _MXU_PRIMS:
+                op_dts = [np.dtype(av.dtype) for av in avals
+                          if getattr(av, "dtype", None) is not None]
+                if op_dts and all(dt == np.float32 for dt in op_dts):
+                    shapes = [tuple(getattr(av, "shape", ())) for av in avals]
+                    report.add(
+                        "dtype:amp-f32-matmul", "warning",
+                        f"{name} on f32 operands {shapes} while the compute "
+                        f"dtype is {cd} — the layer bypassed cast_compute; "
+                        "this op misses the reduced-precision MXU path "
+                        "amp_guard selected",
+                        where=name, shapes=shapes)
+            if name == "convert_element_type":
+                src = eqn.invars[0]
+                peqn = producers.get(id(src))
+                if (peqn is not None
+                        and peqn.primitive.name == "convert_element_type"):
+                    orig = getattr(peqn.invars[0], "aval", None)
+                    final = getattr(eqn.outvars[0], "aval", None)
+                    if (orig is not None and final is not None
+                            and np.dtype(orig.dtype) == np.dtype(final.dtype)):
+                        mid = np.dtype(getattr(src, "aval").dtype)
+                        report.add(
+                            "dtype:cast-roundtrip", "info",
+                            f"cast round-trip {np.dtype(orig.dtype)} → {mid} "
+                            f"→ {np.dtype(final.dtype)}: the pair is a no-op "
+                            "(or a silent precision truncation if the middle "
+                            "dtype is narrower) — plumb the dtype through "
+                            "instead",
+                            where=name)
+
+    from .walker import walk_jaxprs
+    walk_jaxprs(closed_jaxpr.jaxpr, visit)
+
+
+# --------------------------------------------------------------------------
+# 3. whole-program sharding audit
+# --------------------------------------------------------------------------
+
+
+def check_sharding(params: Dict[str, Any], mesh, rules,
+                   report: LintReport, param_info=None,
+                   large_param_bytes: int = 1 << 20) -> None:
+    """Audit the rule table against the actual parameter scope. The
+    per-param drop diagnostics (axis missing / dim not divisible /
+    rank mismatch) come from routing ``sharding._warn_drop`` through
+    the report collector while resolving every spec — the same code
+    path placement uses, so the audit can never disagree with it."""
+    if mesh is None or rules is None or not params:
+        return
+    from ..parallel.sharding import CANONICAL_AXES
+
+    # typo'd axes must be read off the RAW table: adapted_to strips
+    # non-mesh axes (and memoizes, so its one-shot adapt-time warning
+    # may long since have fired outside any collector)
+    nameset = set(mesh.axis_names)
+    for i, (pat, spec) in enumerate(getattr(rules, "rules", []) or []):
+        for entry in spec:
+            axes = entry if isinstance(entry, tuple) else (
+                (entry,) if entry is not None else ())
+            for a in axes:
+                if a not in nameset and a not in CANONICAL_AXES:
+                    report.add(
+                        "sharding:unknown-axis", "warning",
+                        f"rule #{i} {pat.pattern!r} names axis {a!r} which "
+                        f"is neither in the mesh {dict(mesh.shape)} nor a "
+                        f"canonical axis name {sorted(CANONICAL_AXES)} — "
+                        "likely a typo; that dim is silently replicated",
+                        where=pat.pattern, rule_index=i, axis=a)
+
+    adapted = rules.adapted_to(mesh)
+    names = list(params)
+    for i, (pat, spec) in enumerate(getattr(adapted, "rules", []) or []):
+        if not any(pat.search(n) for n in names):
+            report.add(
+                "sharding:unmatched-rule", "warning",
+                f"rule #{i} {pat.pattern!r} → {spec} matches no parameter "
+                f"({len(names)} in scope) — stale pattern or renamed layer",
+                where=pat.pattern, rule_index=i)
+
+    fsdp_n = mesh.shape.get("fsdp", 1) if "fsdp" in mesh.axis_names else 1
+    with collect_into(report):
+        for name in names:
+            v = params[name]
+            spec = adapted.spec_for(name, tuple(v.shape), mesh)
+            nbytes = int(np.prod(v.shape or (1,))) * np.dtype(v.dtype).itemsize
+            replicated = all(e is None for e in spec)
+            if replicated and fsdp_n > 1 and nbytes >= large_param_bytes:
+                report.add(
+                    "sharding:replicated-large", "warning",
+                    f"{name} ({nbytes / 1e6:.2f} MB {v.dtype}{tuple(v.shape)}) "
+                    f"is fully replicated although the mesh has an fsdp axis "
+                    f"of size {fsdp_n} — each device holds a full copy "
+                    f"(+{(fsdp_n - 1) / fsdp_n * nbytes / 1e6:.2f} MB/device "
+                    "vs sharded)",
+                    where=name, bytes=nbytes, fsdp=fsdp_n)
+
+
+# --------------------------------------------------------------------------
+# 4. dead / zero-gradient parameters
+# --------------------------------------------------------------------------
+
+
+def check_params(program, params, state, args, kwargs,
+                 report: LintReport, loss_name: str = "loss",
+                 closed_flat=None, invar_names=None) -> None:
+    """``params:dead`` — parameters materialized by ``Program.init`` that
+    never appear as live jaxpr invars (the trace never reads them: a
+    created-but-unused layer, or a stale checkpoint name).
+    ``params:zero-grad`` — ``trainable=True`` parameters whose gradient
+    is *structurally* zero (literal-0 broadcast in the grad jaxpr):
+    they consume optimizer state and exchange bandwidth every step and
+    never move."""
+    if closed_flat is None:
+        closed_flat, invar_names = program.desc_flat(params, state, *args,
+                                                     **kwargs)
+    jaxpr = closed_flat.jaxpr
+    used = used_var_ids(jaxpr)
+    dead = set()
+    for var, (kind, name) in zip(jaxpr.invars, invar_names):
+        if kind == "param" and id(var) not in used:
+            dead.add(name)
+            report.add(
+                "params:dead", "warning",
+                f"parameter {name!r} "
+                f"{tuple(getattr(var.aval, 'shape', ()))} is initialized "
+                "but never read by the program — dead weight in every "
+                "checkpoint and optimizer step",
+                where=name)
+
+    # gradient structure: only meaningful when a scalar loss is exposed
+    leaves, treedef = jax.tree.flatten(params)
+    pnames = sorted(params)  # jax flattens dicts in sorted-key order
+
+    def loss_of(flat):
+        p = jax.tree.unflatten(treedef, flat)
+        out, _ = program.apply(p, state, *args, training=False, **kwargs)
+        loss = out.get(loss_name) if isinstance(out, dict) else out
+        return loss
+
+    try:
+        out_aval = jax.eval_shape(loss_of, leaves)
+        if getattr(out_aval, "shape", None) != ():
+            return
+        closed_g = jax.make_jaxpr(jax.grad(loss_of))(leaves)
+    except Exception:
+        return  # no scalar loss under this name: skip the grad analysis
+    gj = closed_g.jaxpr
+    producers = producer_map(gj)
+    info = getattr(program, "param_info", {}) or {}
+    for name, gvar in zip(pnames, gj.outvars):
+        pi = info.get(name)
+        if pi is not None and not pi.trainable:
+            continue  # frozen on purpose (stop_gradient): not a finding
+        if name in dead:
+            continue  # already reported with the sharper code
+        if is_structural_zero(gvar, producers):
+            report.add(
+                "params:zero-grad", "warning",
+                f"trainable parameter {name!r} has a structurally zero "
+                f"gradient w.r.t. {loss_name!r} — it is read by the program "
+                "but the loss does not depend on it (forgotten head? "
+                "mark trainable=False to stop paying optimizer state)",
+                where=name)
+
+
+# --------------------------------------------------------------------------
+# 5. recompilation hazards
+# --------------------------------------------------------------------------
+
+
+def check_signature(bound: Dict[str, Any], report: LintReport) -> None:
+    """Inspect the example call signature for retrace hazards. ``bound``
+    maps argument names to example values (``Program.arg_signature``)."""
+    for name, val in bound.items():
+        for sub, leaf in _named_leaves(name, val):
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                continue  # array-like: shape/dtype keyed, retrace-safe
+            if isinstance(leaf, bool) or isinstance(leaf, (int, float)):
+                report.add(
+                    "retrace:weak-scalar", "info",
+                    f"argument {sub!r} is a weak-typed python "
+                    f"{type(leaf).__name__} ({leaf!r}) — it promotes "
+                    "silently in dtype arithmetic, and if routed to a "
+                    "static argument it recompiles per distinct value; "
+                    "wrap in np.asarray(..., dtype=...)",
+                    where=sub)
+                continue
+            if isinstance(leaf, str) or leaf is None:
+                continue
+            try:
+                hash(leaf)
+            except TypeError:
+                report.add(
+                    "retrace:unhashable-arg", "warning",
+                    f"argument {sub!r} is an unhashable "
+                    f"{type(leaf).__name__} — it cannot key a compile "
+                    "cache (static argnums reject it; as a traced arg each "
+                    "call re-converts it); pass an array or a hashable "
+                    "config object",
+                    where=sub)
+
+
+def _named_leaves(name: str, val):
+    """(name, leaf) pairs one level of dict/tuple deep — enough to name
+    feed entries without flattening arrays themselves."""
+    if isinstance(val, dict) and not hasattr(val, "shape"):
+        for k, v in val.items():
+            yield f"{name}[{k!r}]", v
+    else:
+        # lists/tuples are reported on the container, not per element
+        # (the common hazard is a python list standing in for an array)
+        yield name, val

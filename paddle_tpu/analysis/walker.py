@@ -1,0 +1,143 @@
+"""Jaxpr walking utilities shared by the static checker and debugger.
+
+The jaxpr is this framework's ProgramDesc (framework.py docstring), so
+every analysis is some walk over it. These helpers centralize the
+recursion into nested jaxprs (scan/while/cond/pjit/shard_map bodies) so
+rules can reason about *where* an equation sits — e.g. "psum inside a
+scan body" — the information the reference's IR passes got from block
+nesting (program_desc block indices).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+# Primitive names that carry nested jaxprs whose eqns execute repeatedly
+# per outer execution (loop bodies) — the contexts where a per-iteration
+# collective multiplies its wire cost by the trip count.
+LOOP_PRIMS = frozenset({"scan", "while"})
+
+# Cross-device collective primitives, split by cost class: reductions
+# exchange O(payload) over the whole group (the per-microbatch-allreduce
+# hazard class); neighbor permutes are the deliberate building block of
+# ring/pipeline schedules.
+REDUCTION_COLLECTIVES = frozenset({
+    "psum", "psum2", "pmax", "pmin", "all_gather", "all_to_all",
+    "psum_scatter", "reduce_scatter", "pgather",
+})
+PERMUTE_COLLECTIVES = frozenset({"ppermute", "pbroadcast", "collective_permute"})
+COLLECTIVES = REDUCTION_COLLECTIVES | PERMUTE_COLLECTIVES
+
+
+def eqn_subjaxprs(eqn) -> Iterator[Any]:
+    """Yield every jaxpr nested in one equation's params (scan/cond
+    bodies, pjit/shard_map callees, custom_vjp branches...)."""
+    for v in eqn.params.values():
+        if hasattr(v, "jaxpr"):
+            yield v.jaxpr
+        elif hasattr(v, "eqns"):  # raw Jaxpr (not Closed)
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for u in v:
+                if hasattr(u, "jaxpr"):
+                    yield u.jaxpr
+                elif hasattr(u, "eqns"):
+                    yield u
+
+
+def walk_jaxprs(jaxpr, visit: Callable[[Any], None]) -> None:
+    """Depth-first ``visit(jaxpr)`` over a jaxpr and every nested one."""
+    visit(jaxpr)
+    for eqn in jaxpr.eqns:
+        for sub in eqn_subjaxprs(eqn):
+            walk_jaxprs(sub, visit)
+
+
+def iter_eqns(jaxpr, path: Tuple[str, ...] = ()) -> Iterator[Tuple[Any, Tuple[str, ...]]]:
+    """Yield ``(eqn, path)`` for every equation, ``path`` being the tuple
+    of enclosing primitive names outermost-first — e.g. a psum inside the
+    microbatch scan of a jitted step shows ``("pjit", "scan", "shard_map")``."""
+    for eqn in jaxpr.eqns:
+        yield eqn, path
+        sub_path = path + (eqn.primitive.name,)
+        for sub in eqn_subjaxprs(eqn):
+            yield from iter_eqns(sub, sub_path)
+
+
+def in_loop(path: Tuple[str, ...]) -> bool:
+    return any(p in LOOP_PRIMS for p in path)
+
+
+def aval_bytes(aval) -> int:
+    """Byte size of an abstract value (0 for non-array avals)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    return int(np.prod(shape or (1,))) * np.dtype(dtype).itemsize
+
+
+def eqn_out_bytes(eqn) -> int:
+    return sum(aval_bytes(getattr(ov, "aval", None)) for ov in eqn.outvars)
+
+
+def is_literal(var) -> bool:
+    return hasattr(var, "val") and not hasattr(var, "count")
+
+
+def literal_value(var):
+    return getattr(var, "val", None)
+
+
+def producer_map(jaxpr) -> Dict[int, Any]:
+    """id(outvar) → producing eqn for one jaxpr scope (no nesting)."""
+    out: Dict[int, Any] = {}
+    for eqn in jaxpr.eqns:
+        for ov in eqn.outvars:
+            out[id(ov)] = eqn
+    return out
+
+
+def used_var_ids(jaxpr) -> set:
+    """ids of vars consumed anywhere in one jaxpr scope: eqn inputs and
+    the jaxpr's own outputs. An invar absent from this set is dead —
+    traced in but never read (make_jaxpr does not DCE invars)."""
+    used = set()
+    for eqn in jaxpr.eqns:
+        for iv in eqn.invars:
+            if not is_literal(iv):
+                used.add(id(iv))
+    for ov in jaxpr.outvars:
+        if not is_literal(ov):
+            used.add(id(ov))
+    return used
+
+
+def is_structural_zero(var, producers: Dict[int, Any],
+                       _depth: int = 0) -> bool:
+    """True when ``var`` is provably the constant 0 — a literal zero or a
+    broadcast/convert/reshape chain bottoming out in one. This is exactly
+    the shape jax.grad emits for a parameter the loss does not depend on,
+    so it distinguishes structurally-zero gradients from merely
+    data-independent ones (e.g. grad of sum(p) is a broadcast of 1.0)."""
+    if _depth > 32:
+        return False
+    if is_literal(var):
+        v = literal_value(var)
+        try:
+            return bool(np.all(np.asarray(v) == 0))
+        except Exception:
+            return False
+    eqn = producers.get(id(var))
+    if eqn is None:
+        return False
+    if eqn.primitive.name in ("broadcast_in_dim", "convert_element_type",
+                              "reshape", "transpose", "mul", "neg"):
+        # mul: 0 * anything stays 0 (one zero operand suffices)
+        if eqn.primitive.name == "mul":
+            return any(is_structural_zero(iv, producers, _depth + 1)
+                       for iv in eqn.invars)
+        return is_structural_zero(eqn.invars[0], producers, _depth + 1)
+    return False

@@ -1,0 +1,76 @@
+"""Model-zoo registry for the lint CLI: name → (Program, sample feed).
+
+Mirrors the feed conventions the tests use for each zoo family, so
+``python -m paddle_tpu.analysis --model mnist`` lints exactly the
+program shape the e2e tests train."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import numpy as np
+
+from ..core.errors import enforce
+from ..framework import Program, build
+
+
+def _mnist(variant: str, batch: int, seq: int):
+    from ..models import mnist
+    fn = {"mlp": mnist.mlp, "conv": mnist.conv_net}[variant or "mlp"]
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.rand(batch, 784).astype(np.float32),
+            "label": rng.randint(0, 10, (batch, 1)).astype(np.int64)}
+    return build(fn), feed
+
+
+def _lm_feed(batch: int, seq: int, vocab: int = 64, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(3, vocab, (batch, seq)).astype(np.int32)
+    labels = np.concatenate([ids[:, 1:], np.full((batch, 1), 2)],
+                            axis=1).astype(np.int32)
+    return ids, labels
+
+
+def _moe_transformer(variant: str, batch: int, seq: int):
+    from ..models import moe_transformer as m
+    cfg = m.base_config(vocab_size=64, max_len=max(64, seq), d_model=32,
+                        d_inner=64, d_expert=32, num_heads=4, num_layers=2,
+                        num_experts=4, top_k=2, dropout=0.0, fused_ce=False)
+    ids, labels = _lm_feed(batch, seq)
+    return build(m.make_model(cfg)), {"ids": ids, "labels": labels}
+
+
+def _transformer(variant: str, batch: int, seq: int):
+    from ..models import transformer as t
+    cfg = t.base_config(src_vocab=64, trg_vocab=64, d_model=32, d_inner=64,
+                        num_heads=4, num_encoder_layers=2,
+                        num_decoder_layers=2, dropout=0.0)
+    rng = np.random.RandomState(0)
+    feed = {"src_ids": rng.randint(3, 64, (batch, seq)).astype(np.int32),
+            "trg_ids": rng.randint(3, 64, (batch, seq)).astype(np.int32),
+            "labels": rng.randint(3, 64, (batch, seq)).astype(np.int32)}
+    return build(t.make_model(cfg)), feed
+
+
+def _gpt(variant: str, batch: int, seq: int):
+    from ..models import gpt as g
+    cfg = g.base_config(vocab_size=64, max_len=max(64, seq), d_model=32,
+                        d_inner=64, num_heads=4, num_layers=2,
+                        use_flash=False, fused_ce=False, dropout=0.0)
+    ids, labels = _lm_feed(batch, seq)
+    return build(g.make_model(cfg)), {"ids": ids, "labels": labels}
+
+
+ZOO: Dict[str, Callable[[str, int, int], Tuple[Program, dict]]] = {
+    "mnist": _mnist,
+    "moe_transformer": _moe_transformer,
+    "transformer": _transformer,
+    "gpt": _gpt,
+}
+
+
+def build_model(name: str, variant: str = "", batch: int = 8,
+                seq: int = 16) -> Tuple[Program, dict]:
+    enforce(name in ZOO,
+            f"unknown zoo model {name!r}; options: {sorted(ZOO)}")
+    return ZOO[name](variant, batch, seq)

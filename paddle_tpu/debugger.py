@@ -74,17 +74,10 @@ def summarize_params(params: Dict[str, jax.Array]) -> str:
     return "\n".join([header, "-" * len(header)] + rows)
 
 
-def _walk_jaxprs(jx, visit):
-    """Depth-first over a jaxpr and every nested jaxpr (scan/cond/pjit)."""
-    visit(jx)
-    for eqn in jx.eqns:
-        for v in eqn.params.values():
-            if hasattr(v, "jaxpr"):
-                _walk_jaxprs(v.jaxpr, visit)
-            elif isinstance(v, (list, tuple)):
-                for u in v:
-                    if hasattr(u, "jaxpr"):
-                        _walk_jaxprs(u.jaxpr, visit)
+# Jaxpr recursion lives in paddle_tpu.analysis.walker (the static
+# checker shares the same ProgramDesc walk); re-exported here for the
+# debugger's historical callers.
+from .analysis.walker import walk_jaxprs as _walk_jaxprs  # noqa: E402
 
 
 def op_frequence(program, params, state, *args, with_adjacent: bool = False,

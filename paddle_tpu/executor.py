@@ -163,7 +163,10 @@ class Trainer:
         # adapt preset rule tables to the declared mesh once, up front:
         # axes the mesh doesn't have are dropped silently here (the
         # user's declared intent) instead of tripping the _validate
-        # replication warning on every spec lookup
+        # replication warning on every spec lookup. The pre-adaptation
+        # table is kept for the lint's sharding audit — typo'd axes are
+        # only visible on the raw table (adapted_to strips them).
+        self.sharding_rules_raw = sharding_rules
         if sharding_rules is not None and mesh is not None:
             sharding_rules = sharding_rules.adapted_to(mesh)
         self.sharding_rules = sharding_rules
@@ -182,6 +185,7 @@ class Trainer:
         self._step_fn = None
         self._eval_fn = None
         self.global_step = 0
+        self.lint_report = None  # set by startup(lint=...)
         self.loss_scaler = None
         if strategy is not None and (getattr(strategy, "loss_scale", None)
                                      or getattr(strategy, "dynamic_loss_scale", False)):
@@ -192,7 +196,19 @@ class Trainer:
                 growth_interval=strategy.loss_scale_growth_interval)
 
     # ------------------------------------------------------------------
-    def startup(self, rng: Optional[jax.Array] = None, sample_feed: Optional[Feed] = None):
+    def startup(self, rng: Optional[jax.Array] = None, sample_feed: Optional[Feed] = None,
+                lint: str = "off"):
+        """Initialize the scope and build the jitted step.
+
+        ``lint`` runs the static program checker (paddle_tpu.analysis)
+        over the program + built step before anything compiles:
+        ``"warn"`` surfaces findings as :class:`analysis.LintWarning`
+        and proceeds; ``"error"`` raises :class:`analysis.LintError` on
+        any warning-or-worse finding (collective inside the microbatch
+        scan, mis-sharded params, dead weights...); ``"off"`` (default)
+        skips it. The report is kept at ``self.lint_report``."""
+        enforce(lint in ("off", "warn", "error"),
+                f"Trainer.startup(lint={lint!r}): expected off|warn|error")
         if rng is None:
             rng = make_prng_key(get_flag("seed"))
         feed = {k: _abstractify(v) for k, v in (sample_feed or {}).items()}
@@ -221,6 +237,15 @@ class Trainer:
                 ls = jax.device_put(ls, self.place.device())
             self.scope.loss_scale_state = ls
         self._build_step()
+        self.lint_report = None
+        if lint != "off":
+            from . import analysis
+            report = analysis.check_trainer(self, sample_feed)
+            self.lint_report = report
+            if lint == "error":
+                report.enforce_clean("warning")
+            else:
+                report.emit_warnings("warning")
         return self.scope
 
     # ------------------------------------------------------------------
@@ -260,26 +285,40 @@ class Trainer:
 
     def _apply_row_perm(self, params, opt_state, index_of):
         """Apply a per-name row permutation (``index_of(perm)`` chooses
-        direction) to params and matching optimizer accumulator slots."""
+        direction) to params and every per-param optimizer-state
+        subtree.
+
+        Optimizer-state contract (stated on the Optimizer base class):
+        per-param state must live under a dict keyed by the PARAMETER
+        NAME, at any depth — ``opt_state['accums'][name][slot]`` for the
+        built-ins, but any other name-keyed location works. This walk
+        finds every such subtree and permutes the arrays whose leading
+        dim matches the permutation length, so interleaved-layout
+        checkpoints stay aligned for ANY conforming optimizer (not just
+        ones storing state under 'accums'). Never mutates its inputs —
+        callers pass live scope trees on the save path."""
         perms = getattr(self, "_pp_perm", None) or {}
         if not perms:
             return params, opt_state
         params = dict(params)
-        if opt_state is not None:
-            # shallow-copy the touched levels: callers pass live scope
-            # trees (save path) that must not be reordered in place
-            opt_state = dict(opt_state)
-            opt_state["accums"] = {k: dict(v) for k, v in
-                                   opt_state.get("accums", {}).items()}
         for name, perm in perms.items():
-            idx = index_of(perm)
             if name in params:
-                params[name] = jnp.asarray(params[name])[idx]
-            accums = (opt_state or {}).get("accums", {})
-            for slot, arr in list(accums.get(name, {}).items()):
-                if getattr(arr, "ndim", 0) >= 1 and arr.shape[0] == len(perm):
-                    accums[name][slot] = jnp.asarray(arr)[idx]
-        return params, opt_state
+                params[name] = jnp.asarray(params[name])[index_of(perm)]
+
+        def permute_rows(sub, perm):
+            idx = index_of(perm)
+            return jax.tree.map(
+                lambda a: (jnp.asarray(a)[idx]
+                           if getattr(a, "ndim", 0) >= 1
+                           and a.shape[0] == len(perm) else a), sub)
+
+        def walk(tree):
+            if not isinstance(tree, dict):
+                return tree
+            return {k: (permute_rows(v, perms[k]) if k in perms else walk(v))
+                    for k, v in tree.items()}
+
+        return params, (walk(opt_state) if opt_state is not None else None)
 
     def stacked_to_logical(self, params, opt_state=None):
         """Undo the interleaved rest layout (checkpoint/export order)."""
@@ -556,6 +595,16 @@ class Trainer:
             # and any batch size works.
             from .framework import pipeline_mode
             pp_m, pp_v = self._pp_settings()
+            if getattr(self, "_pp_perm", None):
+                b = jax.tree.leaves(feed)[0].shape[0]
+                enforce(
+                    b % pp_m == 0,
+                    f"Trainer.eval with pp_interleave={pp_v}>1 runs the "
+                    f"training pipeline schedule, so the eval batch ({b}) "
+                    f"must be divisible by pp_microbatches={pp_m} (and its "
+                    "microbatches by the dp shard product) — pad or "
+                    "re-batch the eval feed; plain-pp trainers keep the "
+                    "any-batch scan path")
             ctx = (pipeline_mode(self.mesh, pp_m, interleave=pp_v,
                                  param_layout="interleaved")
                    if getattr(self, "_pp_perm", None)
@@ -589,6 +638,16 @@ class Trainer:
         return out
 
     def eval(self, feed: Feed) -> Dict[str, Any]:
+        """Forward pass without dropout/updates.
+
+        With ``pp_interleave>1`` the stacked parameter rows rest in the
+        Megatron interleaved layout, so eval runs the SAME pipeline
+        schedule as training and inherits its feed constraints: the
+        batch must be divisible by ``DistStrategy.pp_microbatches``
+        (and each microbatch by the dp shard product) — enforced at
+        trace time with a message naming the knob. Plain-pp (``pp_interleave=1``) and
+        non-pipeline trainers evaluate on the scan path, where any
+        batch size works. See MIGRATION.md "Deep stacks"."""
         feed = self._put_feed(feed)
         return self._eval_fn(self.scope.params, self.scope.state, feed)
 

@@ -483,8 +483,59 @@ class Program:
 
         return jax.make_jaxpr(f)(params, state, *args, **kwargs)
 
+    def desc_flat(self, params: Params, state: State, *args,
+                  training: bool = False, rng: Optional[jax.Array] = None,
+                  **kwargs):
+        """The jaxpr with NAMED inputs: returns ``(closed_jaxpr, names)``
+        where ``names[i]`` is a ``(kind, name)`` pair for invar i — kind
+        one of ``"param" | "state" | "arg" | "kwarg"`` — so analyses
+        (paddle_tpu.analysis) can map jaxpr dataflow back to the scope's
+        name-keyed variables, the way the reference's passes read
+        VarDesc names off the ProgramDesc."""
+        import jax.tree_util as jtu
+
+        tree = (params, state or {}, args, kwargs)
+        leaves, treedef = jax.tree.flatten(tree)
+        keyed, _ = jtu.tree_flatten_with_path(tree)
+        kinds = ("param", "state", "arg", "kwarg")
+
+        def name_of(path) -> Tuple[str, str]:
+            kind = kinds[path[0].idx]
+            parts = []
+            for k in path[1:]:
+                if hasattr(k, "key"):
+                    parts.append(str(k.key))
+                elif hasattr(k, "idx"):
+                    parts.append(str(k.idx))
+                elif hasattr(k, "name"):
+                    parts.append(str(k.name))
+            return kind, "/".join(parts)
+
+        def f(flat):
+            p, s, a, kw = jax.tree.unflatten(treedef, flat)
+            out, _ = self.apply(p, s, *a, training=training, rng=rng, **kw)
+            return out
+
+        closed = jax.make_jaxpr(f)(leaves)
+        return closed, [name_of(path) for path, _ in keyed]
+
     def arg_names(self) -> List[str]:
         return list(inspect.signature(self.fn).parameters)
+
+    def arg_signature(self, *args, **kwargs) -> Dict[str, Any]:
+        """Bind an example call to ``fn``'s signature and return the
+        name→value mapping — the traced-argument signature the
+        recompilation-hazard lint (paddle_tpu.analysis) inspects before
+        values are abstracted into avals."""
+        try:
+            bound = inspect.signature(self.fn).bind_partial(*args, **kwargs)
+            return dict(bound.arguments)
+        except TypeError:
+            names = self.arg_names()
+            out = {(names[i] if i < len(names) else f"arg{i}"): a
+                   for i, a in enumerate(args)}
+            out.update(kwargs)
+            return out
 
 
 def _concretize(x):

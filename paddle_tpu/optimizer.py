@@ -40,7 +40,18 @@ class Optimizer:
     Update MATH always runs in float32: accumulators are upcast before
     ``_apply_dense`` and cast back after, so only storage precision
     changes.
-    """
+
+    Opt-state layout contract: any PER-PARAMETER state must live under
+    a dict keyed by the parameter's name — the built-ins use
+    ``opt_state['accums'][param_name][slot]``, and subclasses adding
+    state elsewhere must keep the name-keyed shape (e.g.
+    ``opt_state['rows'][param_name]``). Machinery that re-layouts
+    parameter rows (``Trainer._apply_row_perm``, the interleaved
+    pipeline's checkpoint round-trip) walks opt_state for name-keyed
+    subtrees and permutes arrays whose leading dim matches the param's
+    row permutation; per-param state hidden under other keys would be
+    checkpointed in the wrong row order silently. ``step``/``global``
+    (not per-param) are exempt."""
 
     state_dtype = None  # class default: keep accumulators in float32
 

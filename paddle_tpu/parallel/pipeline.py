@@ -255,10 +255,6 @@ def pipeline_apply(
         bspec = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
 
         def _seq(xv, sp, ex, key):
-            if key is not None:
-                for a in bspec:
-                    key = jax.random.fold_in(key, jax.lax.axis_index(a))
-
             def one(a, xs):
                 lp, li = xs
                 # per-layer rng: the scan body is traced once, so without
@@ -271,12 +267,21 @@ def pipeline_apply(
             out, _ = jax.lax.scan(one, xv, (sp, jnp.arange(L_)))
             return out
         if param_specs is None:
-            # plain GSPMD trace: the ambient rng is visible, masks shard
-            # globally — rng_fold(layer) is all that is needed
-            return _seq(x, stacked_params, extras, None)
+            # plain GSPMD trace: the threaded per-step key (when the
+            # blocks use dropout) drives per-layer masks exactly as the
+            # schedule paths do — GSPMD shards the masks globally, so no
+            # per-shard fold is needed (or possible: no axis binding)
+            return _seq(x, stacked_params, extras, rng_key)
+
         # degenerate pipeline but tp-parallel stages: layer_fn uses mesh
         # collectives, so it still needs to run under shard_map; rng (if
-        # any) must be threaded in explicitly and folded per data shard
+        # any) is folded per data-shard position before the shared body
+        def _seq_sharded(xv, sp, ex, key):
+            if key is not None:
+                for a in bspec:
+                    key = jax.random.fold_in(key, jax.lax.axis_index(a))
+            return _seq(xv, sp, ex, key)
+
         bshard = bspec if len(bspec) > 1 else (bspec[0] if bspec else None)
         x_spec = P(bshard, *([None] * (x.ndim - 1)))
         param_spec = jax.tree.map(
@@ -285,7 +290,7 @@ def pipeline_apply(
         ex_spec = None if extras is None else jax.tree.map(
             lambda e: P(bshard, *([None] * (e.ndim - 1))), extras)
         key_spec = None if rng_key is None else P()
-        return jax.shard_map(_seq, mesh=mesh,
+        return jax.shard_map(_seq_sharded, mesh=mesh,
                              in_specs=(x_spec, param_spec, ex_spec, key_spec),
                              out_specs=x_spec, check_vma=False)(
                                  x, stacked_params, extras, rng_key)

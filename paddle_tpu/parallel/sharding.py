@@ -157,16 +157,47 @@ def _filter_axes(entry, nameset):
     return (keep if len(keep) > 1 else (keep[0] if keep else None)), dropped
 
 
-_warned_drops = set()
+class ShardingRuleWarning(UserWarning):
+    """A sharding rule degraded (axis dropped / dim not divisible) —
+    the multi_devices_check_pass analog: silently-replicated params are
+    the reference's classic mis-sharding failure mode."""
+
+
+# warnings-module registry for warn_explicit: dedup is once per unique
+# message (≈ once per rule key — every key renders a distinct message),
+# honoring the ambient warning filters ("always" re-enables, "error"
+# raises) and resettable with reset_drop_warnings(), unlike the old
+# module-global set that could never re-warn.
+_DROP_REGISTRY: dict = {}
+
+# rule-key kind → lint code for the report-collector path
+_DROP_CODES = {
+    "missing": "sharding:unknown-axis",
+    "adapt-typo": "sharding:unknown-axis",
+    "divide": "sharding:indivisible",
+    "rank": "sharding:rank-mismatch",
+}
+
+
+def reset_drop_warnings():
+    """Re-arm the once-per-key drop warnings (test helper)."""
+    _DROP_REGISTRY.clear()
 
 
 def _warn_drop(key, msg):
-    """Warn once per drop site — the multi_devices_check_pass analog:
-    a rule that silently degrades to replicated is the reference's
-    classic mis-sharding failure mode."""
-    if key not in _warned_drops:
-        _warned_drops.add(key)
-        warnings.warn(msg, stacklevel=4)
+    """Surface one rule-degradation diagnostic: routed into the active
+    :class:`~paddle_tpu.analysis.LintReport` when a lint run has one
+    installed (analysis.report.collect_into), else warned once per key
+    via the warnings module."""
+    from ..analysis import report as _lint
+
+    rep = _lint.active_report()
+    if rep is not None:
+        rep.add(_DROP_CODES.get(key[0], "sharding:dropped-axis"), "warning",
+                msg, where=str(key[1]) if len(key) > 1 else "")
+        return
+    warnings.warn_explicit(msg, ShardingRuleWarning, __file__, 0,
+                           module=__name__, registry=_DROP_REGISTRY)
 
 
 def _validate(spec: P, shape: Tuple[int, ...], mesh: Mesh, name: str) -> P:
@@ -183,11 +214,13 @@ def _validate(spec: P, shape: Tuple[int, ...], mesh: Mesh, name: str) -> P:
         kept, dropped = _filter_axes(entry, nameset)
         for a in dropped:
             # once per (axis, mesh shape): presets legitimately run on
-            # smaller meshes, so per-param warnings would flood
+            # smaller meshes, so per-param warnings would flood — the
+            # message carries no param name so registry dedup matches
+            # the key granularity
             _warn_drop(("missing", a, tuple(mesh.shape.items())),
-                       f"sharding rule for {name!r} names axis {a!r} which is "
-                       f"not in the mesh {dict(mesh.shape)}; replicating that "
-                       f"dim (warned once per axis and mesh shape)")
+                       f"sharding rule names axis {a!r} which is not in the "
+                       f"mesh {dict(mesh.shape)}; replicating that dim "
+                       f"(warned once per axis and mesh shape)")
         keep = [] if kept is None else list(kept if isinstance(kept, tuple) else (kept,))
         size = 1
         for a in keep:

@@ -41,11 +41,15 @@ _orig_cache_read = _jax_compiler._cache_read
 
 
 def _single_device_cache_read(module_name, cache_key, compile_options,
-                              backend, executable_devices):
-    if len(executable_devices) > 1:
+                              backend, *rest, **kw):
+    # signature-tolerant: older jaxlibs call _cache_read without
+    # executable_devices (and don't have the multi-device reload bug
+    # this shim works around — let those read the cache unconditionally)
+    devices = rest[0] if rest else kw.get("executable_devices")
+    if devices is not None and len(devices) > 1:
         return None, None
     return _orig_cache_read(module_name, cache_key, compile_options,
-                            backend, executable_devices)
+                            backend, *rest, **kw)
 
 
 _jax_compiler._cache_read = _single_device_cache_read

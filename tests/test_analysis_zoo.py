@@ -1,0 +1,94 @@
+"""Golden lint reports over the model zoo + the CLI front door.
+
+The zoo programs are the acceptance surface of the checker: the healthy
+models must stay clean (a new false positive here is a checker
+regression), the deliberately mis-configured fixture must keep
+producing its distinct finding codes, and the CLI exit status must be
+CI-usable."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import analysis
+from paddle_tpu.analysis.__main__ import main as lint_main
+from paddle_tpu.analysis.zoo import build_model
+
+
+@pytest.mark.parametrize("name", ["mnist", "transformer", "moe_transformer"])
+def test_zoo_models_are_clean(name):
+    program, feed = build_model(name)
+    report = analysis.check(program, feed)
+    assert report.ok("info"), report.render()
+
+
+def test_mnist_conv_clean():
+    program, feed = build_model("mnist", variant="conv")
+    report = analysis.check(program, feed)
+    assert report.ok("warning"), report.render()
+
+
+def test_gpt_amp_golden_report():
+    """Pinned true positive: the non-fused lm-head logits matmul runs
+    f32 under amp (deliberate f32 log_softmax, but the matmul itself
+    bypasses cast_compute) — the exact class of leak the dtype-flow
+    rule exists to surface. If this goes clean, the head was fixed:
+    update the golden."""
+    program, feed = build_model("gpt")
+    report = analysis.check(program, feed, amp="bfloat16")
+    assert "dtype:amp-f32-matmul" in report.codes(), report.render()
+    assert report.codes() <= {"dtype:amp-f32-matmul", "dtype:cast-roundtrip"}
+
+
+def test_gpt_without_amp_clean():
+    program, feed = build_model("gpt")
+    report = analysis.check(program, feed)
+    assert report.ok("warning"), report.render()
+
+
+def test_missharded_fixture_produces_three_distinct_codes():
+    """Acceptance: a deliberately mis-sharded program yields >= 3
+    distinct finding codes, each from a different rule family."""
+    def fn(x):
+        from paddle_tpu.framework import create_parameter
+        w = create_parameter((15, 16), name="enc/w")     # indivisible by 8
+        dead = create_parameter((64, 64), name="dead/w")  # never read
+        return {"loss": jnp.matmul(x, w).sum()}
+
+    mesh = pt.make_mesh({"fsdp": 8})
+    rules = pt.parallel.ShardingRules([
+        (r".*enc/w$", P("fsdp", None)),
+        (r".*stale_pattern.*", P("fsdp")),
+    ], default=P())
+    report = analysis.check(pt.build(fn), {"x": np.ones((2, 15), np.float32)},
+                            mesh=mesh, rules=rules, large_param_bytes=1024)
+    codes = report.codes()
+    assert {"sharding:indivisible", "sharding:unmatched-rule",
+            "params:dead"} <= codes, report.render()
+    assert len(codes) >= 3
+
+
+def test_cli_mnist_exits_zero(capsys):
+    assert lint_main(["--model", "mnist"]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_fail_on_and_json(capsys):
+    # gpt under amp has a warning finding -> exit 1 at --fail-on warning
+    assert lint_main(["--model", "gpt", "--amp", "bfloat16",
+                      "--format", "json"]) == 1
+    out = capsys.readouterr().out
+    import json
+    d = json.loads(out)
+    assert any(f["code"] == "dtype:amp-f32-matmul" for f in d["findings"])
+    # but passes at --fail-on error
+    assert lint_main(["--model", "gpt", "--amp", "bfloat16",
+                      "--fail-on", "error"]) == 0
+
+
+def test_cli_unknown_model():
+    from paddle_tpu.core.errors import EnforceError
+    with pytest.raises(EnforceError):
+        lint_main(["--model", "nope"])

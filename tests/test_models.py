@@ -174,7 +174,7 @@ def test_transformer_fused_qkv_tp_sharding():
                          sharding_rules=pt.parallel.transformer_tp_rules())
     # the one-shot warning dedup would let an earlier test consume the
     # warning this test asserts against — reset it first
-    _sh._warned_drops.clear()
+    _sh.reset_drop_warnings()
     with warnings.catch_warnings():
         warnings.simplefilter("error", UserWarning)
         trainer.startup(sample_feed=feed)

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Lint a model-zoo program (thin wrapper over the package CLI).
+
+    python tools/lint_program.py --model mnist
+    python tools/lint_program.py --model gpt --amp bfloat16 --fail-on warning
+
+See ``python -m paddle_tpu.analysis --help`` for the full flag surface.
+"""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from paddle_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
