@@ -14,7 +14,12 @@ general non-finite-gradient guard (FLAGS_check_nan_inf's actionable
 cousin: instead of aborting, skip and shrink).
 
 All update logic is branchless (jnp.where) so it stays inside the
-jitted train step.
+jitted train step — which also makes the whole loss-scale state a valid
+``lax.scan`` carry leaf: the fused K-step dispatch
+(``Trainer.run_steps``) threads ``{scale, good_steps, overflows}``
+through the scan so dynamic growth/backoff and overflow-skip behave
+bit-identically to K sequential steps (pinned by
+tests/test_fused_steps.py).
 """
 
 from __future__ import annotations

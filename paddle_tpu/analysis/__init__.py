@@ -3,7 +3,7 @@
 The IR-pass layer of the framework (graph_viz_pass / memory_usage_calc /
 ProgramDesc-validator analog, SURVEY §3): a walker over ``Program.desc``
 — the jaxpr IS the ProgramDesc here — that produces a structured
-:class:`LintReport` before anything compiles. Five rule families:
+:class:`LintReport` before anything compiles. Six rule families:
 
 1. collective placement — reduction collectives inside scan/while
    bodies (the unhoisted-accumulation hazard) with per-step comm-byte
@@ -17,7 +17,10 @@ ProgramDesc-validator analog, SURVEY §3): a walker over ``Program.desc``
    time);
 4. dead / frozen parameters — initialized-but-never-read params and
    trainable params with structurally-zero gradients;
-5. recompilation hazards — weak python scalars and unhashable objects
+5. donation aliasing — fetched step outputs that ARE donated inputs
+   passed through (the donated-buffer-reuse footgun, sharpened by the
+   fused K-step dispatch donating the whole training carry);
+6. recompilation hazards — weak python scalars and unhashable objects
    in the traced argument signature.
 
 Three front doors: programmatic :func:`check` / :func:`check_trainer`,

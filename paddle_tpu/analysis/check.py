@@ -139,10 +139,13 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
             "the built step function)")
     select = kwargs.pop("select", None)
     want_coll = select is None or "collective" in select
-    # the collective family runs over the STEP jaxpr below (the program
-    # jaxpr is a subset of it — walking both would double-report)
+    want_donation = select is None or "donation" in select
+    # the collective and donation families run over the STEP jaxpr below
+    # (the program jaxpr is a subset of it — walking both would
+    # double-report; donation needs the step's donate_argnums anyway)
     inner_select = ({"dtype", "sharding", "params", "retrace"}
-                    if select is None else set(select) - {"collective"})
+                    if select is None
+                    else set(select) - {"collective", "donation"})
     # the PRE-adaptation rule table: typo'd axes only exist there
     # (Trainer.__init__ adapts its working copy, stripping them)
     rules = getattr(trainer, "sharding_rules_raw", None) or trainer.sharding_rules
@@ -153,23 +156,64 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
         strategy=trainer.strategy, loss_name=trainer.loss_name,
         select=inner_select, **kwargs)
     report.subject = f"trainer({trainer.program.name})"
-    if not want_coll:
+    if not (want_coll or want_donation):
         return report
 
-    _rules.check_accum_exchange(trainer.strategy, trainer.mesh,
-                                trainer.scope.params, report)
+    if want_coll:
+        _rules.check_accum_exchange(trainer.strategy, trainer.mesh,
+                                    trainer.scope.params, report)
     if sample_feed is None:
         return report
     feed = _concrete_feed(sample_feed)
     ls = getattr(trainer.scope, "loss_scale_state", None) or {}
-    try:
-        step_jaxpr = jax.make_jaxpr(trainer._step_fn)(
-            trainer.scope.params, trainer.scope.opt_state,
+    args = (trainer.scope.params, trainer.scope.opt_state,
             trainer.scope.state, jax.random.PRNGKey(0), feed, ls)
+    # ONE trace of the raw step body serves both families: the same
+    # collective eqns the jitted wrapper would show (minus the pjit
+    # shell), plus the invar→outvar identity the donation rule needs
+    # (the jitted wrapper hides passthrough aliasing)
+    core = getattr(trainer, "_train_step_core", None) or trainer._step_fn
+    try:
+        closed, out_shape = jax.make_jaxpr(core, return_shape=True)(*args)
     except Exception as e:
         report.add("collective:step-trace-failed", "info",
-                   f"could not trace the compiled step for collective "
-                   f"placement ({type(e).__name__}: {e})")
-    else:
-        _rules.check_collectives(step_jaxpr, report, mesh=trainer.mesh)
+                   f"could not trace the step for collective/donation "
+                   f"rules ({type(e).__name__}: {e})")
+        return report
+    if want_coll:
+        _rules.check_collectives(closed, report, mesh=trainer.mesh)
+    if want_donation and getattr(trainer, "_train_step_core", None) is not None:
+        _check_step_donation(trainer, args, closed, out_shape, report)
     return report
+
+
+_STEP_ARGNAMES = ("params", "opt_state", "state", "rng", "feed", "loss_scale")
+
+
+def _check_step_donation(trainer, args, closed, out_shape,
+                         report: LintReport) -> None:
+    """Donation lint over the traced RAW step body: map each donated
+    argnum to its flat invar indices and the step's fetch dict to its
+    flat outvar indices, then flag fetched outputs that ARE donated
+    invars (rules.check_donation)."""
+    donate = set(getattr(trainer, "_donate_argnums", ()) or ())
+    if not donate:
+        return
+    donated = {}
+    idx = 0
+    for argnum, a in enumerate(args):
+        for path, _leaf in jax.tree_util.tree_flatten_with_path(a)[0]:
+            if argnum in donate:
+                name = _STEP_ARGNAMES[argnum] + jax.tree_util.keystr(path)
+                donated[idx] = name
+            idx += 1
+    # step outputs are (new_params, new_opt, new_state, out, new_ls):
+    # only the fetch dict (index 3) is read by the caller after the
+    # step — carry outputs aliasing donated inputs are the POINT of
+    # donation, not a finding
+    fetched = {}
+    for i, (path, _leaf) in enumerate(
+            jax.tree_util.tree_flatten_with_path(out_shape)[0]):
+        if getattr(path[0], "idx", None) == 3:
+            fetched[i] = "out" + jax.tree_util.keystr(path[1:])
+    _rules.check_donation(closed, donated, fetched, report)

@@ -15,6 +15,9 @@ scope, mesh/rules, example arguments) and appends :class:`Finding`s to a
   left replicated).
 - ``params:*``     — dead parameters (initialized, never read) and
   trainable parameters with structurally-zero gradients.
+- ``donation:*``   — fetched step outputs aliasing donated inputs (the
+  donated-buffer-reuse footgun, sharpened by the K-step fused dispatch
+  donating the whole training carry).
 - ``retrace:*``    — recompilation hazards in the traced arg signature
   (weak python scalars, unhashable objects).
 """
@@ -355,7 +358,51 @@ def check_params(program, params, state, args, kwargs,
 
 
 # --------------------------------------------------------------------------
-# 5. recompilation hazards
+# 5. donation aliasing
+# --------------------------------------------------------------------------
+
+
+def check_donation(closed_jaxpr, donated: Dict[int, str],
+                   fetched: Dict[int, str], report: LintReport) -> None:
+    """``donation:fetched-alias`` — a FETCHED step output that is a
+    donated input passed through unchanged (the outvar IS the invar in
+    the step jaxpr). With buffer donation XLA reuses the donated buffer
+    for the in-place param/opt-state update, so the passthrough forces a
+    defensive copy at best — and a caller that keeps the fetched handle
+    across the next (donating) dispatch holds a buffer the runtime
+    considers consumed: the donated-buffer-reuse footgun. The K-step
+    fused dispatch (``Trainer.run_steps``) donates the whole training
+    carry end-to-end, which widens the window — fetch a computed value
+    (e.g. ``jnp.copy`` / a fresh reduction) instead of the raw carry
+    leaf.
+
+    ``donated`` maps flat invar index → display name for every donated
+    leaf; ``fetched`` maps flat outvar index → display name for every
+    leaf of the step's fetch dict."""
+    jaxpr = closed_jaxpr.jaxpr
+    donated_by_id = {id(jaxpr.invars[i]): name
+                     for i, name in donated.items() if i < len(jaxpr.invars)}
+    for i, oname in fetched.items():
+        if i >= len(jaxpr.outvars):
+            continue
+        v = jaxpr.outvars[i]
+        if type(v).__name__ == "Literal":
+            continue
+        src = donated_by_id.get(id(v))
+        if src is not None:
+            report.add(
+                "donation:fetched-alias", "warning",
+                f"fetched step output {oname} is donated input {src} "
+                "passed through unchanged — donation hands that buffer to "
+                "XLA for in-place reuse, so fetching the alias forces a "
+                "copy (or, held across the next donating dispatch, reads "
+                "a consumed buffer); fetch a computed value or drop it "
+                "from fetch_list",
+                where=oname, donated_input=src, outvar_index=i)
+
+
+# --------------------------------------------------------------------------
+# 6. recompilation hazards
 # --------------------------------------------------------------------------
 
 

@@ -113,6 +113,11 @@ define_flag("rng_impl", "auto",
             "native RngBitGenerator on TPU (threefry synthesizes random "
             "bits from many VPU ops and can dominate dropout-heavy "
             "steps) and threefry elsewhere / under determinism")
+define_flag("compile_cache_dir", "",
+            "Persistent XLA compilation cache directory wired by "
+            "Trainer.startup (empty = off). Repeated bench/CI runs skip "
+            "recompiling the (fused) train step; hit/miss is logged on "
+            "the first dispatch. Env PDTPU_COMPILE_CACHE_DIR")
 define_flag("flash_block_q", 0,
             "flash-attention q-block rows; 0 = kernel default "
             "(ops/flash_attention.DEFAULT_BLOCK_Q). Env "

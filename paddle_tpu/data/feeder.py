@@ -6,6 +6,12 @@ the py_reader/double_buffer device pipeline (operators/reader/
 buffered_reader.cc, layers/io.py:478): ``DeviceFeeder`` runs the host
 reader in a background thread and keeps N batches in flight on device so
 host→HBM transfer overlaps with compute.
+
+``DeviceFeeder(stack_k=K)`` additionally assembles K host batches into
+one stacked super-batch ``{name: (K, batch, ...)}`` and transfers it in
+ONE sharded put — the feed side of the fused multi-step dispatch
+(``Trainer.run_steps`` / ``fit(steps_per_dispatch=K)``): one
+host→device transfer and one launch per K optimizer steps instead of K.
 """
 
 from __future__ import annotations
@@ -42,37 +48,150 @@ class DataFeeder:
         return out
 
 
+def stack_batches(bufs: Sequence[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    """Stack K same-shape feed dicts into one ``{name: (K, ...)}``
+    super-batch (the fused-dispatch super-batch layout)."""
+    return {k: np.stack([np.asarray(b[k]) for b in bufs]) for k in bufs[0]}
+
+
+def _stackable(a: Dict[str, np.ndarray], b: Dict[str, np.ndarray]) -> bool:
+    """Two batches can share a super-batch: same keys, shapes, dtypes
+    (a short final reader batch must not poison the stack)."""
+    if a.keys() != b.keys():
+        return False
+    for k in a:
+        va, vb = np.asarray(a[k]), np.asarray(b[k])
+        if va.shape != vb.shape or va.dtype != vb.dtype:
+            return False
+    return True
+
+
+def _host_chunks(batches: Iterator[Dict[str, np.ndarray]], k: int):
+    """The one chunking state machine both feed paths share: yields
+    ``(n, host_feed)`` — full K-chunks stacked (``n == k``),
+    remainder/odd-shape batches singly (``n == 1``, unstacked) so they
+    fall through to the compiled single-step function with no
+    fused-program retrace."""
+    buf: List[Dict[str, np.ndarray]] = []
+    for b in batches:
+        if buf and not _stackable(buf[0], b):
+            for s in buf:
+                yield 1, s
+            buf = []
+        buf.append(b)
+        if len(buf) == k:
+            yield k, stack_batches(buf)
+            buf = []
+    for s in buf:
+        yield 1, s
+
+
+def iter_chunked(batches: Iterator[Dict[str, np.ndarray]], k: int,
+                 put_fn: Callable, put_stacked_fn: Callable):
+    """Synchronous chunker (the no-prefetch path of
+    ``fit(steps_per_dispatch=K)``): ``_host_chunks`` plus the device
+    put, yielding ``(n, device_feed)``."""
+    for n, hb in _host_chunks(batches, k):
+        yield n, (put_stacked_fn(hb) if n > 1 else put_fn(hb))
+
+
 class DeviceFeeder:
     """Double-buffered host→device prefetch (py_reader + double_buffer
-    analog). Wraps an iterator of feed dicts; `__iter__` yields dicts of
-    on-device arrays while the next batches transfer in the background."""
+    analog). Wraps an iterator of feed dicts; ``__iter__`` yields dicts
+    of on-device arrays while the next batches transfer in the
+    background.
+
+    With ``stack_k=K > 1`` the fill thread stacks K host batches into a
+    super-batch, transfers it with ``put_stacked_fn`` in one put, and
+    the iterator yields ``(n, feed)`` pairs — ``n == K`` for full
+    chunks, ``n == 1`` (unstacked, via ``put_fn``) for remainder or
+    shape-mismatched batches.
+
+    The fill thread is CANCELLABLE: abandoning the iterator (break /
+    exception / gc) or calling :meth:`close` unblocks it even when it is
+    parked on a full queue holding device buffers — the old leak where a
+    daemon thread pinned HBM until process exit."""
 
     def __init__(self, batches: Callable[[], Iterator[Dict[str, np.ndarray]]],
                  put_fn: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, jax.Array]]] = None,
-                 capacity: int = 2):
+                 capacity: int = 2, stack_k: int = 1,
+                 put_stacked_fn: Optional[Callable] = None):
         self.batches = batches
         self.put_fn = put_fn or (lambda d: jax.device_put(d))
+        self.put_stacked_fn = put_stacked_fn or self.put_fn
         self.capacity = capacity
+        self.stack_k = max(1, int(stack_k))
+        self._stops: List[threading.Event] = []
+        self._threads: List[threading.Thread] = []
+
+    def close(self):
+        """Cancel every live fill thread (idempotent). Threads parked on
+        a full queue wake on the stop flag and exit, dropping their
+        device-buffer references."""
+        for ev in self._stops:
+            ev.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = [t for t in self._threads if t.is_alive()]
 
     def __iter__(self):
         q: _queue.Queue = _queue.Queue(maxsize=self.capacity)
         END = object()
         err: List[BaseException] = []
+        stop = threading.Event()
+        self._stops.append(stop)
+
+        def put(item) -> bool:
+            # bounded-wait put: a consumer that stopped consuming must
+            # not strand this thread (and its device buffers) forever
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
 
         def fill():
             try:
-                for b in self.batches():
-                    q.put(self.put_fn(b))
+                if self.stack_k > 1:
+                    for n, hb in _host_chunks(self.batches(), self.stack_k):
+                        if stop.is_set():
+                            return
+                        item = (n, self.put_stacked_fn(hb) if n > 1
+                                else self.put_fn(hb))
+                        if not put(item):
+                            return
+                else:
+                    for b in self.batches():
+                        if stop.is_set():
+                            return
+                        if not put(self.put_fn(b)):
+                            return
             except BaseException as e:  # surfaced on the consumer side
                 err.append(e)
             finally:
-                q.put(END)
+                if not put(END):
+                    # stop was set (close() possibly from ANOTHER thread
+                    # than the consumer): a consumer still parked in
+                    # q.get() must not hang — if it is parked, the queue
+                    # is empty and this delivery succeeds
+                    try:
+                        q.put_nowait(END)
+                    except _queue.Full:
+                        pass
 
-        threading.Thread(target=fill, daemon=True).start()
-        while True:
-            item = q.get()
-            if item is END:
-                if err:
-                    raise err[0]
-                return
-            yield item
+        t = threading.Thread(target=fill, daemon=True)
+        self._threads.append(t)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is END:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            # break / exception / generator gc: release the fill thread
+            stop.set()

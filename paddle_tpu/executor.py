@@ -54,6 +54,11 @@ class Scope:
         return sorted(self.params) + sorted(self.state)
 
 
+def _trainer_log():
+    import logging
+    return logging.getLogger("paddle_tpu.trainer")
+
+
 def _check_nan_inf(tree, where: str):
     flat, _ = jax.tree.flatten(tree)
     for leaf in flat:
@@ -183,7 +188,12 @@ class Trainer:
         self.fetch_list = list(fetch_list) if fetch_list is not None else None
         self.scope = Scope()
         self._step_fn = None
+        self._multi_step_fn = None
         self._eval_fn = None
+        # python executions of the step body == traces (the body only
+        # runs at trace time inside jit/scan); tests pin no-retrace
+        # guarantees on this counter staying flat
+        self._trace_count = 0
         self.global_step = 0
         self.lint_report = None  # set by startup(lint=...)
         self.loss_scaler = None
@@ -209,6 +219,7 @@ class Trainer:
         skips it. The report is kept at ``self.lint_report``."""
         enforce(lint in ("off", "warn", "error"),
                 f"Trainer.startup(lint={lint!r}): expected off|warn|error")
+        self._setup_compile_cache()
         if rng is None:
             rng = make_prng_key(get_flag("seed"))
         feed = {k: _abstractify(v) for k, v in (sample_feed or {}).items()}
@@ -522,6 +533,7 @@ class Trainer:
                       else None)
 
         def train_step(params, opt_state, state, rng, feed, ls):
+            self._trace_count += 1  # trace-time only: counts compilations
             def loss_and_aux(p, st, r, f):
                 loss, aux = self._loss_and_aux(p, st, r, f)
                 if scaler is not None:
@@ -577,6 +589,11 @@ class Trainer:
             return new_params, new_opt, new_state, out, new_ls
 
         donate = (0, 1, 2, 5) if self.donate else ()
+        # kept for the fused driver and the donation lint: the raw
+        # python step body (check_trainer traces it to see input→output
+        # passthrough aliasing that the jitted wrapper hides)
+        self._train_step_core = train_step
+        self._donate_argnums = donate
         if self.mesh is not None:
             from .parallel import api as par_api
             self._step_fn = par_api.jit_sharded_step(
@@ -584,6 +601,35 @@ class Trainer:
                 scope=self.scope)
         else:
             self._step_fn = jax.jit(train_step, donate_argnums=donate)
+
+        def run_k_steps(params, opt_state, state, base_rng, step0, feed_k, ls):
+            """Fused multi-step driver: ONE device launch runs K
+            optimizer steps under lax.scan with the full training carry
+            (params, opt_state, state, loss-scale state) resident on
+            device between updates — per-step rng keys reproduce the
+            sequential ``step()`` stream exactly (fold_in of the same
+            base key at the same global step)."""
+            k = jax.tree.leaves(feed_k)[0].shape[0]
+
+            def body(carry, x):
+                p, o, s, ls_ = carry
+                r = jax.random.fold_in(base_rng, step0 + x["i"])
+                p, o, s, out, ls_ = train_step(p, o, s, r, x["feed"], ls_)
+                return (p, o, s, ls_), out
+
+            (p, o, s, new_ls), outs = jax.lax.scan(
+                body, (params, opt_state, state, ls),
+                {"i": jnp.arange(k, dtype=jnp.int32), "feed": feed_k})
+            return p, o, s, outs, new_ls
+
+        kdonate = (0, 1, 2, 6) if self.donate else ()
+        if self.mesh is not None:
+            from .parallel import api as par_api
+            self._multi_step_fn = par_api.jit_sharded_step(
+                self.mesh, self.sharding_rules, run_k_steps,
+                donate_argnums=kdonate, scope=self.scope)
+        else:
+            self._multi_step_fn = jax.jit(run_k_steps, donate_argnums=kdonate)
 
         def eval_step(params, state, feed):
             # With the interleaved rest layout (pp_interleave>1) the
@@ -617,6 +663,60 @@ class Trainer:
         self._eval_fn = jax.jit(eval_step)
 
     # ------------------------------------------------------------------
+    def _setup_compile_cache(self):
+        """Wire the persistent XLA compilation cache (behind the
+        ``compile_cache_dir`` flag / ``PDTPU_COMPILE_CACHE_DIR`` env):
+        repeated bench/CI runs then skip recompiles of the (large) fused
+        step program. Keyed on the HLO hash, so edited model code can
+        never be served a stale executable. Hit/miss is logged on the
+        first dispatch (``paddle_tpu.trainer`` logger)."""
+        import os
+
+        d = get_flag("compile_cache_dir")
+        self._cache_dir = d or None
+        self._cache_logged = False
+        if not d:
+            return
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        try:
+            # the cache singleton latches the dir at first use: drop it
+            # so the flag takes effect even mid-process
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:
+            pass
+        self._cache_entries0 = len(os.listdir(d))
+        _trainer_log().info(
+            "persistent compilation cache at %s (%d entries)", d,
+            self._cache_entries0)
+
+    def _log_compile_cache(self, what: str):
+        """After the first dispatch of a compiled fn: did the persistent
+        cache serve it (entry count unchanged) or was it a miss (new
+        entries written)?"""
+        import os
+
+        if self._cache_logged or not getattr(self, "_cache_dir", None):
+            return
+        self._cache_logged = True
+        try:
+            now = len(os.listdir(self._cache_dir))
+        except OSError:
+            return
+        new = now - self._cache_entries0
+        if new > 0:
+            _trainer_log().info(
+                "compile cache MISS for %s: %d new entr%s written to %s",
+                what, new, "y" if new == 1 else "ies", self._cache_dir)
+        else:
+            _trainer_log().info(
+                "compile cache HIT for %s (served from %s)", what,
+                self._cache_dir)
+
+    # ------------------------------------------------------------------
     def step(self, feed: Feed, rng: Optional[jax.Array] = None) -> Dict[str, Any]:
         """One optimization step; returns the program's fetch dict."""
         enforce(self._step_fn is not None, "call startup() before step()")
@@ -627,6 +727,7 @@ class Trainer:
         with profiler.record_event("trainer.step"):
             p, o, s, out, new_ls = self._step_fn(self.scope.params, self.scope.opt_state,
                                                  self.scope.state, rng, feed, ls)
+        self._log_compile_cache("train step")
         self.scope.params, self.scope.opt_state, self.scope.state = p, o, s
         if self.loss_scaler is not None:
             self.scope.loss_scale_state = new_ls
@@ -636,6 +737,54 @@ class Trainer:
         if get_flag("check_nan_inf"):
             _check_nan_inf(out, "train step outputs")
         return out
+
+    def run_steps(self, stacked_feed: Feed, k: Optional[int] = None,
+                  rng: Optional[jax.Array] = None) -> Dict[str, Any]:
+        """K optimization steps in ONE device launch (fused multi-step
+        dispatch): ``stacked_feed`` carries K per-step batches on a new
+        leading axis (``{name: (K, batch, ...)}``), the jitted program
+        scans over them with params/opt_state/state/loss-scale donated
+        end-to-end, and the fetch dict comes back stacked ``(K, ...)``.
+
+        Per-step rng keys are ``fold_in(base, global_step + i)`` — the
+        SAME stream ``step()`` draws — so K fused steps are numerically
+        identical to K sequential ``step()`` calls (pinned by
+        tests/test_fused_steps.py). ``k`` is validated against the feed's
+        leading dim; each distinct K compiles once (remainder batches
+        should fall through to :meth:`step`, as ``fit`` does).
+        Amortizes the Python→XLA launch overhead that dominates small
+        step times (see BENCH ``dispatch_overhead``)."""
+        enforce(self._multi_step_fn is not None,
+                "call startup() before run_steps()")
+        lead = {name: jax.tree.leaves(v)[0].shape[0]
+                for name, v in stacked_feed.items()}
+        enforce(len(set(lead.values())) == 1,
+                f"run_steps: stacked feed leading dims disagree: {lead}")
+        feed_k = next(iter(lead.values()))
+        if k is None:
+            k = feed_k
+        enforce(k == feed_k,
+                f"run_steps(k={k}): stacked feed carries {feed_k} step "
+                "batches on its leading axis")
+        if rng is None:
+            rng = make_prng_key(get_flag("seed") + 1)
+        feed = self._put_feed(stacked_feed, stacked=True)
+        ls = getattr(self.scope, "loss_scale_state", None) or {}
+        step0 = np.int32(self.global_step)
+        with profiler.record_event("trainer.run_steps"):
+            p, o, s, outs, new_ls = self._multi_step_fn(
+                self.scope.params, self.scope.opt_state, self.scope.state,
+                rng, step0, feed, ls)
+        self._log_compile_cache(f"fused {k}-step program")
+        self.scope.params, self.scope.opt_state, self.scope.state = p, o, s
+        if self.loss_scaler is not None:
+            self.scope.loss_scale_state = new_ls
+        self.global_step += k
+        if get_flag("benchmark"):
+            jax.block_until_ready(outs)
+        if get_flag("check_nan_inf"):
+            _check_nan_inf(outs, "fused train step outputs")
+        return outs
 
     def eval(self, feed: Feed) -> Dict[str, Any]:
         """Forward pass without dropout/updates.
@@ -651,10 +800,14 @@ class Trainer:
         feed = self._put_feed(feed)
         return self._eval_fn(self.scope.params, self.scope.state, feed)
 
-    def _put_feed(self, feed: Feed):
+    def _put_feed(self, feed: Feed, stacked: bool = False):
+        """Place a feed on device/mesh. ``stacked=True``: the feed is a
+        K-step super-batch ``(K, batch, ...)`` — the steps axis stays
+        replicated, the batch sharding applies from dim 1."""
         if self.mesh is not None:
             from .parallel import api as par_api
-            return par_api.put_batch(self.mesh, self.sharding_rules, feed)
+            return par_api.put_batch(self.mesh, self.sharding_rules, feed,
+                                     stacked=stacked)
         dev = self.place.device()
         return {k: jax.device_put(np.asarray(v) if not isinstance(v, jax.Array) else v, dev)
                 for k, v in feed.items()}
@@ -672,27 +825,47 @@ class CheckpointConfig:
 
 
 class Event:
-    """Training events (contrib.trainer BeginEpochEvent/EndStepEvent…)."""
+    """Training events (contrib.trainer BeginEpochEvent/EndStepEvent…).
 
-    def __init__(self, kind: str, epoch: int, step: int, metrics=None):
+    ``num_steps`` > 1 marks a fused-dispatch chunk (``fit(...,
+    steps_per_dispatch=K)``): one begin_step/end_step pair covers
+    ``num_steps`` optimizer steps and the end_step ``metrics`` arrays
+    carry a leading ``(num_steps, ...)`` axis — see MIGRATION.md
+    "Fused stepping"."""
+
+    def __init__(self, kind: str, epoch: int, step: int, metrics=None,
+                 num_steps: int = 1):
         self.kind = kind  # begin_epoch | end_epoch | begin_step | end_step
         self.epoch = epoch
         self.step = step
         self.metrics = metrics or {}
+        self.num_steps = num_steps
 
 
 def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
         dtypes: Optional[Sequence[Any]] = None, event_handler=None,
         checkpoint_config: Optional[CheckpointConfig] = None,
-        prefetch: bool = True):
+        prefetch: bool = True, steps_per_dispatch: int = 1):
     """High-level train loop (contrib.trainer.Trainer.train analog):
     reader → DataFeeder → (optional double-buffered prefetch) →
-    trainer.step, with event callbacks and periodic checkpoints."""
+    trainer.step, with event callbacks and periodic checkpoints.
+
+    ``steps_per_dispatch=K`` fuses the hot path: the prefetch thread
+    stacks K host batches into one super-batch, transfers it in one
+    sharded put, and ``trainer.run_steps`` runs the K optimizer steps in
+    a single device launch. Events fire once per CHUNK (``Event.num_steps``,
+    stacked metrics), ``global_step`` advances by the true step count
+    (remainder batches run singly through ``trainer.step``), and
+    ``step_interval`` checkpoints round forward to the chunk boundary
+    that crossed the interval. See MIGRATION.md "Fused stepping"."""
     import os
 
+    from .core.errors import enforce as _enforce
     from . import io as _io
-    from .data.feeder import DataFeeder, DeviceFeeder
+    from .data.feeder import DataFeeder, DeviceFeeder, iter_chunked
 
+    _enforce(steps_per_dispatch >= 1,
+             f"fit(steps_per_dispatch={steps_per_dispatch}): need >= 1")
     feeder = DataFeeder(feed_names, dtypes)
     kept: List[str] = []
 
@@ -706,6 +879,7 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
             import shutil
             shutil.rmtree(kept.pop(0), ignore_errors=True)
 
+    si = checkpoint_config.step_interval if checkpoint_config else 0
     for epoch in range(num_epochs):
         if event_handler:
             event_handler(Event("begin_epoch", epoch, trainer.global_step))
@@ -714,17 +888,44 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
             for samples in reader():
                 yield feeder.feed(samples)
 
-        iterator = DeviceFeeder(batches, put_fn=trainer._put_feed) if prefetch \
-            else map(trainer._put_feed, batches())
-        for feed in iterator:
-            if event_handler:
-                event_handler(Event("begin_step", epoch, trainer.global_step))
-            out = trainer.step(feed)
-            if event_handler:
-                event_handler(Event("end_step", epoch, trainer.global_step, out))
-            if (checkpoint_config and checkpoint_config.step_interval and
-                    trainer.global_step % checkpoint_config.step_interval == 0):
-                save(f"step_{trainer.global_step}")
+        device_feeder = None
+        if prefetch:
+            device_feeder = DeviceFeeder(
+                batches, put_fn=trainer._put_feed,
+                stack_k=steps_per_dispatch,
+                put_stacked_fn=functools.partial(trainer._put_feed,
+                                                 stacked=True))
+            iterator = iter(device_feeder)
+        elif steps_per_dispatch > 1:
+            iterator = iter_chunked(
+                batches(), steps_per_dispatch, put_fn=trainer._put_feed,
+                put_stacked_fn=functools.partial(trainer._put_feed,
+                                                 stacked=True))
+        else:
+            iterator = map(trainer._put_feed, batches())
+        try:
+            for item in iterator:
+                n, feed = item if steps_per_dispatch > 1 else (1, item)
+                gs_before = trainer.global_step
+                if event_handler:
+                    event_handler(Event("begin_step", epoch, gs_before,
+                                        num_steps=n))
+                out = trainer.run_steps(feed, k=n) if n > 1 \
+                    else trainer.step(feed)
+                if event_handler:
+                    event_handler(Event("end_step", epoch,
+                                        trainer.global_step, out,
+                                        num_steps=n))
+                # chunk-boundary rounding: save whenever this dispatch
+                # crossed a step_interval multiple (== the exact-multiple
+                # check when n == 1)
+                if si and trainer.global_step // si > gs_before // si:
+                    save(f"step_{trainer.global_step}")
+        finally:
+            # consumer abandoned mid-epoch (exception/early exit): the
+            # fill thread must not stay blocked holding device buffers
+            if device_feeder is not None:
+                device_feeder.close()
         if event_handler:
             event_handler(Event("end_epoch", epoch, trainer.global_step))
         if checkpoint_config and checkpoint_config.epoch_interval and \

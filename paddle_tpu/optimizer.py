@@ -51,7 +51,15 @@ class Optimizer:
     subtrees and permutes arrays whose leading dim matches the param's
     row permutation; per-param state hidden under other keys would be
     checkpointed in the wrong row order silently. ``step``/``global``
-    (not per-param) are exempt."""
+    (not per-param) are exempt.
+
+    Scan-carry contract (``Trainer.run_steps`` — the fused K-step
+    dispatch threads opt_state through a ``lax.scan`` carry): ``update``
+    must return an opt_state with the SAME pytree structure and leaf
+    shapes/dtypes as its input — the built-ins already do (the
+    ``_store_acc``/``_compute_acc`` round-trip keeps storage dtype
+    invariant); a subclass that grows or retypes state per step would
+    fail the scan's carry check loudly at trace time."""
 
     state_dtype = None  # class default: keep accumulators in float32
 

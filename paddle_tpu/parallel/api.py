@@ -56,7 +56,8 @@ def shard_scope(mesh: Mesh, rules: Optional[ShardingRules], params, state, opt_s
     return sharded_params, state, place_opt(opt_state) if opt_state is not None else None
 
 
-def put_batch(mesh: Mesh, rules: Optional[ShardingRules], feed: Dict[str, Any]):
+def put_batch(mesh: Mesh, rules: Optional[ShardingRules], feed: Dict[str, Any],
+              stacked: bool = False):
     """Shard a host batch over the data axes (DataFeeder.feed_parallel
     analog, data_feeder.py:201 — without the per-device split loop).
 
@@ -65,13 +66,23 @@ def put_batch(mesh: Mesh, rules: Optional[ShardingRules], feed: Dict[str, Any]):
     shard and the global array is assembled across hosts — the
     num_trainers/trainer_id data split of the reference
     (distribute_transpiler trainer-side), without program surgery.
+
+    ``stacked=True``: the feed is a fused-dispatch super-batch
+    ``{name: (K, batch, ...)}`` (K per-step batches stacked by
+    DeviceFeeder) — the steps axis is replicated and the per-step batch
+    sharding applies from dim 1, so ONE transfer stages K steps of data
+    exactly as K separate ``put_batch`` calls would have.
     """
     rules = _rules(rules, mesh)
     multiproc = jax.process_count() > 1
     out = {}
     for k, v in feed.items():
         arr = np.asarray(v) if not isinstance(v, jax.Array) else v
-        spec = rules.batch_spec(mesh, arr.ndim, shape=arr.shape)
+        if stacked:
+            inner = rules.batch_spec(mesh, arr.ndim - 1, shape=arr.shape[1:])
+            spec = P(None, *inner)
+        else:
+            spec = rules.batch_spec(mesh, arr.ndim, shape=arr.shape)
         ns = NamedSharding(mesh, spec)
         if multiproc:
             # contract: each process feeds its LOCAL slice of the batch
@@ -81,8 +92,13 @@ def put_batch(mesh: Mesh, rules: Optional[ShardingRules], feed: Dict[str, Any]):
             # process (e.g. an {"sp": n} mesh replicates the batch and
             # shards seq: every process feeds the same full batch, and
             # the runtime slices each host's addressable seq shards).
-            span = _procs_spanning(mesh, spec[0] if len(spec) else None)
-            global_shape = (arr.shape[0] * span,) + arr.shape[1:]
+            # Stacked feeds keep the steps axis whole on every process,
+            # so the span is read off the PER-STEP batch dim.
+            bdim = 1 if stacked else 0
+            span = _procs_spanning(mesh,
+                                   spec[bdim] if len(spec) > bdim else None)
+            global_shape = (arr.shape[:bdim]
+                            + (arr.shape[bdim] * span,) + arr.shape[bdim + 1:])
             out[k] = jax.make_array_from_process_local_data(ns, arr, global_shape)
         else:
             out[k] = jax.device_put(arr, ns)

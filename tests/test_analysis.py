@@ -266,7 +266,69 @@ def test_clean_program_has_no_param_findings():
 
 
 # --------------------------------------------------------------------------
-# 5. recompilation hazards
+# 5. donation aliasing (the donated-buffer-reuse footgun)
+# --------------------------------------------------------------------------
+
+
+def test_donation_lint_flags_fetched_param_passthrough():
+    """A fetched step output that IS a donated param passed through
+    unchanged: the classic footgun, sharpened by the fused K-step
+    dispatch donating the whole training carry."""
+    def fn(x):
+        w = create_parameter((4,), name="w")
+        return {"loss": (x * w).sum(), "w_snapshot": w}
+
+    tr = pt.Trainer(pt.build(fn), opt.SGD(0.1), loss_name="loss")
+    feed = {"x": np.ones((4,), np.float32)}
+    tr.startup(sample_feed=feed)
+    rep = analysis.check_trainer(tr, feed)
+    hits = rep.by_code("donation:fetched-alias")
+    assert len(hits) == 1
+    assert "w_snapshot" in hits[0].where
+    assert "params" in hits[0].data["donated_input"]
+
+
+def test_donation_lint_clean_for_computed_outputs():
+    """Computed outputs (even trivially derived from donated inputs)
+    are NOT aliases — only raw passthrough is the footgun. And with
+    donation off there is nothing to flag."""
+    def fn(x):
+        w = create_parameter((4,), name="w")
+        return {"loss": (x * w).sum(), "w_copy": w + 0.0}
+
+    tr = pt.Trainer(pt.build(fn), opt.SGD(0.1), loss_name="loss")
+    feed = {"x": np.ones((4,), np.float32)}
+    tr.startup(sample_feed=feed)
+    assert not analysis.check_trainer(tr, feed).by_code(
+        "donation:fetched-alias")
+
+    def fn2(x):
+        w = create_parameter((4,), name="w")
+        return {"loss": (x * w).sum(), "w_snapshot": w}
+
+    tr2 = pt.Trainer(pt.build(fn2), opt.SGD(0.1), loss_name="loss",
+                     donate=False)
+    tr2.startup(sample_feed=feed)
+    assert not analysis.check_trainer(tr2, feed).by_code(
+        "donation:fetched-alias")
+
+
+def test_donation_lint_select_family():
+    def fn(x):
+        w = create_parameter((4,), name="w")
+        return {"loss": (x * w).sum(), "w_snapshot": w}
+
+    tr = pt.Trainer(pt.build(fn), opt.SGD(0.1), loss_name="loss")
+    feed = {"x": np.ones((4,), np.float32)}
+    tr.startup(sample_feed=feed)
+    only = analysis.check_trainer(tr, feed, select={"donation"})
+    assert set(only.codes()) == {"donation:fetched-alias"}
+    without = analysis.check_trainer(tr, feed, select={"collective"})
+    assert "donation:fetched-alias" not in without.codes()
+
+
+# --------------------------------------------------------------------------
+# 6. recompilation hazards
 # --------------------------------------------------------------------------
 
 

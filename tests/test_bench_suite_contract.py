@@ -26,7 +26,8 @@ def test_priority_order_leads_with_baseline_configs():
                           "resnet50_infer_fp32"]
     assert names[8] == "gpt"
     # every registered config appears exactly once
-    expect = set(bench.TRAIN_CONFIGS) | set(bench.INFER_CONFIGS) | {"gpt_decode"}
+    expect = (set(bench.TRAIN_CONFIGS) | set(bench.INFER_CONFIGS)
+              | {"gpt_decode", "dispatch_overhead"})
     assert set(names) == expect and len(names) == len(expect)
 
 
@@ -49,6 +50,30 @@ def test_run_one_rejects_unknown_and_applies_quick_overrides(monkeypatch):
                         lambda peak, **kw: seen.update(kw) or {"v": 1})
     bench._run_one("gpt_32k", 1.0, quick=True)
     assert seen == {"iters": 2, "seq": 2048}  # QUICK_OVERRIDES applied
+
+
+def test_steps_per_dispatch_knob_recorded(monkeypatch):
+    """--steps_per_dispatch / BENCH_STEPS_PER_DISPATCH rides the env so
+    suite children inherit it, and every train row records the K it was
+    measured under (a K=16 row must never be read as a K=1 row)."""
+    monkeypatch.setitem(bench.TRAIN_CONFIGS, "mnist_mlp",
+                        lambda peak, **kw: {"value": 1.0})
+    monkeypatch.setenv("BENCH_STEPS_PER_DISPATCH", "16")
+    assert bench._run_one("mnist_mlp", 1.0)["steps_per_dispatch"] == 16
+    monkeypatch.delenv("BENCH_STEPS_PER_DISPATCH")
+    assert bench._run_one("mnist_mlp", 1.0)["steps_per_dispatch"] == 1
+    # infer configs have no step loop: no knob recorded
+    monkeypatch.setitem(bench.INFER_CONFIGS, "googlenet_infer",
+                        lambda peak, **kw: {"value": 1.0})
+    assert "steps_per_dispatch" not in bench._run_one("googlenet_infer", 1.0)
+
+
+def test_dispatch_overhead_quick_overrides(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(bench, "bench_dispatch_overhead",
+                        lambda peak, **kw: seen.update(kw) or {"v": 1})
+    bench._run_one("dispatch_overhead", 1.0, quick=True)
+    assert seen == {"iters": 8, "k": 4}
 
 
 def test_assemble_headline_and_partial_shape():
