@@ -130,22 +130,35 @@ def check(
 def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
                   **kwargs) -> LintReport:
     """Lint a started Trainer: the program-level rules over its scope +
-    rule table, plus collective/dtype rules over the jaxpr of the
-    *compiled train step* — the microbatch scan and every shard_map the
-    model routed through are visible there, which is exactly where the
-    unhoisted-accum class of hazard sits."""
+    rule table, plus collective/dtype/donation rules over the jaxpr of
+    the *compiled train step* — the microbatch scan, the strategy's
+    loss scaling, the optimizer update, and every shard_map the model
+    routed through are visible there, which is exactly where the
+    unhoisted-accum class of hazard (and train-only dtype flow: branches
+    gated on ``in_training()``, scaler casts, grad math) sits. Pass
+    ``amp="bfloat16"|"float16"`` to re-trace the step under that
+    compute dtype, the way the real amp training run traces it."""
     enforce(trainer._step_fn is not None,
             "check_trainer: call Trainer.startup() first (the lint walks "
             "the built step function)")
     select = kwargs.pop("select", None)
+    amp = kwargs.get("amp")
     want_coll = select is None or "collective" in select
     want_donation = select is None or "donation" in select
-    # the collective and donation families run over the STEP jaxpr below
-    # (the program jaxpr is a subset of it — walking both would
-    # double-report; donation needs the step's donate_argnums anyway)
-    inner_select = ({"dtype", "sharding", "params", "retrace"}
+    want_dtype = select is None or "dtype" in select
+    # the collective, donation — and, when a step trace is possible,
+    # dtype — families run over the STEP jaxpr below (the program jaxpr
+    # is a subset of it — walking both would double-report; donation
+    # needs the step's donate_argnums anyway; dtype over the step sees
+    # the train path the forward program hides)
+    step_dtype = want_dtype and sample_feed is not None
+    inner_select = ({"sharding", "params", "retrace"}
                     if select is None
                     else set(select) - {"collective", "donation"})
+    if step_dtype:
+        inner_select -= {"dtype"}
+    elif select is None:
+        inner_select |= {"dtype"}
     # the PRE-adaptation rule table: typo'd axes only exist there
     # (Trainer.__init__ adapts its working copy, stripping them)
     rules = getattr(trainer, "sharding_rules_raw", None) or trainer.sharding_rules
@@ -156,7 +169,7 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
         strategy=trainer.strategy, loss_name=trainer.loss_name,
         select=inner_select, **kwargs)
     report.subject = f"trainer({trainer.program.name})"
-    if not (want_coll or want_donation):
+    if not (want_coll or want_donation or step_dtype):
         return report
 
     if want_coll:
@@ -168,20 +181,46 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
     ls = getattr(trainer.scope, "loss_scale_state", None) or {}
     args = (trainer.scope.params, trainer.scope.opt_state,
             trainer.scope.state, jax.random.PRNGKey(0), feed, ls)
-    # ONE trace of the raw step body serves both families: the same
+    # ONE trace of the raw step body serves all three families: the same
     # collective eqns the jitted wrapper would show (minus the pjit
-    # shell), plus the invar→outvar identity the donation rule needs
-    # (the jitted wrapper hides passthrough aliasing)
+    # shell), the invar→outvar identity the donation rule needs (the
+    # jitted wrapper hides passthrough aliasing), and — under amp_guard
+    # — the train-path dtype flow (loss scaling included via the ls arg)
+    from ..framework import amp_guard, compute_dtype
+    import contextlib
     core = getattr(trainer, "_train_step_core", None) or trainer._step_fn
-    try:
-        closed, out_shape = jax.make_jaxpr(core, return_shape=True)(*args)
-    except Exception as e:
+    cd = None
+    trace_err = None
+    with (amp_guard(amp) if amp else contextlib.nullcontext()):
+        if amp:
+            cd = compute_dtype()
+        try:
+            closed, out_shape = jax.make_jaxpr(core, return_shape=True)(*args)
+        except Exception as e:
+            trace_err = e
+    if trace_err is not None:
         report.add("collective:step-trace-failed", "info",
-                   f"could not trace the step for collective/donation "
-                   f"rules ({type(e).__name__}: {e})")
+                   f"could not trace the step for collective/donation/"
+                   f"dtype rules ({type(trace_err).__name__}: {trace_err})")
+        if step_dtype:
+            # the dtype family was withheld from the program-level walk
+            # in anticipation of the step trace — a step that won't
+            # trace must not lose it entirely: fall back to the forward
+            # program jaxpr (the pre-step_dtype coverage). This re-runs
+            # init + the forward trace — acceptable on this rare
+            # failure path; coverage beats the duplicate trace cost.
+            fb = check(trainer.program, sample_feed,
+                       params=trainer.scope.params, state=trainer.scope.state,
+                       mesh=trainer.mesh, rules=rules,
+                       strategy=trainer.strategy, loss_name=trainer.loss_name,
+                       select={"dtype"}, **kwargs)
+            report.findings.extend(fb.findings)
         return report
     if want_coll:
         _rules.check_collectives(closed, report, mesh=trainer.mesh)
+    if step_dtype:
+        _rules.check_dtypes(closed, report, compute_dtype=cd,
+                            feed=sample_feed)
     if want_donation and getattr(trainer, "_train_step_core", None) is not None:
         _check_step_donation(trainer, args, closed, out_shape, report)
     return report

@@ -149,6 +149,16 @@ def check_accum_exchange(strategy, mesh, params, report: LintReport) -> None:
 _MXU_PRIMS = frozenset({"dot_general", "conv_general_dilated"})
 
 
+def _np_dtype(dt):
+    """np.dtype(dt) or None for jax extended dtypes (typed PRNG keys in
+    the train-step jaxpr, fp8 wrappers) that numpy cannot interpret —
+    the dtype rules simply don't apply to those avals."""
+    try:
+        return np.dtype(dt)
+    except TypeError:
+        return None
+
+
 def check_dtypes(closed_jaxpr, report: LintReport,
                  compute_dtype=None, feed: Optional[Dict[str, Any]] = None) -> None:
     """Mixed-precision flow over the whole jaxpr:
@@ -185,15 +195,16 @@ def check_dtypes(closed_jaxpr, report: LintReport,
             out_avals = [getattr(v, "aval", None) for v in eqn.outvars]
             for av in out_avals:
                 if getattr(av, "dtype", None) is not None and \
-                        np.dtype(av.dtype) == np.float64:
+                        _np_dtype(av.dtype) == np.float64:
                     report.add("dtype:f64-leak", "warning",
                                f"{name} produces float64 {av.shape} — no "
                                "f64 MXU path on TPU; cast to f32",
                                where=name)
                     break
             if reduced and name in _MXU_PRIMS:
-                op_dts = [np.dtype(av.dtype) for av in avals
+                op_dts = [_np_dtype(av.dtype) for av in avals
                           if getattr(av, "dtype", None) is not None]
+                op_dts = [dt for dt in op_dts if dt is not None]
                 if op_dts and all(dt == np.float32 for dt in op_dts):
                     shapes = [tuple(getattr(av, "shape", ())) for av in avals]
                     report.add(
@@ -210,13 +221,15 @@ def check_dtypes(closed_jaxpr, report: LintReport,
                         and peqn.primitive.name == "convert_element_type"):
                     orig = getattr(peqn.invars[0], "aval", None)
                     final = getattr(eqn.outvars[0], "aval", None)
-                    if (orig is not None and final is not None
-                            and np.dtype(orig.dtype) == np.dtype(final.dtype)):
-                        mid = np.dtype(getattr(src, "aval").dtype)
+                    odt = _np_dtype(orig.dtype) if orig is not None else None
+                    fdt = _np_dtype(final.dtype) if final is not None else None
+                    mid = _np_dtype(getattr(src, "aval").dtype)
+                    if (odt is not None and fdt is not None
+                            and mid is not None and odt == fdt):
                         report.add(
                             "dtype:cast-roundtrip", "info",
-                            f"cast round-trip {np.dtype(orig.dtype)} → {mid} "
-                            f"→ {np.dtype(final.dtype)}: the pair is a no-op "
+                            f"cast round-trip {odt} → {mid} "
+                            f"→ {fdt}: the pair is a no-op "
                             "(or a silent precision truncation if the middle "
                             "dtype is narrower) — plumb the dtype through "
                             "instead",

@@ -110,7 +110,15 @@ class DeviceFeeder:
     The fill thread is CANCELLABLE: abandoning the iterator (break /
     exception / gc) or calling :meth:`close` unblocks it even when it is
     parked on a full queue holding device buffers — the old leak where a
-    daemon thread pinned HBM until process exit."""
+    daemon thread pinned HBM until process exit.
+
+    A reader/transfer exception on the fill thread PROPAGATES to the
+    consumer: already-transferred batches drain first, then the original
+    exception (fill-thread traceback attached) is re-raised at
+    ``__next__`` — never a bare end-of-iteration that silently truncates
+    the epoch. A fill thread that dies without delivering its END
+    sentinel is detected by a liveness probe instead of hanging the
+    consumer."""
 
     def __init__(self, batches: Callable[[], Iterator[Dict[str, np.ndarray]]],
                  put_fn: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, jax.Array]]] = None,
@@ -186,9 +194,36 @@ class DeviceFeeder:
         t.start()
         try:
             while True:
-                item = q.get()
+                try:
+                    item = q.get(timeout=0.5)
+                except _queue.Empty:
+                    # liveness check: a fill thread that died without
+                    # managing to enqueue END (its sentinel put lost a
+                    # race with close()) must not hang the consumer —
+                    # and its reader error must still surface
+                    if not t.is_alive():
+                        # the thread may have enqueued its final batches
+                        # (and END) between our timeout and this check —
+                        # drain them before concluding, or the race
+                        # silently truncates the epoch
+                        while True:
+                            try:
+                                item = q.get_nowait()
+                            except _queue.Empty:
+                                break
+                            if item is END:
+                                break
+                            yield item
+                        if err:
+                            raise err[0]
+                        return
+                    continue
                 if item is END:
                     if err:
+                        # re-raise the READER's exception at __next__
+                        # with its original fill-thread traceback — a
+                        # reader crash must abort the epoch loudly, not
+                        # truncate it to a silent StopIteration
                         raise err[0]
                     return
                 yield item

@@ -60,12 +60,74 @@ def _trainer_log():
 
 
 def _check_nan_inf(tree, where: str):
+    """Host-side per-leaf scan (FLAGS_check_nan_inf analog) — still used
+    on the forward/eval path (Executor.run). The TRAIN path uses the
+    fused on-device guard instead (Trainer guard / GuardPolicy): one
+    scalar bitmask computed inside the compiled step, no per-leaf host
+    sync."""
     flat, _ = jax.tree.flatten(tree)
     for leaf in flat:
         if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
             if bool(jnp.any(~jnp.isfinite(leaf))):
                 raise FloatingPointError(f"NaN/Inf detected in {where} "
                                          "(FLAGS_check_nan_inf analog)")
+
+
+def _tree_nonfinite(tree) -> jax.Array:
+    """Scalar bool: ANY inexact leaf of ``tree`` holds a NaN/Inf.
+    Traced inside the compiled step — the per-leaf partial reductions
+    fuse into one on-device scalar, the guard's whole detection cost."""
+    leaves = [x for x in jax.tree.leaves(tree)
+              if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)]
+    if not leaves:
+        return jnp.bool_(False)
+    return ~jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves]).all()
+
+
+# module names of the DONATING compiled step programs — the predicate
+# both cache-read gates share (this one and tests/conftest.py's)
+DONATING_STEP_MODULE_TAGS = ("train_step", "run_k_steps")
+
+_cpu_cache_gate_installed = False
+
+
+def _install_cpu_cache_read_gate():
+    """On the CPU backend, gate persistent-compile-cache READS away from
+    DONATING step executables (train_step / run_k_steps): the CPU
+    runtime's disk→executable reload can lose donation alias info and a
+    fetched output then reads clobbered memory — observed as sporadic
+    garbage/NaN losses right after checkpoint saves (see
+    tests/conftest.py, which applies the same quarantine for the test
+    suite). Forward/eval/infer programs — the bulk of the cache's win —
+    keep reading the cache; the step programs recompile once per
+    process. TPU/GPU backends are unaffected and skip this entirely."""
+    global _cpu_cache_gate_installed
+    if _cpu_cache_gate_installed:
+        return
+    try:
+        if jax.default_backend() != "cpu":
+            return
+        from jax._src import compiler as _jc
+        orig = _jc._cache_read
+
+        def gated(module_name, *args, **kw):
+            if any(tag in (module_name or "")
+                   for tag in DONATING_STEP_MODULE_TAGS):
+                return None, None
+            return orig(module_name, *args, **kw)
+
+        _jc._cache_read = gated
+        _cpu_cache_gate_installed = True
+    except Exception as e:
+        # private API drifted: the cache stays fully enabled, which on
+        # this backend can silently corrupt reloaded donating steps —
+        # say so instead of degrading invisibly
+        _trainer_log().warning(
+            "could not install the CPU cache-read gate for donating step "
+            "executables (%s: %s); persistent-cache reloads of "
+            "train_step/run_k_steps may corrupt fetched outputs on this "
+            "backend — consider disabling compile_cache_dir on CPU",
+            type(e).__name__, e)
 
 
 class Executor:
@@ -159,6 +221,7 @@ class Trainer:
         strategy=None,
         donate: bool = True,
         fetch_list: Optional[Sequence[str]] = None,
+        guard=None,
     ):
         self.program = program
         self.optimizer = optimizer
@@ -196,6 +259,18 @@ class Trainer:
         self._trace_count = 0
         self.global_step = 0
         self.lint_report = None  # set by startup(lint=...)
+        # NaN/Inf guard: guard=True -> default GuardPolicy; None ->
+        # defer to the check_nan_inf flag at build time (the check is
+        # compiled into the step program); False -> explicit opt-out
+        # that also overrides the flag; otherwise a GuardPolicy
+        from .resilience import GuardPolicy
+        self.guard_policy = (GuardPolicy() if guard is True
+                             else (None if not guard else guard))
+        self._guard_opt_out = guard is False
+        self.guard_incidents: List[Any] = []
+        self._guard = None            # resolved policy (build time)
+        self._guard_bit_names = ()    # bitmask bit -> checked-value name
+        self._guard_pending = None    # (mask, feed, base_step, k) to examine
         self.loss_scaler = None
         if strategy is not None and (getattr(strategy, "loss_scale", None)
                                      or getattr(strategy, "dynamic_loss_scale", False)):
@@ -531,6 +606,22 @@ class Trainer:
                 "misconfiguration (there is no loop to hoist out of)")
         hoist_axes = (self._hoisted_accum_axes() if mode == "hoisted"
                       else None)
+        # guard resolution happens ONCE here: the detection is compiled
+        # into the step program, so the check_nan_inf flag is read at
+        # build time (set it before startup). An explicit GuardPolicy
+        # degrades gracefully; the bare flag keeps its abort semantics
+        # (escalate on the first incident) minus the per-leaf host syncs.
+        guard = self.guard_policy
+        if guard is None and not self._guard_opt_out \
+                and get_flag("check_nan_inf"):
+            from .resilience import GuardPolicy
+            # eager readback: the legacy flag promises an abort AT the
+            # offending step, including for hand-rolled step() loops
+            # that never call drain_guard()
+            guard = GuardPolicy(max_incidents=0, window=1,
+                                record_feed_digest=False,
+                                defer_readback=False)
+        self._guard = guard
 
         def train_step(params, opt_state, state, rng, feed, ls):
             self._trace_count += 1  # trace-time only: counts compilations
@@ -586,6 +677,50 @@ class Trainer:
                 new_params, new_opt = self.optimizer.update(
                     grads, opt_state, params, self.program.param_info)
                 new_ls = ls
+            if guard is not None:
+                # fused on-device NaN/Inf guard: ONE scalar bitmask over
+                # the gradients and every inexact fetch output, computed
+                # inside the compiled step. On a non-finite step the
+                # update is discarded branchlessly — the pre-step carry
+                # (params/opt_state/state) IS the last-good snapshot,
+                # already on device. Loss-scale state is deliberately
+                # NOT rolled back: the scaler's overflow backoff must
+                # persist or the same overflow recurs forever.
+                from .amp import LossScaler
+                # with a loss scaler, grad overflow is the SCALER's
+                # domain: it already skipped the update and backed the
+                # scale off, and routine calibration overflows must not
+                # count as guard incidents (much less abort the run via
+                # the check_nan_inf route) — the guard then watches the
+                # fetch outputs only
+                names, flags = [], []
+                if scaler is None:
+                    names, flags = ["grads"], [_tree_nonfinite(grads)]
+                for kname in sorted(out):
+                    v = out[kname]
+                    if hasattr(v, "dtype") and jnp.issubdtype(v.dtype,
+                                                              jnp.inexact):
+                        names.append(kname)
+                        flags.append(_tree_nonfinite(v))
+                if len(flags) > 32:
+                    # uint32 mask: shifts past bit 31 are undefined and
+                    # would silently drop detection — fold the tail into
+                    # one combined bit (detection stays exact, only the
+                    # which-output attribution coarsens)
+                    rest = flags[31:]
+                    flags = flags[:31] + [jnp.stack(rest).any()]
+                    names = names[:31] + [
+                        f"any-of-{len(rest)}-more:{'/'.join(names[31:34])}…"]
+                mask = jnp.zeros((), jnp.uint32)
+                for i, fl in enumerate(flags):
+                    mask = mask | (fl.astype(jnp.uint32) << i)
+                finite = mask == 0
+                new_params = LossScaler.select(finite, new_params, params)
+                new_opt = LossScaler.select(finite, new_opt, opt_state)
+                new_state = LossScaler.select(finite, new_state, state)
+                self._guard_bit_names = tuple(names)  # trace-time capture
+                out = dict(out)
+                out["guard_nonfinite"] = mask
             return new_params, new_opt, new_state, out, new_ls
 
         donate = (0, 1, 2, 5) if self.donate else ()
@@ -678,6 +813,7 @@ class Trainer:
         if not d:
             return
         os.makedirs(d, exist_ok=True)
+        _install_cpu_cache_read_gate()
         jax.config.update("jax_compilation_cache_dir", d)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
@@ -734,8 +870,10 @@ class Trainer:
         self.global_step += 1
         if get_flag("benchmark"):
             jax.block_until_ready(out)
-        if get_flag("check_nan_inf"):
-            _check_nan_inf(out, "train step outputs")
+        if self._guard is not None:
+            self._guard_enqueue(out, feed, self.global_step - 1, 1)
+        else:
+            self._warn_inert_nan_flag()
         return out
 
     def run_steps(self, stacked_feed: Feed, k: Optional[int] = None,
@@ -782,9 +920,98 @@ class Trainer:
         self.global_step += k
         if get_flag("benchmark"):
             jax.block_until_ready(outs)
-        if get_flag("check_nan_inf"):
-            _check_nan_inf(outs, "fused train step outputs")
+        if self._guard is not None:
+            self._guard_enqueue(outs, feed, self.global_step - k, k)
+        else:
+            self._warn_inert_nan_flag()
         return outs
+
+    def _warn_inert_nan_flag(self):
+        """The check_nan_inf flag is compiled into the step at
+        _build_step — flipping it on AFTER startup() cannot arm the
+        guard (the old host-scan read it per step). Warn once instead
+        of letting the user believe detection is active."""
+        if getattr(self, "_nan_flag_warned", False) or self._guard_opt_out:
+            return
+        if get_flag("check_nan_inf"):
+            import warnings
+            self._nan_flag_warned = True
+            warnings.warn(
+                "check_nan_inf was enabled after Trainer.startup(): the "
+                "NaN guard is compiled into the step, so the flag has no "
+                "effect on this trainer — set it before startup() (or "
+                "pass Trainer(guard=GuardPolicy(...))). Note the guard "
+                "raises FloatingPointError (the legacy host scan did "
+                "too; Executor.run still uses it).")
+
+    def _guard_enqueue(self, outs, feed, base_step: int, k: int) -> None:
+        """Host half of the NaN/Inf guard, DEFERRED by one dispatch:
+        the bitmask device array is parked and only examined when the
+        NEXT dispatch is already in flight (or at :meth:`drain_guard`),
+        so the guard adds NO host synchronization to the hot path —
+        the readback overlaps the next chunk's device time. Params are
+        protected regardless: the discard-select runs on device inside
+        the step; the host side is bookkeeping (incident records +
+        escalation, at most one chunk late). With
+        ``GuardPolicy(defer_readback=False)`` the mask is examined
+        immediately instead (one blocking fetch per dispatch) so
+        escalation raises at the offending step."""
+        if not self._guard.defer_readback:
+            self._guard_examine(
+                outs["guard_nonfinite"],
+                feed if self._guard.record_feed_digest else None,
+                base_step, k)
+            return
+        prev, self._guard_pending = self._guard_pending, (
+            outs["guard_nonfinite"],
+            feed if self._guard.record_feed_digest else None,
+            base_step, k)
+        if prev is not None:
+            self._guard_examine(*prev)
+
+    def drain_guard(self) -> None:
+        """Examine the last parked guard bitmask (one blocking scalar
+        fetch). Call when the step loop pauses — before a checkpoint
+        read of ``guard_incidents``, at the end of ``fit``, on
+        preemption — so no incident stays unrecorded."""
+        prev, self._guard_pending = self._guard_pending, None
+        if prev is not None:
+            self._guard_examine(*prev)
+
+    def _guard_examine(self, mask_dev, feed, base_step: int, k: int) -> None:
+        from . import resilience
+
+        mask = np.asarray(jax.device_get(mask_dev)).reshape(-1)
+        if not mask.any():
+            return
+        names = self._guard_bit_names
+        recorded = []
+        for i, m in enumerate(mask):
+            m = int(m)
+            if not m:
+                continue
+            bad = tuple(n for b, n in enumerate(names) if (m >> b) & 1)
+            digest = None
+            if feed is not None:
+                try:
+                    # pull only THIS step's slice of a stacked super-
+                    # batch across the link, not all K batches
+                    sl = (jax.tree.map(lambda v: v[i], feed) if k > 1
+                          else feed)
+                    digest = resilience.feed_digest(jax.device_get(sl))
+                except Exception:
+                    digest = None  # digesting must never mask the incident
+            recorded.append(resilience.record_incident(
+                self.guard_incidents, base_step + i, bad or ("unknown",),
+                digest))
+        # escalation is evaluated at each INCIDENT's own step, not the
+        # chunk end: with window < K a mid-chunk incident would
+        # otherwise fall outside the trailing window by the time the
+        # chunk finishes and never escalate (the check_nan_inf route is
+        # window=1 — its abort contract must hold under fused dispatch)
+        for inc in recorded:
+            resilience.escalate_if_needed(self.guard_incidents, self._guard,
+                                          inc.step)
 
     def eval(self, feed: Feed) -> Dict[str, Any]:
         """Forward pass without dropout/updates.
@@ -831,11 +1058,13 @@ class Event:
     steps_per_dispatch=K)``): one begin_step/end_step pair covers
     ``num_steps`` optimizer steps and the end_step ``metrics`` arrays
     carry a leading ``(num_steps, ...)`` axis — see MIGRATION.md
-    "Fused stepping"."""
+    "Fused stepping". A ``"preempted"`` event fires once after the
+    boundary checkpoint when fit exits on SIGTERM/SIGINT."""
 
     def __init__(self, kind: str, epoch: int, step: int, metrics=None,
                  num_steps: int = 1):
-        self.kind = kind  # begin_epoch | end_epoch | begin_step | end_step
+        # begin_epoch | end_epoch | begin_step | end_step | preempted
+        self.kind = kind
         self.epoch = epoch
         self.step = step
         self.metrics = metrics or {}
@@ -845,7 +1074,8 @@ class Event:
 def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
         dtypes: Optional[Sequence[Any]] = None, event_handler=None,
         checkpoint_config: Optional[CheckpointConfig] = None,
-        prefetch: bool = True, steps_per_dispatch: int = 1):
+        prefetch: bool = True, steps_per_dispatch: int = 1,
+        resume: bool = False, preemption: Optional[bool] = None):
     """High-level train loop (contrib.trainer.Trainer.train analog):
     reader → DataFeeder → (optional double-buffered prefetch) →
     trainer.step, with event callbacks and periodic checkpoints.
@@ -857,80 +1087,181 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
     stacked metrics), ``global_step`` advances by the true step count
     (remainder batches run singly through ``trainer.step``), and
     ``step_interval`` checkpoints round forward to the chunk boundary
-    that crossed the interval. See MIGRATION.md "Fused stepping"."""
+    that crossed the interval. See MIGRATION.md "Fused stepping".
+
+    **Fault tolerance** (MIGRATION.md "Fault tolerance & resume"):
+
+    - ``resume=True`` restores the newest *valid* checkpoint under
+      ``checkpoint_config.checkpoint_dir`` (corrupt ones are skipped
+      with a warning, falling back to older), fast-forwards the
+      epoch/in-epoch position recorded in the checkpoint meta, and
+      continues with exact step/loss continuity — restart reproduces
+      the uninterrupted run bit-for-bit for a deterministic reader.
+    - The checkpoint ROTATION list is rebuilt from the directory at
+      startup, so ``max_num_checkpoints`` holds across restarts.
+    - SIGTERM/SIGINT (``preemption``; default on whenever a
+      ``checkpoint_config`` is given, main thread only) requests a
+      checkpoint at the next chunk boundary: fit saves
+      ``step_<global_step>``, drains async orbax saves, fires a
+      ``"preempted"`` event, and returns cleanly.
+    """
+    import contextlib as _contextlib
     import os
+    import shutil
 
     from .core.errors import enforce as _enforce
     from . import io as _io
+    from . import resilience
     from .data.feeder import DataFeeder, DeviceFeeder, iter_chunked
 
     _enforce(steps_per_dispatch >= 1,
              f"fit(steps_per_dispatch={steps_per_dispatch}): need >= 1")
     feeder = DataFeeder(feed_names, dtypes)
-    kept: List[str] = []
 
-    def save(tag: str):
+    start_epoch, skip_steps = 0, 0
+    if resume:
+        _enforce(checkpoint_config is not None,
+                 "fit(resume=True) needs a checkpoint_config to scan")
+        meta = resilience.restore_latest(checkpoint_config.checkpoint_dir,
+                                         trainer)
+        if meta is not None:
+            start_epoch = int(meta.get("epoch", 0))
+            skip_steps = int(meta.get("epoch_step", 0))
+
+    # rebuild the rotation list from disk (oldest first) so pre-existing
+    # checkpoints rotate out across restarts instead of accumulating,
+    # and sweep torn-save tmp leftovers from crashed predecessors
+    def _fit_tag(tag: str) -> bool:
+        # only fit-OWNED tags enter rotation: a user's hand-saved
+        # checkpoint living in the same dir (e.g. "best") must never be
+        # rotation-deleted by us
+        head, _, num = tag.partition("_")
+        return head in ("step", "epoch") and num.isdigit()
+
+    kept: List[str] = []
+    if checkpoint_config is not None:
+        resilience.sweep_tmp_dirs(checkpoint_config.checkpoint_dir)
+        kept = [c.path for c in resilience.list_checkpoints(
+            checkpoint_config.checkpoint_dir) if _fit_tag(c.tag)]
+        # over-quota pre-existing checkpoints are trimmed by the FIRST
+        # save, not here: a startup trim could delete the oldest-but-
+        # only-VALID checkpoint that resume just restored from (newer
+        # ones corrupt) before this run has committed anything new
+
+    last_saved_step = [None]  # step of the last save THIS run performed
+
+    def save(tag: str, epoch: int, epoch_step: int):
         if checkpoint_config is None:
             return
         d = os.path.join(checkpoint_config.checkpoint_dir, tag)
-        _io.save_trainer(d, trainer)
+        _io.save_trainer(d, trainer, extra_meta={"epoch": epoch,
+                                                 "epoch_step": epoch_step})
+        last_saved_step[0] = trainer.global_step
+        if d in kept:      # re-saved tag (e.g. preempt at an interval
+            kept.remove(d)  # boundary): refresh its rotation position
         kept.append(d)
         while len(kept) > checkpoint_config.max_num_checkpoints:
-            import shutil
             shutil.rmtree(kept.pop(0), ignore_errors=True)
 
+    use_preempt = (preemption if preemption is not None
+                   else checkpoint_config is not None)
+    preempt_ctx = (resilience.PreemptionHandler() if use_preempt
+                   else _contextlib.nullcontext())
     si = checkpoint_config.step_interval if checkpoint_config else 0
-    for epoch in range(num_epochs):
-        if event_handler:
-            event_handler(Event("begin_epoch", epoch, trainer.global_step))
+    with preempt_ctx as ph:
+        for epoch in range(start_epoch, num_epochs):
+            # resume lands mid-epoch: fast-forward past the batches the
+            # restored checkpoint already consumed (1 batch == 1 step)
+            skip = skip_steps if epoch == start_epoch else 0
+            steps_in_epoch = skip
+            if event_handler:
+                event_handler(Event("begin_epoch", epoch, trainer.global_step))
 
-        def batches():
-            for samples in reader():
-                yield feeder.feed(samples)
+            def batches(_skip=skip):
+                for i, samples in enumerate(reader()):
+                    if i < _skip:
+                        continue
+                    yield feeder.feed(samples)
 
-        device_feeder = None
-        if prefetch:
-            device_feeder = DeviceFeeder(
-                batches, put_fn=trainer._put_feed,
-                stack_k=steps_per_dispatch,
-                put_stacked_fn=functools.partial(trainer._put_feed,
-                                                 stacked=True))
-            iterator = iter(device_feeder)
-        elif steps_per_dispatch > 1:
-            iterator = iter_chunked(
-                batches(), steps_per_dispatch, put_fn=trainer._put_feed,
-                put_stacked_fn=functools.partial(trainer._put_feed,
-                                                 stacked=True))
-        else:
-            iterator = map(trainer._put_feed, batches())
-        try:
-            for item in iterator:
-                n, feed = item if steps_per_dispatch > 1 else (1, item)
-                gs_before = trainer.global_step
+            device_feeder = None
+            if prefetch:
+                device_feeder = DeviceFeeder(
+                    batches, put_fn=trainer._put_feed,
+                    stack_k=steps_per_dispatch,
+                    put_stacked_fn=functools.partial(trainer._put_feed,
+                                                     stacked=True))
+                iterator = iter(device_feeder)
+            elif steps_per_dispatch > 1:
+                iterator = iter_chunked(
+                    batches(), steps_per_dispatch, put_fn=trainer._put_feed,
+                    put_stacked_fn=functools.partial(trainer._put_feed,
+                                                     stacked=True))
+            else:
+                iterator = map(trainer._put_feed, batches())
+            preempted = False
+            try:
+                for item in iterator:
+                    n, feed = item if steps_per_dispatch > 1 else (1, item)
+                    gs_before = trainer.global_step
+                    if event_handler:
+                        event_handler(Event("begin_step", epoch, gs_before,
+                                            num_steps=n))
+                    out = trainer.run_steps(feed, k=n) if n > 1 \
+                        else trainer.step(feed)
+                    steps_in_epoch += n
+                    if event_handler:
+                        event_handler(Event("end_step", epoch,
+                                            trainer.global_step, out,
+                                            num_steps=n))
+                    # chunk-boundary rounding: save whenever this dispatch
+                    # crossed a step_interval multiple (== the exact-multiple
+                    # check when n == 1)
+                    if si and trainer.global_step // si > gs_before // si:
+                        save(f"step_{trainer.global_step}", epoch,
+                             steps_in_epoch)
+                    if ph is not None and ph.requested:
+                        preempted = True
+                        break
+            finally:
+                # consumer abandoned mid-epoch (exception/early exit): the
+                # fill thread must not stay blocked holding device buffers
+                if device_feeder is not None:
+                    device_feeder.close()
+            if preempted:
+                # preemption flow: boundary checkpoint, drain the parked
+                # guard bitmask and async orbax writes, clean exit (the
+                # TPU maintenance-event analog). Skip the save when the
+                # interval save that just ran already committed this
+                # exact step — a duplicate full gather+write would burn
+                # the preemption grace period for bit-identical state.
+                # A pending guard ESCALATION must not forfeit the
+                # boundary checkpoint (device state is clean — the bad
+                # updates were discarded on device): save first, then
+                # re-raise.
+                guard_err = None
+                try:
+                    trainer.drain_guard()
+                except FloatingPointError as e:
+                    guard_err = e
+                # "already saved" must mean saved by THIS run — a stale
+                # same-tag dir from a previous run (rebuilt into `kept`)
+                # holds old params and must not suppress the save
+                if last_saved_step[0] != trainer.global_step:
+                    save(f"step_{trainer.global_step}", epoch,
+                         steps_in_epoch)
+                _io.wait_for_checkpoints()
                 if event_handler:
-                    event_handler(Event("begin_step", epoch, gs_before,
-                                        num_steps=n))
-                out = trainer.run_steps(feed, k=n) if n > 1 \
-                    else trainer.step(feed)
-                if event_handler:
-                    event_handler(Event("end_step", epoch,
-                                        trainer.global_step, out,
-                                        num_steps=n))
-                # chunk-boundary rounding: save whenever this dispatch
-                # crossed a step_interval multiple (== the exact-multiple
-                # check when n == 1)
-                if si and trainer.global_step // si > gs_before // si:
-                    save(f"step_{trainer.global_step}")
-        finally:
-            # consumer abandoned mid-epoch (exception/early exit): the
-            # fill thread must not stay blocked holding device buffers
-            if device_feeder is not None:
-                device_feeder.close()
-        if event_handler:
-            event_handler(Event("end_epoch", epoch, trainer.global_step))
-        if checkpoint_config and checkpoint_config.epoch_interval and \
-                (epoch + 1) % checkpoint_config.epoch_interval == 0:
-            save(f"epoch_{epoch}")
+                    event_handler(Event("preempted", epoch,
+                                        trainer.global_step))
+                if guard_err is not None:
+                    raise guard_err
+                return trainer
+            if event_handler:
+                event_handler(Event("end_epoch", epoch, trainer.global_step))
+            if checkpoint_config and checkpoint_config.epoch_interval and \
+                    (epoch + 1) % checkpoint_config.epoch_interval == 0:
+                save(f"epoch_{epoch}", epoch + 1, 0)
+    trainer.drain_guard()
     return trainer
 
 

@@ -118,17 +118,29 @@ def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
 def save_persistables(dirname: str, params: Dict[str, jax.Array],
                       state: Optional[Dict[str, jax.Array]] = None,
                       opt_state: Optional[Dict[str, Any]] = None,
-                      meta: Optional[Dict[str, Any]] = None) -> None:
+                      meta: Optional[Dict[str, Any]] = None) -> Dict[str, Dict[str, Any]]:
     """Save all persistable vars (save_persistables analog, io.py:252).
-    Sharded arrays are gathered to host first."""
+    Sharded arrays are gathered to host first. Returns the flat
+    shape/dtype spec per npz file ({filename: {flat key: {"shape",
+    "dtype"}}}) — ``save_trainer`` records it in the checkpoint
+    manifest."""
     os.makedirs(dirname, exist_ok=True)
-    np.savez(os.path.join(dirname, "params.npz"), **_flatten(jax.device_get(params)))
+    spec: Dict[str, Dict[str, Any]] = {}
+
+    def _dump(name, tree):
+        flat = _flatten(jax.device_get(tree))
+        np.savez(os.path.join(dirname, name), **flat)
+        spec[name] = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                      for k, v in flat.items()}
+
+    _dump("params.npz", params)
     if state is not None:
-        np.savez(os.path.join(dirname, "state.npz"), **_flatten(jax.device_get(state)))
+        _dump("state.npz", state)
     if opt_state is not None:
-        np.savez(os.path.join(dirname, "opt_state.npz"), **_flatten(jax.device_get(opt_state)))
+        _dump("opt_state.npz", opt_state)
     with open(os.path.join(dirname, "meta.json"), "w") as f:
         json.dump(meta or {}, f)
+    return spec
 
 
 def load_persistables(dirname: str) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray],
@@ -140,7 +152,13 @@ def load_persistables(dirname: str) -> Tuple[Dict[str, np.ndarray], Dict[str, np
         if not os.path.exists(p):
             return None
         with np.load(p, allow_pickle=False) as z:
-            return _unflatten({k: z[k] for k in z.files})
+            # fresh writable copies, NOT the npz-backed views: jax's CPU
+            # backend zero-copies device_put of host arrays when it can,
+            # and a Trainer later DONATES those buffers — in-place XLA
+            # reuse of memory owned by the zip reader corrupts values
+            # transiently (observed as NaN losses after resume; the
+            # fault-injection suite pins this via resume continuity)
+            return _unflatten({k: np.array(z[k]) for k in z.files})
 
     params = _load("params.npz") or {}
     state = _load("state.npz") or {}
@@ -158,25 +176,113 @@ def load_persistables(dirname: str) -> Tuple[Dict[str, np.ndarray], Dict[str, np
     return params, state, opt_state, meta
 
 
-def save_trainer(dirname: str, trainer) -> None:
+def _fsync_tree(dirname: str) -> None:
+    """fsync every regular file in ``dirname`` (and the dir itself):
+    the atomic-rename commit is only meaningful if the data it commits
+    has reached the disk."""
+    for name in os.listdir(dirname):
+        p = os.path.join(dirname, name)
+        if not os.path.isfile(p):
+            continue
+        fd = os.open(p, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass  # fs without fsync support (tmpfs variants): best effort
+        finally:
+            os.close(fd)
+    _fsync_dir(dirname)
+
+
+def _fsync_dir(dirname: str) -> None:
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def save_trainer(dirname: str, trainer,
+                 extra_meta: Optional[Dict[str, Any]] = None) -> None:
     """Checkpoint a Trainer (params+state+opt_state+step) — the
-    CheckpointConfig/save_checkpoint analog (contrib/trainer.py:100)."""
+    CheckpointConfig/save_checkpoint analog (contrib/trainer.py:100).
+
+    **Atomic + validated**: the collections are written to a
+    ``<dirname>.tmp.<pid>`` sibling, fsynced, covered by a
+    ``manifest.json`` (format version, global_step, per-file CRC32 +
+    size, flat shape/dtype spec), and renamed into place. A crash at
+    ANY point (see the ``save_trainer:*`` crash points in
+    ``testing.faults``) leaves either the previous committed checkpoint
+    or the new one — never a torn directory that ``load_trainer``
+    trusts. ``extra_meta`` entries ride in the checkpoint meta (``fit``
+    stores epoch/epoch_step for resume)."""
+    import shutil
+
+    from . import resilience
+
     meta = {"global_step": trainer.global_step}
     ls = getattr(trainer.scope, "loss_scale_state", None)
     if ls:
         meta["loss_scale_state"] = {k: float(v) for k, v in ls.items()}
+    if extra_meta:
+        meta.update(extra_meta)
     # checkpoints always store logical layer order: undo the trainer's
     # interleaved pipeline rest layout (no-op otherwise)
     params, opt_state = trainer.stacked_to_logical(
         trainer.scope.params, trainer.scope.opt_state)
-    save_persistables(dirname, params, trainer.scope.state,
-                      opt_state, meta=meta)
+    path = os.path.abspath(dirname)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    # clean ANY stale tmp for this tag (a prior process's torn save
+    # leaves <tag>.tmp.<other-pid> behind; fit also sweeps the whole
+    # dir at startup with the unfiltered form)
+    resilience.sweep_tmp_dirs(parent, tag=os.path.basename(path))
+    tmp = f"{path}{resilience.TMP_MARKER}{os.getpid()}"
+    spec = save_persistables(tmp, params, trainer.scope.state,
+                             opt_state, meta=meta)
+    resilience.crash_point("save_trainer:files-written")
+    _fsync_tree(tmp)
+    resilience.write_manifest(tmp, meta=meta, arrays=spec)
+    resilience.crash_point("save_trainer:manifest-written")
+    if os.path.isdir(path):
+        # overwrite of an existing tag: the old dir must vanish before
+        # the rename (rename onto a non-empty dir fails). The window
+        # where neither exists only loses THIS tag — older tags are
+        # untouched and the resume scanner falls back to them.
+        shutil.rmtree(path)
+    os.rename(tmp, path)
+    _fsync_dir(parent)
 
 
 def load_trainer(dirname: str, trainer) -> None:
     """Restore a Trainer in place, re-placing arrays on the trainer's
-    device/mesh (resharding-on-load)."""
-    params, state, opt_state, meta = load_persistables(dirname)
+    device/mesh (resharding-on-load).
+
+    The checkpoint is validated against its manifest first (CRC32 per
+    file, format version); any mismatch — or an npz that fails to parse
+    — raises a structured :class:`~paddle_tpu.resilience.CheckpointCorrupt`
+    instead of a random decoder error. Pre-manifest (legacy) directories
+    load without validation."""
+    from . import resilience
+
+    manifest = resilience.validate_checkpoint(dirname)  # None for legacy
+    try:
+        params, state, opt_state, meta = load_persistables(dirname)
+    except Exception as e:
+        raise resilience.CheckpointCorrupt(
+            dirname, f"unreadable collection: {type(e).__name__}: {e}") from e
+    if not params:
+        raise resilience.CheckpointCorrupt(
+            dirname, "no parameters found (params.npz missing or empty)")
+    if manifest:
+        _check_arrays_spec(manifest, dirname, params=params, state=state,
+                           opt_state=opt_state)
     if opt_state is not None:
         # stateless-optimizer per-param accums are empty dicts, which
         # flatten to nothing on save — restore the per-param keys
@@ -201,13 +307,78 @@ def load_trainer(dirname: str, trainer) -> None:
         opt_state["step"] = jnp.asarray(opt_state["step"], jnp.int32)
     trainer.scope.params, trainer.scope.state, trainer.scope.opt_state = params, state, opt_state
     trainer.global_step = int(meta.get("global_step", 0))
+    # kept for fit(resume=True): epoch/epoch_step and anything else the
+    # saver stored ride here (resilience.restore_latest reads it)
+    trainer._last_loaded_meta = dict(meta)
+    _restore_loss_scale(trainer, meta, dirname)
+
+
+def _check_arrays_spec(manifest: Dict[str, Any], dirname: str,
+                       **collections) -> None:
+    """Verify the loaded trees against the manifest's flat shape/dtype
+    spec — the per-leaf half of checkpoint validation (CRC32 guarantees
+    the bytes; this guarantees the decoded structure matches what the
+    saver recorded, catching a manifest/npz pair that drifted out of
+    sync). Costs a dict re-flatten of data already in memory."""
+    from . import resilience
+
+    spec = manifest.get("arrays") or {}
+    fname = {"params": "params.npz", "state": "state.npz",
+             "opt_state": "opt_state.npz"}
+    for coll, tree in collections.items():
+        want = spec.get(fname[coll])
+        if want is None or tree is None:
+            continue
+        got = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+               for k, v in _flatten(tree).items()}
+        if set(got) != set(want):
+            missing = sorted(set(want) - set(got))[:3]
+            extra = sorted(set(got) - set(want))[:3]
+            raise resilience.CheckpointCorrupt(
+                dirname, f"{fname[coll]} members diverge from manifest "
+                f"(missing: {missing}, unexpected: {extra})")
+        for k, w in want.items():
+            if got[k] != w:
+                raise resilience.CheckpointCorrupt(
+                    dirname, f"{fname[coll]}:{k} is {got[k]} on disk but "
+                    f"the manifest records {w}")
+
+
+def _restore_loss_scale(trainer, meta: Dict[str, Any], dirname: str) -> None:
+    """Loss-scale state across checkpoint/trainer config drift: a
+    checkpoint that predates dynamic loss scaling restored into a
+    scaler-running trainer (or vice versa) must warn and fall back to
+    the scaler's initial state, not KeyError."""
+    import warnings
+
     ls_meta = meta.get("loss_scale_state")
-    if ls_meta and trainer.loss_scaler is not None:
-        trainer.scope.loss_scale_state = jax.device_put({
-            "scale": jnp.float32(ls_meta["scale"]),
-            "good_steps": jnp.int32(ls_meta["good_steps"]),
-            "overflows": jnp.int32(ls_meta["overflows"]),
-        })
+    if trainer.loss_scaler is None:
+        if ls_meta:
+            warnings.warn(
+                f"checkpoint {dirname!r} carries loss_scale_state but the "
+                "trainer has no loss scaler — ignoring it (configure "
+                "DistStrategy.loss_scale to adopt it)")
+        return
+    init = trainer.loss_scaler.init_state()
+    if not ls_meta:
+        warnings.warn(
+            f"checkpoint {dirname!r} has no loss_scale_state but the "
+            "trainer runs a loss scaler — falling back to the scaler's "
+            "initial state (scale will re-calibrate)")
+        ls_meta = {}
+    missing = {"scale", "good_steps", "overflows"} - set(ls_meta)
+    if ls_meta and missing:
+        warnings.warn(
+            f"checkpoint {dirname!r} loss_scale_state is missing "
+            f"{sorted(missing)} — those fields fall back to the scaler's "
+            "initial values")
+    trainer.scope.loss_scale_state = jax.device_put({
+        "scale": jnp.float32(ls_meta.get("scale", float(init["scale"]))),
+        "good_steps": jnp.int32(ls_meta.get("good_steps",
+                                            int(init["good_steps"]))),
+        "overflows": jnp.int32(ls_meta.get("overflows",
+                                           int(init["overflows"]))),
+    })
 
 
 # -- inference model (save/load_inference_model analog) ----------------------

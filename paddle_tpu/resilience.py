@@ -1,0 +1,474 @@
+"""Fault-tolerant training runtime: checkpoint manifests, resume
+scanning, preemption handling, and the NaN/Inf guard policy.
+
+The reference framework's fault-tolerance story lives in the Go
+master/pserver (lease-timeout requeue in go/master/service.go, pserver
+checkpoints in go/pserver/service.go). The *queue* side is reproduced in
+``data.master``; this module supplies the *trainer* side so a worker
+survives preemptions, torn checkpoints, and bad batches without human
+intervention:
+
+- **Manifests** (:func:`write_manifest` / :func:`validate_checkpoint`):
+  every ``io.save_trainer`` checkpoint carries ``manifest.json`` with a
+  format version, ``global_step``, per-file CRC32 checksums + sizes, and
+  the flat shape/dtype spec of every array collection. Validation turns
+  "a random npz error three frames deep" into a structured
+  :class:`CheckpointCorrupt`.
+- **Atomic commit protocol** (implemented in ``io.save_trainer``): files
+  are written to a ``<dir>.tmp.<pid>`` sibling, fsynced, manifested, and
+  renamed into place — a ``kill -9`` at ANY point leaves either the old
+  checkpoint or the new one, never a half-written directory that
+  ``load_trainer`` trusts. Scanners ignore ``*.tmp.*`` leftovers.
+- **Resume scanning** (:func:`list_checkpoints` /
+  :func:`restore_latest`): find the newest checkpoint that actually
+  validates, falling back over corrupt ones — the restart half of the
+  ``test_fault_tolerance_e2e`` contract, available to every
+  ``fit(resume=True)`` caller instead of hand-rolled workers.
+- **Preemption** (:class:`PreemptionHandler`): SIGTERM/SIGINT (the TPU
+  maintenance-event analog) sets a flag; ``fit`` checkpoints at the next
+  chunk boundary, drains async orbax saves, and exits cleanly.
+- **NaN/Inf guard** (:class:`GuardPolicy` + :class:`Incident`): policy
+  and incident records for the Trainer's fused on-device guard — a
+  non-finite step is discarded (params/opt_state restored from the
+  on-device last-good snapshot, branchlessly inside the compiled step),
+  recorded, and training continues; repeated incidents escalate to
+  ``FloatingPointError``.
+- **Deterministic fault injection** (:func:`crash_point` +
+  ``testing.faults``): named crash points in the save path let tests
+  kill a save at an exact phase without subprocess roulette.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import signal
+import threading
+import time
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from .core.errors import EnforceError
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_VERSION = 1
+TMP_MARKER = ".tmp."  # uncommitted checkpoint dirs carry this in their name
+
+
+def _log():
+    return logging.getLogger("paddle_tpu.resilience")
+
+
+class CheckpointCorrupt(EnforceError):
+    """A checkpoint directory failed validation (torn write, truncated
+    or bit-flipped file, missing member, unreadable manifest). Carries
+    ``path`` and ``reason`` so callers can fall back programmatically."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"corrupt checkpoint at {path}: {reason}")
+        self.path = path
+        self.reason = reason
+
+
+# -- fault injection hooks ---------------------------------------------------
+# The save path calls crash_point(tag) at each phase boundary; the set is
+# empty in production (one set-membership test per checkpoint, not per
+# step). testing.faults arms tags to simulate kill -9 at exact phases.
+
+crash_points: set = set()
+
+
+class InjectedCrash(BaseException):
+    """Raised by an armed crash point. Derives from BaseException so
+    ordinary ``except Exception`` recovery code cannot swallow it — the
+    point is to model abrupt process death."""
+
+
+def crash_point(tag: str) -> None:
+    if crash_points and tag in crash_points:
+        raise InjectedCrash(tag)
+
+
+# -- manifest ----------------------------------------------------------------
+
+
+def _crc32_file(path: str, chunk: int = 1 << 20) -> Tuple[int, int]:
+    crc, size = 0, 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return crc & 0xFFFFFFFF, size
+            crc = zlib.crc32(b, crc)
+            size += len(b)
+
+
+def write_manifest(dirname: str, meta: Optional[Dict[str, Any]] = None,
+                   arrays: Optional[Dict[str, Dict[str, Any]]] = None) -> Dict[str, Any]:
+    """Write ``manifest.json`` covering every regular file already in
+    ``dirname``: format version, per-file CRC32 + size, the checkpoint
+    ``meta`` (``global_step`` etc.), and the flat shape/dtype spec of
+    each array collection (``arrays`` maps npz filename → {flat key:
+    {"shape": [...], "dtype": "..."}}). The manifest is written LAST so
+    its presence implies the files it describes were fully written."""
+    files = {}
+    for name in sorted(os.listdir(dirname)):
+        p = os.path.join(dirname, name)
+        if not os.path.isfile(p) or name == MANIFEST_NAME:
+            continue
+        crc, size = _crc32_file(p)
+        files[name] = {"crc32": crc, "size": size}
+    man = {"format_version": MANIFEST_VERSION,
+           "global_step": int((meta or {}).get("global_step", 0)),
+           "meta": meta or {},
+           "files": files,
+           "arrays": arrays or {}}
+    tmp = os.path.join(dirname, MANIFEST_NAME + ".part")
+    with open(tmp, "w") as f:
+        json.dump(man, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dirname, MANIFEST_NAME))
+    return man
+
+
+def validate_checkpoint(dirname: str) -> Optional[Dict[str, Any]]:
+    """Verify a checkpoint directory against its manifest.
+
+    Returns the parsed manifest on success, ``None`` for a legacy
+    (pre-manifest) directory, and raises :class:`CheckpointCorrupt` on
+    any mismatch: unreadable/wrong-version manifest, missing files,
+    size or checksum mismatches.
+
+    Cost: one streaming pass over every file — a restore therefore
+    reads the checkpoint twice (CRC pass, then the actual load). That
+    is the deliberate trade: size/parse checks alone cannot catch
+    silent bit flips, and the whole point of validation is never
+    handing a bitrotted parameter tensor to a resumed run."""
+    if not os.path.isdir(dirname):
+        raise CheckpointCorrupt(dirname, "not a directory")
+    mpath = os.path.join(dirname, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return None  # legacy checkpoint: caller decides how much to trust
+    try:
+        with open(mpath) as f:
+            man = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorrupt(dirname, f"unreadable manifest: {e}") from e
+    ver = man.get("format_version")
+    if not isinstance(ver, int) or ver > MANIFEST_VERSION:
+        raise CheckpointCorrupt(
+            dirname, f"manifest format_version {ver!r} not supported "
+            f"(this build reads <= {MANIFEST_VERSION})")
+    for name, spec in (man.get("files") or {}).items():
+        p = os.path.join(dirname, name)
+        if not os.path.isfile(p):
+            raise CheckpointCorrupt(dirname, f"missing file {name!r}")
+        crc, size = _crc32_file(p)
+        if size != spec.get("size"):
+            raise CheckpointCorrupt(
+                dirname, f"{name!r} truncated/grown: {size} bytes on disk "
+                f"vs {spec.get('size')} in manifest")
+        if crc != spec.get("crc32"):
+            raise CheckpointCorrupt(
+                dirname, f"{name!r} checksum mismatch: crc32 {crc:#010x} "
+                f"on disk vs {spec.get('crc32'):#010x} in manifest")
+    return man
+
+
+# -- checkpoint-directory scanning ------------------------------------------
+
+
+@dataclasses.dataclass
+class CheckpointInfo:
+    path: str
+    tag: str                      # directory basename (epoch_N / step_N)
+    global_step: int              # from manifest (or legacy meta.json); -1 unknown
+    mtime: float
+
+    @property
+    def sort_key(self):
+        return (self.global_step, self.mtime, self.tag)
+
+
+def _read_step(path: str) -> int:
+    for name in (MANIFEST_NAME, "meta.json"):
+        p = os.path.join(path, name)
+        try:
+            with open(p) as f:
+                return int(json.load(f).get("global_step", -1))
+        except (OSError, ValueError, TypeError):
+            continue
+    return -1
+
+
+def list_checkpoints(root: str) -> List[CheckpointInfo]:
+    """Scan ``root`` for committed checkpoint directories, OLDEST first
+    (ascending ``global_step``, mtime tiebreak). Uncommitted ``*.tmp.*``
+    leftovers from killed saves are ignored; validation is NOT performed
+    here (see :func:`restore_latest`)."""
+    out: List[CheckpointInfo] = []
+    if not os.path.isdir(root):
+        return out
+    for name in os.listdir(root):
+        if TMP_MARKER in name:
+            continue
+        p = os.path.join(root, name)
+        if not os.path.isdir(p):
+            continue
+        has_payload = any(
+            os.path.exists(os.path.join(p, f))
+            for f in (MANIFEST_NAME, "meta.json", "params.npz"))
+        if not has_payload:
+            continue
+        out.append(CheckpointInfo(path=p, tag=name,
+                                  global_step=_read_step(p),
+                                  mtime=os.path.getmtime(p)))
+    out.sort(key=lambda c: c.sort_key)
+    return out
+
+
+def sweep_tmp_dirs(root: str, tag: Optional[str] = None) -> List[str]:
+    """Remove uncommitted ``*.tmp.*`` checkpoint leftovers under
+    ``root`` — torn saves from crashed/preempted processes would
+    otherwise accumulate a full checkpoint's worth of disk each.
+    ``tag`` restricts the sweep to one checkpoint tag's leftovers
+    (``<tag>.tmp.*`` — what ``save_trainer`` clears before rewriting
+    that tag); without it the whole dir is swept (fit startup).
+    Single-writer assumption (one training process owns a checkpoint
+    dir, as fit does): a live concurrent writer's tmp dir would be
+    swept too, and its commit rename then fails loudly."""
+    import shutil
+
+    removed = []
+    if not os.path.isdir(root):
+        return removed
+    prefix = f"{tag}{TMP_MARKER}" if tag is not None else None
+    for name in os.listdir(root):
+        if TMP_MARKER not in name:
+            continue
+        if prefix is not None and not name.startswith(prefix):
+            continue
+        p = os.path.join(root, name)
+        if os.path.isdir(p):
+            shutil.rmtree(p, ignore_errors=True)
+            removed.append(p)
+    if removed:
+        _log().info("swept %d stale tmp checkpoint dir(s) under %s",
+                    len(removed), root)
+    return removed
+
+
+def restore_latest(root: str, trainer) -> Optional[Dict[str, Any]]:
+    """Restore ``trainer`` from the newest checkpoint under ``root``
+    that validates and loads, falling back over corrupt ones (warning
+    each). Returns the checkpoint's meta dict, or ``None`` when no
+    restorable checkpoint exists."""
+    from . import io as _io
+
+    for info in reversed(list_checkpoints(root)):
+        try:
+            _io.load_trainer(info.path, trainer)
+        except CheckpointCorrupt as e:
+            _log().warning("skipping corrupt checkpoint %s (%s); "
+                           "falling back to an older one", info.path, e.reason)
+            continue
+        meta = dict(getattr(trainer, "_last_loaded_meta", None) or {})
+        meta.setdefault("global_step", trainer.global_step)
+        _log().info("resumed from %s at global_step=%d", info.path,
+                    trainer.global_step)
+        return meta
+    return None
+
+
+# -- preemption --------------------------------------------------------------
+
+
+class PreemptionHandler:
+    """SIGTERM/SIGINT → "checkpoint at the next chunk boundary and exit
+    cleanly" (the TPU maintenance-event analog; the reference analog is
+    the pserver checkpointing before the master requeues its lease).
+
+    Use as a context manager; ``requested`` flips on the first signal.
+    A SECOND signal of the same kind restores the previous handler and
+    re-raises it, so a stuck run can still be killed interactively.
+    Signal handlers only install in the main thread; elsewhere the
+    handler degrades to an inert flag (``installed`` is False)."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self, signals=None):
+        self.signals = tuple(signals) if signals is not None else self.SIGNALS
+        self._flag = threading.Event()
+        self._old: Dict[int, Any] = {}
+        self.installed = False
+        self.signum: Optional[int] = None
+
+    @property
+    def requested(self) -> bool:
+        return self._flag.is_set()
+
+    def _handle(self, signum, frame):
+        if self._flag.is_set():
+            # second signal: the user really means it — restore the old
+            # handler and re-deliver so default/previous semantics apply.
+            # A non-Python-installed previous handler reads back as None
+            # (signal.signal rejects it): fall back to SIG_DFL so the
+            # escape hatch still kills the process.
+            old = self._old.get(signum) or signal.SIG_DFL
+            try:
+                signal.signal(signum, old)
+            except (ValueError, TypeError):
+                signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        self.signum = signum
+        self._flag.set()
+        _log().warning(
+            "received %s: checkpointing at the next chunk boundary, then "
+            "exiting (signal again to abort immediately)",
+            signal.Signals(signum).name)
+
+    def __enter__(self) -> "PreemptionHandler":
+        if threading.current_thread() is threading.main_thread():
+            for s in self.signals:
+                self._old[s] = signal.signal(s, self._handle)
+            self.installed = True
+        return self
+
+    def __exit__(self, *exc):
+        if self.installed:
+            for s, old in self._old.items():
+                try:
+                    signal.signal(s, old)
+                except (ValueError, TypeError):
+                    pass
+            self._old.clear()
+            self.installed = False
+        return False
+
+
+# -- NaN/Inf guard policy ----------------------------------------------------
+
+
+@dataclasses.dataclass
+class GuardPolicy:
+    """Graceful-degradation policy for non-finite training steps
+    (``Trainer(guard=GuardPolicy(...))``).
+
+    The detection itself is a single fused on-device ``all(isfinite)``
+    reduction over the gradients and every float fetch output, computed
+    INSIDE the compiled step and returned as one extra scalar bitmask in
+    the fetch dict — no per-leaf host sync (the old
+    ``FLAGS_check_nan_inf`` scan dispatched one blocking reduction per
+    leaf from Python). On a non-finite step the update is discarded
+    branchlessly (params/opt_state/state keep their pre-step values —
+    the on-device last-good snapshot is the step's own donated carry),
+    an :class:`Incident` is recorded host-side, and training continues.
+    The host readback is deferred by one dispatch (examined while the
+    next chunk runs; ``Trainer.drain_guard()`` flushes it, ``fit`` does
+    so automatically), so incident records and escalation trail the
+    device by at most one chunk while the hot path keeps ZERO added
+    synchronization.
+
+    ``max_incidents``/``window``: when MORE than ``max_incidents``
+    incidents land within the trailing ``window`` optimizer steps, the
+    guard escalates to ``FloatingPointError`` (``max_incidents=0``
+    raises on the first incident — the FLAGS_check_nan_inf abort
+    semantic, minus the per-leaf syncs). Dynamic loss-scale state is
+    NOT rolled back on a guarded step: the scaler's overflow backoff
+    must persist or the same overflow recurs forever."""
+
+    max_incidents: int = 8
+    window: int = 1000          # in optimizer steps
+    # feed digests require holding the previous dispatch's device feed
+    # until its bitmask is examined: one extra (super-)batch of HBM
+    # resident on every guarded step. Set False for memory-tight runs —
+    # incidents then record step + outputs but no batch fingerprint.
+    record_feed_digest: bool = True
+    # deferred readback (the default) examines the bitmask one dispatch
+    # late so the hot path adds no sync; False reads it back immediately
+    # after every dispatch — escalation then raises AT the offending
+    # step, at the cost of one blocking scalar fetch per dispatch (the
+    # check_nan_inf flag route uses this to keep its abort contract for
+    # hand-rolled step() loops that never call drain_guard())
+    defer_readback: bool = True
+
+
+@dataclasses.dataclass
+class Incident:
+    """One discarded non-finite step, recorded by the guard."""
+
+    step: int                   # global_step of the discarded update
+    outputs: Tuple[str, ...]    # which checked values were non-finite
+    feed_digest: Optional[str]  # crc32 of the offending host batch (or None)
+    wall_time: float
+
+    def __str__(self):
+        return (f"non-finite step {self.step}: {', '.join(self.outputs)}"
+                + (f" (feed crc32 {self.feed_digest})" if self.feed_digest
+                   else ""))
+
+
+def feed_digest(feed: Dict[str, Any], index: Optional[int] = None) -> str:
+    """crc32 digest of a feed dict (one batch). ``index`` selects step
+    ``i`` of a stacked ``(K, batch, ...)`` super-batch. Only called on
+    incidents, so the device→host pull is off the hot path."""
+    import numpy as np
+
+    crc = 0
+    for k in sorted(feed):
+        v = np.asarray(feed[k])
+        if index is not None and v.ndim >= 1:
+            v = v[index]
+        crc = zlib.crc32(k.encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(v).tobytes(), crc)
+    return f"{crc & 0xFFFFFFFF:#010x}"
+
+
+def escalate_if_needed(incidents: List[Incident], policy: GuardPolicy,
+                       current_step: int) -> None:
+    """Raise ``FloatingPointError`` when more than ``policy.max_incidents``
+    incidents fall in the trailing ``policy.window`` steps. Scans the
+    (step-ordered) list from the tail only — O(window incidents), not
+    O(history)."""
+    recent: List[Incident] = []
+    for inc in reversed(incidents):
+        if inc.step <= current_step - policy.window:
+            break
+        if inc.step <= current_step:
+            recent.append(inc)
+    if len(recent) > policy.max_incidents:
+        lines = "\n  ".join(str(i) for i in recent[:5])
+        raise FloatingPointError(
+            f"{len(recent)} non-finite steps within the last "
+            f"{policy.window} steps (GuardPolicy.max_incidents="
+            f"{policy.max_incidents}); last incidents:\n  {lines}")
+
+
+# a multi-month run with occasional sub-threshold incidents must not
+# grow the log without bound; oldest entries beyond this are dropped
+# (escalation only ever looks at the trailing window anyway)
+MAX_INCIDENT_LOG = 10_000
+
+
+def record_incident(incidents: List[Incident], step: int,
+                    outputs: Tuple[str, ...],
+                    digest: Optional[str]) -> Incident:
+    inc = Incident(step=step, outputs=outputs, feed_digest=digest,
+                   wall_time=time.time())
+    incidents.append(inc)
+    if len(incidents) > MAX_INCIDENT_LOG:
+        del incidents[:len(incidents) - MAX_INCIDENT_LOG]
+    _log().warning("guard: discarded %s", inc)
+    return inc
+
+
+__all__ = [
+    "CheckpointCorrupt", "CheckpointInfo", "GuardPolicy", "Incident",
+    "InjectedCrash", "PreemptionHandler", "crash_point", "crash_points",
+    "feed_digest", "list_checkpoints", "restore_latest", "sweep_tmp_dirs",
+    "validate_checkpoint", "write_manifest",
+]

@@ -1,0 +1,6 @@
+"""Test-support utilities shipped with the framework (deterministic
+fault injection for resilience testing). Production code never imports
+this package; it imports :mod:`paddle_tpu.resilience`'s crash-point
+registry lazily instead."""
+
+from . import faults  # noqa: F401

@@ -1,0 +1,129 @@
+"""Deterministic fault-injection harness for the resilience layer.
+
+Three fault families, all exactly reproducible (no subprocess roulette,
+no timing races):
+
+- **Bad batches**: :func:`nan_batch_reader` poisons one batch of a
+  reader with NaN/Inf at an exact batch index — drives the Trainer's
+  on-device guard.
+- **Scripted crashes**: :func:`crash_at_step` (an event handler that
+  dies after step k) and :func:`crashing` (arms a named
+  :func:`~paddle_tpu.resilience.crash_point` inside the save path, so a
+  "kill -9 mid-save" happens at an exact phase: files written but no
+  manifest, manifest written but not committed, ...).
+- **Checkpoint corruption**: :func:`truncate_file` / :func:`flip_byte`
+  tear a committed checkpoint the way a torn disk write would.
+
+Known crash-point tags in the save path (``io.save_trainer``):
+
+- ``save_trainer:files-written`` — npz/meta files on disk, no manifest
+- ``save_trainer:manifest-written`` — manifest on disk, dir not renamed
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from .. import resilience
+
+InjectedCrash = resilience.InjectedCrash
+
+
+# -- bad batches -------------------------------------------------------------
+
+
+def nan_batch_reader(reader: Callable[[], Iterator], at_batch: int,
+                     column: int = 0, value: float = float("nan")):
+    """Wrap a paddle-style reader (``reader() -> iterator of sample
+    lists``): batch ``at_batch`` (0-based) has ``value`` splatted over
+    sample column ``column``. Deterministic: same batch every epoch."""
+
+    def poisoned():
+        for i, samples in enumerate(reader()):
+            if i == at_batch:
+                samples = [
+                    tuple(np.full_like(np.asarray(v, dtype=np.float64)
+                                       if np.asarray(v).dtype.kind in "iu"
+                                       else np.asarray(v), value)
+                          if j == column else v
+                          for j, v in enumerate(s))
+                    for s in samples]
+            yield samples
+    return poisoned
+
+
+def nan_feed(feed: Dict[str, np.ndarray], name: str,
+             value: float = float("nan")) -> Dict[str, np.ndarray]:
+    """Return a copy of a feed dict with ``name`` fully non-finite."""
+    out = dict(feed)
+    out[name] = np.full_like(np.asarray(feed[name], dtype=np.float32), value)
+    return out
+
+
+# -- scripted crashes --------------------------------------------------------
+
+
+def crash_at_step(step: int, kind: str = "end_step"):
+    """Event handler for ``fit``: raises :class:`InjectedCrash` once
+    ``global_step`` reaches ``step`` at the given event kind — the
+    in-process stand-in for ``kill -9`` between chunks (checkpoints
+    already on disk stay exactly as a real crash would leave them)."""
+
+    def handler(event):
+        if event.kind == kind and event.step >= step:
+            raise InjectedCrash(f"scripted crash at step {event.step}")
+    return handler
+
+
+@contextlib.contextmanager
+def crashing(tag: str):
+    """Arm crash point ``tag`` for the duration of the block: the next
+    time the save path reaches it, :class:`InjectedCrash` is raised —
+    phase-exact kill-mid-save."""
+    resilience.crash_points.add(tag)
+    try:
+        yield
+    finally:
+        resilience.crash_points.discard(tag)
+
+
+# -- checkpoint corruption ---------------------------------------------------
+
+
+def truncate_file(ckpt_dir: str, name: Optional[str] = None,
+                  keep_bytes: Optional[int] = None) -> str:
+    """Truncate a file inside a committed checkpoint (default: the
+    largest npz, to half its size) — the torn-tail failure mode."""
+    name = name or _largest_npz(ckpt_dir)
+    p = os.path.join(ckpt_dir, name)
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.truncate(size // 2 if keep_bytes is None else keep_bytes)
+    return name
+
+
+def flip_byte(ckpt_dir: str, name: Optional[str] = None,
+              offset: Optional[int] = None) -> str:
+    """XOR one byte of a checkpoint file (default: the largest npz,
+    middle byte) — the silent-bitrot failure mode that only a checksum
+    catches."""
+    name = name or _largest_npz(ckpt_dir)
+    p = os.path.join(ckpt_dir, name)
+    off = os.path.getsize(p) // 2 if offset is None else offset
+    with open(p, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return name
+
+
+def _largest_npz(ckpt_dir: str) -> str:
+    npz = [n for n in os.listdir(ckpt_dir) if n.endswith(".npz")]
+    if not npz:
+        raise FileNotFoundError(f"no npz files in {ckpt_dir}")
+    return max(npz, key=lambda n: os.path.getsize(os.path.join(ckpt_dir, n)))

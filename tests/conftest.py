@@ -48,6 +48,20 @@ def _single_device_cache_read(module_name, cache_key, compile_options,
     devices = rest[0] if rest else kw.get("executable_devices")
     if devices is not None and len(devices) > 1:
         return None, None
+    # The same runtime also mis-reloads DONATING executables: a
+    # disk-reloaded train step occasionally loses the donation alias
+    # info and a fetched output reads clobbered memory (observed as a
+    # sporadic garbage/NaN loss right after a checkpoint save in the
+    # resume-continuity tests — reproducible only with a warm cache,
+    # never with fresh compiles). Gate the trainer's donating step
+    # programs (train_step / run_k_steps) out of cache reads too;
+    # forward/eval/infer programs keep the big cache win.
+    try:  # one predicate, shared with the production gate
+        from paddle_tpu.executor import DONATING_STEP_MODULE_TAGS as _tags
+    except Exception:
+        _tags = ("train_step", "run_k_steps")
+    if any(tag in (module_name or "") for tag in _tags):
+        return None, None
     return _orig_cache_read(module_name, cache_key, compile_options,
                             backend, *rest, **kw)
 

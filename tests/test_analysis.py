@@ -164,6 +164,39 @@ def test_f64_feed_flagged():
     assert "dtype:f64-leak" in rep.codes()
 
 
+def test_amp_lint_runs_on_train_path():
+    """check_trainer(amp=...) re-traces the STEP under the amp compute
+    dtype, so dtype-flow findings that only exist on the train path —
+    here an uncast f32 aux head gated on in_training() — are caught
+    even though the forward program (training=False trace) hides them."""
+    from paddle_tpu.framework import create_parameter, in_training
+
+    def model(x):
+        h = L.fc(x, 8)
+        w = create_parameter((8, 8), name="aux_w")
+        loss = h.sum() + (w * 0.0).sum()
+        if in_training():   # train-only branch bypassing cast_compute
+            loss = loss + jnp.matmul(h.astype(jnp.float32), w).sum()
+        return {"loss": loss}
+
+    feed = {"x": np.ones((2, 8), np.float32)}
+    prog = pt.build(model)
+    # forward-only lint cannot see the branch
+    fwd = analysis.check(prog, feed, amp="bfloat16")
+    assert "dtype:amp-f32-matmul" not in fwd.codes()
+    tr = pt.Trainer(prog, opt.SGD(0.1), loss_name="loss")
+    tr.startup(sample_feed=feed)
+    rep = analysis.check_trainer(tr, feed, amp="bfloat16")
+    assert "dtype:amp-f32-matmul" in rep.codes()
+    # without amp the rule has nothing to enforce on the step either
+    plain = analysis.check_trainer(tr, feed)
+    assert "dtype:amp-f32-matmul" not in plain.codes()
+    # family selection still isolates: dtype excluded -> no dtype codes
+    sel = analysis.check_trainer(tr, feed, select={"donation"},
+                                 amp="bfloat16")
+    assert not [c for c in sel.codes() if c.startswith("dtype")]
+
+
 # --------------------------------------------------------------------------
 # 3. sharding audit
 # --------------------------------------------------------------------------

@@ -27,7 +27,7 @@ def test_priority_order_leads_with_baseline_configs():
     assert names[8] == "gpt"
     # every registered config appears exactly once
     expect = (set(bench.TRAIN_CONFIGS) | set(bench.INFER_CONFIGS)
-              | {"gpt_decode", "dispatch_overhead"})
+              | {"gpt_decode", "dispatch_overhead", "guard_overhead"})
     assert set(names) == expect and len(names) == len(expect)
 
 
@@ -73,6 +73,14 @@ def test_dispatch_overhead_quick_overrides(monkeypatch):
     monkeypatch.setattr(bench, "bench_dispatch_overhead",
                         lambda peak, **kw: seen.update(kw) or {"v": 1})
     bench._run_one("dispatch_overhead", 1.0, quick=True)
+    assert seen == {"iters": 8, "k": 4}
+
+
+def test_guard_overhead_quick_overrides(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(bench, "bench_guard_overhead",
+                        lambda peak, **kw: seen.update(kw) or {"v": 1})
+    bench._run_one("guard_overhead", 1.0, quick=True)
     assert seen == {"iters": 8, "k": 4}
 
 

@@ -1,0 +1,537 @@
+"""Fault-injection suite for the resilience layer (fast, CPU, non-slow):
+atomic validated checkpoints survive kill-mid-save, ``fit(resume=True)``
+reproduces step/loss continuity bit-exactly, the on-device NaN guard
+discards bad steps with params unchanged and records incidents, SIGTERM
+produces a boundary checkpoint + clean exit, and reader exceptions
+propagate out of the prefetch thread. Driven by the deterministic
+harness in paddle_tpu.testing.faults — no subprocess roulette."""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as pt
+from paddle_tpu import io as pio
+from paddle_tpu import layers as L
+from paddle_tpu import optimizer as opt
+from paddle_tpu import resilience
+from paddle_tpu.parallel import DistStrategy
+from paddle_tpu.testing import faults
+
+DIM, CLASSES, BS, N_BATCHES = 6, 4, 4, 8
+
+
+def _net(x, label):
+    h = L.fc(x, 16, name="fc1")
+    logits = L.fc(h, CLASSES, name="fc2")
+    return {"loss": L.mean(L.softmax_with_cross_entropy(logits, label))}
+
+
+_PROG = pt.build(_net)
+_FEED = {"x": np.zeros((BS, DIM), np.float32),
+         "label": np.zeros((BS, 1), np.int64)}
+
+
+def _trainer(strategy=None, guard=None):
+    tr = pt.Trainer(_PROG, opt.SGD(0.1), loss_name="loss",
+                    strategy=strategy, guard=guard)
+    tr.startup(sample_feed=_FEED)
+    return tr
+
+
+def _reader(n_batches=N_BATCHES, seed=7):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n_batches):
+            x = rng.randn(BS, DIM).astype(np.float32)
+            y = rng.randint(0, CLASSES, (BS,)).astype(np.int64)
+            yield [(x[j], y[j:j + 1]) for j in range(BS)]
+    return reader
+
+
+def _fit(tr, cfg=None, epochs=2, handler=None, **kw):
+    return pt.fit(tr, _reader(), num_epochs=epochs,
+                  feed_names=["x", "label"], dtypes=["float32", "int64"],
+                  checkpoint_config=cfg, event_handler=handler, **kw)
+
+
+def _params_equal(a, b):
+    a, b = jax.device_get(a), jax.device_get(b)
+    return all(np.array_equal(a[k], b[k]) for k in a)
+
+
+# -- atomic validated checkpoints -------------------------------------------
+
+
+def test_manifest_written_and_validates(tmp_path):
+    tr = _trainer()
+    tr.step(_FEED)
+    d = str(tmp_path / "ck")
+    pio.save_trainer(d, tr)
+    man = resilience.validate_checkpoint(d)
+    assert man["format_version"] == resilience.MANIFEST_VERSION
+    assert man["global_step"] == 1
+    assert set(man["files"]) >= {"params.npz", "meta.json"}
+    # the arrays spec names every saved leaf with shape+dtype
+    assert man["arrays"]["params.npz"]["fc1/w"] == {
+        "shape": [DIM, 16], "dtype": "float32"}
+
+
+@pytest.mark.parametrize("phase", ["save_trainer:files-written",
+                                   "save_trainer:manifest-written"])
+def test_kill_mid_save_keeps_previous_checkpoint_loadable(tmp_path, phase):
+    """A crash at ANY phase of save_trainer (files written but no
+    manifest; manifest written but dir not committed) must leave the
+    previous committed checkpoint untouched and loadable, and the torn
+    tmp dir invisible to the scanner."""
+    tr = _trainer()
+    tr.step(_FEED)
+    ck1 = str(tmp_path / "step_1")
+    pio.save_trainer(ck1, tr)
+    tr.step(_FEED)
+    ck2 = str(tmp_path / "step_2")
+    with faults.crashing(phase):
+        with pytest.raises(faults.InjectedCrash):
+            pio.save_trainer(ck2, tr)
+    # torn save: no committed step_2, tmp leftovers ignored by the scan
+    assert not os.path.isdir(ck2)
+    scanned = resilience.list_checkpoints(str(tmp_path))
+    assert [c.tag for c in scanned] == ["step_1"]
+    # the previous checkpoint restores a fresh trainer exactly
+    tr2 = _trainer()
+    meta = resilience.restore_latest(str(tmp_path), tr2)
+    assert meta is not None and tr2.global_step == 1
+
+
+def test_corrupt_checkpoint_raises_structured(tmp_path):
+    tr = _trainer()
+    tr.step(_FEED)
+    d = str(tmp_path / "ck")
+    pio.save_trainer(d, tr)
+
+    flipped = faults.flip_byte(d)
+    with pytest.raises(resilience.CheckpointCorrupt) as ei:
+        pio.load_trainer(d, _trainer())
+    assert flipped in str(ei.value) and "checksum" in str(ei.value)
+    assert ei.value.path == d
+
+    pio.save_trainer(d, tr)  # atomic overwrite repairs the tag
+    pio.load_trainer(d, _trainer())  # sanity: valid again
+    truncated = faults.truncate_file(d)
+    with pytest.raises(resilience.CheckpointCorrupt) as ei:
+        pio.load_trainer(d, _trainer())
+    assert truncated in str(ei.value)
+
+
+def test_legacy_checkpoint_without_manifest_still_loads(tmp_path):
+    """Pre-manifest directories (plain save_persistables) keep loading —
+    validation is skipped, not enforced retroactively."""
+    tr = _trainer()
+    tr.step(_FEED)
+    d = str(tmp_path / "legacy")
+    pio.save_persistables(d, tr.scope.params, tr.scope.state,
+                          tr.scope.opt_state, meta={"global_step": 1})
+    assert resilience.validate_checkpoint(d) is None
+    tr2 = _trainer()
+    pio.load_trainer(d, tr2)
+    assert tr2.global_step == 1 and _params_equal(tr.scope.params,
+                                                  tr2.scope.params)
+
+
+def test_stale_tmp_dirs_swept(tmp_path):
+    """Torn-save leftovers (<tag>.tmp.<pid> from a crashed process) must
+    not accumulate: the next save of the same tag removes them, and
+    fit's startup sweep clears the rest."""
+    tr = _trainer()
+    tr.step(_FEED)
+    with faults.crashing("save_trainer:manifest-written"):
+        with pytest.raises(faults.InjectedCrash):
+            pio.save_trainer(str(tmp_path / "step_1"), tr)
+    assert any(resilience.TMP_MARKER in n for n in os.listdir(tmp_path))
+    pio.save_trainer(str(tmp_path / "step_1"), tr)  # same tag: sweeps
+    assert os.listdir(tmp_path) == ["step_1"]
+    with faults.crashing("save_trainer:files-written"):
+        with pytest.raises(faults.InjectedCrash):
+            pio.save_trainer(str(tmp_path / "step_2"), tr)
+    cfg = pt.CheckpointConfig(str(tmp_path), epoch_interval=0,
+                              step_interval=0, max_num_checkpoints=2)
+    _fit(_trainer(), cfg, epochs=1)  # startup sweep clears other tags' tmp
+    assert not any(resilience.TMP_MARKER in n for n in os.listdir(tmp_path))
+
+
+def test_guard_mask_caps_at_32_checked_values():
+    """More than 32 checked values must fold into the uint32 bitmask's
+    last bit (shifts past bit 31 are undefined) — detection stays
+    exact, only the attribution coarsens."""
+    def many(x, label):
+        out = {"loss": L.mean(L.softmax_with_cross_entropy(
+            L.fc(x, CLASSES, name="mfc"), label))}
+        for i in range(40):
+            out[f"m{i:02d}"] = x.sum() * (i + 1.0)
+        return out
+
+    tr = pt.Trainer(pt.build(many), opt.SGD(0.1), loss_name="loss",
+                    guard=pt.GuardPolicy())
+    tr.startup(sample_feed=_FEED)
+    before = jax.device_get(tr.scope.params)
+    tr.step(faults.nan_feed(_FEED, "x"))
+    tr.drain_guard()
+    assert _params_equal(before, tr.scope.params)
+    (inc,) = tr.guard_incidents
+    assert len(inc.outputs) == 32
+    assert inc.outputs[-1].startswith("any-of-")
+
+
+# -- resumable fit -----------------------------------------------------------
+
+
+def test_resume_reproduces_uninterrupted_run_bit_exactly(tmp_path):
+    cfg = pt.CheckpointConfig(str(tmp_path), epoch_interval=0,
+                              step_interval=4, max_num_checkpoints=3)
+    ref_losses = []
+    ref = _fit(_trainer(), handler=lambda e: ref_losses.append(
+        float(e.metrics["loss"])) if e.kind == "end_step" else None)
+
+    crashed = _trainer()
+    with pytest.raises(faults.InjectedCrash):
+        _fit(crashed, cfg, handler=faults.crash_at_step(7))
+    assert [c.tag for c in resilience.list_checkpoints(str(tmp_path))] \
+        == ["step_4"]
+
+    resumed_losses = []
+    res = _fit(_trainer(), cfg, resume=True,
+               handler=lambda e: resumed_losses.append(
+                   float(e.metrics["loss"])) if e.kind == "end_step" else None)
+    assert res.global_step == ref.global_step == 2 * N_BATCHES
+    # exact continuity: the resumed tail equals the uninterrupted run's
+    # tail bit-for-bit (same rng stream via fold_in(base, global_step),
+    # same reader order after the fast-forward)
+    assert resumed_losses == ref_losses[-len(resumed_losses):]
+    assert _params_equal(ref.scope.params, res.scope.params)
+
+
+def test_resume_falls_back_over_corrupt_newest(tmp_path):
+    cfg = pt.CheckpointConfig(str(tmp_path), epoch_interval=0,
+                              step_interval=4, max_num_checkpoints=4)
+    _fit(_trainer(), cfg)
+    ckpts = resilience.list_checkpoints(str(tmp_path))
+    assert len(ckpts) >= 2
+    faults.flip_byte(ckpts[-1].path)
+    tr = _trainer()
+    meta = resilience.restore_latest(str(tmp_path), tr)
+    assert meta is not None
+    assert tr.global_step == ckpts[-2].global_step
+
+
+def test_resume_with_empty_dir_starts_fresh(tmp_path):
+    cfg = pt.CheckpointConfig(str(tmp_path / "none"), epoch_interval=0,
+                              step_interval=0, max_num_checkpoints=2)
+    tr = _fit(_trainer(), cfg, epochs=1, resume=True)
+    assert tr.global_step == N_BATCHES
+
+
+def test_rotation_rebuilt_across_restarts(tmp_path):
+    """`kept` used to start empty each run, so pre-existing checkpoints
+    never rotated out and max_num_checkpoints was violated after any
+    restart."""
+    cfg = pt.CheckpointConfig(str(tmp_path), epoch_interval=0,
+                              step_interval=2, max_num_checkpoints=3)
+    _fit(_trainer(), cfg, epochs=1)   # 8 steps -> saves at 2,4,6,8
+    assert len(os.listdir(str(tmp_path))) == 3
+    _fit(_trainer(), cfg, epochs=1)   # restart: old tags must rotate out
+    dirs = sorted(os.listdir(str(tmp_path)))
+    assert len(dirs) == 3
+    # the survivors are the NEWEST three by global_step, from run 2
+    steps = sorted(c.global_step
+                   for c in resilience.list_checkpoints(str(tmp_path)))
+    assert steps == [4, 6, 8]
+
+
+# -- loss-scale state drift --------------------------------------------------
+
+
+def test_loss_scale_state_mismatch_warns_not_crashes(tmp_path):
+    amp_strategy = DistStrategy(loss_scale=2.0 ** 10,
+                                dynamic_loss_scale=True)
+    # checkpoint WITHOUT scaler state -> trainer WITH scaler
+    plain = _trainer()
+    plain.step(_FEED)
+    d1 = str(tmp_path / "plain")
+    pio.save_trainer(d1, plain)
+    scaled = _trainer(strategy=amp_strategy)
+    with pytest.warns(UserWarning, match="no loss_scale_state"):
+        pio.load_trainer(d1, scaled)
+    assert float(scaled.scope.loss_scale_state["scale"]) == 2.0 ** 10
+    scaled.step(_FEED)  # and the trainer still steps
+
+    # checkpoint WITH scaler state -> trainer WITHOUT scaler
+    d2 = str(tmp_path / "scaled")
+    pio.save_trainer(d2, scaled)
+    plain2 = _trainer()
+    with pytest.warns(UserWarning, match="no loss scaler"):
+        pio.load_trainer(d2, plain2)
+    plain2.step(_FEED)
+
+
+# -- NaN/Inf guard -----------------------------------------------------------
+
+
+def test_nan_batch_discarded_params_unchanged_incident_recorded():
+    tr = _trainer(guard=pt.GuardPolicy(max_incidents=3, window=100))
+    tr.step(_FEED)
+    before = jax.device_get(tr.scope.params)
+    tr.step(faults.nan_feed(_FEED, "x"))
+    tr.drain_guard()
+    assert _params_equal(before, tr.scope.params)
+    assert len(tr.guard_incidents) == 1
+    inc = tr.guard_incidents[0]
+    assert inc.step == 1
+    assert "grads" in inc.outputs and "loss" in inc.outputs
+    assert inc.feed_digest is not None
+    # training continues: the next good step moves params again
+    tr.step(_FEED)
+    tr.drain_guard()
+    assert not _params_equal(before, tr.scope.params)
+    assert len(tr.guard_incidents) == 1
+
+
+def test_nan_batch_mid_fit_completes_training():
+    tr = _trainer(guard=pt.GuardPolicy())
+    reader = faults.nan_batch_reader(_reader(), at_batch=3)
+    pt.fit(tr, reader, num_epochs=1, feed_names=["x", "label"],
+           dtypes=["float32", "int64"])
+    assert tr.global_step == N_BATCHES          # no step lost
+    assert [i.step for i in tr.guard_incidents] == [3]
+    assert np.isfinite(float(tr.eval(_FEED)["loss"]))
+
+
+def test_guard_escalates_after_max_incidents():
+    tr = _trainer(guard=pt.GuardPolicy(max_incidents=1, window=100))
+    bad = faults.nan_feed(_FEED, "x")
+    tr.step(bad)
+    tr.step(bad)
+    with pytest.raises(FloatingPointError, match="non-finite steps"):
+        tr.step(_FEED)  # deferred readback: escalation lands here
+        tr.drain_guard()
+    assert len(tr.guard_incidents) == 2
+
+
+def test_check_nan_inf_flag_routes_to_fused_guard():
+    """The legacy flag keeps its contract for hand-rolled step() loops:
+    the abort raises AT the offending step (eager readback — no
+    drain_guard() knowledge required), and the state is still clean
+    (update discarded on device) — strictly better than the old
+    post-hoc per-leaf host scan."""
+    from paddle_tpu.core import config
+    config.set_flag("check_nan_inf", True)
+    try:
+        tr = _trainer()  # flag resolved at _build_step
+        before = jax.device_get(tr.scope.params)
+        with pytest.raises(FloatingPointError):
+            tr.step(faults.nan_feed(_FEED, "x"))
+        assert _params_equal(before, tr.scope.params)
+        assert len(tr.guard_incidents) == 1
+    finally:
+        config.set_flag("check_nan_inf", False)
+
+
+def test_guard_escalation_holds_mid_chunk_with_window_one():
+    """window=1 (the check_nan_inf abort contract) must escalate even
+    when the incident lands MID-chunk under fused dispatch — escalation
+    is evaluated at each incident's own step, not the chunk end."""
+    from paddle_tpu.data.feeder import stack_batches
+    tr = _trainer(guard=pt.GuardPolicy(max_incidents=0, window=1))
+    stacked = stack_batches([_FEED, faults.nan_feed(_FEED, "x"),
+                             _FEED, _FEED])
+    tr.run_steps(tr._put_feed(stacked, stacked=True), k=4)
+    with pytest.raises(FloatingPointError):
+        tr.drain_guard()
+    assert [i.step for i in tr.guard_incidents] == [1]
+
+
+def test_guard_fused_dispatch_reports_per_step_incidents():
+    tr = _trainer(guard=pt.GuardPolicy(max_incidents=10, window=100))
+    from paddle_tpu.data.feeder import stack_batches
+    bad = faults.nan_feed(_FEED, "x")
+    stacked = stack_batches([_FEED, bad, _FEED, bad])
+    tr.run_steps(tr._put_feed(stacked, stacked=True), k=4)
+    tr.drain_guard()
+    assert [i.step for i in tr.guard_incidents] == [1, 3]
+
+
+def test_guard_with_loss_scaler_leaves_grad_overflow_to_scaler():
+    """With a loss scaler the guard must NOT watch raw gradients: a
+    routine calibration overflow is the scaler's job (skip + backoff),
+    not a guard incident — and under the check_nan_inf route it must
+    not abort amp training at the first backoff. The guard still
+    watches the fetch outputs (a truly NaN batch escalates via loss)."""
+    tr = _trainer(strategy=DistStrategy(loss_scale=2.0 ** 10,
+                                        dynamic_loss_scale=True),
+                  guard=pt.GuardPolicy())
+    tr.step(_FEED)
+    assert "grads" not in tr._guard_bit_names
+    assert "loss" in tr._guard_bit_names
+    # scaler-less trainer keeps the grads bit
+    tr2 = _trainer(guard=pt.GuardPolicy())
+    tr2.step(_FEED)
+    assert "grads" in tr2._guard_bit_names
+
+
+def test_rotation_never_deletes_foreign_checkpoints(tmp_path):
+    """A hand-saved checkpoint in the same dir (e.g. 'best') must never
+    be rotation-deleted — only fit-owned step_*/epoch_* tags rotate."""
+    tr = _trainer()
+    tr.step(_FEED)
+    pio.save_trainer(str(tmp_path / "best"), tr)
+    cfg = pt.CheckpointConfig(str(tmp_path), epoch_interval=0,
+                              step_interval=2, max_num_checkpoints=2)
+    _fit(_trainer(), cfg, epochs=1)   # saves at 2,4,6,8 -> rotates
+    assert os.path.isdir(tmp_path / "best")
+    steps = [c.tag for c in resilience.list_checkpoints(str(tmp_path))]
+    assert "best" in steps and len(steps) == 3  # best + 2 rotated tags
+
+
+# -- preemption --------------------------------------------------------------
+
+
+def test_sigterm_boundary_checkpoint_and_clean_exit(tmp_path):
+    cfg = pt.CheckpointConfig(str(tmp_path), epoch_interval=0,
+                              step_interval=0, max_num_checkpoints=3)
+    events = []
+
+    def handler(e):
+        events.append(e.kind)
+        if e.kind == "end_step" and e.step == 5:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    tr = _fit(_trainer(), cfg, handler=handler)   # returns, no exception
+    assert tr.global_step == 5
+    assert events[-1] == "preempted"
+    ckpts = resilience.list_checkpoints(str(tmp_path))
+    assert [c.global_step for c in ckpts] == [5]
+    # the boundary checkpoint validates and resumes
+    tr2 = _trainer()
+    assert resilience.restore_latest(str(tmp_path), tr2) is not None
+    assert tr2.global_step == 5
+    # the previous SIGTERM disposition was restored on fit exit
+    assert signal.getsignal(signal.SIGTERM) in (
+        signal.SIG_DFL, signal.default_int_handler) or callable(
+        signal.getsignal(signal.SIGTERM))
+
+
+def test_preemption_with_pending_escalation_still_saves_boundary(tmp_path):
+    """A guard escalation pending at preemption time must not forfeit
+    the boundary checkpoint: device state is clean (bad updates were
+    discarded on device), so fit saves first, then re-raises."""
+    cfg = pt.CheckpointConfig(str(tmp_path), epoch_interval=0,
+                              step_interval=0, max_num_checkpoints=3)
+    reader = faults.nan_batch_reader(_reader(), at_batch=5)
+
+    def handler(e):
+        if e.kind == "end_step" and e.step == 6:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    tr = _trainer(guard=pt.GuardPolicy(max_incidents=0, window=100))
+    with pytest.raises(FloatingPointError):
+        pt.fit(tr, reader, num_epochs=2, feed_names=["x", "label"],
+               dtypes=["float32", "int64"], checkpoint_config=cfg,
+               event_handler=handler)
+    # the boundary checkpoint was committed before the re-raise
+    assert [c.global_step
+            for c in resilience.list_checkpoints(str(tmp_path))] == [6]
+
+
+def test_preemption_saves_despite_stale_same_tag_dir(tmp_path):
+    """A stale step_<N> dir from a PREVIOUS run must not suppress the
+    preemption boundary save — 'already saved' means saved by this
+    run."""
+    stale = _trainer()
+    stale.global_step = 5  # fake a prior run's checkpoint at the same tag
+    pio.save_trainer(str(tmp_path / "step_5"), stale)
+    stale_probe = float(jax.device_get(stale.eval(_FEED)["loss"]))
+    cfg = pt.CheckpointConfig(str(tmp_path), epoch_interval=0,
+                              step_interval=0, max_num_checkpoints=3)
+
+    def handler(e):
+        if e.kind == "end_step" and e.step == 5:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    _fit(_trainer(), cfg, handler=handler)
+    tr2 = _trainer()
+    assert resilience.restore_latest(str(tmp_path), tr2) is not None
+    assert tr2.global_step == 5
+    # the restored params are the preempted run's (5 real steps), not
+    # the stale zero-step ones
+    probe = float(jax.device_get(tr2.eval(_FEED)["loss"]))
+    assert probe != stale_probe
+
+
+def test_guard_false_overrides_check_nan_inf_flag():
+    from paddle_tpu.core import config
+    config.set_flag("check_nan_inf", True)
+    try:
+        tr = _trainer(guard=False)
+        out = tr.step(faults.nan_feed(_FEED, "x"))  # must not raise
+        assert "guard_nonfinite" not in out
+    finally:
+        config.set_flag("check_nan_inf", False)
+
+
+def test_preempted_run_resumes_to_completion(tmp_path):
+    cfg = pt.CheckpointConfig(str(tmp_path), epoch_interval=0,
+                              step_interval=0, max_num_checkpoints=3)
+
+    def handler(e):
+        if e.kind == "end_step" and e.step == 5:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    _fit(_trainer(), cfg, handler=handler)
+    res = _fit(_trainer(), cfg, resume=True)
+    assert res.global_step == 2 * N_BATCHES
+
+
+# -- DeviceFeeder error propagation ------------------------------------------
+
+
+class _ReaderBoom(RuntimeError):
+    pass
+
+
+def _boom_batches(good=2):
+    def batches():
+        for _ in range(good):
+            yield {"x": np.ones((2, 3), np.float32)}
+        raise _ReaderBoom("disk died")
+    return batches
+
+
+@pytest.mark.parametrize("stack_k", [1, 2])
+def test_feeder_reader_exception_propagates(stack_k):
+    from paddle_tpu.data.feeder import DeviceFeeder
+    df = DeviceFeeder(_boom_batches(), stack_k=stack_k)
+    got = []
+    with pytest.raises(_ReaderBoom, match="disk died") as ei:
+        for item in df:
+            got.append(item)
+    assert got, "good batches before the failure must still be delivered"
+    # original fill-thread traceback attached, not a bare re-raise
+    import traceback
+    tb = "".join(traceback.format_tb(ei.value.__traceback__))
+    assert "batches" in tb
+    df.close()
+
+
+def test_fit_surfaces_reader_exception():
+    def reader():
+        yield from _reader(n_batches=2)()
+        raise _ReaderBoom("reader crashed mid-epoch")
+
+    tr = _trainer()
+    with pytest.raises(_ReaderBoom):
+        pt.fit(tr, reader, num_epochs=1, feed_names=["x", "label"],
+               dtypes=["float32", "int64"])
+    assert tr.global_step == 2  # good batches trained, then loud abort
