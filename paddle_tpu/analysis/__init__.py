@@ -3,7 +3,7 @@
 The IR-pass layer of the framework (graph_viz_pass / memory_usage_calc /
 ProgramDesc-validator analog, SURVEY §3): a walker over ``Program.desc``
 — the jaxpr IS the ProgramDesc here — that produces a structured
-:class:`LintReport` before anything compiles. Six rule families:
+:class:`LintReport` before anything compiles. Seven rule families:
 
 1. collective placement — reduction collectives inside scan/while
    bodies (the unhoisted-accumulation hazard) with per-step comm-byte
@@ -21,7 +21,11 @@ ProgramDesc-validator analog, SURVEY §3): a walker over ``Program.desc``
    passed through (the donated-buffer-reuse footgun, sharpened by the
    fused K-step dispatch donating the whole training carry);
 6. recompilation hazards — weak python scalars and unhashable objects
-   in the traced argument signature.
+   in the traced argument signature;
+7. feed wire-format candidates — float32 feed inputs whose first
+   in-program uses are a cast/normalize, static evidence the field
+   could cross the host→device link as uint8/bf16 wire with the decode
+   fused into the step (data/wire.py).
 
 Three front doors: programmatic :func:`check` / :func:`check_trainer`,
 ``Trainer.startup(lint="warn"|"error")``, and the CLI

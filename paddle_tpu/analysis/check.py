@@ -62,6 +62,7 @@ def check(
     loss_name: str = "loss",
     large_param_bytes: int = 1 << 20,
     select: Optional[set] = None,
+    feed_wire=None,
 ) -> LintReport:
     """Statically lint ``program``. ``sample_feed`` supplies example
     inputs (arrays or ShapeDtypeStructs) keyed by the program fn's arg
@@ -70,11 +71,24 @@ def check(
     config-level collective checks, ``amp`` re-traces under
     ``amp_guard(amp)`` so the dtype-flow rules see the mixed-precision
     graph. ``select`` restricts to a subset of rule families
-    ({"collective", "dtype", "sharding", "params", "retrace"})."""
+    ({"collective", "dtype", "sharding", "params", "retrace", "feed"}).
+    ``feed_wire`` (a ``FeedWire`` or ``{name: WireSpec}``) maps a
+    wire-typed sample feed to its logical dtypes for the trace and
+    keeps the ``feed:wire-candidate`` rule from re-suggesting fields
+    already carried in a wire format."""
     from ..framework import amp_guard
     import contextlib
 
     report = LintReport(subject=program.name)
+    from ..data.wire import FeedWire
+    feed_wire = FeedWire.make(feed_wire)  # accept a plain {name: WireSpec}
+    if sample_feed and feed_wire is not None:
+        # a wire-typed sample feed (raw uint8 pixels) must trace the
+        # program at its LOGICAL dtype, exactly as Trainer.startup
+        # initializes it — otherwise the trace fails (uint8 into f32
+        # convs) and every jaxpr-level family silently degrades to
+        # analysis:trace-failed
+        sample_feed = feed_wire.logical_feed(sample_feed)
     feed = _concrete_feed(sample_feed)
     fam = (lambda f: select is None or f in select)
 
@@ -95,7 +109,8 @@ def check(
                     rng if rng is not None else make_prng_key(get_flag("seed")),
                     **feed)
             state = state or {}
-            if fam("collective") or fam("dtype") or fam("params"):
+            if fam("collective") or fam("dtype") or fam("params") \
+                    or fam("feed"):
                 closed, invar_names = program.desc_flat(params, state, **feed)
         except Exception as e:
             # a trace that can't run (e.g. a required arg was dropped as
@@ -120,6 +135,10 @@ def check(
             _rules.check_params(program, params, state, (), feed, report,
                                 loss_name=loss_name, closed_flat=closed,
                                 invar_names=invar_names)
+        if fam("feed") and closed is not None:
+            wired = set(feed_wire.specs) if feed_wire is not None else set()
+            _rules.check_feed_wire(closed, invar_names, report,
+                                   already_wired=wired)
     if fam("sharding"):
         _rules.check_sharding(params, mesh, rules, report,
                               param_info=getattr(program, "param_info", None),
@@ -152,7 +171,7 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
     # needs the step's donate_argnums anyway; dtype over the step sees
     # the train path the forward program hides)
     step_dtype = want_dtype and sample_feed is not None
-    inner_select = ({"sharding", "params", "retrace"}
+    inner_select = ({"sharding", "params", "retrace", "feed"}
                     if select is None
                     else set(select) - {"collective", "donation"})
     if step_dtype:
@@ -167,7 +186,8 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
         params=trainer.scope.params, state=trainer.scope.state,
         mesh=trainer.mesh, rules=rules,
         strategy=trainer.strategy, loss_name=trainer.loss_name,
-        select=inner_select, **kwargs)
+        select=inner_select,
+        feed_wire=getattr(trainer, "feed_wire", None), **kwargs)
     report.subject = f"trainer({trainer.program.name})"
     if not (want_coll or want_donation or step_dtype):
         return report
@@ -213,7 +233,8 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
                        params=trainer.scope.params, state=trainer.scope.state,
                        mesh=trainer.mesh, rules=rules,
                        strategy=trainer.strategy, loss_name=trainer.loss_name,
-                       select={"dtype"}, **kwargs)
+                       select={"dtype"},
+                       feed_wire=getattr(trainer, "feed_wire", None), **kwargs)
             report.findings.extend(fb.findings)
         return report
     if want_coll:

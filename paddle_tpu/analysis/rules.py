@@ -20,6 +20,10 @@ scope, mesh/rules, example arguments) and appends :class:`Finding`s to a
   donating the whole training carry).
 - ``retrace:*``    — recompilation hazards in the traced arg signature
   (weak python scalars, unhashable objects).
+- ``feed:*``       — input-pipeline wire-format opportunities: float32
+  feed inputs whose first in-program uses are a cast/normalize could
+  cross the host→device link as uint8/bf16 wire (data/wire.WireSpec)
+  and decode on device for free.
 """
 
 from __future__ import annotations
@@ -461,3 +465,89 @@ def _named_leaves(name: str, val):
         # lists/tuples are reported on the container, not per element
         # (the common hazard is a python list standing in for an array)
         yield name, val
+
+
+# --------------------------------------------------------------------------
+# 7. feed wire-format candidates
+# --------------------------------------------------------------------------
+
+# first-use primitives that prove a feed value is only ever cast or
+# affinely renormalized before real compute touches it — the static
+# evidence it could cross the link in a narrower wire dtype and decode
+# on device (data/wire.py) with identical results
+_WIRE_FIRST_USES = frozenset({"convert_element_type", "add", "sub", "mul",
+                              "div"})
+
+
+def _is_const_like(var, constvar_ids, producers, _depth: int = 0) -> bool:
+    """Literal, trace-time constant, or a broadcast/convert chain over
+    one — the "other operand" shape of a normalize like (x-127)/64."""
+    from .walker import is_literal
+
+    if _depth > 8:
+        return False
+    if is_literal(var) or id(var) in constvar_ids:
+        return True
+    eqn = producers.get(id(var))
+    if eqn is not None and eqn.primitive.name in ("broadcast_in_dim",
+                                                  "convert_element_type",
+                                                  "reshape"):
+        return _is_const_like(eqn.invars[0], constvar_ids, producers,
+                              _depth + 1)
+    return False
+
+
+def check_feed_wire(closed_flat, invar_names, report: LintReport,
+                    already_wired=()) -> None:
+    """``feed:wire-candidate`` — a float32 feed input whose every
+    first use is a dtype cast or a constant affine normalize
+    (``(x - mean) / std`` and friends): the program itself proves the
+    field could ship as uint8 (quantized) or bf16 (truncated) wire —
+    4×/2× fewer host→device bytes — with the decode fused into the step
+    for free. Fields already covered by the trainer's ``feed_wire``
+    table are skipped; integer feeds (labels/ids) are never candidates.
+    """
+    jaxpr = closed_flat.jaxpr
+    constvar_ids = {id(v) for v in getattr(jaxpr, "constvars", ())}
+    producers = producer_map(jaxpr)
+    all_eqns = list(iter_eqns(jaxpr))
+    for var, (kind, name) in zip(jaxpr.invars, invar_names):
+        if kind not in ("arg", "kwarg") or name in already_wired:
+            continue
+        aval = getattr(var, "aval", None)
+        dt = _np_dtype(getattr(aval, "dtype", None)) if aval is not None else None
+        if dt != np.float32:
+            continue
+        consumers = [eqn for eqn, _path in all_eqns
+                     if any(iv is var for iv in eqn.invars)]
+        if not consumers:
+            continue  # dead feed: not this rule's finding
+        casts_only = True
+        for eqn in consumers:
+            pname = eqn.primitive.name
+            if pname not in _WIRE_FIRST_USES:
+                casts_only = False
+                break
+            if pname != "convert_element_type":
+                others = [iv for iv in eqn.invars if iv is not var]
+                if not all(_is_const_like(iv, constvar_ids, producers)
+                           for iv in others):
+                    casts_only = False
+                    break
+        if not casts_only:
+            continue
+        nbytes = aval_bytes(aval)
+        arithmetic = any(e.primitive.name != "convert_element_type"
+                         for e in consumers)
+        suggestion = ("WireSpec.quantize('uint8', scale, zero_point) — ~4x"
+                      if arithmetic else "WireSpec.cast('bfloat16') — 2x")
+        report.add(
+            "feed:wire-candidate", "info",
+            f"feed {name!r} (float32, {nbytes / 1e6:.3f} MB/batch) is only "
+            f"cast/normalized before use ({sorted({e.primitive.name for e in consumers})}) "
+            f"— it can cross the host→device link in a narrower wire dtype "
+            f"with the decode fused into the step: {suggestion} fewer wire "
+            "bytes (Trainer(feed_wire={...}), data/wire.py). Never quantize "
+            "label/id fields.",
+            where=name, bytes_per_batch=nbytes,
+            first_uses=sorted({e.primitive.name for e in consumers}))

@@ -2,15 +2,17 @@
 python/paddle/reader/, python/paddle/dataset/, fluid data_feeder.py,
 operators/reader/*)."""
 
-from . import datasets, feeder, image, reader
-from .feeder import DataFeeder, DeviceFeeder
+from . import datasets, feeder, image, reader, wire
+from .feeder import DataFeeder, DeviceFeeder, PipelineMetrics
 from .reader import (Fake, PipeReader, batch, buffered, cache, chain, compose,
                      fake, firstn, map_readers, multiprocess_reader, shuffle,
                      xmap_readers)
+from .wire import FeedWire, WireSpec
 
 __all__ = [
-    "datasets", "feeder", "reader",
-    "DataFeeder", "DeviceFeeder",
+    "datasets", "feeder", "reader", "wire",
+    "DataFeeder", "DeviceFeeder", "PipelineMetrics",
+    "FeedWire", "WireSpec",
     "batch", "buffered", "cache", "chain", "compose", "firstn",
     "map_readers", "shuffle", "xmap_readers",
 ]

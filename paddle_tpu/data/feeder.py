@@ -18,12 +18,111 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
 from ..core.dtypes import convert_dtype
+
+
+class PipelineMetrics:
+    """Input-pipeline stage accounting (thread-safe): per-stage wall
+    time and byte counters accumulated by :class:`DeviceFeeder` (fill
+    thread: reader / encode / stack / h2d / dispatch-wait) and by
+    ``Trainer._put_feed`` on direct-step paths, surfaced through
+    :meth:`report` / ``Trainer.pipeline_report()``.
+
+    Stages:
+
+    - ``reader``   — waiting on the host reader for the next batch;
+    - ``encode``   — wire-format encode (quantize/cast) of host arrays;
+    - ``stack``    — assembling K batches into a fused-dispatch
+      super-batch;
+    - ``h2d``      — the device put. On the DeviceFeeder fill thread
+      this times the COMPLETED transfer (block_until_ready); the
+      direct-step paths (``Trainer._put_feed`` / ``put_batch``) record
+      submission time only, a lower bound on async backends;
+    - ``dispatch`` — the fill thread blocked on a full prefetch queue,
+      i.e. waiting for the consumer's dispatches to drain (the
+      compute-bound signal).
+
+    ``consumer_starved_s`` is the mirror image: time the training-loop
+    thread waited for a batch (the input-bound signal). ``h2d_bytes``
+    counts WIRE bytes (what actually crossed the link);
+    ``encode_saved_bytes`` accumulates logical-minus-wire so the report
+    can state the reduction honestly."""
+
+    _STAGES = ("reader", "encode", "stack", "h2d", "dispatch")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self.stage_s = {s: 0.0 for s in self._STAGES}
+            self.h2d_bytes = 0
+            self.encode_saved_bytes = 0
+            self.consumer_starved_s = 0.0
+            self.batches = 0
+            self.chunks = 0
+
+    def add(self, stage: str, seconds: float):
+        with self._lock:
+            self.stage_s[stage] += seconds
+
+    def record_encode(self, seconds: float, logical_nbytes: int,
+                      wire_nbytes: int):
+        with self._lock:
+            self.stage_s["encode"] += seconds
+            self.encode_saved_bytes += max(0, logical_nbytes - wire_nbytes)
+
+    def record_h2d(self, nbytes: int, seconds: float):
+        with self._lock:
+            self.stage_s["h2d"] += seconds
+            self.h2d_bytes += nbytes
+            self.chunks += 1
+
+    def record_batch(self, reader_seconds: float):
+        with self._lock:
+            self.stage_s["reader"] += reader_seconds
+            self.batches += 1
+
+    def record_starved(self, seconds: float):
+        with self._lock:
+            self.consumer_starved_s += seconds
+
+    def report(self) -> Dict[str, Any]:
+        """Per-stage attribution + an effective-link estimate:
+        ``h2d_mbps`` is wire bytes over time spent in the put,
+        ``bottleneck`` names the stage with the most accumulated time,
+        and ``input_bound`` says whether the training loop starved for
+        data more than the fill thread waited on it."""
+        with self._lock:
+            stages = dict(self.stage_s)
+            h2d_bytes = self.h2d_bytes
+            saved = self.encode_saved_bytes
+            starved = self.consumer_starved_s
+            batches, chunks = self.batches, self.chunks
+        logical = h2d_bytes + saved
+        h2d_s = stages["h2d"]
+        return {
+            "stages_s": {k: round(v, 6) for k, v in stages.items()},
+            "h2d_bytes": int(h2d_bytes),
+            "logical_bytes": int(logical),
+            "wire_reduction": (round(logical / h2d_bytes, 3)
+                               if h2d_bytes else None),
+            "h2d_mbps": (round(h2d_bytes / 1e6 / h2d_s, 2)
+                         if h2d_s > 0 and h2d_bytes else None),
+            "batches": batches,
+            "chunks": chunks,
+            "consumer_starved_s": round(starved, 6),
+            "bottleneck": max(stages, key=stages.get) if any(
+                v > 0 for v in stages.values()) else None,
+            "input_bound": starved > stages["dispatch"],
+        }
 
 
 class DataFeeder:
@@ -54,6 +153,18 @@ def stack_batches(bufs: Sequence[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray
     return {k: np.stack([np.asarray(b[k]) for b in bufs]) for k in bufs[0]}
 
 
+def host_feed_nbytes(feed: Dict[str, Any]) -> int:
+    """Bytes of the HOST arrays in a feed dict — what a device put of it
+    moves across the link (device-resident arrays count zero: they are
+    already there)."""
+    total = 0
+    for v in feed.values():
+        if isinstance(v, jax.Array):
+            continue
+        total += np.asarray(v).nbytes
+    return total
+
+
 def _stackable(a: Dict[str, np.ndarray], b: Dict[str, np.ndarray]) -> bool:
     """Two batches can share a super-batch: same keys, shapes, dtypes
     (a short final reader batch must not poison the stack)."""
@@ -66,12 +177,13 @@ def _stackable(a: Dict[str, np.ndarray], b: Dict[str, np.ndarray]) -> bool:
     return True
 
 
-def _host_chunks(batches: Iterator[Dict[str, np.ndarray]], k: int):
+def _host_chunks(batches: Iterator[Dict[str, np.ndarray]], k: int,
+                 metrics: Optional[PipelineMetrics] = None):
     """The one chunking state machine both feed paths share: yields
     ``(n, host_feed)`` — full K-chunks stacked (``n == k``),
     remainder/odd-shape batches singly (``n == 1``, unstacked) so they
     fall through to the compiled single-step function with no
-    fused-program retrace."""
+    fused-program retrace. ``metrics`` attributes the stack time."""
     buf: List[Dict[str, np.ndarray]] = []
     for b in batches:
         if buf and not _stackable(buf[0], b):
@@ -80,7 +192,11 @@ def _host_chunks(batches: Iterator[Dict[str, np.ndarray]], k: int):
             buf = []
         buf.append(b)
         if len(buf) == k:
-            yield k, stack_batches(buf)
+            t0 = time.perf_counter()
+            stacked = stack_batches(buf)
+            if metrics is not None:
+                metrics.add("stack", time.perf_counter() - t0)
+            yield k, stacked
             buf = []
     for s in buf:
         yield 1, s
@@ -118,19 +234,80 @@ class DeviceFeeder:
     ``__next__`` — never a bare end-of-iteration that silently truncates
     the epoch. A fill thread that dies without delivering its END
     sentinel is detected by a liveness probe instead of hanging the
-    consumer."""
+    consumer.
+
+    ``encode_fn`` (e.g. ``FeedWire.encode``) runs ON THE FILL THREAD,
+    per batch, BEFORE stacking — wire-format encode and per-field dtype
+    conversion never touch the training-loop thread, and K-chunk
+    stacking operates on the already-shrunk wire arrays. ``metrics``
+    (a :class:`PipelineMetrics`) attributes per-stage time and wire
+    bytes: reader wait, encode, stack, h2d put, and the
+    fill-thread-blocked-on-consumer dispatch wait; pair it with a
+    ``put_fn`` that does not itself record (``Trainer._put_feed``
+    with ``record=False``) or the h2d stage double-counts."""
 
     def __init__(self, batches: Callable[[], Iterator[Dict[str, np.ndarray]]],
                  put_fn: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, jax.Array]]] = None,
                  capacity: int = 2, stack_k: int = 1,
-                 put_stacked_fn: Optional[Callable] = None):
+                 put_stacked_fn: Optional[Callable] = None,
+                 encode_fn: Optional[Callable] = None,
+                 metrics: Optional[PipelineMetrics] = None,
+                 logical_nbytes_fn: Optional[Callable] = None):
         self.batches = batches
         self.put_fn = put_fn or (lambda d: jax.device_put(d))
         self.put_stacked_fn = put_stacked_fn or self.put_fn
         self.capacity = capacity
         self.stack_k = max(1, int(stack_k))
+        self.encode_fn = encode_fn
+        self.metrics = metrics
+        # spec-aware logical-byte counter (FeedWire.logical_nbytes):
+        # counts already-wire-dtype reader output at its DECODED width
+        # so wire_reduction reports the true link saving
+        self.logical_nbytes_fn = logical_nbytes_fn or host_feed_nbytes
         self._stops: List[threading.Event] = []
         self._threads: List[threading.Thread] = []
+
+    def pipeline_report(self) -> Optional[Dict[str, Any]]:
+        """The accumulated :meth:`PipelineMetrics.report`, or None when
+        the feeder was built without metrics."""
+        return self.metrics.report() if self.metrics is not None else None
+
+    def _instrumented_batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        """Fill-thread source: times the reader wait per batch and runs
+        the wire encode (host numpy) before chunk assembly."""
+        m, enc = self.metrics, self.encode_fn
+        it = iter(self.batches())
+        while True:
+            t0 = time.perf_counter()
+            try:
+                b = next(it)
+            except StopIteration:
+                return
+            if m is not None:
+                m.record_batch(time.perf_counter() - t0)
+            if enc is not None:
+                t0 = time.perf_counter()
+                logical = self.logical_nbytes_fn(b) if m is not None else 0
+                b = enc(b)
+                if m is not None:
+                    m.record_encode(time.perf_counter() - t0, logical,
+                                    host_feed_nbytes(b))
+            yield b
+
+    def _timed_put(self, fn, host_feed):
+        if self.metrics is None:
+            return fn(host_feed)
+        nbytes = host_feed_nbytes(host_feed)
+        t0 = time.perf_counter()
+        out = fn(host_feed)
+        # device_put is ASYNC on accelerators: wait for the transfer so
+        # h2d_mbps measures the link, not the submission. This blocks
+        # only the fill thread — the capacity queue keeps the consumer
+        # overlapped — and is what makes the report's bottleneck
+        # attribution honest on a slow host→device link.
+        jax.block_until_ready(out)
+        self.metrics.record_h2d(nbytes, time.perf_counter() - t0)
+        return out
 
     def close(self):
         """Cancel every live fill thread (idempotent). Threads parked on
@@ -149,12 +326,19 @@ class DeviceFeeder:
         stop = threading.Event()
         self._stops.append(stop)
 
-        def put(item) -> bool:
+        metrics = self.metrics
+
+        def put(item, timed: bool = True) -> bool:
             # bounded-wait put: a consumer that stopped consuming must
-            # not strand this thread (and its device buffers) forever
+            # not strand this thread (and its device buffers) forever.
+            # Time blocked here is the DISPATCH WAIT — the consumer's
+            # device dispatches are what drains the queue.
+            t0 = time.perf_counter()
             while not stop.is_set():
                 try:
                     q.put(item, timeout=0.1)
+                    if timed and metrics is not None:
+                        metrics.add("dispatch", time.perf_counter() - t0)
                     return True
                 except _queue.Full:
                     continue
@@ -163,23 +347,25 @@ class DeviceFeeder:
         def fill():
             try:
                 if self.stack_k > 1:
-                    for n, hb in _host_chunks(self.batches(), self.stack_k):
+                    for n, hb in _host_chunks(self._instrumented_batches(),
+                                              self.stack_k, metrics=metrics):
                         if stop.is_set():
                             return
-                        item = (n, self.put_stacked_fn(hb) if n > 1
-                                else self.put_fn(hb))
+                        item = (n, self._timed_put(self.put_stacked_fn, hb)
+                                if n > 1 else self._timed_put(self.put_fn, hb))
                         if not put(item):
                             return
                 else:
-                    for b in self.batches():
+                    for b in self._instrumented_batches():
                         if stop.is_set():
                             return
-                        if not put(self.put_fn(b)):
+                        if not put(self._timed_put(self.put_fn, b)):
                             return
             except BaseException as e:  # surfaced on the consumer side
                 err.append(e)
             finally:
-                if not put(END):
+                # END delivery is shutdown, not dispatch wait — untimed
+                if not put(END, timed=False):
                     # stop was set (close() possibly from ANOTHER thread
                     # than the consumer): a consumer still parked in
                     # q.get() must not hang — if it is parked, the queue
@@ -194,9 +380,17 @@ class DeviceFeeder:
         t.start()
         try:
             while True:
+                t_wait = time.perf_counter()
                 try:
                     item = q.get(timeout=0.5)
+                    # starvation accounting: the training loop waited
+                    # this long for input (END arrival is shutdown, not
+                    # starvation — skip it below)
+                    if metrics is not None and item is not END:
+                        metrics.record_starved(time.perf_counter() - t_wait)
                 except _queue.Empty:
+                    if metrics is not None:
+                        metrics.record_starved(time.perf_counter() - t_wait)
                     # liveness check: a fill thread that died without
                     # managing to enqueue END (its sentinel put lost a
                     # race with close()) must not hang the consumer —

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import time as _time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -222,6 +223,7 @@ class Trainer:
         donate: bool = True,
         fetch_list: Optional[Sequence[str]] = None,
         guard=None,
+        feed_wire=None,
     ):
         self.program = program
         self.optimizer = optimizer
@@ -271,6 +273,13 @@ class Trainer:
         self._guard = None            # resolved policy (build time)
         self._guard_bit_names = ()    # bitmask bit -> checked-value name
         self._guard_pending = None    # (mask, feed, base_step, k) to examine
+        # feed wire formats (data/wire.py): host-side encode in
+        # _put_feed / the DeviceFeeder fill thread, device-side decode
+        # traced into the step program (fused — no extra dispatch)
+        from .data.feeder import PipelineMetrics
+        from .data.wire import FeedWire
+        self.feed_wire = FeedWire.make(feed_wire)
+        self.pipeline_metrics = PipelineMetrics()
         self.loss_scaler = None
         if strategy is not None and (getattr(strategy, "loss_scale", None)
                                      or getattr(strategy, "dynamic_loss_scale", False)):
@@ -298,6 +307,11 @@ class Trainer:
         if rng is None:
             rng = make_prng_key(get_flag("seed"))
         feed = {k: _abstractify(v) for k, v in (sample_feed or {}).items()}
+        if self.feed_wire is not None:
+            # a wire-typed sample feed (raw uint8 pixels) initializes
+            # the model at its LOGICAL dtype — the decode runs before
+            # the model ever sees the feed
+            feed = self.feed_wire.logical_feed(feed)
         params, state = self.program.init(rng, **feed)
         params = self._interleave_stacked_params(params)
         sd = getattr(self.strategy, "opt_state_dtype", None) if self.strategy else None
@@ -594,6 +608,12 @@ class Trainer:
     def _build_step(self):
         accum_steps = getattr(self.strategy, "accum_steps", 1) if self.strategy else 1
         scaler = self.loss_scaler
+        # wire-format decode is resolved ONCE here, like the guard: the
+        # dequant/cast is traced into the step program and fused by XLA
+        # into the first consumers — the feed crosses the link in the
+        # wire dtype and costs no extra device launch to decode. Use
+        # set_feed_wire() to change it after startup (rebuilds).
+        wire = self.feed_wire
         # validate the exchange mode UNCONDITIONALLY: a typo'd or
         # inapplicable knob must fail loudly, never silently no-op
         # (the _warn_unconsumed lesson)
@@ -625,6 +645,8 @@ class Trainer:
 
         def train_step(params, opt_state, state, rng, feed, ls):
             self._trace_count += 1  # trace-time only: counts compilations
+            if wire is not None:
+                feed = wire.decode(feed)
             def loss_and_aux(p, st, r, f):
                 loss, aux = self._loss_and_aux(p, st, r, f)
                 if scaler is not None:
@@ -767,6 +789,8 @@ class Trainer:
             self._multi_step_fn = jax.jit(run_k_steps, donate_argnums=kdonate)
 
         def eval_step(params, state, feed):
+            if wire is not None:
+                feed = wire.decode(feed)
             # With the interleaved rest layout (pp_interleave>1) the
             # stacked rows are only meaningful through the pipeline
             # schedule, so eval must enter the same pipeline ctx as
@@ -1027,17 +1051,71 @@ class Trainer:
         feed = self._put_feed(feed)
         return self._eval_fn(self.scope.params, self.scope.state, feed)
 
-    def _put_feed(self, feed: Feed, stacked: bool = False):
-        """Place a feed on device/mesh. ``stacked=True``: the feed is a
-        K-step super-batch ``(K, batch, ...)`` — the steps axis stays
-        replicated, the batch sharding applies from dim 1."""
+    def set_feed_wire(self, feed_wire) -> None:
+        """Install (or change) the feed wire-format table. Before
+        ``startup`` this is equivalent to the constructor arg; after,
+        the step/eval programs are rebuilt so the decode is traced into
+        them (one recompile on the next dispatch)."""
+        from .data.wire import FeedWire
+        wire = FeedWire.make(feed_wire)
+        if wire == self.feed_wire:
+            return
+        self.feed_wire = wire
+        if self._step_fn is not None:
+            self._build_step()
+
+    def pipeline_report(self) -> Dict[str, Any]:
+        """Input-pipeline stage attribution accumulated since startup
+        (or the last ``pipeline_metrics.reset()``): per-stage seconds
+        (reader/encode/stack/h2d/dispatch), wire vs logical bytes, the
+        effective h2d MB/s estimate, and the bottleneck stage. Fed by
+        the DeviceFeeder fill thread under ``fit`` and by ``_put_feed``
+        on direct ``step()``/``run_steps()`` calls."""
+        return self.pipeline_metrics.report()
+
+    def _put_feed(self, feed: Feed, stacked: bool = False,
+                  record: bool = True):
+        """Wire-encode (host side) and place a feed on device/mesh.
+        ``stacked=True``: the feed is a K-step super-batch
+        ``(K, batch, ...)`` — the steps axis stays replicated, the batch
+        sharding applies from dim 1. Fields covered by ``feed_wire``
+        cross the link in their wire dtype; already-encoded arrays (the
+        DeviceFeeder fill thread encodes before stacking) pass through.
+        ``record=False`` suppresses the pipeline-metrics accounting —
+        used when a DeviceFeeder owns the timing of this call."""
+        metrics = self.pipeline_metrics if record else None
+        if self.feed_wire is not None:
+            t0 = _time.perf_counter()
+            encoded = self.feed_wire.encode(feed)
+            if metrics is not None:
+                host = {k: v for k, v in feed.items()
+                        if not isinstance(v, jax.Array)}
+                if host:
+                    # logical bytes are spec-aware: a reader that
+                    # already produces wire-dtype data (raw uint8
+                    # pixels) still counts at the decode dtype's width,
+                    # so wire_reduction states the true link saving
+                    logical = self.feed_wire.logical_nbytes(host)
+                    wire_b = sum(np.asarray(encoded[k]).nbytes
+                                 for k in host)
+                    metrics.record_encode(_time.perf_counter() - t0,
+                                          logical, wire_b)
+            feed = encoded
         if self.mesh is not None:
             from .parallel import api as par_api
             return par_api.put_batch(self.mesh, self.sharding_rules, feed,
-                                     stacked=stacked)
+                                     stacked=stacked, metrics=metrics)
         dev = self.place.device()
-        return {k: jax.device_put(np.asarray(v) if not isinstance(v, jax.Array) else v, dev)
-                for k, v in feed.items()}
+        host_bytes = 0
+        if metrics is not None:
+            from .data.feeder import host_feed_nbytes
+            host_bytes = host_feed_nbytes(feed)
+            t0 = _time.perf_counter()
+        out = {k: jax.device_put(np.asarray(v) if not isinstance(v, jax.Array) else v, dev)
+               for k, v in feed.items()}
+        if metrics is not None and host_bytes:
+            metrics.record_h2d(host_bytes, _time.perf_counter() - t0)
+        return out
 
 
 class CheckpointConfig:
@@ -1059,23 +1137,29 @@ class Event:
     ``num_steps`` optimizer steps and the end_step ``metrics`` arrays
     carry a leading ``(num_steps, ...)`` axis — see MIGRATION.md
     "Fused stepping". A ``"preempted"`` event fires once after the
-    boundary checkpoint when fit exits on SIGTERM/SIGINT."""
+    boundary checkpoint when fit exits on SIGTERM/SIGINT.
+
+    ``pipeline`` carries the input-pipeline stage report
+    (``Trainer.pipeline_report()``) on ``end_epoch``/``preempted``
+    events — per-stage time, wire bytes, h2d MB/s, bottleneck stage."""
 
     def __init__(self, kind: str, epoch: int, step: int, metrics=None,
-                 num_steps: int = 1):
+                 num_steps: int = 1, pipeline=None):
         # begin_epoch | end_epoch | begin_step | end_step | preempted
         self.kind = kind
         self.epoch = epoch
         self.step = step
         self.metrics = metrics or {}
         self.num_steps = num_steps
+        self.pipeline = pipeline
 
 
 def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
         dtypes: Optional[Sequence[Any]] = None, event_handler=None,
         checkpoint_config: Optional[CheckpointConfig] = None,
         prefetch: bool = True, steps_per_dispatch: int = 1,
-        resume: bool = False, preemption: Optional[bool] = None):
+        resume: bool = False, preemption: Optional[bool] = None,
+        feed_wire=None):
     """High-level train loop (contrib.trainer.Trainer.train analog):
     reader → DataFeeder → (optional double-buffered prefetch) →
     trainer.step, with event callbacks and periodic checkpoints.
@@ -1088,6 +1172,16 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
     (remainder batches run singly through ``trainer.step``), and
     ``step_interval`` checkpoints round forward to the chunk boundary
     that crossed the interval. See MIGRATION.md "Fused stepping".
+
+    ``feed_wire={name: WireSpec}`` (or a FeedWire) installs feed wire
+    formats (MIGRATION.md "Feed wire formats"): the fill thread encodes
+    each batch to its wire dtype (uint8/int8 quantized, bf16/f16
+    truncated) BEFORE stacking, the transfer carries the shrunk bytes,
+    and the compiled step decodes on device with no extra launch.
+    Per-stage pipeline metrics (reader/encode/stack/h2d/dispatch wait,
+    wire bytes, effective link MB/s) accumulate either way and ride the
+    ``end_epoch``/``preempted`` events as ``Event.pipeline``
+    (``trainer.pipeline_report()`` at any time).
 
     **Fault tolerance** (MIGRATION.md "Fault tolerance & resume"):
 
@@ -1116,6 +1210,8 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
 
     _enforce(steps_per_dispatch >= 1,
              f"fit(steps_per_dispatch={steps_per_dispatch}): need >= 1")
+    if feed_wire is not None:
+        trainer.set_feed_wire(feed_wire)
     feeder = DataFeeder(feed_names, dtypes)
 
     start_epoch, skip_steps = 0, 0
@@ -1185,11 +1281,23 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
 
             device_feeder = None
             if prefetch:
+                # the feeder owns the stage timing (put_fn record=False
+                # so h2d isn't double-counted) and runs the wire encode
+                # on the fill thread, per batch, before stacking
                 device_feeder = DeviceFeeder(
-                    batches, put_fn=trainer._put_feed,
+                    batches,
+                    put_fn=functools.partial(trainer._put_feed,
+                                             record=False),
                     stack_k=steps_per_dispatch,
                     put_stacked_fn=functools.partial(trainer._put_feed,
-                                                     stacked=True))
+                                                     stacked=True,
+                                                     record=False),
+                    encode_fn=(trainer.feed_wire.encode
+                               if trainer.feed_wire is not None else None),
+                    metrics=trainer.pipeline_metrics,
+                    logical_nbytes_fn=(trainer.feed_wire.logical_nbytes
+                                       if trainer.feed_wire is not None
+                                       else None))
                 iterator = iter(device_feeder)
             elif steps_per_dispatch > 1:
                 iterator = iter_chunked(
@@ -1252,12 +1360,14 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
                 _io.wait_for_checkpoints()
                 if event_handler:
                     event_handler(Event("preempted", epoch,
-                                        trainer.global_step))
+                                        trainer.global_step,
+                                        pipeline=trainer.pipeline_report()))
                 if guard_err is not None:
                     raise guard_err
                 return trainer
             if event_handler:
-                event_handler(Event("end_epoch", epoch, trainer.global_step))
+                event_handler(Event("end_epoch", epoch, trainer.global_step,
+                                    pipeline=trainer.pipeline_report()))
             if checkpoint_config and checkpoint_config.epoch_interval and \
                     (epoch + 1) % checkpoint_config.epoch_interval == 0:
                 save(f"epoch_{epoch}", epoch + 1, 0)

@@ -57,7 +57,7 @@ def shard_scope(mesh: Mesh, rules: Optional[ShardingRules], params, state, opt_s
 
 
 def put_batch(mesh: Mesh, rules: Optional[ShardingRules], feed: Dict[str, Any],
-              stacked: bool = False):
+              stacked: bool = False, metrics=None):
     """Shard a host batch over the data axes (DataFeeder.feed_parallel
     analog, data_feeder.py:201 — without the per-device split loop).
 
@@ -72,10 +72,28 @@ def put_batch(mesh: Mesh, rules: Optional[ShardingRules], feed: Dict[str, Any],
     DeviceFeeder) — the steps axis is replicated and the per-step batch
     sharding applies from dim 1, so ONE transfer stages K steps of data
     exactly as K separate ``put_batch`` calls would have.
+
+    Wire-encoded feeds (data/wire.py) need no special casing — the
+    batch spec keys on shape, not dtype, so a uint8/bf16 wire array
+    shards exactly like its fp32 logical counterpart. ``metrics`` (a
+    ``data.feeder.PipelineMetrics``) records the h2d stage: the HOST
+    bytes actually handed to the runtime (wire bytes; the honest
+    numerator for link-MB/s estimates — per process, its local shard)
+    and the put SUBMISSION wall time — a lower bound on async backends;
+    the DeviceFeeder fill-thread path times completed transfers.
+    Device-resident inputs count zero bytes.
     """
+    import time as _time
+
     rules = _rules(rules, mesh)
     multiproc = jax.process_count() > 1
     out = {}
+    host_bytes = 0
+    t0 = 0.0
+    if metrics is not None:
+        from ..data.feeder import host_feed_nbytes
+        host_bytes = host_feed_nbytes(feed)
+        t0 = _time.perf_counter()
     for k, v in feed.items():
         arr = np.asarray(v) if not isinstance(v, jax.Array) else v
         if stacked:
@@ -102,6 +120,8 @@ def put_batch(mesh: Mesh, rules: Optional[ShardingRules], feed: Dict[str, Any],
             out[k] = jax.make_array_from_process_local_data(ns, arr, global_shape)
         else:
             out[k] = jax.device_put(arr, ns)
+    if metrics is not None and host_bytes:
+        metrics.record_h2d(host_bytes, _time.perf_counter() - t0)
     return out
 
 

@@ -27,7 +27,8 @@ def test_priority_order_leads_with_baseline_configs():
     assert names[8] == "gpt"
     # every registered config appears exactly once
     expect = (set(bench.TRAIN_CONFIGS) | set(bench.INFER_CONFIGS)
-              | {"gpt_decode", "dispatch_overhead", "guard_overhead"})
+              | {"gpt_decode", "dispatch_overhead", "guard_overhead",
+                 "input_pipeline"})
     assert set(names) == expect and len(names) == len(expect)
 
 
@@ -82,6 +83,48 @@ def test_guard_overhead_quick_overrides(monkeypatch):
                         lambda peak, **kw: seen.update(kw) or {"v": 1})
     bench._run_one("guard_overhead", 1.0, quick=True)
     assert seen == {"iters": 8, "k": 4}
+
+
+def test_input_pipeline_quick_overrides(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(bench, "bench_input_pipeline",
+                        lambda peak, **kw: seen.update(kw) or {"v": 1})
+    bench._run_one("input_pipeline", 1.0, quick=True)
+    assert seen == {"iters": 8, "k": 4}
+    assert bench._result_key("input_pipeline") == "input_pipeline"
+
+
+def test_input_pipeline_row_schema(monkeypatch):
+    """The input_pipeline row (fp32 vs bf16 vs uint8 wire at K=1/K=16)
+    pins its schema here: the driver's round records are read by byte
+    math downstream, so the wire/logical byte fields and the per-cell
+    step-time keys must not silently drift. Timing and Trainer are
+    stubbed — the byte math is pure python."""
+    monkeypatch.setattr(bench, "_time_trainer",
+                        lambda tr, feeds, **kw: (1e-3, 1e-3))
+
+    class _T:
+        feed_wire = None
+
+        def startup(self, **kw):
+            pass
+
+    import paddle_tpu as pt
+    monkeypatch.setattr(pt, "Trainer", lambda *a, **kw: _T())
+    row = bench.bench_input_pipeline(1.0, batch_size=8, iters=2, k=2)
+    for key in ("value", "unit", "step_time_ms", "feed_wire_bytes_per_step",
+                "feed_logical_bytes_per_step", "steps_per_dispatch",
+                "speedup_uint8_vs_fp32_k1", "speedup_uint8_vs_fp32_fused",
+                "speedup_bf16_vs_fp32_fused"):
+        assert key in row, key
+    assert row["steps_per_dispatch"] == 2  # names the K "fused" measured
+    # the acceptance lever: uint8 wire cuts >= 3.5x off the fp32 bytes
+    assert row["value"] >= 3.5
+    b = row["feed_wire_bytes_per_step"]
+    assert b["fp32"] > b["bf16"] > b["uint8"]
+    assert set(row["step_time_ms"]) == {f"{v}_k{kk}" for v in
+                                        ("fp32", "bf16", "uint8")
+                                        for kk in (1, 2)}
 
 
 def test_assemble_headline_and_partial_shape():
