@@ -40,6 +40,7 @@ import argparse
 import contextlib
 import functools
 import json
+import os
 import time
 
 import numpy as np
@@ -701,6 +702,123 @@ def bench_input_pipeline(peak, batch_size=256, iters=24, k=16):
     }
 
 
+def _serving_predictors(batch_size):
+    """Export the MNIST MLP at fp32 and through the real int8 datapath;
+    {variant: (Predictor, feed)}. Untrained weights — this row measures
+    the serving runtime, not the model."""
+    import contextlib
+    import tempfile
+
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import io as pio, quantize
+    from paddle_tpu.models import mnist
+
+    prog = pt.build(mnist.mlp)
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.randn(batch_size, 784).astype(np.float32),
+            "label": rng.randint(0, 10, (batch_size, 1)).astype(np.int64)}
+    params, state = prog.init(jax.random.PRNGKey(0), **feed)
+    out = {}
+    for variant in ("fp32", "int8"):
+        ctx = (quantize.int8_serving() if variant == "int8"
+               else contextlib.nullcontext())
+        d = os.path.join(tempfile.mkdtemp(), "model")
+        with ctx:
+            pio.save_inference_model(d, prog, params, state, feed)
+        out[variant] = (pio.load_inference_model(d), feed)
+    return out
+
+
+def _make_server(pred, workers, queue_size):
+    from paddle_tpu import serving
+
+    return serving.PredictorServer(pred, workers=workers,
+                                   queue_size=queue_size)
+
+
+def _calibrate_serving(server, feed, iters=8):
+    """Mean per-request service time through the full server path."""
+    for _ in range(2):
+        server.run(feed, timeout=120)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        server.run(feed, timeout=120)
+    return (time.perf_counter() - t0) / iters
+
+
+def _drive_serving(server, feed, n, rate):
+    """Open-loop driver: ``n`` submits at fixed offered ``rate`` req/s
+    (no backpressure from the client — rejected submits don't slow the
+    arrival process). Returns (per-request latencies of completed
+    requests in seconds, rejected count)."""
+    from paddle_tpu import serving
+
+    pending, rejected = [], 0
+    interval = 1.0 / rate
+    next_t = time.perf_counter()
+    for _ in range(n):
+        now = time.perf_counter()
+        if now < next_t:
+            time.sleep(next_t - now)
+        next_t += interval
+        try:
+            pending.append(server.submit(feed))
+        except serving.ServerOverloaded:
+            rejected += 1
+    lats = []
+    for p in pending:
+        p.result(timeout=120)
+        lats.append(p.latency)
+    return lats, rejected
+
+
+def bench_serving(peak, batch_size=64, requests=240, workers=2,
+                  queue_size=16):
+    """Serving-runtime suite row: end-to-end p50/p99 latency through
+    ``PredictorServer`` (bounded queue + validation + AOT predictor
+    pool) at a fixed offered load of 0.6x measured capacity, plus the
+    reject rate with the queue saturated at 3x capacity — fp32 vs the
+    real int8 datapath. ``value`` is the fp32 steady-state p99 in ms;
+    the saturated phase proves overload sheds (typed rejects) instead
+    of queueing without bound."""
+    latency = {}
+    reject_rate = {}
+    offered = {}
+    for variant, (pred, feed) in sorted(_serving_predictors(batch_size).items()):
+        server = _make_server(pred, workers, queue_size)
+        try:
+            svc = _calibrate_serving(server, feed)
+            capacity = workers / svc            # req/s the pool sustains
+            steady_rate = max(1.0, 0.6 * capacity)
+            lats, _ = _drive_serving(server, feed, requests, steady_rate)
+            sat_rate = 3.0 * capacity
+            _, rejected = _drive_serving(server, feed, requests, sat_rate)
+        finally:
+            server.close(drain=True, timeout=120)
+        lat = np.array(lats)
+        latency[variant] = {
+            "p50": round(float(np.percentile(lat, 50)) * 1e3, 4),
+            "p99": round(float(np.percentile(lat, 99)) * 1e3, 4),
+        }
+        reject_rate[variant] = round(rejected / requests, 4)
+        offered[variant] = {"steady_rps": round(steady_rate, 2),
+                            "saturated_rps": round(sat_rate, 2)}
+    return {
+        "value": latency["fp32"]["p99"],
+        "unit": f"ms p99 steady-state served latency (fp32, bs={batch_size}, "
+                "0.6x capacity offered load)",
+        "latency_ms": latency,
+        "reject_rate_saturated": reject_rate,
+        "offered_rps": offered,
+        "requests": requests,
+        "workers": workers,
+        "queue_size": queue_size,
+        "batch_size": batch_size,
+    }
+
+
 def bench_mnist_mlp(peak, batch_size=128, iters=50):
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
@@ -971,7 +1089,8 @@ def _suite_names():
     import os
 
     names = [*TRAIN_CONFIGS, *INFER_CONFIGS, "gpt_decode",
-             "dispatch_overhead", "guard_overhead", "input_pipeline"]
+             "dispatch_overhead", "guard_overhead", "input_pipeline",
+             "serving"]
     # the BASELINE five first, then the reference's headline serving
     # rows, then gpt — a driver that kills the suite early (the partial
     # SIGTERM record) still captures the configs that matter most
@@ -1029,6 +1148,10 @@ def _run_one(name: str, peak: float, quick: bool = False, batch_size=None):
         if quick:
             kw.update(iters=8, k=4)
         return bench_input_pipeline(peak, **kw)
+    if name == "serving":
+        if quick:
+            kw.update(requests=40)
+        return bench_serving(peak, **kw)
     raise ValueError(f"unknown config {name}")
 
 

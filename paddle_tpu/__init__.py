@@ -11,8 +11,9 @@ decoding, and a StableHLO inference/export path.
 
 from . import analysis, backward, clip, core, data, debugger, evaluator, framework, initializer
 from . import io, layers, lr_scheduler, metrics, models, nets, optimizer
-from . import parallel, quantize, regularizer, resilience, sparse, transpiler
+from . import parallel, quantize, regularizer, resilience, serving, sparse, transpiler
 from .resilience import CheckpointCorrupt, GuardPolicy, PreemptionHandler
+from .serving import PredictorServer
 from .core import CPUPlace, CUDAPlace, Place, TPUPlace, default_place
 from .executor import CheckpointConfig, Event, Executor, Inferencer, Scope, Trainer, fit
 from .framework import (

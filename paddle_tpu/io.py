@@ -18,16 +18,34 @@ reshapes between save and load work by construction.
 from __future__ import annotations
 
 import json
+import logging
 import os
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .core.errors import enforce
+from .core.errors import EnforceError, enforce
 
 SEP = "||"  # path separator for nested pytree keys (param names use '/')
+
+
+def _log():
+    return logging.getLogger("paddle_tpu.io")
+
+
+class InvalidRequest(EnforceError, ValueError):
+    """A serving/inference feed failed structural validation: missing or
+    extra feed key, shape or dtype mismatch, off-bucket batch size, or a
+    non-finite payload. Carries ``field`` (the offending feed name) and
+    ``reason`` so servers can answer with a structured error instead of
+    a raw ``KeyError`` or an XLA abort."""
+
+    def __init__(self, field: str, reason: str):
+        super().__init__(f"invalid request: feed {field!r} {reason}")
+        self.field = field
+        self.reason = reason
 
 # numpy's npz format stores ml_dtypes extension types (bfloat16, fp8) as
 # raw void bytes that can't round-trip; encode them as a same-width
@@ -399,34 +417,146 @@ def _in_spec(flat_sources, exported):
             for (src, name), av in zip(flat_sources, exported.in_avals)]
 
 
+def _recover_renamed_aside(path: str) -> None:
+    """Crash recovery for the two-rename overwrite window: a save that
+    died between rename-aside and commit leaves the only good artifact
+    at ``<path>.tmp.<pid>.old`` with nothing at ``path``. Restore it
+    BEFORE the tmp sweep — the sweep's ``<tag>.tmp.*`` pattern would
+    otherwise delete the sole surviving copy while the replacement save
+    could still fail before committing."""
+    from . import resilience
+
+    if os.path.isdir(path):
+        return
+    olds = sorted(p for p in
+                  (os.path.join(os.path.dirname(path), n)
+                   for n in os.listdir(os.path.dirname(path) or "."))
+                  if p.startswith(f"{path}{resilience.TMP_MARKER}")
+                  and p.endswith(".old") and os.path.isdir(p))
+    if not olds:
+        return
+    newest = max(olds, key=os.path.getmtime)
+    os.rename(newest, path)
+    _log().warning("recovered artifact %s from interrupted overwrite (%s)",
+                   path, os.path.basename(newest))
+
+
+def _infer_batch_info(example_feed: Dict[str, Any]) -> Tuple[int, List[str]]:
+    """(batch_size, batched_feed_names) of an example feed: the batch is
+    the leading dim of the first (sorted) non-scalar feed; every feed
+    sharing that leading dim is treated as batched — the axis shape
+    buckets and request padding operate on."""
+    batch = 0
+    for k in sorted(example_feed):
+        v = np.asarray(example_feed[k])
+        if v.ndim >= 1:
+            batch = int(v.shape[0])
+            break
+    batched = [k for k in sorted(example_feed)
+               if np.asarray(example_feed[k]).ndim >= 1
+               and np.asarray(example_feed[k]).shape[0] == batch]
+    return batch, batched
+
+
+def _resize_batch(v: np.ndarray, n: int) -> np.ndarray:
+    """Example feed at a different bucket size: slice down or tile up
+    along dim 0 (values only seed the trace — shapes/dtypes matter)."""
+    if v.shape[0] >= n:
+        return np.ascontiguousarray(v[:n])
+    reps = -(-n // v.shape[0])  # ceil
+    return np.ascontiguousarray(
+        np.concatenate([v] * reps, axis=0)[:n])
+
+
 def save_inference_model(dirname: str, program, params: Dict[str, jax.Array],
-                         state: Dict[str, jax.Array], example_feed: Dict[str, Any]) -> None:
+                         state: Dict[str, jax.Array], example_feed: Dict[str, Any],
+                         batch_buckets: Optional[Sequence[int]] = None) -> None:
     """Export program.apply (inference mode, params baked as inputs) as a
     serialized StableHLO artifact + weights (io.py:544 analog: prune to
-    feed/fetch + serialize ProgramDesc + save params)."""
-    os.makedirs(dirname, exist_ok=True)
+    feed/fetch + serialize ProgramDesc + save params).
+
+    **Atomic + validated commit** (the ``save_trainer`` discipline
+    applied to deployment artifacts): everything is written to a
+    ``<dirname>.tmp.<pid>`` sibling, fsynced, covered by a
+    ``resilience.write_manifest`` manifest (per-file CRC32 + size, flat
+    shape/dtype spec of the weight collections), and renamed into place.
+    A crash mid-EXPORT leaves the previous artifact committed; when
+    OVERWRITING an existing artifact the old one is renamed aside first,
+    so the only no-artifact-at-``dirname`` window is two renames wide
+    (a crash inside it preserves the old artifact under a ``.tmp.*.old``
+    marker, and a concurrent loader fails loudly rather than reading a
+    torn tree). ``load_inference_model`` / a hot-reloading
+    ``serving.PredictorServer`` reject torn or bit-flipped artifacts
+    with a structured :class:`~paddle_tpu.resilience.CheckpointCorrupt`.
+
+    ``batch_buckets`` exports ADDITIONAL fixed batch sizes of the same
+    program (``model.b{N}.stablehlo`` siblings): the precompiled shape
+    bucket set a :class:`~paddle_tpu.serving.PredictorServer` pads
+    ragged request batches up to, so adversarial batch shapes can never
+    trigger a recompile on the request path. The example feed's own
+    batch size is always a bucket."""
+    import shutil
+
+    import jax.export  # noqa: F401  (jax 0.4.x: submodule needs explicit import)
+
+    from . import resilience
+
     feed_names = sorted(example_feed)
+    batch, batched_feeds = _infer_batch_info(example_feed)
+    buckets = sorted(set(int(b) for b in (batch_buckets or [])) | {batch})
+    enforce(all(b > 0 for b in buckets),
+            f"batch_buckets must be positive, got {buckets}")
 
     def infer_fn(params_, state_, *feed_vals):
         feed = dict(zip(feed_names, feed_vals))
         out, _ = program.apply(params_, state_, training=False, **feed)
         return out
 
-    example_vals = [jnp.asarray(np.asarray(example_feed[k])) for k in feed_names]
     host_params, host_state = jax.device_get(params), jax.device_get(state)
-    exported = jax.export.export(jax.jit(infer_fn))(
-        host_params, host_state, *example_vals)
-    with open(os.path.join(dirname, "model.stablehlo"), "wb") as f:
+
+    def _export_at(feed):
+        vals = [jnp.asarray(np.asarray(feed[k])) for k in feed_names]
+        return jax.export.export(jax.jit(infer_fn))(
+            host_params, host_state, *vals)
+
+    exported = _export_at(example_feed)
+    bucket_exports = {}
+    for b in buckets:
+        if b == batch:
+            continue
+        bucket_exports[b] = _export_at(
+            {k: (_resize_batch(np.asarray(v), b) if k in batched_feeds
+                 else np.asarray(v))
+             for k, v in example_feed.items()})
+
+    path = os.path.abspath(dirname)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    _recover_renamed_aside(path)
+    resilience.sweep_tmp_dirs(parent, tag=os.path.basename(path))
+    tmp = f"{path}{resilience.TMP_MARKER}{os.getpid()}"
+    os.makedirs(tmp)
+
+    with open(os.path.join(tmp, "model.stablehlo"), "wb") as f:
         f.write(exported.serialize())
-    np.savez(os.path.join(dirname, "params.npz"), **_flatten(host_params))
-    np.savez(os.path.join(dirname, "state.npz"), **_flatten(host_state))
+    for b, exp in bucket_exports.items():
+        with open(os.path.join(tmp, f"model.b{b}.stablehlo"), "wb") as f:
+            f.write(exp.serialize())
+    flat_params, flat_state = _flatten(host_params), _flatten(host_state)
+    np.savez(os.path.join(tmp, "params.npz"), **flat_params)
+    np.savez(os.path.join(tmp, "state.npz"), **flat_state)
+    arrays_spec = {name: {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                          for k, v in flat.items()}
+                   for name, flat in (("params.npz", flat_params),
+                                      ("state.npz", flat_state))}
     # Python-free deployment artifact (inference/io.h:35 analog): the raw
     # StableHLO bytecode plus the flat call signature, so native/
     # predictor.cc can compile+run through the PJRT C API with no
     # libpython. Inputs are the flattened (params, state, *feeds) leaves
     # in exported.in_avals order; "source" tells the C++ loader which
     # npz member (or feed) supplies each argument.
-    with open(os.path.join(dirname, "model.mlir"), "wb") as f:
+    with open(os.path.join(tmp, "model.mlir"), "wb") as f:
         f.write(exported.mlir_module_serialized)
     param_leaves = _flat_leaves_in_tree_order(host_params)
     state_leaves = _flat_leaves_in_tree_order(host_state)
@@ -448,9 +578,32 @@ def save_inference_model(dirname: str, program, params: Dict[str, jax.Array],
                     f" aval expects {av.dtype}")
     out_spec = [{"dtype": str(av.dtype), "shape": list(av.shape)}
                 for av in exported.out_avals]
-    with open(os.path.join(dirname, "meta.json"), "w") as f:
-        json.dump({"feed_names": feed_names, "inputs": in_spec,
-                   "outputs": out_spec}, f)
+    meta = {"feed_names": feed_names, "inputs": in_spec, "outputs": out_spec,
+            "batch_size": batch, "batched_feeds": batched_feeds,
+            "batch_buckets": buckets}
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    resilience.crash_point("save_inference_model:files-written")
+    _fsync_tree(tmp)
+    resilience.write_manifest(tmp, meta={"kind": "inference_model"},
+                              arrays=arrays_spec)
+    resilience.crash_point("save_inference_model:manifest-written")
+    old = None
+    if os.path.isdir(path):
+        # overwrite: move the committed artifact ASIDE (one rename)
+        # rather than rmtree-ing it first — the no-artifact window is
+        # two renames wide instead of a full recursive delete, and a
+        # crash inside it leaves the previous artifact intact under the
+        # .tmp marker (a concurrent load during the window fails
+        # loudly; a hot-reloading PredictorServer rolls back and keeps
+        # serving its in-memory model)
+        old = f"{path}{resilience.TMP_MARKER}{os.getpid()}.old"
+        os.rename(path, old)
+        resilience.crash_point("save_inference_model:committing")
+    os.rename(tmp, path)
+    _fsync_dir(parent)
+    if old is not None:
+        shutil.rmtree(old, ignore_errors=True)
 
 
 def save_train_artifact(dirname: str, trainer, example_feed: Dict[str, Any]) -> None:
@@ -469,6 +622,8 @@ def save_train_artifact(dirname: str, trainer, example_feed: Dict[str, Any]) -> 
     traced step: threefry, so the artifact is backend-portable); the
     C++ driver feeds the step index.
     """
+    import jax.export  # noqa: F401  (jax 0.4.x: submodule needs explicit import)
+
     program, optimizer = trainer.program, trainer.optimizer
     enforce(trainer.scope.params is not None, "save_train_artifact: call "
             "trainer.startup() first")
@@ -545,49 +700,235 @@ def save_train_artifact(dirname: str, trainer, example_feed: Dict[str, Any]) -> 
                    "inputs": in_spec}, f)
 
 
+# process-wide count of predictor AOT compiles: the serving tests pin
+# this across warmed-up traffic to prove off-bucket/adversarial request
+# shapes can never reach a recompile on the request path
+_aot_compiles = 0
+
+
+def aot_compile_count() -> int:
+    """Number of predictor AOT compiles performed by this process."""
+    return _aot_compiles
+
+
+def _aot_compile(exported):
+    """AOT-compile an Exported at its own in_avals (the
+    NativePaddlePredictor Init/Prepare split, api_impl.cc:64)."""
+    global _aot_compiles
+    flat = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+            for a in exported.in_avals]
+    args, kwargs = jax.tree.unflatten(exported.in_tree, flat)
+    compiled = jax.jit(exported.call).lower(*args, **kwargs).compile()
+    _aot_compiles += 1
+    return compiled
+
+
 class Predictor:
     """Loaded inference model (PaddlePredictor analog,
     paddle_inference_api.h:141: Run(inputs)->outputs; Clone is free —
     the executable is stateless and thread-safe).
 
-    The executable is **AOT-compiled once** at construction
-    (jit(exported.call).lower(...).compile() from the export's own
-    in_avals — the NativePaddlePredictor Init/Prepare split,
-    api_impl.cc:64): ``run()`` never re-enters tracing/compilation, it
-    only device_puts the feeds and executes."""
+    The executable is **AOT-compiled once** per shape bucket at
+    construction: ``run()`` never re-enters tracing/compilation, it only
+    validates + device_puts the feeds and executes. ``run`` validates
+    the feed structurally first — a missing/extra key or a shape/dtype
+    mismatch raises a typed :class:`InvalidRequest` naming the offending
+    field instead of a raw ``KeyError`` or an XLA shape abort.
 
-    def __init__(self, exported, params, state, feed_names, _compiled=None):
+    ``batch_buckets`` maps each precompiled batch size to its
+    executable; ``run`` dispatches on the request's batch dim (exact
+    match only — padding ragged batches up to a bucket is the serving
+    layer's job, :class:`paddle_tpu.serving.PredictorServer`)."""
+
+    def __init__(self, exported, params, state, feed_names, _compiled=None,
+                 bucket_exports: Optional[Dict[int, Any]] = None,
+                 batch_size: Optional[int] = None,
+                 batched_feeds: Optional[Sequence[str]] = None,
+                 _buckets: Optional[Dict[int, Any]] = None):
         self._exported = exported
         self._params = jax.device_put(params)
         self._state = jax.device_put(state)
-        self.feed_names = feed_names
+        self.feed_names = list(feed_names)
+        # feed avals are the trailing in_avals (flat order is
+        # (params..., state..., *feeds) with feeds in sorted-name order)
+        self._feed_avals = dict(zip(self.feed_names,
+                                    list(exported.in_avals)[-len(self.feed_names):]))
+        if batch_size is None or batched_feeds is None:
+            batch_size, batched_feeds = _infer_batch_info(
+                {k: np.zeros(a.shape, np.int8)
+                 for k, a in self._feed_avals.items()})
+        self.batch_size = int(batch_size)
+        self.batched_feeds = frozenset(batched_feeds)
         if _compiled is None:
-            flat = [jax.ShapeDtypeStruct(a.shape, a.dtype)
-                    for a in exported.in_avals]
             try:
-                args, kwargs = jax.tree.unflatten(exported.in_tree, flat)
-                _compiled = jax.jit(exported.call).lower(*args, **kwargs).compile()
-            except Exception:
+                _compiled = _aot_compile(exported)
+            except Exception as e:
                 # fall back to the jit dispatch cache: first run() traces,
-                # subsequent calls still skip tracing/compilation
+                # subsequent calls still skip tracing/compilation. This
+                # reintroduces trace-on-request — say so loudly instead
+                # of silently degrading the serving latency contract.
+                _log().warning(
+                    "Predictor AOT compile failed (%s: %s); falling back to "
+                    "the jit dispatch cache — the first run() of each feed "
+                    "shape will trace+compile ON the request path",
+                    type(e).__name__, e)
                 _compiled = jax.jit(exported.call)
         self._compiled = _compiled
+        if _buckets is not None:           # clone(): share everything
+            self._buckets = _buckets
+        else:
+            self._buckets = {self.batch_size: self._compiled}
+            for b, exp in (bucket_exports or {}).items():
+                if int(b) == self.batch_size:
+                    continue
+                try:
+                    self._buckets[int(b)] = _aot_compile(exp)
+                except Exception as e:
+                    _log().warning(
+                        "bucket %d AOT compile failed (%s: %s); falling back "
+                        "to the jit dispatch cache for that bucket",
+                        b, type(e).__name__, e)
+                    self._buckets[int(b)] = jax.jit(exp.call)
+
+    @property
+    def batch_buckets(self) -> List[int]:
+        """Precompiled batch sizes, ascending."""
+        return sorted(self._buckets)
+
+    def feed_spec(self, batch: Optional[int] = None) -> Dict[str, Tuple[Tuple[int, ...], Any]]:
+        """{feed name: (shape, dtype)} at bucket ``batch`` (default: the
+        export's own batch size)."""
+        batch = self.batch_size if batch is None else int(batch)
+        out = {}
+        for k, a in self._feed_avals.items():
+            shape = tuple(a.shape)
+            if k in self.batched_feeds:
+                shape = (batch,) + shape[1:]
+            out[k] = (shape, np.dtype(str(a.dtype)))
+        return out
+
+    def validate_feed(self, feed: Dict[str, Any],
+                      allow_padding: bool = False) -> Tuple[int, int]:
+        """Structural request validation. Returns ``(n, bucket)`` — the
+        request's batch size and the precompiled bucket that serves it
+        (``n == bucket`` unless ``allow_padding``, where the smallest
+        bucket >= n is chosen). Raises :class:`InvalidRequest` naming
+        the offending field for missing/extra keys, shape or dtype
+        mismatches, and off-bucket batch sizes."""
+        for k in self.feed_names:
+            if k not in feed:
+                raise InvalidRequest(k, "is missing from the feed "
+                                     f"(expected keys: {self.feed_names})")
+        for k in sorted(feed):
+            if k not in self._feed_avals:
+                raise InvalidRequest(
+                    k, "is not a feed of this model "
+                    f"(expected keys: {self.feed_names})")
+        buckets = self.batch_buckets
+        n = None
+        arrs = {k: np.asarray(feed[k]) for k in self.feed_names}
+        for k in self.feed_names:
+            if k not in self.batched_feeds:
+                continue
+            v = arrs[k]
+            if v.ndim < 1:
+                raise InvalidRequest(k, "must be batched (got a scalar)")
+            if n is None:
+                n = int(v.shape[0])
+            elif int(v.shape[0]) != n:
+                raise InvalidRequest(
+                    k, f"batch dim {v.shape[0]} disagrees with the "
+                    f"request's batch size {n}")
+        if n is None:
+            n = self.batch_size
+        if n == 0:
+            raise InvalidRequest(
+                sorted(self.batched_feeds)[0] if self.batched_feeds
+                else self.feed_names[0], "has an empty batch")
+        if allow_padding:
+            fits = [b for b in buckets if b >= n]
+            if not fits:
+                raise InvalidRequest(
+                    sorted(self.batched_feeds)[0] if self.batched_feeds
+                    else self.feed_names[0],
+                    f"batch size {n} exceeds the largest precompiled "
+                    f"bucket (buckets: {buckets})")
+            bucket = fits[0]
+        else:
+            if n not in self._buckets:
+                raise InvalidRequest(
+                    sorted(self.batched_feeds)[0] if self.batched_feeds
+                    else self.feed_names[0],
+                    f"batch size {n} is not a precompiled bucket "
+                    f"(buckets: {buckets})")
+            bucket = n
+        spec = self.feed_spec(n)  # request-sized: padding happens later
+        for k in self.feed_names:
+            v = arrs[k]
+            want_shape, want_dtype = spec[k]
+            if tuple(v.shape) != want_shape:
+                raise InvalidRequest(
+                    k, f"has shape {tuple(v.shape)}, expected {want_shape}")
+            got = v.dtype
+            if got != want_dtype and \
+                    jax.dtypes.canonicalize_dtype(got) != want_dtype:
+                raise InvalidRequest(
+                    k, f"has dtype {got}, expected {want_dtype}")
+        return n, bucket
 
     def run(self, feed: Dict[str, Any]):
+        n, bucket = self.validate_feed(feed, allow_padding=False)
         vals = [jnp.asarray(np.asarray(feed[k])) for k in self.feed_names]
-        return self._compiled(self._params, self._state, *vals)
+        return self._buckets[bucket](self._params, self._state, *vals)
 
     def clone(self) -> "Predictor":
-        # share the compiled executable and device-resident weights
+        # share the compiled executables and device-resident weights
         return Predictor(self._exported, self._params, self._state,
-                         self.feed_names, _compiled=self._compiled)
+                         self.feed_names, _compiled=self._compiled,
+                         batch_size=self.batch_size,
+                         batched_feeds=self.batched_feeds,
+                         _buckets=self._buckets)
 
 
 def load_inference_model(dirname: str) -> Predictor:
-    with open(os.path.join(dirname, "model.stablehlo"), "rb") as f:
-        exported = jax.export.deserialize(f.read())
-    params, state, _, meta = load_persistables(dirname)
-    return Predictor(exported, params, state, meta["feed_names"])
+    """Load + AOT-compile a :class:`Predictor` from a
+    ``save_inference_model`` artifact.
+
+    The artifact is validated against its manifest first (per-file
+    CRC32 + size) — a torn or bit-flipped artifact raises a structured
+    :class:`~paddle_tpu.resilience.CheckpointCorrupt` instead of a
+    random decoder error three frames deep. Pre-manifest (legacy)
+    directories load without validation."""
+    import jax.export  # noqa: F401  (jax 0.4.x: submodule needs explicit import)
+
+    from . import resilience
+
+    resilience.validate_checkpoint(dirname)  # None for legacy dirs
+    try:
+        with open(os.path.join(dirname, "model.stablehlo"), "rb") as f:
+            exported = jax.export.deserialize(f.read())
+        params, state, _, meta = load_persistables(dirname)
+    except (resilience.CheckpointCorrupt, FileNotFoundError):
+        raise
+    except Exception as e:
+        raise resilience.CheckpointCorrupt(
+            dirname, f"unreadable artifact: {type(e).__name__}: {e}") from e
+    bucket_exports = {}
+    for b in meta.get("batch_buckets", []):
+        p = os.path.join(dirname, f"model.b{b}.stablehlo")
+        if not os.path.exists(p):
+            continue
+        try:
+            with open(p, "rb") as f:
+                bucket_exports[int(b)] = jax.export.deserialize(f.read())
+        except Exception as e:
+            raise resilience.CheckpointCorrupt(
+                dirname, f"unreadable bucket export model.b{b}.stablehlo: "
+                f"{type(e).__name__}: {e}") from e
+    return Predictor(exported, params, state, meta["feed_names"],
+                     bucket_exports=bucket_exports,
+                     batch_size=meta.get("batch_size"),
+                     batched_feeds=meta.get("batched_feeds"))
 
 
 def save_params(dirname: str, params, state=None, opt_state=None):

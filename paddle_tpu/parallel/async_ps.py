@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import socket
 import subprocess
+import time
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -86,19 +87,56 @@ class PServerProcess:
             pass
 
 
+class PushUndelivered(ConnectionError):
+    """A push was SENT but the connection died before the server's
+    reply arrived: the update may or may not have applied server-side.
+    The client reconnects for subsequent requests but never RESENDS the
+    push — at-most-once semantics (a resend could double-apply the
+    gradient; losing one is ordinary async-SGD staleness)."""
+
+
 class PSClient:
     """Socket client for the pserver protocol. Dense params are flat f32
     buffers keyed by name; sparse pushes update [rows, dim] params
-    row-wise (the distributed-lookup-table update path)."""
+    row-wise (the distributed-lookup-table update path).
+
+    **Reconnect-with-backoff** (the ``data.master.MasterClient``
+    discipline): a dead connection or restarted pserver is retried
+    transparently with exponential backoff for IDEMPOTENT requests —
+    ``pull``/``init_param`` (first-writer-wins makes a resend a no-op)/
+    ``status``/``save``. ``push``/``push_quantized``/``push_rows`` are
+    NOT idempotent: the request is sent at most once; connection
+    establishment still retries, but a reply lost after a completed send
+    raises :class:`PushUndelivered` instead of resending (see
+    :class:`AsyncPSTrainer.step`, which drops that step's gradient and
+    keeps training)."""
 
     def __init__(self, addr: Tuple[str, int], trainer_id: int = 0,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, retries: int = 30,
+                 retry_backoff: float = 0.05, retry_backoff_max: float = 2.0):
         self.addr = tuple(addr)
         self.trainer_id = int(trainer_id)
-        self._sock = socket.create_connection(self.addr, timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.timeout = timeout
+        self.retries = max(1, int(retries))
+        self.retry_backoff = retry_backoff
+        self.retry_backoff_max = retry_backoff_max
+        self._sock: Optional[socket.socket] = None
+        self._connect()  # fail fast on misconfigured addr
 
     # -- transport ----------------------------------------------------------
+    def _connect(self):
+        s = socket.create_connection(self.addr, timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = s
+
+    def _drop_sock(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
     def _readline(self) -> str:
         buf = bytearray()
         while True:
@@ -118,19 +156,55 @@ class PSClient:
             out += chunk
         return bytes(out)
 
-    def _request(self, line: str, payload: bytes = b"") -> str:
-        self._sock.sendall(line.encode() + b"\n" + payload)
-        resp = self._readline()
-        if resp.startswith("ERR"):
-            raise RuntimeError(f"pserver: {resp}")
-        return resp
+    def _request(self, line: str, payload: bytes = b"",
+                 idempotent: bool = True, body_len=None):
+        """One protocol round trip with reconnect/backoff. ``body_len``
+        (resp → byte count) reads a framed payload INSIDE the retry
+        scope, so a connection lost mid-body retries the whole request
+        (idempotent case) instead of desyncing. Returns ``resp`` or
+        ``(resp, body)``."""
+        delay = self.retry_backoff
+        last_err: Optional[Exception] = None
+        for _ in range(self.retries):
+            try:
+                if self._sock is None:
+                    self._connect()
+            except OSError as e:
+                last_err = e
+                time.sleep(delay)
+                delay = min(delay * 2, self.retry_backoff_max)
+                continue
+            sent = False
+            try:
+                self._sock.sendall(line.encode() + b"\n" + payload)
+                sent = True
+                resp = self._readline()
+                if resp.startswith("ERR"):
+                    raise RuntimeError(f"pserver: {resp}")
+                if body_len is None:
+                    return resp
+                return resp, self._read_exact(body_len(resp))
+            except (OSError, ConnectionError) as e:
+                self._drop_sock()
+                last_err = e
+                if sent and not idempotent:
+                    raise PushUndelivered(
+                        f"push reply lost after send ({e}); NOT resending — "
+                        "the update may have applied server-side") from e
+                time.sleep(delay)
+                delay = min(delay * 2, self.retry_backoff_max)
+        raise ConnectionError(
+            f"pserver unreachable at {self.addr} after {self.retries} "
+            f"attempts: {last_err}")
 
     def close(self):
+        if self._sock is None:
+            return
         try:
             self._sock.sendall(b"QUIT\n")
         except OSError:
             pass
-        self._sock.close()
+        self._drop_sock()
 
     # -- param API ----------------------------------------------------------
     @staticmethod
@@ -151,15 +225,17 @@ class PSClient:
         return resp == "OK NEW"
 
     def pull(self, name: str, shape, dtype=np.float32) -> np.ndarray:
-        resp = self._request(f"PULL {self.trainer_id} {self._check_name(name)}")
-        n = int(resp.split()[1])
-        arr = np.frombuffer(self._read_exact(n), dtype=np.float32)
+        _, data = self._request(
+            f"PULL {self.trainer_id} {self._check_name(name)}",
+            body_len=lambda resp: int(resp.split()[1]))
+        arr = np.frombuffer(data, dtype=np.float32)
         return arr.reshape(shape).astype(dtype, copy=False)
 
     def push(self, name: str, grad: np.ndarray) -> int:
         data = np.ascontiguousarray(grad, dtype=np.float32).tobytes()
         resp = self._request(
-            f"PUSH {self.trainer_id} {self._check_name(name)} {len(data)}", data)
+            f"PUSH {self.trainer_id} {self._check_name(name)} {len(data)}",
+            data, idempotent=False)
         return int(resp.split()[1])
 
     def push_quantized(self, name: str, grad: np.ndarray) -> int:
@@ -172,7 +248,7 @@ class PSClient:
         q = np.clip(np.round(g / scale * 127.0), -127, 127).astype(np.int8)
         resp = self._request(
             f"PUSHQ {self.trainer_id} {self._check_name(name)} {q.size} "
-            f"{scale!r}", q.tobytes())
+            f"{scale!r}", q.tobytes(), idempotent=False)
         return int(resp.split()[1])
 
     def push_rows(self, name: str, row_ids: np.ndarray,
@@ -186,7 +262,7 @@ class PSClient:
         resp = self._request(
             f"PUSHROWS {self.trainer_id} {self._check_name(name)} "
             f"{vals.shape[0]} {vals.shape[1]}",
-            ids.tobytes() + vals.tobytes())
+            ids.tobytes() + vals.tobytes(), idempotent=False)
         return int(resp.split()[1])
 
     def save(self) -> None:
@@ -240,6 +316,7 @@ class AsyncPSTrainer:
         self.params = None
         self.state = None
         self.global_step = 0
+        self.pushes_lost = 0  # at-most-once pushes whose reply was lost
 
         def grad_step(params, state, rng, feed):
             def loss_fn(p, st, r, f):
@@ -299,6 +376,16 @@ class AsyncPSTrainer:
         send = (self.client.push_quantized if self.compress_grads
                 else self.client.push)
         for name, leaf in _named_leaves(jax.device_get(grads)):
-            send(name, leaf)
+            try:
+                send(name, leaf)
+            except PushUndelivered as e:
+                # at-most-once: the grad is dropped, never resent (a
+                # resend could double-apply) — one stale step, the
+                # trade async-SGD already makes for stragglers
+                self.pushes_lost += 1
+                import logging
+                logging.getLogger("paddle_tpu.async_ps").warning(
+                    "dropped push of %s at step %d (%s); continuing",
+                    name, self.global_step, e)
         self.global_step += 1
         return out

@@ -303,12 +303,24 @@ class PreemptionHandler:
         self.signals = tuple(signals) if signals is not None else self.SIGNALS
         self._flag = threading.Event()
         self._old: Dict[int, Any] = {}
+        self._callbacks: List[Any] = []
         self.installed = False
         self.signum: Optional[int] = None
 
     @property
     def requested(self) -> bool:
         return self._flag.is_set()
+
+    def on_signal(self, callback) -> "PreemptionHandler":
+        """Register ``callback()`` to run on the FIRST signal, right
+        after the flag flips — lets a long-blocking consumer (e.g. a
+        ``serving.PredictorServer`` starting its drain) react
+        immediately instead of at its next flag poll. Callbacks run in
+        signal-handler context: keep them to flag flips and
+        non-blocking kicks; exceptions are swallowed (a crashing
+        callback must not turn a clean preemption into an abort)."""
+        self._callbacks.append(callback)
+        return self
 
     def _handle(self, signum, frame):
         if self._flag.is_set():
@@ -326,6 +338,11 @@ class PreemptionHandler:
             return
         self.signum = signum
         self._flag.set()
+        for cb in self._callbacks:
+            try:
+                cb()
+            except Exception:
+                pass
         _log().warning(
             "received %s: checkpointing at the next chunk boundary, then "
             "exiting (signal again to abort immediately)",

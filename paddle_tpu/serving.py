@@ -1,0 +1,955 @@
+"""Production serving runtime: bounded-queue predictor server with
+request validation, shape bucketing, deadlines, a circuit breaker, hot
+model reload, and health signals.
+
+The reference's inference engine contract (NativePaddlePredictor
+Init/Prepare/Run/Clone, api_impl.cc:64) covers a single process calling
+``Run`` in a loop; the serving story around it — capacity limits, model
+swaps, health checks — lived in the fleet layer. Here the AOT-once
+discipline that makes XLA executables predictable under load gets the
+surrounding runtime, the serving-side sibling of the fault-tolerant
+*training* runtime in :mod:`paddle_tpu.resilience`:
+
+- **Typed request validation** — a malformed request (missing/extra
+  feed key, shape/dtype mismatch, non-finite payload) raises
+  :class:`InvalidRequest` naming the offending field at ``submit``
+  time, before it can occupy queue capacity or abort an executable.
+- **Shape bucketing** — requests are padded up to a fixed,
+  precompiled bucket set (``save_inference_model(batch_buckets=...)``),
+  so ragged or adversarial batch sizes can never trigger a recompile on
+  the request path; off-bucket shapes are rejected, and per-bucket
+  compile counts are pinned after warmup (``metrics.report()``'s
+  ``compiles_since_warmup`` stays 0).
+- **Bounded queue + deadlines** — saturation raises
+  :class:`ServerOverloaded` (never unbounded memory); a request whose
+  deadline passes while queued is dropped without executing.
+- **Watchdog + circuit breaker** — a dispatch that hangs past the
+  watchdog timeout, or repeated executable failures, trip the breaker:
+  subsequent submits fail fast with :class:`CircuitOpen`, and after a
+  cooldown a half-open probe request recovers the pool.
+- **Hot reload** — :meth:`PredictorServer.reload` loads and
+  CRC-validates a new artifact off-thread (the
+  ``resilience.write_manifest`` manifest written by
+  ``save_inference_model``), canaries it on a golden feed, and
+  atomically swaps it in; any failure rolls back with zero dropped
+  in-flight requests.
+- **Drain + health** — :meth:`PredictorServer.close(drain=True)`
+  finishes queued work before stopping (pair with
+  :class:`~paddle_tpu.resilience.PreemptionHandler` for SIGTERM);
+  :meth:`health` is the readiness/liveness state machine and
+  :class:`ServingMetrics` the latency/queue/error counters, with a
+  ``report()`` mirroring ``Trainer.pipeline_report()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue as _queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .core.errors import EnforceError
+from .io import InvalidRequest  # noqa: F401  (re-exported: submit raises it)
+
+
+def _log():
+    return logging.getLogger("paddle_tpu.serving")
+
+
+# -- typed serving errors -----------------------------------------------------
+
+
+class ServingError(EnforceError):
+    """Base of every typed serving-runtime error."""
+
+
+class ServerOverloaded(ServingError):
+    """The bounded work queue is full — shed load instead of growing
+    memory. Carries ``queue_depth``/``capacity`` for the reject reply."""
+
+    def __init__(self, queue_depth: int, capacity: int):
+        super().__init__(f"server overloaded: queue depth {queue_depth} at "
+                         f"capacity {capacity}")
+        self.queue_depth = queue_depth
+        self.capacity = capacity
+
+
+class DeadlineExceeded(ServingError, TimeoutError):
+    """The request's deadline passed before a result was produced."""
+
+
+class CircuitOpen(ServingError):
+    """The circuit breaker is open (recent failures/hangs): failing fast
+    instead of queueing onto a broken executable. ``retry_after`` is the
+    seconds until the next half-open probe is allowed."""
+
+    def __init__(self, retry_after: float):
+        super().__init__(f"circuit breaker open: retry after "
+                         f"{max(0.0, retry_after):.2f}s")
+        self.retry_after = retry_after
+
+
+class WorkerHung(ServingError):
+    """A dispatch exceeded the watchdog timeout; the worker was
+    abandoned and its request failed fast."""
+
+
+class ServerClosed(ServingError):
+    """submit() after close()/drain started."""
+
+
+class ReloadFailed(ServingError):
+    """Hot reload rejected (corrupt artifact, incompatible signature, or
+    canary failure) — the previous model keeps serving."""
+
+    def __init__(self, dirname: str, reason: str):
+        super().__init__(f"reload of {dirname!r} failed: {reason} "
+                         "(previous model still serving)")
+        self.dirname = dirname
+        self.reason = reason
+
+
+# -- latency histogram --------------------------------------------------------
+
+# log-spaced upper bounds, 50us .. ~80s, ratio ~1.3 (55 buckets): fixed
+# memory, ~15% percentile resolution — the usual serving-histogram trade
+_HIST_BOUNDS = tuple(50e-6 * (1.3 ** i) for i in range(55))
+
+
+class LatencyHistogram:
+    """Fixed-bucket log-scale latency histogram (seconds in,
+    percentiles out). Not thread-safe on its own — ServingMetrics holds
+    the lock."""
+
+    def __init__(self):
+        self.counts = [0] * (len(_HIST_BOUNDS) + 1)
+        self.total = 0
+        self.sum_s = 0.0
+        self.max_s = 0.0
+
+    def record(self, seconds: float) -> None:
+        import bisect
+        self.counts[bisect.bisect_left(_HIST_BOUNDS, seconds)] += 1
+        self.total += 1
+        self.sum_s += seconds
+        self.max_s = max(self.max_s, seconds)
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Upper bound of the bucket holding the p-th percentile (p in
+        [0, 100]); None when empty."""
+        if not self.total:
+            return None
+        rank = p / 100.0 * self.total
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank:
+                return (_HIST_BOUNDS[i] if i < len(_HIST_BOUNDS)
+                        else self.max_s)
+        return self.max_s
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BreakerPolicy:
+    """Circuit-breaker tuning: ``failure_threshold`` consecutive
+    failures (or one watchdog hang) trip it open; after ``cooldown``
+    seconds one half-open probe request is let through — success closes
+    the breaker, failure re-opens it for another cooldown."""
+
+    failure_threshold: int = 5
+    cooldown: float = 30.0
+
+
+class CircuitBreaker:
+    """closed → open → half_open → closed state machine (thread-safe)."""
+
+    def __init__(self, policy: Optional[BreakerPolicy] = None):
+        self.policy = policy or BreakerPolicy()
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._consecutive = 0
+        self._open_until = 0.0
+        self._probe_out = False
+        self.trips = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def acquire(self) -> Optional[str]:
+        """Admission check for one request. Returns ``"pass"``
+        (breaker closed), ``"probe"`` (the one half-open probe), or
+        ``None`` (open: fail fast)."""
+        with self._lock:
+            if self._state == "closed":
+                return "pass"
+            now = time.monotonic()
+            if self._state == "open" and now >= self._open_until:
+                self._state = "half_open"
+                self._probe_out = False
+            if self._state == "half_open" and not self._probe_out:
+                self._probe_out = True
+                return "probe"
+            return None
+
+    def retry_after(self) -> float:
+        with self._lock:
+            return self._open_until - time.monotonic()
+
+    def cancel(self, token: Optional[str]) -> None:
+        """A request admitted but never executed (validation reject,
+        queue-full reject) returns its probe slot."""
+        if token != "probe":
+            return
+        with self._lock:
+            if self._state == "half_open":
+                self._probe_out = False
+
+    def record(self, token: Optional[str], success: bool) -> None:
+        with self._lock:
+            if success:
+                self._consecutive = 0
+                # only the half-open PROBE closes an open breaker — and
+                # only while the breaker is still waiting on it: a stale
+                # success (an abandoned hung worker — or hung probe —
+                # finally returning after a fresh trip) must not mask a
+                # tripped pool or bypass the cooldown that trip started
+                if token == "probe" and self._state == "half_open":
+                    self._state = "closed"
+                    self._probe_out = False
+                return
+            if token == "probe" or self._state == "half_open":
+                self._reopen()
+                return
+            self._consecutive += 1
+            if self._state == "closed" and \
+                    self._consecutive >= self.policy.failure_threshold:
+                self._trip()
+
+    def trip(self) -> None:
+        """Force the breaker open (the watchdog's hung-dispatch path —
+        one hang is conclusive, no threshold)."""
+        with self._lock:
+            self._trip()
+
+    def _trip(self):
+        self._state = "open"
+        self._open_until = time.monotonic() + self.policy.cooldown
+        self._probe_out = False
+        self.trips += 1
+        _log().warning("circuit breaker OPEN for %.2fs (%d trips)",
+                       self.policy.cooldown, self.trips)
+
+    def _reopen(self):
+        self._state = "open"
+        self._open_until = time.monotonic() + self.policy.cooldown
+        self._probe_out = False
+
+
+# -- metrics ------------------------------------------------------------------
+
+
+class ServingMetrics:
+    """Thread-safe serving counters + latency histogram, surfaced via
+    :meth:`report` (the serving mirror of
+    ``PipelineMetrics.report``/``Trainer.pipeline_report()``)."""
+
+    _COUNTERS = ("submitted", "completed", "rejected_invalid",
+                 "rejected_overload", "rejected_breaker", "timeouts",
+                 "errors", "hangs", "workers_replaced", "reloads",
+                 "reload_failures")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            for c in self._COUNTERS:
+                setattr(self, c, 0)
+            self.hist = LatencyHistogram()
+
+    def bump(self, counter: str, by: int = 1):
+        with self._lock:
+            setattr(self, counter, getattr(self, counter) + by)
+
+    def record_latency(self, seconds: float):
+        with self._lock:
+            self.hist.record(seconds)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {c: getattr(self, c) for c in self._COUNTERS}
+            h = self.hist
+            out["latency_ms"] = {
+                "p50": _ms(h.percentile(50)), "p95": _ms(h.percentile(95)),
+                "p99": _ms(h.percentile(99)), "max": _ms(h.max_s or None),
+                "mean": _ms(h.sum_s / h.total if h.total else None),
+                "count": h.total,
+            }
+            return out
+
+
+def _ms(seconds: Optional[float]) -> Optional[float]:
+    return None if seconds is None else round(seconds * 1e3, 4)
+
+
+# -- requests -----------------------------------------------------------------
+
+
+class _Request:
+    __slots__ = ("feed", "n", "bucket", "deadline", "token", "done",
+                 "value", "error", "submitted", "completed")
+
+    def __init__(self, feed, n, bucket, deadline, token):
+        self.feed = feed
+        self.n = n
+        self.bucket = bucket
+        self.deadline = deadline      # absolute monotonic, or None
+        self.token = token            # breaker admission token
+        self.done = threading.Event()
+        self.value = None
+        self.error: Optional[BaseException] = None
+        self.submitted = time.monotonic()
+        self.completed: Optional[float] = None
+
+
+class PendingResult:
+    """Handle returned by :meth:`PredictorServer.submit`."""
+
+    def __init__(self, req: _Request):
+        self._req = req
+
+    def done(self) -> bool:
+        return self._req.done.is_set()
+
+    @property
+    def latency(self) -> Optional[float]:
+        """End-to-end seconds (queue wait included) once complete."""
+        r = self._req
+        return None if r.completed is None else r.completed - r.submitted
+
+    def result(self, timeout: Optional[float] = None):
+        """Block for the outcome; raises the request's typed error, or
+        :class:`DeadlineExceeded` when ``timeout``/the request deadline
+        passes first (the request itself is then dropped unexecuted by
+        the worker that dequeues it)."""
+        r = self._req
+        if timeout is None and r.deadline is not None:
+            timeout = max(0.0, r.deadline - time.monotonic()) + 1.0
+        if not r.done.wait(timeout):
+            raise DeadlineExceeded(
+                f"no result within {timeout:.2f}s (request still queued or "
+                "executing; it will be dropped at its deadline)")
+        if r.error is not None:
+            raise r.error
+        return r.value
+
+
+# -- the server ---------------------------------------------------------------
+
+
+class _Worker:
+    __slots__ = ("thread", "busy_since", "request", "abandoned", "index")
+
+    def __init__(self, index: int):
+        self.index = index
+        self.thread: Optional[threading.Thread] = None
+        self.busy_since: Optional[float] = None
+        self.request: Optional[_Request] = None
+        self.abandoned = False
+
+
+class PredictorServer:
+    """Bounded-queue serving runtime over a pool of ``Predictor.clone()``
+    workers (one clone per worker thread — the PaddlePredictor::Clone
+    contract; the executable and device weights are shared).
+
+    ``predictor`` needs the :class:`paddle_tpu.io.Predictor` surface:
+    ``clone()``, ``run(feed)``, ``feed_names``, ``batch_buckets``,
+    ``batched_feeds``, ``feed_spec(b)``, ``validate_feed(feed,
+    allow_padding=)`` — the fault-injection wrappers in
+    ``paddle_tpu.testing.faults`` duck-type it.
+
+    Request flow: :meth:`submit` validates structurally (typed
+    :class:`InvalidRequest`), checks the breaker (fail-fast
+    :class:`CircuitOpen`), and enqueues (reject
+    :class:`ServerOverloaded` when full) → a worker pads the batch up to
+    its precompiled bucket, executes, slices the outputs back to the
+    request's batch size, and completes the :class:`PendingResult`.
+    :meth:`run` is the synchronous wrapper.
+
+    ``golden_feed`` (+ optional ``canary_check(outputs)``) gates hot
+    reloads: a candidate model must serve the golden feed with finite
+    outputs (and pass ``canary_check``) before it is swapped in."""
+
+    def __init__(self, predictor, workers: int = 2, queue_size: int = 32,
+                 default_deadline: Optional[float] = None,
+                 breaker: Optional[BreakerPolicy] = None,
+                 watchdog_timeout: Optional[float] = 60.0,
+                 golden_feed: Optional[Dict[str, Any]] = None,
+                 canary_check: Optional[Callable[[Any], Any]] = None,
+                 reject_nonfinite: bool = True,
+                 warmup: bool = True, start: bool = True):
+        from . import io as _io
+
+        self._io = _io
+        self._predictor = predictor
+        self._generation = 1
+        self._model_lock = threading.Lock()
+        self._reload_lock = threading.Lock()
+        self._last_reload_error: Optional[BaseException] = None
+        self.num_workers = int(workers)
+        self.queue_size = int(queue_size)
+        self.default_deadline = default_deadline
+        self.watchdog_timeout = watchdog_timeout
+        self.golden_feed = golden_feed
+        self.canary_check = canary_check
+        self.reject_nonfinite = bool(reject_nonfinite)
+        self._do_warmup = bool(warmup)
+        self._queue: _queue.Queue = _queue.Queue(maxsize=self.queue_size)
+        self._complete_lock = threading.Lock()
+        self.metrics = ServingMetrics()
+        self.breaker = CircuitBreaker(breaker)
+        self._workers: List[_Worker] = []
+        self._watchdog: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._state = "starting"
+        self._state_lock = threading.Lock()
+        self._started_at = time.monotonic()
+        self._pinned_compiles: Optional[int] = None
+        if start:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "PredictorServer":
+        """Spawn workers + watchdog, warm every bucket once, pin the
+        compile count, flip readiness."""
+        with self._state_lock:
+            if self._state != "starting":
+                return self
+        for i in range(self.num_workers):
+            self._spawn_worker(i)
+        if self.watchdog_timeout is not None:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, daemon=True,
+                name="pdtpu-serving-watchdog")
+            self._watchdog.start()
+        if self._do_warmup:
+            self._warmup(self._predictor)
+        # pin: any AOT compile after this point is a serving-contract
+        # violation the metrics report makes visible
+        self._pinned_compiles = self._io.aot_compile_count()
+        with self._state_lock:
+            self._state = "ready"
+        return self
+
+    def _warmup(self, predictor) -> None:
+        """One execution per bucket (golden feed where it fits, zeros
+        otherwise): pages weights/executables in so the first real
+        request sees steady-state latency."""
+        clone = predictor.clone()
+        for b in predictor.batch_buckets:
+            feed = self._bucket_feed(predictor, b)
+            out = clone.run(feed)
+            _block_on(out)
+
+    def _bucket_feed(self, predictor, bucket: int) -> Dict[str, np.ndarray]:
+        spec = predictor.feed_spec(bucket)
+        golden = self.golden_feed or {}
+        feed = {}
+        for k, (shape, dtype) in spec.items():
+            if k in golden:
+                v = np.asarray(golden[k])
+                if k in predictor.batched_feeds:
+                    from .io import _resize_batch
+                    v = _resize_batch(v, bucket)
+                feed[k] = v.astype(dtype, copy=False)
+            else:
+                feed[k] = np.zeros(shape, dtype)
+        return feed
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Stop the server. ``drain=True`` (graceful: the SIGTERM path)
+        finishes every queued request first; ``drain=False`` fails
+        queued requests fast with :class:`ServerClosed`. Idempotent."""
+        with self._state_lock:
+            if self._state == "stopped":
+                return
+            self._state = "draining" if drain else "stopping"
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if drain:
+            # abandoned (hung) workers never go idle — waiting on them
+            # would spin the SIGTERM drain forever; their requests were
+            # already failed fast by the watchdog
+            while not self._queue.empty() or any(
+                    w.busy_since is not None and not w.abandoned
+                    for w in self._workers):
+                if deadline is not None and time.monotonic() > deadline:
+                    break
+                time.sleep(0.005)
+        self._stop.set()
+        # fail anything STILL queued (drain=False teardown, or a drain
+        # that hit its timeout): workers exit without dequeuing once the
+        # stop flag is set, and a stranded request would block its
+        # client's result() forever; probe tokens release their slot
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except _queue.Empty:
+                break
+            self.breaker.cancel(req.token)
+            self._complete(req, error=ServerClosed("server stopping"))
+        for w in self._workers:
+            if w.abandoned:
+                continue   # wedged in a dispatch; daemon thread, no join
+            if w.thread is not None and w.thread is not threading.current_thread():
+                w.thread.join(timeout=5.0)
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5.0)
+        with self._state_lock:
+            self._state = "stopped"
+
+    def __enter__(self) -> "PredictorServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=True)
+
+    # -- request path --------------------------------------------------------
+
+    def submit(self, feed: Dict[str, Any],
+               deadline: Optional[float] = None) -> PendingResult:
+        """Validate + enqueue one request; returns a
+        :class:`PendingResult`. ``deadline`` is seconds from now (falls
+        back to ``default_deadline``); raises :class:`InvalidRequest`,
+        :class:`CircuitOpen`, :class:`ServerOverloaded`, or
+        :class:`ServerClosed` — all typed, all naming the reason."""
+        with self._state_lock:
+            state = self._state
+        if state in ("draining", "stopping", "stopped"):
+            raise ServerClosed(f"server is {state}")
+        if state == "starting":
+            raise ServerClosed("server not started (call start())")
+        token = self.breaker.acquire()
+        if token is None:
+            self.metrics.bump("rejected_breaker")
+            raise CircuitOpen(self.breaker.retry_after())
+        try:
+            with self._model_lock:
+                predictor = self._predictor
+            n, bucket = predictor.validate_feed(feed, allow_padding=True)
+            if self.reject_nonfinite:
+                _check_finite(feed, predictor.feed_names)
+        except InvalidRequest:
+            self.breaker.cancel(token)
+            self.metrics.bump("rejected_invalid")
+            raise
+        except BaseException:
+            # validation can also raise raw numpy errors (e.g. a ragged
+            # nested list in np.asarray): the admission token — possibly
+            # THE half-open probe slot — must still go back, or the
+            # breaker wedges in half_open rejecting everything forever
+            self.breaker.cancel(token)
+            raise
+        rel = self.default_deadline if deadline is None else deadline
+        req = _Request(feed, n, bucket,
+                       None if rel is None else time.monotonic() + rel,
+                       token)
+        # state re-check + enqueue are ATOMIC under the state lock:
+        # close() flips the state under the same lock before draining,
+        # so a request can never slip into the queue after the drain
+        # loop decided it was empty (it would hang forever un-serviced)
+        with self._state_lock:
+            if self._state != "ready":
+                self.breaker.cancel(token)
+                raise ServerClosed(f"server is {self._state}")
+            try:
+                self._queue.put_nowait(req)
+            except _queue.Full:
+                self.breaker.cancel(token)
+                self.metrics.bump("rejected_overload")
+                raise ServerOverloaded(self._queue.qsize(),
+                                       self.queue_size) from None
+        self.metrics.bump("submitted")
+        return PendingResult(req)
+
+    def run(self, feed: Dict[str, Any], timeout: Optional[float] = None):
+        """Synchronous submit+wait (``timeout`` doubles as the request
+        deadline when no ``default_deadline`` is configured)."""
+        deadline = timeout if self.default_deadline is None else None
+        return self.submit(feed, deadline=deadline).result(timeout)
+
+    # -- worker machinery ----------------------------------------------------
+
+    def _spawn_worker(self, index: int) -> _Worker:
+        w = _Worker(index)
+        w.thread = threading.Thread(target=self._worker_loop, args=(w,),
+                                    daemon=True,
+                                    name=f"pdtpu-serving-worker-{index}")
+        self._workers.append(w)
+        w.thread.start()
+        return w
+
+    def _worker_loop(self, w: _Worker) -> None:
+        clone = None
+        gen = 0
+        while not self._stop.is_set() and not w.abandoned:
+            try:
+                req = self._queue.get(timeout=0.05)
+            except _queue.Empty:
+                continue
+            now = time.monotonic()
+            if req.deadline is not None and now > req.deadline:
+                # expired while queued: drop WITHOUT executing — the
+                # clean-cancel half of the deadline contract. The
+                # breaker token goes back too: an expired half-open
+                # PROBE must release its slot or the breaker wedges in
+                # half_open rejecting everything forever
+                self.breaker.cancel(req.token)
+                self.metrics.bump("timeouts")
+                self._complete(req, error=DeadlineExceeded(
+                    f"deadline passed {now - req.deadline:.3f}s before "
+                    "dispatch"))
+                continue
+            if self.breaker.state == "open" and req.token == "pass":
+                # tripped while this request sat queued: fail fast, do
+                # not run the broken executable again
+                self.metrics.bump("rejected_breaker")
+                self._complete(req, error=CircuitOpen(
+                    self.breaker.retry_after()))
+                continue
+            w.request = req
+            w.busy_since = now
+            try:
+                with self._model_lock:
+                    pred, gen_now = self._predictor, self._generation
+                if clone is None or gen != gen_now:
+                    clone = pred.clone()
+                    gen = gen_now
+                out = clone.run(self._pad(pred, req))
+                _block_on(out)
+                out = _slice_outputs(out, req.n, req.bucket)
+            except BaseException as e:
+                first = self._complete(req, error=e)
+                # an ABANDONED worker's eventual outcome is stale
+                # evidence: the watchdog already tripped for the hang,
+                # and a late failure must not re-open a breaker that has
+                # since recovered (nor double-count into the metrics —
+                # _complete returning False means the watchdog won)
+                if not w.abandoned:
+                    self.breaker.record(req.token, success=False)
+                if first:
+                    self.metrics.bump("errors")
+            else:
+                if not w.abandoned:
+                    self.breaker.record(req.token, success=True)
+                if self._complete(req, value=out):
+                    self.metrics.bump("completed")
+                    self.metrics.record_latency(
+                        time.monotonic() - req.submitted)
+            finally:
+                w.busy_since = None
+                w.request = None
+
+    @staticmethod
+    def _pad(predictor, req: _Request) -> Dict[str, Any]:
+        """Pad batched feeds up to the precompiled bucket (zeros — the
+        pad rows are sliced off the outputs)."""
+        if req.n == req.bucket:
+            return req.feed
+        out = {}
+        for k in predictor.feed_names:
+            v = np.asarray(req.feed[k])
+            if k in predictor.batched_feeds:
+                pad = np.zeros((req.bucket - req.n,) + v.shape[1:], v.dtype)
+                v = np.concatenate([v, pad], axis=0)
+            out[k] = v
+        return out
+
+    def _complete(self, req: _Request, value=None,
+                  error: Optional[BaseException] = None) -> bool:
+        """First completion wins — atomically: the watchdog and a
+        just-finishing worker may race to complete the same request, and
+        a torn check-then-set would let the loser overwrite the winner's
+        outcome (or double-count it in the metrics)."""
+        with self._complete_lock:
+            if req.done.is_set():
+                return False
+            req.error = error
+            req.value = value
+            req.completed = time.monotonic()
+            req.done.set()
+            return True
+
+    def _watchdog_loop(self) -> None:
+        interval = max(0.01, min(0.5, (self.watchdog_timeout or 1.0) / 4))
+        while not self._stop.is_set():
+            time.sleep(interval)
+            now = time.monotonic()
+            for w in list(self._workers):
+                busy = w.busy_since
+                if w.abandoned or busy is None:
+                    continue
+                if now - busy <= self.watchdog_timeout:
+                    continue
+                req = w.request
+                w.abandoned = True
+                self.metrics.bump("hangs")
+                self.breaker.trip()
+                _log().error(
+                    "worker %d hung for %.2fs (watchdog_timeout=%.2fs): "
+                    "breaker tripped, worker abandoned + replaced",
+                    w.index, now - busy, self.watchdog_timeout)
+                if req is not None:
+                    self._complete(req, error=WorkerHung(
+                        f"dispatch exceeded the {self.watchdog_timeout}s "
+                        "watchdog timeout"))
+                self.metrics.bump("workers_replaced")
+                self._spawn_worker(len(self._workers))
+
+    # -- hot reload ----------------------------------------------------------
+
+    def reload(self, dirname: str, block: bool = True):
+        """Hot-swap the served model from a ``save_inference_model``
+        artifact. The load (manifest CRC validation + AOT compile) and
+        the golden-feed canary run OFF the request path on a dedicated
+        thread; only the final pointer swap takes the model lock, so
+        in-flight requests finish on the clone they started with — zero
+        drops either way. Any failure (torn artifact →
+        ``CheckpointCorrupt``, signature drift or canary rejection →
+        :class:`ReloadFailed`) leaves the previous model serving.
+
+        ``block=False`` returns the loader thread immediately
+        (``last_reload_error`` and the metrics counters carry the
+        outcome); ``block=True`` joins and re-raises."""
+        err: List[BaseException] = []
+
+        def _load():
+            try:
+                self._do_reload(dirname)
+            except BaseException as e:
+                err.append(e)
+
+        t = threading.Thread(target=_load, daemon=True,
+                             name="pdtpu-serving-reload")
+        t.start()
+        if not block:
+            return t
+        t.join()
+        if err:
+            raise err[0]
+        return None
+
+    def _do_reload(self, dirname: str) -> None:
+        with self._reload_lock:
+            try:
+                new_pred = self._io.load_inference_model(dirname)
+                old = self._predictor
+                if list(new_pred.feed_names) != list(old.feed_names):
+                    raise ReloadFailed(
+                        dirname, f"feed names {new_pred.feed_names} != "
+                        f"served model's {old.feed_names}")
+                dropped = [b for b in old.batch_buckets
+                           if b not in new_pred.batch_buckets]
+                if dropped:
+                    raise ReloadFailed(
+                        dirname, f"bucket set shrank (missing {dropped}): "
+                        "in-flight bucket traffic would go off-bucket")
+                for b in old.batch_buckets:
+                    got, want = new_pred.feed_spec(b), old.feed_spec(b)
+                    if got != want:
+                        diff = sorted(k for k in want if got.get(k) != want[k])
+                        raise ReloadFailed(
+                            dirname, f"feed signature drifted at bucket {b} "
+                            f"(fields {diff}: {[got.get(k) for k in diff]} vs "
+                            f"served {[want[k] for k in diff]}): queued "
+                            "in-flight requests validated against the old "
+                            "shapes would all fail on the new model")
+                self._canary(new_pred, dirname)
+                # candidate buckets are already AOT-compiled: warm them
+                # off-thread so the swap doesn't cold-start a request
+                self._warmup(new_pred)
+            except BaseException as e:
+                self._last_reload_error = e
+                self.metrics.bump("reload_failures")
+                # the rejected candidate's AOT compiles happened OFF the
+                # request path: re-pin so the compiles_since_warmup
+                # contract signal doesn't read as a (false) request-path
+                # recompile forever after a rolled-back reload
+                if self._pinned_compiles is not None:
+                    self._pinned_compiles = self._io.aot_compile_count()
+                _log().warning("hot reload of %s rolled back: %s", dirname, e)
+                raise
+            with self._model_lock:
+                self._predictor = new_pred
+                self._generation += 1
+            self._last_reload_error = None
+            self._pinned_compiles = self._io.aot_compile_count()
+            self.metrics.bump("reloads")
+            _log().info("hot reload: now serving %s (generation %d)",
+                        dirname, self._generation)
+
+    def _canary(self, predictor, dirname: str) -> None:
+        # the golden feed is resized onto a precompiled bucket exactly
+        # like warmup does (Predictor.run is exact-bucket-strict, and a
+        # legal off-bucket golden feed must not make every reload fail)
+        buckets = predictor.batch_buckets
+        n = 0
+        for k in sorted(predictor.batched_feeds):
+            if self.golden_feed is not None and k in self.golden_feed:
+                n = int(np.asarray(self.golden_feed[k]).shape[0])
+                break
+        fits = [b for b in buckets if b >= n]
+        feed = self._bucket_feed(predictor, fits[0] if fits else buckets[-1])
+        try:
+            out = predictor.run(feed)
+            _block_on(out)
+        except Exception as e:
+            raise ReloadFailed(
+                dirname, f"canary execution failed: {type(e).__name__}: {e}")
+        bad = _nonfinite_outputs(out)
+        if bad:
+            raise ReloadFailed(
+                dirname, f"canary produced non-finite outputs: {bad}")
+        if self.canary_check is not None:
+            try:
+                ok = self.canary_check(out)
+            except Exception as e:
+                raise ReloadFailed(dirname, f"canary_check raised "
+                                   f"{type(e).__name__}: {e}")
+            if ok is False:
+                raise ReloadFailed(dirname, "canary_check returned False")
+
+    # -- observability -------------------------------------------------------
+
+    @property
+    def generation(self) -> int:
+        with self._model_lock:
+            return self._generation
+
+    @property
+    def last_reload_error(self) -> Optional[BaseException]:
+        """The most recent reload's failure (None after a success) —
+        the outcome channel for ``reload(..., block=False)`` callers."""
+        return self._last_reload_error
+
+    def health(self) -> Dict[str, Any]:
+        """Readiness/liveness state machine: ``live`` (the process can
+        still make progress — workers exist and the runtime is not
+        stopped) and ``ready`` (new requests are being accepted AND have
+        a worker pool behind them). States: ``starting`` → ``ready``
+        (sub-states ``overloaded`` while the queue is full and
+        ``breaker_open``/``half_open`` while tripped) → ``draining`` →
+        ``stopped``."""
+        with self._state_lock:
+            state = self._state
+        if state == "ready":
+            bstate = self.breaker.state
+            if bstate == "open":
+                state = "breaker_open"
+            elif bstate == "half_open":
+                state = "half_open"
+            elif self._queue.full():
+                state = "overloaded"
+        alive = [w for w in self._workers
+                 if not w.abandoned and w.thread is not None
+                 and w.thread.is_alive()]
+        return {
+            "live": state not in ("stopped",) and bool(alive),
+            "ready": state in ("ready", "overloaded", "half_open"),
+            "state": state,
+            "generation": self.generation,
+            "queue_depth": self._queue.qsize(),
+            "queue_capacity": self.queue_size,
+            "workers": len(alive),
+            "workers_busy": sum(1 for w in alive if w.busy_since is not None),
+            "breaker": self.breaker.state,
+            "uptime_s": round(time.monotonic() - self._started_at, 3),
+        }
+
+    def report(self) -> Dict[str, Any]:
+        """Metrics + health in one dict (the serving mirror of
+        ``Trainer.pipeline_report()``): latency percentiles, queue
+        depth, reject/timeout/error/breaker counters, reload outcomes,
+        and the compile-count pin (``compiles_since_warmup`` must stay 0
+        for a bucketed server — the AOT-once serving contract)."""
+        out = self.metrics.snapshot()
+        out["health"] = self.health()
+        out["breaker"] = {"state": self.breaker.state,
+                          "trips": self.breaker.trips}
+        with self._model_lock:
+            pred = self._predictor
+        compiles = self._io.aot_compile_count()
+        out["batch_buckets"] = list(pred.batch_buckets)
+        out["compiles_since_warmup"] = (
+            None if self._pinned_compiles is None
+            else compiles - self._pinned_compiles)
+        return out
+
+
+# -- helpers ------------------------------------------------------------------
+
+
+def _block_on(out) -> None:
+    import jax
+
+    jax.block_until_ready(out)
+
+
+def _check_finite(feed: Dict[str, Any], feed_names) -> None:
+    for k in feed_names:
+        v = np.asarray(feed[k])
+        if v.dtype.kind == "f" and not np.isfinite(v).all():
+            raise InvalidRequest(k, "contains non-finite values "
+                                 "(NaN/Inf payload rejected)")
+
+
+def _nonfinite_outputs(out) -> List[str]:
+    bad = []
+    items = out.items() if isinstance(out, dict) else [("output", out)]
+    for k, v in items:
+        a = np.asarray(v)
+        if a.dtype.kind == "f" and not np.isfinite(a).all():
+            bad.append(str(k))
+    return bad
+
+
+def _slice_outputs(out, n: int, bucket: int):
+    """Slice padded-batch outputs back to the request's batch size
+    (identity when no padding happened — preserving bit-identity with a
+    bare ``Predictor.run`` for in-bucket requests)."""
+    if n == bucket:
+        return out
+
+    def _one(v):
+        try:
+            if hasattr(v, "shape") and len(v.shape) >= 1 and \
+                    int(v.shape[0]) == bucket:
+                return v[:n]
+        except TypeError:
+            pass
+        return v
+
+    if isinstance(out, dict):
+        return {k: _one(v) for k, v in out.items()}
+    if isinstance(out, (list, tuple)):
+        return type(out)(_one(v) for v in out)
+    return _one(out)
+
+
+__all__ = [
+    "BreakerPolicy", "CircuitBreaker", "CircuitOpen", "DeadlineExceeded",
+    "InvalidRequest", "LatencyHistogram", "PendingResult", "PredictorServer",
+    "ReloadFailed", "ServerClosed", "ServerOverloaded", "ServingError",
+    "ServingMetrics", "WorkerHung",
+]

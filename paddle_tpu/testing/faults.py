@@ -1,6 +1,7 @@
-"""Deterministic fault-injection harness for the resilience layer.
+"""Deterministic fault-injection harness for the resilience + serving
+layers.
 
-Three fault families, all exactly reproducible (no subprocess roulette,
+Four fault families, all exactly reproducible (no subprocess roulette,
 no timing races):
 
 - **Bad batches**: :func:`nan_batch_reader` poisons one batch of a
@@ -12,18 +13,28 @@ no timing races):
   "kill -9 mid-save" happens at an exact phase: files written but no
   manifest, manifest written but not committed, ...).
 - **Checkpoint corruption**: :func:`truncate_file` / :func:`flip_byte`
-  tear a committed checkpoint the way a torn disk write would.
+  tear a committed checkpoint (or inference artifact) the way a torn
+  disk write would.
+- **Serving faults**: :class:`FaultyPredictor` wraps a Predictor with a
+  scripted ``run`` behavior — :func:`hanging_predictor` (wedged
+  executable, drives the dispatch watchdog), :func:`failing_predictor`
+  (crash-looping executable, drives the circuit breaker) — with call
+  counts shared across ``clone()`` so a worker pool sees one fault
+  script, not one per worker.
 
-Known crash-point tags in the save path (``io.save_trainer``):
+Known crash-point tags in the save paths:
 
 - ``save_trainer:files-written`` — npz/meta files on disk, no manifest
 - ``save_trainer:manifest-written`` — manifest on disk, dir not renamed
+- ``save_inference_model:files-written`` / ``:manifest-written`` — the
+  same two phases of the inference-artifact export
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
+import threading
 from typing import Callable, Dict, Iterator, Optional
 
 import numpy as np
@@ -127,3 +138,90 @@ def _largest_npz(ckpt_dir: str) -> str:
     if not npz:
         raise FileNotFoundError(f"no npz files in {ckpt_dir}")
     return max(npz, key=lambda n: os.path.getsize(os.path.join(ckpt_dir, n)))
+
+
+# -- serving faults ----------------------------------------------------------
+
+
+class FaultyPredictor:
+    """Duck-typed :class:`paddle_tpu.io.Predictor` wrapper for serving
+    fault injection: validation/bucketing surfaces delegate to the real
+    predictor, ``run`` routes through ``behavior(base, feed, call_index)``
+    — which may hang, raise, or serve normally. The call counter and the
+    behavior are SHARED across :meth:`clone`, so a
+    ``serving.PredictorServer`` worker pool executes one deterministic
+    fault script regardless of which worker dequeues which request."""
+
+    def __init__(self, base, behavior: Callable, _counter=None, _lock=None):
+        self._base = base
+        self._behavior = behavior
+        self._counter = _counter if _counter is not None else [0]
+        self._lock = _lock if _lock is not None else threading.Lock()
+
+    # validation/bucketing surface: delegate
+    @property
+    def feed_names(self):
+        return self._base.feed_names
+
+    @property
+    def batch_buckets(self):
+        return self._base.batch_buckets
+
+    @property
+    def batched_feeds(self):
+        return self._base.batched_feeds
+
+    @property
+    def batch_size(self):
+        return self._base.batch_size
+
+    def feed_spec(self, batch=None):
+        return self._base.feed_spec(batch)
+
+    def validate_feed(self, feed, allow_padding=False):
+        return self._base.validate_feed(feed, allow_padding=allow_padding)
+
+    def run(self, feed):
+        with self._lock:
+            i = self._counter[0]
+            self._counter[0] += 1
+        return self._behavior(self._base, feed, i)
+
+    def clone(self) -> "FaultyPredictor":
+        return FaultyPredictor(self._base.clone(), self._behavior,
+                               _counter=self._counter, _lock=self._lock)
+
+
+def hanging_predictor(base, release: "threading.Event",
+                      hang_calls: int = 1,
+                      skip_calls: int = 0) -> FaultyPredictor:
+    """``run`` blocks on ``release`` for calls ``[skip_calls,
+    skip_calls + hang_calls)`` (then serves normally) — the
+    wedged-executable fault that drives the serving watchdog. Always
+    ``release.set()`` in test teardown or the abandoned worker thread
+    outlives the test."""
+
+    def behavior(b, feed, i):
+        if skip_calls <= i < skip_calls + hang_calls:
+            release.wait()
+        return b.run(feed)
+
+    return FaultyPredictor(base, behavior)
+
+
+def failing_predictor(base, fail_calls: int = 1_000_000,
+                      skip_calls: int = 0,
+                      exc: Optional[Callable[[], BaseException]] = None
+                      ) -> FaultyPredictor:
+    """``run`` raises on calls ``[skip_calls, skip_calls + fail_calls)``
+    (then serves normally) — the crash-looping executable that trips the
+    circuit breaker; a finite ``fail_calls`` lets the half-open probe
+    find a recovered executable."""
+
+    def behavior(b, feed, i):
+        if skip_calls <= i < skip_calls + fail_calls:
+            raise (exc() if exc is not None
+                   else RuntimeError(f"injected executable failure #{i}"))
+        return b.run(feed)
+
+    return FaultyPredictor(base, behavior)

@@ -100,6 +100,61 @@ def test_dc_asgd_delay_compensation():
         fresh.close()
 
 
+def test_client_survives_pserver_restart_kill_mid_stream(tmp_path):
+    """The bounded reconnect-with-backoff contract (the MasterClient
+    discipline applied to PSClient): kill the pserver mid-stream —
+    idempotent requests (pull) retry transparently with backoff onto the
+    restarted server (recovered from its snapshot); pushes are
+    at-most-once — with the server gone they raise a typed
+    ConnectionError/PushUndelivered instead of silently resending into a
+    possible double-apply."""
+    import threading
+    import time
+
+    from paddle_tpu.parallel.async_ps import PushUndelivered  # noqa: F401
+
+    snap = str(tmp_path / "ps.snap")
+    with PServerProcess(lr=0.1, optimizer="sgd", snapshot_path=snap) as srv:
+        c = PSClient(srv.addr, retries=20, retry_backoff=0.05,
+                     retry_backoff_max=0.25)
+        c.init_param("w", np.zeros(4, np.float32))
+        c.push("w", np.ones(4, np.float32))          # w = -0.1
+        c.save()                                     # snapshot to disk
+        port = srv.port
+        srv.stop()                                   # kill -9 mid-stream
+
+        # at-most-once: the push is never queued for resend — it fails
+        # with PushUndelivered (send landed in the OS buffer before the
+        # reset) or plain ConnectionError (connect refused after retries)
+        with pytest.raises(ConnectionError):
+            c.push("w", np.ones(4, np.float32))
+
+        restarted = {}
+
+        def delayed_restart():
+            time.sleep(0.4)
+            restarted["srv"] = PServerProcess(port=port, lr=0.1,
+                                              optimizer="sgd",
+                                              snapshot_path=snap)
+
+        t = threading.Thread(target=delayed_restart)
+        t.start()
+        try:
+            # issued while the server is still DOWN: reconnect-with-
+            # backoff rides out the restart window transparently
+            got = c.pull("w", (4,))
+            t.join()
+            np.testing.assert_allclose(got, -0.1 * np.ones(4), rtol=1e-6)
+            c.push("w", np.ones(4, np.float32))      # healthy again
+            np.testing.assert_allclose(c.pull("w", (4,)),
+                                       -0.2 * np.ones(4), rtol=1e-6)
+            c.close()
+        finally:
+            t.join()
+            if "srv" in restarted:
+                restarted["srv"].stop()
+
+
 def _mnist_feed(rng, n=64):
     return {"image": rng.randn(n, 784).astype(np.float32),
             "label": rng.randint(0, 10, (n, 1)).astype(np.int64)}

@@ -28,7 +28,7 @@ def test_priority_order_leads_with_baseline_configs():
     # every registered config appears exactly once
     expect = (set(bench.TRAIN_CONFIGS) | set(bench.INFER_CONFIGS)
               | {"gpt_decode", "dispatch_overhead", "guard_overhead",
-                 "input_pipeline"})
+                 "input_pipeline", "serving"})
     assert set(names) == expect and len(names) == len(expect)
 
 
@@ -92,6 +92,54 @@ def test_input_pipeline_quick_overrides(monkeypatch):
     bench._run_one("input_pipeline", 1.0, quick=True)
     assert seen == {"iters": 8, "k": 4}
     assert bench._result_key("input_pipeline") == "input_pipeline"
+
+
+def test_serving_quick_overrides(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(bench, "bench_serving",
+                        lambda peak, **kw: seen.update(kw) or {"v": 1})
+    bench._run_one("serving", 1.0, quick=True)
+    assert seen == {"requests": 40}
+    assert bench._result_key("serving") == "serving"
+
+
+def test_serving_row_schema(monkeypatch):
+    """The serving row (PredictorServer steady p50/p99 + saturated
+    reject rate, fp32 vs int8) pins its schema: downstream readers
+    compare rounds by these exact keys. Export/server/driver are
+    stubbed — the assembly math is pure python."""
+
+    class _Server:
+        def close(self, drain=True, timeout=None):
+            pass
+
+    monkeypatch.setattr(bench, "_serving_predictors",
+                        lambda bs: {"fp32": ("P32", {"x": 1}),
+                                    "int8": ("P8", {"x": 1})})
+    monkeypatch.setattr(bench, "_make_server",
+                        lambda pred, workers, queue_size: _Server())
+    monkeypatch.setattr(bench, "_calibrate_serving",
+                        lambda server, feed, iters=8: 0.002)
+    monkeypatch.setattr(
+        bench, "_drive_serving",
+        # saturated phase (rate > capacity) rejects half the offered load
+        lambda server, feed, n, rate: ([0.004] * n,
+                                       n // 2 if rate > 1000.0 else 0))
+    row = bench.bench_serving(1.0, batch_size=8, requests=20, workers=2,
+                              queue_size=4)
+    for key in ("value", "unit", "latency_ms", "reject_rate_saturated",
+                "offered_rps", "requests", "workers", "queue_size",
+                "batch_size"):
+        assert key in row, key
+    assert set(row["latency_ms"]) == {"fp32", "int8"}
+    for v in row["latency_ms"].values():
+        assert set(v) == {"p50", "p99"}
+    assert row["value"] == row["latency_ms"]["fp32"]["p99"] == 4.0
+    # capacity = 2 workers / 2ms = 1000 rps: steady at 600 keeps 0
+    # rejects, saturated at 3000 sheds half
+    assert row["reject_rate_saturated"] == {"fp32": 0.5, "int8": 0.5}
+    assert row["offered_rps"]["fp32"]["steady_rps"] == 600.0
+    assert row["offered_rps"]["fp32"]["saturated_rps"] == 3000.0
 
 
 def test_input_pipeline_row_schema(monkeypatch):

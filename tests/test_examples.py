@@ -46,10 +46,15 @@ def test_train_gpt_example_hoisted_accum_and_int8_generate():
 
 @pytest.mark.slow
 def test_serve_classifier_example_runs_int8():
+    """The PredictorServer-backed example end to end: int8 export with
+    buckets, steady traffic, overload shedding, zero-drop drain
+    (--threads is kept as the pre-PredictorServer alias of --workers)."""
     out = _run("serve_classifier.py", "--train_steps", "8", "--calls", "3",
                "--threads", "2", "--int8")
-    assert "int8 datapath" in out
+    assert "int8 datapath" in out and "buckets [16, 64]" in out
+    assert "rejected with ServerOverloaded" in out
     assert "served accuracy" in out
+    assert "drained: state=stopped" in out and "errors=0" in out
 
 
 @pytest.mark.slow
