@@ -208,6 +208,22 @@ def _result(n_per_step, unit, dt_pipe, dt_comp, flops_per_step, peak,
             _wire.feed_wire_nbytes(feed, fw))
         out["feed_logical_bytes_per_step"] = int(
             _wire.feed_logical_nbytes(feed, fw))
+    if trainer is not None and feed is not None and \
+            os.environ.get("BENCH_FUSIONS", "1") != "0":
+        # the top-k fusion table rides every train row so two rounds
+        # diff to "this fusion got slower" (tools/profile_diff.py:
+        # cost_frac × step_time_ms localizes a regression to a named
+        # fusion). The re-lower/re-compile this costs is served by the
+        # persistent compile cache; failure must not lose the row.
+        try:
+            rep = trainer.fusion_report(feed)
+            out["top_fusions"] = rep["top_fusions"]
+            out["fusion_n_units"] = rep["n_units"]
+            out["fusion_coverage_top_k"] = rep["coverage_top_k"]
+            if rep.get("temp_mb") is not None:
+                out["temp_mb"] = round(rep["temp_mb"], 3)
+        except Exception as e:
+            out["top_fusions_error"] = f"{type(e).__name__}: {e}"
     base = BASELINES.get(baseline_key or "")
     out["vs_baseline"] = round(float(value) / base, 2) if base else None
     return out
@@ -819,6 +835,58 @@ def bench_serving(peak, batch_size=64, requests=240, workers=2,
     }
 
 
+def bench_fusion_profile(peak, batch_size=16, seq=128, iters=8, top_k=8):
+    """Observability suite row: the fusion-aware profiler pointed at a
+    transformer train step. A short pipelined window (host feeds through
+    ``Trainer.step`` so the dispatch timer and pipeline metrics carry
+    real numbers) followed by ``fusion_report`` + ``profile_report``.
+    ``value`` is the top-k roofline-cost coverage — the fraction of the
+    compiled step's static cost the named top-k fusion rows explain;
+    ``top_fusions`` is the same table every train row records, and
+    ``breakdown``/``bottleneck`` are the unified step profile."""
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.models import transformer
+
+    cfg = transformer.base_config(src_vocab=4000, trg_vocab=4000,
+                                  dropout=0.0, max_len=seq, dtype="bfloat16",
+                                  fused_ce=True)
+    model = pt.build(transformer.make_model(cfg))
+    rng = np.random.RandomState(0)
+    feeds = [{
+        "src_ids": rng.randint(3, 4000, (batch_size, seq)).astype(np.int32),
+        "trg_ids": rng.randint(3, 4000, (batch_size, seq)).astype(np.int32),
+        "labels": rng.randint(3, 4000, (batch_size, seq)).astype(np.int32),
+    } for _ in range(4)]
+    trainer = pt.Trainer(model, opt.Adam(1e-3), loss_name="loss",
+                         fetch_list=["loss"])
+    trainer.startup(sample_feed=feeds[0])
+    out = trainer.step(feeds[0])
+    _sync(out)
+    trainer.reset_profile()  # measured window excludes warmup/compile
+    for i in range(iters):
+        out = trainer.step(feeds[i % len(feeds)])
+    _sync(out)
+    fus = trainer.fusion_report(feeds[0], top_k=top_k)
+    prof = trainer.profile_report()
+    res = {
+        "value": fus["coverage_top_k"],
+        "unit": f"top-{top_k} fusion roofline-cost coverage "
+                "(transformer train step)",
+        "top_fusions": fus["top_fusions"],
+        "n_units": fus["n_units"],
+        "n_in_loop": fus["n_in_loop"],
+        "avg_step_ms": prof["avg_step_ms"],
+        "breakdown": prof["breakdown"],
+        "bottleneck": prof["bottleneck"],
+        "batch_size": batch_size,
+        "seq": seq,
+    }
+    if fus.get("temp_mb") is not None:
+        res["temp_mb"] = round(fus["temp_mb"], 3)
+    return res
+
+
 def bench_mnist_mlp(peak, batch_size=128, iters=50):
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt
@@ -1090,7 +1158,7 @@ def _suite_names():
 
     names = [*TRAIN_CONFIGS, *INFER_CONFIGS, "gpt_decode",
              "dispatch_overhead", "guard_overhead", "input_pipeline",
-             "serving"]
+             "serving", "fusion_profile"]
     # the BASELINE five first, then the reference's headline serving
     # rows, then gpt — a driver that kills the suite early (the partial
     # SIGTERM record) still captures the configs that matter most
@@ -1152,6 +1220,10 @@ def _run_one(name: str, peak: float, quick: bool = False, batch_size=None):
         if quick:
             kw.update(requests=40)
         return bench_serving(peak, **kw)
+    if name == "fusion_profile":
+        if quick:
+            kw.update(iters=2, batch_size=4, seq=64)
+        return bench_fusion_profile(peak, **kw)
     raise ValueError(f"unknown config {name}")
 
 

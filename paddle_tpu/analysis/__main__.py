@@ -50,6 +50,13 @@ def main(argv=None) -> int:
     ap.add_argument("--amp", default="",
                     help="lint under this compute dtype (e.g. bfloat16)")
     ap.add_argument("--loss-name", default="loss")
+    ap.add_argument("--select", default="",
+                    help="comma-list restricting rule families, e.g. "
+                         '"pipeline,collective" (default: all)')
+    ap.add_argument("--pp-microbatches", type=int, default=0,
+                    help="lint this pipeline schedule shape "
+                         "(pipeline:* family) against --batch / --mesh")
+    ap.add_argument("--pp-interleave", type=int, default=1)
     ap.add_argument("--fail-on", default="warning",
                     choices=("info", "warning", "error"),
                     help="exit 1 when findings at/above this severity exist")
@@ -65,8 +72,15 @@ def main(argv=None) -> int:
     program, feed = build_model(args.model, args.variant, args.batch, args.seq)
     mesh = _parse_mesh(args.mesh) if args.mesh else None
     rules = _parse_rules(args.rules) if args.rules else None
-    report = check(program, feed, mesh=mesh, rules=rules,
-                   amp=args.amp or None, loss_name=args.loss_name)
+    strategy = None
+    if args.pp_microbatches:
+        from ..parallel import DistStrategy
+        strategy = DistStrategy(pp_microbatches=args.pp_microbatches,
+                                pp_interleave=args.pp_interleave)
+    select = {s.strip() for s in args.select.split(",") if s.strip()} or None
+    report = check(program, feed, mesh=mesh, rules=rules, strategy=strategy,
+                   amp=args.amp or None, loss_name=args.loss_name,
+                   select=select)
     if args.format == "json":
         print(json.dumps(report.to_dict(), indent=1, default=str))
     else:

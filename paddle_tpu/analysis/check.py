@@ -68,10 +68,11 @@ def check(
     inputs (arrays or ShapeDtypeStructs) keyed by the program fn's arg
     names; ``params``/``state`` default to a fresh ``Program.init``.
     ``mesh``+``rules`` enable the sharding audit, ``strategy`` the
-    config-level collective checks, ``amp`` re-traces under
-    ``amp_guard(amp)`` so the dtype-flow rules see the mixed-precision
-    graph. ``select`` restricts to a subset of rule families
-    ({"collective", "dtype", "sharding", "params", "retrace", "feed"}).
+    config-level collective checks and the pipeline-shape lints,
+    ``amp`` re-traces under ``amp_guard(amp)`` so the dtype-flow rules
+    see the mixed-precision graph. ``select`` restricts to a subset of
+    rule families ({"collective", "dtype", "sharding", "params",
+    "retrace", "feed", "pipeline"}).
     ``feed_wire`` (a ``FeedWire`` or ``{name: WireSpec}``) maps a
     wire-typed sample feed to its logical dtypes for the trace and
     keeps the ``feed:wire-candidate`` rule from re-suggesting fields
@@ -143,6 +144,8 @@ def check(
         _rules.check_sharding(params, mesh, rules, report,
                               param_info=getattr(program, "param_info", None),
                               large_param_bytes=large_param_bytes)
+    if fam("pipeline"):
+        _rules.check_pipeline(strategy, mesh, sample_feed, report)
     return report
 
 
@@ -156,22 +159,39 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
     unhoisted-accum class of hazard (and train-only dtype flow: branches
     gated on ``in_training()``, scaler casts, grad math) sits. Pass
     ``amp="bfloat16"|"float16"`` to re-trace the step under that
-    compute dtype, the way the real amp training run traces it."""
+    compute dtype, the way the real amp training run traces it.
+
+    Two families reach past the jaxpr:
+
+    - ``memory`` — the HBM/remat advisor (``profiling.advisor``):
+      per-device params + opt state + backward-held activations vs the
+      device budget, emitting ``memory:remat-candidate``. Needs a
+      budget: automatic where the backend exposes ``memory_stats()``
+      (TPU), or pass ``hbm_budget_bytes=...`` explicitly (CPU).
+    - ``hlo`` — collective placement over the *optimized HLO* of the
+      compiled step (``profiling.fusion`` walk): GSPMD-inserted
+      all-reduces inside while bodies are caught directly instead of
+      inferred from config. OFF by default (it compiles the step a
+      second time); enable with ``hlo=True`` or ``select={"hlo",...}``.
+    """
     enforce(trainer._step_fn is not None,
             "check_trainer: call Trainer.startup() first (the lint walks "
             "the built step function)")
     select = kwargs.pop("select", None)
+    hlo = kwargs.pop("hlo", False) or (select is not None and "hlo" in select)
+    hbm_budget_bytes = kwargs.pop("hbm_budget_bytes", None)
     amp = kwargs.get("amp")
     want_coll = select is None or "collective" in select
     want_donation = select is None or "donation" in select
     want_dtype = select is None or "dtype" in select
+    want_memory = select is None or "memory" in select
     # the collective, donation — and, when a step trace is possible,
     # dtype — families run over the STEP jaxpr below (the program jaxpr
     # is a subset of it — walking both would double-report; donation
     # needs the step's donate_argnums anyway; dtype over the step sees
     # the train path the forward program hides)
     step_dtype = want_dtype and sample_feed is not None
-    inner_select = ({"sharding", "params", "retrace", "feed"}
+    inner_select = ({"sharding", "params", "retrace", "feed", "pipeline"}
                     if select is None
                     else set(select) - {"collective", "donation"})
     if step_dtype:
@@ -189,14 +209,44 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
         select=inner_select,
         feed_wire=getattr(trainer, "feed_wire", None), **kwargs)
     report.subject = f"trainer({trainer.program.name})"
-    if not (want_coll or want_donation or step_dtype):
-        return report
+    if want_coll or want_donation or step_dtype:
+        _check_step_jaxpr(trainer, sample_feed, report, rules, amp,
+                          want_coll, want_donation, step_dtype, kwargs)
+    # families that reach PAST the jaxpr — both need a sample feed to
+    # trace/compile with, and both degrade to a finding on failure (the
+    # lint surface must never crash the startup path it guards)
+    if want_memory and sample_feed is not None:
+        try:
+            from ..profiling.advisor import advise
+            advise(trainer, sample_feed, hbm_budget_bytes=hbm_budget_bytes,
+                   report=report)
+        except Exception as e:
+            report.add("memory:advisor-failed", "info",
+                       f"HBM advisor could not estimate the step "
+                       f"({type(e).__name__}: {e})")
+    if hlo and sample_feed is not None:
+        try:
+            from ..debugger import _lower_step
+            from ..profiling.fusion import module_units, parse_hlo_module
+            text = _lower_step(trainer, sample_feed).compile().as_text()
+            _rules.check_hlo_collectives(
+                module_units(parse_hlo_module(text)), report)
+        except Exception as e:
+            report.add("collective:hlo-walk-failed", "info",
+                       f"could not compile/walk the optimized HLO "
+                       f"({type(e).__name__}: {e})")
+    return report
 
+
+def _check_step_jaxpr(trainer, sample_feed, report, rules, amp,
+                      want_coll, want_donation, step_dtype, kwargs) -> None:
+    """The step-jaxpr families of ``check_trainer`` (collective,
+    donation, train-path dtype)."""
     if want_coll:
         _rules.check_accum_exchange(trainer.strategy, trainer.mesh,
                                     trainer.scope.params, report)
     if sample_feed is None:
-        return report
+        return
     feed = _concrete_feed(sample_feed)
     ls = getattr(trainer.scope, "loss_scale_state", None) or {}
     args = (trainer.scope.params, trainer.scope.opt_state,
@@ -236,7 +286,7 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
                        select={"dtype"},
                        feed_wire=getattr(trainer, "feed_wire", None), **kwargs)
             report.findings.extend(fb.findings)
-        return report
+        return
     if want_coll:
         _rules.check_collectives(closed, report, mesh=trainer.mesh)
     if step_dtype:
@@ -244,7 +294,6 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
                             feed=sample_feed)
     if want_donation and getattr(trainer, "_train_step_core", None) is not None:
         _check_step_donation(trainer, args, closed, out_shape, report)
-    return report
 
 
 _STEP_ARGNAMES = ("params", "opt_state", "state", "rng", "feed", "loss_scale")

@@ -468,7 +468,155 @@ def _named_leaves(name: str, val):
 
 
 # --------------------------------------------------------------------------
-# 7. feed wire-format candidates
+# 7. pipeline shape
+# --------------------------------------------------------------------------
+
+
+def check_pipeline(strategy, mesh, feed: Optional[Dict[str, Any]],
+                   report: LintReport) -> None:
+    """``pipeline:*`` — the pipeline-schedule shape constraints that
+    used to surface only as runtime enforces inside ``pipeline_apply``
+    (mid-trace, after startup cost is sunk), checked statically at
+    startup from the strategy + mesh + sample feed:
+
+    - ``pipeline:batch-indivisible`` — batch % pp_microbatches != 0
+      (the trace WILL fail at the first step);
+    - ``pipeline:microbatch-indivisible`` — microbatch not divisible
+      by the dp/fsdp data-shard product;
+    - ``pipeline:bubble`` — the exact fill/drain waste fraction of the
+      schedule (``parallel.pipeline.bubble_fraction``), warned above
+      20% with the microbatch/interleave levers named.
+
+    The runtime enforces stay (defense in depth); this family names
+    the fix before anything compiles."""
+    pp_m = int(getattr(strategy, "pp_microbatches", 0) or 0) if strategy else 0
+    if pp_m <= 0:
+        return
+    pp_v = max(1, int(getattr(strategy, "pp_interleave", 1) or 1))
+    b = None
+    for v in (feed or {}).values():
+        shape = getattr(v, "shape", None)
+        if shape is None:
+            try:
+                shape = np.asarray(v).shape
+            except Exception:
+                continue
+        if shape:
+            b = int(shape[0])
+            break
+    indivisible = b is not None and b % pp_m != 0
+    if indivisible:
+        report.add(
+            "pipeline:batch-indivisible", "warning",
+            f"batch {b} is not divisible by pp_microbatches={pp_m} — "
+            "pipeline_apply will reject the trace at the first step; "
+            "re-batch the feed or lower pp_microbatches",
+            where="DistStrategy.pp_microbatches", batch=b,
+            pp_microbatches=pp_m)
+    dshard = 1
+    if mesh is not None:
+        for a in ("dp", "fsdp"):
+            if a in mesh.axis_names:
+                dshard *= mesh.shape[a]
+    # the microbatch-divisibility math would divide by a lie when the
+    # batch itself is indivisible; the bubble estimate below depends
+    # only on the schedule shape and must still run
+    if b is not None and not indivisible and dshard > 1 \
+            and (b // pp_m) % dshard != 0:
+        report.add(
+            "pipeline:microbatch-indivisible", "warning",
+            f"microbatch size {b // pp_m} (batch {b} / "
+            f"pp_microbatches {pp_m}) is not divisible by the data-shard "
+            f"product {dshard} — lower pp_microbatches or raise the batch",
+            where="DistStrategy.pp_microbatches", batch=b,
+            pp_microbatches=pp_m, data_shards=dshard)
+    p = (mesh.shape["pp"] if mesh is not None
+         and "pp" in getattr(mesh, "axis_names", ()) else 1)
+    if p > 1:
+        from ..parallel.pipeline import bubble_fraction
+        frac = bubble_fraction(p, pp_m, pp_v)
+        sev = "warning" if frac > 0.2 else "info"
+        report.add(
+            "pipeline:bubble", sev,
+            f"schedule bubble is {frac:.1%} of ticks (pp={p}, "
+            f"microbatches={pp_m}, interleave={pp_v})"
+            + (" — raise pp_microbatches (ideally a multiple of pp) or "
+               "pp_interleave (V× less bubble, V× more neighbor-hop "
+               "activation traffic)" if frac > 0.2 else ""),
+            where="DistStrategy.pp_microbatches", bubble_fraction=frac,
+            pp=p, microbatches=pp_m, interleave=pp_v)
+
+
+# --------------------------------------------------------------------------
+# 8. HLO-level collective placement (optimized-HLO walk)
+# --------------------------------------------------------------------------
+
+_HLO_REDUCTIONS = frozenset({"all-reduce", "reduce-scatter", "all-gather",
+                             "all-to-all"})
+_HLO_PERMUTES = frozenset({"collective-permute", "collective-broadcast"})
+
+
+def check_hlo_collectives(units, report: LintReport) -> None:
+    """``collective:hlo-*`` — collective placement read off the
+    OPTIMIZED HLO of the compiled step (``profiling.fusion`` units),
+    catching what the jaxpr walk structurally cannot: collectives the
+    GSPMD partitioner *inserted* (the per-microbatch gradient exchange
+    is invisible pre-partitioning — ``collective:microbatch-exchange``
+    infers it from config; this sees it directly).
+
+    - ``collective:hlo-in-while`` (warning) — a reduction collective
+      inside a compiled while-loop body pays its wire every iteration;
+    - ``collective:hlo-unrolled-loop`` (warning) — N>1 copies of the
+      same source-level exchange whose op_name path shows a loop body:
+      XLA unrolled the loop, the per-iteration cost is now N× visible
+      instances (how XLA:CPU compiles small scans);
+    - ``collective:hlo-permute-in-while`` (info) — in-loop neighbor
+      permutes, the deliberate ring/pipeline structure, with bytes."""
+    from collections import Counter
+
+    unrolled: Counter = Counter()
+    unrolled_bytes: Dict[Any, int] = {}
+    for u in units:
+        src = u.source_ops[0] if u.source_ops else ""
+        if u.op in _HLO_REDUCTIONS and u.in_loop:
+            report.add(
+                "collective:hlo-in-while", "warning",
+                f"{u.op} ({u.out_bytes / 1e6:.3f} MB result, source "
+                f"{src or 'unknown'}) inside compiled while-loop body "
+                f"{u.computation!r} — the partitioned executable pays this "
+                "exchange EVERY iteration (×trip count wire); hoist the "
+                "exchange out of the loop "
+                "(DistStrategy.accum_exchange='hoisted') or confirm it is "
+                "deliberate schedule structure",
+                where=f"{u.computation}/{u.name}",
+                payload_bytes=u.out_bytes, source=src)
+        elif u.op in _HLO_PERMUTES and u.in_loop:
+            report.add(
+                "collective:hlo-permute-in-while", "info",
+                f"{u.op} ({u.out_bytes / 1e6:.3f} MB, source "
+                f"{src or 'unknown'}) inside while body {u.computation!r} "
+                "— expected for ring/pipeline schedules",
+                where=f"{u.computation}/{u.name}",
+                payload_bytes=u.out_bytes, source=src)
+        elif u.op in _HLO_REDUCTIONS and "while/body" in src:
+            key = (u.op, src)
+            unrolled[key] += 1
+            unrolled_bytes[key] = unrolled_bytes.get(key, 0) + u.out_bytes
+    for (op, src), n in sorted(unrolled.items()):
+        if n <= 1:
+            continue  # one instance = likely the hoisted/final exchange
+        total = unrolled_bytes[(op, src)]
+        report.add(
+            "collective:hlo-unrolled-loop", "warning",
+            f"{n} copies of {op} from loop-body source {src!r} "
+            f"({total / 1e6:.3f} MB total) — XLA unrolled the loop, so "
+            f"the per-iteration exchange is paid {n}×; same fix as "
+            "collective:hlo-in-while",
+            where=src, op=op, instances=n, payload_bytes=total, source=src)
+
+
+# --------------------------------------------------------------------------
+# 9. feed wire-format candidates
 # --------------------------------------------------------------------------
 
 # first-use primitives that prove a feed value is only ever cast or

@@ -117,18 +117,24 @@ def reset_profiler():
     _spans.clear()
 
 
-def timeline(path: str) -> int:
+def timeline(path: str, extra_spans=None) -> int:
     """tools/timeline.py:115 analog: dump recorded host spans as
     chrome://tracing JSON (device-side timelines come from the
     jax.profiler trace directory — perfetto-compatible). Returns the
-    number of events written."""
+    number of events written.
+
+    ``extra_spans`` — additional ``(name, start_us, dur_us, tid)``
+    tuples merged into the dump; the Trainer's always-on per-dispatch
+    spans (``profiling.steptime``) export through here so a trace
+    exists even when the global profiler was never enabled."""
     import json as _json
 
     events = [
         {"name": name, "ph": "X", "ts": ts, "dur": dur,
          "pid": 0, "tid": tid, "cat": "host"}
-        for name, ts, dur, tid in _spans
+        for name, ts, dur, tid in list(_spans) + list(extra_spans or [])
     ]
+    events.sort(key=lambda e: e["ts"])
     with open(path, "w") as f:
         _json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     return len(events)

@@ -278,8 +278,15 @@ class Trainer:
         # traced into the step program (fused — no extra dispatch)
         from .data.feeder import PipelineMetrics
         from .data.wire import FeedWire
+        from .profiling.steptime import StepTimer
         self.feed_wire = FeedWire.make(feed_wire)
         self.pipeline_metrics = PipelineMetrics()
+        # per-dispatch wall-time accounting (profiling.steptime):
+        # always-on — two clock reads per dispatch, <2% of step time
+        # test-pinned — and merged with pipeline_metrics by
+        # profile_report()
+        self.step_timer = StepTimer()
+        self._fusion_report = None  # cache: fusion_report(feed) result
         self.loss_scaler = None
         if strategy is not None and (getattr(strategy, "loss_scale", None)
                                      or getattr(strategy, "dynamic_loss_scale", False)):
@@ -884,9 +891,11 @@ class Trainer:
             rng = jax.random.fold_in(make_prng_key(get_flag("seed") + 1), self.global_step)
         feed = self._put_feed(feed)
         ls = getattr(self.scope, "loss_scale_state", None) or {}
+        t0 = _time.perf_counter()
         with profiler.record_event("trainer.step"):
             p, o, s, out, new_ls = self._step_fn(self.scope.params, self.scope.opt_state,
                                                  self.scope.state, rng, feed, ls)
+        self.step_timer.record_dispatch(t0, _time.perf_counter(), 1, "step")
         self._log_compile_cache("train step")
         self.scope.params, self.scope.opt_state, self.scope.state = p, o, s
         if self.loss_scaler is not None:
@@ -933,10 +942,13 @@ class Trainer:
         feed = self._put_feed(stacked_feed, stacked=True)
         ls = getattr(self.scope, "loss_scale_state", None) or {}
         step0 = np.int32(self.global_step)
+        t0 = _time.perf_counter()
         with profiler.record_event("trainer.run_steps"):
             p, o, s, outs, new_ls = self._multi_step_fn(
                 self.scope.params, self.scope.opt_state, self.scope.state,
                 rng, step0, feed, ls)
+        self.step_timer.record_dispatch(t0, _time.perf_counter(), k,
+                                        "run_steps")
         self._log_compile_cache(f"fused {k}-step program")
         self.scope.params, self.scope.opt_state, self.scope.state = p, o, s
         if self.loss_scaler is not None:
@@ -1084,6 +1096,45 @@ class Trainer:
         ``record=False`` suppresses the pipeline-metrics accounting —
         used when a DeviceFeeder owns the timing of this call."""
         metrics = self.pipeline_metrics if record else None
+        return self._put_feed_impl(feed, stacked, metrics)
+
+    def fusion_report(self, feed: Feed, top_k: int = 8) -> Dict[str, Any]:
+        """Fusion-level cost attribution of the compiled train step
+        (profiling.fusion): parses the executable's optimized HLO into
+        per-fusion units with bytes + analytic FLOPs + source-level op
+        names and ranks the top-k by roofline cost. Re-lowers and
+        re-compiles the step (same cost as
+        ``debugger.collective_report``); the result is cached and rides
+        along in :meth:`profile_report`."""
+        from .profiling import fusion_report as _fusion_report
+        self._fusion_report = _fusion_report(self, feed, top_k=top_k)
+        return self._fusion_report
+
+    def profile_report(self) -> Dict[str, Any]:
+        """The unified step profile (profiling.steptime): per-dispatch
+        wall-time totals merged with the input-pipeline stage report
+        into a compute / h2d / host-encode / starvation breakdown with
+        a named bottleneck, plus the cached fusion table when
+        :meth:`fusion_report` has run. Emitted as ``Event.profile`` on
+        ``end_epoch``/``preempted``; see MIGRATION.md "Profiling &
+        memory advisor" for the schema."""
+        from .profiling import profile_report as _profile_report
+        return _profile_report(self, fusion=self._fusion_report)
+
+    def export_trace(self, path: str) -> int:
+        """Write the retained dispatch spans (and any enabled-profiler
+        host spans) as chrome://tracing JSON via the ``core.profiler``
+        timeline machinery. Returns the number of events written."""
+        from .profiling import export_chrome_trace
+        return export_chrome_trace(self, path)
+
+    def reset_profile(self) -> None:
+        """Zero the step-timer and pipeline-stage accumulators (e.g.
+        between warmup and a measured window)."""
+        self.step_timer.reset()
+        self.pipeline_metrics.reset()
+
+    def _put_feed_impl(self, feed: Feed, stacked, metrics):
         if self.feed_wire is not None:
             t0 = _time.perf_counter()
             encoded = self.feed_wire.encode(feed)
@@ -1141,10 +1192,15 @@ class Event:
 
     ``pipeline`` carries the input-pipeline stage report
     (``Trainer.pipeline_report()``) on ``end_epoch``/``preempted``
-    events — per-stage time, wire bytes, h2d MB/s, bottleneck stage."""
+    events — per-stage time, wire bytes, h2d MB/s, bottleneck stage.
+    ``profile`` carries the unified step profile
+    (``Trainer.profile_report()``) on the same events — per-dispatch
+    wall time, the compute/h2d/host-encode/starvation breakdown with
+    its named bottleneck, and the cached fusion table when one was
+    computed."""
 
     def __init__(self, kind: str, epoch: int, step: int, metrics=None,
-                 num_steps: int = 1, pipeline=None):
+                 num_steps: int = 1, pipeline=None, profile=None):
         # begin_epoch | end_epoch | begin_step | end_step | preempted
         self.kind = kind
         self.epoch = epoch
@@ -1152,6 +1208,7 @@ class Event:
         self.metrics = metrics or {}
         self.num_steps = num_steps
         self.pipeline = pipeline
+        self.profile = profile
 
 
 def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
@@ -1359,15 +1416,22 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
                          steps_in_epoch)
                 _io.wait_for_checkpoints()
                 if event_handler:
+                    # ONE profile snapshot: Event.pipeline aliases its
+                    # pipeline section, so handlers comparing the two
+                    # never see the fill thread advance between them
+                    profile = trainer.profile_report()
                     event_handler(Event("preempted", epoch,
                                         trainer.global_step,
-                                        pipeline=trainer.pipeline_report()))
+                                        pipeline=profile["pipeline"],
+                                        profile=profile))
                 if guard_err is not None:
                     raise guard_err
                 return trainer
             if event_handler:
+                profile = trainer.profile_report()
                 event_handler(Event("end_epoch", epoch, trainer.global_step,
-                                    pipeline=trainer.pipeline_report()))
+                                    pipeline=profile["pipeline"],
+                                    profile=profile))
             if checkpoint_config and checkpoint_config.epoch_interval and \
                     (epoch + 1) % checkpoint_config.epoch_interval == 0:
                 save(f"epoch_{epoch}", epoch + 1, 0)

@@ -1,0 +1,129 @@
+"""Step-time breakdown: per-dispatch wall time + unified profile report.
+
+PR 4's ``PipelineMetrics`` named the input-pipeline bottleneck; this
+module extends that discipline to the compiled step itself. The Trainer
+records every ``step``/``run_steps`` dispatch into a :class:`StepTimer`
+(two ``perf_counter`` reads and a list append — cheap enough to stay
+always-on; the <2% overhead contract is test-pinned), and
+``Trainer.profile_report()`` merges the dispatch timeline with
+``pipeline_report()`` into one compute / h2d / host-encode / starvation
+breakdown, emitted on ``Event.end_epoch``.
+
+Honesty note: dispatches are ASYNC on accelerators — the recorded
+per-dispatch wall time is what the *training-loop thread* spent in the
+call (submission + any implicit drain when the runtime backpressures on
+donated buffers). Over a steady-state run the loop thread is either
+inside dispatch calls (device-bound) or starved waiting for input
+(input-bound), so the two totals attribute the wall clock end to end;
+single-dispatch numbers are a lower bound on device time.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+# ring-buffer cap on retained spans: a week-long fit must not grow an
+# unbounded list just because profiling is always-on
+_MAX_SPANS = 8192
+
+
+class StepTimer:
+    """Per-dispatch wall-time accumulator (training-loop thread only —
+    no locking needed; the DeviceFeeder stages have their own
+    thread-safe PipelineMetrics)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.dispatches = 0
+        self.steps = 0
+        self.dispatch_s = 0.0
+        self.first_t0: Optional[float] = None
+        self.last_t1: Optional[float] = None
+        self._spans: deque = deque(maxlen=_MAX_SPANS)
+
+    def record_dispatch(self, t0: float, t1: float, num_steps: int = 1,
+                        kind: str = "step") -> None:
+        """Record one step()/run_steps() call: ``t0``/``t1`` are
+        ``time.perf_counter()`` readings around the dispatch."""
+        self.dispatches += 1
+        self.steps += num_steps
+        self.dispatch_s += t1 - t0
+        if self.first_t0 is None:
+            self.first_t0 = t0
+        self.last_t1 = t1
+        self._spans.append((kind, num_steps, t0, t1))
+
+    def spans_us(self) -> List[Tuple[str, float, float, int]]:
+        """Retained dispatch spans as ``(name, start_us, dur_us, tid)``
+        tuples — the shape ``core.profiler.timeline`` consumes."""
+        return [(f"trainer.{kind}[{n}]", t0 * 1e6, (t1 - t0) * 1e6, 1)
+                for kind, n, t0, t1 in self._spans]
+
+    def report(self) -> Dict[str, Any]:
+        span = ((self.last_t1 - self.first_t0)
+                if self.first_t0 is not None else 0.0)
+        return {
+            "steps": self.steps,
+            "dispatches": self.dispatches,
+            "dispatch_s": round(self.dispatch_s, 6),
+            "span_s": round(span, 6),
+            "avg_step_ms": (round(self.dispatch_s / self.steps * 1e3, 4)
+                            if self.steps else None),
+            "avg_dispatch_ms": (round(self.dispatch_s / self.dispatches * 1e3,
+                                      4) if self.dispatches else None),
+            "spans_retained": len(self._spans),
+        }
+
+
+def profile_report(trainer, fusion: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+    """The unified step profile: dispatch timing + input-pipeline stage
+    attribution + (optionally) a cached fusion table, with a named
+    bottleneck. Schema (MIGRATION.md "Profiling & memory advisor"):
+
+    - ``steps`` / ``dispatches`` / ``avg_step_ms`` / ``span_s`` — from
+      the per-dispatch :class:`StepTimer`;
+    - ``breakdown`` — seconds per attribution bucket: ``compute_s``
+      (training-loop thread inside dispatch calls), ``h2d_s`` (device
+      puts), ``host_encode_s`` (wire encode), ``reader_s`` (host reader
+      wait), ``starved_s`` (loop thread waiting for input). With
+      prefetch the feeder buckets overlap compute — ``starved_s`` is
+      the non-overlapped input-bound signal;
+    - ``bottleneck`` — the largest bucket, with ``input_bound`` carried
+      from the pipeline report;
+    - ``pipeline`` — the full ``pipeline_report()``;
+    - ``fusion`` — the top-k fusion table when one has been computed
+      (``Trainer.fusion_report``), else None.
+    """
+    st = trainer.step_timer.report()
+    pipe = trainer.pipeline_report()
+    stages = pipe.get("stages_s", {})
+    breakdown = {
+        "compute_s": st["dispatch_s"],
+        "h2d_s": stages.get("h2d", 0.0),
+        "host_encode_s": stages.get("encode", 0.0),
+        "reader_s": stages.get("reader", 0.0),
+        "starved_s": pipe.get("consumer_starved_s", 0.0),
+    }
+    bottleneck = (max(breakdown, key=breakdown.get)
+                  if any(v > 0 for v in breakdown.values()) else None)
+    return {
+        **st,
+        "breakdown": {k: round(v, 6) for k, v in breakdown.items()},
+        "bottleneck": bottleneck,
+        "input_bound": pipe.get("input_bound", False),
+        "pipeline": pipe,
+        "fusion": fusion,
+    }
+
+
+def export_chrome_trace(trainer, path: str) -> int:
+    """Dump the trainer's retained dispatch spans (plus any host spans
+    the ``core.profiler`` collected while enabled) as chrome://tracing
+    JSON. Returns the number of events written."""
+    from ..core import profiler
+
+    return profiler.timeline(path, extra_spans=trainer.step_timer.spans_us())

@@ -28,7 +28,7 @@ def test_priority_order_leads_with_baseline_configs():
     # every registered config appears exactly once
     expect = (set(bench.TRAIN_CONFIGS) | set(bench.INFER_CONFIGS)
               | {"gpt_decode", "dispatch_overhead", "guard_overhead",
-                 "input_pipeline", "serving"})
+                 "input_pipeline", "serving", "fusion_profile"})
     assert set(names) == expect and len(names) == len(expect)
 
 
@@ -101,6 +101,57 @@ def test_serving_quick_overrides(monkeypatch):
     bench._run_one("serving", 1.0, quick=True)
     assert seen == {"requests": 40}
     assert bench._result_key("serving") == "serving"
+
+
+def test_fusion_profile_quick_overrides(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(bench, "bench_fusion_profile",
+                        lambda peak, **kw: seen.update(kw) or {"v": 1})
+    bench._run_one("fusion_profile", 1.0, quick=True)
+    assert seen == {"iters": 2, "batch_size": 4, "seq": 64}
+    assert bench._result_key("fusion_profile") == "fusion_profile"
+
+
+def test_train_rows_carry_top_fusions(monkeypatch):
+    """Every train row records its top-k fusion table (the regression-
+    attribution contract: two BENCH records diff via
+    tools/profile_diff.py by these rows' stable keys), and a fusion
+    failure degrades to an error field, never a lost row."""
+    table = [{"key": "dot|dense/matmul|f32[8,8]", "name": "dot.1",
+              "op": "dot", "kind": "dot", "computation": "main",
+              "in_loop": False, "flops": 1024.0, "bytes": 768,
+              "out_bytes": 256, "source_ops": ["dense/matmul"],
+              "cost_frac": 0.9}]
+
+    class _T:
+        feed_wire = None
+
+        def fusion_report(self, feed, top_k=8):
+            return {"top_fusions": table, "n_units": 12,
+                    "coverage_top_k": 0.97, "temp_mb": 1.5}
+
+    row = bench._result(8, "samples/sec", 1e-3, 1e-3, 1e6, 1e12,
+                        trainer=_T(), feed={"x": 1})
+    assert row["top_fusions"] == table
+    assert row["fusion_n_units"] == 12
+    assert row["fusion_coverage_top_k"] == 0.97
+    assert row["temp_mb"] == 1.5
+
+    class _Broken(_T):
+        def fusion_report(self, feed, top_k=8):
+            raise RuntimeError("no HLO text on this backend")
+
+    row = bench._result(8, "samples/sec", 1e-3, 1e-3, 1e6, 1e12,
+                        trainer=_Broken(), feed={"x": 1})
+    assert "top_fusions" not in row
+    assert "no HLO text" in row["top_fusions_error"]
+    assert row["value"] > 0  # the row itself survived
+
+    # BENCH_FUSIONS=0 opt-out: no fusion work attempted
+    monkeypatch.setenv("BENCH_FUSIONS", "0")
+    row = bench._result(8, "samples/sec", 1e-3, 1e-3, 1e6, 1e12,
+                        trainer=_Broken(), feed={"x": 1})
+    assert "top_fusions" not in row and "top_fusions_error" not in row
 
 
 def test_serving_row_schema(monkeypatch):

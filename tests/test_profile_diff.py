@@ -1,0 +1,136 @@
+"""tools/profile_diff.py — bench regression attribution.
+
+The acceptance scenario: two recorded BENCH json files whose train rows
+carry ``top_fusions`` tables diff to "THIS fusion got slower" — an
+injected slowdown must be localized to the right fusion key. Also
+pinned: appeared/vanished fusions (compiler re-fusions), the --config
+filter, --json output, and the exit-2 contract on records with nothing
+diffable (historical BENCH records predating ``top_fusions``)."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from tools import profile_diff
+
+
+def _record(configs):
+    """A BENCH-suite-shaped record (the thing bench.py emits)."""
+    return {"metric": "suite", "value": 0.5, "unit": "MFU",
+            "configs": configs}
+
+
+def _row(step_ms, fusions):
+    return {"value": 100.0, "unit": "samples/sec", "step_time_ms": step_ms,
+            "top_fusions": [
+                {"key": k, "name": k.split("|")[0], "op": k.split("|")[0],
+                 "kind": "loop", "computation": "main", "in_loop": False,
+                 "flops": fl, "bytes": by, "out_bytes": by // 2,
+                 "source_ops": [k.split("|")[1]], "cost_frac": cf}
+                for (k, cf, fl, by) in fusions]}
+
+
+def test_injected_slowdown_localized_to_right_fusion(tmp_path):
+    """Run A: three fusions at 50/30/20% of a 10ms step. Run B: the
+    matmul fusion tripled (a regression injected into that one fusion:
+    its cost share AND the step time rise). The diff must rank it
+    slowest — not the fusions whose absolute share merely drifted."""
+    a = _record({"mnist_mlp_train": _row(10.0, [
+        ("dot|dense/matmul|f32[128,200]", 0.50, 2e9, 4_000_000),
+        ("fusion|mlp/relu|f32[128,200]", 0.30, 1e6, 2_000_000),
+        ("reduce|loss/sum|f32[]", 0.20, 5e5, 1_000_000),
+    ])})
+    b = _record({"mnist_mlp_train": _row(20.0, [
+        ("dot|dense/matmul|f32[128,200]", 0.75, 6e9, 12_000_000),
+        ("fusion|mlp/relu|f32[128,200]", 0.15, 1e6, 2_000_000),
+        ("reduce|loss/sum|f32[]", 0.10, 5e5, 1_000_000),
+    ])})
+    fa, fb = tmp_path / "BENCH_rA.json", tmp_path / "BENCH_rB.json"
+    fa.write_text(json.dumps(a))
+    fb.write_text(json.dumps(b))
+
+    diff = profile_diff.diff_records(a, b)
+    d = diff["configs"]["mnist_mlp_train"]
+    assert d["step_delta_ms"] == 10.0
+    assert d["slowest"] == "dot|dense/matmul|f32[128,200]"
+    top = d["fusions"][0]
+    # 0.50*10ms -> 0.75*20ms: +10ms of the +10ms regression
+    assert top["est_ms_a"] == 5.0 and top["est_ms_b"] == 15.0
+    assert top["delta_ms"] == 10.0 and top["status"] == "common"
+    assert top["flops_b"] > top["flops_a"]  # program-level evidence
+    # the relu fusion stayed flat in absolute terms: 3ms -> 3ms
+    relu = next(e for e in d["fusions"]
+                if e["key"].startswith("fusion|mlp/relu"))
+    assert relu["delta_ms"] == 0.0
+
+    # the CLI over the two recorded files agrees and exits 0
+    rc = profile_diff.main([str(fa), str(fb)])
+    assert rc == 0
+
+
+def test_appeared_and_vanished_fusions(capsys):
+    a = _record({"m_train": _row(10.0, [
+        ("dot|a|f32[8,8]", 0.9, 1e6, 1000),
+        ("fusion|gone|f32[4]", 0.1, 1e3, 100)])})
+    b = _record({"m_train": _row(10.0, [
+        ("dot|a|f32[8,8]", 0.8, 1e6, 1000),
+        ("fusion|new|f32[16]", 0.2, 2e3, 200)])})
+    d = profile_diff.diff_records(a, b)["configs"]["m_train"]
+    status = {e["key"]: e["status"] for e in d["fusions"]}
+    assert status["fusion|gone|f32[4]"] == "vanished"
+    assert status["fusion|new|f32[16]"] == "appeared"
+    assert status["dot|a|f32[8,8]"] == "common"
+    out = profile_diff.render(profile_diff.diff_records(a, b))
+    assert "m_train" in out and "appeared" in out and "vanished" in out
+
+
+def test_config_filter_and_json_output(tmp_path, capsys):
+    rec = _record({"a_train": _row(1.0, [("dot|x|f32[2]", 1.0, 1.0, 8)]),
+                   "b_train": _row(2.0, [("dot|y|f32[2]", 1.0, 1.0, 8)])})
+    fa, fb = tmp_path / "a.json", tmp_path / "b.json"
+    fa.write_text(json.dumps(rec))
+    fb.write_text(json.dumps(rec))
+    rc = profile_diff.main([str(fa), str(fb), "--config", "b_train",
+                            "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert list(doc["configs"]) == ["b_train"]
+    assert doc["configs"]["b_train"]["step_delta_ms"] == 0.0
+
+
+def test_historical_records_without_top_fusions_exit_2(tmp_path, capsys):
+    """Pre-PR-6 BENCH records carry no top_fusions: the CLI must say
+    'nothing compared' (exit 2), not fake a clean diff. Exercised
+    against the repo's real recorded BENCH files."""
+    here = os.path.join(os.path.dirname(__file__), os.pardir)
+    r04, r05 = (os.path.join(here, f"BENCH_r0{n}.json") for n in (4, 5))
+    if not (os.path.exists(r04) and os.path.exists(r05)):
+        pytest.skip("recorded BENCH files not present")
+    rc = profile_diff.main([r04, r05])
+    assert rc == 2
+
+
+def test_rows_accepts_raw_envelope_and_bare_row():
+    row = _row(1.0, [("dot|x|f32[2]", 1.0, 1.0, 8)])
+    assert profile_diff._rows({"result": row}) == {"<row>": row}
+    assert profile_diff._rows(row) == {"<row>": row}
+    assert profile_diff._rows({"configs": {"x_train": {"value": 1}}}) == {}
+
+
+def test_fusion_profile_row_diffs_via_avg_step_ms():
+    """The fusion_profile suite row records avg_step_ms rather than
+    step_time_ms; the diff accepts either clock."""
+    row_a = {"avg_step_ms": 4.0,
+             "top_fusions": [{"key": "dot|q|f32[4]", "cost_frac": 1.0,
+                              "flops": 1.0, "bytes": 8, "source_ops": []}]}
+    row_b = {"avg_step_ms": 8.0,
+             "top_fusions": [{"key": "dot|q|f32[4]", "cost_frac": 1.0,
+                              "flops": 1.0, "bytes": 8, "source_ops": []}]}
+    d = profile_diff.diff_rows(row_a, row_b)
+    assert d["step_delta_ms"] == 4.0
+    assert d["fusions"][0]["delta_ms"] == 4.0

@@ -1,0 +1,163 @@
+"""Fusion-level diff of two bench records — regression attribution.
+
+Turns "the suite got slower between BENCH_r04 and BENCH_r05" into "this
+fusion got slower": every bench train row (and the ``fusion_profile``
+suite row) records its ``top_fusions`` table — per-fusion roofline cost
+fractions over the compiled step's optimized HLO, keyed by a stable
+``op|source_op|shape`` identity that survives recompiles — so two
+records diff straight to named fusions.
+
+Attribution model (honest about what it is): a fusion's estimated
+milliseconds in a run is ``cost_frac × step_time_ms`` — the measured
+step time spread across fusions by their static roofline share. A
+program-level regression (an op got bigger, a fusion broke apart, a new
+fusion appeared) moves ``cost_frac``/``flops``/``bytes`` and is
+localized exactly; a pure runtime regression with an unchanged program
+spreads proportionally across all fusions (the diff then shows a
+uniform scale-up, which is itself the diagnosis: not one fusion, the
+whole step — look at the breakdown/link fields instead).
+
+Usage::
+
+    python tools/profile_diff.py BENCH_r04.json BENCH_r05.json
+    python tools/profile_diff.py A.json B.json --config transformer_train
+    python tools/profile_diff.py A.json B.json --json
+
+Exit status: 0 on a clean diff, 2 when the records share no diffable
+rows (so CI can tell "no regression" from "nothing was compared").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _rows(record: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """Diffable rows of a record: suite records contribute every config
+    that carries a ``top_fusions`` table; a bare single row (the
+    ``--emit raw`` result payload, or a saved ``fusion_report``) is
+    accepted as one row."""
+    if isinstance(record.get("configs"), dict):
+        return {k: v for k, v in record["configs"].items()
+                if isinstance(v, dict) and v.get("top_fusions")}
+    if record.get("top_fusions"):
+        return {"<row>": record}
+    if isinstance(record.get("result"), dict):  # --emit raw envelope
+        return _rows(record["result"])
+    return {}
+
+
+def _step_ms(row: Dict[str, Any]) -> Optional[float]:
+    for key in ("step_time_ms", "avg_step_ms"):
+        v = row.get(key)
+        if isinstance(v, (int, float)):
+            return float(v)
+    return None
+
+
+def diff_rows(a: Dict[str, Any], b: Dict[str, Any],
+              top: int = 10) -> Dict[str, Any]:
+    """Diff one config row pair; returns the per-fusion deltas ranked
+    by absolute estimated-ms change, with appeared/vanished fusions
+    (a fusion the compiler split or newly formed) kept in the ranking."""
+    ams, bms = _step_ms(a), _step_ms(b)
+    fa = {f["key"]: f for f in a.get("top_fusions", [])}
+    fb = {f["key"]: f for f in b.get("top_fusions", [])}
+    entries: List[Dict[str, Any]] = []
+    for key in set(fa) | set(fb):
+        ra, rb = fa.get(key), fb.get(key)
+        ea = (ra["cost_frac"] * ams) if ra is not None and ams else None
+        eb = (rb["cost_frac"] * bms) if rb is not None and bms else None
+        src = (rb or ra).get("source_ops", [])
+        entries.append({
+            "key": key,
+            "status": ("common" if ra is not None and rb is not None
+                       else ("appeared" if rb is not None else "vanished")),
+            "est_ms_a": round(ea, 4) if ea is not None else None,
+            "est_ms_b": round(eb, 4) if eb is not None else None,
+            "delta_ms": round((eb or 0.0) - (ea or 0.0), 4),
+            "cost_frac_a": ra["cost_frac"] if ra is not None else None,
+            "cost_frac_b": rb["cost_frac"] if rb is not None else None,
+            "flops_a": ra["flops"] if ra is not None else None,
+            "flops_b": rb["flops"] if rb is not None else None,
+            "bytes_a": ra["bytes"] if ra is not None else None,
+            "bytes_b": rb["bytes"] if rb is not None else None,
+            "source_ops": src,
+        })
+    entries.sort(key=lambda e: (-abs(e["delta_ms"]), e["key"]))
+    slower = [e for e in entries if e["delta_ms"] > 0]
+    return {
+        "step_ms_a": ams,
+        "step_ms_b": bms,
+        "step_delta_ms": (round(bms - ams, 4)
+                          if ams is not None and bms is not None else None),
+        "slowest": slower[0]["key"] if slower else None,
+        "fusions": entries[:max(1, top)],
+    }
+
+
+def diff_records(rec_a: Dict[str, Any], rec_b: Dict[str, Any],
+                 config: Optional[str] = None,
+                 top: int = 10) -> Dict[str, Any]:
+    """Diff every config present in BOTH records (or just ``config``)."""
+    rows_a, rows_b = _rows(rec_a), _rows(rec_b)
+    keys = sorted(set(rows_a) & set(rows_b))
+    if config is not None:
+        keys = [k for k in keys if k == config]
+    return {"configs": {k: diff_rows(rows_a[k], rows_b[k], top=top)
+                        for k in keys}}
+
+
+def _fmt(v, unit="") -> str:
+    return "-" if v is None else f"{v}{unit}"
+
+
+def render(diff: Dict[str, Any]) -> str:
+    lines = []
+    for name, d in diff["configs"].items():
+        lines.append(f"== {name}: step {_fmt(d['step_ms_a'], ' ms')} -> "
+                     f"{_fmt(d['step_ms_b'], ' ms')} "
+                     f"(delta {_fmt(d['step_delta_ms'], ' ms')})")
+        if d["slowest"]:
+            lines.append(f"   slowest-moving fusion: {d['slowest']}")
+        for e in d["fusions"]:
+            src = e["source_ops"][0] if e["source_ops"] else ""
+            lines.append(
+                f"   {e['delta_ms']:+9.4f} ms  {e['status']:<8} "
+                f"{e['key']}  [{src}]")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Diff the per-fusion cost attribution of two bench "
+                    "records (BENCH_r*.json) — names which fusion a step-"
+                    "time regression lives in.")
+    p.add_argument("record_a")
+    p.add_argument("record_b")
+    p.add_argument("--config", default=None,
+                   help="diff only this config row (e.g. transformer_train)")
+    p.add_argument("--top", type=int, default=10,
+                   help="fusions to show per config (by |delta|)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    args = p.parse_args(argv)
+    with open(args.record_a) as f:
+        rec_a = json.load(f)
+    with open(args.record_b) as f:
+        rec_b = json.load(f)
+    diff = diff_records(rec_a, rec_b, config=args.config, top=args.top)
+    if args.as_json:
+        print(json.dumps(diff, indent=2))
+    else:
+        out = render(diff)
+        print(out if out.strip() else "(no rows with top_fusions in common)")
+    return 0 if diff["configs"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
