@@ -27,23 +27,46 @@ ProgramDesc-validator analog, SURVEY §3): a walker over ``Program.desc``
    could cross the host→device link as uint8/bf16 wire with the decode
    fused into the step (data/wire.py).
 
-Three front doors: programmatic :func:`check` / :func:`check_trainer`,
-``Trainer.startup(lint="warn"|"error")``, and the CLI
-``python -m paddle_tpu.analysis --model mnist`` (also
-``tools/lint_program.py``).
+Two further families reach past the single program:
+
+8. MoE routing capacity — static ``capacity_factor``/``top_k`` combos
+   whose expected token drop rate exceeds a threshold (``moe:capacity``);
+9. replicated optimizer state — opt-state accumulators fully replicated
+   across a data axis above a size threshold, the ZeRO trigger
+   (``sharding:replicated-optstate``).
+
+And the checker's cross-ARTIFACT layer, :mod:`.contracts`
+(:func:`check_artifacts`): static compatibility proofs between trainer
+programs, checkpoint manifests, serving artifacts, and mesh specs —
+``ckpt:*`` / ``artifact:*`` findings whose runtime counterparts are
+crashes (``CheckpointCorrupt``, ``ReloadFailed``, sharding aborts).
+
+Four front doors: programmatic :func:`check` / :func:`check_trainer` /
+:func:`check_artifacts`, ``Trainer.startup(lint="warn"|"error")``, the
+CLI ``python -m paddle_tpu.analysis --model mnist`` (also
+``tools/lint_program.py``), and the CI gate ``tools/lint_gate.py --ci``
+(stable finding fingerprints + a committed baseline file + SARIF).
 """
 
 from .check import check, check_trainer
+from .contracts import (check_artifacts, check_reload_compat, serving_spec,
+                        trainer_specs)
 from .report import (Finding, LintError, LintReport, LintWarning,
-                     active_report, collect_into)
+                     active_report, apply_severity, baseline_key,
+                     collect_into, load_baseline, new_findings, to_sarif,
+                     write_baseline)
 from .walker import (COLLECTIVES, PERMUTE_COLLECTIVES,
                      REDUCTION_COLLECTIVES, aval_bytes, eqn_subjaxprs,
                      iter_eqns, walk_jaxprs)
 
 __all__ = [
     "check", "check_trainer",
+    "check_artifacts", "check_reload_compat", "serving_spec",
+    "trainer_specs",
     "Finding", "LintError", "LintReport", "LintWarning",
     "active_report", "collect_into",
+    "apply_severity", "baseline_key", "load_baseline", "new_findings",
+    "to_sarif", "write_baseline",
     "COLLECTIVES", "PERMUTE_COLLECTIVES", "REDUCTION_COLLECTIVES",
     "aval_bytes", "eqn_subjaxprs", "iter_eqns", "walk_jaxprs",
 ]

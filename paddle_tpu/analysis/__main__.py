@@ -3,9 +3,20 @@
     python -m paddle_tpu.analysis --model mnist
     python -m paddle_tpu.analysis --model moe_transformer --amp bfloat16 \
         --mesh fsdp=8 --rules fsdp --fail-on warning --format json
+    python -m paddle_tpu.analysis --model gpt --amp bfloat16 --ci \
+        --baseline tools/analysis_baseline.json
 
-Exit status: 0 when the report is clean at ``--fail-on`` (default
-``warning``), 1 otherwise — CI-greppable like any linter.
+Exit status (CI contract, also the ``tools/lint_gate.py`` contract):
+
+- **0** — clean at ``--fail-on`` (default ``warning``); under ``--ci``,
+  no finding whose fingerprint is absent from ``--baseline``.
+- **1** — findings present (new findings under ``--ci``), each printed
+  with its stable fingerprint so the failing PR can name what changed.
+- **3** — the checker itself crashed (import error, trace explosion,
+  bad baseline file). Distinct from 1 so CI can tell "your change
+  introduced a finding" from "the checker is broken" — a crash must
+  never read as a lint pass OR as the PR author's finding. (2 is
+  argparse's usage-error exit, left untouched.)
 """
 
 from __future__ import annotations
@@ -13,6 +24,14 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import traceback
+
+
+def _usage_error(msg: str) -> "SystemExit":
+    """A bad flag VALUE is a usage error — exit 2, argparse's own code,
+    never 1 (findings) or 3 (checker crash)."""
+    print(msg, file=sys.stderr)
+    return SystemExit(2)
 
 
 def _parse_mesh(spec: str):
@@ -29,8 +48,32 @@ def _parse_rules(name: str):
     table = {"replicated": replicated, "fsdp": fsdp,
              "tp": transformer_tp_rules}
     if name not in table:
-        raise SystemExit(f"--rules must be one of {sorted(table)}")
+        raise _usage_error(f"--rules must be one of {sorted(table)}")
     return table[name]()
+
+
+def _parse_severity(pairs):
+    from .report import SEVERITIES
+
+    overrides = {}
+    for pair in pairs or ():
+        code, sep, sev = pair.partition("=")
+        if not sep:
+            raise _usage_error(
+                f"--severity takes code=level (e.g. moe:capacity=error), "
+                f"got {pair!r}")
+        sev = sev.strip()
+        if sev not in SEVERITIES:
+            # reject here, BEFORE the model build: a typo'd level must
+            # be exit 2, not a paid-for exit-3 "checker crashed"
+            raise _usage_error(
+                f"--severity level must be one of {SEVERITIES}, "
+                f"got {sev!r}")
+        overrides[code.strip()] = sev
+    return overrides
+
+
+EXIT_CLEAN, EXIT_FINDINGS, EXIT_INTERNAL = 0, 1, 3
 
 
 def main(argv=None) -> int:
@@ -40,7 +83,8 @@ def main(argv=None) -> int:
     ap.add_argument("--model", required=True,
                     help="zoo model: mnist | transformer | moe_transformer | gpt")
     ap.add_argument("--variant", default="",
-                    help="model variant (mnist: mlp|conv)")
+                    help="model variant (mnist: mlp|conv; "
+                         "moe_transformer: tight)")
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=16)
     ap.add_argument("--mesh", default="",
@@ -63,29 +107,95 @@ def main(argv=None) -> int:
     ap.add_argument("--level", default="info",
                     choices=("info", "warning", "error"),
                     help="minimum severity to print")
-    ap.add_argument("--format", default="text", choices=("text", "json"))
+    ap.add_argument("--format", default="text",
+                    choices=("text", "json", "sarif"))
+    ap.add_argument("--severity", action="append", metavar="CODE=LEVEL",
+                    help="override a finding code's (or whole family's) "
+                         "severity, e.g. --severity moe:capacity=error; "
+                         "repeatable")
+    ap.add_argument("--baseline", default="",
+                    help="baseline suppression file: fingerprints listed "
+                         "there never fail the run")
+    ap.add_argument("--write-baseline", default="", metavar="PATH",
+                    help="write the run's findings as a new baseline file "
+                         "and exit 0 (freeze today's findings as accepted)")
+    ap.add_argument("--ci", action="store_true",
+                    help="gate mode: fail (exit 1) only on findings NOT in "
+                         "--baseline, printing each new fingerprint")
+    ap.add_argument("--subject", default="",
+                    help="baseline subject key (default: "
+                         "model[.variant][.amp] — the tools/lint_gate.py "
+                         "naming, so its committed baseline suppresses "
+                         "CLI runs of the same config)")
     args = ap.parse_args(argv)
+    overrides = _parse_severity(args.severity)
 
-    from . import check
-    from .zoo import build_model
+    from .report import (apply_severity, load_baseline, new_findings,
+                         to_sarif, write_baseline)
 
-    program, feed = build_model(args.model, args.variant, args.batch, args.seq)
-    mesh = _parse_mesh(args.mesh) if args.mesh else None
-    rules = _parse_rules(args.rules) if args.rules else None
-    strategy = None
-    if args.pp_microbatches:
-        from ..parallel import DistStrategy
-        strategy = DistStrategy(pp_microbatches=args.pp_microbatches,
-                                pp_interleave=args.pp_interleave)
-    select = {s.strip() for s in args.select.split(",") if s.strip()} or None
-    report = check(program, feed, mesh=mesh, rules=rules, strategy=strategy,
-                   amp=args.amp or None, loss_name=args.loss_name,
-                   select=select)
-    if args.format == "json":
-        print(json.dumps(report.to_dict(), indent=1, default=str))
-    else:
-        print(report.render(args.level))
-    return 0 if report.ok(args.fail_on) else 1
+    # everything from here is "the checker ran": a crash is exit 3, not
+    # a finding verdict — argparse usage errors above stay exit 2
+    try:
+        from . import check
+        from .zoo import build_model
+
+        # the subject scopes baseline keys: it must match what
+        # tools/lint_gate.py names the same config ("gpt.amp") or the
+        # committed baseline can never suppress a CLI run of it
+        subject = args.subject or (
+            args.model + (f".{args.variant}" if args.variant else "")
+            + (".amp" if args.amp else ""))
+        program, feed = build_model(args.model, args.variant, args.batch,
+                                    args.seq)
+        mesh = _parse_mesh(args.mesh) if args.mesh else None
+        rules = _parse_rules(args.rules) if args.rules else None
+        strategy = None
+        if args.pp_microbatches:
+            from ..parallel import DistStrategy
+            strategy = DistStrategy(pp_microbatches=args.pp_microbatches,
+                                    pp_interleave=args.pp_interleave)
+        select = ({s.strip() for s in args.select.split(",") if s.strip()}
+                  or None)
+        report = check(program, feed, mesh=mesh, rules=rules,
+                       strategy=strategy, amp=args.amp or None,
+                       loss_name=args.loss_name, select=select)
+        apply_severity(report, overrides)
+
+        if args.write_baseline:
+            doc = write_baseline(args.write_baseline, [(subject, report)])
+            print(f"wrote baseline {args.write_baseline} "
+                  f"({len(doc['baseline'])} suppressed fingerprints)")
+            return EXIT_CLEAN
+
+        baseline = load_baseline(args.baseline or None)
+        fresh = new_findings(subject, report, baseline, args.fail_on)
+
+        if args.format == "json":
+            print(json.dumps(report.to_dict(), indent=1, default=str))
+        elif args.format == "sarif":
+            print(json.dumps(to_sarif([(subject, report)]), indent=1))
+        else:
+            print(report.render(args.level))
+        if (args.ci or args.baseline) and fresh:
+            # stderr: stdout stays machine-parseable under json/sarif
+            print(f"{len(fresh)} new finding(s) vs baseline "
+                  f"{args.baseline or '<empty>'}:", file=sys.stderr)
+            for f in fresh:
+                print(f"  {f.fingerprint}", file=sys.stderr)
+    except Exception:
+        # NOT BaseException: SystemExit keeps its own code and a ^C
+        # (KeyboardInterrupt, conventional 130) must stay a cancelled
+        # run, never read as "the checker is broken"
+        traceback.print_exc()
+        print("analysis: internal error (exit 3) — the checker crashed; "
+              "this is NOT a lint verdict", file=sys.stderr)
+        return EXIT_INTERNAL
+
+    # --baseline honors its promise ("fingerprints listed there never
+    # fail the run") with or without --ci
+    if args.ci or args.baseline:
+        return EXIT_FINDINGS if fresh else EXIT_CLEAN
+    return EXIT_CLEAN if report.ok(args.fail_on) else EXIT_FINDINGS
 
 
 if __name__ == "__main__":

@@ -72,7 +72,7 @@ def check(
     ``amp`` re-traces under ``amp_guard(amp)`` so the dtype-flow rules
     see the mixed-precision graph. ``select`` restricts to a subset of
     rule families ({"collective", "dtype", "sharding", "params",
-    "retrace", "feed", "pipeline"}).
+    "retrace", "feed", "pipeline", "moe"}).
     ``feed_wire`` (a ``FeedWire`` or ``{name: WireSpec}``) maps a
     wire-typed sample feed to its logical dtypes for the trace and
     keeps the ``feed:wire-candidate`` rule from re-suggesting fields
@@ -102,7 +102,12 @@ def check(
     dropped = sorted(k for k, v in feed.items() if not _traceable(v))
     feed = {k: v for k, v in feed.items() if _traceable(v)}
     amp_ctx = amp_guard(amp) if amp else contextlib.nullcontext()
-    with amp_ctx:
+    # the MoE capacity rule reads the static routing configs every
+    # moe() layer records at trace time — capture them around the same
+    # traces the jaxpr families already pay for (duplicate records from
+    # init + desc_flat dedupe by finding fingerprint)
+    from ..parallel.moe import capture_moe_configs
+    with amp_ctx, capture_moe_configs() as moe_configs:
         closed = invar_names = None
         try:
             if params is None:
@@ -111,7 +116,7 @@ def check(
                     **feed)
             state = state or {}
             if fam("collective") or fam("dtype") or fam("params") \
-                    or fam("feed"):
+                    or fam("feed") or fam("moe"):
                 closed, invar_names = program.desc_flat(params, state, **feed)
         except Exception as e:
             # a trace that can't run (e.g. a required arg was dropped as
@@ -140,6 +145,8 @@ def check(
             wired = set(feed_wire.specs) if feed_wire is not None else set()
             _rules.check_feed_wire(closed, invar_names, report,
                                    already_wired=wired)
+    if fam("moe"):
+        _rules.check_moe_capacity(moe_configs, report)
     if fam("sharding"):
         _rules.check_sharding(params, mesh, rules, report,
                               param_info=getattr(program, "param_info", None),
@@ -180,6 +187,8 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
     select = kwargs.pop("select", None)
     hlo = kwargs.pop("hlo", False) or (select is not None and "hlo" in select)
     hbm_budget_bytes = kwargs.pop("hbm_budget_bytes", None)
+    replicated_optstate_bytes = kwargs.pop("replicated_optstate_bytes",
+                                           64 << 20)
     amp = kwargs.get("amp")
     want_coll = select is None or "collective" in select
     want_donation = select is None or "donation" in select
@@ -191,7 +200,8 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
     # needs the step's donate_argnums anyway; dtype over the step sees
     # the train path the forward program hides)
     step_dtype = want_dtype and sample_feed is not None
-    inner_select = ({"sharding", "params", "retrace", "feed", "pipeline"}
+    inner_select = ({"sharding", "params", "retrace", "feed", "pipeline",
+                     "moe"}
                     if select is None
                     else set(select) - {"collective", "donation"})
     if step_dtype:
@@ -209,6 +219,15 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
         select=inner_select,
         feed_wire=getattr(trainer, "feed_wire", None), **kwargs)
     report.subject = f"trainer({trainer.program.name})"
+    # the ZeRO trigger: only the trainer door sees live optimizer state
+    # (the program-level check has no opt_state to audit)
+    if (select is None or "sharding" in select) \
+            and trainer.mesh is not None \
+            and trainer.scope.opt_state is not None:
+        _rules.check_replicated_optstate(
+            trainer.scope.params, trainer.scope.opt_state, trainer.mesh,
+            rules, report,
+            replicated_optstate_bytes=replicated_optstate_bytes)
     if want_coll or want_donation or step_dtype:
         _check_step_jaxpr(trainer, sample_feed, report, rules, amp,
                           want_coll, want_donation, step_dtype, kwargs)

@@ -1,0 +1,631 @@
+"""Cross-artifact contract verifier: static compatibility analysis
+between the framework's long-lived artifacts.
+
+The repo now produces three artifact kinds that outlive the process
+that wrote them — CRC-manifested trainer checkpoints
+(``io.save_trainer`` + ``resilience.write_manifest``), multi-bucket AOT
+serving artifacts (``io.save_inference_model``), and sharded training
+programs — and every compatibility question between them used to be
+answered by a runtime crash: a shape-drifted checkpoint died inside the
+next step's retrace, a stale serving artifact failed the reload canary
+at swap time, an infeasible mesh reshard aborted at ``device_put``.
+
+``check_artifacts`` answers those questions *statically*: given any
+pair of {trainer/program, checkpoint dir, inference artifact dir,
+mesh/sharding spec} it proves or refutes compatibility from metadata
+alone — manifests (``resilience.read_manifest``), artifact meta
+(``io.read_artifact_meta``), and spec-only tree flattening
+(``io.flat_spec``) — no CRC pass, no deserialization, no compile, no
+device work. This is the ProgramDesc-lineage idea of the reference
+(a serialized program IS checkable data) extended to the artifacts
+around the program, with the GSPMD-style partition metadata reasoning
+of PAPERS.md ("GSPMD", "Automatic Cross-Replica Sharding of Weight
+Update in Data-Parallel Training") applied to restore-at-a-different-
+mesh feasibility.
+
+Finding families (each named finding's runtime counterpart is pinned in
+``tests/test_contracts.py``):
+
+- ``ckpt:*`` — checkpoint manifest flat shape/dtype spec vs the
+  trainer's param/opt-state spec: missing/extra entries, shape/dtype
+  drift (``load_trainer`` raises ``CheckpointCorrupt``), loss-scale
+  state drift (runtime warns + falls back), and restore-at-different-
+  mesh feasibility including whether a dp N→M reshard is expressible.
+- ``artifact:*`` — saved bucket set + per-bucket feed specs vs a live
+  server (or the trainer that re-exports): the exact drift classes the
+  serving reload canary only catches at swap time, plus internal
+  consistency (bucket files named by meta but missing on disk).
+- ``sharding:replicated-optstate`` — optimizer state fully replicated
+  across a data axis above a size threshold: the ZeRO trigger
+  (``rules.check_replicated_optstate``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core.errors import enforce
+from . import rules as _rules
+from .report import LintReport, collect_into
+
+_COLLECTIONS = ("params.npz", "state.npz", "opt_state.npz")
+# params drift makes load_trainer raise CheckpointCorrupt (error); the
+# other collections degrade at runtime (state rebuilt / scaler fallback
+# warnings) so their drift reports at warning severity
+_COLLECTION_SEVERITY = {"params.npz": "error", "state.npz": "warning",
+                        "opt_state.npz": "warning"}
+
+
+def _unmangle_key(key: str, recorded_dtype: Optional[str] = None) -> str:
+    """Logical leaf name of a mangled npz member key — the inverse of
+    ``io._mangle_key`` (strip one ``@raw`` escape or one exotic-dtype
+    suffix whose recorded storage dtype matches the encoding)."""
+    from ..io import _EXOTIC_DTYPES
+
+    if "@" not in key:
+        return key
+    stem, _, suffix = key.rpartition("@")
+    if suffix == "raw":
+        return stem
+    enc = _EXOTIC_DTYPES.get(suffix)
+    if enc is not None and (recorded_dtype is None
+                            or np.dtype(recorded_dtype) == np.dtype(enc)):
+        return stem
+    return key
+
+
+def trainer_specs(trainer) -> Dict[str, Any]:
+    """The trainer-side contract surface: the flat shape/dtype spec
+    ``io.save_trainer`` would record for each collection (computed from
+    shapes only — no device reads; an interleaved-pipeline row layout
+    is a permutation, so the spec is layout-agnostic), plus loss-scaler
+    presence and the mesh axes."""
+    scope = trainer.scope
+    enforce(getattr(scope, "params", None) is not None,
+            "contracts.trainer_specs: call trainer.startup() first (the "
+            "contract is the started scope's spec)")
+    from .. import io as _io
+
+    arrays = {"params.npz": _io.flat_spec(scope.params),
+              "state.npz": _io.flat_spec(scope.state or {})}
+    if scope.opt_state is not None:
+        arrays["opt_state.npz"] = _io.flat_spec(scope.opt_state)
+    mesh = getattr(trainer, "mesh", None)
+    return {
+        "arrays": arrays,
+        "has_loss_scaler": getattr(trainer, "loss_scaler", None) is not None,
+        "mesh_axes": ({str(a): int(mesh.shape[a]) for a in mesh.axis_names}
+                      if mesh is not None else None),
+    }
+
+
+def serving_spec(predictor) -> Dict[str, Any]:
+    """Static description of a live served model (a
+    :class:`~paddle_tpu.io.Predictor` or anything duck-typed like one):
+    what a candidate artifact must stay compatible with across a hot
+    reload."""
+    return {
+        "feed_names": list(predictor.feed_names),
+        "batched_feeds": sorted(predictor.batched_feeds),
+        "buckets": {
+            int(b): {k: (tuple(shape), str(np.dtype(dt)))
+                     for k, (shape, dt) in predictor.feed_spec(b).items()}
+            for b in predictor.batch_buckets},
+    }
+
+
+def _feed_shapes(sample_feed: Optional[Dict[str, Any]]) -> Dict[str, Tuple[int, ...]]:
+    out = {}
+    for k in sorted(sample_feed or {}):
+        shape = getattr(sample_feed[k], "shape", None)
+        if shape is None:
+            try:
+                shape = np.asarray(sample_feed[k]).shape
+            except Exception:
+                continue
+        if shape:
+            out[k] = tuple(int(d) for d in shape)
+    return out
+
+
+# --------------------------------------------------------------------------
+# ckpt:* — checkpoint vs trainer/mesh
+# --------------------------------------------------------------------------
+
+
+def _check_ckpt_arrays(specs: Dict[str, Any], manifest: Dict[str, Any],
+                       report: LintReport) -> None:
+    arrays = manifest.get("arrays") or {}
+    for fname in _COLLECTIONS:
+        want = specs["arrays"].get(fname)
+        got = arrays.get(fname)
+        sev = _COLLECTION_SEVERITY[fname]
+        if want is None and got is None:
+            continue
+        if got is None:
+            if fname == "params.npz":
+                report.add(
+                    "ckpt:missing-collection", "error",
+                    "checkpoint manifest records no params.npz spec — "
+                    "load_trainer raises CheckpointCorrupt (no parameters "
+                    "found) or the legacy path loads unvalidated",
+                    where=fname)
+            else:
+                report.add(
+                    "ckpt:missing-collection", "warning",
+                    f"the trainer persists {fname} but the checkpoint "
+                    f"manifest has no spec for it — that collection will "
+                    "not restore (optimizer state/statistics restart "
+                    "from scratch)",
+                    where=fname)
+            continue
+        if want is None:
+            report.add(
+                "ckpt:extra-collection", "info",
+                f"checkpoint carries {fname} but the trainer does not "
+                "persist that collection (e.g. an optimizer-less "
+                "evaluator restoring a training checkpoint) — it is "
+                "ignored on load",
+                where=fname)
+            continue
+        missing = sorted(set(want) - set(got))
+        extra = sorted(set(got) - set(want))
+        for k in missing:
+            report.add(
+                "ckpt:missing-entry", sev,
+                f"{fname} has no entry for {_unmangle_key(k)!r} "
+                f"{tuple(want[k]['shape'])} — the trainer's model config "
+                "gained this leaf since the checkpoint was written; "
+                "load_trainer "
+                + ("raises CheckpointCorrupt (params diverge)"
+                   if sev == "error" else "restores it uninitialized"),
+                where=f"{fname}:{k}", shape=list(want[k]["shape"]))
+        for k in extra:
+            report.add(
+                "ckpt:extra-entry", sev,
+                f"{fname} carries {_unmangle_key(k)!r} "
+                f"{tuple(got[k]['shape'])} which the trainer's model no "
+                "longer has — renamed or removed layer; load_trainer "
+                + ("raises CheckpointCorrupt (params diverge)"
+                   if sev == "error" else "drops it"),
+                where=f"{fname}:{k}", shape=list(got[k]["shape"]))
+        for k in sorted(set(want) & set(got)):
+            w, g = want[k], got[k]
+            if list(w["shape"]) != list(g["shape"]):
+                report.add(
+                    "ckpt:shape-drift", sev,
+                    f"{fname}:{_unmangle_key(k)} is {tuple(g['shape'])} in "
+                    f"the checkpoint but the trainer expects "
+                    f"{tuple(w['shape'])} — "
+                    + ("load_trainer raises CheckpointCorrupt naming the "
+                       "drifted param" if sev == "error"
+                       else "the restored value cannot feed the step"),
+                    where=f"{fname}:{k}",
+                    got=list(g["shape"]), expected=list(w["shape"]))
+            elif str(w["dtype"]) != str(g["dtype"]):
+                report.add(
+                    "ckpt:dtype-drift", sev,
+                    f"{fname}:{_unmangle_key(k)} is {g['dtype']} in the "
+                    f"checkpoint but the trainer expects {w['dtype']}",
+                    where=f"{fname}:{k}",
+                    got=str(g["dtype"]), expected=str(w["dtype"]))
+
+
+def _check_loss_scale(specs: Dict[str, Any], manifest: Dict[str, Any],
+                      report: LintReport) -> None:
+    ls_meta = (manifest.get("meta") or {}).get("loss_scale_state")
+    if specs["has_loss_scaler"] and not ls_meta:
+        report.add(
+            "ckpt:loss-scale-drift", "warning",
+            "the trainer runs a loss scaler but the checkpoint has no "
+            "loss_scale_state — restore falls back to the scaler's "
+            "initial state (scale re-calibrates; the first post-resume "
+            "steps may overflow-skip)",
+            where="loss_scale_state")
+    elif ls_meta and not specs["has_loss_scaler"]:
+        report.add(
+            "ckpt:loss-scale-drift", "warning",
+            "the checkpoint carries loss_scale_state but the trainer has "
+            "no loss scaler — it is ignored on load (configure "
+            "DistStrategy.loss_scale to adopt it)",
+            where="loss_scale_state")
+    elif ls_meta:
+        missing = sorted({"scale", "good_steps", "overflows"} - set(ls_meta))
+        if missing:
+            report.add(
+                "ckpt:loss-scale-drift", "warning",
+                f"checkpoint loss_scale_state is missing {missing} — "
+                "those fields fall back to the scaler's initial values",
+                where="loss_scale_state")
+
+
+def _check_reshard(manifest: Dict[str, Any], mesh, rules,
+                   sample_feed: Optional[Dict[str, Any]],
+                   report: LintReport) -> None:
+    """Restore-at-a-different-mesh feasibility. Checkpoint arrays are
+    stored UNSHARDED (fully gathered), so a mesh change is a question
+    about the *target* placement only: (a) every rule-sharded param dim
+    must divide the target axes (a dropped rule silently replicates —
+    HBM regression, not a crash), and (b) the per-step batch must
+    divide the target data-shard product (``put_batch``'s NamedSharding
+    raises otherwise). A dp N→M resize that passes both is expressible
+    by construction — that verdict is the ``ckpt:mesh-reshard`` info
+    finding."""
+    if mesh is None:
+        return
+    from ..parallel.api import _rules as _adapt
+
+    saved_axes = (manifest.get("meta") or {}).get("mesh_axes")
+    target_axes = {str(a): int(mesh.shape[a]) for a in mesh.axis_names}
+    if saved_axes == target_axes:
+        return  # same mesh: nothing to reshard
+    arrays = (manifest.get("arrays") or {}).get("params.npz") or {}
+    table = _adapt(rules, mesh)
+    dropped = LintReport("reshard")
+    with collect_into(dropped):
+        for key, entry in arrays.items():
+            table.spec_for(_unmangle_key(key, entry.get("dtype")),
+                           tuple(entry["shape"]), mesh)
+    for f in dropped.findings:
+        report.add(
+            "ckpt:reshard-dropped-rule", "warning",
+            f"restoring this checkpoint at mesh {target_axes} drops a "
+            f"sharding rule ({f.message}) — the param loads fully "
+            "replicated instead of sharded: feasible, but each device "
+            "pays the full copy",
+            where=f.where or "sharding_rules", **{
+                k: v for k, v in f.data.items()
+                if k in ("axis", "shape", "dtype")})
+    # mirror put_batch EXACTLY: each feed's dim-0 sharding comes from
+    # rules.batch_spec (which honors ShardingRules.batch_axes — a
+    # {dp,fsdp} mesh whose rules batch-shard only dp splits 2-way, not
+    # 8-way), and EVERY feed must divide its own shard product, not
+    # just the alphabetically-first one
+    offending: Dict[str, Tuple[int, int, Tuple[str, ...]]] = {}
+    batch = data_n = None
+    for name, shape in _feed_shapes(sample_feed).items():
+        spec = table.batch_spec(mesh, len(shape), shape=shape)
+        # an empty P() means the batch stays unsharded (no batch axes
+        # in the target mesh, e.g. pure-tp) — always feasible
+        entry = spec[0] if len(spec) else None
+        axes = (entry if isinstance(entry, tuple)
+                else (entry,) if entry else ())
+        n = int(np.prod([mesh.shape[a] for a in axes] or [1]))
+        batch = int(shape[0]) if batch is None else batch
+        data_n = n if data_n is None else max(data_n, n)
+        if n > 1 and shape[0] % n:
+            offending[name] = (int(shape[0]), n, tuple(axes))
+    infeasible = bool(offending)
+    if infeasible:
+        _, (b, n, axes) = sorted(offending.items())[0]
+        report.add(
+            "ckpt:reshard-infeasible", "error",
+            f"restoring at mesh {target_axes} is not expressible with "
+            f"the current feed: batch {b} (feed"
+            f"{'s' if len(offending) > 1 else ''} {sorted(offending)}) "
+            f"does not divide the {n}-way batch-shard product "
+            f"({'x'.join(f'{a}={mesh.shape[a]}' for a in axes)}) — "
+            "put_batch's NamedSharding rejects the split at the first "
+            "step; re-batch the feed or pick a divisible mesh",
+            where="batch", got=[b], expected=[n])
+    if not infeasible:
+        # a pre-mesh-meta checkpoint has no saved axes, so this may not
+        # be a reshard at all — the verdict is about restoring AT this
+        # mesh, never a claim that the mesh changed
+        claim = (f"restore at a different mesh ({saved_axes} -> "
+                 f"{target_axes}) is" if saved_axes else
+                 f"restore at mesh {target_axes} is (checkpoint predates "
+                 "mesh metadata — the saved mesh is unknown)")
+        report.add(
+            "ckpt:mesh-reshard", "info",
+            f"{claim} expressible: checkpoint arrays are stored "
+            "unsharded and re-placed per the rule table at load"
+            + (f"; batch {batch} divides the {data_n}-way batch shards"
+               if batch is not None and (data_n or 1) > 1 else
+               "; batch feasibility UNCHECKED (pass sample_feed to "
+               "verify the feed divides the target batch shards)"
+               if batch is None else "")
+            + (" (some rules drop — see ckpt:reshard-dropped-rule)"
+               if dropped.findings else ""),
+            where="mesh")
+
+
+# --------------------------------------------------------------------------
+# artifact:* — serving artifact vs trainer / live server
+# --------------------------------------------------------------------------
+
+
+def _norm_spec(spec: Dict[str, Tuple]) -> Dict[str, Tuple]:
+    return {k: (tuple(int(d) for d in shape), str(np.dtype(dt)))
+            for k, (shape, dt) in spec.items()}
+
+
+def _check_artifact_internal(info: Dict[str, Any],
+                             report: LintReport) -> None:
+    meta = info["meta"]
+    if not info["model_file"]:
+        report.add(
+            "artifact:missing-model", "error",
+            "model.stablehlo is missing — load_inference_model raises "
+            "FileNotFoundError; the artifact directory is torn",
+            where="model.stablehlo")
+    for b, present in sorted(info["bucket_files"].items()):
+        if not present and b != int(meta.get("batch_size", -1)):
+            report.add(
+                "artifact:stale-bucket", "error",
+                f"meta.json names batch bucket {b} but "
+                f"model.b{b}.stablehlo is missing on disk — "
+                f"load_inference_model raises CheckpointCorrupt (the "
+                f"manifest names the file); a LEGACY artifact silently "
+                f"drops the bucket, so a server loading it rejects "
+                f"batch-{b} traffic (InvalidRequest: not a precompiled "
+                f"bucket) and a hot reload over a server that serves it "
+                f"fails 'bucket set shrank'",
+                where=f"model.b{b}.stablehlo", bucket=b)
+    if info["manifest"] is None:
+        report.add(
+            "artifact:no-manifest", "info",
+            "pre-manifest (legacy) artifact: weight files load without "
+            "CRC validation",
+            where="manifest.json")
+
+
+def _check_artifact_vs_trainer(info: Dict[str, Any], trainer,
+                               sample_feed: Optional[Dict[str, Any]],
+                               report: LintReport) -> None:
+    """Does this serving artifact still match the trainer that will
+    (re-)export and hot-reload it? Weights spec vs the trainer's params
+    spec, and feed signature vs the trainer's sample feed."""
+    import jax
+
+    from .. import io as _io
+
+    meta = info["meta"]
+    manifest = info["manifest"]
+    if manifest is not None:
+        want = _io.flat_spec(trainer.scope.params)
+        got = (manifest.get("arrays") or {}).get("params.npz") or {}
+        diverged = sorted(
+            set(want) ^ set(got)
+            | {k for k in set(want) & set(got)
+               if list(want[k]["shape"]) != list(got[k]["shape"])
+               or str(want[k]["dtype"]) != str(got[k]["dtype"])})
+        if diverged:
+            report.add(
+                "artifact:param-drift", "warning",
+                f"artifact weights diverge from the trainer's params at "
+                f"{len(diverged)} entr"
+                f"{'y' if len(diverged) == 1 else 'ies'} "
+                f"(first: {diverged[:3]}) — this artifact was exported "
+                "from a different model config; the next "
+                "save_inference_model from this trainer will not be a "
+                "drop-in replacement for it",
+                where="params.npz", expected=diverged[:3])
+    if not sample_feed:
+        return
+    feed_wire = getattr(trainer, "feed_wire", None)
+    feeds = dict(sample_feed)
+    if feed_wire is not None:
+        feeds = feed_wire.logical_feed({
+            k: jax.ShapeDtypeStruct(np.shape(v), np.asarray(v).dtype)
+            for k, v in feeds.items()})
+    want_names = sorted(feeds)
+    got_names = sorted(meta.get("feed_names", []))
+    if want_names != got_names:
+        report.add(
+            "artifact:feed-names", "error",
+            f"artifact feed names {got_names} != the trainer program's "
+            f"{want_names} — requests built from the trainer's feed "
+            "contract fail validation (InvalidRequest: missing / not a "
+            "feed)",
+            where="feed_names", got=got_names, expected=want_names)
+        return
+    art = _norm_spec(_io.artifact_feed_spec(meta))
+    batched = set(meta.get("batched_feeds", []))
+    for k in want_names:
+        v = feeds[k]
+        shape = tuple(int(d) for d in np.shape(v))
+        dtype = str(jax.dtypes.canonicalize_dtype(
+            getattr(v, "dtype", np.asarray(v).dtype)))
+        a_shape, a_dtype = art[k]
+        cmp_shape = shape[1:] if k in batched else shape
+        cmp_a = a_shape[1:] if k in batched else a_shape
+        if cmp_shape != cmp_a or dtype != a_dtype:
+            report.add(
+                "artifact:feed-drift", "error",
+                f"feed signature drifted at {k!r}: artifact expects "
+                f"{a_shape}/{a_dtype}, the trainer feeds "
+                f"{shape}/{dtype} — every request the trainer-side "
+                "contract produces fails this artifact's validation",
+                where=k, got=[list(a_shape), a_dtype],
+                expected=[list(shape), dtype])
+
+
+def check_reload_compat(served: Dict[str, Any], info: Dict[str, Any],
+                        report: Optional[LintReport] = None) -> LintReport:
+    """The serving pre-reload contract: would hot-swapping the artifact
+    at ``info`` under a server currently serving ``served``
+    (:func:`serving_spec`) strand in-flight traffic? Statically detects
+    the exact drift classes ``PredictorServer._do_reload`` otherwise
+    pays a full load + AOT compile to discover: feed-name drift,
+    bucket-set shrinkage (including buckets the meta still names but
+    whose files are gone), and per-bucket feed signature drift."""
+    from .. import io as _io
+
+    report = report or LintReport(subject=f"reload({info['path']})")
+    meta = info["meta"]
+    got_names = list(meta.get("feed_names", []))
+    if got_names != list(served["feed_names"]):
+        report.add(
+            "artifact:feed-names", "error",
+            f"feed names {got_names} != served model's "
+            f"{list(served['feed_names'])}",
+            where="feed_names", got=got_names,
+            expected=list(served["feed_names"]))
+        return report
+    candidate = {b for b, present in info["bucket_files"].items() if present}
+    if int(meta.get("batch_size", 0) or 0) and info["model_file"]:
+        candidate.add(int(meta["batch_size"]))
+    dropped = sorted(b for b in served["buckets"] if b not in candidate)
+    if dropped:
+        report.add(
+            "artifact:bucket-shrank", "error",
+            f"bucket set shrank (missing {dropped}): in-flight bucket "
+            "traffic would go off-bucket after the swap",
+            where="batch_buckets", buckets=dropped)
+    for b in sorted(set(served["buckets"]) & candidate):
+        got = _norm_spec(_io.artifact_feed_spec(meta, b))
+        want = _norm_spec(served["buckets"][b])
+        if got != want:
+            diff = sorted(k for k in want if got.get(k) != want[k])
+            report.add(
+                "artifact:feed-drift", "error",
+                f"feed signature drifted at bucket {b} (fields {diff}: "
+                f"{[got.get(k) for k in diff]} vs served "
+                f"{[want[k] for k in diff]}): queued in-flight requests "
+                "validated against the old shapes would all fail on the "
+                "new model",
+                where=f"bucket:{b}", bucket=b, expected=diff)
+    return report
+
+
+# --------------------------------------------------------------------------
+# front door
+# --------------------------------------------------------------------------
+
+
+def _degrade(report: LintReport, code: str, where: str, fn, *args) -> None:
+    """Run one sub-check, degrading a crash on malformed input metadata
+    (a meta.json whose sections disagree, a manifest entry missing its
+    shape) to an error finding naming the exception — the verifier's
+    own contract: corrupt ARTIFACTS are findings, exit 3 is reserved
+    for the checker being broken."""
+    try:
+        fn(*args)
+    except Exception as e:
+        report.add(code, "error",
+                   f"metadata is malformed — the "
+                   f"{fn.__name__.lstrip('_')} check cannot run on it "
+                   f"({type(e).__name__}: {e}); the runtime load dies on "
+                   "the same inconsistency", where=where)
+
+
+def check_artifacts(
+    trainer=None,
+    checkpoint_dir: Optional[str] = None,
+    artifact_dir: Optional[str] = None,
+    mesh=None,
+    sharding_rules=None,
+    sample_feed: Optional[Dict[str, Any]] = None,
+    serving: Optional[Dict[str, Any]] = None,
+    replicated_optstate_bytes: int = 64 << 20,
+    subject: Optional[str] = None,
+) -> LintReport:
+    """Statically verify compatibility between any pair of artifacts.
+
+    Pass any combination of:
+
+    - ``trainer`` — a STARTED :class:`~paddle_tpu.executor.Trainer`
+      (its scope spec, loss scaler, mesh and rules are the live side
+      of every contract);
+    - ``checkpoint_dir`` — an ``io.save_trainer`` checkpoint:
+      ``ckpt:*`` findings against the trainer's spec and the
+      restore-mesh feasibility analysis;
+    - ``artifact_dir`` — an ``io.save_inference_model`` artifact:
+      ``artifact:*`` internal-consistency findings, plus drift against
+      the trainer (weights + feed signature) and/or against ``serving``
+      (a :func:`serving_spec` of the live server — the hot-reload
+      contract);
+    - ``mesh`` / ``sharding_rules`` — the TARGET placement for the
+      reshard analysis (default: the trainer's);
+    - ``sample_feed`` — example feed (arrays or ShapeDtypeStructs);
+      supplies the batch for reshard feasibility and the trainer-side
+      feed signature.
+
+    Everything is metadata-only: no device work, no CRC pass, no
+    StableHLO deserialization, no compiles — safe to run in CI or at
+    server startup on every candidate artifact. Unreadable inputs
+    degrade to ``ckpt:unreadable`` / ``artifact:unreadable`` error
+    findings, and metadata that parses but is internally inconsistent
+    (sections disagreeing, spec entries missing fields) degrades to
+    ``ckpt:malformed`` / ``artifact:malformed`` — never a crash of the
+    check.
+    """
+    from .. import resilience
+    from .. import io as _io
+
+    enforce(trainer is not None or checkpoint_dir or artifact_dir,
+            "check_artifacts: pass at least one of trainer / "
+            "checkpoint_dir / artifact_dir")
+    names = [n for n in (
+        f"trainer({trainer.program.name})" if trainer is not None else None,
+        checkpoint_dir, artifact_dir) if n]
+    report = LintReport(subject=subject or " ~ ".join(names))
+    specs = trainer_specs(trainer) if trainer is not None else None
+    mesh = mesh if mesh is not None else getattr(trainer, "mesh", None)
+    if sharding_rules is None and trainer is not None:
+        sharding_rules = (getattr(trainer, "sharding_rules_raw", None)
+                          or trainer.sharding_rules)
+
+    if checkpoint_dir:
+        manifest = None
+        try:
+            manifest = resilience.read_manifest(checkpoint_dir)
+        except resilience.CheckpointCorrupt as e:
+            report.add(
+                "ckpt:unreadable", "error",
+                f"checkpoint metadata is unreadable ({e.reason}) — "
+                "load_trainer raises CheckpointCorrupt",
+                where=checkpoint_dir)
+        if manifest is None and not report.by_code("ckpt:unreadable"):
+            report.add(
+                "ckpt:legacy", "info",
+                "pre-manifest checkpoint: no flat spec recorded, so "
+                "nothing is statically verifiable (and the runtime load "
+                "validates nothing either)",
+                where=checkpoint_dir)
+        elif manifest is not None:
+            if specs is not None:
+                _degrade(report, "ckpt:malformed", checkpoint_dir,
+                         _check_ckpt_arrays, specs, manifest, report)
+                _degrade(report, "ckpt:malformed", checkpoint_dir,
+                         _check_loss_scale, specs, manifest, report)
+            _degrade(report, "ckpt:malformed", checkpoint_dir,
+                     _check_reshard, manifest, mesh,
+                     sharding_rules, sample_feed, report)
+
+    if artifact_dir:
+        info = None
+        try:
+            info = _io.read_artifact_meta(artifact_dir)
+        except resilience.CheckpointCorrupt as e:
+            report.add(
+                "artifact:unreadable", "error",
+                f"artifact metadata is unreadable ({e.reason}) — "
+                "load_inference_model / a hot reload raises "
+                "CheckpointCorrupt",
+                where=artifact_dir)
+        if info is not None:
+            _degrade(report, "artifact:malformed", artifact_dir,
+                     _check_artifact_internal, info, report)
+            if trainer is not None:
+                _degrade(report, "artifact:malformed", artifact_dir,
+                         _check_artifact_vs_trainer, info, trainer,
+                         sample_feed, report)
+            if serving is not None:
+                _degrade(report, "artifact:malformed", artifact_dir,
+                         check_reload_compat, serving, info, report)
+
+    if trainer is not None and mesh is not None \
+            and trainer.scope.opt_state is not None:
+        _rules.check_replicated_optstate(
+            trainer.scope.params, trainer.scope.opt_state, mesh,
+            sharding_rules, report,
+            replicated_optstate_bytes=replicated_optstate_bytes)
+    return report
+
+
+__all__ = ["check_artifacts", "check_reload_compat", "serving_spec",
+           "trainer_specs"]

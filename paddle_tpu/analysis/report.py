@@ -11,20 +11,41 @@ A :class:`LintReport` is also a *collector*: while one is installed via
 rule-drop warnings) append findings instead of emitting ad-hoc
 ``warnings.warn`` calls, so a single ``analysis.check`` run gathers
 everything the trace touched.
+
+CI surface: every finding carries a stable :attr:`Finding.fingerprint`
+(``family:rule|subject|shape`` — same key scheme as the profiler's
+fusion diff keys), reports dedupe on it (repeated identical findings
+bump :attr:`Finding.count` instead of accumulating), and the module
+provides the machine consumers a gate needs: a baseline suppression
+file (:func:`load_baseline` / :func:`write_baseline` /
+:func:`new_findings`), per-code severity overrides
+(:func:`apply_severity`), and a SARIF 2.1.0 emitter (:func:`to_sarif`).
 """
 
 from __future__ import annotations
 
 import contextlib
 import dataclasses
+import json
 import threading
 import warnings
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
-from ..core.errors import EnforceError
+from ..core.errors import EnforceError, enforce
 
 SEVERITIES = ("info", "warning", "error")
 _SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+# data keys that participate in the fingerprint's shape signature: the
+# STRUCTURAL identity of a finding (what it is about), never the
+# measurements (byte counts, fractions) that legitimately drift run to
+# run and would make baseline keys unstable. "path" (the named-jaxpr
+# nesting a collective sits in) is structural too: without it every
+# `collective:in-scan` psum in a program shares one fingerprint, and a
+# baseline accepting one loop's exchange would silently suppress a NEW
+# one introduced in a different loop
+_FINGERPRINT_DATA_KEYS = ("shape", "shapes", "dtype", "axis", "bucket",
+                          "buckets", "expected", "got", "path")
 
 
 class LintError(EnforceError):
@@ -46,39 +67,76 @@ class Finding:
     """One diagnostic: ``code`` is ``family:rule`` (e.g.
     ``"collective:in-scan"``), ``where`` names the anchor (parameter,
     equation, feed key), ``data`` holds rule-specific measurements
-    (comm-byte estimates, shapes)."""
+    (comm-byte estimates, shapes). ``count`` is the number of identical
+    occurrences merged into this entry (reports dedupe on
+    :attr:`fingerprint`)."""
 
     code: str
     severity: str
     message: str
     where: str = ""
     data: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    count: int = 1
 
     def __post_init__(self):
         assert self.severity in SEVERITIES, self.severity
 
+    @property
+    def fingerprint(self) -> str:
+        """Stable identity key ``family:rule|subject|shape``: the code,
+        the anchor, and the structural data keys (shapes/dtypes/axes —
+        never byte measurements). Two findings with the same fingerprint
+        are THE SAME finding (dedupe merges them; baselines suppress by
+        this key); the message text is free to improve between versions
+        without invalidating every baseline."""
+        sig = ",".join(f"{k}={self.data[k]!r}"
+                       for k in _FINGERPRINT_DATA_KEYS if k in self.data)
+        return f"{self.code}|{self.where}|{sig}"
+
     def __str__(self) -> str:
         loc = f" [{self.where}]" if self.where else ""
-        return f"{self.severity.upper():<8} {self.code:<28}{loc} {self.message}"
+        mult = f" (x{self.count})" if self.count > 1 else ""
+        return (f"{self.severity.upper():<8} {self.code:<28}{loc} "
+                f"{self.message}{mult}")
 
 
 class LintReport:
-    """Ordered collection of findings for one checked program."""
+    """Ordered collection of findings for one checked program,
+    deduplicated by :attr:`Finding.fingerprint`: re-adding an identical
+    finding (startup lint + an explicit ``check_trainer`` re-run merged
+    via :meth:`extend`, or a rule that fires once per trace of the same
+    layer) bumps ``count`` on the existing entry instead of
+    accumulating — baselines need exactly one stable key per finding."""
 
     def __init__(self, subject: str = "program"):
         self.subject = subject
         self.findings: List[Finding] = []
+        self._by_fingerprint: Dict[Tuple[str, str], Finding] = {}
 
     # -- building ----------------------------------------------------------
     def add(self, code: str, severity: str, message: str, where: str = "",
             **data) -> Finding:
-        f = Finding(code=code, severity=severity, message=message,
-                    where=where, data=dict(data))
+        return self.merge(Finding(code=code, severity=severity,
+                                  message=message, where=where,
+                                  data=dict(data)))
+
+    def merge(self, f: Finding) -> Finding:
+        """Add ``f``, deduplicating by fingerprint (count accumulates).
+        A same-fingerprint finding at a *different* severity is kept
+        separate — severity overrides must never silently swallow an
+        escalated duplicate."""
+        key = (f.fingerprint, f.severity)
+        existing = self._by_fingerprint.get(key)
+        if existing is not None:
+            existing.count += f.count
+            return existing
         self.findings.append(f)
+        self._by_fingerprint[key] = f
         return f
 
     def extend(self, other: "LintReport") -> "LintReport":
-        self.findings.extend(other.findings)
+        for f in other.findings:
+            self.merge(dataclasses.replace(f, data=dict(f.data)))
         return self
 
     # -- querying ----------------------------------------------------------
@@ -116,7 +174,9 @@ class LintReport:
         return {
             "subject": self.subject,
             "counts": self.counts(),
-            "findings": [dataclasses.asdict(f) for f in self.findings],
+            "findings": [dict(dataclasses.asdict(f),
+                              fingerprint=f.fingerprint)
+                         for f in self.findings],
         }
 
     def enforce_clean(self, level: str = "warning") -> "LintReport":
@@ -164,3 +224,146 @@ def collect_into(report: LintReport):
         yield report
     finally:
         stack.pop()
+
+
+# --------------------------------------------------------------------------
+# CI surface: severity overrides, baseline suppression, SARIF
+# --------------------------------------------------------------------------
+
+
+def apply_severity(report: LintReport,
+                   overrides: Optional[Dict[str, str]] = None) -> LintReport:
+    """Re-severity findings per a config mapping: keys are exact codes
+    (``"moe:capacity"``) or whole families (``"collective"``); exact
+    codes win. Lets a deployment promote a lint to a gate-blocking
+    error (or demote a known-noisy one) without forking the rules."""
+    if not overrides:
+        return report
+    for sev in overrides.values():
+        enforce(sev in SEVERITIES,
+                f"severity override must be one of {SEVERITIES}, got {sev!r}")
+    old = report.findings
+    report.findings = []
+    report._by_fingerprint = {}
+    for f in old:
+        sev = overrides.get(f.code) or overrides.get(f.code.split(":")[0])
+        if sev:
+            f.severity = sev
+        report.merge(f)   # re-merge: overrides may collapse severity splits
+    return report
+
+
+BASELINE_VERSION = 1
+
+
+def baseline_key(subject: str, finding: Finding) -> str:
+    """The key a finding is suppressed under: the checked subject (zoo
+    config id / program name) scoping the finding's fingerprint — the
+    same finding on two different programs is two baseline entries."""
+    return f"{subject}::{finding.fingerprint}"
+
+
+def write_baseline(path: str,
+                   reports: Iterable[Tuple[str, LintReport]]) -> Dict[str, Any]:
+    """Write a baseline suppression file covering every finding in
+    ``reports`` (an iterable of ``(subject, report)``). Committing the
+    file freezes today's findings as accepted debt; the gate then fails
+    only on NEW fingerprints."""
+    entries: Dict[str, Any] = {}
+    for subject, report in reports:
+        for f in report.findings:
+            key = baseline_key(subject, f)
+            prev = entries.get(key)
+            entries[key] = {
+                "code": f.code,
+                "severity": f.severity,
+                "where": f.where,
+                "count": f.count + (prev["count"] if prev else 0),
+            }
+    doc = {"version": BASELINE_VERSION,
+           "tool": "paddle_tpu.analysis",
+           "baseline": dict(sorted(entries.items()))}
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return doc
+
+
+def load_baseline(path: Optional[str]) -> Dict[str, Any]:
+    """Parse a baseline file → {baseline_key: entry}. ``None`` or a
+    missing file reads as the empty baseline (every finding is new)."""
+    if not path:
+        return {}
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except FileNotFoundError:
+        return {}
+    enforce(isinstance(doc, dict) and isinstance(doc.get("baseline"), dict),
+            f"baseline file {path!r} is not a "
+            "{'version':..,'baseline':{...}} document")
+    ver = doc.get("version")
+    enforce(isinstance(ver, int) and ver <= BASELINE_VERSION,
+            f"baseline file {path!r} has version {ver!r}; this build reads "
+            f"<= {BASELINE_VERSION}")
+    return doc["baseline"]
+
+
+def new_findings(subject: str, report: LintReport,
+                 baseline: Dict[str, Any],
+                 level: str = "warning") -> List[Finding]:
+    """Findings at/above ``level`` whose baseline key is NOT suppressed
+    — what a CI gate fails on. Suppression is by key presence: a
+    baselined finding whose count grew is still suppressed (counts are
+    measurements, not identity)."""
+    return [f for f in report.at_least(level)
+            if baseline_key(subject, f) not in baseline]
+
+
+_SARIF_LEVEL = {"info": "note", "warning": "warning", "error": "error"}
+
+
+def to_sarif(reports: Iterable[Tuple[str, LintReport]]) -> Dict[str, Any]:
+    """Render ``(subject, report)`` pairs as one SARIF 2.1.0 run —
+    the interchange format CI annotators (GitHub code scanning et al.)
+    ingest. Rules are the distinct finding codes; each result carries
+    the stable fingerprint under ``partialFingerprints`` so re-runs
+    update rather than duplicate annotations."""
+    rules: Dict[str, Dict[str, Any]] = {}
+    results: List[Dict[str, Any]] = []
+    for subject, report in reports:
+        for f in report.findings:
+            rules.setdefault(f.code, {
+                "id": f.code,
+                "shortDescription": {"text": f.code},
+                "defaultConfiguration": {
+                    "level": _SARIF_LEVEL[f.severity]},
+            })
+            results.append({
+                "ruleId": f.code,
+                "level": _SARIF_LEVEL[f.severity],
+                "message": {"text": f"[{subject}] {f.message}"},
+                "partialFingerprints": {
+                    "paddleTpuLint/v1": baseline_key(subject, f)},
+                "occurrenceCount": f.count,
+                "locations": [{
+                    "logicalLocations": [{
+                        "name": f.where or subject,
+                        "fullyQualifiedName": f"{subject}::{f.where}"
+                                              if f.where else subject,
+                    }],
+                }],
+            })
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "paddle_tpu.analysis",
+                "informationUri": "https://example.invalid/paddle_tpu",
+                "rules": [rules[k] for k in sorted(rules)],
+            }},
+            "results": results,
+        }],
+    }

@@ -24,6 +24,13 @@ scope, mesh/rules, example arguments) and appends :class:`Finding`s to a
   feed inputs whose first in-program uses are a cast/normalize could
   cross the host→device link as uint8/bf16 wire (data/wire.WireSpec)
   and decode on device for free.
+- ``moe:*``        — mixture-of-experts routing shape: static
+  ``capacity_factor``/``top_k`` combos whose expected token drop rate
+  (computable from the dispatch tensor shapes alone) exceeds a
+  threshold.
+- ``sharding:replicated-optstate`` — optimizer state fully replicated
+  across a data-parallel axis above a size threshold: the ZeRO
+  (cross-replica sharded weight update) trigger.
 """
 
 from __future__ import annotations
@@ -237,7 +244,11 @@ def check_dtypes(closed_jaxpr, report: LintReport,
                             "(or a silent precision truncation if the middle "
                             "dtype is narrower) — plumb the dtype through "
                             "instead",
-                            where=name)
+                            where=name,
+                            # the dtype triple discriminates fingerprints:
+                            # a NEW f32->f16->f32 round-trip must not be
+                            # suppressed by a baselined f32->bf16->f32 one
+                            dtype=f"{odt}->{mid}->{fdt}")
 
     from .walker import walk_jaxprs
     walk_jaxprs(closed_jaxpr.jaxpr, visit)
@@ -699,3 +710,168 @@ def check_feed_wire(closed_flat, invar_names, report: LintReport,
             "label/id fields.",
             where=name, bytes_per_batch=nbytes,
             first_uses=sorted({e.primitive.name for e in consumers}))
+
+
+# --------------------------------------------------------------------------
+# 10. MoE routing capacity
+# --------------------------------------------------------------------------
+
+
+def _phi(z: float) -> float:
+    import math
+    return math.exp(-0.5 * z * z) / math.sqrt(2.0 * math.pi)
+
+
+def _Phi(z: float) -> float:
+    import math
+    return 0.5 * (1.0 + math.erf(z / math.sqrt(2.0)))
+
+
+def expected_moe_drop_rate(tokens: int, top_k: int, num_experts: int,
+                           capacity: int) -> float:
+    """Expected fraction of routed (token, choice) assignments dropped
+    by the static per-expert capacity, under the *uniform random
+    routing* model (each of the ``tokens * top_k`` assignments lands on
+    one of ``num_experts`` experts independently — what an untrained or
+    collapsed router looks like; the load-balance aux loss pushes
+    TOWARD this distribution, so it is the right static prior).
+
+    Per-expert load L ~ Binomial(T=tokens*top_k, 1/E); expected overflow
+    is E[max(L - C, 0)], evaluated with the normal approximation
+    ``(mu - C) * Phi(-z) + sigma * phi(z)``, ``z = (C - mu) / sigma``.
+    The total drop rate is ``E * overflow / T``. Exact at the
+    deterministic limit (sigma -> 0: rate = max(mu - C, 0) * E / T,
+    i.e. ``1 - capacity_factor`` for capacity_factor < 1)."""
+    import math
+    t_assign = tokens * top_k
+    if t_assign <= 0 or num_experts <= 0:
+        return 0.0
+    p = 1.0 / num_experts
+    mu = t_assign * p
+    var = t_assign * p * (1.0 - p)
+    if var <= 0.0:
+        overflow = max(mu - capacity, 0.0)
+    else:
+        sigma = math.sqrt(var)
+        z = (capacity - mu) / sigma
+        overflow = (mu - capacity) * _Phi(-z) + sigma * _phi(z)
+    rate = num_experts * max(overflow, 0.0) / t_assign
+    return min(max(rate, 0.0), 1.0)
+
+
+def check_moe_capacity(moe_configs, report: LintReport,
+                       drop_threshold: float = 0.05) -> None:
+    """``moe:capacity`` — a routed-expert layer whose static
+    ``capacity_factor``/``top_k`` combo implies an expected token drop
+    rate above ``drop_threshold``. Dropped tokens pass through the MoE
+    block with a zero combine weight — silent quality loss that no
+    runtime error ever surfaces; the capacity is fully determined by
+    the traced shapes (``parallel.moe`` computes it before any device
+    work), so this is knowable before the first step.
+
+    ``moe_configs`` is the record list a
+    ``parallel.moe.capture_moe_configs()`` block collected around the
+    program trace."""
+    for cfg in moe_configs or ():
+        rate = expected_moe_drop_rate(cfg["tokens"], cfg["top_k"],
+                                      cfg["num_experts"], cfg["capacity"])
+        if rate <= drop_threshold:
+            continue
+        lever = (f"raise capacity_factor above "
+                 f"{cfg['capacity_factor']:g} (capacity scales "
+                 "linearly) or lower top_k")
+        report.add(
+            "moe:capacity", "warning",
+            f"expert capacity {cfg['capacity']} (capacity_factor="
+            f"{cfg['capacity_factor']:g}, top_k={cfg['top_k']}, "
+            f"{cfg['num_experts']} experts, {cfg['tokens']} tokens"
+            + (f"/device over ep={cfg['ep']}" if cfg.get("ep", 1) > 1
+               else "")
+            + f") drops an expected {rate:.1%} of routed tokens under "
+            f"uniform routing (threshold {drop_threshold:.1%}) — dropped "
+            f"tokens skip the expert FFN with zero combine weight, a "
+            f"silent quality loss; {lever}",
+            where=cfg.get("name", "moe"),
+            expected_drop_rate=rate,
+            capacity=cfg["capacity"], top_k=cfg["top_k"],
+            num_experts=cfg["num_experts"], tokens=cfg["tokens"],
+            capacity_factor=cfg["capacity_factor"])
+
+
+# --------------------------------------------------------------------------
+# 11. replicated optimizer state (the ZeRO trigger)
+# --------------------------------------------------------------------------
+
+
+def check_replicated_optstate(params, opt_state, mesh, rules,
+                              report: LintReport,
+                              replicated_optstate_bytes: int = 64 << 20) -> None:
+    """``sharding:replicated-optstate`` — per-parameter optimizer
+    accumulators (Adam moments etc.) that every device along a
+    data-parallel axis holds a full copy of, totalling more than
+    ``replicated_optstate_bytes`` per device.
+
+    In this framework optimizer accums inherit their parameter's
+    sharding spec (``parallel.api.shard_scope``), and data axes shard
+    only the batch — so under plain dp the ENTIRE optimizer state is
+    replicated N ways. That is exactly the redundancy the ZeRO /
+    cross-replica-sharded weight update removes (each replica owns a
+    1/N shard of opt state, all-gathers fresh params once per step):
+    this lint is the static trigger for that optimization."""
+    if mesh is None or opt_state is None or not params:
+        return
+    from ..parallel import mesh as mesh_lib
+
+    data_axes = tuple(a for a in mesh_lib.data_axis_names(mesh)
+                      if mesh.shape[a] > 1)
+    data_n = mesh_lib.data_parallel_size(mesh)
+    if data_n <= 1:
+        return
+    from ..parallel.api import _rules as _adapt
+    table = _adapt(rules, mesh)
+    data_axis_set = set(data_axes)
+    repl_bytes = 0.0   # per-device bytes carrying data-axis redundancy
+    saved_bytes = 0.0  # what a ZeRO 1/data_n shard would reclaim
+    leaves = 0
+    for pname, acc in (opt_state.get("accums") or {}).items():
+        if pname not in params:
+            continue
+        pshape = tuple(params[pname].shape)
+        spec = table.spec_for(pname, pshape, mesh)
+        spec_axes = [a for e in spec if e is not None
+                     for a in (e if isinstance(e, tuple) else (e,))
+                     if a in mesh.axis_names]
+        sharded_n = int(np.prod([mesh.shape[a] for a in spec_axes] or [1]))
+        sharded_data_n = int(np.prod([mesh.shape[a] for a in spec_axes
+                                      if a in data_axis_set] or [1]))
+        for v in jax.tree.leaves(acc):
+            shape = tuple(getattr(v, "shape", ()))
+            nbytes = int(np.prod(shape or (1,))) * np.dtype(v.dtype).itemsize
+            # only leaves sharing the param's shape inherit its spec
+            # (shard_scope's contract); scalars/step counters replicate
+            inherit = shape == pshape
+            per_dev = nbytes / (sharded_n if inherit else 1)
+            # redundancy is what remains across the data axes AFTER the
+            # spec's own data-axis sharding: an fsdp-style rule that
+            # already shards along a data axis carries none there
+            repl = data_n // (sharded_data_n if inherit else 1)
+            if repl <= 1:
+                continue
+            repl_bytes += per_dev
+            saved_bytes += per_dev * (repl - 1) / repl
+            leaves += 1
+    if leaves == 0 or repl_bytes < replicated_optstate_bytes:
+        return
+    axes_desc = "x".join(f"{a}={mesh.shape[a]}" for a in data_axes)
+    report.add(
+        "sharding:replicated-optstate", "warning",
+        f"{repl_bytes / 1e6:.1f} MB/device of optimizer state "
+        f"({leaves} accumulator tensors) is replicated across the "
+        f"{data_n}-way data axis ({axes_desc}) — a ZeRO-style "
+        f"cross-replica sharded update (each replica owns a 1/{data_n} "
+        f"shard of opt state and the update, params all-gathered once "
+        f"per step) reclaims {saved_bytes / 1e6:.1f} MB/device of HBM",
+        where="opt_state",
+        replicated_bytes_per_device=int(repl_bytes),
+        zero_saving_bytes=int(saved_bytes),
+        data_shards=data_n, leaves=leaves)

@@ -33,9 +33,17 @@ def _lm_feed(batch: int, seq: int, vocab: int = 64, seed: int = 0):
 
 def _moe_transformer(variant: str, batch: int, seq: int):
     from ..models import moe_transformer as m
+    enforce(variant in ("", "tight"),
+            f"moe_transformer variants: tight; got {variant!r}")
+    # "tight": a deliberately under-capacitied router (capacity_factor
+    # 0.5 drops ~half of all routed tokens under uniform routing) — the
+    # moe:capacity golden-finding fixture; the default config stays
+    # clean (cf 1.25 -> ~0.04% expected drop)
+    cf = 0.5 if variant == "tight" else 1.25
     cfg = m.base_config(vocab_size=64, max_len=max(64, seq), d_model=32,
                         d_inner=64, d_expert=32, num_heads=4, num_layers=2,
-                        num_experts=4, top_k=2, dropout=0.0, fused_ce=False)
+                        num_experts=4, top_k=2, dropout=0.0, fused_ce=False,
+                        capacity_factor=cf)
     ids, labels = _lm_feed(batch, seq)
     return build(m.make_model(cfg)), {"ids": ids, "labels": labels}
 

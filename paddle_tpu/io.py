@@ -57,22 +57,32 @@ _EXOTIC_DTYPES = {"bfloat16": np.uint16,
 # -- pytree <-> flat dict ----------------------------------------------------
 
 
-def _mangle_leaf(prefix: str, arr: np.ndarray):
-    """Single source of truth for leaf-key mangling: the npz member name
-    written by _flatten and the meta.json name written by
-    _flat_leaves_in_tree_order must stay byte-identical (the native
-    predictor looks meta names up in the npz table)."""
-    if arr.dtype.name in _EXOTIC_DTYPES:
-        return f"{prefix}@{arr.dtype.name}", arr.view(_EXOTIC_DTYPES[arr.dtype.name])
+def _mangle_key(prefix: str, dtype: np.dtype):
+    """(stored key, stored dtype) for a leaf of logical dtype ``dtype``
+    named ``prefix`` — the key/dtype half of :func:`_mangle_leaf`,
+    shared with the spec-only flattener (:func:`flat_spec`) so a spec
+    computed without touching array data can never disagree with what
+    ``save_persistables`` actually writes."""
+    if dtype.name in _EXOTIC_DTYPES:
+        return f"{prefix}@{dtype.name}", np.dtype(_EXOTIC_DTYPES[dtype.name])
     if (prefix.endswith("@raw")
-            or any(prefix.endswith(f"@{dt}") and arr.dtype == enc
+            or any(prefix.endswith(f"@{dt}") and dtype == enc
                    for dt, enc in _EXOTIC_DTYPES.items())):
         # a genuine integer param whose NAME ends in '@bfloat16' etc.
         # (or '@raw' itself) would be indistinguishable from our
         # encoding on load — escape with a '@raw' marker (load strips
         # exactly one suffix, so escaping nests safely)
-        return f"{prefix}@raw", arr
-    return prefix, arr
+        return f"{prefix}@raw", dtype
+    return prefix, dtype
+
+
+def _mangle_leaf(prefix: str, arr: np.ndarray):
+    """Single source of truth for leaf-key mangling: the npz member name
+    written by _flatten and the meta.json name written by
+    _flat_leaves_in_tree_order must stay byte-identical (the native
+    predictor looks meta names up in the npz table)."""
+    key, dtype = _mangle_key(prefix, arr.dtype)
+    return key, (arr.view(dtype) if dtype != arr.dtype else arr)
 
 
 def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
@@ -103,6 +113,31 @@ def _flat_leaves_in_tree_order(tree: Any, prefix: str = ""):
         pass
     else:
         out.append(_mangle_leaf(prefix, np.asarray(tree)))
+    return out
+
+
+def flat_spec(tree: Any, prefix: str = "") -> Dict[str, Dict[str, Any]]:
+    """The flat ``{npz key: {"shape": [...], "dtype": "..."}}`` spec
+    :func:`save_persistables` would record for ``tree`` — computed from
+    shapes/dtypes ONLY (no ``device_get``, no flattened copies): the
+    trainer-side half of the static checkpoint-compatibility check in
+    ``analysis.contracts``. Key mangling (exotic-dtype ``@bfloat16``
+    suffixes, ``@raw`` escapes) goes through the same :func:`_mangle_key`
+    the save path uses, so the two can never drift."""
+    out: Dict[str, Dict[str, Any]] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(flat_spec(v, f"{prefix}{SEP}{k}" if prefix else str(k)))
+    elif tree is None:
+        pass
+    else:
+        shape = getattr(tree, "shape", None)
+        dtype = getattr(tree, "dtype", None)
+        if shape is None or dtype is None:
+            arr = np.asarray(tree)
+            shape, dtype = arr.shape, arr.dtype
+        key, stored = _mangle_key(prefix, np.dtype(dtype))
+        out[key] = {"shape": list(shape), "dtype": str(np.dtype(stored))}
     return out
 
 
@@ -247,6 +282,14 @@ def save_trainer(dirname: str, trainer,
     ls = getattr(trainer.scope, "loss_scale_state", None)
     if ls:
         meta["loss_scale_state"] = {k: float(v) for k, v in ls.items()}
+    mesh = getattr(trainer, "mesh", None)
+    if mesh is not None:
+        # the mesh the checkpoint was WRITTEN at: arrays are stored
+        # unsharded, but recording the axes lets the static contract
+        # verifier (analysis.contracts) name the N->M reshard a restore
+        # at a different mesh implies and judge its feasibility
+        meta["mesh_axes"] = {str(a): int(mesh.shape[a])
+                             for a in mesh.axis_names}
     if extra_meta:
         meta.update(extra_meta)
     # checkpoints always store logical layer order: undo the trainer's
@@ -301,6 +344,7 @@ def load_trainer(dirname: str, trainer) -> None:
     if manifest:
         _check_arrays_spec(manifest, dirname, params=params, state=state,
                            opt_state=opt_state)
+    _check_trainer_param_drift(dirname, trainer, params)
     if opt_state is not None:
         # stateless-optimizer per-param accums are empty dicts, which
         # flatten to nothing on save — restore the per-param keys
@@ -329,6 +373,45 @@ def load_trainer(dirname: str, trainer) -> None:
     # saver stored ride here (resilience.restore_latest reads it)
     trainer._last_loaded_meta = dict(meta)
     _restore_loss_scale(trainer, meta, dirname)
+
+
+def _check_trainer_param_drift(dirname: str, trainer, params) -> None:
+    """A checkpoint whose PARAMETER spec diverges from the trainer it is
+    restored into (renamed layer, resized dim, dtype change — i.e. the
+    model config drifted since the save) used to load "successfully" and
+    then die as a shape error deep inside the next step's retrace, or
+    worse, train garbage. Raise a structured
+    :class:`~paddle_tpu.resilience.CheckpointCorrupt` at LOAD time
+    naming the drifted entries instead — the runtime counterpart of the
+    ``ckpt:*`` findings ``analysis.contracts.check_artifacts`` reports
+    without touching the checkpoint. Only runs on a started trainer
+    (``scope.params`` populated); state/opt-state drift stays a
+    warning-level static finding (the runtime falls back by rebuilding
+    them)."""
+    from . import resilience
+
+    have = getattr(getattr(trainer, "scope", None), "params", None)
+    if not have:
+        return
+    # the trainer may hold the interleaved-pipeline row layout; that is
+    # a row PERMUTATION of the logical layout — shapes/dtypes/names are
+    # identical, so the spec comparison is layout-agnostic
+    want, got = flat_spec(have), flat_spec(params)
+    if set(want) != set(got):
+        missing = sorted(set(want) - set(got))[:3]
+        extra = sorted(set(got) - set(want))[:3]
+        raise resilience.CheckpointCorrupt(
+            dirname, f"checkpoint params diverge from the trainer's "
+            f"(missing: {missing}, unexpected: {extra}) — the model "
+            "config drifted since this checkpoint was written")
+    drift = {k: (got[k], want[k]) for k in want if got[k] != want[k]}
+    if drift:
+        k, (g, w) = sorted(drift.items())[0]
+        raise resilience.CheckpointCorrupt(
+            dirname, f"checkpoint param {k!r} is {g} but the trainer "
+            f"expects {w} ({len(drift)} drifted entr"
+            f"{'y' if len(drift) == 1 else 'ies'} total) — the model "
+            "config drifted since this checkpoint was written")
 
 
 def _check_arrays_spec(manifest: Dict[str, Any], dirname: str,
@@ -929,6 +1012,72 @@ def load_inference_model(dirname: str) -> Predictor:
                      bucket_exports=bucket_exports,
                      batch_size=meta.get("batch_size"),
                      batched_feeds=meta.get("batched_feeds"))
+
+
+def read_artifact_meta(dirname: str) -> Dict[str, Any]:
+    """Static metadata surface of a ``save_inference_model`` artifact:
+    the parsed ``meta.json`` (feed names, flat input/output specs,
+    batch buckets), the manifest (flat weight spec — read WITHOUT the
+    CRC pass), and which per-bucket StableHLO files actually exist on
+    disk. No deserialization, no AOT compile, no device work — this is
+    what ``analysis.contracts`` and the serving pre-reload check reason
+    over. Raises :class:`~paddle_tpu.resilience.CheckpointCorrupt` for
+    a directory that is not a readable artifact."""
+    from . import resilience
+
+    if not os.path.isdir(dirname):
+        raise resilience.CheckpointCorrupt(dirname, "not a directory")
+    mpath = os.path.join(dirname, "meta.json")
+    if not os.path.exists(mpath):
+        raise resilience.CheckpointCorrupt(
+            dirname, "no meta.json (not a save_inference_model artifact)")
+    try:
+        with open(mpath) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        raise resilience.CheckpointCorrupt(
+            dirname, f"unreadable meta.json: {e}") from e
+    manifest = resilience.read_manifest(dirname)  # None for legacy
+    batch = int(meta.get("batch_size", 0) or 0)
+    bucket_files = {}
+    for b in meta.get("batch_buckets", []) or []:
+        b = int(b)
+        # the export's own batch size lives in model.stablehlo itself
+        name = ("model.stablehlo" if b == batch
+                else f"model.b{b}.stablehlo")
+        bucket_files[b] = os.path.isfile(os.path.join(dirname, name))
+    return {
+        "path": dirname,
+        "meta": meta,
+        "manifest": manifest,
+        "bucket_files": bucket_files,
+        "model_file": os.path.isfile(os.path.join(dirname,
+                                                  "model.stablehlo")),
+    }
+
+
+def artifact_feed_spec(meta: Dict[str, Any],
+                       batch: Optional[int] = None) -> Dict[str, Tuple[Tuple[int, ...], Any]]:
+    """``{feed name: (shape, dtype)}`` at bucket ``batch`` (default:
+    the export's own batch size), reconstructed from an artifact's
+    ``meta.json`` dict alone — byte-for-byte the spec
+    :meth:`Predictor.feed_spec` computes from the deserialized export,
+    so a static pre-reload check and the live server can never
+    disagree."""
+    feeds = {e["name"]: e for e in meta.get("inputs", [])
+             if e.get("source") == "feed"}
+    enforce(set(feeds) == set(meta.get("feed_names", [])),
+            f"artifact meta is inconsistent: inputs name feeds "
+            f"{sorted(feeds)} but feed_names is {meta.get('feed_names')}")
+    batch = int(meta["batch_size"]) if batch is None else int(batch)
+    batched = set(meta.get("batched_feeds", []))
+    out = {}
+    for k, e in feeds.items():
+        shape = tuple(int(d) for d in e["shape"])
+        if k in batched:
+            shape = (batch,) + shape[1:]
+        out[k] = (shape, np.dtype(str(e["dtype"])))
+    return out
 
 
 def save_params(dirname: str, params, state=None, opt_state=None):

@@ -27,8 +27,10 @@ Returns ``(out, aux_loss)`` — aux_loss is the load-balance term
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
+import threading
 from typing import Optional, Tuple
 
 import jax
@@ -39,6 +41,36 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..framework import LayerHelper, cast_compute
 from .. import initializer as init
 from . import mesh as mesh_lib
+
+
+# -- static-config capture (analysis.contracts / moe:capacity lint) ---------
+# Every moe() call records its routing shape here when a capture is
+# active: the capacity/top_k/token numbers are fully static (they size
+# the dispatch tensors), so the expected token drop rate is computable
+# without running anything. analysis.check wraps its program traces in
+# capture_moe_configs() and feeds the records to rules.check_moe_capacity.
+
+_capture_tls = threading.local()
+
+
+@contextlib.contextmanager
+def capture_moe_configs():
+    """Collect the static routing config of every ``moe()`` layer traced
+    inside the block. Yields the list the records append to. Nested
+    captures each see only their own block's layers; with no capture
+    active, recording is a no-op (zero trace-time cost)."""
+    prev = getattr(_capture_tls, "log", None)
+    _capture_tls.log = log = []
+    try:
+        yield log
+    finally:
+        _capture_tls.log = prev
+
+
+def _record_config(**cfg) -> None:
+    log = getattr(_capture_tls, "log", None)
+    if log is not None:
+        log.append(cfg)
 
 
 def _topk_dispatch(probs, top_k: int, capacity: int, normalize_gates: bool):
@@ -183,6 +215,16 @@ def moe(
     shards = ep * int(np.prod([mesh.shape[a] for a in data_axes] or [1]))
     t_local = (b // max(1, shards)) * s if ep > 1 else b * s
     capacity = max(1, int(math.ceil(t_local * top_k / num_experts * capacity_factor)))
+    # record under the FULL scoped path (what params are named under):
+    # two MoE layers in different scopes are distinct findings — the
+    # scope-local helper name ("moe_0") would collide their fingerprints
+    # and a baseline for one would suppress the other
+    from ..framework import current_context
+    _ctx = current_context()
+    _record_config(name=_ctx.full_name(helper.name) if _ctx else helper.name,
+                   num_experts=num_experts, top_k=top_k,
+                   capacity_factor=float(capacity_factor), capacity=capacity,
+                   tokens=t_local, ep=ep)
 
     if ep == 1:
         # dense path (single device / ep absent): same algorithm, no collectives

@@ -134,6 +134,37 @@ def write_manifest(dirname: str, meta: Optional[Dict[str, Any]] = None,
     return man
 
 
+def read_manifest(dirname: str) -> Optional[Dict[str, Any]]:
+    """Parse a checkpoint/artifact directory's ``manifest.json`` WITHOUT
+    the CRC pass — the static metadata surface the cross-artifact
+    verifier (``analysis.contracts``) reasons over: the flat shape/dtype
+    spec (``manifest["arrays"]``), the checkpoint meta (global_step,
+    loss_scale_state, mesh_axes), and the per-file size table.
+
+    Returns ``None`` for a legacy (pre-manifest) directory; raises
+    :class:`CheckpointCorrupt` for a missing/unreadable/wrong-version
+    manifest — the same classification :func:`validate_checkpoint`
+    makes, minus the streaming checksum read (which only a real restore
+    should pay; a bit-flipped *payload* is invisible here by design,
+    but a bit-flipped manifest is caught)."""
+    if not os.path.isdir(dirname):
+        raise CheckpointCorrupt(dirname, "not a directory")
+    mpath = os.path.join(dirname, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return None
+    try:
+        with open(mpath) as f:
+            man = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorrupt(dirname, f"unreadable manifest: {e}") from e
+    ver = man.get("format_version")
+    if not isinstance(ver, int) or ver > MANIFEST_VERSION:
+        raise CheckpointCorrupt(
+            dirname, f"manifest format_version {ver!r} not supported "
+            f"(this build reads <= {MANIFEST_VERSION})")
+    return man
+
+
 def validate_checkpoint(dirname: str) -> Optional[Dict[str, Any]]:
     """Verify a checkpoint directory against its manifest.
 
@@ -147,21 +178,9 @@ def validate_checkpoint(dirname: str) -> Optional[Dict[str, Any]]:
     is the deliberate trade: size/parse checks alone cannot catch
     silent bit flips, and the whole point of validation is never
     handing a bitrotted parameter tensor to a resumed run."""
-    if not os.path.isdir(dirname):
-        raise CheckpointCorrupt(dirname, "not a directory")
-    mpath = os.path.join(dirname, MANIFEST_NAME)
-    if not os.path.exists(mpath):
+    man = read_manifest(dirname)
+    if man is None:
         return None  # legacy checkpoint: caller decides how much to trust
-    try:
-        with open(mpath) as f:
-            man = json.load(f)
-    except (OSError, ValueError) as e:
-        raise CheckpointCorrupt(dirname, f"unreadable manifest: {e}") from e
-    ver = man.get("format_version")
-    if not isinstance(ver, int) or ver > MANIFEST_VERSION:
-        raise CheckpointCorrupt(
-            dirname, f"manifest format_version {ver!r} not supported "
-            f"(this build reads <= {MANIFEST_VERSION})")
     for name, spec in (man.get("files") or {}).items():
         p = os.path.join(dirname, name)
         if not os.path.isfile(p):
@@ -486,6 +505,6 @@ def record_incident(incidents: List[Incident], step: int,
 __all__ = [
     "CheckpointCorrupt", "CheckpointInfo", "GuardPolicy", "Incident",
     "InjectedCrash", "PreemptionHandler", "crash_point", "crash_points",
-    "feed_digest", "list_checkpoints", "restore_latest", "sweep_tmp_dirs",
-    "validate_checkpoint", "write_manifest",
+    "feed_digest", "list_checkpoints", "read_manifest", "restore_latest",
+    "sweep_tmp_dirs", "validate_checkpoint", "write_manifest",
 ]

@@ -750,9 +750,44 @@ class PredictorServer:
             raise err[0]
         return None
 
+    def reload_preflight(self, dirname: str):
+        """Static pre-reload contract check: the
+        :class:`~paddle_tpu.analysis.LintReport` of
+        ``analysis.contracts.check_reload_compat`` for swapping the
+        artifact at ``dirname`` in over the currently-served model —
+        metadata only (no CRC pass, no deserialization, no AOT
+        compile), so an operator or a rolling-fleet controller can
+        vet a candidate against every server BEFORE any of them pays
+        a load. ``reload`` runs this automatically and rejects on any
+        error-severity finding."""
+        from .analysis import contracts
+        info = self._io.read_artifact_meta(dirname)
+        with self._model_lock:
+            served = contracts.serving_spec(self._predictor)
+        return contracts.check_reload_compat(served, info)
+
+    def _reload_static_check(self, dirname: str) -> None:
+        # early REJECT only, never an early accept: a candidate whose
+        # metadata alone proves the swap would strand in-flight traffic
+        # fails before the load + per-bucket AOT compile is paid; an
+        # unreadable/odd artifact falls through for the real load to
+        # classify (CheckpointCorrupt with the CRC detail), and the
+        # post-load checks below stay as the backstop for drift classes
+        # only the deserialized export shows
+        try:
+            report = self.reload_preflight(dirname)
+        except Exception:
+            return
+        errs = report.at_least("error")
+        if errs:
+            more = (f" (+{len(errs) - 1} more static contract finding(s))"
+                    if len(errs) > 1 else "")
+            raise ReloadFailed(dirname, errs[0].message + more)
+
     def _do_reload(self, dirname: str) -> None:
         with self._reload_lock:
             try:
+                self._reload_static_check(dirname)
                 new_pred = self._io.load_inference_model(dirname)
                 old = self._predictor
                 if list(new_pred.feed_names) != list(old.feed_names):

@@ -537,3 +537,90 @@ def test_apply_row_perm_walks_all_name_keyed_state():
                                np.asarray(params["stack/w"]))
     np.testing.assert_allclose(np.asarray(o3["accums"]["stack/w"]["m"]),
                                np.asarray(rows * 100.0))
+
+
+# --------------------------------------------------------------------------
+# CLI exit codes: findings (1) vs internal error (3)
+# --------------------------------------------------------------------------
+
+
+def test_cli_exit1_on_findings_vs_exit3_on_crash(tmp_path, capsys):
+    """The CI contract of `python -m paddle_tpu.analysis`: exit 1 means
+    YOUR program has findings; exit 3 means the CHECKER broke (unknown
+    model, bad baseline file) — a crash must never read as a lint
+    verdict in either direction."""
+    import json
+
+    from paddle_tpu.analysis.__main__ import main as lint_main
+
+    # findings present (the tight-MoE golden) -> 1
+    argv = ["--model", "moe_transformer", "--variant", "tight"]
+    assert lint_main(argv) == 1
+    assert "moe:capacity" in capsys.readouterr().out
+
+    # checker crash (unknown zoo model) -> 3, with the traceback shown
+    assert lint_main(["--model", "no_such_model"]) == 3
+    assert "internal error" in capsys.readouterr().err
+
+    # a malformed baseline file is a checker problem, not a verdict -> 3
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as fh:
+        json.dump({"version": 99, "baseline": {}}, fh)
+    assert lint_main(argv + ["--ci", "--baseline", bad]) == 3
+    capsys.readouterr()
+
+    # a bad flag VALUE is a usage error -> 2 (argparse's code), never
+    # 1 ("you introduced a finding") or 3 ("the checker is broken")
+    with pytest.raises(SystemExit) as ei:
+        lint_main(argv + ["--severity", "no_equals_sign"])
+    assert ei.value.code == 2
+    with pytest.raises(SystemExit) as ei:
+        lint_main(argv + ["--severity", "moe:capacity=bogus"])
+    assert ei.value.code == 2   # rejected BEFORE paying the model build
+    with pytest.raises(SystemExit) as ei:
+        lint_main(argv + ["--rules", "nope"])
+    assert ei.value.code == 2
+    capsys.readouterr()
+
+    # --baseline keeps its promise without --ci too
+    base0 = str(tmp_path / "base0.json")
+    assert lint_main(argv + ["--write-baseline", base0]) == 0
+    assert lint_main(argv + ["--baseline", base0]) == 0
+    capsys.readouterr()
+
+    # --ci still names the new fingerprints under machine formats
+    assert lint_main(argv + ["--ci", "--format", "sarif"]) == 1
+    cap = capsys.readouterr()
+    assert json.loads(cap.out)["version"] == "2.1.0"
+    assert "moe:capacity|blocks/moe_0" in cap.err
+
+    # --ci with the findings baselined -> 0; severity demotion -> 0 too
+    base = str(tmp_path / "base.json")
+    assert lint_main(argv + ["--write-baseline", base]) == 0
+    capsys.readouterr()
+    assert lint_main(argv + ["--ci", "--baseline", base]) == 0
+    assert lint_main(argv + ["--severity", "moe:capacity=info"]) == 0
+    capsys.readouterr()
+
+
+def test_cli_subject_matches_lint_gate_baseline(capsys):
+    """The CLI's baseline subject must name configs the way
+    tools/lint_gate.py does ("gpt.amp", "moe_transformer.tight"), or the
+    committed baseline can never suppress a CLI run: the module
+    docstring's own example must exit 0 against the committed file."""
+    import os
+
+    from paddle_tpu.analysis.__main__ import main as lint_main
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    baseline = os.path.join(root, "tools", "analysis_baseline.json")
+    assert lint_main(["--model", "gpt", "--amp", "bfloat16", "--ci",
+                      "--baseline", baseline]) == 0
+    capsys.readouterr()
+    # --subject overrides the default naming entirely: a made-up
+    # subject no longer matches the suppressed keys -> the golden
+    # finding reads as new again
+    assert lint_main(["--model", "gpt", "--amp", "bfloat16", "--ci",
+                      "--baseline", baseline,
+                      "--subject", "somewhere_else"]) == 1
+    assert "new finding" in capsys.readouterr().err

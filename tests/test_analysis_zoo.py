@@ -88,7 +88,27 @@ def test_cli_fail_on_and_json(capsys):
                       "--fail-on", "error"]) == 0
 
 
-def test_cli_unknown_model():
-    from paddle_tpu.core.errors import EnforceError
-    with pytest.raises(EnforceError):
-        lint_main(["--model", "nope"])
+def test_cli_unknown_model_is_internal_error():
+    """A crash inside the checker (here: an unknown zoo model blowing
+    up build_model) must exit 3 — distinct from exit 1 so CI can tell
+    "your change introduced a finding" from "the checker is broken"."""
+    assert lint_main(["--model", "nope"]) == 3
+
+
+def test_moe_tight_golden_report():
+    """Pinned true positive: the 'tight' moe_transformer variant runs
+    capacity_factor=0.5 — under uniform routing the static expected
+    token drop rate is ~50%, far over the 5% threshold. The default
+    variant must stay clean (cf=1.25 -> ~0.04%): if this golden goes
+    clean, the fixture's capacity changed — update the variant, not the
+    threshold."""
+    program, feed = build_model("moe_transformer", variant="tight")
+    report = analysis.check(program, feed)
+    hits = report.by_code("moe:capacity")
+    assert hits, report.render()
+    rate = hits[0].data["expected_drop_rate"]
+    assert 0.3 < rate < 0.6, rate
+    assert hits[0].severity == "warning"
+    # dedupe: repeated traces of the same layer merge into one finding
+    # per fingerprint with a count, not an accumulating list
+    assert len({f.fingerprint for f in hits}) == len(hits)

@@ -1,0 +1,825 @@
+"""analysis.contracts — the cross-artifact contract verifier, plus the
+CI surface it feeds (fingerprints, baselines, SARIF, tools/lint_gate).
+
+The acceptance shape of every contract test here: the STATIC finding
+and its RUNTIME counterpart error are pinned in the same test, so the
+claim "check_artifacts reports what the runtime would raise" is never
+aspirational. Fault injection reuses paddle_tpu.testing.faults
+(flip_byte) plus hand-edited manifest specs for the drift classes a
+byte flip can't express deterministically."""
+
+import json
+import os
+import shutil
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as pt
+from paddle_tpu import analysis
+from paddle_tpu import io as pio
+from paddle_tpu import layers as L
+from paddle_tpu import optimizer as opt
+from paddle_tpu import resilience
+from paddle_tpu.analysis import report as lint_report
+from paddle_tpu.analysis.report import Finding, LintReport
+from paddle_tpu.core.errors import EnforceError
+from paddle_tpu.parallel import DistStrategy
+from paddle_tpu.parallel.sharding import ShardingRules
+from paddle_tpu.resilience import CheckpointCorrupt
+from paddle_tpu.serving import PredictorServer, ReloadFailed
+from paddle_tpu.testing import faults
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from tools import lint_gate
+
+DIM, CLASSES, BS = 6, 4, 4
+
+
+def _net(dim_h=16):
+    def net(x, label):
+        h = L.fc(x, dim_h, name="fc1")
+        logits = L.fc(h, CLASSES, name="fc2")
+        return {"loss": L.mean(L.softmax_with_cross_entropy(logits, label))}
+    return net
+
+
+def _feed(batch=BS, dim=DIM):
+    return {"x": np.zeros((batch, dim), np.float32),
+            "label": np.zeros((batch, 1), np.int64)}
+
+
+def _trainer(dim_h=16, mesh=None, rules=None, strategy=None, optim=None,
+             feed=None):
+    tr = pt.Trainer(pt.build(_net(dim_h)), optim or opt.SGD(0.1),
+                    loss_name="loss", mesh=mesh, sharding_rules=rules,
+                    strategy=strategy)
+    tr.startup(sample_feed=feed or _feed())
+    return tr
+
+
+def _checkpoint(tmp_path, tr, name="ck", **kw):
+    d = str(tmp_path / name)
+    pio.save_trainer(d, tr, **kw)
+    return d
+
+
+def _edit_manifest(ck, mutate):
+    p = os.path.join(ck, resilience.MANIFEST_NAME)
+    with open(p) as f:
+        man = json.load(f)
+    mutate(man)
+    with open(p, "w") as f:
+        json.dump(man, f)
+
+
+# --------------------------------------------------------------------------
+# ckpt:* — checkpoint vs trainer, static finding + runtime counterpart
+# --------------------------------------------------------------------------
+
+
+def test_clean_pair_has_no_findings(tmp_path):
+    tr = _trainer()
+    tr.step(_feed())
+    ck = _checkpoint(tmp_path, tr)
+    rep = analysis.check_artifacts(trainer=tr, checkpoint_dir=ck)
+    assert rep.ok("info"), rep.render("info")
+    pio.load_trainer(ck, tr)  # and the runtime agrees
+
+
+def test_shape_drifted_checkpoint_static_and_runtime(tmp_path):
+    """Acceptance (a): a checkpoint whose param shapes drifted from the
+    trainer's model config is a named error finding — and load_trainer
+    raises CheckpointCorrupt naming the same param."""
+    ck = _checkpoint(tmp_path, _trainer(dim_h=16))
+    tr24 = _trainer(dim_h=24)
+    rep = analysis.check_artifacts(trainer=tr24, checkpoint_dir=ck)
+    drift = rep.by_code("ckpt:shape-drift")
+    assert drift and all(f.severity == "error" for f in drift)
+    assert {f.where for f in drift} == {
+        "params.npz:fc1/w", "params.npz:fc1/b", "params.npz:fc2/w"}
+    f = next(f for f in drift if f.where == "params.npz:fc1/w")
+    assert f.data["got"] == [6, 16] and f.data["expected"] == [6, 24]
+    with pytest.raises(CheckpointCorrupt, match="fc1/b.*drifted"):
+        pio.load_trainer(ck, tr24)
+
+
+def test_missing_and_extra_entries_static_and_runtime(tmp_path):
+    """A renamed layer shows up as a missing+extra pair; load_trainer's
+    runtime verdict is the same divergence, raised as
+    CheckpointCorrupt."""
+    def renamed(x, label):
+        h = L.fc(x, 16, name="fc1")
+        logits = L.fc(h, CLASSES, name="head")   # fc2 renamed
+        return {"loss": L.mean(L.softmax_with_cross_entropy(logits, label))}
+
+    ck = _checkpoint(tmp_path, _trainer())
+    tr2 = pt.Trainer(pt.build(renamed), opt.SGD(0.1), loss_name="loss")
+    tr2.startup(sample_feed=_feed())
+    rep = analysis.check_artifacts(trainer=tr2, checkpoint_dir=ck)
+    missing = rep.by_code("ckpt:missing-entry")
+    extra = rep.by_code("ckpt:extra-entry")
+    assert {f.where for f in missing} >= {"params.npz:head/w"}
+    assert {f.where for f in extra} >= {"params.npz:fc2/w"}
+    assert all(f.severity == "error" for f in missing + extra)
+    with pytest.raises(CheckpointCorrupt, match="diverge"):
+        pio.load_trainer(ck, tr2)
+
+
+def test_manifest_bitrot_static_and_runtime(tmp_path):
+    """faults.flip_byte on the manifest itself: statically
+    ckpt:unreadable, at runtime CheckpointCorrupt — a torn manifest must
+    never read as 'legacy, validate nothing'."""
+    tr = _trainer()
+    ck = _checkpoint(tmp_path, tr)
+    faults.flip_byte(ck, name=resilience.MANIFEST_NAME, offset=0)
+    rep = analysis.check_artifacts(trainer=tr, checkpoint_dir=ck)
+    (f,) = rep.by_code("ckpt:unreadable")
+    assert f.severity == "error" and "unreadable" in f.message
+    assert not rep.by_code("ckpt:legacy")
+    with pytest.raises(CheckpointCorrupt, match="manifest"):
+        pio.load_trainer(ck, tr)
+
+
+def test_manifest_spec_hand_edit_shape_static_and_runtime(tmp_path):
+    """Satellite: rewrite one manifest spec entry's shape. Statically
+    ckpt:shape-drift names the entry; at runtime the manifest/npz
+    cross-check in load_trainer raises CheckpointCorrupt on the same
+    entry."""
+    tr = _trainer()
+    ck = _checkpoint(tmp_path, tr)
+
+    def grow_fc1(man):
+        man["arrays"]["params.npz"]["fc1/w"]["shape"] = [DIM, 99]
+    _edit_manifest(ck, grow_fc1)
+
+    rep = analysis.check_artifacts(trainer=tr, checkpoint_dir=ck)
+    (f,) = rep.by_code("ckpt:shape-drift")
+    assert f.where == "params.npz:fc1/w" and f.severity == "error"
+    assert f.data["got"] == [DIM, 99]
+    with pytest.raises(CheckpointCorrupt,
+                       match="fc1/w.*manifest records"):
+        pio.load_trainer(ck, tr)
+
+
+def test_manifest_spec_hand_edit_dtype_static_and_runtime(tmp_path):
+    tr = _trainer()
+    ck = _checkpoint(tmp_path, tr)
+
+    def retype_fc1_b(man):
+        man["arrays"]["params.npz"]["fc1/b"]["dtype"] = "float64"
+    _edit_manifest(ck, retype_fc1_b)
+
+    rep = analysis.check_artifacts(trainer=tr, checkpoint_dir=ck)
+    (f,) = rep.by_code("ckpt:dtype-drift")
+    assert f.where == "params.npz:fc1/b"
+    assert f.data == {"got": "float64", "expected": "float32"}
+    with pytest.raises(CheckpointCorrupt,
+                       match="fc1/b.*manifest records"):
+        pio.load_trainer(ck, tr)
+
+
+def test_loss_scale_drift_static_and_runtime(tmp_path):
+    """Both drift directions are warnings (the runtime warns and falls
+    back — it never crashes), so they must not block a gate at
+    fail-on=error."""
+    plain = _trainer()
+    ck_plain = _checkpoint(tmp_path, plain, "ck_plain")
+    scaled = _trainer(strategy=DistStrategy(loss_scale=2.0 ** 10,
+                                            dynamic_loss_scale=True))
+    rep = analysis.check_artifacts(trainer=scaled, checkpoint_dir=ck_plain)
+    (f,) = rep.by_code("ckpt:loss-scale-drift")
+    assert f.severity == "warning" and "no loss_scale_state" in f.message
+    assert rep.ok("error")
+    with pytest.warns(UserWarning, match="no loss_scale_state"):
+        pio.load_trainer(ck_plain, scaled)
+
+    ck_scaled = _checkpoint(tmp_path, scaled, "ck_scaled")
+    rep = analysis.check_artifacts(trainer=plain, checkpoint_dir=ck_scaled)
+    (f,) = rep.by_code("ckpt:loss-scale-drift")
+    assert f.severity == "warning" and "no loss scaler" in f.message
+    with pytest.warns(UserWarning, match="no loss scaler"):
+        pio.load_trainer(ck_scaled, plain)
+
+
+def test_malformed_metadata_degrades_to_finding_not_crash(tmp_path):
+    """Metadata that parses but is internally inconsistent is a
+    *finding* (the artifact is corrupt), never a checker crash — a CI
+    caller must see exit 1 with the artifact named, not exit 3."""
+    tr = _trainer()
+    ck = _checkpoint(tmp_path, tr)
+
+    def drop_shape(man):
+        del man["arrays"]["params.npz"]["fc1/w"]["shape"]
+    _edit_manifest(ck, drop_shape)
+    rep = analysis.check_artifacts(trainer=tr, checkpoint_dir=ck,
+                                   mesh=pt.make_mesh({"dp": 8}),
+                                   sample_feed=_feed(batch=8))
+    assert rep.by_code("ckpt:malformed"), rep.render("info")
+
+    art, _ = _export(tmp_path)
+    mpath = os.path.join(art, "meta.json")
+    with open(mpath) as fh:
+        meta = json.load(fh)
+    # inputs table disagrees with feed_names: a torn partial rewrite
+    meta["inputs"] = [e for e in meta["inputs"]
+                      if not (e.get("source") == "feed"
+                              and e["name"] == "x")]
+    with open(mpath, "w") as fh:
+        json.dump(meta, fh)
+    rep = analysis.check_artifacts(trainer=tr, artifact_dir=art,
+                                   sample_feed=_feed())
+    (f,) = rep.by_code("artifact:malformed")
+    assert f.severity == "error" and "EnforceError" in f.message
+
+
+def test_legacy_checkpoint_is_info_only(tmp_path):
+    tr = _trainer()
+    ck = _checkpoint(tmp_path, tr)
+    os.remove(os.path.join(ck, resilience.MANIFEST_NAME))
+    rep = analysis.check_artifacts(trainer=tr, checkpoint_dir=ck)
+    (f,) = rep.by_code("ckpt:legacy")
+    assert f.severity == "info"
+    assert rep.ok("warning")
+
+
+# --------------------------------------------------------------------------
+# restore-at-a-different-mesh feasibility (the dp N->M reshard verdicts)
+# --------------------------------------------------------------------------
+
+
+def test_reshard_infeasible_static_and_runtime(tmp_path):
+    """Acceptance (c): restoring a single-host checkpoint at dp=8 with a
+    batch the data axis can't split is statically ckpt:reshard-infeasible
+    — the runtime counterpart being put_batch's NamedSharding rejecting
+    the first feed."""
+    tr = _trainer()
+    ck = _checkpoint(tmp_path, tr)
+    mesh8 = pt.make_mesh({"dp": 8})
+    rep = analysis.check_artifacts(trainer=tr, checkpoint_dir=ck,
+                                   mesh=mesh8, sample_feed=_feed(batch=4))
+    (f,) = rep.by_code("ckpt:reshard-infeasible")
+    assert f.severity == "error"
+    assert f.data == {"got": [4], "expected": [8]}
+    assert not rep.by_code("ckpt:mesh-reshard")  # no feasible verdict
+    with pytest.raises(ValueError, match="divisible by 8"):
+        tr8 = pt.Trainer(pt.build(_net()), opt.SGD(0.1), loss_name="loss",
+                         mesh=mesh8)
+        tr8.startup(sample_feed=_feed(batch=4))
+        tr8.step(_feed(batch=4))
+
+
+def test_reshard_feasible_n_to_m_static_and_runtime(tmp_path):
+    """The positive verdict: a dp 2->8 resize whose batch divides the
+    target data shards is expressible (arrays are stored unsharded) —
+    an info finding, and the actual restore + step works."""
+    mesh2 = pt.make_mesh({"dp": 2}, devices=jax.devices()[:2])
+    tr2 = _trainer(mesh=mesh2, feed=_feed(batch=8))
+    ck = _checkpoint(tmp_path, tr2)
+    man = resilience.read_manifest(ck)
+    assert man["meta"]["mesh_axes"] == {"dp": 2}  # the saved-at mesh
+
+    mesh8 = pt.make_mesh({"dp": 8})
+    tr8 = _trainer(mesh=mesh8, feed=_feed(batch=8))
+    rep = analysis.check_artifacts(trainer=tr8, checkpoint_dir=ck,
+                                   sample_feed=_feed(batch=8))
+    (f,) = rep.by_code("ckpt:mesh-reshard")
+    assert f.severity == "info"
+    assert "{'dp': 2} -> {'dp': 8}" in f.message
+    assert not rep.by_code("ckpt:reshard-infeasible")
+    assert rep.ok("warning"), rep.render("info")
+    pio.load_trainer(ck, tr8)
+    tr8.step(_feed(batch=8))
+
+
+def test_reshard_same_mesh_is_silent(tmp_path):
+    mesh8 = pt.make_mesh({"dp": 8})
+    tr = _trainer(mesh=mesh8, feed=_feed(batch=8))
+    ck = _checkpoint(tmp_path, tr)
+    rep = analysis.check_artifacts(trainer=tr, checkpoint_dir=ck,
+                                   sample_feed=_feed(batch=8))
+    assert not [f for f in rep.findings if f.code.startswith("ckpt:")], \
+        rep.render("info")
+
+
+def test_reshard_honors_rules_batch_axes(tmp_path):
+    """The feasibility verdict must mirror put_batch, which shards the
+    batch per ShardingRules.batch_axes — NOT the mesh's nominal data
+    axes. On a {dp:2, fsdp:4} mesh with batch_axes=('dp',), batch 4
+    splits 2-way and restores fine; calling it infeasible against the
+    8-way data-axis product would be a false alarm (and the runtime
+    step is the proof)."""
+    tr = _trainer()
+    ck = _checkpoint(tmp_path, tr)
+    mesh = pt.make_mesh({"dp": 2, "fsdp": 4})
+    rules = ShardingRules(batch_axes=("dp",))
+    rep = analysis.check_artifacts(trainer=tr, checkpoint_dir=ck,
+                                   mesh=mesh, sharding_rules=rules,
+                                   sample_feed=_feed(batch=4))
+    assert not rep.by_code("ckpt:reshard-infeasible"), rep.render("info")
+    (f,) = rep.by_code("ckpt:mesh-reshard")
+    assert "2-way" in f.message
+    # runtime counterpart: the restore + step actually works
+    tr_m = _trainer(mesh=mesh, rules=rules, feed=_feed(batch=4))
+    pio.load_trainer(ck, tr_m)
+    tr_m.step(_feed(batch=4))
+    # and WITHOUT the batch_axes restriction the same batch is honestly
+    # infeasible (8-way product), so the rules truly drive the verdict
+    rep = analysis.check_artifacts(trainer=tr, checkpoint_dir=ck,
+                                   mesh=mesh, sample_feed=_feed(batch=4))
+    (f,) = rep.by_code("ckpt:reshard-infeasible")
+    assert f.data == {"got": [4], "expected": [8]}
+
+
+def test_reshard_dropped_rule_is_warning_not_error(tmp_path):
+    """A target mesh that can't honor a sharding rule (dim 6 over tp=8)
+    is feasible-but-degraded: the param replicates. Warning, with the
+    feasibility verdict still emitted."""
+    tr = _trainer()
+    ck = _checkpoint(tmp_path, tr)
+    mesh_tp = pt.make_mesh({"tp": 8})
+    rules = ShardingRules([(r".*fc1/w", P("tp", None))])
+    rep = analysis.check_artifacts(trainer=tr, checkpoint_dir=ck,
+                                   mesh=mesh_tp, sharding_rules=rules,
+                                   sample_feed=_feed())
+    dropped = rep.by_code("ckpt:reshard-dropped-rule")
+    assert dropped and all(f.severity == "warning" for f in dropped)
+    (f,) = rep.by_code("ckpt:mesh-reshard")
+    assert "some rules drop" in f.message
+
+
+# --------------------------------------------------------------------------
+# artifact:* — serving artifact internal consistency + drift
+# --------------------------------------------------------------------------
+
+
+def _export(tmp_path, name="art", dim_h=16, feed=None, **kw):
+    prog = pt.build(_net(dim_h))
+    feed = feed or _feed(batch=8)
+    params, state = prog.init(jax.random.PRNGKey(0), **feed)
+    d = str(tmp_path / name)
+    pio.save_inference_model(d, prog, jax.tree.map(np.asarray, params),
+                             state, feed, **kw)
+    return d, prog
+
+
+def test_stale_bucket_static_and_runtime(tmp_path):
+    """Acceptance (b): meta.json names bucket 4 but its StableHLO file
+    is gone — statically artifact:stale-bucket, at runtime
+    load_inference_model raises CheckpointCorrupt naming the file."""
+    art, _ = _export(tmp_path, batch_buckets=[4, 8])
+    os.remove(os.path.join(art, "model.b4.stablehlo"))
+    rep = analysis.check_artifacts(artifact_dir=art)
+    (f,) = rep.by_code("artifact:stale-bucket")
+    assert f.severity == "error" and f.data["bucket"] == 4
+    with pytest.raises(CheckpointCorrupt, match="model.b4.stablehlo"):
+        pio.load_inference_model(art)
+
+
+def test_missing_model_file_static_and_runtime(tmp_path):
+    art, _ = _export(tmp_path)
+    os.remove(os.path.join(art, "model.stablehlo"))
+    rep = analysis.check_artifacts(artifact_dir=art)
+    assert rep.by_code("artifact:missing-model")
+    with pytest.raises(CheckpointCorrupt, match="model.stablehlo"):
+        pio.load_inference_model(art)
+
+
+def test_torn_artifact_dir_is_unreadable_finding(tmp_path):
+    d = str(tmp_path / "torn")
+    os.makedirs(d)
+    rep = analysis.check_artifacts(artifact_dir=d)
+    (f,) = rep.by_code("artifact:unreadable")
+    assert "meta.json" in f.message
+
+
+def test_artifact_param_and_feed_drift_vs_trainer(tmp_path):
+    """The re-export contract: an artifact from an older model config
+    diverges from the trainer that would hot-reload over it — weights
+    at warning (the next export replaces them), feed signature at error
+    (every trainer-contract request fails validation). Runtime
+    counterpart: the loaded predictor rejects the trainer's feed."""
+    art, _ = _export(tmp_path, dim_h=16)          # exported with x[_,6]
+    tr = _trainer(dim_h=24, feed=_feed(dim=8))    # now feeds x[_,8]
+    rep = analysis.check_artifacts(trainer=tr, artifact_dir=art,
+                                   sample_feed=_feed(dim=8))
+    (pdrift,) = rep.by_code("artifact:param-drift")
+    assert pdrift.severity == "warning"
+    (fdrift,) = rep.by_code("artifact:feed-drift")
+    assert fdrift.severity == "error" and fdrift.where == "x"
+    pred = pio.load_inference_model(art)
+    from paddle_tpu.io import InvalidRequest
+    with pytest.raises(InvalidRequest, match="x.*shape"):
+        pred.run({k: v[:8] for k, v in _feed(batch=8, dim=8).items()})
+
+
+def test_artifact_feed_names_drift(tmp_path):
+    art, _ = _export(tmp_path)
+
+    def other(image, label):
+        logits = L.fc(image, CLASSES, name="fc")
+        return {"loss": L.mean(L.softmax_with_cross_entropy(logits, label))}
+
+    tr = pt.Trainer(pt.build(other), opt.SGD(0.1), loss_name="loss")
+    feed = {"image": np.zeros((BS, DIM), np.float32),
+            "label": np.zeros((BS, 1), np.int64)}
+    tr.startup(sample_feed=feed)
+    rep = analysis.check_artifacts(trainer=tr, artifact_dir=art,
+                                   sample_feed=feed)
+    (f,) = rep.by_code("artifact:feed-names")
+    assert f.data["expected"] == ["image", "label"]
+    assert f.data["got"] == ["label", "x"]
+
+
+# --------------------------------------------------------------------------
+# the serving pre-reload contract
+# --------------------------------------------------------------------------
+
+
+def test_reload_preflight_rejects_statically_without_paying_load(tmp_path):
+    """PredictorServer.reload over a shrunk-bucket candidate fails from
+    metadata alone: the load + per-bucket AOT compile is never paid.
+    reload_preflight exposes the same report for fleet controllers."""
+    import types
+
+    art_full, prog = _export(tmp_path, "full", batch_buckets=[4, 8])
+    art_small, _ = _export(tmp_path, "small")     # bucket set {8} only
+    pred = pio.load_inference_model(art_full)
+    srv = PredictorServer(pred, workers=1, queue_size=4, warmup=False)
+    try:
+        rep = srv.reload_preflight(art_small)
+        (f,) = rep.by_code("artifact:bucket-shrank")
+        assert f.data["buckets"] == [4]
+        assert srv.reload_preflight(art_full).ok("error")
+
+        def _never(*a, **k):
+            raise AssertionError("static reject must not pay a load")
+        srv._io = types.SimpleNamespace(
+            read_artifact_meta=pio.read_artifact_meta,
+            load_inference_model=_never,
+            aot_compile_count=pio.aot_compile_count)
+        with pytest.raises(ReloadFailed, match="bucket set shrank"):
+            srv.reload(art_small, block=True)
+        assert srv.generation == 1
+    finally:
+        srv._io = pio
+        srv.close(drain=False)
+
+
+def test_check_reload_compat_feed_drift_per_bucket(tmp_path):
+    art_full, _ = _export(tmp_path, "full", batch_buckets=[4, 8])
+    art_drift, _ = _export(tmp_path, "drift", feed=_feed(batch=8, dim=8),
+                           batch_buckets=[4, 8])
+    pred = pio.load_inference_model(art_full)
+    served = analysis.serving_spec(pred)
+    rep = analysis.check_reload_compat(
+        served, pio.read_artifact_meta(art_drift))
+    drift = rep.by_code("artifact:feed-drift")
+    assert {f.data["bucket"] for f in drift} == {4, 8}
+    assert all("x" in f.data["expected"] for f in drift)
+
+
+# --------------------------------------------------------------------------
+# sharding:replicated-optstate — the ZeRO trigger
+# --------------------------------------------------------------------------
+
+
+def test_replicated_optstate_flags_adam_on_dp_mesh():
+    mesh8 = pt.make_mesh({"dp": 8})
+    tr = _trainer(mesh=mesh8, optim=opt.Adam(1e-3), feed=_feed(batch=8))
+    rep = analysis.check_artifacts(trainer=tr, replicated_optstate_bytes=1)
+    (f,) = rep.by_code("sharding:replicated-optstate")
+    assert f.severity == "warning"
+    assert f.data["data_shards"] == 8
+    # Adam: m+v per param leaf; a 1/8 shard reclaims 7/8
+    assert f.data["zero_saving_bytes"] == pytest.approx(
+        f.data["replicated_bytes_per_device"] * 7 / 8, rel=1e-6)
+    # same trigger through the check_trainer door
+    rep2 = analysis.check_trainer(tr, sample_feed=_feed(batch=8),
+                                  replicated_optstate_bytes=1)
+    assert rep2.by_code("sharding:replicated-optstate")
+
+
+def test_replicated_optstate_not_fooled_by_fsdp_sharding():
+    """Accums sharded ALONG a data axis (fsdp rules) carry no data-axis
+    redundancy — the ZeRO saving is already realized, so no trigger.
+    Only the leaves the rule table leaves replicated count."""
+    from paddle_tpu.parallel.sharding import fsdp
+
+    mesh = pt.make_mesh({"fsdp": 8})
+    tr = _trainer(mesh=mesh, rules=fsdp(min_size_to_shard=1),
+                  optim=opt.Adam(1e-3), feed=_feed(batch=8, dim=8))
+    # every param has an 8-divisible dim: fc1/w (8,16), fc1/b (16,),
+    # fc2/w (16,4), fc2/b (4,)... fc2/b's largest dim is 4 -> replicated
+    rep = analysis.check_artifacts(trainer=tr, replicated_optstate_bytes=1)
+    hits = rep.by_code("sharding:replicated-optstate")
+    if hits:   # only the un-shardable fc2/b moments may contribute
+        assert hits[0].data["replicated_bytes_per_device"] <= 2 * 4 * 4, \
+            hits[0].message
+
+
+def test_replicated_optstate_quiet_below_threshold_and_for_sgd():
+    mesh8 = pt.make_mesh({"dp": 8})
+    tr = _trainer(mesh=mesh8, optim=opt.Adam(1e-3), feed=_feed(batch=8))
+    rep = analysis.check_artifacts(trainer=tr)   # default 64 MB floor
+    assert not rep.by_code("sharding:replicated-optstate")
+    sgd = _trainer(mesh=mesh8, feed=_feed(batch=8))
+    rep = analysis.check_artifacts(trainer=sgd, replicated_optstate_bytes=1)
+    assert not rep.by_code("sharding:replicated-optstate")
+
+
+# --------------------------------------------------------------------------
+# moe:capacity — the drop-rate model (golden finding lives in the zoo test)
+# --------------------------------------------------------------------------
+
+
+def test_expected_moe_drop_rate_limits():
+    from paddle_tpu.analysis.rules import expected_moe_drop_rate
+
+    # deterministic limit: cf=0.5 -> half the assignments drop
+    big = expected_moe_drop_rate(tokens=1 << 20, top_k=1, num_experts=4,
+                                 capacity=(1 << 20) // 8)
+    assert big == pytest.approx(0.5, abs=0.01)
+    # ample capacity -> essentially nothing drops
+    assert expected_moe_drop_rate(1024, 2, 4, 4096) < 1e-6
+    # monotone non-increasing in capacity
+    rates = [expected_moe_drop_rate(4096, 2, 8, c)
+             for c in (128, 256, 512, 1024, 4096)]
+    assert all(a >= b for a, b in zip(rates, rates[1:]))
+    assert expected_moe_drop_rate(0, 2, 4, 16) == 0.0
+
+
+def test_moe_configs_recorded_under_full_scoped_name():
+    """Two MoE layers in DIFFERENT name scopes must record distinct
+    names — the scope-local helper name ('moe_0') would collide their
+    fingerprints and baselining one would suppress the other."""
+    import jax
+    from paddle_tpu.framework import name_scope
+    from paddle_tpu.parallel.moe import capture_moe_configs, moe
+
+    def net(x):
+        with name_scope("enc"):
+            a, _ = moe(x, num_experts=4, d_ff=8, capacity_factor=0.5)
+        with name_scope("dec"):
+            b, _ = moe(a, num_experts=4, d_ff=8, capacity_factor=4.0)
+        return {"loss": L.mean(b)}
+
+    prog = pt.build(net)
+    feed = {"x": np.zeros((2, 4, 8), np.float32)}
+    with capture_moe_configs() as log:
+        prog.init(jax.random.PRNGKey(0), **feed)
+    names = sorted(c["name"] for c in log)
+    # the context-global counter already distinguishes same-trace
+    # layers; the scope prefix additionally pins the name to the param
+    # path (stable when an unrelated layer shifts the counter)
+    assert names == ["dec/moe_1", "enc/moe_0"], names
+    rep = LintReport("t")
+    from paddle_tpu.analysis.rules import check_moe_capacity
+    check_moe_capacity(log, rep)
+    (f,) = rep.by_code("moe:capacity")   # only the under-capacitied one
+    assert f.where == "enc/moe_0"
+
+
+def test_check_moe_capacity_threshold():
+    from paddle_tpu.analysis.rules import check_moe_capacity
+
+    cfg = dict(name="moe_0", tokens=4096, top_k=2, num_experts=8,
+               capacity=256, capacity_factor=0.25)
+    rep = LintReport("t")
+    check_moe_capacity([cfg], rep)
+    (f,) = rep.by_code("moe:capacity")
+    assert 0.7 < f.data["expected_drop_rate"] < 0.8   # ~1 - cf
+    rep2 = LintReport("t")
+    check_moe_capacity([dict(cfg, capacity=2048, capacity_factor=2.0)], rep2)
+    assert not rep2.findings
+
+
+# --------------------------------------------------------------------------
+# report CI machinery: fingerprints, dedupe, baselines, severity, SARIF
+# --------------------------------------------------------------------------
+
+
+def test_fingerprint_dedupe_bumps_count():
+    rep = LintReport("t")
+    f1 = rep.add("moe:capacity", "warning", "msg v1", where="moe_0",
+                 expected_drop_rate=0.5)
+    f2 = rep.add("moe:capacity", "warning", "msg v2 (improved wording)",
+                 where="moe_0", expected_drop_rate=0.493)
+    assert f1 is f2 and f1.count == 2 and len(rep.findings) == 1
+    # measurements are NOT identity; structural keys are
+    rep.add("moe:capacity", "warning", "other layer", where="moe_1")
+    assert len(rep.findings) == 2
+
+
+def test_extend_dedupes_repeated_checks():
+    """Satellite: startup lint + an explicit re-run merged into one
+    report keep one stable key per finding (counts accumulate)."""
+    def one():
+        r = LintReport("t")
+        r.add("ckpt:shape-drift", "error", "m", where="params.npz:w",
+              got=[2], expected=[3])
+        return r
+
+    merged = LintReport("t").extend(one()).extend(one())
+    assert len(merged.findings) == 1
+    assert merged.findings[0].count == 2
+    # extend copies: mutating the merged finding leaves the source alone
+    src = one()
+    LintReport("t").extend(src).findings[0].count = 99
+    assert src.findings[0].count == 1
+
+
+def test_fingerprint_discriminates_distinct_sites():
+    """Findings whose `where` is a bare primitive name must still get
+    distinct fingerprints per SITE, or a baseline accepting one
+    instance silently suppresses every future new one of that class:
+    `path` (loop nesting) and `dtype` (cast triple) are structural
+    identity, so two collectives in different loops — or two cast
+    round-trips through different dtypes — are two baseline entries."""
+    rep = LintReport("t")
+    a = rep.add("collective:in-scan", "warning", "m", where="psum",
+                payload_bytes=100, path=["scan", "fwd"])
+    b = rep.add("collective:in-scan", "warning", "m", where="psum",
+                payload_bytes=100, path=["scan", "bwd"])
+    assert a.fingerprint != b.fingerprint and len(rep.findings) == 2
+    c = rep.add("dtype:cast-roundtrip", "info", "m",
+                where="convert_element_type",
+                dtype="float32->bfloat16->float32")
+    d = rep.add("dtype:cast-roundtrip", "info", "m",
+                where="convert_element_type",
+                dtype="float32->float16->float32")
+    assert c.fingerprint != d.fingerprint
+    # but payload measurements still are NOT identity
+    e = rep.add("collective:in-scan", "warning", "m", where="psum",
+                payload_bytes=999, path=["scan", "fwd"])
+    assert e is a and a.count == 2
+
+
+def test_same_fingerprint_different_severity_kept_separate():
+    rep = LintReport("t")
+    rep.add("a:b", "warning", "m", where="w")
+    rep.add("a:b", "error", "m", where="w")
+    assert len(rep.findings) == 2
+
+
+def test_apply_severity_exact_beats_family():
+    rep = LintReport("t")
+    rep.add("moe:capacity", "warning", "m", where="moe_0")
+    rep.add("moe:other", "warning", "m", where="moe_0")
+    lint_report.apply_severity(rep, {"moe": "info", "moe:capacity": "error"})
+    sev = {f.code: f.severity for f in rep.findings}
+    assert sev == {"moe:capacity": "error", "moe:other": "info"}
+    with pytest.raises(EnforceError, match="severity override"):
+        lint_report.apply_severity(rep, {"moe": "fatal"})
+
+
+def test_baseline_roundtrip_and_new_findings(tmp_path):
+    rep = LintReport("t")
+    rep.add("a:b", "warning", "m", where="w", shape=[2, 3])
+    rep.add("c:d", "error", "m2", where="v")
+    path = str(tmp_path / "base.json")
+    doc = lint_report.write_baseline(path, [("subj", rep)])
+    assert len(doc["baseline"]) == 2
+    base = lint_report.load_baseline(path)
+    assert lint_report.new_findings("subj", rep, base) == []
+    # count growth stays suppressed (counts are measurements)
+    rep.add("a:b", "warning", "m again", where="w", shape=[2, 3])
+    assert lint_report.new_findings("subj", rep, base) == []
+    # the SAME fingerprint on a different subject is a new finding
+    assert len(lint_report.new_findings("other", rep, base)) == 2
+    # a genuinely new finding surfaces
+    f = rep.add("e:f", "warning", "fresh", where="w")
+    assert lint_report.new_findings("subj", rep, base) == [f]
+    # info-level findings don't gate at the default level
+    rep.add("g:h", "info", "note", where="w")
+    assert lint_report.new_findings("subj", rep, base) == [f]
+    # missing file == empty baseline
+    assert lint_report.load_baseline(str(tmp_path / "nope.json")) == {}
+
+
+def test_bad_baseline_file_is_enforced(tmp_path):
+    p = str(tmp_path / "bad.json")
+    with open(p, "w") as fh:
+        json.dump(["not", "a", "baseline"], fh)
+    with pytest.raises(EnforceError, match="baseline file"):
+        lint_report.load_baseline(p)
+    with open(p, "w") as fh:
+        json.dump({"version": 99, "baseline": {}}, fh)
+    with pytest.raises(EnforceError, match="version"):
+        lint_report.load_baseline(p)
+
+
+def test_sarif_emitter_shape():
+    rep = LintReport("t")
+    rep.add("a:b", "warning", "m", where="w")
+    rep.add("a:b", "warning", "m", where="w")   # count=2
+    rep.add("c:d", "error", "m2", where="")
+    doc = lint_report.to_sarif([("subj", rep)])
+    assert doc["version"] == "2.1.0" and len(doc["runs"]) == 1
+    run = doc["runs"][0]
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] == ["a:b", "c:d"]
+    by_rule = {r["ruleId"]: r for r in run["results"]}
+    assert by_rule["a:b"]["occurrenceCount"] == 2
+    assert by_rule["a:b"]["level"] == "warning"
+    assert by_rule["c:d"]["level"] == "error"
+    fp = by_rule["a:b"]["partialFingerprints"]["paddleTpuLint/v1"]
+    assert fp == lint_report.baseline_key("subj", rep.findings[0])
+    assert by_rule["c:d"]["locations"][0]["logicalLocations"][0][
+        "name"] == "subj"
+
+
+# --------------------------------------------------------------------------
+# tools/lint_gate.py — the CI gate over the analysis zoo
+# --------------------------------------------------------------------------
+
+
+def test_lint_gate_clean_on_committed_baseline(capsys):
+    """Tier-1 gate: the full zoo sweep against the committed baseline
+    must be clean. A PR that introduces a new finding on any zoo
+    program fails THIS test with the fingerprint named — fix the
+    finding or re-write tools/analysis_baseline.json deliberately."""
+    rc = lint_gate.main(["--ci"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "lint gate clean" in out
+    # the two golden true positives are present AND baselined
+    base = lint_report.load_baseline(lint_gate.DEFAULT_BASELINE)
+    assert any(k.startswith("moe_transformer.tight::moe:capacity")
+               for k in base)
+    assert any(k.startswith("gpt.amp::dtype:amp-f32-matmul") for k in base)
+
+
+def test_lint_gate_exit1_on_injected_new_finding(tmp_path, monkeypatch,
+                                                 capsys):
+    """Acceptance: removing a fingerprint from (a copy of) the committed
+    baseline makes that finding 'new' — exit 1, fingerprint printed."""
+    base = lint_report.load_baseline(lint_gate.DEFAULT_BASELINE)
+    trimmed = {k: v for k, v in base.items() if "moe:capacity" not in k}
+    assert len(trimmed) < len(base)
+    p = str(tmp_path / "trimmed.json")
+    with open(p, "w") as fh:
+        json.dump({"version": 1, "baseline": trimmed}, fh)
+    monkeypatch.setattr(lint_gate, "GATE_CONFIGS", [
+        {"subject": "moe_transformer.tight", "model": "moe_transformer",
+         "variant": "tight"}])
+    rc = lint_gate.main(["--ci", "--baseline", p])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "moe_transformer.tight::moe:capacity" in out
+    assert "--write-baseline" in out   # the remediation is named
+
+
+def test_lint_gate_exit3_on_checker_crash(monkeypatch, capsys):
+    """Acceptance: a crash inside the sweep is exit 3 — never a pass,
+    never the PR author's finding."""
+    monkeypatch.setattr(lint_gate, "GATE_CONFIGS",
+                        [{"subject": "broken", "model": "no_such_model"}])
+    rc = lint_gate.main(["--ci"])
+    assert rc == 3
+    assert "internal error" in capsys.readouterr().err
+
+
+def test_lint_gate_write_baseline_roundtrip(tmp_path, monkeypatch, capsys):
+    monkeypatch.setattr(lint_gate, "GATE_CONFIGS", [
+        {"subject": "moe_transformer.tight", "model": "moe_transformer",
+         "variant": "tight"},
+        {"subject": "mnist.mlp", "model": "mnist", "variant": "mlp"}])
+    p = str(tmp_path / "fresh.json")
+    assert lint_gate.main(["--write-baseline", p]) == 0
+    assert lint_gate.main(["--ci", "--baseline", p]) == 0
+    # severity overrides re-gate without forking rules: demoting the
+    # capacity lint to info takes it out of a warning-level gate
+    assert lint_gate.main(["--ci", "--baseline", str(tmp_path / "none.json"),
+                           "--severity", "moe:capacity=info"]) == 0
+
+
+# --------------------------------------------------------------------------
+# io.flat_spec — the spec-only flattener can never drift from the saver
+# --------------------------------------------------------------------------
+
+
+def test_flat_spec_matches_saved_manifest(tmp_path):
+    tr = _trainer()
+    ck = _checkpoint(tmp_path, tr)
+    man = resilience.read_manifest(ck)
+    assert pio.flat_spec(tr.scope.params) == man["arrays"]["params.npz"]
+
+
+def test_flat_spec_exotic_dtype_mangling():
+    import ml_dtypes
+
+    tree = {"a": {"w": np.zeros((2, 3), ml_dtypes.bfloat16)},
+            "plain": np.zeros((4,), np.int32)}
+    spec = pio.flat_spec(tree)
+    assert spec == {
+        "a||w@bfloat16": {"shape": [2, 3], "dtype": "uint16"},
+        "plain": {"shape": [4], "dtype": "int32"},
+    }
+    # and the escape hatch: a genuine name collision gets @raw
+    raw = pio.flat_spec({"x@bfloat16": np.zeros((1,), np.uint16)})
+    assert list(raw) == ["x@bfloat16@raw"]

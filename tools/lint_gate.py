@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""CI lint gate: the whole analysis zoo vs a committed baseline.
+
+    python tools/lint_gate.py --ci                      # the CI entry point
+    python tools/lint_gate.py --write-baseline tools/analysis_baseline.json
+    python tools/lint_gate.py --ci --sarif lint.sarif   # + CI annotations
+
+Runs the static checker (``paddle_tpu.analysis.check``) over every
+:data:`GATE_CONFIGS` entry — the model-zoo sweep that is this repo's
+acceptance surface — and compares the findings' stable fingerprints
+against the committed baseline file. A PR that introduces a NEW finding
+on any zoo program fails fast with the fingerprint named; the findings
+already frozen in the baseline (the gpt amp-leak golden, the tight-MoE
+capacity golden) stay accepted debt until someone fixes them and
+re-writes the baseline.
+
+Exit status (same contract as ``python -m paddle_tpu.analysis``):
+
+- **0** — no finding at/above ``--fail-on`` outside the baseline;
+- **1** — new findings, each printed as ``subject::fingerprint``;
+- **3** — the checker itself crashed on some config (a crash must never
+  read as a pass or as the PR author's finding).
+
+``--write-baseline`` freezes the current findings and exits 0; commit
+the file. ``--sarif PATH`` additionally writes a SARIF 2.1.0 run for
+code-scanning annotators.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import traceback
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+EXIT_CLEAN, EXIT_FINDINGS, EXIT_INTERNAL = 0, 1, 3
+
+DEFAULT_BASELINE = os.path.join(ROOT, "tools", "analysis_baseline.json")
+
+# The gated sweep. Every entry is device-free (no mesh) so the gate
+# runs identically on a laptop, in CI, and on a TPU host. Adding a
+# config here (or a finding to an existing one) requires re-writing
+# the committed baseline — which is exactly the review conversation
+# the gate exists to force.
+GATE_CONFIGS = [
+    {"subject": "mnist.mlp", "model": "mnist", "variant": "mlp"},
+    {"subject": "mnist.conv", "model": "mnist", "variant": "conv"},
+    {"subject": "transformer", "model": "transformer"},
+    {"subject": "gpt", "model": "gpt"},
+    # golden true positive: the non-fused lm-head f32 matmul under amp
+    {"subject": "gpt.amp", "model": "gpt", "amp": "bfloat16"},
+    {"subject": "moe_transformer", "model": "moe_transformer"},
+    # golden true positive: under-capacitied router (expected ~50% drop)
+    {"subject": "moe_transformer.tight", "model": "moe_transformer",
+     "variant": "tight"},
+]
+
+
+def run_gate(configs=None):
+    """Run the checker over ``configs`` (default :data:`GATE_CONFIGS`)
+    → list of ``(subject, LintReport)``. Lets tests and other tools
+    reuse the sweep without the process exit semantics."""
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis.zoo import build_model
+
+    out = []
+    for cfg in configs if configs is not None else GATE_CONFIGS:
+        program, feed = build_model(cfg["model"], cfg.get("variant", ""),
+                                    cfg.get("batch", 8), cfg.get("seq", 16))
+        report = analysis.check(program, feed, amp=cfg.get("amp"))
+        out.append((cfg["subject"], report))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/lint_gate.py",
+        description="CI lint gate: analysis zoo vs committed baseline")
+    ap.add_argument("--ci", action="store_true",
+                    help="gate mode (the default behavior; the flag "
+                         "documents intent in CI scripts)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help=f"baseline file (default {DEFAULT_BASELINE})")
+    ap.add_argument("--write-baseline", default="", metavar="PATH",
+                    help="freeze the current findings to PATH and exit 0")
+    ap.add_argument("--sarif", default="", metavar="PATH",
+                    help="also write a SARIF 2.1.0 report to PATH")
+    ap.add_argument("--fail-on", default="warning",
+                    choices=("info", "warning", "error"))
+    ap.add_argument("--severity", action="append", metavar="CODE=LEVEL",
+                    help="override a code's/family's severity, repeatable")
+    args = ap.parse_args(argv)
+
+    try:
+        from paddle_tpu.analysis.__main__ import _parse_severity
+        from paddle_tpu.analysis.report import (apply_severity, baseline_key,
+                                                load_baseline, new_findings,
+                                                to_sarif, write_baseline)
+
+        overrides = _parse_severity(args.severity)
+        reports = run_gate()
+        for _, report in reports:
+            apply_severity(report, overrides)
+
+        if args.sarif:
+            with open(args.sarif, "w") as fh:
+                json.dump(to_sarif(reports), fh, indent=1)
+            print(f"wrote SARIF: {args.sarif}")
+        if args.write_baseline:
+            doc = write_baseline(args.write_baseline, reports)
+            print(f"wrote baseline {args.write_baseline} "
+                  f"({len(doc['baseline'])} suppressed fingerprints over "
+                  f"{len(reports)} configs)")
+            return EXIT_CLEAN
+
+        baseline = load_baseline(args.baseline)
+        fresh = [(subject, f) for subject, report in reports
+                 for f in new_findings(subject, report, baseline,
+                                       args.fail_on)]
+        total = sum(len(r.findings) for _, r in reports)
+        if not fresh:
+            print(f"lint gate clean: {len(reports)} configs, {total} "
+                  f"finding(s), all baselined "
+                  f"({len(baseline)} suppressed fingerprints)")
+            return EXIT_CLEAN
+        print(f"lint gate FAILED: {len(fresh)} new finding(s) not in "
+              f"{args.baseline}:")
+        for subject, f in fresh:
+            print(f"  {baseline_key(subject, f)}")
+            print(f"    {f}")
+        print("fix the finding, or accept it deliberately with: "
+              f"python tools/lint_gate.py --write-baseline {args.baseline}")
+        return EXIT_FINDINGS
+    except Exception:
+        # NOT BaseException: SystemExit keeps its own code and a ^C
+        # stays a cancelled run, never "the checker is broken"
+        traceback.print_exc()
+        print("lint_gate: internal error (exit 3) — the checker crashed; "
+              "this is NOT a lint verdict", file=sys.stderr)
+        return EXIT_INTERNAL
+
+
+if __name__ == "__main__":
+    sys.exit(main())
