@@ -718,6 +718,78 @@ def bench_input_pipeline(peak, batch_size=256, iters=24, k=16):
     }
 
 
+def bench_elastic_reshard(peak, batch_size=64, iters=3, n_from=4, n_to=2):
+    """Elastic-reshard suite row: wall time + bytes re-placed of a
+    checkpoint restore ACROSS a dp N→M mesh change
+    (``resilience.reshard_restore`` — the static feasibility proof plus
+    re-placement per the target rules) vs a same-mesh restore of the
+    identical checkpoint. ``value`` is the reshard-restore wall time in
+    ms (best of ``iters``) — the price a preempted fleet pays to rejoin
+    at a different worker count; ``reshard_overhead_x`` is the ratio to
+    the same-mesh restore, the honest statement of what the mesh change
+    itself costs on top of an ordinary resume."""
+    import tempfile
+
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import io as pio
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu import resilience
+    from paddle_tpu.models import mnist
+
+    devs = jax.devices()
+    req_from, req_to = int(n_from), int(n_to)
+    n_from = max(1, min(req_from, len(devs)))
+    n_to = max(1, min(req_to, len(devs)))
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.randn(batch_size, 784).astype(np.float32),
+            "label": rng.randint(0, 10, (batch_size, 1)).astype(np.int64)}
+
+    def make(n):
+        tr = pt.Trainer(pt.build(mnist.mlp), opt.SGD(0.01), loss_name="loss",
+                        fetch_list=["loss"],
+                        mesh=pt.make_mesh({"dp": n}, devices=devs[:n]))
+        tr.startup(sample_feed=feed)
+        return tr
+
+    src = make(n_from)
+    src.step(feed)
+    with tempfile.TemporaryDirectory() as d:
+        ck = os.path.join(d, "ck")
+        pio.save_trainer(ck, src)
+        same = make(n_from)
+        t_same = float("inf")
+        for _ in range(max(1, iters)):
+            t0 = time.perf_counter()
+            pio.load_trainer(ck, same)
+            t_same = min(t_same, time.perf_counter() - t0)
+        tgt = make(n_to)
+        t_reshard, rep = float("inf"), None
+        for _ in range(max(1, iters)):
+            r = resilience.reshard_restore(ck, tgt, sample_feed=feed)
+            if r["seconds"] < t_reshard:
+                t_reshard, rep = r["seconds"], r
+    row = {
+        "value": round(t_reshard * 1e3, 3),
+        "unit": f"ms reshard-restore (dp {n_from}->{n_to})",
+        "same_mesh_restore_ms": round(t_same * 1e3, 3),
+        "reshard_overhead_x": round(t_reshard / max(t_same, 1e-9), 3),
+        "bytes_moved": int(rep["bytes_moved"]),
+        "from_axes": rep["saved_axes"],
+        "to_axes": rep["target_axes"],
+        "batch_size": batch_size,
+        "iters": iters,
+    }
+    if n_from == n_to:
+        # too few devices to express the requested mesh change: the row
+        # measured a same-placement restore. Say so rather than letting
+        # a round-diff read ~1.0x overhead as a cross-mesh result.
+        row["degenerate"] = (f"device count clamped dp {req_from}->{req_to} "
+                             f"to {n_from}->{n_to}: no mesh change measured")
+    return row
+
+
 def _serving_predictors(batch_size):
     """Export the MNIST MLP at fp32 and through the real int8 datapath;
     {variant: (Predictor, feed)}. Untrained weights — this row measures
@@ -1158,7 +1230,7 @@ def _suite_names():
 
     names = [*TRAIN_CONFIGS, *INFER_CONFIGS, "gpt_decode",
              "dispatch_overhead", "guard_overhead", "input_pipeline",
-             "serving", "fusion_profile"]
+             "serving", "fusion_profile", "elastic_reshard"]
     # the BASELINE five first, then the reference's headline serving
     # rows, then gpt — a driver that kills the suite early (the partial
     # SIGTERM record) still captures the configs that matter most
@@ -1224,6 +1296,10 @@ def _run_one(name: str, peak: float, quick: bool = False, batch_size=None):
         if quick:
             kw.update(iters=2, batch_size=4, seq=64)
         return bench_fusion_profile(peak, **kw)
+    if name == "elastic_reshard":
+        if quick:
+            kw.update(iters=1)
+        return bench_elastic_reshard(peak, **kw)
     raise ValueError(f"unknown config {name}")
 
 

@@ -12,7 +12,8 @@ decoding, and a StableHLO inference/export path.
 from . import analysis, backward, clip, core, data, debugger, evaluator, framework, initializer
 from . import io, layers, lr_scheduler, metrics, models, nets, optimizer
 from . import parallel, quantize, regularizer, resilience, serving, sparse, transpiler
-from .resilience import CheckpointCorrupt, GuardPolicy, PreemptionHandler
+from .resilience import (CheckpointCorrupt, GuardPolicy, PreemptionHandler,
+                         ReshardError, reshard_restore)
 from .serving import PredictorServer
 from .core import CPUPlace, CUDAPlace, Place, TPUPlace, default_place
 from .executor import CheckpointConfig, Event, Executor, Inferencer, Scope, Trainer, fit

@@ -30,7 +30,11 @@ Finding families (each named finding's runtime counterpart is pinned in
   trainer's param/opt-state spec: missing/extra entries, shape/dtype
   drift (``load_trainer`` raises ``CheckpointCorrupt``), loss-scale
   state drift (runtime warns + falls back), and restore-at-different-
-  mesh feasibility including whether a dp N→M reshard is expressible.
+  mesh feasibility including whether a dp N→M reshard is expressible —
+  ``ckpt:mesh-reshard`` pairs with ``resilience.reshard_restore``
+  succeeding, ``ckpt:reshard-infeasible`` with it raising a
+  ``ReshardError`` carrying the same finding text (pinned pairwise in
+  ``tests/test_contracts.py``).
 - ``artifact:*`` — saved bucket set + per-bucket feed specs vs a live
   server (or the trainer that re-exports): the exact drift classes the
   serving reload canary only catches at swap time, plus internal
@@ -88,16 +92,16 @@ def trainer_specs(trainer) -> Dict[str, Any]:
             "contract is the started scope's spec)")
     from .. import io as _io
 
+    from .. import resilience
+
     arrays = {"params.npz": _io.flat_spec(scope.params),
               "state.npz": _io.flat_spec(scope.state or {})}
     if scope.opt_state is not None:
         arrays["opt_state.npz"] = _io.flat_spec(scope.opt_state)
-    mesh = getattr(trainer, "mesh", None)
     return {
         "arrays": arrays,
         "has_loss_scaler": getattr(trainer, "loss_scaler", None) is not None,
-        "mesh_axes": ({str(a): int(mesh.shape[a]) for a in mesh.axis_names}
-                      if mesh is not None else None),
+        "mesh_axes": resilience.trainer_mesh_axes(trainer),
     }
 
 
@@ -255,12 +259,18 @@ def _check_reshard(manifest: Dict[str, Any], mesh, rules,
     finding."""
     if mesh is None:
         return
+    from .. import resilience
     from ..parallel.api import _rules as _adapt
 
     saved_axes = (manifest.get("meta") or {}).get("mesh_axes")
-    target_axes = {str(a): int(mesh.shape[a]) for a in mesh.axis_names}
-    if saved_axes == target_axes:
-        return  # same mesh: nothing to reshard
+    target_axes = resilience.mesh_axes(mesh)
+    if saved_axes is not None and \
+            resilience.normalize_mesh_axes(saved_axes) == \
+            resilience.normalize_mesh_axes(target_axes):
+        # same PLACEMENT (size-1 axes normalized away, exactly like the
+        # load_trainer gate — the pinned pairwise agreement must hold
+        # for {'dp': 2, 'pp': 1} vs {'dp': 2} too): nothing to reshard
+        return
     arrays = (manifest.get("arrays") or {}).get("params.npz") or {}
     table = _adapt(rules, mesh)
     dropped = LintReport("reshard")
@@ -313,15 +323,21 @@ def _check_reshard(manifest: Dict[str, Any], mesh, rules,
     if not infeasible:
         # a pre-mesh-meta checkpoint has no saved axes, so this may not
         # be a reshard at all — the verdict is about restoring AT this
-        # mesh, never a claim that the mesh changed
-        claim = (f"restore at a different mesh ({saved_axes} -> "
-                 f"{target_axes}) is" if saved_axes else
+        # mesh, never a claim that the mesh changed. {} is different:
+        # the checkpoint KNOWS it was saved single-device (the 1->N
+        # elastic case)
+        claim = (f"restore at a different mesh "
+                 f"({saved_axes or 'single-device'} -> {target_axes}) is"
+                 if saved_axes is not None else
                  f"restore at mesh {target_axes} is (checkpoint predates "
                  "mesh metadata — the saved mesh is unknown)")
         report.add(
             "ckpt:mesh-reshard", "info",
             f"{claim} expressible: checkpoint arrays are stored "
-            "unsharded and re-placed per the rule table at load"
+            "unsharded and re-placed per the rule table at load — "
+            "resilience.reshard_restore(checkpoint_dir, trainer) (or "
+            "fit(resume=True, elastic=True)) performs it with bit-exact "
+            "state"
             + (f"; batch {batch} divides the {data_n}-way batch shards"
                if batch is not None and (data_n or 1) > 1 else
                "; batch feasibility UNCHECKED (pass sample_feed to "

@@ -1215,7 +1215,8 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
         dtypes: Optional[Sequence[Any]] = None, event_handler=None,
         checkpoint_config: Optional[CheckpointConfig] = None,
         prefetch: bool = True, steps_per_dispatch: int = 1,
-        resume: bool = False, preemption: Optional[bool] = None,
+        resume: bool = False, elastic: bool = False,
+        preemption: Optional[bool] = None,
         feed_wire=None):
     """High-level train loop (contrib.trainer.Trainer.train analog):
     reader → DataFeeder → (optional double-buffered prefetch) →
@@ -1248,6 +1249,19 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
       epoch/in-epoch position recorded in the checkpoint meta, and
       continues with exact step/loss continuity — restart reproduces
       the uninterrupted run bit-for-bit for a deterministic reader.
+    - ``elastic=True`` (with ``resume=True``) lets the resume ride
+      through a WORKER-COUNT change: a checkpoint saved at different
+      mesh axes than this trainer's is reshard-restored
+      (``resilience.reshard_restore`` — bit-exact re-placement per the
+      trainer's target rules) instead of raising. Step accounting needs
+      no special casing across the N→M boundary: the reader batch is
+      GLOBAL (dp only splits it across devices), so the epoch/
+      epoch_step fast-forward and ``steps_per_dispatch`` re-stacking
+      (including a different K than the run that saved) hold unchanged
+      — one reader batch is one optimizer step at any mesh. Without
+      ``elastic``, the mesh mismatch surfaces as a structured
+      ``resilience.ReshardError`` at startup, naming saved vs. target
+      axes, instead of a ``device_put`` stack trace mid-run.
     - The checkpoint ROTATION list is rebuilt from the directory at
       startup, so ``max_num_checkpoints`` holds across restarts.
     - SIGTERM/SIGINT (``preemption``; default on whenever a
@@ -1271,12 +1285,27 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
         trainer.set_feed_wire(feed_wire)
     feeder = DataFeeder(feed_names, dtypes)
 
+    _enforce(resume or not elastic,
+             "fit(elastic=True) without resume=True does nothing: elastic "
+             "names the resume-across-a-mesh-change behavior")
     start_epoch, skip_steps = 0, 0
     if resume:
         _enforce(checkpoint_config is not None,
                  "fit(resume=True) needs a checkpoint_config to scan")
+        sample_feed = None
+        if elastic:
+            # peek one reader batch so the reshard feasibility check can
+            # prove the per-step batch divides the target shards — the
+            # infeasible case must be a structured ReshardError HERE,
+            # not a raw put_batch ValueError mid-run (readers are
+            # re-iterable callables; each epoch calls reader() fresh,
+            # so the peek consumes nothing)
+            first = next(iter(reader()), None)
+            if first is not None:
+                sample_feed = feeder.feed(first)
         meta = resilience.restore_latest(checkpoint_config.checkpoint_dir,
-                                         trainer)
+                                         trainer, elastic=elastic,
+                                         sample_feed=sample_feed)
         if meta is not None:
             start_epoch = int(meta.get("epoch", 0))
             skip_steps = int(meta.get("epoch_step", 0))

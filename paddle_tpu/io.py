@@ -12,7 +12,11 @@ XLA version) machines.
 Resharding on load (the pserver slice/merge analog,
 io.py:881 _load_slice_up_vars): arrays are saved unsharded (fully
 gathered); loading places them per the current mesh/rules, so mesh
-reshapes between save and load work by construction.
+reshapes between save and load work by construction. A mesh CHANGE is
+gated, not implicit: ``load_trainer`` raises a structured
+``resilience.ReshardError`` on a ``meta.mesh_axes`` mismatch, and
+``resilience.reshard_restore`` is the explicit elastic door (static
+feasibility proof + bit-exact re-placement).
 """
 
 from __future__ import annotations
@@ -282,14 +286,15 @@ def save_trainer(dirname: str, trainer,
     ls = getattr(trainer.scope, "loss_scale_state", None)
     if ls:
         meta["loss_scale_state"] = {k: float(v) for k, v in ls.items()}
-    mesh = getattr(trainer, "mesh", None)
-    if mesh is not None:
-        # the mesh the checkpoint was WRITTEN at: arrays are stored
-        # unsharded, but recording the axes lets the static contract
-        # verifier (analysis.contracts) name the N->M reshard a restore
-        # at a different mesh implies and judge its feasibility
-        meta["mesh_axes"] = {str(a): int(mesh.shape[a])
-                             for a in mesh.axis_names}
+    # the mesh the checkpoint was WRITTEN at: arrays are stored
+    # unsharded, but recording the axes lets the static contract
+    # verifier (analysis.contracts) name the N->M reshard a restore at
+    # a different mesh implies and judge its feasibility. Recorded
+    # UNCONDITIONALLY ({} for a single-device trainer): a meshless
+    # checkpoint restored at dp=N is the 1->N elastic case and must
+    # trip the same ReshardError gate — only checkpoints that predate
+    # this key (no mesh_axes at all) pass ungated
+    meta["mesh_axes"] = resilience.trainer_mesh_axes(trainer) or {}
     if extra_meta:
         meta.update(extra_meta)
     # checkpoints always store logical layer order: undo the trainer's
@@ -321,7 +326,7 @@ def save_trainer(dirname: str, trainer,
     _fsync_dir(parent)
 
 
-def load_trainer(dirname: str, trainer) -> None:
+def load_trainer(dirname: str, trainer, allow_reshard: bool = False) -> None:
     """Restore a Trainer in place, re-placing arrays on the trainer's
     device/mesh (resharding-on-load).
 
@@ -329,9 +334,46 @@ def load_trainer(dirname: str, trainer) -> None:
     file, format version); any mismatch — or an npz that fails to parse
     — raises a structured :class:`~paddle_tpu.resilience.CheckpointCorrupt`
     instead of a random decoder error. Pre-manifest (legacy) directories
-    load without validation."""
+    load without validation.
+
+    A checkpoint whose recorded ``meta.mesh_axes`` differ from the
+    trainer's mesh used to "load" and then die later — in ``put_batch``'s
+    ``device_put`` or a retrace shape error deep inside the first step.
+    It now raises a structured
+    :class:`~paddle_tpu.resilience.ReshardError` at LOAD time naming the
+    saved vs. target axes. A mesh change is a supported operation, just
+    an explicit one: go through
+    :func:`~paddle_tpu.resilience.reshard_restore` (or
+    ``fit(resume=True, elastic=True)``), which proves feasibility with
+    the static contract checker first — or pass ``allow_reshard=True``
+    to skip the gate (the arrays are stored unsharded, so placement per
+    the target rules is the whole reshard). Size-1 axes are normalized
+    away: ``{"dp": 1}`` and no mesh place identically and do not trip
+    the gate; checkpoints that predate mesh metadata pass through
+    (the saved mesh is unknowable)."""
     from . import resilience
 
+    # the mesh gate needs only the manifest META — run it BEFORE the
+    # full per-file CRC pass, so a mesh-mismatched restore (which
+    # reshard_restore will load again, paying the CRC sweep there) is
+    # rejected from one cheap JSON read, not a double scan of the
+    # checkpoint bytes
+    if not allow_reshard:
+        meta_man = resilience.read_manifest(dirname)  # None for legacy
+        saved_axes = ((meta_man or {}).get("meta") or {}).get("mesh_axes")
+        target_axes = resilience.trainer_mesh_axes(trainer)
+        if saved_axes is not None and \
+                resilience.normalize_mesh_axes(saved_axes) != \
+                resilience.normalize_mesh_axes(target_axes):
+            raise resilience.ReshardError(
+                dirname, saved_axes, target_axes,
+                f"checkpoint was saved at mesh axes {saved_axes} but the "
+                f"target trainer runs "
+                f"{target_axes or 'a single device'} — restoring across a "
+                "mesh change is an elastic reshard; use "
+                "resilience.reshard_restore(checkpoint_dir, trainer) or "
+                "fit(resume=True, elastic=True) (or load_trainer("
+                "allow_reshard=True) to skip the feasibility check)")
     manifest = resilience.validate_checkpoint(dirname)  # None for legacy
     try:
         params, state, opt_state, meta = load_persistables(dirname)

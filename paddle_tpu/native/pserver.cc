@@ -33,6 +33,22 @@
 //       (int8-quantized gradient: g[i] = q[i]*scale/127 — 4x less wire
 //        than PUSH; quantized-collective lineage, EQuARX-style)
 //   PUSHROWS <trainer> <name> <nrows> <rowdim>\n<i32 ids><f32 vals> -> OK <v>
+//   EXPORT <name>                   -> OK <vlen> <alen> <version>\n
+//                                      <f32 value><f32 accum>
+//       (full shard-migration state of one param: value + optimizer
+//        accumulator + version; per-trainer DC-ASGD baks are staleness
+//        references and do not migrate, same as SAVE)
+//   IMPORT <name> <vlen> <alen> <version>\n<f32 value><f32 accum> -> OK
+//       (absolute overwrite-or-create — the receive half of a pserver
+//        shard split/merge. Idempotent by construction: importing the
+//        same state twice is a no-op, so the client may safely retry
+//        it across a connection loss, unlike PUSH)
+//   DELETE <name>                   -> OK GONE | OK ABSENT (idempotent)
+//       (the cleanup half of shard migration: the old owner drops its
+//        copy AFTER routing switched, so orphaned shards neither leak
+//        memory across resizes nor silently absorb pushes from
+//        trainers that have not rebound yet — those now fail loudly
+//        with ERR unknown param)
 //   SAVE                            -> OK | ERR (atomic snapshot to path)
 //   STATUS                          -> OK params=N pushes=M
 //   QUIT                            -> closes the connection
@@ -153,6 +169,55 @@ class PServer {
     ++p.version;
     ++pushes_;
     return "OK " + std::to_string(p.version) + "\n";
+  }
+
+  // Shard migration (the go/pserver slice/merge analog re-expressed as
+  // a verb pair): EXPORT hands a param's full server-side state to the
+  // coordinator, IMPORT installs it absolutely on the new owner.
+  std::string Export(const std::string& name, std::string* payload) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = params_.find(name);
+    if (it == params_.end()) return "ERR unknown param " + name + "\n";
+    const Param& p = it->second;
+    payload->assign(reinterpret_cast<const char*>(p.value.data()),
+                    p.value.size() * sizeof(float));
+    payload->append(reinterpret_cast<const char*>(p.accum.data()),
+                    p.accum.size() * sizeof(float));
+    return "OK " + std::to_string(p.value.size()) + " " +
+           std::to_string(p.accum.size()) + " " +
+           std::to_string(p.version) + "\n";
+  }
+
+  std::string Import(const std::string& name, int64_t vlen, int64_t alen,
+                     int64_t version, const std::string& value_bytes,
+                     const std::string& accum_bytes) {
+    if (vlen < 0 || alen < 0 ||
+        value_bytes.size() != size_t(vlen) * sizeof(float) ||
+        accum_bytes.size() != size_t(alen) * sizeof(float))
+      return "ERR size mismatch\n";
+    Param p;
+    p.value.resize(size_t(vlen));
+    p.accum.resize(size_t(alen));
+    memcpy(p.value.data(), value_bytes.data(), size_t(vlen) * sizeof(float));
+    memcpy(p.accum.data(), accum_bytes.data(), size_t(alen) * sizeof(float));
+    p.version = version;
+    // re-establish the optimizer invariant Init() guarantees (same as
+    // Recover): the exporter may run a different optimizer — ApplyOne
+    // indexes accum unconditionally under adagrad
+    if (opt_ == Opt::kAdagrad && p.accum.size() != p.value.size())
+      p.accum.assign(p.value.size(), 0.f);
+    if (opt_ == Opt::kSGD) p.accum.clear();
+    std::lock_guard<std::mutex> g(mu_);
+    // absolute overwrite (NOT first-writer-wins): a rejoining server may
+    // hold a stale copy from before its shard moved away — migration
+    // must install the authoritative state regardless
+    params_[name] = std::move(p);
+    return "OK IMPORTED\n";
+  }
+
+  std::string Delete(const std::string& name) {
+    std::lock_guard<std::mutex> g(mu_);
+    return params_.erase(name) ? "OK GONE\n" : "OK ABSENT\n";
   }
 
   std::string Status() {
@@ -410,13 +475,31 @@ void ServeClient(PServer* ps, int fd) {
       if (!ReadBody(fd, size_t(b) * sizeof(int32_t), &ids)) break;
       if (!ReadBody(fd, size_t(b) * size_t(c) * sizeof(float), &vals)) break;
       resp = ps->PushRows(name, b, c, ids, vals);
+    } else if (sscanf(line.c_str(), "EXPORT %255s", name) == 1) {
+      resp = ps->Export(name, &payload);
+    } else if (sscanf(line.c_str(), "DELETE %255s", name) == 1) {
+      resp = ps->Delete(name);
+    } else if (sscanf(line.c_str(), "IMPORT %255s %lld %lld %lld",
+                      name, &a, &b, &c) == 4) {
+      // same overflow discipline as PUSHROWS: bound each length by the
+      // payload cap before the size_t arithmetic, and read value/accum
+      // as SEPARATE bodies — each gets the full 512MB ReadBody budget,
+      // so any param PUSH can carry (value <= cap) stays migratable
+      // even with an equally large optimizer accumulator riding along
+      const long long kMaxElems = (512ll << 20) / int(sizeof(float));
+      if (a < 0 || b < 0 || a > kMaxElems || b > kMaxElems) break;
+      std::string vbody, abody;
+      if (!ReadBody(fd, size_t(a) * sizeof(float), &vbody)) break;
+      if (!ReadBody(fd, size_t(b) * sizeof(float), &abody)) break;
+      resp = ps->Import(name, a, b, c, vbody, abody);
     } else if (line == "SAVE") {
       resp = ps->Save();
     } else if (line == "STATUS") {
       resp = ps->Status();
     } else if (line == "QUIT") {
       break;
-    } else if (line.rfind("INIT ", 0) == 0 || line.rfind("PUSH", 0) == 0) {
+    } else if (line.rfind("INIT ", 0) == 0 || line.rfind("PUSH", 0) == 0 ||
+               line.rfind("IMPORT ", 0) == 0) {
       // payload-carrying header that failed to parse (e.g. name >255
       // chars truncated by %255s): the payload length is unknowable, so
       // the stream is unrecoverable — close rather than desync into

@@ -27,6 +27,14 @@ Typical use (one server process, N trainer processes)::
     t.startup(sample_feed=batch)
     for batch in data:
         out = t.step(batch)                              # push-grad, no barrier
+
+Sharded fleet with elastic membership (pass a server LIST — params
+route by rendezvous hash via :class:`PSShardGroup`; ``resize`` rides a
+split/merge mid-run with full optimizer state migrated)::
+
+    t = AsyncPSTrainer(prog, [srv1.addr, srv2.addr])
+    ...
+    t.client.resize([srv1.addr, srv2.addr, srv3.addr])   # shard split
 """
 
 from __future__ import annotations
@@ -276,6 +284,230 @@ class PSClient:
         return {k: int(v) for k, v in
                 (kv.split("=") for kv in resp[3:].split())}
 
+    # -- shard migration ----------------------------------------------------
+    def export_param(self, name: str) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Pull a param's FULL server-side state for shard migration:
+        ``(value, optimizer accum, version)`` as flat f32 arrays.
+        Idempotent (a read), so it retries transparently like
+        :meth:`pull`."""
+
+        def _blen(resp):
+            _, vlen, alen, _ = resp.split()
+            return (int(vlen) + int(alen)) * 4
+
+        resp, data = self._request(f"EXPORT {self._check_name(name)}",
+                                   body_len=_blen)
+        _, vlen, alen, version = resp.split()
+        vlen, alen = int(vlen), int(alen)
+        buf = np.frombuffer(data, dtype=np.float32)
+        return buf[:vlen].copy(), buf[vlen:vlen + alen].copy(), int(version)
+
+    def import_param(self, name: str, value: np.ndarray,
+                     accum: np.ndarray, version: int = 0) -> None:
+        """Install a param's full state on this server (absolute
+        overwrite-or-create) — the receive half of a shard split/merge.
+        Unlike :meth:`push` this IS idempotent (it sets absolute state,
+        it does not apply a delta), so a reply lost after send retries
+        transparently instead of raising :class:`PushUndelivered`."""
+        v = np.ascontiguousarray(value, dtype=np.float32).reshape(-1)
+        a = np.ascontiguousarray(accum, dtype=np.float32).reshape(-1)
+        self._request(
+            f"IMPORT {self._check_name(name)} {v.size} {a.size} "
+            f"{int(version)}", v.tobytes() + a.tobytes())
+
+    def delete_param(self, name: str) -> None:
+        """Drop a param from this server (idempotent) — the cleanup
+        half of shard migration on the OLD owner."""
+        self._request(f"DELETE {self._check_name(name)}")
+
+
+def _rendezvous_score(name: str, addr: Tuple[str, int]) -> Tuple[int, Tuple]:
+    """Highest-random-weight (rendezvous) score of ``(name, server)``:
+    deterministic across processes (crc32, no PYTHONHASHSEED
+    dependence), and minimal-movement by construction — adding or
+    removing a server only re-homes the params whose max moved, ~1/N of
+    the set, never a full reshuffle. The addr tiebreak makes the
+    ordering total."""
+    import zlib as _zlib
+
+    key = f"{name}@{addr[0]}:{addr[1]}".encode()
+    return (_zlib.crc32(key) & 0xFFFFFFFF, (str(addr[0]), int(addr[1])))
+
+
+class PSShardGroup:
+    """Client-side shard router over N pservers — the membership-change
+    half of elastic training for the async-PS path (the reference's
+    slice_variable/pserver-shard analog, distribute_transpiler.py:81,
+    made dynamic).
+
+    Params are routed to servers by rendezvous hashing of the param
+    name, so every trainer process computes the SAME owner table from
+    the same address list with no coordination. The per-server
+    transport is a plain :class:`PSClient`, so the reconnect semantics
+    are preserved verbatim: pulls/exports retry transparently with
+    backoff, pushes stay at-most-once (:class:`PushUndelivered` on a
+    lost reply — counted by ``AsyncPSTrainer.step``, never resent).
+
+    **Membership change** (:meth:`resize`): when the server set grows
+    (shard split) or shrinks (shard merge), exactly the params whose
+    rendezvous owner changed migrate — full state (value + optimizer
+    accumulator + version) moves via ``EXPORT`` from the old owner and
+    ``IMPORT`` (absolute overwrite, idempotent) onto the new one, and
+    the routing table switches only after EVERY move landed. A crash
+    mid-resize (see the ``ps_resize:*`` crash points) therefore leaves
+    the OLD routing fully authoritative; re-running ``resize`` re-
+    exports from the old owners (picking up any pushes that landed in
+    between) and re-imports idempotently; after the switch the old
+    owner's copy is DELETEd, so repeated resizes do not accumulate dead
+    shards server-side. One coordinator performs the migrating
+    ``resize``; other trainer processes adopt the new membership with
+    :meth:`rebind` (route-only, no data movement). A trainer that has
+    NOT rebound yet and pushes into a migrated shard fails loudly
+    (``ERR unknown param`` — the old copy is gone), never silently
+    updates an orphan: rebind promptly after the coordinator announces
+    a resize. Per-trainer DC-ASGD staleness baks do not migrate (same
+    contract as the server's own snapshot).
+
+    Crash points (armed by ``testing.faults``):
+
+    - ``ps_resize:exported`` — after one param's state left its old
+      owner, before the import (fires per moved param)
+    - ``ps_resize:imported`` — all moves imported, routing not yet
+      switched
+    """
+
+    def __init__(self, addrs: Sequence[Tuple[str, int]], trainer_id: int = 0,
+                 **client_kw):
+        enforce(len(addrs) >= 1, "PSShardGroup needs at least one pserver")
+        self.trainer_id = int(trainer_id)
+        self._client_kw = dict(client_kw)
+        self._clients: Dict[Tuple[str, int], PSClient] = {}
+        self.addrs: List[Tuple[str, int]] = []
+        self._names: set = set()
+        self._set_addrs(addrs)
+
+    def _set_addrs(self, addrs) -> None:
+        new = [(str(h), int(p)) for h, p in addrs]
+        enforce(len(set(new)) == len(new),
+                f"duplicate pserver addrs in {new}")
+        self.addrs = new
+
+    def _client(self, addr: Tuple[str, int]) -> PSClient:
+        if addr not in self._clients:
+            self._clients[addr] = PSClient(addr, trainer_id=self.trainer_id,
+                                           **self._client_kw)
+        return self._clients[addr]
+
+    def owner(self, name: str) -> Tuple[str, int]:
+        """The server currently responsible for ``name``."""
+        return max(self.addrs, key=lambda a: _rendezvous_score(name, a))
+
+    # -- PSClient surface, routed by owner ----------------------------------
+    def init_param(self, name: str, value: np.ndarray) -> bool:
+        self._names.add(name)
+        return self._client(self.owner(name)).init_param(name, value)
+
+    def pull(self, name: str, shape, dtype=np.float32) -> np.ndarray:
+        return self._client(self.owner(name)).pull(name, shape, dtype=dtype)
+
+    def push(self, name: str, grad: np.ndarray) -> int:
+        return self._client(self.owner(name)).push(name, grad)
+
+    def push_quantized(self, name: str, grad: np.ndarray) -> int:
+        return self._client(self.owner(name)).push_quantized(name, grad)
+
+    def push_rows(self, name: str, row_ids, row_grads) -> int:
+        return self._client(self.owner(name)).push_rows(name, row_ids,
+                                                        row_grads)
+
+    def save(self) -> None:
+        for addr in self.addrs:
+            self._client(addr).save()
+
+    def status(self) -> Dict[str, int]:
+        """Aggregate counters summed over the live membership."""
+        out: Dict[str, int] = {}
+        for addr in self.addrs:
+            for k, v in self._client(addr).status().items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    def close(self) -> None:
+        for c in self._clients.values():
+            c.close()
+        self._clients.clear()
+
+    # -- membership change --------------------------------------------------
+    def shard_map(self) -> Dict[Tuple[str, int], List[str]]:
+        """{server addr: sorted param names it owns} — the routing table
+        the group would use right now."""
+        out: Dict[Tuple[str, int], List[str]] = {a: [] for a in self.addrs}
+        for name in sorted(self._names):
+            out[self.owner(name)].append(name)
+        return out
+
+    def resize(self, new_addrs: Sequence[Tuple[str, int]]) -> List[str]:
+        """Split/merge the shard set onto a new server membership.
+        Returns the (sorted) param names that migrated. Routing switches
+        atomically at the end — any failure (unreachable exporter, an
+        injected crash) leaves the old membership fully authoritative
+        and the call retryable."""
+        from .. import resilience
+
+        new = [(str(h), int(p)) for h, p in new_addrs]
+        enforce(len(set(new)) == len(new) and new,
+                f"resize: bad membership {new}")
+        old_owner = {name: self.owner(name) for name in self._names}
+        new_owner = {name: max(new, key=lambda a: _rendezvous_score(name, a))
+                     for name in self._names}
+        moves = sorted(n for n in self._names
+                       if old_owner[n] != new_owner[n])
+        for name in moves:
+            value, accum, version = \
+                self._client(old_owner[name]).export_param(name)
+            resilience.crash_point("ps_resize:exported")
+            self._client(new_owner[name]).import_param(name, value, accum,
+                                                       version)
+        resilience.crash_point("ps_resize:imported")
+        self._set_addrs(new)
+        # ONLY after routing switched: drop the migrated shards from
+        # their old owners (idempotent DELETE). Before the switch the
+        # old copy is the crash-retry safety net; after it, keeping it
+        # would leak a full value+accum per move AND silently absorb
+        # pushes from trainers that have not rebound — deleting makes
+        # those fail loudly (ERR unknown param) instead. Best-effort:
+        # an old owner that already left/died has nothing worth
+        # cleaning, and a skipped delete only costs memory until that
+        # server restarts fresh.
+        for name in moves:
+            addr = old_owner[name]
+            if addr not in self.addrs:
+                continue  # server left the membership with its copy
+            try:
+                self._client(addr).delete_param(name)
+            except (ConnectionError, OSError) as e:
+                _ps_log().warning("could not clean up migrated shard %s "
+                                  "on %s (%s)", name, addr, e)
+        # drop transports to servers that left the membership
+        for addr in [a for a in self._clients if a not in self.addrs]:
+            self._clients.pop(addr).close()
+        _ps_log().info("resharded %d param(s) onto %d server(s)",
+                       len(moves), len(new))
+        return moves
+
+    def rebind(self, new_addrs: Sequence[Tuple[str, int]]) -> None:
+        """Adopt a membership some OTHER process's :meth:`resize`
+        already migrated: route-only, no data movement."""
+        self._set_addrs(new_addrs)
+        for addr in [a for a in self._clients if a not in self.addrs]:
+            self._clients.pop(addr).close()
+
+
+def _ps_log():
+    import logging
+
+    return logging.getLogger("paddle_tpu.async_ps")
+
 
 def _named_leaves(tree) -> Sequence[Tuple[str, Any]]:
     """Stable name per leaf from its pytree path (the send_recv var-name
@@ -292,6 +524,19 @@ def _named_leaves(tree) -> Sequence[Tuple[str, Any]]:
     return out
 
 
+def _make_ps_client(addr, trainer_id: int):
+    """``addr`` may be one ``(host, port)`` (a single pserver → plain
+    :class:`PSClient`), a sequence of them (a shard set →
+    :class:`PSShardGroup`), or an already-built client/group (shared by
+    a membership coordinator)."""
+    if isinstance(addr, (PSClient, PSShardGroup)):
+        return addr
+    seq = list(addr)
+    if seq and isinstance(seq[0], (tuple, list)):
+        return PSShardGroup(seq, trainer_id=trainer_id)
+    return PSClient(tuple(seq), trainer_id=trainer_id)
+
+
 class AsyncPSTrainer:
     """Barrier-free trainer: jitted local gradients, server-side updates.
 
@@ -299,9 +544,16 @@ class AsyncPSTrainer:
     every step (matches plain SGD exactly when training alone); larger
     values trade staleness for fewer round-trips — the async knob the
     reference exposes through sync_mode=False.
+
+    ``addr`` may be a single pserver ``(host, port)`` or a LIST of them:
+    the latter shards params across the set via :class:`PSShardGroup`,
+    and ``trainer.client.resize([...])`` rides a pserver membership
+    change mid-run (shard split/merge with state preserved) without
+    touching the step loop — pushes into a migrating shard keep their
+    at-most-once semantics (`pushes_lost` counts, never resends).
     """
 
-    def __init__(self, program, addr: Tuple[str, int], loss_name: str = "loss",
+    def __init__(self, program, addr, loss_name: str = "loss",
                  trainer_id: int = 0, pull_interval: int = 1,
                  fetch_list: Optional[Sequence[str]] = None,
                  compress_grads: bool = False):
@@ -309,7 +561,7 @@ class AsyncPSTrainer:
 
         self.program = program
         self.loss_name = loss_name
-        self.client = PSClient(addr, trainer_id=trainer_id)
+        self.client = _make_ps_client(addr, trainer_id)
         self.pull_interval = max(1, int(pull_interval))
         self.compress_grads = bool(compress_grads)
         self.fetch_list = list(fetch_list) if fetch_list is not None else None

@@ -24,6 +24,17 @@ intervention:
   validates, falling back over corrupt ones — the restart half of the
   ``test_fault_tolerance_e2e`` contract, available to every
   ``fit(resume=True)`` caller instead of hand-rolled workers.
+- **Elastic resharding** (:func:`reshard_restore` +
+  :class:`ReshardError`): restore a checkpoint onto a trainer whose
+  mesh DIFFERS from the saved ``meta.mesh_axes`` (dp N→M in either
+  direction) with bit-exact model state — arrays are stored unsharded,
+  so the reshard is a re-placement per the TARGET ``ShardingRules``
+  (the exact normalization training placement uses). Feasibility is
+  proven by the same static checker ``analysis.contracts`` runs in CI
+  (``ckpt:mesh-reshard`` / ``ckpt:reshard-infeasible``), so the
+  runtime error carries the static verdict's reason text verbatim.
+  ``fit(resume=True, elastic=True)`` rides through a worker-count
+  change this way instead of dying in ``device_put``.
 - **Preemption** (:class:`PreemptionHandler`): SIGTERM/SIGINT (the TPU
   maintenance-event analog) sets a flag; ``fit`` checkpoints at the next
   chunk boundary, drains async orbax saves, and exits cleanly.
@@ -72,12 +83,36 @@ class CheckpointCorrupt(EnforceError):
         self.reason = reason
 
 
+class ReshardError(EnforceError):
+    """A checkpoint restore implies a mesh reshard that was either not
+    requested (``load_trainer`` without ``allow_reshard`` on a
+    ``meta.mesh_axes`` mismatch) or is not expressible (the batch
+    cannot divide the target data-shard product — the same verdict
+    ``analysis.contracts`` reports statically as
+    ``ckpt:reshard-infeasible``, whose finding text rides here as
+    ``reason``). Distinct from :class:`CheckpointCorrupt` on purpose:
+    the checkpoint is FINE — falling back to an older one would
+    silently discard training progress, so resume scanning re-raises
+    instead of skipping."""
+
+    def __init__(self, path: str, saved_axes, target_axes, reason: str):
+        super().__init__(f"cannot restore {path}: {reason}")
+        self.path = path
+        self.saved_axes = dict(saved_axes) if saved_axes else None
+        self.target_axes = dict(target_axes) if target_axes else None
+        self.reason = reason
+
+
 # -- fault injection hooks ---------------------------------------------------
-# The save path calls crash_point(tag) at each phase boundary; the set is
-# empty in production (one set-membership test per checkpoint, not per
-# step). testing.faults arms tags to simulate kill -9 at exact phases.
+# The save/reshard paths call crash_point(tag) at each phase boundary;
+# both registries are empty in production (one membership test per
+# checkpoint/resize, not per step). testing.faults arms tags to simulate
+# kill -9 at exact phases (crash_points -> raise InjectedCrash) or to run
+# a side effect at the phase without dying (crash_callbacks — e.g. kill a
+# pserver PROCESS mid-shard-split, testing.faults.acting).
 
 crash_points: set = set()
+crash_callbacks: Dict[str, Any] = {}
 
 
 class InjectedCrash(BaseException):
@@ -87,6 +122,10 @@ class InjectedCrash(BaseException):
 
 
 def crash_point(tag: str) -> None:
+    if crash_callbacks:
+        cb = crash_callbacks.get(tag)
+        if cb is not None:
+            cb()
     if crash_points and tag in crash_points:
         raise InjectedCrash(tag)
 
@@ -280,16 +319,38 @@ def sweep_tmp_dirs(root: str, tag: Optional[str] = None) -> List[str]:
     return removed
 
 
-def restore_latest(root: str, trainer) -> Optional[Dict[str, Any]]:
+def restore_latest(root: str, trainer, elastic: bool = False,
+                   sample_feed: Optional[Dict[str, Any]] = None
+                   ) -> Optional[Dict[str, Any]]:
     """Restore ``trainer`` from the newest checkpoint under ``root``
     that validates and loads, falling back over corrupt ones (warning
     each). Returns the checkpoint's meta dict, or ``None`` when no
-    restorable checkpoint exists."""
+    restorable checkpoint exists.
+
+    A checkpoint saved at DIFFERENT mesh axes than the trainer's is not
+    corruption: without ``elastic`` the structured
+    :class:`ReshardError` propagates (falling back to an older
+    checkpoint would silently discard progress — all checkpoints of a
+    run share its mesh); with ``elastic=True`` the restore routes
+    through :func:`reshard_restore`, which proves feasibility with the
+    static checker and re-places every array per the trainer's target
+    rules — the ``fit(resume=True, elastic=True)`` path."""
     from . import io as _io
 
     for info in reversed(list_checkpoints(root)):
         try:
-            _io.load_trainer(info.path, trainer)
+            try:
+                _io.load_trainer(info.path, trainer)
+            except ReshardError:
+                if not elastic:
+                    raise
+                rep = reshard_restore(info.path, trainer,
+                                      sample_feed=sample_feed)
+                _log().info(
+                    "elastic resume: resharded %s from mesh %s onto %s "
+                    "(%d bytes re-placed in %.3fs)", info.path,
+                    rep["saved_axes"], rep["target_axes"],
+                    rep["bytes_moved"], rep["seconds"])
         except CheckpointCorrupt as e:
             _log().warning("skipping corrupt checkpoint %s (%s); "
                            "falling back to an older one", info.path, e.reason)
@@ -300,6 +361,90 @@ def restore_latest(root: str, trainer) -> Optional[Dict[str, Any]]:
                     trainer.global_step)
         return meta
     return None
+
+
+# -- elastic resharding -------------------------------------------------------
+
+
+def normalize_mesh_axes(axes: Optional[Dict[str, Any]]) -> Dict[str, int]:
+    """Canonical ``{axis: size}`` with size-1 axes dropped: a
+    ``{"dp": 1}`` mesh and no mesh at all place arrays identically, so
+    they must compare equal for the reshard gate."""
+    return {str(k): int(v) for k, v in (axes or {}).items() if int(v) > 1}
+
+
+def mesh_axes(mesh) -> Optional[Dict[str, int]]:
+    """The ``meta.mesh_axes`` encoding of a ``jax.sharding.Mesh``
+    (``None`` for no mesh). THE single encoder: ``io.save_trainer``
+    records it, the ``load_trainer`` gate and the static reshard
+    verdicts (``analysis.contracts``) compare against it — one
+    implementation, so the save side and every check side can never
+    drift."""
+    if mesh is None:
+        return None
+    return {str(a): int(mesh.shape[a]) for a in mesh.axis_names}
+
+
+def trainer_mesh_axes(trainer) -> Optional[Dict[str, int]]:
+    """:func:`mesh_axes` of the trainer's mesh (``None`` for a
+    single-device trainer)."""
+    return mesh_axes(getattr(trainer, "mesh", None))
+
+
+def reshard_restore(checkpoint_dir: str, trainer,
+                    sample_feed: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    """Restore a checkpoint onto a trainer whose mesh DIFFERS from the
+    saved ``meta.mesh_axes`` — the elastic-resharding door (dp N→M in
+    either direction, single-device included).
+
+    Checkpoint arrays are stored unsharded (fully gathered), so the
+    redistribution is a re-placement per the TARGET trainer's
+    ``ShardingRules`` — the restore goes through the exact
+    ``parallel.api.shard_scope`` normalization training placement uses,
+    so the resharded layout can never drift from what ``startup`` would
+    build. Model state is bit-exact: same params/opt_state/mutable
+    state/loss-scale state/rng-step meta as a same-mesh restore.
+
+    Feasibility is proven FIRST with the static contract checker
+    (``analysis.contracts.check_artifacts``) so the runtime and CI can
+    never disagree: a pair the checker calls ``ckpt:reshard-infeasible``
+    raises :class:`ReshardError` carrying that finding's text verbatim,
+    BEFORE any trainer state is touched; a ``ckpt:mesh-reshard``
+    (expressible) pair restores. ``sample_feed`` supplies the per-step
+    batch for the divisibility half of the check — without it, batch
+    feasibility is unchecked (mirroring the static verdict's wording)
+    and an indivisible batch surfaces at the first ``put_batch``.
+
+    Returns a report dict: ``saved_axes``/``target_axes``,
+    ``global_step``, ``bytes_moved`` (checkpoint bytes re-placed) and
+    ``seconds`` (restore wall time) — the ``elastic_reshard`` bench row
+    reads these."""
+    from . import io as _io
+    from .analysis import contracts as _contracts
+
+    t0 = time.perf_counter()
+    man = read_manifest(checkpoint_dir)  # CheckpointCorrupt if unreadable
+    saved_axes = ((man or {}).get("meta") or {}).get("mesh_axes")
+    target_axes = trainer_mesh_axes(trainer)
+    report = _contracts.check_artifacts(
+        trainer=trainer, checkpoint_dir=checkpoint_dir,
+        sample_feed=sample_feed)
+    infeasible = report.by_code("ckpt:reshard-infeasible")
+    if infeasible:
+        raise ReshardError(checkpoint_dir, saved_axes, target_axes,
+                           infeasible[0].message)
+    _io.load_trainer(checkpoint_dir, trainer, allow_reshard=True)
+    bytes_moved = sum(int(spec.get("size", 0))
+                      for spec in ((man or {}).get("files") or {}).values())
+    return {
+        "meta": dict(getattr(trainer, "_last_loaded_meta", None) or {}),
+        "saved_axes": dict(saved_axes) if saved_axes else None,
+        "target_axes": dict(target_axes) if target_axes else None,
+        "global_step": trainer.global_step,
+        "bytes_moved": bytes_moved,
+        "seconds": time.perf_counter() - t0,
+    }
 
 
 # -- preemption --------------------------------------------------------------
@@ -504,7 +649,9 @@ def record_incident(incidents: List[Incident], step: int,
 
 __all__ = [
     "CheckpointCorrupt", "CheckpointInfo", "GuardPolicy", "Incident",
-    "InjectedCrash", "PreemptionHandler", "crash_point", "crash_points",
-    "feed_digest", "list_checkpoints", "read_manifest", "restore_latest",
-    "sweep_tmp_dirs", "validate_checkpoint", "write_manifest",
+    "InjectedCrash", "PreemptionHandler", "ReshardError", "crash_point",
+    "crash_points", "feed_digest", "list_checkpoints", "mesh_axes",
+    "normalize_mesh_axes", "read_manifest", "reshard_restore",
+    "restore_latest", "sweep_tmp_dirs", "trainer_mesh_axes",
+    "validate_checkpoint", "write_manifest",
 ]

@@ -21,13 +21,24 @@ no timing races):
   (crash-looping executable, drives the circuit breaker) — with call
   counts shared across ``clone()`` so a worker pool sees one fault
   script, not one per worker.
+- **Membership changes**: :func:`visible_devices` /
+  :func:`membership_meshes` build deterministic shrunk/grown device
+  meshes (the preempted-worker / rejoined-worker analog on the CPU
+  test fixture) so elastic reshard drills replay exactly;
+  :func:`acting` runs a side effect — e.g. ``srv.stop()`` killing a
+  pserver — at a named crash point WITHOUT dying there, so "a server
+  died mid-shard-split" happens at an exact phase.
 
-Known crash-point tags in the save paths:
+Known crash-point tags in the save/reshard paths:
 
 - ``save_trainer:files-written`` — npz/meta files on disk, no manifest
 - ``save_trainer:manifest-written`` — manifest on disk, dir not renamed
 - ``save_inference_model:files-written`` / ``:manifest-written`` — the
   same two phases of the inference-artifact export
+- ``ps_resize:exported`` — one param's state left its old pserver,
+  import not yet sent (fires per moved param during a shard
+  split/merge)
+- ``ps_resize:imported`` — every move imported, routing not switched
 """
 
 from __future__ import annotations
@@ -100,6 +111,62 @@ def crashing(tag: str):
         yield
     finally:
         resilience.crash_points.discard(tag)
+
+
+@contextlib.contextmanager
+def acting(tag: str, callback: Callable[[], None], once: bool = True):
+    """Run ``callback()`` when crash point ``tag`` fires, WITHOUT
+    raising there — the process under test keeps running while
+    something else dies at an exact phase (e.g. ``srv.stop()`` killing
+    a pserver mid-shard-split, so the migration's own fault handling is
+    what gets exercised). ``once`` (default) disarms after the first
+    firing — a per-item tag like ``ps_resize:exported`` fires per move,
+    and the drill usually wants exactly one deterministic kill. Yields
+    a one-element list holding the firing count."""
+    fired = [0]
+
+    def _cb():
+        if once and fired[0]:
+            return
+        fired[0] += 1
+        callback()
+
+    resilience.crash_callbacks[tag] = _cb
+    try:
+        yield fired
+    finally:
+        resilience.crash_callbacks.pop(tag, None)
+
+
+# -- membership changes ------------------------------------------------------
+
+
+def visible_devices(n: int):
+    """The first ``n`` of the process's devices, deterministically — the
+    stand-in for "the job restarted with a different worker count" on
+    the fixed-size CPU test fixture (the 8-device
+    ``xla_force_host_platform_device_count`` mesh): meshes built over
+    ``visible_devices(4)`` and ``visible_devices(2)`` are exactly what
+    a dp 4→2 preemption drill restores between."""
+    import jax
+
+    devs = list(jax.devices())
+    if not 1 <= int(n) <= len(devs):
+        raise ValueError(f"visible_devices({n}): process has {len(devs)} "
+                         "devices")
+    return devs[:int(n)]
+
+
+def membership_meshes(counts, axis: str = "dp"):
+    """Deterministic membership-change schedule: one ``{axis: n}`` mesh
+    per entry of ``counts``, each over :func:`visible_devices` — e.g.
+    ``membership_meshes([4, 2])`` scripts a kill-at-dp-4 →
+    rejoin-at-dp-2 elastic drill. Same counts, same meshes, every
+    run."""
+    from ..parallel.mesh import make_mesh
+
+    return [make_mesh({axis: int(n)}, devices=visible_devices(int(n)))
+            for n in counts]
 
 
 # -- checkpoint corruption ---------------------------------------------------

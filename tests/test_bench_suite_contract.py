@@ -28,7 +28,8 @@ def test_priority_order_leads_with_baseline_configs():
     # every registered config appears exactly once
     expect = (set(bench.TRAIN_CONFIGS) | set(bench.INFER_CONFIGS)
               | {"gpt_decode", "dispatch_overhead", "guard_overhead",
-                 "input_pipeline", "serving", "fusion_profile"})
+                 "input_pipeline", "serving", "fusion_profile",
+                 "elastic_reshard"})
     assert set(names) == expect and len(names) == len(expect)
 
 
@@ -110,6 +111,15 @@ def test_fusion_profile_quick_overrides(monkeypatch):
     bench._run_one("fusion_profile", 1.0, quick=True)
     assert seen == {"iters": 2, "batch_size": 4, "seq": 64}
     assert bench._result_key("fusion_profile") == "fusion_profile"
+
+
+def test_elastic_reshard_quick_overrides(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(bench, "bench_elastic_reshard",
+                        lambda peak, **kw: seen.update(kw) or {"v": 1})
+    bench._run_one("elastic_reshard", 1.0, quick=True)
+    assert seen == {"iters": 1}
+    assert bench._result_key("elastic_reshard") == "elastic_reshard"
 
 
 def test_train_rows_carry_top_fusions(monkeypatch):

@@ -269,7 +269,10 @@ def test_interleaved_rest_layout_checkpoints_logical(tmp_path):
     prog_s = pt.build(transformer.make_model(_cfg()))
     tr_s = pt.Trainer(prog_s, opt.Adam(1e-3), loss_name="loss")
     tr_s.startup(sample_feed=feed)
-    pio.load_trainer(str(tmp_path / "ck"), tr_s)
+    # a mesh change is explicit now: reshard_restore is the door (the
+    # {dp,pp} -> single-device restore is the dp N->1 elastic case)
+    pt.resilience.reshard_restore(str(tmp_path / "ck"), tr_s,
+                                  sample_feed=feed)
     np.testing.assert_allclose(float(tr_s.eval(feed)["loss"]), ev,
                                atol=2e-4, rtol=2e-4)
 
