@@ -120,6 +120,7 @@ def _time_trainer(trainer, host_batches, warmup=3, iters=20,
     batches per transfer and each dispatch is one fused K-step launch;
     both numbers stay per-STEP so K is directly comparable to 1."""
     from paddle_tpu.data.feeder import DeviceFeeder, stack_batches
+    from paddle_tpu.telemetry import counter_deltas, get_registry
 
     k = steps_per_dispatch or _steps_per_dispatch()
     if k <= 1:
@@ -132,11 +133,16 @@ def _time_trainer(trainer, host_batches, warmup=3, iters=20,
             for i in range(iters):
                 yield host_batches[i % len(host_batches)]
 
+        tel0 = get_registry().counter_values()
         t0 = time.perf_counter()
         for feed in DeviceFeeder(gen, put_fn=trainer._put_feed, capacity=2):
             out = trainer.step(feed)
         _sync(out)
         dt_pipe = (time.perf_counter() - t0) / iters
+        # registry counter deltas over the measured window, per step —
+        # the row's `telemetry` snapshot (_result picks this up)
+        trainer._bench_telemetry = counter_deltas(
+            tel0, get_registry().counter_values(), per=iters)
 
         staged = [trainer._put_feed(b) for b in host_batches[:2]]
         out = trainer.step(staged[0])
@@ -166,11 +172,14 @@ def _time_trainer(trainer, host_batches, warmup=3, iters=20,
                           stack_k=k,
                           put_stacked_fn=lambda d: trainer._put_feed(
                               d, stacked=True))
+    tel0 = get_registry().counter_values()
     t0 = time.perf_counter()
     for n, feed in feeder:
         out = trainer.run_steps(feed, k=n) if n > 1 else trainer.step(feed)
     _sync(out)
     dt_pipe = (time.perf_counter() - t0) / steps
+    trainer._bench_telemetry = counter_deltas(
+        tel0, get_registry().counter_values(), per=steps)
 
     # feeds are NOT donated (only the training carry is), so pre-staged
     # super-batches can be reused across dispatches like the k=1 path
@@ -197,6 +206,13 @@ def _result(n_per_step, unit, dt_pipe, dt_comp, flops_per_step, peak,
         "mfu": round(flops_per_step / dt_pipe / peak, 4),
         "mfu_compute_only": round(flops_per_step / dt_comp / peak, 4),
     }
+    if trainer is not None:
+        # the measured window's registry counter deltas per step
+        # (steps/dispatches/h2d bytes/guard incidents...), recorded by
+        # _time_trainer — rows are comparable across rounds and iters
+        tel = getattr(trainer, "_bench_telemetry", None)
+        if tel is not None:
+            out["telemetry"] = tel
     if feed is not None:
         # the honest h2d numerator: WIRE bytes (what actually crosses
         # the link under the trainer's feed_wire table), alongside the
@@ -871,16 +887,26 @@ def bench_serving(peak, batch_size=64, requests=240, workers=2,
     real int8 datapath. ``value`` is the fp32 steady-state p99 in ms;
     the saturated phase proves overload sheds (typed rejects) instead
     of queueing without bound."""
+    from paddle_tpu.telemetry import counter_deltas, get_registry
+
     latency = {}
     reject_rate = {}
     offered = {}
+    telemetry = {}
     for variant, (pred, feed) in sorted(_serving_predictors(batch_size).items()):
         server = _make_server(pred, workers, queue_size)
         try:
             svc = _calibrate_serving(server, feed)
             capacity = workers / svc            # req/s the pool sustains
             steady_rate = max(1.0, 0.6 * capacity)
+            tel0 = get_registry().counter_values()
             lats, _ = _drive_serving(server, feed, requests, steady_rate)
+            # steady-phase registry COUNTER deltas per REQUEST — the
+            # serving row's `telemetry` snapshot (submitted/completed/
+            # reject series; histograms are not counters and are
+            # deliberately excluded — latency lives in latency_ms)
+            telemetry[variant] = counter_deltas(
+                tel0, get_registry().counter_values(), per=requests)
             sat_rate = 3.0 * capacity
             _, rejected = _drive_serving(server, feed, requests, sat_rate)
         finally:
@@ -900,6 +926,7 @@ def bench_serving(peak, batch_size=64, requests=240, workers=2,
         "latency_ms": latency,
         "reject_rate_saturated": reject_rate,
         "offered_rps": offered,
+        "telemetry": telemetry,
         "requests": requests,
         "workers": workers,
         "queue_size": queue_size,

@@ -11,7 +11,7 @@ decoding, and a StableHLO inference/export path.
 
 from . import analysis, backward, clip, core, data, debugger, evaluator, framework, initializer
 from . import io, layers, lr_scheduler, metrics, models, nets, optimizer
-from . import parallel, quantize, regularizer, resilience, serving, sparse, transpiler
+from . import parallel, quantize, regularizer, resilience, serving, sparse, telemetry, transpiler
 from .resilience import (CheckpointCorrupt, GuardPolicy, PreemptionHandler,
                          ReshardError, reshard_restore)
 from .serving import PredictorServer

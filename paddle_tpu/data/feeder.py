@@ -94,6 +94,46 @@ class PipelineMetrics:
         with self._lock:
             self.consumer_starved_s += seconds
 
+    def telemetry_families(self, inst: str = "0") -> list:
+        """The same accumulators as registry metric families under the
+        ``paddle_tpu_feeder_*`` names (scrape-time: the Trainer's
+        telemetry collector calls this, so the exported series can
+        never disagree with :meth:`report`)."""
+        from ..telemetry.registry import counter_family
+
+        with self._lock:
+            stages = dict(self.stage_s)
+            h2d_bytes, saved = self.h2d_bytes, self.encode_saved_bytes
+            starved = self.consumer_starved_s
+            batches, chunks = self.batches, self.chunks
+        labels = {"inst": inst}
+        return [
+            counter_family(
+                "paddle_tpu_feeder_stage_seconds_total",
+                "Input-pipeline seconds per stage "
+                "(reader/encode/stack/h2d/dispatch wait)",
+                [({**labels, "stage": s}, round(v, 6))
+                 for s, v in sorted(stages.items())]),
+            counter_family(
+                "paddle_tpu_feeder_batches_total",
+                "Host batches pulled from the reader", [(labels, batches)]),
+            counter_family(
+                "paddle_tpu_feeder_chunks_total",
+                "Device transfers (fused chunks count once)",
+                [(labels, chunks)]),
+            counter_family(
+                "paddle_tpu_feeder_h2d_bytes_total",
+                "Wire bytes moved host-to-device", [(labels, h2d_bytes)]),
+            counter_family(
+                "paddle_tpu_feeder_encode_saved_bytes_total",
+                "Logical-minus-wire bytes the feed wire encode saved",
+                [(labels, saved)]),
+            counter_family(
+                "paddle_tpu_feeder_consumer_starved_seconds_total",
+                "Training-loop seconds spent waiting for input",
+                [(labels, round(starved, 6))]),
+        ]
+
     def report(self) -> Dict[str, Any]:
         """Per-stage attribution + an effective-link estimate:
         ``h2d_mbps`` is wire bytes over time spent in the put,
@@ -244,7 +284,16 @@ class DeviceFeeder:
     bytes: reader wait, encode, stack, h2d put, and the
     fill-thread-blocked-on-consumer dispatch wait; pair it with a
     ``put_fn`` that does not itself record (``Trainer._put_feed``
-    with ``record=False``) or the h2d stage double-counts."""
+    with ``record=False``) or the h2d stage double-counts.
+
+    ``journal`` (a :class:`paddle_tpu.telemetry.RunJournal`) correlates
+    the pipeline with the dispatches it feeds: the fill thread mints a
+    span id per chunk and emits a ``feeder.fill`` event when the
+    transfer lands; after the iterator yields an item,
+    :attr:`last_span` holds that item's span (exact for the serial
+    single-consumer iteration contract) so the consumer can hand the
+    SAME span to ``trainer.step``/``run_steps`` — fill and dispatch of
+    one chunk then share one trace id end to end (``fit`` does this)."""
 
     def __init__(self, batches: Callable[[], Iterator[Dict[str, np.ndarray]]],
                  put_fn: Optional[Callable[[Dict[str, np.ndarray]], Dict[str, jax.Array]]] = None,
@@ -252,7 +301,8 @@ class DeviceFeeder:
                  put_stacked_fn: Optional[Callable] = None,
                  encode_fn: Optional[Callable] = None,
                  metrics: Optional[PipelineMetrics] = None,
-                 logical_nbytes_fn: Optional[Callable] = None):
+                 logical_nbytes_fn: Optional[Callable] = None,
+                 journal=None):
         self.batches = batches
         self.put_fn = put_fn or (lambda d: jax.device_put(d))
         self.put_stacked_fn = put_stacked_fn or self.put_fn
@@ -260,6 +310,8 @@ class DeviceFeeder:
         self.stack_k = max(1, int(stack_k))
         self.encode_fn = encode_fn
         self.metrics = metrics
+        self.journal = journal
+        self.last_span: Optional[str] = None
         # spec-aware logical-byte counter (FeedWire.logical_nbytes):
         # counts already-wire-dtype reader output at its DECODED width
         # so wire_reduction reports the true link saving
@@ -344,6 +396,22 @@ class DeviceFeeder:
                     continue
             return False
 
+        journal = self.journal
+
+        def fill_event(n, hb, putter):
+            """One chunk's transfer + its ``feeder.fill`` journal event
+            (span minted HERE, on the fill thread, at chunk-creation
+            time — the consumer re-uses it for the dispatch)."""
+            if journal is None:
+                return putter(hb), None
+            span = journal.new_span()
+            t0 = time.perf_counter()
+            dev = putter(hb)
+            journal.emit("feeder.fill", span=span, num_steps=n,
+                         nbytes=host_feed_nbytes(hb),
+                         put_s=round(time.perf_counter() - t0, 6))
+            return dev, span
+
         def fill():
             try:
                 if self.stack_k > 1:
@@ -351,15 +419,20 @@ class DeviceFeeder:
                                               self.stack_k, metrics=metrics):
                         if stop.is_set():
                             return
-                        item = (n, self._timed_put(self.put_stacked_fn, hb)
-                                if n > 1 else self._timed_put(self.put_fn, hb))
-                        if not put(item):
+                        dev, span = fill_event(
+                            n, hb, (lambda b, _n=n: self._timed_put(
+                                self.put_stacked_fn if _n > 1
+                                else self.put_fn, b)))
+                        if not put(((n, dev), span)):
                             return
                 else:
                     for b in self._instrumented_batches():
                         if stop.is_set():
                             return
-                        if not put(self._timed_put(self.put_fn, b)):
+                        dev, span = fill_event(
+                            1, b,
+                            lambda hb: self._timed_put(self.put_fn, hb))
+                        if not put((dev, span)):
                             return
             except BaseException as e:  # surfaced on the consumer side
                 err.append(e)
@@ -407,7 +480,8 @@ class DeviceFeeder:
                                 break
                             if item is END:
                                 break
-                            yield item
+                            payload, self.last_span = item
+                            yield payload
                         if err:
                             raise err[0]
                         return
@@ -420,7 +494,8 @@ class DeviceFeeder:
                         # truncate it to a silent StopIteration
                         raise err[0]
                     return
-                yield item
+                payload, self.last_span = item
+                yield payload
         finally:
             # break / exception / generator gc: release the fill thread
             stop.set()

@@ -202,6 +202,38 @@ class Executor:
         self._jit_cache.clear()
 
 
+def _register_trainer_telemetry(trainer) -> int:
+    """Register the trainer's scrape-time collector in the process
+    registry: step/dispatch counters from the StepTimer, feeder stage
+    counters from the PipelineMetrics, plus the trainer-level
+    global-step gauge and guard-incident counter — all read from the
+    structures the trainer already maintains, so the exported series
+    cannot disagree with ``profile_report()``/``pipeline_report()``
+    and the hot path pays nothing at publication time. The collector
+    is weakly bound to the trainer (dropped when it is collected; the
+    registry hands the live trainer back at scrape time)."""
+    from .telemetry import get_registry
+    from .telemetry.registry import counter_family, gauge_family
+
+    def collect(tr):
+        inst = tr.telemetry_inst
+        labels = {"inst": inst}
+        fams = [
+            gauge_family("paddle_tpu_trainer_global_step",
+                         "Current optimizer global step",
+                         [(labels, tr.global_step)]),
+            counter_family(
+                "paddle_tpu_trainer_guard_incidents_total",
+                "Non-finite steps discarded by the NaN/Inf guard",
+                [(labels, tr.guard_incident_total)]),
+        ]
+        fams.extend(tr.step_timer.telemetry_families(inst))
+        fams.extend(tr.pipeline_metrics.telemetry_families(inst))
+        return fams
+
+    return get_registry().add_collector(collect, owner=trainer)
+
+
 class Trainer:
     """Jitted train loop: the Executor+optimizer / ParallelExecutor story.
 
@@ -279,13 +311,24 @@ class Trainer:
         from .data.feeder import PipelineMetrics
         from .data.wire import FeedWire
         from .profiling.steptime import StepTimer
+        from .telemetry import get_journal, get_registry
         self.feed_wire = FeedWire.make(feed_wire)
         self.pipeline_metrics = PipelineMetrics()
+        # unified telemetry (paddle_tpu.telemetry): every trainer
+        # publishes into the process registry through ONE scrape-time
+        # collector (zero hot-path cost; the `inst` label keeps two
+        # live trainers' series apart) and journals one correlated
+        # event per dispatch through the StepTimer
+        self.journal = get_journal()
+        self.telemetry_inst = get_registry().next_instance("trainer")
+        self.guard_incident_total = 0
+        self._telemetry_server = None
         # per-dispatch wall-time accounting (profiling.steptime):
         # always-on — two clock reads per dispatch, <2% of step time
         # test-pinned — and merged with pipeline_metrics by
         # profile_report()
-        self.step_timer = StepTimer()
+        self.step_timer = StepTimer(journal=self.journal,
+                                    inst=self.telemetry_inst)
         self._fusion_report = None  # cache: fusion_report(feed) result
         self.loss_scaler = None
         if strategy is not None and (getattr(strategy, "loss_scale", None)
@@ -295,6 +338,10 @@ class Trainer:
                 init_scale=strategy.loss_scale or 2.0 ** 15,
                 dynamic=strategy.dynamic_loss_scale,
                 growth_interval=strategy.loss_scale_growth_interval)
+        # registered LAST: a scrape racing a half-constructed trainer
+        # (or an __init__ that raises above) must never see a
+        # collector whose attributes don't exist yet
+        self._telemetry_cid = _register_trainer_telemetry(self)
 
     # ------------------------------------------------------------------
     def startup(self, rng: Optional[jax.Array] = None, sample_feed: Optional[Feed] = None,
@@ -884,18 +931,24 @@ class Trainer:
                 self._cache_dir)
 
     # ------------------------------------------------------------------
-    def step(self, feed: Feed, rng: Optional[jax.Array] = None) -> Dict[str, Any]:
-        """One optimization step; returns the program's fetch dict."""
+    def step(self, feed: Feed, rng: Optional[jax.Array] = None,
+             span: Optional[str] = None) -> Dict[str, Any]:
+        """One optimization step; returns the program's fetch dict.
+        ``span`` correlates this dispatch's journal event with the
+        feeder fill that produced the batch (``fit`` passes the
+        DeviceFeeder's chunk span; minted fresh when omitted)."""
         enforce(self._step_fn is not None, "call startup() before step()")
         if rng is None:
             rng = jax.random.fold_in(make_prng_key(get_flag("seed") + 1), self.global_step)
         feed = self._put_feed(feed)
         ls = getattr(self.scope, "loss_scale_state", None) or {}
+        base_step = self.global_step
         t0 = _time.perf_counter()
         with profiler.record_event("trainer.step"):
             p, o, s, out, new_ls = self._step_fn(self.scope.params, self.scope.opt_state,
                                                  self.scope.state, rng, feed, ls)
-        self.step_timer.record_dispatch(t0, _time.perf_counter(), 1, "step")
+        self.step_timer.record_dispatch(t0, _time.perf_counter(), 1, "step",
+                                        span=span, base_step=base_step)
         self._log_compile_cache("train step")
         self.scope.params, self.scope.opt_state, self.scope.state = p, o, s
         if self.loss_scaler is not None:
@@ -910,7 +963,8 @@ class Trainer:
         return out
 
     def run_steps(self, stacked_feed: Feed, k: Optional[int] = None,
-                  rng: Optional[jax.Array] = None) -> Dict[str, Any]:
+                  rng: Optional[jax.Array] = None,
+                  span: Optional[str] = None) -> Dict[str, Any]:
         """K optimization steps in ONE device launch (fused multi-step
         dispatch): ``stacked_feed`` carries K per-step batches on a new
         leading axis (``{name: (K, batch, ...)}``), the jitted program
@@ -948,7 +1002,8 @@ class Trainer:
                 self.scope.params, self.scope.opt_state, self.scope.state,
                 rng, step0, feed, ls)
         self.step_timer.record_dispatch(t0, _time.perf_counter(), k,
-                                        "run_steps")
+                                        "run_steps", span=span,
+                                        base_step=int(step0))
         self._log_compile_cache(f"fused {k}-step program")
         self.scope.params, self.scope.opt_state, self.scope.state = p, o, s
         if self.loss_scaler is not None:
@@ -1040,14 +1095,26 @@ class Trainer:
             recorded.append(resilience.record_incident(
                 self.guard_incidents, base_step + i, bad or ("unknown",),
                 digest))
+        self.guard_incident_total += len(recorded)
         # escalation is evaluated at each INCIDENT's own step, not the
         # chunk end: with window < K a mid-chunk incident would
         # otherwise fall outside the trailing window by the time the
         # chunk finishes and never escalate (the check_nan_inf route is
         # window=1 — its abort contract must hold under fused dispatch)
         for inc in recorded:
-            resilience.escalate_if_needed(self.guard_incidents, self._guard,
-                                          inc.step)
+            try:
+                resilience.escalate_if_needed(self.guard_incidents,
+                                              self._guard, inc.step)
+            except FloatingPointError as e:
+                # flight-record the escalation BEFORE it unwinds: the
+                # ring still holds the incidents/dispatches leading up
+                from .telemetry import flight_dump
+                self.journal.emit("guard.escalation", step=inc.step,
+                                  error=str(e)[:500])
+                flight_dump("guard_escalation",
+                            detail={"step": inc.step,
+                                    "error": str(e)[:500]})
+                raise
 
     def eval(self, feed: Feed) -> Dict[str, Any]:
         """Forward pass without dropout/updates.
@@ -1134,6 +1201,36 @@ class Trainer:
         self.step_timer.reset()
         self.pipeline_metrics.reset()
 
+    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
+        """Opt-in scrape endpoint for a TRAINING worker: start the
+        stdlib ``GET /metrics`` (Prometheus text of the process
+        registry — this trainer's series carry its ``inst`` label) +
+        ``GET /healthz`` server; port 0 picks a free port (see
+        ``.port``). The same :class:`~paddle_tpu.telemetry.
+        TelemetryServer` backs ``PredictorServer.serve_metrics`` —
+        trainer and serving fleet look identical to the scraper.
+        Idempotent — repeat calls return the same running server
+        (ports/threads don't leak); caller owns ``.close()``."""
+        from .telemetry import serve_metrics as _serve
+
+        def health():
+            return {
+                "live": True,
+                "role": "trainer",
+                "inst": self.telemetry_inst,
+                "run": self.journal.run_id,
+                "global_step": self.global_step,
+                "guard_incidents": self.guard_incident_total,
+            }
+
+        srv = self._telemetry_server
+        if srv is None or not srv._thread.is_alive():
+            # fresh server only when none is running (a closed one may
+            # be re-opened later; never two live endpoints per trainer)
+            srv = self._telemetry_server = _serve(health_fn=health,
+                                                  port=port, host=host)
+        return srv
+
     def _put_feed_impl(self, feed: Feed, stacked, metrics):
         if self.feed_wire is not None:
             t0 = _time.perf_counter()
@@ -1197,11 +1294,19 @@ class Event:
     (``Trainer.profile_report()``) on the same events — per-dispatch
     wall time, the compute/h2d/host-encode/starvation breakdown with
     its named bottleneck, and the cached fusion table when one was
-    computed."""
+    computed.
+
+    A ``"profile"`` event fires every time ``global_step`` crosses a
+    multiple of ``fit(profile_interval_steps=N)`` (chunk-boundary
+    rounded like interval checkpoints), carrying the same
+    ``pipeline``/``profile`` payloads — so a long epoch reports
+    between boundaries through the same path, with no extra host
+    sync."""
 
     def __init__(self, kind: str, epoch: int, step: int, metrics=None,
                  num_steps: int = 1, pipeline=None, profile=None):
-        # begin_epoch | end_epoch | begin_step | end_step | preempted
+        # begin_epoch | end_epoch | begin_step | end_step | profile
+        # | preempted
         self.kind = kind
         self.epoch = epoch
         self.step = step
@@ -1217,10 +1322,23 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
         prefetch: bool = True, steps_per_dispatch: int = 1,
         resume: bool = False, elastic: bool = False,
         preemption: Optional[bool] = None,
-        feed_wire=None):
+        feed_wire=None, profile_interval_steps: int = 0):
     """High-level train loop (contrib.trainer.Trainer.train analog):
     reader → DataFeeder → (optional double-buffered prefetch) →
     trainer.step, with event callbacks and periodic checkpoints.
+
+    ``profile_interval_steps=N`` fires a ``"profile"`` event (carrying
+    ``Event.profile``/``Event.pipeline`` exactly like ``end_epoch``
+    does) every time ``global_step`` crosses a multiple of N, so a
+    long epoch is not blind between boundaries — same report path,
+    host-side accumulators only, no extra device↔host sync.
+
+    **Telemetry** (MIGRATION.md "Telemetry"): checkpoint saves/
+    restores, preemption, and guard incidents are journaled; with a
+    ``checkpoint_config`` the process flight recorder re-roots to
+    ``<checkpoint_dir>/flight`` and dumps the recent-event ring on
+    SIGTERM preemption, guard escalation, ``ReshardError``, and any
+    unhandled exception that aborts the loop.
 
     ``steps_per_dispatch=K`` fuses the hot path: the prefetch thread
     stacks K host batches into one super-batch, transfers it in one
@@ -1270,6 +1388,41 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
       ``step_<global_step>``, drains async orbax saves, fires a
       ``"preempted"`` event, and returns cleanly.
     """
+    import os
+
+    from . import resilience
+    from .telemetry import flight_dump, get_recorder
+
+    if checkpoint_config is not None:
+        # crash artifacts live next to the checkpoints they explain
+        get_recorder().set_root(
+            os.path.join(checkpoint_config.checkpoint_dir, "flight"))
+    try:
+        return _fit_impl(trainer, reader, num_epochs, feed_names, dtypes,
+                         event_handler, checkpoint_config, prefetch,
+                         steps_per_dispatch, resume, elastic, preemption,
+                         feed_wire, profile_interval_steps)
+    except resilience.InjectedCrash:
+        raise  # models abrupt process death: a real kill -9 dumps nothing
+    except FloatingPointError:
+        raise  # guard escalation already flight-dumped at the escalate site
+    except resilience.ReshardError:
+        raise  # already flight-dumped at the raise site (resilience)
+    except Exception as e:
+        # unhandled abort: capture what the run was doing when it died
+        err = f"{type(e).__name__}: {e}"[:500]
+        trainer.journal.emit("fit.error", error=err,
+                             global_step=trainer.global_step)
+        flight_dump("fit_exception",
+                    detail={"error": err,
+                            "global_step": trainer.global_step})
+        raise
+
+
+def _fit_impl(trainer, reader, num_epochs, feed_names, dtypes,
+              event_handler, checkpoint_config, prefetch,
+              steps_per_dispatch, resume, elastic, preemption,
+              feed_wire, profile_interval_steps):
     import contextlib as _contextlib
     import os
     import shutil
@@ -1278,9 +1431,17 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
     from . import io as _io
     from . import resilience
     from .data.feeder import DataFeeder, DeviceFeeder, iter_chunked
+    from .telemetry import flight_dump, get_registry
+
+    ckpt_counter = get_registry().counter(
+        "paddle_tpu_trainer_checkpoints_total",
+        "Checkpoints committed by fit", ("kind",))
 
     _enforce(steps_per_dispatch >= 1,
              f"fit(steps_per_dispatch={steps_per_dispatch}): need >= 1")
+    _enforce(profile_interval_steps >= 0,
+             f"fit(profile_interval_steps={profile_interval_steps}): "
+             "need >= 0 (0 disables interval profile events)")
     if feed_wire is not None:
         trainer.set_feed_wire(feed_wire)
     feeder = DataFeeder(feed_names, dtypes)
@@ -1309,6 +1470,9 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
         if meta is not None:
             start_epoch = int(meta.get("epoch", 0))
             skip_steps = int(meta.get("epoch_step", 0))
+            trainer.journal.emit("ckpt.restore",
+                                 global_step=trainer.global_step,
+                                 epoch=start_epoch, epoch_step=skip_steps)
 
     # rebuild the rotation list from disk (oldest first) so pre-existing
     # checkpoints rotate out across restarts instead of accumulating,
@@ -1336,8 +1500,13 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
         if checkpoint_config is None:
             return
         d = os.path.join(checkpoint_config.checkpoint_dir, tag)
+        t0 = _time.perf_counter()
         _io.save_trainer(d, trainer, extra_meta={"epoch": epoch,
                                                  "epoch_step": epoch_step})
+        trainer.journal.emit("ckpt.save", tag=tag, path=d,
+                             global_step=trainer.global_step,
+                             seconds=round(_time.perf_counter() - t0, 6))
+        ckpt_counter.inc(kind=tag.partition("_")[0] or "other")
         last_saved_step[0] = trainer.global_step
         if d in kept:      # re-saved tag (e.g. preempt at an interval
             kept.remove(d)  # boundary): refresh its rotation position
@@ -1356,6 +1525,8 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
             # restored checkpoint already consumed (1 batch == 1 step)
             skip = skip_steps if epoch == start_epoch else 0
             steps_in_epoch = skip
+            trainer.journal.emit("fit.begin_epoch", epoch=epoch,
+                                 global_step=trainer.global_step)
             if event_handler:
                 event_handler(Event("begin_epoch", epoch, trainer.global_step))
 
@@ -1383,7 +1554,8 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
                     metrics=trainer.pipeline_metrics,
                     logical_nbytes_fn=(trainer.feed_wire.logical_nbytes
                                        if trainer.feed_wire is not None
-                                       else None))
+                                       else None),
+                    journal=trainer.journal)
                 iterator = iter(device_feeder)
             elif steps_per_dispatch > 1:
                 iterator = iter_chunked(
@@ -1396,17 +1568,34 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
             try:
                 for item in iterator:
                     n, feed = item if steps_per_dispatch > 1 else (1, item)
+                    # the chunk's trace id, minted by the fill thread:
+                    # its dispatch event correlates with the feeder.fill
+                    # event that produced this batch
+                    span = (device_feeder.last_span
+                            if device_feeder is not None else None)
                     gs_before = trainer.global_step
                     if event_handler:
                         event_handler(Event("begin_step", epoch, gs_before,
                                             num_steps=n))
-                    out = trainer.run_steps(feed, k=n) if n > 1 \
-                        else trainer.step(feed)
+                    out = trainer.run_steps(feed, k=n, span=span) if n > 1 \
+                        else trainer.step(feed, span=span)
                     steps_in_epoch += n
                     if event_handler:
                         event_handler(Event("end_step", epoch,
                                             trainer.global_step, out,
                                             num_steps=n))
+                    # interval profile events: same chunk-boundary
+                    # rounding as checkpoints, same report path as
+                    # end_epoch (host accumulators only, no host sync)
+                    pi = profile_interval_steps
+                    if pi and event_handler and \
+                            trainer.global_step // pi > gs_before // pi:
+                        profile = trainer.profile_report()
+                        event_handler(Event("profile", epoch,
+                                            trainer.global_step,
+                                            num_steps=n,
+                                            pipeline=profile["pipeline"],
+                                            profile=profile))
                     # chunk-boundary rounding: save whenever this dispatch
                     # crossed a step_interval multiple (== the exact-multiple
                     # check when n == 1)
@@ -1444,6 +1633,20 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
                     save(f"step_{trainer.global_step}", epoch,
                          steps_in_epoch)
                 _io.wait_for_checkpoints()
+                # journal + flight-record the preemption AFTER the
+                # boundary save so the dump's ring contains the
+                # ckpt.save event (and any guard incidents drained
+                # above) — the black box explains the exit
+                signum = getattr(ph, "signum", None)
+                trainer.journal.emit("fit.preempted", epoch=epoch,
+                                     global_step=trainer.global_step,
+                                     signum=signum)
+                get_registry().counter(
+                    "paddle_tpu_trainer_preemptions_total",
+                    "SIGTERM/SIGINT preemptions handled by fit").inc()
+                flight_dump("preempted",
+                            detail={"global_step": trainer.global_step,
+                                    "epoch": epoch, "signum": signum})
                 if event_handler:
                     # ONE profile snapshot: Event.pipeline aliases its
                     # pipeline section, so handlers comparing the two

@@ -52,6 +52,16 @@
 //   SAVE                            -> OK | ERR (atomic snapshot to path)
 //   STATUS                          -> OK params=N pushes=M
 //   QUIT                            -> closes the connection
+//
+// Optional trace field: a client may append " trace=<id>" (no
+// whitespace in <id>) to a PULL/PUSH/PUSHQ/PUSHROWS header line. The
+// field rides AFTER the positionally-parsed tokens, so an old server's
+// sscanf ignores it (and an old client simply never sends it); this
+// server echoes it at the end of the OK reply line ("OK <v>
+// trace=<id>"), which old clients in turn ignore (they read reply
+// fields positionally). The id is the telemetry span minted at the
+// trainer's step, so a slow or lost exchange is attributable to a
+// specific worker step against a specific pserver.
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -439,6 +449,19 @@ bool ReadBody(int fd, size_t len, std::string* body) {
   return len == 0 || ReadExact(fd, &(*body)[0], len);
 }
 
+// Echo a request header's optional " trace=<id>" token at the end of an
+// OK reply line (see the protocol note above). ERR replies are left
+// untouched — their text is part of the error contract.
+std::string WithTrace(std::string resp, const std::string& line) {
+  size_t pos = line.rfind(" trace=");
+  if (pos == std::string::npos || resp.rfind("OK", 0) != 0) return resp;
+  std::string tok = line.substr(pos + 1);
+  size_t sp = tok.find_first_of(" \t");
+  if (sp != std::string::npos) tok.resize(sp);
+  if (!resp.empty() && resp.back() == '\n') resp.pop_back();
+  return resp + " " + tok + "\n";
+}
+
 void ServeClient(PServer* ps, int fd) {
   std::string line;
   while (ReadLine(fd, &line)) {
@@ -450,17 +473,17 @@ void ServeClient(PServer* ps, int fd) {
       if (!ReadBody(fd, a, &body)) break;
       resp = ps->Init(name, body);
     } else if (sscanf(line.c_str(), "PULL %lld %255s", &a, name) == 2) {
-      resp = ps->Pull(int(a), name, &payload);
+      resp = WithTrace(ps->Pull(int(a), name, &payload), line);
     } else if (sscanf(line.c_str(), "PUSH %lld %255s %lld", &a, name, &b) == 3) {
       std::string body;
       if (!ReadBody(fd, b, &body)) break;
-      resp = ps->Push(int(a), name, body);
+      resp = WithTrace(ps->Push(int(a), name, body), line);
     } else if (float scale = 0.f;
                sscanf(line.c_str(), "PUSHQ %lld %255s %lld %f",
                       &a, name, &b, &scale) == 4) {
       std::string body;
       if (b < 0 || !ReadBody(fd, size_t(b), &body)) break;
-      resp = ps->PushQuantized(int(a), name, b, scale, body);
+      resp = WithTrace(ps->PushQuantized(int(a), name, b, scale, body), line);
     } else if (sscanf(line.c_str(), "PUSHROWS %lld %255s %lld %lld",
                       &a, name, &b, &c) == 4) {
       // reject before the size_t casts: a huge b or c would wrap the
@@ -474,7 +497,7 @@ void ServeClient(PServer* ps, int fd) {
       std::string ids, vals;
       if (!ReadBody(fd, size_t(b) * sizeof(int32_t), &ids)) break;
       if (!ReadBody(fd, size_t(b) * size_t(c) * sizeof(float), &vals)) break;
-      resp = ps->PushRows(name, b, c, ids, vals);
+      resp = WithTrace(ps->PushRows(name, b, c, ids, vals), line);
     } else if (sscanf(line.c_str(), "EXPORT %255s", name) == 1) {
       resp = ps->Export(name, &payload);
     } else if (sscanf(line.c_str(), "DELETE %255s", name) == 1) {

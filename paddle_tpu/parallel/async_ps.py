@@ -129,6 +129,18 @@ class PSClient:
         self.retry_backoff = retry_backoff
         self.retry_backoff_max = retry_backoff_max
         self._sock: Optional[socket.socket] = None
+        # resilience counters (surfaced by report(), not bare pokes):
+        # connects counts every successful TCP establish (reconnects =
+        # connects - 1), retry_attempts every request re-issued after a
+        # transport failure, pushes_undelivered the at-most-once pushes
+        # whose reply was lost (never resent)
+        self.requests_sent = 0
+        self.retry_attempts = 0
+        self.connects = 0
+        self.pushes_sent = 0
+        self.pulls = 0
+        self.pushes_undelivered = 0
+        self.last_reply: Optional[str] = None
         self._connect()  # fail fast on misconfigured addr
 
     # -- transport ----------------------------------------------------------
@@ -136,6 +148,7 @@ class PSClient:
         s = socket.create_connection(self.addr, timeout=self.timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = s
+        self.connects += 1
 
     def _drop_sock(self):
         if self._sock is not None:
@@ -173,7 +186,9 @@ class PSClient:
         ``(resp, body)``."""
         delay = self.retry_backoff
         last_err: Optional[Exception] = None
-        for _ in range(self.retries):
+        for attempt in range(self.retries):
+            if attempt:
+                self.retry_attempts += 1
             try:
                 if self._sock is None:
                     self._connect()
@@ -186,7 +201,9 @@ class PSClient:
             try:
                 self._sock.sendall(line.encode() + b"\n" + payload)
                 sent = True
+                self.requests_sent += 1
                 resp = self._readline()
+                self.last_reply = resp
                 if resp.startswith("ERR"):
                     raise RuntimeError(f"pserver: {resp}")
                 if body_len is None:
@@ -196,6 +213,7 @@ class PSClient:
                 self._drop_sock()
                 last_err = e
                 if sent and not idempotent:
+                    self.pushes_undelivered += 1
                     raise PushUndelivered(
                         f"push reply lost after send ({e}); NOT resending — "
                         "the update may have applied server-side") from e
@@ -232,21 +250,42 @@ class PSClient:
         resp = self._request(f"INIT {self._check_name(name)} {len(data)}", data)
         return resp == "OK NEW"
 
-    def pull(self, name: str, shape, dtype=np.float32) -> np.ndarray:
+    @staticmethod
+    def _trace_suffix(span: Optional[str]) -> str:
+        """Optional trace field in the framed header: `` trace=<id>``
+        appended AFTER the fields a peer parses positionally. An OLD
+        peer's ``sscanf`` stops at its last conversion and ignores
+        trailing tokens — fully backward/forward compatible; a NEW
+        pserver echoes the token in its reply so the round trip is
+        attributable to the specific server (see ``last_reply``)."""
+        if span is None:
+            return ""
+        enforce(not any(c.isspace() for c in span),
+                f"trace span must not contain whitespace: {span!r}")
+        return f" trace={span}"
+
+    def pull(self, name: str, shape, dtype=np.float32,
+             span: Optional[str] = None) -> np.ndarray:
         _, data = self._request(
-            f"PULL {self.trainer_id} {self._check_name(name)}",
+            f"PULL {self.trainer_id} {self._check_name(name)}"
+            f"{self._trace_suffix(span)}",
             body_len=lambda resp: int(resp.split()[1]))
+        self.pulls += 1
         arr = np.frombuffer(data, dtype=np.float32)
         return arr.reshape(shape).astype(dtype, copy=False)
 
-    def push(self, name: str, grad: np.ndarray) -> int:
+    def push(self, name: str, grad: np.ndarray,
+             span: Optional[str] = None) -> int:
         data = np.ascontiguousarray(grad, dtype=np.float32).tobytes()
         resp = self._request(
-            f"PUSH {self.trainer_id} {self._check_name(name)} {len(data)}",
+            f"PUSH {self.trainer_id} {self._check_name(name)} {len(data)}"
+            f"{self._trace_suffix(span)}",
             data, idempotent=False)
+        self.pushes_sent += 1
         return int(resp.split()[1])
 
-    def push_quantized(self, name: str, grad: np.ndarray) -> int:
+    def push_quantized(self, name: str, grad: np.ndarray,
+                       span: Optional[str] = None) -> int:
         """Int8-quantized dense push (abs-max symmetric, one f32 scale):
         4× less wire than :meth:`push`, dequantized server-side before
         the identical update path — the quantized-collective technique
@@ -256,11 +295,14 @@ class PSClient:
         q = np.clip(np.round(g / scale * 127.0), -127, 127).astype(np.int8)
         resp = self._request(
             f"PUSHQ {self.trainer_id} {self._check_name(name)} {q.size} "
-            f"{scale!r}", q.tobytes(), idempotent=False)
+            f"{scale!r}{self._trace_suffix(span)}", q.tobytes(),
+            idempotent=False)
+        self.pushes_sent += 1
         return int(resp.split()[1])
 
     def push_rows(self, name: str, row_ids: np.ndarray,
-                  row_grads: np.ndarray) -> int:
+                  row_grads: np.ndarray,
+                  span: Optional[str] = None) -> int:
         """Sparse push: ``row_grads[k]`` updates row ``row_ids[k]`` of the
         [rows, dim] param — SelectedRows send + pserver row-optimize."""
         ids = np.ascontiguousarray(row_ids, dtype=np.int32)
@@ -269,9 +311,26 @@ class PSClient:
                 "push_rows wants ids [n] and grads [n, dim]")
         resp = self._request(
             f"PUSHROWS {self.trainer_id} {self._check_name(name)} "
-            f"{vals.shape[0]} {vals.shape[1]}",
+            f"{vals.shape[0]} {vals.shape[1]}{self._trace_suffix(span)}",
             ids.tobytes() + vals.tobytes(), idempotent=False)
+        self.pushes_sent += 1
         return int(resp.split()[1])
+
+    def report(self) -> Dict[str, Any]:
+        """Client-side resilience/traffic counters (the typed surface
+        tests and bench read instead of poking private attributes):
+        requests/pushes/pulls sent, reconnects (successful re-
+        establishes after the first connect), retry attempts, and
+        at-most-once pushes whose reply was lost."""
+        return {
+            "addr": f"{self.addr[0]}:{self.addr[1]}",
+            "requests": self.requests_sent,
+            "pushes": self.pushes_sent,
+            "pulls": self.pulls,
+            "reconnects": max(0, self.connects - 1),
+            "retries": self.retry_attempts,
+            "pushes_undelivered": self.pushes_undelivered,
+        }
 
     def save(self) -> None:
         """Trigger an atomic server-side checkpoint of params + optimizer
@@ -384,6 +443,10 @@ class PSShardGroup:
         self._clients: Dict[Tuple[str, int], PSClient] = {}
         self.addrs: List[Tuple[str, int]] = []
         self._names: set = set()
+        # counters of transports CLOSED by resize()/rebind(): folded
+        # into report() so the aggregate totals stay monotonic across
+        # membership changes (a Prometheus counter must never reverse)
+        self._retired_counts: Dict[str, int] = {}
         self._set_addrs(addrs)
 
     def _set_addrs(self, addrs) -> None:
@@ -407,18 +470,24 @@ class PSShardGroup:
         self._names.add(name)
         return self._client(self.owner(name)).init_param(name, value)
 
-    def pull(self, name: str, shape, dtype=np.float32) -> np.ndarray:
-        return self._client(self.owner(name)).pull(name, shape, dtype=dtype)
+    def pull(self, name: str, shape, dtype=np.float32,
+             span: Optional[str] = None) -> np.ndarray:
+        return self._client(self.owner(name)).pull(name, shape, dtype=dtype,
+                                                   span=span)
 
-    def push(self, name: str, grad: np.ndarray) -> int:
-        return self._client(self.owner(name)).push(name, grad)
+    def push(self, name: str, grad: np.ndarray,
+             span: Optional[str] = None) -> int:
+        return self._client(self.owner(name)).push(name, grad, span=span)
 
-    def push_quantized(self, name: str, grad: np.ndarray) -> int:
-        return self._client(self.owner(name)).push_quantized(name, grad)
+    def push_quantized(self, name: str, grad: np.ndarray,
+                       span: Optional[str] = None) -> int:
+        return self._client(self.owner(name)).push_quantized(name, grad,
+                                                             span=span)
 
-    def push_rows(self, name: str, row_ids, row_grads) -> int:
+    def push_rows(self, name: str, row_ids, row_grads,
+                  span: Optional[str] = None) -> int:
         return self._client(self.owner(name)).push_rows(name, row_ids,
-                                                        row_grads)
+                                                        row_grads, span=span)
 
     def save(self) -> None:
         for addr in self.addrs:
@@ -432,9 +501,38 @@ class PSShardGroup:
                 out[k] = out.get(k, 0) + v
         return out
 
+    _AGG_KEYS = ("requests", "pushes", "pulls", "reconnects", "retries",
+                 "pushes_undelivered")
+
+    def _retire_client(self, client: PSClient) -> None:
+        """Fold a departing transport's counters into the retired
+        aggregate BEFORE closing it — totals must stay monotonic
+        across resize()/rebind() (their traffic happened)."""
+        rep = client.report()
+        for k in self._AGG_KEYS:
+            self._retired_counts[k] = self._retired_counts.get(k, 0) + rep[k]
+        client.close()
+
+    def report(self) -> Dict[str, Any]:
+        """Client-side counters: aggregate totals over every transport
+        this group has opened — servers that left the membership
+        included (their traffic is folded into the totals at
+        retirement, so the aggregate never goes backwards) — plus the
+        per-server breakdown of the LIVE transports keyed by
+        ``host:port``."""
+        servers = {f"{a[0]}:{a[1]}": c.report()
+                   for a, c in sorted(self._clients.items())}
+        agg: Dict[str, Any] = {k: self._retired_counts.get(k, 0)
+                               for k in self._AGG_KEYS}
+        for rep in servers.values():
+            for k in self._AGG_KEYS:
+                agg[k] += rep[k]
+        agg["servers"] = servers
+        return agg
+
     def close(self) -> None:
         for c in self._clients.values():
-            c.close()
+            self._retire_client(c)
         self._clients.clear()
 
     # -- membership change --------------------------------------------------
@@ -490,7 +588,7 @@ class PSShardGroup:
                                   "on %s (%s)", name, addr, e)
         # drop transports to servers that left the membership
         for addr in [a for a in self._clients if a not in self.addrs]:
-            self._clients.pop(addr).close()
+            self._retire_client(self._clients.pop(addr))
         _ps_log().info("resharded %d param(s) onto %d server(s)",
                        len(moves), len(new))
         return moves
@@ -500,7 +598,7 @@ class PSShardGroup:
         already migrated: route-only, no data movement."""
         self._set_addrs(new_addrs)
         for addr in [a for a in self._clients if a not in self.addrs]:
-            self._clients.pop(addr).close()
+            self._retire_client(self._clients.pop(addr))
 
 
 def _ps_log():
@@ -537,6 +635,45 @@ def _make_ps_client(addr, trainer_id: int):
     return PSClient(tuple(seq), trainer_id=trainer_id)
 
 
+def _register_ps_telemetry(trainer: "AsyncPSTrainer") -> int:
+    """Register the async-PS trainer's scrape-time collector: the
+    client transport counters (push/pull/reconnect/retry/undelivered)
+    plus the trainer's ``pushes_lost`` and step gauge, all read from
+    :meth:`AsyncPSTrainer.report`'s store at scrape time. Weakly bound
+    to the trainer (the registry hands the live trainer back at
+    scrape time)."""
+    from ..telemetry import get_registry
+    from ..telemetry.registry import counter_family, gauge_family
+
+    def collect(tr):
+        rep = tr.report()
+        cli = rep["client"]
+        labels = {"inst": tr.telemetry_inst}
+        return [
+            gauge_family("paddle_tpu_ps_trainer_step",
+                         "Async-PS trainer global step",
+                         [(labels, rep["global_step"])]),
+            counter_family(
+                "paddle_tpu_ps_pushes_lost_total",
+                "At-most-once pushes dropped after a lost reply",
+                [(labels, rep["pushes_lost"])]),
+            counter_family("paddle_tpu_ps_pushes_total",
+                           "Gradient pushes sent to pservers",
+                           [(labels, cli["pushes"])]),
+            counter_family("paddle_tpu_ps_pulls_total",
+                           "Parameter pulls from pservers",
+                           [(labels, cli["pulls"])]),
+            counter_family("paddle_tpu_ps_reconnects_total",
+                           "Transport re-establishes after the first "
+                           "connect", [(labels, cli["reconnects"])]),
+            counter_family("paddle_tpu_ps_retries_total",
+                           "Requests re-issued after a transport failure",
+                           [(labels, cli["retries"])]),
+        ]
+
+    return get_registry().add_collector(collect, owner=trainer)
+
+
 class AsyncPSTrainer:
     """Barrier-free trainer: jitted local gradients, server-side updates.
 
@@ -569,6 +706,13 @@ class AsyncPSTrainer:
         self.state = None
         self.global_step = 0
         self.pushes_lost = 0  # at-most-once pushes whose reply was lost
+        # unified telemetry: a per-step span rides the wire protocol's
+        # optional trace field (old pservers ignore it), and the
+        # client/trainer counters publish into the process registry
+        # through one scrape-time collector (see report())
+        from ..telemetry import get_journal, get_registry
+        self.journal = get_journal()
+        self.telemetry_inst = get_registry().next_instance("ps_trainer")
 
         def grad_step(params, state, rng, feed):
             def loss_fn(p, st, r, f):
@@ -586,6 +730,8 @@ class AsyncPSTrainer:
             return grads, out, new_state
 
         self._grad_fn = jax.jit(grad_step)
+        # registered last: a scrape must never see a half-built trainer
+        self._telemetry_cid = _register_ps_telemetry(self)
 
     # ------------------------------------------------------------------
     def startup(self, rng=None, sample_feed: Optional[Dict[str, Any]] = None):
@@ -604,12 +750,13 @@ class AsyncPSTrainer:
         self.params = self._pull_into(params)
         return self.params
 
-    def _pull_into(self, params):
+    def _pull_into(self, params, span: Optional[str] = None):
         import jax
 
         leaves = _named_leaves(params)
         pulled = [self.client.pull(n, np.shape(l),
-                                   dtype=getattr(l, "dtype", np.float32))
+                                   dtype=getattr(l, "dtype", np.float32),
+                                   span=span)
                   for n, l in leaves]
         treedef = jax.tree_util.tree_structure(params)
         return jax.tree_util.tree_unflatten(treedef, pulled)
@@ -622,22 +769,54 @@ class AsyncPSTrainer:
         if rng is None:
             rng = jax.random.fold_in(
                 jax.random.PRNGKey(get_flag("seed") + 1), self.global_step)
+        # one span per optimizer step: every pull/push of this step
+        # carries it on the wire (optional trace field in the framed
+        # header — a new pserver echoes it, an old one ignores it), so
+        # a slow or lost exchange is attributable to THIS step on THIS
+        # worker against a specific pserver
+        span = self.journal.new_span()
         if self.global_step % self.pull_interval == 0:
-            self.params = self._pull_into(self.params)
+            self.params = self._pull_into(self.params, span=span)
         grads, out, self.state = self._grad_fn(self.params, self.state, rng, feed)
         send = (self.client.push_quantized if self.compress_grads
                 else self.client.push)
         for name, leaf in _named_leaves(jax.device_get(grads)):
             try:
-                send(name, leaf)
+                send(name, leaf, span=span)
             except PushUndelivered as e:
                 # at-most-once: the grad is dropped, never resent (a
                 # resend could double-apply) — one stale step, the
                 # trade async-SGD already makes for stragglers
                 self.pushes_lost += 1
+                self.journal.emit(
+                    "ps.push_lost", span=span, inst=self.telemetry_inst,
+                    param=name, step=self.global_step,
+                    server=self._owner_str(name))
                 import logging
                 logging.getLogger("paddle_tpu.async_ps").warning(
                     "dropped push of %s at step %d (%s); continuing",
                     name, self.global_step, e)
+        self.journal.emit("ps.step", span=span, inst=self.telemetry_inst,
+                          step=self.global_step)
         self.global_step += 1
         return out
+
+    def _owner_str(self, name: str) -> Optional[str]:
+        owner = getattr(self.client, "owner", None)
+        if owner is None:
+            a = getattr(self.client, "addr", None)
+            return f"{a[0]}:{a[1]}" if a else None
+        a = owner(name)
+        return f"{a[0]}:{a[1]}"
+
+    def report(self) -> Dict[str, Any]:
+        """Trainer + transport resilience counters in one dict (the
+        typed surface replacing bare-attribute pokes): ``pushes_lost``
+        (at-most-once pushes this trainer dropped), ``global_step``,
+        and the :meth:`PSClient.report`/:meth:`PSShardGroup.report`
+        counters under ``client``."""
+        return {
+            "global_step": self.global_step,
+            "pushes_lost": self.pushes_lost,
+            "client": self.client.report(),
+        }

@@ -341,8 +341,9 @@ def restore_latest(root: str, trainer, elastic: bool = False,
         try:
             try:
                 _io.load_trainer(info.path, trainer)
-            except ReshardError:
+            except ReshardError as re_err:
                 if not elastic:
+                    _flight_reshard(re_err)
                     raise
                 rep = reshard_restore(info.path, trainer,
                                       sample_feed=sample_feed)
@@ -364,6 +365,22 @@ def restore_latest(root: str, trainer, elastic: bool = False,
 
 
 # -- elastic resharding -------------------------------------------------------
+
+
+def _flight_reshard(err: "ReshardError") -> None:
+    """Journal + flight-dump a ReshardError about to unwind: a run
+    refusing to come back up is exactly when an operator needs the
+    black box (what the run restored from, what mesh it wanted)."""
+    from .telemetry import flight_dump, get_journal
+
+    get_journal().emit("ckpt.reshard_error", path=err.path,
+                       saved_axes=err.saved_axes,
+                       target_axes=err.target_axes,
+                       reason=str(err.reason)[:500])
+    flight_dump("reshard_error",
+                detail={"path": err.path, "saved_axes": err.saved_axes,
+                        "target_axes": err.target_axes,
+                        "reason": str(err.reason)[:500]})
 
 
 def normalize_mesh_axes(axes: Optional[Dict[str, Any]]) -> Dict[str, int]:
@@ -432,9 +449,15 @@ def reshard_restore(checkpoint_dir: str, trainer,
         sample_feed=sample_feed)
     infeasible = report.by_code("ckpt:reshard-infeasible")
     if infeasible:
-        raise ReshardError(checkpoint_dir, saved_axes, target_axes,
+        err = ReshardError(checkpoint_dir, saved_axes, target_axes,
                            infeasible[0].message)
+        _flight_reshard(err)
+        raise err
     _io.load_trainer(checkpoint_dir, trainer, allow_reshard=True)
+    from .telemetry import get_registry
+    get_registry().counter(
+        "paddle_tpu_resilience_reshards_total",
+        "Elastic checkpoint restores onto a different mesh").inc()
     bytes_moved = sum(int(spec.get("size", 0))
                       for spec in ((man or {}).get("files") or {}).values())
     return {
@@ -644,6 +667,11 @@ def record_incident(incidents: List[Incident], step: int,
     if len(incidents) > MAX_INCIDENT_LOG:
         del incidents[:len(incidents) - MAX_INCIDENT_LOG]
     _log().warning("guard: discarded %s", inc)
+    # journal the incident so a flight dump taken later (escalation,
+    # preemption, watchdog) names the non-finite steps that led up
+    from .telemetry import get_journal
+    get_journal().emit("guard.incident", step=step,
+                       outputs=list(outputs), feed_digest=digest)
     return inc
 
 

@@ -168,16 +168,33 @@ class BreakerPolicy:
 
 
 class CircuitBreaker:
-    """closed → open → half_open → closed state machine (thread-safe)."""
+    """closed → open → half_open → closed state machine (thread-safe).
 
-    def __init__(self, policy: Optional[BreakerPolicy] = None):
+    ``on_trip(reason)`` fires AFTER the lock is released whenever the
+    breaker (re)opens — reasons ``"failures"`` (threshold trip),
+    ``"hang"`` (watchdog :meth:`trip`), ``"probe_failure"`` (half-open
+    probe failed). The server uses it to journal the state change and
+    flight-record the trip; a raising callback is swallowed (telemetry
+    must never wedge the breaker)."""
+
+    def __init__(self, policy: Optional[BreakerPolicy] = None,
+                 on_trip: Optional[Callable[[str], Any]] = None):
         self.policy = policy or BreakerPolicy()
+        self.on_trip = on_trip
         self._lock = threading.Lock()
         self._state = "closed"
         self._consecutive = 0
         self._open_until = 0.0
         self._probe_out = False
         self.trips = 0
+
+    def _fire_on_trip(self, reason: str) -> None:
+        if self.on_trip is None:
+            return
+        try:
+            self.on_trip(reason)
+        except Exception:
+            pass
 
     @property
     def state(self) -> str:
@@ -214,6 +231,7 @@ class CircuitBreaker:
                 self._probe_out = False
 
     def record(self, token: Optional[str], success: bool) -> None:
+        fire = None
         with self._lock:
             if success:
                 self._consecutive = 0
@@ -226,19 +244,24 @@ class CircuitBreaker:
                     self._state = "closed"
                     self._probe_out = False
                 return
-            if token == "probe" or self._state == "half_open":
+            elif token == "probe" or self._state == "half_open":
                 self._reopen()
-                return
-            self._consecutive += 1
-            if self._state == "closed" and \
-                    self._consecutive >= self.policy.failure_threshold:
-                self._trip()
+                fire = "probe_failure"
+            else:
+                self._consecutive += 1
+                if self._state == "closed" and \
+                        self._consecutive >= self.policy.failure_threshold:
+                    self._trip()
+                    fire = "failures"
+        if fire:
+            self._fire_on_trip(fire)
 
     def trip(self) -> None:
         """Force the breaker open (the watchdog's hung-dispatch path —
         one hang is conclusive, no threshold)."""
         with self._lock:
             self._trip()
+        self._fire_on_trip("hang")
 
     def _trip(self):
         self._state = "open"
@@ -295,7 +318,64 @@ class ServingMetrics:
                 "mean": _ms(h.sum_s / h.total if h.total else None),
                 "count": h.total,
             }
+            # the raw histogram (bucket upper bounds in SECONDS +
+            # per-bucket counts, one overflow bucket past the last
+            # bound): the Prometheus exporter emits a real _bucket
+            # series from this instead of re-deriving from percentiles
+            out["latency_hist"] = {
+                "bounds_s": list(_HIST_BOUNDS),
+                "counts": list(h.counts),
+                "sum_s": h.sum_s,
+                "count": h.total,
+            }
             return out
+
+    def telemetry_families(self, inst: str = "0") -> list:
+        """The same counters + histogram as registry metric families
+        (``paddle_tpu_serving_*``) — called by the PredictorServer's
+        scrape-time collector, so the exported series agree with
+        :meth:`report` by construction."""
+        from .telemetry.registry import counter_family, histogram_family
+
+        snap = self.snapshot()
+        labels = {"inst": inst}
+        fams = [
+            counter_family("paddle_tpu_serving_submitted_total",
+                           "Requests accepted into the queue",
+                           [(labels, snap["submitted"])]),
+            counter_family("paddle_tpu_serving_completed_total",
+                           "Requests completed successfully",
+                           [(labels, snap["completed"])]),
+            counter_family(
+                "paddle_tpu_serving_rejected_total",
+                "Requests rejected at submit (by reason)",
+                [({**labels, "reason": r}, snap[f"rejected_{r}"])
+                 for r in ("invalid", "overload", "breaker")]),
+            counter_family("paddle_tpu_serving_timeouts_total",
+                           "Requests dropped at their deadline",
+                           [(labels, snap["timeouts"])]),
+            counter_family("paddle_tpu_serving_errors_total",
+                           "Requests failed by an executable error",
+                           [(labels, snap["errors"])]),
+            counter_family("paddle_tpu_serving_hangs_total",
+                           "Dispatches abandoned by the watchdog",
+                           [(labels, snap["hangs"])]),
+            counter_family("paddle_tpu_serving_workers_replaced_total",
+                           "Workers replaced after a watchdog hang",
+                           [(labels, snap["workers_replaced"])]),
+            counter_family(
+                "paddle_tpu_serving_reloads_total",
+                "Hot-reload attempts (by outcome)",
+                [({**labels, "outcome": "ok"}, snap["reloads"]),
+                 ({**labels, "outcome": "failed"},
+                  snap["reload_failures"])]),
+        ]
+        h = snap["latency_hist"]
+        fams.append(histogram_family(
+            "paddle_tpu_serving_latency_seconds",
+            "End-to-end served latency (queue wait included)",
+            labels, h["bounds_s"], h["counts"], h["sum_s"], h["count"]))
+        return fams
 
 
 def _ms(seconds: Optional[float]) -> Optional[float]:
@@ -307,14 +387,15 @@ def _ms(seconds: Optional[float]) -> Optional[float]:
 
 class _Request:
     __slots__ = ("feed", "n", "bucket", "deadline", "token", "done",
-                 "value", "error", "submitted", "completed")
+                 "value", "error", "submitted", "completed", "span")
 
-    def __init__(self, feed, n, bucket, deadline, token):
+    def __init__(self, feed, n, bucket, deadline, token, span=None):
         self.feed = feed
         self.n = n
         self.bucket = bucket
         self.deadline = deadline      # absolute monotonic, or None
         self.token = token            # breaker admission token
+        self.span = span              # trace id minted at submit
         self.done = threading.Event()
         self.value = None
         self.error: Optional[BaseException] = None
@@ -327,6 +408,13 @@ class PendingResult:
 
     def __init__(self, req: _Request):
         self._req = req
+
+    @property
+    def span(self) -> Optional[str]:
+        """The request's trace id (minted at submit): every journal
+        event of its lifecycle — submit, worker dispatch, completion,
+        a watchdog hang — carries it."""
+        return self._req.span
 
     def done(self) -> bool:
         return self._req.done.is_set()
@@ -418,7 +506,14 @@ class PredictorServer:
         self._queue: _queue.Queue = _queue.Queue(maxsize=self.queue_size)
         self._complete_lock = threading.Lock()
         self.metrics = ServingMetrics()
-        self.breaker = CircuitBreaker(breaker)
+        # unified telemetry: journal spans per request, a scrape-time
+        # collector in the process registry (the `inst` label keeps
+        # replicas apart), flight dumps on hangs/breaker trips
+        from .telemetry import get_journal, get_registry
+        self.journal = get_journal()
+        self.telemetry_inst = get_registry().next_instance("serving")
+        self._telemetry_server = None
+        self.breaker = CircuitBreaker(breaker, on_trip=self._on_breaker_trip)
         self._workers: List[_Worker] = []
         self._watchdog: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -426,6 +521,8 @@ class PredictorServer:
         self._state_lock = threading.Lock()
         self._started_at = time.monotonic()
         self._pinned_compiles: Optional[int] = None
+        # registered last: a scrape must never see a half-built server
+        self._telemetry_cid = _register_server_telemetry(self)
         if start:
             self.start()
 
@@ -518,6 +615,13 @@ class PredictorServer:
             self._watchdog.join(timeout=5.0)
         with self._state_lock:
             self._state = "stopped"
+        if self._telemetry_server is not None:
+            self._telemetry_server.close()
+            self._telemetry_server = None
+        # a closed server must not keep exporting live-looking queue/
+        # worker gauges for as long as a caller holds a reference
+        from .telemetry import get_registry
+        get_registry().remove_collector(self._telemetry_cid)
 
     def __enter__(self) -> "PredictorServer":
         return self
@@ -540,9 +644,15 @@ class PredictorServer:
             raise ServerClosed(f"server is {state}")
         if state == "starting":
             raise ServerClosed("server not started (call start())")
+        # the request's trace id is minted HERE, at submit: every
+        # journal event of its life (queue, worker dispatch, outcome,
+        # a watchdog hang) carries it — PendingResult.span exposes it
+        span = self.journal.new_span()
         token = self.breaker.acquire()
         if token is None:
             self.metrics.bump("rejected_breaker")
+            self.journal.emit("serving.reject", span=span,
+                              inst=self.telemetry_inst, reason="breaker")
             raise CircuitOpen(self.breaker.retry_after())
         try:
             with self._model_lock:
@@ -550,9 +660,12 @@ class PredictorServer:
             n, bucket = predictor.validate_feed(feed, allow_padding=True)
             if self.reject_nonfinite:
                 _check_finite(feed, predictor.feed_names)
-        except InvalidRequest:
+        except InvalidRequest as e:
             self.breaker.cancel(token)
             self.metrics.bump("rejected_invalid")
+            self.journal.emit("serving.reject", span=span,
+                              inst=self.telemetry_inst, reason="invalid",
+                              field=getattr(e, "field", None))
             raise
         except BaseException:
             # validation can also raise raw numpy errors (e.g. a ragged
@@ -564,7 +677,15 @@ class PredictorServer:
         rel = self.default_deadline if deadline is None else deadline
         req = _Request(feed, n, bucket,
                        None if rel is None else time.monotonic() + rel,
-                       token)
+                       token, span=span)
+        # journaled BEFORE the enqueue: a fast worker can dequeue and
+        # emit serving.dispatch microseconds after put_nowait, and the
+        # span's timeline must never read dispatch-before-submit (an
+        # overload reject after this event is an accurate submit→reject
+        # record of the attempt)
+        self.journal.emit("serving.submit", span=span,
+                          inst=self.telemetry_inst, n=n, bucket=bucket,
+                          deadline_s=rel, queue_depth=self._queue.qsize())
         # state re-check + enqueue are ATOMIC under the state lock:
         # close() flips the state under the same lock before draining,
         # so a request can never slip into the queue after the drain
@@ -578,6 +699,10 @@ class PredictorServer:
             except _queue.Full:
                 self.breaker.cancel(token)
                 self.metrics.bump("rejected_overload")
+                self.journal.emit("serving.reject", span=span,
+                                  inst=self.telemetry_inst,
+                                  reason="overload",
+                                  queue_depth=self._queue.qsize())
                 raise ServerOverloaded(self._queue.qsize(),
                                        self.queue_size) from None
         self.metrics.bump("submitted")
@@ -617,6 +742,9 @@ class PredictorServer:
                 # half_open rejecting everything forever
                 self.breaker.cancel(req.token)
                 self.metrics.bump("timeouts")
+                self.journal.emit("serving.expired", span=req.span,
+                                  inst=self.telemetry_inst,
+                                  late_s=round(now - req.deadline, 6))
                 self._complete(req, error=DeadlineExceeded(
                     f"deadline passed {now - req.deadline:.3f}s before "
                     "dispatch"))
@@ -625,11 +753,18 @@ class PredictorServer:
                 # tripped while this request sat queued: fail fast, do
                 # not run the broken executable again
                 self.metrics.bump("rejected_breaker")
+                self.journal.emit("serving.reject", span=req.span,
+                                  inst=self.telemetry_inst,
+                                  reason="breaker_queued")
                 self._complete(req, error=CircuitOpen(
                     self.breaker.retry_after()))
                 continue
             w.request = req
             w.busy_since = now
+            self.journal.emit("serving.dispatch", span=req.span,
+                              inst=self.telemetry_inst, worker=w.index,
+                              n=req.n, bucket=req.bucket,
+                              queued_s=round(now - req.submitted, 6))
             try:
                 with self._model_lock:
                     pred, gen_now = self._predictor, self._generation
@@ -650,13 +785,21 @@ class PredictorServer:
                     self.breaker.record(req.token, success=False)
                 if first:
                     self.metrics.bump("errors")
+                    self.journal.emit(
+                        "serving.error", span=req.span,
+                        inst=self.telemetry_inst, worker=w.index,
+                        error=f"{type(e).__name__}: {e}"[:300])
             else:
                 if not w.abandoned:
                     self.breaker.record(req.token, success=True)
                 if self._complete(req, value=out):
+                    latency = time.monotonic() - req.submitted
                     self.metrics.bump("completed")
-                    self.metrics.record_latency(
-                        time.monotonic() - req.submitted)
+                    self.metrics.record_latency(latency)
+                    self.journal.emit("serving.complete", span=req.span,
+                                      inst=self.telemetry_inst,
+                                      worker=w.index,
+                                      latency_s=round(latency, 6))
             finally:
                 w.busy_since = None
                 w.request = None
@@ -705,7 +848,21 @@ class PredictorServer:
                 req = w.request
                 w.abandoned = True
                 self.metrics.bump("hangs")
+                span = req.span if req is not None else None
+                # the hang event goes into the ring BEFORE the breaker
+                # trips, so both this dump and the trip's are complete
+                self.journal.emit("serving.hang", span=span,
+                                  inst=self.telemetry_inst,
+                                  worker=w.index,
+                                  busy_s=round(now - busy, 6))
                 self.breaker.trip()
+                from .telemetry import flight_dump
+                flight_dump("worker_hung", span=span,
+                            detail={"worker": w.index,
+                                    "busy_s": round(now - busy, 6),
+                                    "watchdog_timeout":
+                                        self.watchdog_timeout,
+                                    "inst": self.telemetry_inst})
                 _log().error(
                     "worker %d hung for %.2fs (watchdog_timeout=%.2fs): "
                     "breaker tripped, worker abandoned + replaced",
@@ -716,6 +873,21 @@ class PredictorServer:
                         "watchdog timeout"))
                 self.metrics.bump("workers_replaced")
                 self._spawn_worker(len(self._workers))
+
+    def _on_breaker_trip(self, reason: str) -> None:
+        """Breaker (re)open: journal it and flight-record the recent
+        ring. The watchdog's ``hang`` path already dumped WITH the
+        hung request's span — don't double-dump for the same event;
+        ``probe_failure`` re-opens are journal-only (the original trip
+        dumped)."""
+        self.journal.emit("serving.breaker_open", inst=self.telemetry_inst,
+                          reason=reason, trips=self.breaker.trips)
+        if reason == "failures":
+            from .telemetry import flight_dump
+            flight_dump("breaker_trip",
+                        detail={"reason": reason,
+                                "trips": self.breaker.trips,
+                                "inst": self.telemetry_inst})
 
     # -- hot reload ----------------------------------------------------------
 
@@ -817,6 +989,9 @@ class PredictorServer:
             except BaseException as e:
                 self._last_reload_error = e
                 self.metrics.bump("reload_failures")
+                self.journal.emit("serving.reload", inst=self.telemetry_inst,
+                                  dirname=dirname, ok=False,
+                                  error=f"{type(e).__name__}: {e}"[:300])
                 # the rejected candidate's AOT compiles happened OFF the
                 # request path: re-pin so the compiles_since_warmup
                 # contract signal doesn't read as a (false) request-path
@@ -831,6 +1006,9 @@ class PredictorServer:
             self._last_reload_error = None
             self._pinned_compiles = self._io.aot_compile_count()
             self.metrics.bump("reloads")
+            self.journal.emit("serving.reload", inst=self.telemetry_inst,
+                              dirname=dirname, ok=True,
+                              generation=self._generation)
             _log().info("hot reload: now serving %s (generation %d)",
                         dirname, self._generation)
 
@@ -878,6 +1056,14 @@ class PredictorServer:
         the outcome channel for ``reload(..., block=False)`` callers."""
         return self._last_reload_error
 
+    def _alive_workers(self) -> List[_Worker]:
+        """THE worker-liveness definition — shared by :meth:`health`
+        and the registry collector so ``/healthz`` and the
+        ``paddle_tpu_serving_workers*`` gauges can never drift."""
+        return [w for w in self._workers
+                if not w.abandoned and w.thread is not None
+                and w.thread.is_alive()]
+
     def health(self) -> Dict[str, Any]:
         """Readiness/liveness state machine: ``live`` (the process can
         still make progress — workers exist and the runtime is not
@@ -896,9 +1082,7 @@ class PredictorServer:
                 state = "half_open"
             elif self._queue.full():
                 state = "overloaded"
-        alive = [w for w in self._workers
-                 if not w.abandoned and w.thread is not None
-                 and w.thread.is_alive()]
+        alive = self._alive_workers()
         return {
             "live": state not in ("stopped",) and bool(alive),
             "ready": state in ("ready", "overloaded", "half_open"),
@@ -911,6 +1095,22 @@ class PredictorServer:
             "breaker": self.breaker.state,
             "uptime_s": round(time.monotonic() - self._started_at, 3),
         }
+
+    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
+        """Opt-in scrape endpoint: start the stdlib ``GET /metrics``
+        (Prometheus text of the process registry — this server's
+        series carry its ``inst`` label) + ``GET /healthz`` (this
+        server's :meth:`health`; 503 once not live) server. Port 0
+        picks a free port (``.port``); :meth:`close` stops it. The
+        same :class:`~paddle_tpu.telemetry.TelemetryServer` backs
+        ``Trainer.serve_metrics`` — one scraper config covers the
+        trainer and the serving fleet."""
+        from .telemetry import serve_metrics as _serve
+
+        if self._telemetry_server is None:
+            self._telemetry_server = _serve(health_fn=self.health,
+                                            port=port, host=host)
+        return self._telemetry_server
 
     def report(self) -> Dict[str, Any]:
         """Metrics + health in one dict (the serving mirror of
@@ -933,6 +1133,56 @@ class PredictorServer:
 
 
 # -- helpers ------------------------------------------------------------------
+
+
+def _register_server_telemetry(server: PredictorServer) -> int:
+    """Register the server's scrape-time collector in the process
+    registry: every ``ServingMetrics`` counter + the latency histogram
+    (same store ``report()`` reads, so the series can never disagree),
+    plus live queue-depth/capacity/worker gauges and breaker state.
+    Weakly bound — a collected server's series drop out, and
+    :meth:`PredictorServer.close` removes the collector eagerly so a
+    stopped-but-referenced server stops exporting live-looking
+    gauges."""
+    from .telemetry import get_registry
+    from .telemetry.registry import counter_family, gauge_family
+
+    def collect(srv):
+        inst = srv.telemetry_inst
+        labels = {"inst": inst}
+        fams = srv.metrics.telemetry_families(inst)
+        alive = srv._alive_workers()
+        bstate = srv.breaker.state
+        fams.extend([
+            gauge_family("paddle_tpu_serving_queue_depth",
+                         "Requests currently queued",
+                         [(labels, srv._queue.qsize())]),
+            gauge_family("paddle_tpu_serving_queue_capacity",
+                         "Bounded queue capacity",
+                         [(labels, srv.queue_size)]),
+            gauge_family("paddle_tpu_serving_workers",
+                         "Live (non-abandoned) workers",
+                         [(labels, len(alive))]),
+            gauge_family("paddle_tpu_serving_workers_busy",
+                         "Workers currently executing a dispatch",
+                         [(labels, sum(1 for w in alive
+                                       if w.busy_since is not None))]),
+            gauge_family("paddle_tpu_serving_breaker_open",
+                         "1 while the circuit breaker is open",
+                         [(labels, 1 if bstate == "open" else 0)]),
+            gauge_family("paddle_tpu_serving_breaker_half_open",
+                         "1 while the breaker awaits its half-open probe",
+                         [(labels, 1 if bstate == "half_open" else 0)]),
+            counter_family("paddle_tpu_serving_breaker_trips_total",
+                           "Circuit-breaker trips",
+                           [(labels, srv.breaker.trips)]),
+            gauge_family("paddle_tpu_serving_generation",
+                         "Served-model generation (bumps on hot reload)",
+                         [(labels, srv.generation)]),
+        ])
+        return fams
+
+    return get_registry().add_collector(collect, owner=server)
 
 
 def _block_on(out) -> None:

@@ -1,0 +1,48 @@
+"""paddle_tpu.telemetry — unified process telemetry.
+
+Before this package every subsystem reported into its own ad-hoc dict
+(``PipelineMetrics.report()``, ``ServingMetrics.report()``,
+``trainer.profile_report()``, bare ``pushes_lost`` attributes) with no
+common export format, no cross-component correlation, and nothing
+captured at the moment of a crash. Telemetry is the one surface an
+operator points Prometheus (and post-mortem tooling) at:
+
+- :mod:`registry` — the process-wide **metrics registry** (counters,
+  gauges, log-bucket histograms with labels; scrape-time collectors
+  for zero hot-path cost) that Trainer/feeder/guard/checkpoint,
+  async-PS client/server counters, and serving queue/latency/breaker
+  state all publish into, under the
+  ``paddle_tpu_<subsystem>_<name>{labels}`` naming convention, with
+  Prometheus-text and JSON exporters.
+- :mod:`journal` — the **structured run journal**: a JSONL event
+  stream with a run id and monotonic per-event sequence; span ids
+  minted at submit/dispatch time correlate feeder fill, fused-dispatch
+  chunks, serving worker execution, and async-PS pushes end to end.
+- :mod:`recorder` — the **flight recorder**: the journal's bounded
+  ring flushed to disk (atomic, CRC-manifested like checkpoints) on
+  guard escalation, watchdog ``WorkerHung``, breaker trips, SIGTERM
+  preemption, ``ReshardError``, and unhandled ``fit`` exceptions;
+  rendered by ``tools/flight_dump.py``.
+- :mod:`http` — the opt-in stdlib-only ``GET /metrics`` +
+  ``GET /healthz`` endpoint both ``Trainer.serve_metrics()`` and
+  ``PredictorServer.serve_metrics()`` expose.
+
+See MIGRATION.md "Telemetry" for the metric name table, journal event
+schema, and flight-recorder trigger/dump format.
+"""
+
+from .journal import RunJournal, get_journal, new_run_id, set_journal
+from .recorder import (FlightRecorder, default_flight_dir, flight_dump,
+                       get_recorder)
+from .registry import (Counter, Gauge, Histogram, MetricFamily,
+                       MetricsRegistry, counter_deltas, counter_family,
+                       gauge_family, get_registry, histogram_family)
+from .http import TelemetryServer, serve_metrics
+
+__all__ = [
+    "Counter", "FlightRecorder", "Gauge", "Histogram", "MetricFamily",
+    "MetricsRegistry", "RunJournal", "TelemetryServer", "counter_deltas",
+    "counter_family", "default_flight_dir", "flight_dump", "gauge_family",
+    "get_journal", "get_recorder", "get_registry", "histogram_family",
+    "new_run_id", "serve_metrics", "set_journal",
+]

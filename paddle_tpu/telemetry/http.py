@@ -1,0 +1,109 @@
+"""Opt-in stdlib-only HTTP endpoint: ``GET /metrics`` (Prometheus text
+exposition of the registry) + ``GET /healthz`` (JSON readiness).
+
+One :class:`TelemetryServer` serves both a :class:`~paddle_tpu.
+telemetry.registry.MetricsRegistry` and a ``health_fn`` — the SAME
+class backs ``Trainer.serve_metrics()`` and
+``PredictorServer.serve_metrics()``, so a trainer worker and a serving
+replica look identical to the scraper. ``/healthz`` returns 200 while
+``health_fn()["live"]`` is truthy (or absent) and 503 otherwise — the
+shape fleet load-balancer probes expect. No third-party dependency:
+``http.server.ThreadingHTTPServer`` on a daemon thread, port 0 picks a
+free port (``.port`` reports it).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+from .registry import MetricsRegistry, get_registry
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class TelemetryServer:
+    """``/metrics`` + ``/healthz`` over a registry (daemon thread)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 port: int = 0, host: str = "127.0.0.1"):
+        self.registry = registry if registry is not None else get_registry()
+        self.health_fn = health_fn
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib handler name)
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    try:
+                        body = outer.registry.render_prometheus().encode()
+                        self._reply(200, PROMETHEUS_CONTENT_TYPE, body)
+                    except Exception as e:
+                        self._reply(500, "text/plain; charset=utf-8",
+                                    f"scrape failed: {e}\n".encode())
+                elif path == "/healthz":
+                    try:
+                        health = (outer.health_fn() if outer.health_fn
+                                  else {"live": True})
+                        code = 200 if health.get("live", True) else 503
+                        self._reply(code, "application/json",
+                                    json.dumps(health, sort_keys=True,
+                                               default=repr).encode())
+                    except Exception as e:
+                        self._reply(503, "application/json",
+                                    json.dumps({"live": False,
+                                                "error": repr(e)}).encode())
+                else:
+                    self._reply(404, "text/plain; charset=utf-8",
+                                b"only /metrics and /healthz live here\n")
+
+            def _reply(self, code: int, ctype: str, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # scrapes must not spam stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="pdtpu-telemetry-http")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "TelemetryServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def serve_metrics(registry: Optional[MetricsRegistry] = None,
+                  health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                  port: int = 0, host: str = "127.0.0.1") -> TelemetryServer:
+    """Start a :class:`TelemetryServer`; port 0 picks a free port."""
+    return TelemetryServer(registry=registry, health_fn=health_fn,
+                           port=port, host=host)
+
+
+__all__ = ["PROMETHEUS_CONTENT_TYPE", "TelemetryServer", "serve_metrics"]

@@ -1,0 +1,209 @@
+"""Structured run journal: one correlated JSONL event stream per
+process.
+
+Every event carries the process ``run`` id, a monotonic ``seq``, a
+wall-clock ``t``, a ``kind`` (dotted ``subsystem.event``), and an
+optional ``span`` — the trace id minted at ``submit``/dispatch time
+and propagated through feeder fill, fused-dispatch chunks, serving
+worker execution, and the async-PS wire protocol, so one slow request
+or lost push is attributable end to end (``tools/flight_dump.py
+--span <id>`` renders exactly its lifecycle).
+
+The journal always retains a bounded ring of recent events — the
+flight recorder's buffer (:mod:`paddle_tpu.telemetry.recorder` flushes
+it to disk on crash-shaped triggers). A JSONL file sink is opt-in
+(:meth:`RunJournal.open`, or ``PDTPU_JOURNAL_PATH`` for the process
+default): the hot path then pays one ``json.dumps`` + buffered write
+per event, which is why dispatch-rate emitters stay ring-only by
+default.
+
+Emitting is cheap by construction (dict build + lock + deque append,
+no device interaction): the trainer emits once per DISPATCH (not per
+step), which keeps journal overhead inside the <2% K=16 budget the
+tests pin, with zero added device↔host syncs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+# ring capacity: enough context to explain the seconds before a crash
+# without a week-long fit growing memory (one event is ~200 bytes)
+DEFAULT_RING = 4096
+
+
+def new_run_id() -> str:
+    """Process run id: wall-clock prefix (sortable across a fleet's
+    dumps) + random suffix (unique across same-second restarts)."""
+    return time.strftime("%Y%m%dT%H%M%S") + "-" + secrets.token_hex(4)
+
+
+# span ids are minted on hot paths (one per dispatch chunk / serving
+# request); os.urandom per mint costs tens of µs on some kernels, so
+# spans are a per-process random prefix (urandom, once) + a counter —
+# unique within the process by construction, unique across a fleet's
+# processes by the 32-bit prefix
+_span_lock = threading.Lock()
+_span_prefix = secrets.token_hex(4)
+_span_counter = 0
+
+
+def _mint_span() -> str:
+    global _span_counter
+    with _span_lock:
+        _span_counter += 1
+        n = _span_counter
+    return f"{_span_prefix}{n & 0xFFFFFFFF:08x}"
+
+
+class RunJournal:
+    """Thread-safe correlated event stream (ring + optional sinks)."""
+
+    def __init__(self, run_id: Optional[str] = None,
+                 ring_size: int = DEFAULT_RING):
+        self.run_id = run_id or new_run_id()
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._ring: deque = deque(maxlen=ring_size)
+        self._files: List[Any] = []
+        self.dropped_sink_writes = 0
+
+    # -- spans -------------------------------------------------------------
+    @staticmethod
+    def new_span() -> str:
+        """Mint a trace/span id (16 hex chars): at ``submit`` for a
+        serving request, at chunk fill/dispatch for a training step,
+        at ``step`` for an async-PS push batch. Cheap by construction
+        (a counter under a process-random prefix, no urandom per
+        call) — minting rides hot paths."""
+        return _mint_span()
+
+    # -- sinks -------------------------------------------------------------
+    def open(self, path: str) -> "RunJournal":
+        """Attach a JSONL file sink (append mode, line-buffered via
+        explicit flush per event). Multiple sinks are allowed."""
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        f = open(path, "a", encoding="utf-8")
+        with self._lock:
+            self._files.append(f)
+        return self
+
+    def close(self) -> None:
+        with self._lock:
+            files, self._files = self._files, []
+        for f in files:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    # -- emission ----------------------------------------------------------
+    def emit(self, kind: str, span: Optional[str] = None,
+             **fields) -> Dict[str, Any]:
+        """Record one event; returns the event dict (already sequenced).
+        The sink write happens UNDER the journal lock: concurrent
+        emitters (serving workers, the watchdog, the feeder fill
+        thread, the training loop) must neither interleave bytes
+        mid-line nor land out of ``seq`` order in the JSONL file. A
+        failing file sink is counted, never raised — telemetry must
+        not take down the run it observes."""
+        with self._lock:
+            self._seq += 1
+            event: Dict[str, Any] = {"run": self.run_id, "seq": self._seq,
+                                     "t": time.time(), "kind": kind}
+            if span is not None:
+                event["span"] = span
+            event.update(fields)
+            self._ring.append(event)
+            if self._files:
+                try:
+                    line = json.dumps(event, sort_keys=True,
+                                      default=_json_default) + "\n"
+                except (TypeError, ValueError):
+                    line = json.dumps(
+                        {"run": self.run_id, "seq": event["seq"],
+                         "t": event["t"], "kind": kind,
+                         "unserializable": True}) + "\n"
+                for f in self._files:
+                    try:
+                        f.write(line)
+                        f.flush()
+                    except (OSError, ValueError):
+                        self.dropped_sink_writes += 1
+        return event
+
+    # -- reads -------------------------------------------------------------
+    def recent(self, n: Optional[int] = None,
+               kind: Optional[str] = None,
+               span: Optional[str] = None) -> List[Dict[str, Any]]:
+        """The retained ring (oldest first), optionally filtered by
+        ``kind`` prefix and/or ``span``."""
+        with self._lock:
+            events = list(self._ring)
+        if kind is not None:
+            events = [e for e in events if e["kind"].startswith(kind)]
+        if span is not None:
+            events = [e for e in events if e.get("span") == span]
+        if n is not None:
+            events = events[-n:]
+        return events
+
+    @property
+    def seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+
+def _json_default(o):
+    try:
+        import numpy as np
+        if isinstance(o, np.generic):
+            return o.item()
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+    except ImportError:
+        pass
+    return repr(o)
+
+
+# -- the process-wide default journal -----------------------------------------
+
+_default_lock = threading.Lock()
+_default_journal: Optional[RunJournal] = None
+
+
+def get_journal() -> RunJournal:
+    """THE process journal (created on first use; honors
+    ``PDTPU_JOURNAL_PATH`` as an initial JSONL sink)."""
+    global _default_journal
+    with _default_lock:
+        if _default_journal is None:
+            j = RunJournal()
+            path = os.environ.get("PDTPU_JOURNAL_PATH")
+            if path:
+                try:
+                    j.open(path)
+                except OSError:
+                    pass  # an unwritable sink must not break startup
+            _default_journal = j
+        return _default_journal
+
+
+def set_journal(journal: Optional[RunJournal]) -> Optional[RunJournal]:
+    """Swap the process journal (tests; returns the previous one)."""
+    global _default_journal
+    with _default_lock:
+        old, _default_journal = _default_journal, journal
+        return old
+
+
+__all__ = ["DEFAULT_RING", "RunJournal", "get_journal", "new_run_id",
+           "set_journal"]

@@ -164,6 +164,42 @@ def test_train_rows_carry_top_fusions(monkeypatch):
     assert "top_fusions" not in row and "top_fusions_error" not in row
 
 
+def test_train_rows_carry_telemetry_snapshot():
+    """Every train row records the measured window's registry counter
+    deltas per step under `telemetry` (what _time_trainer snapshots
+    around the pipelined loop); a trainer without a measured window
+    (stubbed/infer paths) records none — never a crash."""
+
+    class _T:
+        feed_wire = None
+        _bench_telemetry = {
+            'paddle_tpu_trainer_steps_total{inst="0"}': 1.0,
+            'paddle_tpu_feeder_h2d_bytes_total{inst="0"}': 25088.0,
+        }
+
+    row = bench._result(8, "samples/sec", 1e-3, 1e-3, 1e6, 1e12,
+                        trainer=_T())
+    assert row["telemetry"] == _T._bench_telemetry
+
+    class _Bare:
+        feed_wire = None
+
+    row = bench._result(8, "samples/sec", 1e-3, 1e-3, 1e6, 1e12,
+                        trainer=_Bare())
+    assert "telemetry" not in row and row["value"] > 0
+
+
+def test_telemetry_counter_deltas_math():
+    """counter_deltas is the snapshot's whole math: only moved series,
+    normalized by the measured step/request count."""
+    from paddle_tpu.telemetry import counter_deltas
+
+    before = {"a": 10.0, "b": 5.0}
+    after = {"a": 26.0, "b": 5.0, "c": 4.0}
+    assert counter_deltas(before, after, per=8) == {"a": 2.0, "c": 0.5}
+    assert counter_deltas(before, after) == {"a": 16.0, "c": 4.0}
+
+
 def test_serving_row_schema(monkeypatch):
     """The serving row (PredictorServer steady p50/p99 + saturated
     reject rate, fp32 vs int8) pins its schema: downstream readers
@@ -189,9 +225,15 @@ def test_serving_row_schema(monkeypatch):
     row = bench.bench_serving(1.0, batch_size=8, requests=20, workers=2,
                               queue_size=4)
     for key in ("value", "unit", "latency_ms", "reject_rate_saturated",
-                "offered_rps", "requests", "workers", "queue_size",
-                "batch_size"):
+                "offered_rps", "telemetry", "requests", "workers",
+                "queue_size", "batch_size"):
         assert key in row, key
+    # the telemetry snapshot is per-variant: steady-phase registry
+    # counter deltas per offered request (dict of series -> delta)
+    assert set(row["telemetry"]) == {"fp32", "int8"}
+    for tel in row["telemetry"].values():
+        assert isinstance(tel, dict)
+        assert all(isinstance(v, float) for v in tel.values())
     assert set(row["latency_ms"]) == {"fp32", "int8"}
     for v in row["latency_ms"].values():
         assert set(v) == {"p50", "p99"}

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Pretty-print / filter a paddle_tpu flight-recorder dump.
+
+A dump directory (written by ``paddle_tpu.telemetry.FlightRecorder`` on
+guard escalation, watchdog hangs, breaker trips, preemption,
+ReshardError, or an unhandled fit exception) holds ``events.jsonl``
+(the journal's recent-event ring), ``flight.json`` (trigger, span,
+registry snapshot), and a CRC ``manifest.json``.
+
+    python tools/flight_dump.py <dump-dir>            # full timeline
+    python tools/flight_dump.py <dump-dir> --span ID  # one request/step
+    python tools/flight_dump.py <dump-dir> --kind serving.
+    python tools/flight_dump.py <dump-dir> --last 50
+    python tools/flight_dump.py <dump-dir> --no-validate   # skip CRC
+    python tools/flight_dump.py <dir> --json          # raw events out
+
+Exit codes: 0 rendered, 2 unreadable/corrupt dump, 3 internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from paddle_tpu import resilience  # noqa: E402
+from paddle_tpu.telemetry.recorder import EVENTS_NAME, META_NAME  # noqa: E402
+
+# event fields already rendered in the fixed columns
+_CORE = ("run", "seq", "t", "kind", "span")
+
+
+def load_dump(path: str, validate: bool = True):
+    """(meta, events) of a dump dir — or an events.jsonl given
+    directly (meta then None). Raises CheckpointCorrupt/OSError/
+    ValueError on an unreadable or CRC-failing dump."""
+    if os.path.isfile(path):
+        return None, _read_events(path)
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"{path}: no such dump")
+    if validate:
+        resilience.validate_checkpoint(path)  # CRC over events + meta
+    meta = None
+    mpath = os.path.join(path, META_NAME)
+    if os.path.exists(mpath):
+        with open(mpath, encoding="utf-8") as f:
+            meta = json.load(f)
+    return meta, _read_events(os.path.join(path, EVENTS_NAME))
+
+
+def _read_events(path: str):
+    events = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError as e:
+                raise ValueError(f"{path}:{i + 1}: bad JSONL line: {e}")
+    return events
+
+
+def filter_events(events, span=None, kind=None, last=None):
+    if span:
+        events = [e for e in events if e.get("span") == span]
+    if kind:
+        events = [e for e in events if str(e.get("kind", "")
+                                           ).startswith(kind)]
+    if last:
+        events = events[-last:]
+    return events
+
+
+def render(meta, events, out=sys.stdout):
+    if meta:
+        out.write(f"flight dump: trigger={meta.get('trigger')!r} "
+                  f"run={meta.get('run')} "
+                  f"events={meta.get('num_events')}"
+                  + (f" span={meta['span']}" if meta.get("span") else "")
+                  + "\n")
+        detail = meta.get("detail") or {}
+        if detail:
+            out.write("  detail: " + json.dumps(detail, sort_keys=True)
+                      + "\n")
+    if not events:
+        out.write("(no events match)\n")
+        return
+    t0 = events[0].get("t", 0.0)
+    out.write(f"{'seq':>7} {'+sec':>9} {'span':<16} {'kind':<22} fields\n")
+    for e in events:
+        extra = {k: v for k, v in e.items() if k not in _CORE}
+        out.write(f"{e.get('seq', '?'):>7} "
+                  f"{e.get('t', t0) - t0:>9.3f} "
+                  f"{(e.get('span') or '-'):<16} "
+                  f"{e.get('kind', '?'):<22} "
+                  + json.dumps(extra, sort_keys=True, default=repr)
+                  + "\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a paddle_tpu flight-recorder dump")
+    ap.add_argument("path", help="dump directory (or a bare events.jsonl)")
+    ap.add_argument("--span", help="only events of this span id")
+    ap.add_argument("--kind", help="only kinds with this prefix "
+                                   "(e.g. 'serving.' or 'guard.')")
+    ap.add_argument("--last", type=int, help="only the last N (after "
+                                             "filtering)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit filtered events as JSONL instead of a table")
+    ap.add_argument("--no-validate", action="store_true",
+                    help="skip the CRC manifest check")
+    args = ap.parse_args(argv)
+    try:
+        meta, events = load_dump(args.path, validate=not args.no_validate)
+    except (resilience.CheckpointCorrupt, OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    events = filter_events(events, span=args.span, kind=args.kind,
+                           last=args.last)
+    if args.json:
+        for e in events:
+            print(json.dumps(e, sort_keys=True, default=repr))
+    else:
+        render(meta, events)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
