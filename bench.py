@@ -934,6 +934,163 @@ def bench_serving(peak, batch_size=64, requests=240, workers=2,
     }
 
 
+def _fleet_artifact(batch_size):
+    """Export the MNIST MLP with bucket set {1, batch_size}; returns
+    (artifact dir, single-row feed). Untrained weights — the row
+    measures the fleet/batching runtime, not the model."""
+    import tempfile
+
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import io as pio
+    from paddle_tpu.models import mnist
+
+    prog = pt.build(mnist.mlp)
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.randn(batch_size, 784).astype(np.float32),
+            "label": rng.randint(0, 10, (batch_size, 1)).astype(np.int64)}
+    params, state = prog.init(jax.random.PRNGKey(0), **feed)
+    d = os.path.join(tempfile.mkdtemp(), "model")
+    pio.save_inference_model(d, prog, params, state, feed,
+                             batch_buckets=[1, batch_size])
+    feed1 = {k: np.asarray(v)[:1] for k, v in feed.items()}
+    return d, feed1
+
+
+def _make_fleet_front(dirname, variant, replicas, workers, queue_size,
+                      max_wait_ms):
+    """One serving front per variant: ``single`` = one PredictorServer
+    holding ALL the workers (the pre-fleet deployment), ``fleet`` = a
+    FleetRouter over ``replicas`` pad-alone servers, ``fleet_coalesced``
+    = the same fleet with continuous batching on. Total worker count
+    AND aggregate queue capacity are identical across variants (the
+    single front gets replicas x queue_size) — the deltas isolate the
+    runtime, not the parallelism or the queueing headroom."""
+    from paddle_tpu import io as pio, serving
+    from paddle_tpu.fleet import BatchPolicy, FleetRouter
+
+    if variant == "single":
+        return serving.PredictorServer(pio.load_inference_model(dirname),
+                                       workers=replicas * workers,
+                                       queue_size=replicas * queue_size)
+    policy = (BatchPolicy(max_wait_ms=max_wait_ms)
+              if variant == "fleet_coalesced" else None)
+    return FleetRouter.spawn(dirname, replicas=replicas, workers=workers,
+                             queue_size=queue_size, batch_policy=policy)
+
+
+def _drive_fleet(front, feed, n, rate):
+    """Open-loop driver at fixed offered ``rate`` req/s (rejects don't
+    slow the arrival process). Returns (latencies of completed requests
+    in seconds, rejected count, elapsed seconds submit-to-last-
+    result)."""
+    from paddle_tpu import serving
+
+    pending, rejected = [], 0
+    interval = 1.0 / rate
+    t0 = time.perf_counter()
+    next_t = t0
+    for _ in range(n):
+        now = time.perf_counter()
+        if now < next_t:
+            time.sleep(next_t - now)
+        next_t += interval
+        try:
+            pending.append(front.submit(feed))
+        except (serving.ServerOverloaded, serving.CircuitOpen,
+                serving.ServingError):
+            rejected += 1
+    lats = []
+    for p in pending:
+        try:
+            p.result(timeout=120)
+            lats.append(p.latency)
+        except serving.ServingError:
+            rejected += 1
+    return lats, rejected, time.perf_counter() - t0
+
+
+def bench_serving_fleet(peak, batch_size=8, requests=240, replicas=3,
+                        workers=1, queue_size=32, max_wait_ms=2.0):
+    """Fleet suite row: p99 + per-worker throughput at 3x measured
+    saturation for three fronts over the SAME artifact and total
+    worker count — one big PredictorServer (``single``), a FleetRouter
+    over N pad-alone replicas (``fleet``), and the same fleet with
+    continuous batching (``fleet_coalesced``) — plus the two deltas
+    the ROADMAP item asks for: fleet-vs-single-process and
+    coalesced-vs-pad-alone. Traffic is single-row requests (the
+    coalescable worst case for pad-alone: every dispatch is 7/8 pad
+    rows at bucket 8). ``value`` is the coalesced p99 in ms; the
+    offered rate is 3x the single front's measured capacity for every
+    variant, so the deltas compare like with like."""
+    from paddle_tpu.telemetry import counter_deltas, get_registry
+
+    dirname, feed1 = _fleet_artifact(batch_size)
+    total_workers = replicas * workers
+    latency = {}
+    throughput_per_worker = {}
+    reject_rate = {}
+    telemetry = {}
+    sat_rate = None
+    for variant in ("single", "fleet", "fleet_coalesced"):
+        front = _make_fleet_front(dirname, variant, replicas, workers,
+                                  queue_size, max_wait_ms)
+        try:
+            if sat_rate is None:   # calibrate ONCE (on the single front)
+                svc = _calibrate_serving(front, feed1)
+                sat_rate = 3.0 * total_workers / max(svc, 1e-9)
+            tel0 = get_registry().counter_values()
+            lats, rejected, elapsed = _drive_fleet(front, feed1, requests,
+                                                   sat_rate)
+            telemetry[variant] = counter_deltas(
+                tel0, get_registry().counter_values(), per=requests)
+        finally:
+            front.close(drain=True, timeout=120)
+        lat = np.array(lats) if lats else np.array([0.0])
+        latency[variant] = {
+            "p50": round(float(np.percentile(lat, 50)) * 1e3, 4),
+            "p99": round(float(np.percentile(lat, 99)) * 1e3, 4),
+        }
+        throughput_per_worker[variant] = round(
+            len(lats) / max(elapsed, 1e-9) / total_workers, 2)
+        reject_rate[variant] = round(rejected / requests, 4)
+    deltas = {
+        "fleet_vs_single": {
+            "p99_ms": round(latency["fleet"]["p99"]
+                            - latency["single"]["p99"], 4),
+            "throughput_per_worker_ratio": round(
+                throughput_per_worker["fleet"]
+                / max(throughput_per_worker["single"], 1e-9), 4),
+        },
+        "coalesced_vs_pad_alone": {
+            "p99_ms": round(latency["fleet_coalesced"]["p99"]
+                            - latency["fleet"]["p99"], 4),
+            "throughput_per_worker_ratio": round(
+                throughput_per_worker["fleet_coalesced"]
+                / max(throughput_per_worker["fleet"], 1e-9), 4),
+        },
+    }
+    return {
+        "value": latency["fleet_coalesced"]["p99"],
+        "unit": f"ms p99 coalesced-fleet served latency ({replicas}x"
+                f"{workers} workers, single-row requests, 3x saturation "
+                "offered load)",
+        "latency_ms": latency,
+        "throughput_per_worker_rps": throughput_per_worker,
+        "reject_rate": reject_rate,
+        "deltas": deltas,
+        "telemetry": telemetry,
+        "offered_rps": round(sat_rate, 2),
+        "requests": requests,
+        "replicas": replicas,
+        "workers": workers,
+        "queue_size": queue_size,
+        "batch_size": batch_size,
+        "max_wait_ms": max_wait_ms,
+    }
+
+
 def bench_fusion_profile(peak, batch_size=16, seq=128, iters=8, top_k=8):
     """Observability suite row: the fusion-aware profiler pointed at a
     transformer train step. A short pipelined window (host feeds through
@@ -1257,7 +1414,8 @@ def _suite_names():
 
     names = [*TRAIN_CONFIGS, *INFER_CONFIGS, "gpt_decode",
              "dispatch_overhead", "guard_overhead", "input_pipeline",
-             "serving", "fusion_profile", "elastic_reshard"]
+             "serving", "serving_fleet", "fusion_profile",
+             "elastic_reshard"]
     # the BASELINE five first, then the reference's headline serving
     # rows, then gpt — a driver that kills the suite early (the partial
     # SIGTERM record) still captures the configs that matter most
@@ -1319,6 +1477,10 @@ def _run_one(name: str, peak: float, quick: bool = False, batch_size=None):
         if quick:
             kw.update(requests=40)
         return bench_serving(peak, **kw)
+    if name == "serving_fleet":
+        if quick:
+            kw.update(requests=60, replicas=2)
+        return bench_serving_fleet(peak, **kw)
     if name == "fusion_profile":
         if quick:
             kw.update(iters=2, batch_size=4, seq=64)

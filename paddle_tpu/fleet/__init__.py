@@ -1,0 +1,48 @@
+"""paddle_tpu.fleet — continuous batching + the replicated serving
+fleet.
+
+The paper's production story is a *fleet* of processes behind a
+dispatch layer; ``PredictorServer`` (PR 5) is one process padding
+every request alone. This package is the next tier:
+
+- :mod:`batching` — **continuous batching**: coalesce queued requests
+  into the largest precompiled bucket within a latency budget
+  (:class:`BatchPolicy`), per-request row spans slicing outputs back
+  per caller, bit-identical to pad-alone with zero new compiles.
+  Wired into ``PredictorServer(batch_policy=...)``.
+- :mod:`router` — :class:`FleetRouter`: N ``PredictorServer`` replicas
+  (spawned in-process from an artifact, or adopted) behind
+  health-aware least-loaded routing with shared shed/deadline policy
+  at the front door, retry-on-replica-death for never-dispatched
+  requests (at-most-once for dispatched ones, mirroring ``PSClient``
+  push semantics), rolling hot reload (canary one replica, fan out,
+  roll back on failure), and an aggregated ``/metrics`` endpoint
+  merging every replica's series under a ``replica`` label.
+- :mod:`decode` — the decode-side serving workload: batched
+  incremental decoding with the int8 KV cache served through the
+  batching scheduler.
+
+Drills: ``tools/fleet_drill.py`` (kill/hang/reload over a local fleet,
+exit 0/2). See MIGRATION.md "Serving fleet & continuous batching".
+"""
+
+from .batching import BatchPolicy
+
+_ROUTER_NAMES = ("FleetRouter", "FleetPending", "NoReplicaAvailable")
+_DECODE_NAMES = ("export_decoder", "decode_server")
+
+
+def __getattr__(name):
+    # router/decode import serving (which imports batching above):
+    # resolving them lazily keeps the package importable from
+    # serving.py without a cycle
+    if name in _ROUTER_NAMES:
+        from . import router
+        return getattr(router, name)
+    if name in _DECODE_NAMES:
+        from . import decode
+        return getattr(decode, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["BatchPolicy", *_ROUTER_NAMES, *_DECODE_NAMES]

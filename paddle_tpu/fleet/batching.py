@@ -1,0 +1,137 @@
+"""Continuous-batching primitives: the pure planning half of the
+serving scheduler.
+
+``PredictorServer`` pads every request up to a precompiled bucket and
+dispatches it ALONE — at high single-request QPS most of every
+executable launch is pad rows. Continuous batching coalesces queued
+requests into ONE dispatch of the largest precompiled bucket that fits
+within a latency budget (:class:`BatchPolicy`), amortizing the fixed
+per-dispatch cost (host→device puts, executable launch, output sync)
+across real rows instead of zeros. Like the XLA fusion work this
+framework leans on, the win is amortization of fixed overhead over
+coalesced work — and because only the SAME precompiled bucket set is
+ever dispatched, it costs zero new compiles (the
+``compiles_since_warmup == 0`` serving contract holds unchanged).
+
+This module is the pure, lock-free planning layer — bucket selection,
+feed merging, per-request row spans, output re-slicing — driven by the
+worker loop in :mod:`paddle_tpu.serving` (which owns the queue, the
+deadlines, and the breaker). Correctness contract: a coalesced
+request's sliced output is **bit-identical** to the same request run
+pad-alone through ``Predictor.run`` into the bucket the scheduler
+dispatched — the SAME precompiled executable, the scheduler only ever
+changes which pad rows surround the request's rows (pinned in
+``tests/test_fleet.py``). Across *different* buckets results are
+numerically close but not bit-pinned (two buckets are two XLA
+executables — the PR-5 contract was likewise in-bucket).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchPolicy:
+    """Continuous-batching tuning for ``PredictorServer``.
+
+    ``max_wait_ms``: how long the scheduler may hold a dequeued request
+    past its submit time to gather more coalescable work. Already-queued
+    requests are taken for free (no added wait); the budget only bounds
+    *idle waiting* for requests that have not arrived yet, so a lone
+    request is dispatched at most ``max_wait_ms`` after submit and a
+    burst is dispatched immediately. The wait never extends past the
+    tightest deadline in the forming batch.
+
+    ``max_requests``: optional cap on requests per coalesced dispatch
+    (None = bounded only by the largest precompiled bucket).
+    """
+
+    max_wait_ms: float = 2.0
+    max_requests: Optional[int] = None
+
+
+def pick_bucket(total_rows: int, buckets: Sequence[int]) -> int:
+    """Smallest precompiled bucket holding ``total_rows`` (buckets
+    ascending; caller guarantees fit)."""
+    for b in buckets:
+        if b >= total_rows:
+            return int(b)
+    raise ValueError(f"{total_rows} rows exceed the largest bucket "
+                     f"(buckets: {list(buckets)})")
+
+
+def nonbatched_key(feed: Dict[str, Any], feed_names: Sequence[str],
+                   batched_feeds) -> Tuple[bytes, ...]:
+    """Byte-exact identity of a request's NON-batched feeds. Two
+    requests may only share a dispatch when these agree — a non-batched
+    feed has one value per dispatch, and silently preferring one
+    caller's value would corrupt the other's answer."""
+    return tuple(np.asarray(feed[k]).tobytes()
+                 for k in feed_names if k not in batched_feeds)
+
+
+def merge_feeds(requests, feed_names: Sequence[str], batched_feeds,
+                bucket: int) -> Dict[str, np.ndarray]:
+    """One padded bucket-sized feed from a compatible request group:
+    batched feeds are row-concatenated in group order and zero-padded
+    up to ``bucket`` (exactly the pad-alone padding, just with real
+    rows where zeros were); non-batched feeds take the first request's
+    value (the group is nonbatched_key-compatible by construction)."""
+    out: Dict[str, np.ndarray] = {}
+    total = sum(r.n for r in requests)
+    for k in feed_names:
+        if k not in batched_feeds:
+            out[k] = np.asarray(requests[0].feed[k])
+            continue
+        parts = [np.asarray(r.feed[k]) for r in requests]
+        if bucket > total:
+            parts.append(np.zeros((bucket - total,) + parts[0].shape[1:],
+                                  parts[0].dtype))
+        out[k] = parts[0] if len(parts) == 1 and bucket == total \
+            else np.concatenate(parts, axis=0)
+    return out
+
+
+def row_spans(requests) -> List[Tuple[int, int]]:
+    """[(row_offset, n), ...] of each request inside the merged batch,
+    in group order — the slice map that routes outputs back to their
+    callers."""
+    spans = []
+    off = 0
+    for r in requests:
+        spans.append((off, r.n))
+        off += r.n
+    return spans
+
+
+def slice_rows(out, offset: int, n: int, bucket: int):
+    """Slice one request's rows back out of a bucket-sized output
+    (arrays whose leading dim is not the bucket — losses, scalars —
+    are returned whole, same rule as the pad-alone slicer). Identity
+    when the request IS the whole bucket — preserving bit-identity
+    (and zero copies) with a bare ``Predictor.run``."""
+    if offset == 0 and n == bucket:
+        return out
+
+    def _one(v):
+        try:
+            if hasattr(v, "shape") and len(v.shape) >= 1 and \
+                    int(v.shape[0]) == bucket:
+                return v[offset:offset + n]
+        except TypeError:
+            pass
+        return v
+
+    if isinstance(out, dict):
+        return {k: _one(v) for k, v in out.items()}
+    if isinstance(out, (list, tuple)):
+        return type(out)(_one(v) for v in out)
+    return _one(out)
+
+
+__all__ = ["BatchPolicy", "merge_feeds", "nonbatched_key", "pick_bucket",
+           "row_spans", "slice_rows"]

@@ -1,0 +1,589 @@
+"""FleetRouter: N ``PredictorServer`` replicas behind one front door.
+
+The paper's production tier is a fleet of processes behind a dispatch
+layer; this is that layer for the serving side. The router owns three
+contracts a single replica cannot:
+
+- **Health-aware least-loaded routing** — every submit consults each
+  replica's ``health()`` (the same state machine ``/healthz`` serves):
+  not-ready replicas (breaker open, draining, dead) are skipped, and
+  among ready ones the lowest ``queue_depth + workers_busy`` wins.
+  Shed/deadline policy is shared at the front door: the router's
+  ``default_deadline`` applies fleet-wide, and when every replica
+  rejects, ONE typed error surfaces (:class:`~paddle_tpu.serving.
+  ServerOverloaded` if the fleet is saturated, :class:`~paddle_tpu.
+  serving.CircuitOpen` if every replica's breaker is open,
+  :class:`NoReplicaAvailable` otherwise).
+- **Retry-on-replica-death, at-most-once for dispatched work** — a
+  request that fails with :class:`~paddle_tpu.serving.ServerClosed`
+  was provably NEVER dispatched (the replica's queue/kill paths
+  guarantee it): :class:`FleetPending` transparently resubmits it to
+  another replica. A request that was dispatched when its replica died
+  surfaces :class:`~paddle_tpu.serving.ReplicaDied` exactly once and
+  is never retried — mirroring ``PSClient``'s idempotent-pull /
+  at-most-once-push split.
+- **Rolling hot reload** — :meth:`FleetRouter.reload` canaries ONE
+  replica first (its own golden-feed canary + static preflight), then
+  fans out; a canary failure touches nothing else, a mid-rollout
+  failure rolls the already-swapped replicas back to the previous
+  artifact. Zero dropped in-flight requests across all replicas (each
+  swap is the replica's own zero-drop reload).
+
+Observability: :meth:`FleetRouter.metrics_families` merges every
+replica's ``telemetry_families()`` under a ``replica`` label
+(:func:`paddle_tpu.telemetry.merge_exports`) plus the router's own
+``paddle_tpu_fleet_*`` series, and :meth:`FleetRouter.serve_metrics`
+exposes the merged export at one ``/metrics`` endpoint (Prometheus
+text, ``?format=json`` for JSON) with the fleet ``health()`` behind
+``/healthz``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..serving import (CircuitOpen, PendingResult, PredictorServer,
+                       ReloadFailed, ServerClosed, ServerOverloaded,
+                       ServingError)
+
+
+def _log():
+    import logging
+    return logging.getLogger("paddle_tpu.fleet")
+
+
+class NoReplicaAvailable(ServingError):
+    """No replica could accept the request (none ready, or every ready
+    replica rejected it with mixed reasons). Carries the per-replica
+    states for the reject reply."""
+
+    def __init__(self, states: Dict[str, str]):
+        super().__init__(f"no replica available: {states}")
+        self.states = dict(states)
+
+
+class _Replica:
+    __slots__ = ("name", "server")
+
+    def __init__(self, name: str, server: PredictorServer):
+        self.name = name
+        self.server = server
+
+
+class FleetPending:
+    """Front-door handle over a routed request. ``result()`` surfaces
+    the replica's typed outcome — except :class:`ServerClosed`, the
+    never-dispatched signal, which triggers a transparent reroute to
+    another replica (each replica tried at most once per request;
+    deadline budget carried across reroutes as an absolute point)."""
+
+    def __init__(self, router: "FleetRouter", feed: Dict[str, Any],
+                 replica: str, inner: PendingResult,
+                 abs_deadline: Optional[float]):
+        self._router = router
+        self._feed = feed
+        self._inner = inner
+        self._abs_deadline = abs_deadline
+        self.replica = replica          # current (latest) replica
+        self.tried = [replica]          # routing history
+
+    @property
+    def span(self) -> Optional[str]:
+        """The CURRENT attempt's trace id (a reroute mints a new span
+        on the new replica; ``tried`` still names every hop)."""
+        return self._inner.span
+
+    def done(self) -> bool:
+        return self._inner.done()
+
+    @property
+    def latency(self) -> Optional[float]:
+        return self._inner.latency
+
+    def result(self, timeout: Optional[float] = None):
+        # `timeout` bounds the WHOLE call, reroutes included — a
+        # replica death must not restart the caller's clock
+        bound = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if bound is not None:
+                timeout = max(0.0, bound - time.monotonic())
+            try:
+                return self._inner.result(timeout)
+            except (ServerClosed, CircuitOpen):
+                # never dispatched: both outcomes are only ever raised
+                # BEFORE a request reaches an executable (ServerClosed
+                # = the replica died/stopped with it queued, CircuitOpen
+                # = the breaker tripped while it sat queued), so a
+                # reroute cannot double-execute. At-most-once holds —
+                # a DISPATCHED request on a dead replica raises
+                # ReplicaDied, which this except does not catch.
+                rel = None
+                if self._abs_deadline is not None:
+                    rel = self._abs_deadline - time.monotonic()
+                replica, inner = self._router._route(
+                    self._feed, rel, exclude=set(self.tried),
+                    retry_of=self._inner.span)
+                self.replica = replica
+                self.tried.append(replica)
+                self._inner = inner
+
+
+class FleetRouter:
+    """Supervise N ``PredictorServer`` replicas behind health-aware
+    least-loaded routing (see the module docstring for the routing /
+    retry / reload contracts).
+
+    ``replicas``: dict ``{name: PredictorServer}`` (or a list, named
+    ``r0..rN-1``) to ADOPT existing servers, or use :meth:`spawn` to
+    build N replicas in-process from a ``save_inference_model``
+    artifact (one load, executables shared via ``Predictor.clone``).
+    ``dirname`` (remembered by :meth:`spawn`/:meth:`reload`) is the
+    currently-served artifact — the rollback target for a failed
+    rolling reload and the source for :meth:`replace`. ``server_kw``
+    is the ``PredictorServer`` kwargs a dirname-based :meth:`replace`
+    respawns with (``spawn`` records its own; an ADOPTED fleet that
+    wants dirname respawns must pass the kwargs its replicas were
+    built with, or the replacement would silently come up with default
+    workers/queue/no batch policy)."""
+
+    def __init__(self, replicas, default_deadline: Optional[float] = None,
+                 dirname: Optional[str] = None,
+                 server_kw: Optional[Dict[str, Any]] = None):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        if not isinstance(replicas, dict):
+            replicas = {f"r{i}": srv for i, srv in enumerate(replicas)}
+        self._replicas: Dict[str, _Replica] = {
+            name: _Replica(name, srv) for name, srv in replicas.items()}
+        self.default_deadline = default_deadline
+        self.dirname = dirname
+        self._server_kw: Dict[str, Any] = dict(server_kw or {})
+        self._lock = threading.Lock()
+        self._reload_lock = threading.Lock()
+        self._rr = 0                     # round-robin tie-breaker
+        self._counters: Dict[str, float] = {
+            "submitted": 0, "rerouted": 0, "shed": 0,
+            "replicas_replaced": 0, "reloads": 0, "reload_rollbacks": 0,
+            "reload_failures": 0}
+        self._routed: Dict[str, int] = {n: 0 for n in self._replicas}
+        self._telemetry_server = None
+        from ..telemetry import get_journal, get_registry
+        self.journal = get_journal()
+        self.telemetry_inst = get_registry().next_instance("fleet")
+        self._telemetry_cid = get_registry().add_collector(
+            FleetRouter._own_families, owner=self)
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def spawn(cls, dirname: str, replicas: int = 2,
+              default_deadline: Optional[float] = None,
+              **server_kw) -> "FleetRouter":
+        """Build an in-process fleet from one artifact: the model is
+        loaded (and AOT-compiled) ONCE, then each replica gets its own
+        ``PredictorServer`` over a ``Predictor.clone()`` — executables
+        and device weights shared, queues/workers/breakers per
+        replica. ``server_kw`` (workers, queue_size, batch_policy,
+        golden_feed, ...) applies to every replica."""
+        from ..io import load_inference_model
+
+        base = load_inference_model(dirname)
+        servers = {}
+        for i in range(int(replicas)):
+            servers[f"r{i}"] = PredictorServer(
+                base if i == 0 else base.clone(), **server_kw)
+        return cls(servers, default_deadline=default_deadline,
+                   dirname=dirname, server_kw=server_kw)
+
+    # -- replica access ------------------------------------------------------
+
+    @property
+    def replica_names(self) -> List[str]:
+        with self._lock:
+            return list(self._replicas)
+
+    def replica(self, name: str) -> PredictorServer:
+        with self._lock:
+            return self._replicas[name].server
+
+    def replace(self, name: str,
+                server: Optional[PredictorServer] = None) -> PredictorServer:
+        """Swap a (typically dead) replica for a fresh one: an explicit
+        ``server``, or one respawned from the fleet's current artifact
+        (``spawn``-built fleets). The old server is killed if still
+        live; routing picks the replacement up on the next submit —
+        the recovery half of the kill drill."""
+        if server is None:
+            if self.dirname is None:
+                raise ValueError(
+                    f"replace({name!r}) needs an explicit server for an "
+                    "adopted fleet (no artifact dirname on record)")
+            if not self._server_kw:
+                _log().warning(
+                    "replace(%r): no server_kw on record (adopted fleet) — "
+                    "the replacement comes up with PredictorServer "
+                    "defaults; pass server_kw to FleetRouter to respawn "
+                    "with the fleet's real config", name)
+            from ..io import load_inference_model
+            server = PredictorServer(load_inference_model(self.dirname),
+                                     **self._server_kw)
+        with self._lock:
+            old = self._replicas.get(name)
+            self._replicas[name] = _Replica(name, server)
+            self._routed.setdefault(name, 0)
+            self._counters["replicas_replaced"] += 1
+        if old is not None and old.server.health()["state"] != "stopped":
+            old.server.kill(reason=f"replaced by router ({name})")
+        # the replacement's artifact load moved the process-wide AOT
+        # counter: re-pin the SIBLINGS' compiles_since_warmup so the
+        # off-path load doesn't read as a request-path recompile
+        self._repin_all()
+        self.journal.emit("fleet.replace", inst=self.telemetry_inst,
+                          replica=name)
+        return server
+
+    def _repin_all(self) -> None:
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            try:
+                rep.server.repin_compiles()
+            except Exception:  # a dead replica has nothing to re-pin
+                pass
+
+    # -- request path --------------------------------------------------------
+
+    def submit(self, feed: Dict[str, Any],
+               deadline: Optional[float] = None) -> FleetPending:
+        """Route one request to the least-loaded ready replica.
+        ``deadline`` (seconds from now; falls back to the router's
+        ``default_deadline``) is the FLEET-WIDE budget — reroutes after
+        a replica death spend the same clock. Raises the front-door
+        shed error when no replica accepts."""
+        rel = self.default_deadline if deadline is None else deadline
+        replica, inner = self._route(feed, rel)
+        # counted only once a replica ACCEPTED it (shed requests are
+        # counted by _route as shed, not as accepted intake)
+        with self._lock:
+            self._counters["submitted"] += 1
+        abs_deadline = None if rel is None else time.monotonic() + rel
+        return FleetPending(self, feed, replica, inner, abs_deadline)
+
+    def run(self, feed: Dict[str, Any], timeout: Optional[float] = None):
+        """Synchronous submit+wait (the ``PredictorServer.run``
+        mirror)."""
+        deadline = timeout if self.default_deadline is None else None
+        return self.submit(feed, deadline=deadline).result(timeout)
+
+    def _route(self, feed: Dict[str, Any], rel_deadline: Optional[float],
+               exclude: Optional[set] = None,
+               retry_of: Optional[str] = None
+               ) -> Tuple[str, PendingResult]:
+        """One routing pass: try ready replicas least-loaded-first,
+        skipping ``exclude``; returns ``(name, PendingResult)`` or
+        raises the front-door shed error. ``retry_of`` marks a
+        reroute (journaled, counted)."""
+        if rel_deadline is not None and rel_deadline <= 0:
+            from ..serving import DeadlineExceeded
+            raise DeadlineExceeded(
+                "fleet deadline exhausted before a replica accepted")
+        candidates = self._ranked(exclude or set())
+        states: Dict[str, str] = {}
+        errors: List[BaseException] = []
+        for rep, health in candidates:
+            states[rep.name] = health["state"]
+            if not health["ready"]:
+                continue
+            try:
+                inner = rep.server.submit(feed, deadline=rel_deadline)
+            except (ServerOverloaded, CircuitOpen, ServerClosed) as e:
+                errors.append(e)
+                states[rep.name] = f"rejected:{type(e).__name__}"
+                continue
+            with self._lock:
+                self._routed[rep.name] = self._routed.get(rep.name, 0) + 1
+                if retry_of is not None:
+                    self._counters["rerouted"] += 1
+            if retry_of is not None:
+                self.journal.emit("fleet.reroute", span=inner.span,
+                                  inst=self.telemetry_inst,
+                                  replica=rep.name, retry_of=retry_of)
+            return rep.name, inner
+        # nobody took it: shed with ONE typed front-door error
+        with self._lock:
+            self._counters["shed"] += 1
+        self.journal.emit("fleet.shed", inst=self.telemetry_inst,
+                          states=states)
+        if errors and all(isinstance(e, ServerOverloaded) for e in errors):
+            raise ServerOverloaded(
+                sum(e.queue_depth for e in errors),
+                sum(e.capacity for e in errors))
+        if errors and all(isinstance(e, CircuitOpen) for e in errors):
+            raise CircuitOpen(min(e.retry_after for e in errors))
+        raise NoReplicaAvailable(states)
+
+    def _ranked(self, exclude: set) -> List[Tuple[_Replica, Dict[str, Any]]]:
+        """Replicas with their health snapshots, least-loaded first
+        (ready before not-ready; load = queued + busy workers; ties
+        broken round-robin so equal-load replicas share traffic)."""
+        with self._lock:
+            reps = [r for n, r in self._replicas.items() if n not in exclude]
+            rr = self._rr
+            self._rr += 1
+        scored = []
+        for i, rep in enumerate(reps):
+            try:
+                h = rep.server.health()
+            except Exception:  # a torn-down replica must not break routing
+                h = {"ready": False, "live": False, "state": "unreachable",
+                     "queue_depth": 0, "workers_busy": 0}
+            load = h.get("queue_depth", 0) + h.get("workers_busy", 0)
+            scored.append((not h.get("ready"), load, (i + rr) % max(len(reps), 1),
+                           rep, h))
+        scored.sort(key=lambda s: s[:3])
+        return [(rep, h) for _, _, _, rep, h in scored]
+
+    # -- rolling reload ------------------------------------------------------
+
+    def reload(self, dirname: str) -> Dict[str, int]:
+        """Rolling hot reload across the fleet: canary ONE replica
+        (its reload runs the static preflight + golden-feed canary and
+        rolls itself back on failure — a failed canary leaves every
+        OTHER replica untouched), then fan out one replica at a time.
+        A mid-rollout failure rolls every already-swapped replica back
+        to the previous artifact before re-raising. Zero dropped
+        in-flight requests across all replicas either way (each swap is
+        the replica's own zero-drop reload). Returns
+        ``{name: generation}`` after the rollout."""
+        with self._reload_lock:
+            with self._lock:
+                order = [r for r in self._replicas.values()
+                         if r.server.health()["live"]]
+            if not order:
+                raise ReloadFailed(dirname, "no live replica to reload")
+            prev = self.dirname
+            canary = order[0]
+            self.journal.emit("fleet.reload_canary",
+                              inst=self.telemetry_inst,
+                              replica=canary.name, dirname=dirname)
+            try:
+                try:
+                    canary.server.reload(dirname, block=True)
+                except BaseException as e:
+                    with self._lock:
+                        self._counters["reload_failures"] += 1
+                    self.journal.emit("fleet.reload",
+                                      inst=self.telemetry_inst,
+                                      dirname=dirname, ok=False,
+                                      stage="canary",
+                                      error=f"{type(e).__name__}: "
+                                            f"{e}"[:300])
+                    _log().warning(
+                        "fleet reload of %s: canary %s rejected (%s) — "
+                        "fleet untouched", dirname, canary.name, e)
+                    raise
+                swapped = [canary]
+                for rep in order[1:]:
+                    try:
+                        rep.server.reload(dirname, block=True)
+                    except BaseException as e:
+                        self._rollback(swapped, prev, dirname, e)
+                        raise ReloadFailed(
+                            dirname, f"replica {rep.name} failed "
+                            f"mid-rollout ({type(e).__name__}: {e}); "
+                            f"fleet rolled back to {prev!r}") from e
+                    swapped.append(rep)
+            finally:
+                # every replica's reload (and a rollback's) is an
+                # off-request-path load that moved the process-wide AOT
+                # counter: re-pin the whole fleet so sibling loads never
+                # read as request-path recompiles
+                self._repin_all()
+            self.dirname = dirname
+            with self._lock:
+                self._counters["reloads"] += 1
+            self.journal.emit("fleet.reload", inst=self.telemetry_inst,
+                              dirname=dirname, ok=True,
+                              replicas=[r.name for r in swapped])
+            return {r.name: r.server.generation for r in swapped}
+
+    def _rollback(self, swapped: List[_Replica], prev: Optional[str],
+                  dirname: str, cause: BaseException) -> None:
+        with self._lock:
+            self._counters["reload_failures"] += 1
+            self._counters["reload_rollbacks"] += 1
+        self.journal.emit("fleet.reload", inst=self.telemetry_inst,
+                          dirname=dirname, ok=False, stage="rollout",
+                          error=f"{type(cause).__name__}: {cause}"[:300],
+                          rolling_back=[r.name for r in swapped])
+        if prev is None:
+            _log().error(
+                "fleet reload of %s failed mid-rollout with no previous "
+                "artifact on record: %d replica(s) left on the new model",
+                dirname, len(swapped))
+            return
+        for rep in swapped:
+            try:
+                rep.server.reload(prev, block=True)
+            except BaseException as e:  # pragma: no cover - best effort
+                _log().error("rollback of replica %s to %s failed: %s",
+                             rep.name, prev, e)
+
+    # -- health + lifecycle --------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        """Fleet readiness/liveness over the replicas' own state
+        machines: ``ready`` (every replica ready) → ``degraded`` (some
+        down, at least one ready — the fleet serves at reduced
+        capacity) → ``unavailable`` (live replicas, none ready) →
+        ``stopped``."""
+        with self._lock:
+            reps = dict(self._replicas)
+        health = {}
+        for name, rep in reps.items():
+            try:
+                health[name] = rep.server.health()
+            except Exception as e:
+                health[name] = {"live": False, "ready": False,
+                                "state": f"unreachable:{type(e).__name__}"}
+        live = [n for n, h in health.items() if h.get("live")]
+        ready = [n for n, h in health.items() if h.get("ready")]
+        if ready and len(ready) == len(health):
+            state = "ready"
+        elif ready:
+            state = "degraded"
+        elif live:
+            state = "unavailable"
+        else:
+            state = "stopped"
+        return {"state": state, "live": bool(live), "ready": bool(ready),
+                "replicas": health, "replicas_live": len(live),
+                "replicas_ready": len(ready),
+                "queue_depth": sum(h.get("queue_depth", 0)
+                                   for h in health.values())}
+
+    def report(self) -> Dict[str, Any]:
+        """Router counters + per-replica reports in one dict (the
+        fleet mirror of ``PredictorServer.report()``)."""
+        with self._lock:
+            out: Dict[str, Any] = dict(self._counters)
+            out["routed"] = dict(self._routed)
+        out["health"] = self.health()
+        with self._lock:
+            reps = dict(self._replicas)
+        out["replicas"] = {n: r.server.report() for n, r in reps.items()
+                           if r.server.health()["live"]}
+        return out
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Close every replica (graceful drain by default) and the
+        aggregated endpoint. Idempotent."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            try:
+                rep.server.close(drain=drain, timeout=timeout)
+            except Exception:  # pragma: no cover - teardown best effort
+                pass
+        if self._telemetry_server is not None:
+            self._telemetry_server.close()
+            self._telemetry_server = None
+        from ..telemetry import get_registry
+        get_registry().remove_collector(self._telemetry_cid)
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=True)
+
+    # -- aggregated telemetry ------------------------------------------------
+
+    def _own_families(self):
+        """The router's OWN series (``paddle_tpu_fleet_*``): routing/
+        shed/retry counters + live/ready replica gauges. Registered as
+        a process-registry collector; also merged into
+        :meth:`metrics_families`."""
+        from ..telemetry.registry import counter_family, gauge_family
+
+        labels = {"inst": self.telemetry_inst}
+        with self._lock:
+            counters = dict(self._counters)
+            routed = dict(self._routed)
+        h = self.health()
+        return [
+            counter_family("paddle_tpu_fleet_submitted_total",
+                           "Requests accepted at the fleet front door",
+                           [(labels, counters["submitted"])]),
+            counter_family("paddle_tpu_fleet_routed_total",
+                           "Requests routed, by replica",
+                           [({**labels, "replica": n}, v)
+                            for n, v in sorted(routed.items())]),
+            counter_family("paddle_tpu_fleet_rerouted_total",
+                           "Never-dispatched requests resubmitted after a "
+                           "replica death",
+                           [(labels, counters["rerouted"])]),
+            counter_family("paddle_tpu_fleet_shed_total",
+                           "Requests shed at the front door",
+                           [(labels, counters["shed"])]),
+            counter_family("paddle_tpu_fleet_replicas_replaced_total",
+                           "Replicas replaced after death",
+                           [(labels, counters["replicas_replaced"])]),
+            counter_family(
+                "paddle_tpu_fleet_reloads_total",
+                "Rolling reloads (by outcome)",
+                [({**labels, "outcome": "ok"}, counters["reloads"]),
+                 ({**labels, "outcome": "failed"},
+                  counters["reload_failures"])]),
+            counter_family("paddle_tpu_fleet_reload_rollbacks_total",
+                           "Mid-rollout failures rolled back fleet-wide",
+                           [(labels, counters["reload_rollbacks"])]),
+            gauge_family("paddle_tpu_fleet_replicas_live",
+                         "Replicas whose process is live",
+                         [(labels, h["replicas_live"])]),
+            gauge_family("paddle_tpu_fleet_replicas_ready",
+                         "Replicas accepting traffic",
+                         [(labels, h["replicas_ready"])]),
+        ]
+
+    def metrics_families(self):
+        """The fleet-aggregated export: every replica's
+        ``telemetry_families()`` merged under a ``replica`` label
+        (:func:`paddle_tpu.telemetry.merge_exports`) + the router's own
+        ``paddle_tpu_fleet_*`` series (labeled ``replica="router"`` so
+        the merged export has no unlabeled stragglers). Naming-
+        convention clean by construction
+        (``telemetry.validate_families`` — test-pinned)."""
+        from ..telemetry.registry import merge_exports
+
+        with self._lock:
+            reps = dict(self._replicas)
+        named = {"router": self._own_families()}
+        for name, rep in reps.items():
+            try:
+                named[name] = rep.server.telemetry_families()
+            except Exception:  # a dead replica exports nothing
+                continue
+        return merge_exports(named, label="replica")
+
+    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
+        """The fleet-aggregated scrape endpoint: ``GET /metrics``
+        (Prometheus text of :meth:`metrics_families`; ``?format=json``
+        for the JSON snapshot) + ``GET /healthz`` (the fleet
+        :meth:`health`, 503 once no replica is ready). One scrape
+        covers every replica — the series differ only by ``replica``
+        label."""
+        from ..telemetry import serve_metrics as _serve
+        from ..telemetry.registry import FamiliesView
+
+        if self._telemetry_server is None:
+            self._telemetry_server = _serve(
+                registry=FamiliesView(self.metrics_families),
+                health_fn=self.health, port=port, host=host)
+        return self._telemetry_server
+
+
+__all__ = ["FleetPending", "FleetRouter", "NoReplicaAvailable"]

@@ -53,6 +53,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from .core.errors import EnforceError
+from .fleet import batching as _batching
 from .io import InvalidRequest  # noqa: F401  (re-exported: submit raises it)
 
 
@@ -99,7 +100,20 @@ class WorkerHung(ServingError):
 
 
 class ServerClosed(ServingError):
-    """submit() after close()/drain started."""
+    """submit() after close()/drain started — also the outcome of a
+    request that was accepted but NEVER dispatched when its server
+    died or stopped. A router may safely resubmit such a request
+    elsewhere (it provably never executed); see
+    :class:`~paddle_tpu.fleet.FleetRouter`."""
+
+
+class ReplicaDied(ServingError):
+    """The serving replica died (``PredictorServer.kill`` — the
+    in-process stand-in for the process being killed) while this
+    request was DISPATCHED on one of its workers. At-most-once: the
+    request may or may not have executed, so it is surfaced exactly
+    once as this error and never retried — the serving mirror of
+    ``PSClient.push``'s ``PushUndelivered``."""
 
 
 class ReloadFailed(ServingError):
@@ -288,7 +302,8 @@ class ServingMetrics:
     _COUNTERS = ("submitted", "completed", "rejected_invalid",
                  "rejected_overload", "rejected_breaker", "timeouts",
                  "errors", "hangs", "workers_replaced", "reloads",
-                 "reload_failures")
+                 "reload_failures", "coalesced_batches",
+                 "coalesced_requests")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -369,6 +384,12 @@ class ServingMetrics:
                 [({**labels, "outcome": "ok"}, snap["reloads"]),
                  ({**labels, "outcome": "failed"},
                   snap["reload_failures"])]),
+            counter_family("paddle_tpu_serving_coalesced_batches_total",
+                           "Dispatches that coalesced >1 request",
+                           [(labels, snap["coalesced_batches"])]),
+            counter_family("paddle_tpu_serving_coalesced_requests_total",
+                           "Requests served inside a coalesced dispatch",
+                           [(labels, snap["coalesced_requests"])]),
         ]
         h = snap["latency_hist"]
         fams.append(histogram_family(
@@ -446,13 +467,19 @@ class PendingResult:
 
 
 class _Worker:
-    __slots__ = ("thread", "busy_since", "request", "abandoned", "index")
+    __slots__ = ("thread", "busy_since", "request", "group", "carry",
+                 "abandoned", "index")
 
     def __init__(self, index: int):
         self.index = index
         self.thread: Optional[threading.Thread] = None
         self.busy_since: Optional[float] = None
         self.request: Optional[_Request] = None
+        # the full coalesced group behind `request` (None = pad-alone)
+        self.group: Optional[List[_Request]] = None
+        # requests pulled while coalescing that could not join the
+        # forming batch — served FIRST on the next loop iteration
+        self.carry: List[_Request] = []
         self.abandoned = False
 
 
@@ -477,7 +504,15 @@ class PredictorServer:
 
     ``golden_feed`` (+ optional ``canary_check(outputs)``) gates hot
     reloads: a candidate model must serve the golden feed with finite
-    outputs (and pass ``canary_check``) before it is swapped in."""
+    outputs (and pass ``canary_check``) before it is swapped in.
+
+    ``batch_policy`` (a :class:`paddle_tpu.fleet.BatchPolicy`) turns on
+    **continuous batching**: workers coalesce queued requests into the
+    largest precompiled bucket that fits within the policy's wait
+    budget, slice outputs back per caller by row span, and preserve
+    every per-request contract — deadlines, spans, validation, typed
+    errors — with results bit-identical to pad-alone dispatch and zero
+    new compiles (the same bucket executables serve, just fuller)."""
 
     def __init__(self, predictor, workers: int = 2, queue_size: int = 32,
                  default_deadline: Optional[float] = None,
@@ -486,6 +521,7 @@ class PredictorServer:
                  golden_feed: Optional[Dict[str, Any]] = None,
                  canary_check: Optional[Callable[[Any], Any]] = None,
                  reject_nonfinite: bool = True,
+                 batch_policy=None,
                  warmup: bool = True, start: bool = True):
         from . import io as _io
 
@@ -502,6 +538,11 @@ class PredictorServer:
         self.golden_feed = golden_feed
         self.canary_check = canary_check
         self.reject_nonfinite = bool(reject_nonfinite)
+        # continuous batching (fleet.batching.BatchPolicy): workers
+        # coalesce queued requests into the largest precompiled bucket
+        # within the policy's wait budget; None = pad-alone (the PR-5
+        # behavior, unchanged)
+        self.batch_policy = batch_policy
         self._do_warmup = bool(warmup)
         self._queue: _queue.Queue = _queue.Queue(maxsize=self.queue_size)
         self._complete_lock = threading.Lock()
@@ -587,9 +628,11 @@ class PredictorServer:
         if drain:
             # abandoned (hung) workers never go idle — waiting on them
             # would spin the SIGTERM drain forever; their requests were
-            # already failed fast by the watchdog
+            # already failed fast by the watchdog. Carried (coalescer-
+            # deferred) requests count as pending work too.
             while not self._queue.empty() or any(
-                    w.busy_since is not None and not w.abandoned
+                    (w.busy_since is not None or w.carry)
+                    and not w.abandoned
                     for w in self._workers):
                 if deadline is not None and time.monotonic() > deadline:
                     break
@@ -611,6 +654,13 @@ class PredictorServer:
                 continue   # wedged in a dispatch; daemon thread, no join
             if w.thread is not None and w.thread is not threading.current_thread():
                 w.thread.join(timeout=5.0)
+        # abandoned workers never run their loop-exit cleanup: fail any
+        # carried (never-dispatched) request they still hold
+        for w in self._workers:
+            for r in w.carry:
+                self.breaker.cancel(r.token)
+                self._complete(r, error=ServerClosed("server stopping"))
+            w.carry = []
         if self._watchdog is not None:
             self._watchdog.join(timeout=5.0)
         with self._state_lock:
@@ -621,6 +671,67 @@ class PredictorServer:
         # a closed server must not keep exporting live-looking queue/
         # worker gauges for as long as a caller holds a reference
         from .telemetry import get_registry
+        get_registry().remove_collector(self._telemetry_cid)
+
+    def kill(self, reason: str = "replica killed") -> None:
+        """Abrupt replica death — the in-process stand-in for the
+        serving process being ``kill -9``'d, used by fleet drills
+        (``testing.faults.kill_server``) and exercised by
+        :class:`~paddle_tpu.fleet.FleetRouter`'s retry contract. No
+        drain, no joins:
+
+        - requests still QUEUED (or coalescer-carried) were provably
+          never dispatched: they fail with :class:`ServerClosed`, which
+          a router may safely resubmit to another replica;
+        - requests DISPATCHED on a worker fail with
+          :class:`ReplicaDied` exactly once and are never retried
+          (at-most-once — the execution may or may not have happened);
+        - the flight recorder captures the kill with the first
+          in-flight request's span, so the post-mortem shows exactly
+          what the replica was serving when it died.
+
+        Idempotent; a later :meth:`close` is a no-op."""
+        with self._state_lock:
+            if self._state == "stopped":
+                return
+            self._state = "stopped"
+        self._stop.set()
+        died = []
+        for w in self._workers:
+            grp = list(w.group or ())
+            if not grp and w.request is not None:
+                grp = [w.request]
+            w.abandoned = True
+            for r in grp:
+                if self._complete(r, error=ReplicaDied(reason)):
+                    died.append(r.span)
+            for r in w.carry:
+                self.breaker.cancel(r.token)
+                self._complete(r, error=ServerClosed(reason))
+            w.carry = []
+        requeued = 0
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except _queue.Empty:
+                break
+            self.breaker.cancel(req.token)
+            self._complete(req, error=ServerClosed(reason))
+            requeued += 1
+        self.journal.emit("serving.killed", span=died[0] if died else None,
+                          inst=self.telemetry_inst, reason=reason,
+                          inflight=len(died), queued=requeued)
+        from .telemetry import flight_dump, get_registry
+        flight_dump("replica_killed", span=died[0] if died else None,
+                    detail={"reason": reason, "inflight": len(died),
+                            "inflight_spans": died, "queued": requeued,
+                            "inst": self.telemetry_inst})
+        _log().error("replica killed (%s): %d in-flight failed "
+                     "at-most-once, %d never-dispatched failed retryable",
+                     reason, len(died), requeued)
+        if self._telemetry_server is not None:
+            self._telemetry_server.close()
+            self._telemetry_server = None
         get_registry().remove_collector(self._telemetry_cid)
 
     def __enter__(self) -> "PredictorServer":
@@ -725,84 +836,177 @@ class PredictorServer:
         w.thread.start()
         return w
 
+    def _admit(self, req: _Request) -> Optional[_Request]:
+        """Dequeue-time admission, shared by pad-alone dispatch and the
+        coalescing collector: a request whose deadline passed while
+        queued is dropped WITHOUT executing (the clean-cancel half of
+        the deadline contract — its breaker token goes back too, an
+        expired half-open PROBE must release its slot or the breaker
+        wedges in half_open rejecting everything forever); a request
+        admitted before the breaker tripped fails fast instead of
+        running the broken executable again. Returns the request, or
+        None after completing it with its typed outcome."""
+        now = time.monotonic()
+        if req.deadline is not None and now > req.deadline:
+            self.breaker.cancel(req.token)
+            self.metrics.bump("timeouts")
+            self.journal.emit("serving.expired", span=req.span,
+                              inst=self.telemetry_inst,
+                              late_s=round(now - req.deadline, 6))
+            self._complete(req, error=DeadlineExceeded(
+                f"deadline passed {now - req.deadline:.3f}s before "
+                "dispatch"))
+            return None
+        if self.breaker.state == "open" and req.token == "pass":
+            self.metrics.bump("rejected_breaker")
+            self.journal.emit("serving.reject", span=req.span,
+                              inst=self.telemetry_inst,
+                              reason="breaker_queued")
+            self._complete(req, error=CircuitOpen(
+                self.breaker.retry_after()))
+            return None
+        return req
+
+    def _coalesce(self, w: _Worker, first: _Request) -> List[_Request]:
+        """Form a coalesced group seeded by ``first``: already-queued
+        requests are taken for free, then the worker waits up to the
+        policy's ``max_wait_ms`` past ``first``'s submit (never past
+        the tightest deadline in the forming group) for more. Stops at
+        the largest precompiled bucket, the policy's ``max_requests``,
+        or the first incompatible candidate (different non-batched feed
+        bytes, or it would overflow the bucket) — which is CARRIED and
+        seeds this worker's next dispatch, never reordered behind later
+        traffic. Every candidate passes the same dequeue-time admission
+        as pad-alone dispatch."""
+        pol = self.batch_policy
+        with self._model_lock:
+            pred = self._predictor
+        buckets = pred.batch_buckets
+        max_rows = buckets[-1]
+        group = [first]
+        total = first.n
+        key = _batching.nonbatched_key(first.feed, pred.feed_names,
+                                       pred.batched_feeds)
+        hold_until = first.submitted + pol.max_wait_ms / 1e3
+        while total < max_rows and not self._stop.is_set():
+            if pol.max_requests is not None and \
+                    len(group) >= pol.max_requests:
+                break
+            limit = hold_until
+            for r in group:
+                if r.deadline is not None:
+                    limit = min(limit, r.deadline)
+            wait = limit - time.monotonic()
+            try:
+                cand = (self._queue.get_nowait() if wait <= 0
+                        else self._queue.get(timeout=min(wait, 0.02)))
+            except _queue.Empty:
+                if wait <= 0:
+                    break
+                continue
+            cand = self._admit(cand)
+            if cand is None:
+                continue
+            if total + cand.n > max_rows or _batching.nonbatched_key(
+                    cand.feed, pred.feed_names,
+                    pred.batched_feeds) != key:
+                w.carry.append(cand)
+                break
+            group.append(cand)
+            total += cand.n
+        return group
+
     def _worker_loop(self, w: _Worker) -> None:
         clone = None
         gen = 0
         while not self._stop.is_set() and not w.abandoned:
-            try:
-                req = self._queue.get(timeout=0.05)
-            except _queue.Empty:
+            if w.carry:
+                req = w.carry.pop(0)
+            else:
+                try:
+                    req = self._queue.get(timeout=0.05)
+                except _queue.Empty:
+                    continue
+            req = self._admit(req)
+            if req is None:
                 continue
-            now = time.monotonic()
-            if req.deadline is not None and now > req.deadline:
-                # expired while queued: drop WITHOUT executing — the
-                # clean-cancel half of the deadline contract. The
-                # breaker token goes back too: an expired half-open
-                # PROBE must release its slot or the breaker wedges in
-                # half_open rejecting everything forever
-                self.breaker.cancel(req.token)
-                self.metrics.bump("timeouts")
-                self.journal.emit("serving.expired", span=req.span,
-                                  inst=self.telemetry_inst,
-                                  late_s=round(now - req.deadline, 6))
-                self._complete(req, error=DeadlineExceeded(
-                    f"deadline passed {now - req.deadline:.3f}s before "
-                    "dispatch"))
-                continue
-            if self.breaker.state == "open" and req.token == "pass":
-                # tripped while this request sat queued: fail fast, do
-                # not run the broken executable again
-                self.metrics.bump("rejected_breaker")
-                self.journal.emit("serving.reject", span=req.span,
-                                  inst=self.telemetry_inst,
-                                  reason="breaker_queued")
-                self._complete(req, error=CircuitOpen(
-                    self.breaker.retry_after()))
-                continue
+            group = ([req] if self.batch_policy is None
+                     else self._coalesce(w, req))
+            with self._model_lock:
+                pred, gen_now = self._predictor, self._generation
+            total = sum(r.n for r in group)
+            bucket = (req.bucket if len(group) == 1
+                      else _batching.pick_bucket(total, pred.batch_buckets))
+            spans = _batching.row_spans(group)
             w.request = req
-            w.busy_since = now
-            self.journal.emit("serving.dispatch", span=req.span,
-                              inst=self.telemetry_inst, worker=w.index,
-                              n=req.n, bucket=req.bucket,
-                              queued_s=round(now - req.submitted, 6))
+            w.group = group
+            w.busy_since = now = time.monotonic()
+            for (off, n), r in zip(spans, group):
+                extra = ({"coalesced": len(group), "row": off}
+                         if len(group) > 1 else {})
+                self.journal.emit("serving.dispatch", span=r.span,
+                                  inst=self.telemetry_inst, worker=w.index,
+                                  n=n, bucket=bucket,
+                                  queued_s=round(now - r.submitted, 6),
+                                  **extra)
             try:
-                with self._model_lock:
-                    pred, gen_now = self._predictor, self._generation
                 if clone is None or gen != gen_now:
                     clone = pred.clone()
                     gen = gen_now
-                out = clone.run(self._pad(pred, req))
+                feed = (self._pad(pred, req) if len(group) == 1
+                        else _batching.merge_feeds(group, pred.feed_names,
+                                                   pred.batched_feeds,
+                                                   bucket))
+                out = clone.run(feed)
                 _block_on(out)
-                out = _slice_outputs(out, req.n, req.bucket)
             except BaseException as e:
-                first = self._complete(req, error=e)
-                # an ABANDONED worker's eventual outcome is stale
-                # evidence: the watchdog already tripped for the hang,
-                # and a late failure must not re-open a breaker that has
-                # since recovered (nor double-count into the metrics —
-                # _complete returning False means the watchdog won)
-                if not w.abandoned:
-                    self.breaker.record(req.token, success=False)
-                if first:
-                    self.metrics.bump("errors")
-                    self.journal.emit(
-                        "serving.error", span=req.span,
-                        inst=self.telemetry_inst, worker=w.index,
-                        error=f"{type(e).__name__}: {e}"[:300])
+                for r in group:
+                    first = self._complete(r, error=e)
+                    # an ABANDONED worker's eventual outcome is stale
+                    # evidence: the watchdog already tripped for the
+                    # hang, and a late failure must not re-open a
+                    # breaker that has since recovered (nor
+                    # double-count into the metrics — _complete
+                    # returning False means the watchdog won)
+                    if not w.abandoned:
+                        self.breaker.record(r.token, success=False)
+                    if first:
+                        self.metrics.bump("errors")
+                        self.journal.emit(
+                            "serving.error", span=r.span,
+                            inst=self.telemetry_inst, worker=w.index,
+                            error=f"{type(e).__name__}: {e}"[:300])
             else:
-                if not w.abandoned:
-                    self.breaker.record(req.token, success=True)
-                if self._complete(req, value=out):
-                    latency = time.monotonic() - req.submitted
-                    self.metrics.bump("completed")
-                    self.metrics.record_latency(latency)
-                    self.journal.emit("serving.complete", span=req.span,
-                                      inst=self.telemetry_inst,
-                                      worker=w.index,
-                                      latency_s=round(latency, 6))
+                if len(group) > 1:
+                    self.metrics.bump("coalesced_batches")
+                    self.metrics.bump("coalesced_requests", by=len(group))
+                done_t = time.monotonic()
+                for (off, n), r in zip(spans, group):
+                    if not w.abandoned:
+                        self.breaker.record(r.token, success=True)
+                    sliced = _batching.slice_rows(out, off, n, bucket)
+                    if self._complete(r, value=sliced):
+                        latency = done_t - r.submitted
+                        self.metrics.bump("completed")
+                        self.metrics.record_latency(latency)
+                        extra = ({"coalesced": len(group)}
+                                 if len(group) > 1 else {})
+                        self.journal.emit("serving.complete", span=r.span,
+                                          inst=self.telemetry_inst,
+                                          worker=w.index,
+                                          latency_s=round(latency, 6),
+                                          **extra)
             finally:
                 w.busy_since = None
                 w.request = None
+                w.group = None
+        # loop exit with requests still carried (stop flag raced the
+        # coalescer): they were never dispatched — fail them typed so
+        # no client blocks forever, probe tokens go back
+        for r in w.carry:
+            self.breaker.cancel(r.token)
+            self._complete(r, error=ServerClosed("server stopping"))
+        w.carry = []
 
     @staticmethod
     def _pad(predictor, req: _Request) -> Dict[str, Any]:
@@ -845,16 +1049,19 @@ class PredictorServer:
                     continue
                 if now - busy <= self.watchdog_timeout:
                     continue
-                req = w.request
+                group = list(w.group or ())
+                if not group and w.request is not None:
+                    group = [w.request]
                 w.abandoned = True
                 self.metrics.bump("hangs")
-                span = req.span if req is not None else None
+                span = group[0].span if group else None
                 # the hang event goes into the ring BEFORE the breaker
                 # trips, so both this dump and the trip's are complete
                 self.journal.emit("serving.hang", span=span,
                                   inst=self.telemetry_inst,
                                   worker=w.index,
-                                  busy_s=round(now - busy, 6))
+                                  busy_s=round(now - busy, 6),
+                                  inflight=len(group))
                 self.breaker.trip()
                 from .telemetry import flight_dump
                 flight_dump("worker_hung", span=span,
@@ -867,12 +1074,18 @@ class PredictorServer:
                     "worker %d hung for %.2fs (watchdog_timeout=%.2fs): "
                     "breaker tripped, worker abandoned + replaced",
                     w.index, now - busy, self.watchdog_timeout)
-                if req is not None:
-                    self._complete(req, error=WorkerHung(
+                # EVERY request of a coalesced dispatch hung with it:
+                # fail each fast (their callers are all waiting)
+                for r in group:
+                    self._complete(r, error=WorkerHung(
                         f"dispatch exceeded the {self.watchdog_timeout}s "
                         "watchdog timeout"))
                 self.metrics.bump("workers_replaced")
-                self._spawn_worker(len(self._workers))
+                neww = self._spawn_worker(len(self._workers))
+                # never-dispatched requests the coalescer carried on
+                # the wedged worker move to its replacement — they
+                # must not strand behind an abandoned loop
+                neww.carry, w.carry = w.carry, []
 
     def _on_breaker_trip(self, reason: str) -> None:
         """Breaker (re)open: journal it and flight-record the recent
@@ -1096,6 +1309,63 @@ class PredictorServer:
             "uptime_s": round(time.monotonic() - self._started_at, 3),
         }
 
+    def repin_compiles(self) -> None:
+        """Re-pin the ``compiles_since_warmup`` contract counter. The
+        AOT counter is process-wide, so it also moves when ANOTHER
+        server in the process legitimately loads off the request path —
+        a fleet sibling's rolling reload or a router ``replace()``. The
+        owner of that operation re-pins the rest of the fleet
+        (``FleetRouter`` does this automatically) so the signal keeps
+        meaning "request-path recompiles on THIS server". No-op before
+        warmup."""
+        if self._pinned_compiles is not None:
+            self._pinned_compiles = self._io.aot_compile_count()
+
+    def telemetry_families(self):
+        """This server's FULL registry export — every
+        ``ServingMetrics`` counter + the latency histogram (same store
+        ``report()`` reads, so the series can never disagree) plus live
+        queue-depth/capacity/worker gauges and breaker/generation
+        state. Doubles as the process-registry collector callback
+        (called at scrape time) and the per-replica source a
+        :class:`~paddle_tpu.fleet.FleetRouter` merges under a
+        ``replica`` label for the fleet-aggregated ``/metrics``."""
+        from .telemetry.registry import counter_family, gauge_family
+
+        inst = self.telemetry_inst
+        labels = {"inst": inst}
+        fams = self.metrics.telemetry_families(inst)
+        alive = self._alive_workers()
+        bstate = self.breaker.state
+        fams.extend([
+            gauge_family("paddle_tpu_serving_queue_depth",
+                         "Requests currently queued",
+                         [(labels, self._queue.qsize())]),
+            gauge_family("paddle_tpu_serving_queue_capacity",
+                         "Bounded queue capacity",
+                         [(labels, self.queue_size)]),
+            gauge_family("paddle_tpu_serving_workers",
+                         "Live (non-abandoned) workers",
+                         [(labels, len(alive))]),
+            gauge_family("paddle_tpu_serving_workers_busy",
+                         "Workers currently executing a dispatch",
+                         [(labels, sum(1 for w in alive
+                                       if w.busy_since is not None))]),
+            gauge_family("paddle_tpu_serving_breaker_open",
+                         "1 while the circuit breaker is open",
+                         [(labels, 1 if bstate == "open" else 0)]),
+            gauge_family("paddle_tpu_serving_breaker_half_open",
+                         "1 while the breaker awaits its half-open probe",
+                         [(labels, 1 if bstate == "half_open" else 0)]),
+            counter_family("paddle_tpu_serving_breaker_trips_total",
+                           "Circuit-breaker trips",
+                           [(labels, self.breaker.trips)]),
+            gauge_family("paddle_tpu_serving_generation",
+                         "Served-model generation (bumps on hot reload)",
+                         [(labels, self.generation)]),
+        ])
+        return fams
+
     def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
         """Opt-in scrape endpoint: start the stdlib ``GET /metrics``
         (Prometheus text of the process registry — this server's
@@ -1137,52 +1407,17 @@ class PredictorServer:
 
 def _register_server_telemetry(server: PredictorServer) -> int:
     """Register the server's scrape-time collector in the process
-    registry: every ``ServingMetrics`` counter + the latency histogram
-    (same store ``report()`` reads, so the series can never disagree),
-    plus live queue-depth/capacity/worker gauges and breaker state.
-    Weakly bound — a collected server's series drop out, and
-    :meth:`PredictorServer.close` removes the collector eagerly so a
-    stopped-but-referenced server stops exporting live-looking
+    registry — the callback IS :meth:`PredictorServer.
+    telemetry_families` (one export surface for the process registry
+    AND fleet aggregation, so they can never drift). Weakly bound — a
+    collected server's series drop out, and :meth:`PredictorServer.
+    close`/:meth:`~PredictorServer.kill` remove the collector eagerly
+    so a stopped-but-referenced server stops exporting live-looking
     gauges."""
     from .telemetry import get_registry
-    from .telemetry.registry import counter_family, gauge_family
 
-    def collect(srv):
-        inst = srv.telemetry_inst
-        labels = {"inst": inst}
-        fams = srv.metrics.telemetry_families(inst)
-        alive = srv._alive_workers()
-        bstate = srv.breaker.state
-        fams.extend([
-            gauge_family("paddle_tpu_serving_queue_depth",
-                         "Requests currently queued",
-                         [(labels, srv._queue.qsize())]),
-            gauge_family("paddle_tpu_serving_queue_capacity",
-                         "Bounded queue capacity",
-                         [(labels, srv.queue_size)]),
-            gauge_family("paddle_tpu_serving_workers",
-                         "Live (non-abandoned) workers",
-                         [(labels, len(alive))]),
-            gauge_family("paddle_tpu_serving_workers_busy",
-                         "Workers currently executing a dispatch",
-                         [(labels, sum(1 for w in alive
-                                       if w.busy_since is not None))]),
-            gauge_family("paddle_tpu_serving_breaker_open",
-                         "1 while the circuit breaker is open",
-                         [(labels, 1 if bstate == "open" else 0)]),
-            gauge_family("paddle_tpu_serving_breaker_half_open",
-                         "1 while the breaker awaits its half-open probe",
-                         [(labels, 1 if bstate == "half_open" else 0)]),
-            counter_family("paddle_tpu_serving_breaker_trips_total",
-                           "Circuit-breaker trips",
-                           [(labels, srv.breaker.trips)]),
-            gauge_family("paddle_tpu_serving_generation",
-                         "Served-model generation (bumps on hot reload)",
-                         [(labels, srv.generation)]),
-        ])
-        return fams
-
-    return get_registry().add_collector(collect, owner=server)
+    return get_registry().add_collector(PredictorServer.telemetry_families,
+                                        owner=server)
 
 
 def _block_on(out) -> None:
@@ -1209,32 +1444,9 @@ def _nonfinite_outputs(out) -> List[str]:
     return bad
 
 
-def _slice_outputs(out, n: int, bucket: int):
-    """Slice padded-batch outputs back to the request's batch size
-    (identity when no padding happened — preserving bit-identity with a
-    bare ``Predictor.run`` for in-bucket requests)."""
-    if n == bucket:
-        return out
-
-    def _one(v):
-        try:
-            if hasattr(v, "shape") and len(v.shape) >= 1 and \
-                    int(v.shape[0]) == bucket:
-                return v[:n]
-        except TypeError:
-            pass
-        return v
-
-    if isinstance(out, dict):
-        return {k: _one(v) for k, v in out.items()}
-    if isinstance(out, (list, tuple)):
-        return type(out)(_one(v) for v in out)
-    return _one(out)
-
-
 __all__ = [
     "BreakerPolicy", "CircuitBreaker", "CircuitOpen", "DeadlineExceeded",
     "InvalidRequest", "LatencyHistogram", "PendingResult", "PredictorServer",
-    "ReloadFailed", "ServerClosed", "ServerOverloaded", "ServingError",
-    "ServingMetrics", "WorkerHung",
+    "ReloadFailed", "ReplicaDied", "ServerClosed", "ServerOverloaded",
+    "ServingError", "ServingMetrics", "WorkerHung",
 ]

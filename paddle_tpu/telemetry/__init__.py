@@ -31,18 +31,23 @@ See MIGRATION.md "Telemetry" for the metric name table, journal event
 schema, and flight-recorder trigger/dump format.
 """
 
-from .journal import RunJournal, get_journal, new_run_id, set_journal
+from .journal import (RunJournal, get_journal, new_run_id, parse_sample,
+                      set_journal)
 from .recorder import (FlightRecorder, default_flight_dir, flight_dump,
                        get_recorder)
-from .registry import (Counter, Gauge, Histogram, MetricFamily,
+from .registry import (Counter, FamiliesView, Gauge, Histogram, MetricFamily,
                        MetricsRegistry, counter_deltas, counter_family,
-                       gauge_family, get_registry, histogram_family)
+                       families_snapshot, gauge_family, get_registry,
+                       histogram_family, merge_exports,
+                       render_families_prometheus, validate_families)
 from .http import TelemetryServer, serve_metrics
 
 __all__ = [
-    "Counter", "FlightRecorder", "Gauge", "Histogram", "MetricFamily",
-    "MetricsRegistry", "RunJournal", "TelemetryServer", "counter_deltas",
-    "counter_family", "default_flight_dir", "flight_dump", "gauge_family",
-    "get_journal", "get_recorder", "get_registry", "histogram_family",
-    "new_run_id", "serve_metrics", "set_journal",
+    "Counter", "FamiliesView", "FlightRecorder", "Gauge", "Histogram",
+    "MetricFamily", "MetricsRegistry", "RunJournal", "TelemetryServer",
+    "counter_deltas", "counter_family", "default_flight_dir",
+    "families_snapshot", "flight_dump", "gauge_family", "get_journal",
+    "get_recorder", "get_registry", "histogram_family", "merge_exports",
+    "new_run_id", "parse_sample", "render_families_prometheus",
+    "serve_metrics", "set_journal", "validate_families",
 ]

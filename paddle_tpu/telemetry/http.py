@@ -1,5 +1,6 @@
 """Opt-in stdlib-only HTTP endpoint: ``GET /metrics`` (Prometheus text
-exposition of the registry) + ``GET /healthz`` (JSON readiness).
+exposition of the registry; ``?format=json`` for the JSON snapshot) +
+``GET /healthz`` (JSON readiness).
 
 One :class:`TelemetryServer` serves both a :class:`~paddle_tpu.
 telemetry.registry.MetricsRegistry` and a ``health_fn`` — the SAME
@@ -36,11 +37,18 @@ class TelemetryServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib handler name)
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
                     try:
-                        body = outer.registry.render_prometheus().encode()
-                        self._reply(200, PROMETHEUS_CONTENT_TYPE, body)
+                        # ?format=json serves the registry's JSON
+                        # snapshot (the bench/flight-dump shape) from
+                        # the same endpoint as the Prometheus text
+                        if "format=json" in query.split("&"):
+                            self._reply(200, "application/json",
+                                        outer.registry.render_json().encode())
+                        else:
+                            body = outer.registry.render_prometheus().encode()
+                            self._reply(200, PROMETHEUS_CONTENT_TYPE, body)
                     except Exception as e:
                         self._reply(500, "text/plain; charset=utf-8",
                                     f"scrape failed: {e}\n".encode())

@@ -21,6 +21,20 @@ Emitting is cheap by construction (dict build + lock + deque append,
 no device interaction): the trainer emits once per DISPATCH (not per
 step), which keeps journal overhead inside the <2% K=16 budget the
 tests pin, with zero added device↔host syncs.
+
+At very high serving QPS even the ring fills with request-lifecycle
+events faster than anything else can land in it. **Per-kind sampling**
+(``RunJournal(sample={"serving": 0.01})``, or
+``PDTPU_JOURNAL_SAMPLE=serving=0.01,ps=0.5`` for the process default)
+keeps a deterministic fraction: the keep/drop decision is a hash of
+the event's **span** (so one request's submit → dispatch → complete
+events share a fate — a sampled-in submit always keeps its lifecycle)
+or of the event's seq for span-less events. No ``random`` anywhere:
+the same traffic journals the same events every run. Kinds match by
+longest dotted prefix (``"serving"`` covers every ``serving.*`` kind;
+``"*"`` is the catch-all); unconfigured kinds always keep. Dropped
+events still consume a ``seq`` (gaps in the sink are visible sampling,
+not corruption) and are counted in ``dropped_sampled``.
 """
 
 from __future__ import annotations
@@ -30,6 +44,7 @@ import os
 import secrets
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Any, Dict, List, Optional
 
@@ -66,13 +81,16 @@ class RunJournal:
     """Thread-safe correlated event stream (ring + optional sinks)."""
 
     def __init__(self, run_id: Optional[str] = None,
-                 ring_size: int = DEFAULT_RING):
+                 ring_size: int = DEFAULT_RING,
+                 sample: Optional[Dict[str, float]] = None):
         self.run_id = run_id or new_run_id()
         self._lock = threading.Lock()
         self._seq = 0
         self._ring: deque = deque(maxlen=ring_size)
         self._files: List[Any] = []
+        self._sample: Dict[str, float] = dict(sample or {})
         self.dropped_sink_writes = 0
+        self.dropped_sampled = 0
 
     # -- spans -------------------------------------------------------------
     @staticmethod
@@ -83,6 +101,45 @@ class RunJournal:
         (a counter under a process-random prefix, no urandom per
         call) — minting rides hot paths."""
         return _mint_span()
+
+    # -- sampling ----------------------------------------------------------
+    def set_sample(self, sample: Optional[Dict[str, float]]) -> None:
+        """Replace the per-kind sampling table: ``{kind_prefix: rate}``
+        with rates in [0, 1] (``{}``/None keeps everything). Matching
+        is by longest dotted prefix of the event kind; ``"*"`` is the
+        catch-all for otherwise-unconfigured kinds."""
+        with self._lock:
+            self._sample = dict(sample or {})
+
+    def sample_rate(self, kind: str) -> float:
+        """The configured keep-rate for ``kind`` (1.0 = keep all)."""
+        with self._lock:
+            return self._rate_locked(kind)
+
+    def _rate_locked(self, kind: str) -> float:
+        s = self._sample
+        if not s:
+            return 1.0
+        k = kind
+        while True:
+            if k in s:
+                return float(s[k])
+            if "." not in k:
+                break
+            k = k.rsplit(".", 1)[0]
+        return float(s.get("*", 1.0))
+
+    @staticmethod
+    def _sampled_in(key: str, rate: float) -> bool:
+        # deterministic keep/drop: a crc32 of the span (or seq) mapped
+        # onto [0, 1) — NOT random.random(), so the same traffic
+        # journals the same events every run, and every event of one
+        # span shares a fate (span-consistent sampling)
+        if rate >= 1.0:
+            return True
+        if rate <= 0.0:
+            return False
+        return (zlib.crc32(key.encode()) & 0xFFFFFFFF) / 2.0 ** 32 < rate
 
     # -- sinks -------------------------------------------------------------
     def open(self, path: str) -> "RunJournal":
@@ -122,6 +179,15 @@ class RunJournal:
             if span is not None:
                 event["span"] = span
             event.update(fields)
+            rate = self._rate_locked(kind)
+            if rate < 1.0 and not self._sampled_in(
+                    span if span is not None else f"{self.run_id}:{self._seq}",
+                    rate):
+                # sampled out: the seq is consumed (sink gaps read as
+                # sampling, not corruption) but neither ring nor sinks
+                # see the event — the high-QPS pressure valve
+                self.dropped_sampled += 1
+                return event
             self._ring.append(event)
             if self._files:
                 try:
@@ -180,13 +246,34 @@ _default_lock = threading.Lock()
 _default_journal: Optional[RunJournal] = None
 
 
+def parse_sample(spec: Optional[str]) -> Dict[str, float]:
+    """Parse a ``PDTPU_JOURNAL_SAMPLE`` value — comma-separated
+    ``kind=rate`` pairs, e.g. ``"serving=0.01,ps=0.5"`` — into a
+    sampling table. Malformed entries are skipped (a bad env var must
+    not break startup); rates clamp to [0, 1]."""
+    out: Dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        kind, _, rate = part.partition("=")
+        try:
+            out[kind.strip()] = min(1.0, max(0.0, float(rate)))
+        except ValueError:
+            continue
+    return out
+
+
 def get_journal() -> RunJournal:
     """THE process journal (created on first use; honors
-    ``PDTPU_JOURNAL_PATH`` as an initial JSONL sink)."""
+    ``PDTPU_JOURNAL_PATH`` as an initial JSONL sink and
+    ``PDTPU_JOURNAL_SAMPLE`` as the initial per-kind sampling
+    table)."""
     global _default_journal
     with _default_lock:
         if _default_journal is None:
-            j = RunJournal()
+            j = RunJournal(
+                sample=parse_sample(os.environ.get("PDTPU_JOURNAL_SAMPLE")))
             path = os.environ.get("PDTPU_JOURNAL_PATH")
             if path:
                 try:
@@ -206,4 +293,4 @@ def set_journal(journal: Optional[RunJournal]) -> Optional[RunJournal]:
 
 
 __all__ = ["DEFAULT_RING", "RunJournal", "get_journal", "new_run_id",
-           "set_journal"]
+           "parse_sample", "set_journal"]

@@ -337,67 +337,143 @@ class MetricsRegistry:
         counter ``_total`` suffix, label-name pattern, duplicate
         series, cross-publisher type/help conflicts, unit-suffix
         hygiene for histograms."""
-        out: List[str] = []
-        seen_series: Dict[str, str] = {}
         fams = self.collect()
-        out.extend(getattr(self, "_last_merge_conflicts", []))
-        by_name: Dict[str, List[MetricFamily]] = {}
-        for fam in fams:
-            by_name.setdefault(fam.name, []).append(fam)
-        for fam in fams:
-            out.extend(_family_violations(fam))
-            for labels, _ in fam.samples:
-                for ln in labels:
-                    if not LABEL_NAME_RE.match(ln):
-                        out.append(f"{fam.name}: bad label name {ln!r}")
-                key = _series_key(fam.name, labels)
-                if key in seen_series:
-                    out.append(f"duplicate series {key} (missing an "
-                               "'inst' label on a per-instance collector?)")
-                seen_series[key] = fam.name
-        return out
+        return (list(getattr(self, "_last_merge_conflicts", []))
+                + validate_families(fams))
 
     # -- exporters ---------------------------------------------------------
     def render_prometheus(self) -> str:
         """Prometheus text exposition (format 0.0.4) of every family."""
-        lines: List[str] = []
-        for fam in self.collect():
-            lines.append(f"# HELP {fam.name} {_esc_help(fam.help)}")
-            lines.append(f"# TYPE {fam.name} {fam.type}")
-            if fam.type == "histogram":
-                for labels, h in fam.samples:
-                    cum = 0
-                    bounds = list(h["bounds"]) + [math.inf]
-                    for le, c in zip(bounds, h["counts"]):
-                        cum += c
-                        lab = dict(labels)
-                        lab["le"] = _fmt_float(le)
-                        lines.append(f"{fam.name}_bucket{_fmt_labels(lab)} "
-                                     f"{cum}")
-                    lines.append(f"{fam.name}_sum{_fmt_labels(labels)} "
-                                 f"{_fmt_float(h['sum'])}")
-                    lines.append(f"{fam.name}_count{_fmt_labels(labels)} "
-                                 f"{h['count']}")
-            else:
-                for labels, value in fam.samples:
-                    lines.append(f"{fam.name}{_fmt_labels(labels)} "
-                                 f"{_fmt_float(value)}")
-        return "\n".join(lines) + "\n"
+        return render_families_prometheus(self.collect())
 
     def render_json(self) -> str:
         """JSON export of the same snapshot (bench rows, flight dumps)."""
         return json.dumps(self.snapshot(), sort_keys=True)
 
     def snapshot(self) -> Dict[str, Any]:
-        out: Dict[str, Any] = {}
-        for fam in self.collect():
-            out[fam.name] = {
-                "type": fam.type,
-                "help": fam.help,
-                "samples": [{"labels": labels, "value": value}
-                            for labels, value in fam.samples],
-            }
-        return out
+        return families_snapshot(self.collect())
+
+
+# -- family-list exporters (shared by the registry and merged views) ----------
+
+
+def render_families_prometheus(fams: Iterable[MetricFamily]) -> str:
+    """Prometheus text exposition (format 0.0.4) of a family list —
+    the one renderer behind ``MetricsRegistry.render_prometheus`` AND
+    fleet-aggregated views (:func:`merge_exports`), so a replica and a
+    router scrape identically."""
+    lines: List[str] = []
+    for fam in fams:
+        lines.append(f"# HELP {fam.name} {_esc_help(fam.help)}")
+        lines.append(f"# TYPE {fam.name} {fam.type}")
+        if fam.type == "histogram":
+            for labels, h in fam.samples:
+                cum = 0
+                bounds = list(h["bounds"]) + [math.inf]
+                for le, c in zip(bounds, h["counts"]):
+                    cum += c
+                    lab = dict(labels)
+                    lab["le"] = _fmt_float(le)
+                    lines.append(f"{fam.name}_bucket{_fmt_labels(lab)} "
+                                 f"{cum}")
+                lines.append(f"{fam.name}_sum{_fmt_labels(labels)} "
+                             f"{_fmt_float(h['sum'])}")
+                lines.append(f"{fam.name}_count{_fmt_labels(labels)} "
+                             f"{h['count']}")
+        else:
+            for labels, value in fam.samples:
+                lines.append(f"{fam.name}{_fmt_labels(labels)} "
+                             f"{_fmt_float(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def families_snapshot(fams: Iterable[MetricFamily]) -> Dict[str, Any]:
+    """JSON-shaped snapshot of a family list (the ``render_json``
+    payload)."""
+    out: Dict[str, Any] = {}
+    for fam in fams:
+        out[fam.name] = {
+            "type": fam.type,
+            "help": fam.help,
+            "samples": [{"labels": labels, "value": value}
+                        for labels, value in fam.samples],
+        }
+    return out
+
+
+def validate_families(fams: Iterable[MetricFamily]) -> List[str]:
+    """Naming-convention violations of a family list (empty == clean);
+    the per-family half of ``MetricsRegistry.validate``, shared with
+    merged fleet exports so an aggregated ``/metrics`` is held to the
+    same contract as a single process's."""
+    out: List[str] = []
+    seen_series: Dict[str, str] = {}
+    for fam in fams:
+        out.extend(_family_violations(fam))
+        for labels, _ in fam.samples:
+            for ln in labels:
+                if not LABEL_NAME_RE.match(ln):
+                    out.append(f"{fam.name}: bad label name {ln!r}")
+            key = _series_key(fam.name, labels)
+            if key in seen_series:
+                out.append(f"duplicate series {key} (missing an "
+                           "'inst' label on a per-instance collector?)")
+            seen_series[key] = fam.name
+    return out
+
+
+def merge_exports(named: Dict[str, Iterable[MetricFamily]],
+                  label: str = "replica") -> List[MetricFamily]:
+    """Merge several publishers' family lists into one export, stamping
+    every sample with ``{label: name}`` — the fleet-aggregation
+    primitive: a router calls each replica's ``telemetry_families()``
+    and serves the merged result from ONE ``/metrics`` endpoint, each
+    series distinguishable by its ``replica`` label. Same-name families
+    merge into one (first publisher's type/help win — replicas of one
+    fleet publish identical declarations); a source whose sample
+    already carries ``label`` is left alone (nested merges don't
+    re-stamp)."""
+    if not LABEL_NAME_RE.match(label):
+        raise ValueError(f"merge label {label!r} violates the label "
+                         "naming convention")
+    merged: Dict[str, MetricFamily] = {}
+    for name in sorted(named):
+        for fam in named[name]:
+            have = merged.get(fam.name)
+            if have is None:
+                have = merged[fam.name] = MetricFamily(fam.name, fam.type,
+                                                       fam.help)
+            for labels, value in fam.samples:
+                stamped = dict(labels)
+                stamped.setdefault(label, name)
+                have.add(stamped, value)
+    return [merged[k] for k in sorted(merged)]
+
+
+class FamiliesView:
+    """Registry-shaped read-only view over a families callback: the
+    duck type :class:`~paddle_tpu.telemetry.http.TelemetryServer`
+    scrapes (``render_prometheus``/``render_json``) without being a
+    :class:`MetricsRegistry` — how a fleet router serves its replicas'
+    MERGED series from one endpoint."""
+
+    def __init__(self, collect_fn: Callable[[], List[MetricFamily]]):
+        self._collect_fn = collect_fn
+
+    def collect(self) -> List[MetricFamily]:
+        return self._collect_fn()
+
+    def render_prometheus(self) -> str:
+        return render_families_prometheus(self.collect())
+
+    def snapshot(self) -> Dict[str, Any]:
+        return families_snapshot(self.collect())
+
+    def render_json(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+    def validate(self) -> List[str]:
+        return validate_families(self.collect())
 
 
 def _series_key(name: str, labels: Dict[str, str]) -> str:
@@ -494,7 +570,9 @@ def get_registry() -> MetricsRegistry:
 
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricFamily", "MetricsRegistry",
-    "METRIC_NAME_RE", "DEFAULT_TIME_BUCKETS", "counter_deltas",
-    "counter_family", "gauge_family", "histogram_family", "get_registry",
+    "Counter", "FamiliesView", "Gauge", "Histogram", "MetricFamily",
+    "MetricsRegistry", "METRIC_NAME_RE", "DEFAULT_TIME_BUCKETS",
+    "counter_deltas", "counter_family", "families_snapshot", "gauge_family",
+    "get_registry", "histogram_family", "merge_exports",
+    "render_families_prometheus", "validate_families",
 ]

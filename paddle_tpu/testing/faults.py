@@ -20,7 +20,11 @@ no timing races):
   executable, drives the dispatch watchdog), :func:`failing_predictor`
   (crash-looping executable, drives the circuit breaker) — with call
   counts shared across ``clone()`` so a worker pool sees one fault
-  script, not one per worker.
+  script, not one per worker; :func:`kill_server` is the replica-death
+  drill for a serving fleet (abrupt ``PredictorServer.kill``:
+  never-dispatched requests fail retryable, dispatched ones fail
+  at-most-once — drives ``FleetRouter``'s reroute contract and
+  ``tools/fleet_drill.py``).
 - **Membership changes**: :func:`visible_devices` /
   :func:`membership_meshes` build deterministic shrunk/grown device
   meshes (the preempted-worker / rejoined-worker analog on the CPU
@@ -274,6 +278,20 @@ def hanging_predictor(base, release: "threading.Event",
         return b.run(feed)
 
     return FaultyPredictor(base, behavior)
+
+
+def kill_server(server, reason: str = "injected replica kill"):
+    """Abrupt replica death for fleet drills: delegates to
+    :meth:`paddle_tpu.serving.PredictorServer.kill` — the in-process
+    stand-in for the serving process being ``kill -9``'d. Queued
+    (never-dispatched) requests fail with ``ServerClosed`` (a
+    ``FleetRouter`` reroutes them), dispatched in-flight requests fail
+    with ``ReplicaDied`` exactly once (at-most-once, never retried),
+    and the flight recorder captures the kill with the in-flight
+    request's span. Deterministic: no subprocess, no signal timing —
+    the kill happens exactly where the drill calls it."""
+    server.kill(reason=reason)
+    return server
 
 
 def failing_predictor(base, fail_calls: int = 1_000_000,

@@ -28,8 +28,8 @@ def test_priority_order_leads_with_baseline_configs():
     # every registered config appears exactly once
     expect = (set(bench.TRAIN_CONFIGS) | set(bench.INFER_CONFIGS)
               | {"gpt_decode", "dispatch_overhead", "guard_overhead",
-                 "input_pipeline", "serving", "fusion_profile",
-                 "elastic_reshard"})
+                 "input_pipeline", "serving", "serving_fleet",
+                 "fusion_profile", "elastic_reshard"})
     assert set(names) == expect and len(names) == len(expect)
 
 
@@ -243,6 +243,73 @@ def test_serving_row_schema(monkeypatch):
     assert row["reject_rate_saturated"] == {"fp32": 0.5, "int8": 0.5}
     assert row["offered_rps"]["fp32"]["steady_rps"] == 600.0
     assert row["offered_rps"]["fp32"]["saturated_rps"] == 3000.0
+
+
+def test_serving_fleet_quick_overrides(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(bench, "bench_serving_fleet",
+                        lambda peak, **kw: seen.update(kw) or {"v": 1})
+    bench._run_one("serving_fleet", 1.0, quick=True)
+    assert seen == {"requests": 60, "replicas": 2}
+    assert bench._result_key("serving_fleet") == "serving_fleet"
+
+
+def test_serving_fleet_row_schema(monkeypatch):
+    """The serving_fleet row (p99 + throughput/worker at 3x saturation
+    for single-process vs fleet vs coalesced-fleet, with the
+    fleet-vs-single and coalesced-vs-pad-alone deltas) pins its schema:
+    downstream readers compare rounds by these exact keys. Artifact/
+    front/driver are stubbed — the assembly math is pure python."""
+
+    class _Front:
+        def close(self, drain=True, timeout=None):
+            pass
+
+    monkeypatch.setattr(bench, "_fleet_artifact",
+                        lambda bs: ("DIR", {"x": 1}))
+    monkeypatch.setattr(
+        bench, "_make_fleet_front",
+        lambda dirname, variant, replicas, workers, queue_size,
+        max_wait_ms: _Front())
+    monkeypatch.setattr(bench, "_calibrate_serving",
+                        lambda front, feed, iters=8: 0.002)
+    lat_by_variant = {"single": 0.004, "fleet": 0.003,
+                      "fleet_coalesced": 0.002}
+    calls = []
+
+    def drive(front, feed, n, rate):
+        variant = ("single", "fleet", "fleet_coalesced")[len(calls)]
+        calls.append(rate)
+        # every variant completes all n in n/100 s, at its own latency
+        return [lat_by_variant[variant]] * n, 0, n / 100.0
+
+    monkeypatch.setattr(bench, "_drive_fleet", drive)
+    row = bench.bench_serving_fleet(1.0, batch_size=8, requests=20,
+                                    replicas=2, workers=1, queue_size=4,
+                                    max_wait_ms=2.0)
+    for key in ("value", "unit", "latency_ms", "throughput_per_worker_rps",
+                "reject_rate", "deltas", "telemetry", "offered_rps",
+                "requests", "replicas", "workers", "queue_size",
+                "batch_size", "max_wait_ms"):
+        assert key in row, key
+    variants = {"single", "fleet", "fleet_coalesced"}
+    assert set(row["latency_ms"]) == variants
+    assert set(row["telemetry"]) == variants
+    for v in row["latency_ms"].values():
+        assert set(v) == {"p50", "p99"}
+    # calibrated ONCE on the single front: 3x * 2 workers / 2ms = 3000
+    # rps offered to every variant
+    assert calls == [3000.0] * 3
+    assert row["offered_rps"] == 3000.0
+    # completed 20 in 0.2s over 2 workers = 50 rps/worker everywhere
+    assert row["throughput_per_worker_rps"] == {
+        "single": 50.0, "fleet": 50.0, "fleet_coalesced": 50.0}
+    assert row["value"] == row["latency_ms"]["fleet_coalesced"]["p99"] == 2.0
+    d = row["deltas"]
+    assert set(d) == {"fleet_vs_single", "coalesced_vs_pad_alone"}
+    assert d["fleet_vs_single"]["p99_ms"] == 3.0 - 4.0
+    assert d["coalesced_vs_pad_alone"]["p99_ms"] == 2.0 - 3.0
+    assert d["fleet_vs_single"]["throughput_per_worker_ratio"] == 1.0
 
 
 def test_input_pipeline_row_schema(monkeypatch):

@@ -1,0 +1,599 @@
+"""paddle_tpu.fleet acceptance suite: continuous batching + the
+replicated serving fleet. All CPU + deterministic fault injection.
+
+The acceptance contracts:
+
+  * coalesced-batch results are BIT-identical to the same requests run
+    pad-alone through Predictor.run, with compiles_since_warmup == 0
+    after warmup (the batching scheduler only ever fills precompiled
+    buckets);
+  * per-request deadlines/spans/validation survive coalescing (an
+    expired group member is dropped unexecuted; each member's journal
+    timeline carries its own span);
+  * kill-one-replica under load: zero accepted-then-dropped requests —
+    never-dispatched requests reroute transparently, dispatched ones
+    surface ReplicaDied exactly once; fleet health degrades and
+    recovers; the flight recorder captures the kill with an in-flight
+    span;
+  * rolling reload canaries one replica and rolls back fleet-wide on
+    failure with zero dropped in-flight requests;
+  * the aggregated /metrics merges every replica's series under a
+    `replica` label and stays naming-convention clean;
+  * batched int8-KV decode through the scheduler equals sequential
+    decode;
+  * tools/fleet_drill.py passes its own contracts (exit 0).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import paddle_tpu as pt
+from paddle_tpu import io as pio
+from paddle_tpu import serving, telemetry
+from paddle_tpu.fleet import BatchPolicy, FleetRouter, NoReplicaAvailable
+from paddle_tpu.fleet import batching as fbatch
+from paddle_tpu.serving import (CircuitOpen, DeadlineExceeded,
+                                PredictorServer, ReloadFailed, ReplicaDied,
+                                ServerClosed, ServerOverloaded)
+from paddle_tpu.telemetry.journal import RunJournal
+from paddle_tpu.testing import faults
+
+
+def _feed(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"image": rng.randn(n, 784).astype(np.float32),
+            "label": rng.randint(0, 10, (n, 1)).astype(np.int64)}
+
+
+def _single(feed, i):
+    return {k: np.asarray(v)[i:i + 1] for k, v in feed.items()}
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    from paddle_tpu.models import mnist
+
+    d = str(tmp_path_factory.mktemp("fleet") / "model")
+    prog = pt.build(mnist.mlp)
+    feed8 = _feed(8)
+    params, state = prog.init(jax.random.PRNGKey(0), **feed8)
+    pio.save_inference_model(d, prog, jax.tree.map(np.asarray, params),
+                             state, feed8, batch_buckets=[4, 8])
+    return {"dir": d, "prog": prog, "params": params, "state": state,
+            "feed8": feed8}
+
+
+@pytest.fixture(scope="module")
+def pred(artifact):
+    return pio.load_inference_model(artifact["dir"])
+
+
+@pytest.fixture()
+def fresh_journal():
+    old = telemetry.set_journal(RunJournal())
+    try:
+        yield telemetry.get_journal()
+    finally:
+        telemetry.set_journal(old)
+
+
+def _export_variant(artifact, tmp_path, name, mutate):
+    params = jax.tree.map(np.asarray, artifact["params"])
+    params = mutate(params)
+    d = str(tmp_path / name)
+    pio.save_inference_model(d, artifact["prog"], params, artifact["state"],
+                             artifact["feed8"], batch_buckets=[4, 8])
+    return d
+
+
+# -- batching planner units ---------------------------------------------------
+
+
+class _FakeReq:
+    def __init__(self, n, feed):
+        self.n = n
+        self.feed = feed
+
+
+def test_pick_bucket_and_row_spans():
+    assert fbatch.pick_bucket(1, [4, 8]) == 4
+    assert fbatch.pick_bucket(5, [4, 8]) == 8
+    assert fbatch.pick_bucket(8, [4, 8]) == 8
+    with pytest.raises(ValueError, match="exceed the largest"):
+        fbatch.pick_bucket(9, [4, 8])
+    group = [_FakeReq(2, None), _FakeReq(1, None), _FakeReq(3, None)]
+    assert fbatch.row_spans(group) == [(0, 2), (2, 1), (3, 3)]
+
+
+def test_merge_feeds_and_nonbatched_key():
+    f1 = {"x": np.arange(4, dtype=np.float32).reshape(2, 2),
+          "k": np.float32(7.0)}
+    f2 = {"x": np.arange(4, 6, dtype=np.float32).reshape(1, 2),
+          "k": np.float32(7.0)}
+    f3 = {"x": np.zeros((1, 2), np.float32), "k": np.float32(8.0)}
+    names, batched = ["k", "x"], {"x"}
+    assert fbatch.nonbatched_key(f1, names, batched) == \
+        fbatch.nonbatched_key(f2, names, batched)
+    assert fbatch.nonbatched_key(f1, names, batched) != \
+        fbatch.nonbatched_key(f3, names, batched)
+    merged = fbatch.merge_feeds([_FakeReq(2, f1), _FakeReq(1, f2)],
+                                names, batched, bucket=4)
+    assert merged["x"].shape == (4, 2)
+    np.testing.assert_array_equal(merged["x"][:3],
+                                  np.concatenate([f1["x"], f2["x"]]))
+    np.testing.assert_array_equal(merged["x"][3:], 0)
+    assert merged["k"] == np.float32(7.0)
+
+
+def test_slice_rows_identity_and_slicing():
+    out = {"y": np.arange(8), "scalar": np.float32(1.0)}
+    assert fbatch.slice_rows(out, 0, 8, 8) is out       # whole bucket
+    part = fbatch.slice_rows(out, 2, 3, 8)
+    np.testing.assert_array_equal(part["y"], [2, 3, 4])
+    assert part["scalar"] == np.float32(1.0)            # non-bucket leaf whole
+
+
+# -- continuous batching through PredictorServer ------------------------------
+
+
+def test_coalesced_bit_identical_to_pad_alone_zero_compiles(
+        pred, fresh_journal):
+    """THE batching acceptance pin: singles coalesce into one bucket
+    dispatch, every caller's sliced rows are BIT-identical to the same
+    request run pad-alone through Predictor.run into the bucket the
+    scheduler dispatched (same executable — the scheduler only turns
+    pad rows into real rows; each request's dispatched bucket is read
+    back from its span's journal event), and the AOT compile count
+    never moves."""
+    feed8 = _feed(8, seed=3)
+
+    def pad_alone(f, n, b):
+        padded = {k: np.concatenate(
+            [np.asarray(v),
+             np.zeros((b - n,) + np.asarray(v).shape[1:],
+                      np.asarray(v).dtype)]) for k, v in f.items()}
+        return np.asarray(pred.run(padded)["logits"])[:n]
+
+    def dispatched_bucket(p):
+        ev = [e for e in fresh_journal.recent(span=p.span)
+              if e["kind"] == "serving.dispatch"]
+        assert len(ev) == 1
+        return ev[0]["bucket"]
+
+    srv = PredictorServer(pred, workers=1, queue_size=32,
+                          batch_policy=BatchPolicy(max_wait_ms=50.0))
+    try:
+        before = pio.aot_compile_count()
+        pends = [srv.submit(_single(feed8, i)) for i in range(6)]
+        pends.append(srv.submit({k: np.asarray(v)[:2]
+                                 for k, v in feed8.items()}))
+        outs = [np.asarray(p.result(timeout=60)["logits"]) for p in pends]
+        for i in range(6):
+            assert outs[i].shape == (1, 10)
+            assert outs[i].tobytes() == pad_alone(
+                _single(feed8, i), 1, dispatched_bucket(pends[i])).tobytes()
+        assert outs[6].tobytes() == pad_alone(
+            {k: np.asarray(v)[:2] for k, v in feed8.items()}, 2,
+            dispatched_bucket(pends[6])).tobytes()
+        rep = srv.report()
+        assert pio.aot_compile_count() == before
+        assert rep["compiles_since_warmup"] == 0
+        assert rep["coalesced_batches"] >= 1
+        assert rep["coalesced_requests"] >= 4
+        assert rep["completed"] == 7
+    finally:
+        srv.close(drain=True, timeout=30)
+
+
+def test_coalesced_full_bucket_request_still_bit_identical(pred):
+    """A request that IS a whole bucket passes through untouched (the
+    PR-5 bit-identity contract survives batch_policy)."""
+    feed8 = _feed(8, seed=4)
+    golden = np.asarray(pred.run(feed8)["logits"])
+    srv = PredictorServer(pred, workers=1, queue_size=8,
+                          batch_policy=BatchPolicy(max_wait_ms=1.0))
+    try:
+        got = np.asarray(srv.run(feed8, timeout=60)["logits"])
+        assert got.tobytes() == golden.tobytes()
+    finally:
+        srv.close(drain=True, timeout=30)
+
+
+def test_coalesce_preserves_deadlines_and_spans(pred, fresh_journal):
+    """A group member whose deadline expired while queued is dropped
+    WITHOUT executing; each member's journal timeline carries its own
+    span with submit→dispatch→complete and the coalesced row map."""
+    release = threading.Event()
+    hang = faults.hanging_predictor(pred, release, hang_calls=1)
+    srv = PredictorServer(hang, workers=1, queue_size=16, warmup=False,
+                          watchdog_timeout=30.0,
+                          batch_policy=BatchPolicy(max_wait_ms=1.0))
+    try:
+        feed8 = _feed(8)
+        blocker = srv.submit(feed8)          # wedges the lone worker
+        time.sleep(0.05)
+        expiring = srv.submit(_single(feed8, 0), deadline=0.01)
+        live = [srv.submit(_single(feed8, i)) for i in range(1, 4)]
+        time.sleep(0.1)                      # the deadline passes queued
+        release.set()
+        blocker.result(timeout=60)
+        with pytest.raises(DeadlineExceeded):
+            expiring.result(timeout=60)
+        for p in live:
+            assert np.asarray(p.result(timeout=60)["logits"]).shape == (1, 10)
+        assert srv.metrics.snapshot()["timeouts"] == 1
+        # span timelines: every live request has its own full lifecycle
+        for p in live:
+            kinds = [e["kind"] for e in fresh_journal.recent(span=p.span)]
+            assert kinds[0] == "serving.submit"
+            assert "serving.dispatch" in kinds
+            assert kinds[-1] == "serving.complete"
+        disp = [e for e in fresh_journal.recent(kind="serving.dispatch")
+                if e.get("coalesced")]
+        assert disp and all("row" in e for e in disp)
+        # the expired member never dispatched
+        assert not [e for e in fresh_journal.recent(kind="serving.dispatch")
+                    if e.get("span") == expiring.span]
+    finally:
+        release.set()
+        srv.close(drain=False, timeout=5)
+
+
+def test_coalesced_error_fails_every_member_typed(pred):
+    flaky = faults.failing_predictor(pred, fail_calls=1)
+    srv = PredictorServer(flaky, workers=1, queue_size=16, warmup=False,
+                          batch_policy=BatchPolicy(max_wait_ms=50.0))
+    try:
+        feed8 = _feed(8)
+        pends = [srv.submit(_single(feed8, i)) for i in range(3)]
+        outcomes = []
+        for p in pends:
+            try:
+                p.result(timeout=60)
+                outcomes.append("ok")
+            except RuntimeError:
+                outcomes.append("err")
+        # the injected failure hits ONE dispatch: either all three
+        # coalesced into it (all err) or the first dispatch failed and
+        # the rest succeeded — never a hang, never an untyped outcome
+        assert "err" in outcomes
+        m = srv.metrics.snapshot()
+        assert m["errors"] == outcomes.count("err")
+    finally:
+        srv.close(drain=False, timeout=5)
+
+
+# -- fleet router -------------------------------------------------------------
+
+
+def test_router_routes_around_dead_replica_and_health(pred):
+    servers = {"r0": PredictorServer(pred, workers=1, queue_size=8),
+               "r1": PredictorServer(pred.clone(), workers=1, queue_size=8)}
+    router = FleetRouter(servers)
+    try:
+        feed8 = _feed(8)
+        assert router.health()["state"] == "ready"
+        faults.kill_server(router.replica("r0"))
+        h = router.health()
+        assert h["state"] == "degraded" and h["ready"]
+        for _ in range(3):   # routing skips the dead replica
+            out = router.run(feed8, timeout=60)
+            assert np.asarray(out["logits"]).shape == (8, 10)
+        assert router.report()["routed"]["r1"] >= 3
+        # adopted fleet: replace() needs an explicit server
+        with pytest.raises(ValueError, match="explicit server"):
+            router.replace("r0")
+        router.replace("r0", PredictorServer(pred.clone(), workers=1,
+                                             queue_size=8))
+        assert router.health()["state"] == "ready"
+    finally:
+        router.close(drain=False, timeout=10)
+
+
+def test_router_front_door_shed_overload_and_deadline(pred):
+    release = threading.Event()
+    hang = faults.hanging_predictor(pred, release, hang_calls=2)
+    servers = [PredictorServer(hang, workers=1, queue_size=1, warmup=False,
+                               watchdog_timeout=30.0),
+               PredictorServer(hang.clone(), workers=1, queue_size=1,
+                               warmup=False, watchdog_timeout=30.0)]
+    router = FleetRouter(servers, default_deadline=30.0)
+    try:
+        feed8 = _feed(8)
+        pends = []
+        shed_err = None
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and shed_err is None:
+            try:
+                pends.append(router.submit(feed8))  # wedges, then fills
+            except ServerOverloaded as err:
+                shed_err = err
+        assert shed_err is not None, "fleet never shed under saturation"
+        assert shed_err.capacity == 2   # summed front-door capacities
+        rep = router.report()
+        assert rep["shed"] >= 1
+        assert rep["submitted"] == len(pends)   # shed ≠ accepted intake
+        release.set()
+        for p in pends:
+            p.result(timeout=60)
+    finally:
+        release.set()
+        router.close(drain=False, timeout=10)
+
+
+def test_kill_drill_zero_dropped_at_saturation(artifact, fresh_journal):
+    """THE kill acceptance pin, at ~3x saturation: kill one replica
+    mid-load → zero accepted-then-dropped (no ServerClosed surfaces),
+    never-dispatched requests reroute, dispatched ones surface
+    ReplicaDied exactly once, health degrades and recovers, and the
+    flight recorder holds the kill with an in-flight span."""
+    router = FleetRouter.spawn(artifact["dir"], replicas=3, workers=1,
+                               queue_size=16,
+                               batch_policy=BatchPolicy(max_wait_ms=2.0))
+    try:
+        feed8 = artifact["feed8"]
+        # measure service rate, then offer 3x
+        for _ in range(2):
+            router.run(feed8, timeout=60)
+        t0 = time.perf_counter()
+        for _ in range(6):
+            router.run(feed8, timeout=60)
+        svc = (time.perf_counter() - t0) / 6
+        interval = svc / 3.0 / 3          # 3 workers at 3x saturation
+        pends, shed = [], 0
+        states_during = []
+        for i in range(60):
+            try:
+                pends.append(router.submit(_single(feed8, i % 8)))
+            except (ServerOverloaded, CircuitOpen, NoReplicaAvailable):
+                shed += 1
+            if i == 20:
+                faults.kill_server(router.replica("r1"))
+                states_during.append(router.health()["state"])
+            time.sleep(interval)
+        ok, died, dropped = 0, [], []
+        for p in pends:
+            try:
+                p.result(timeout=60)
+                ok += 1
+            except ReplicaDied:
+                died.append(p)
+            except BaseException as e:
+                dropped.append(e)
+        assert not dropped, f"accepted requests dropped: {dropped[:3]}"
+        assert ok + len(died) == len(pends)
+        assert states_during == ["degraded"]
+        router.replace("r1")
+        assert router.health()["state"] == "ready"
+        assert router.run(feed8, timeout=60) is not None
+        # the flight recorder captured the kill; if requests were
+        # in-flight, the dump's span belongs to one of them
+        dumps = [d for d in telemetry.get_recorder().dumps
+                 if "replica_killed" in d]
+        assert dumps
+        with open(os.path.join(dumps[-1], "flight.json")) as f:
+            meta = json.load(f)
+        assert meta["trigger"] == "replica_killed"
+        if died:
+            assert meta["span"] in {p.span for p in died}
+        rep = router.report()
+        assert rep["rerouted"] >= 0 and rep["replicas_replaced"] == 1
+    finally:
+        router.close(drain=False, timeout=10)
+
+
+def test_rolling_reload_fans_out_with_zero_drops(artifact, tmp_path):
+    d2 = _export_variant(artifact, tmp_path, "v2",
+                         lambda p: jax.tree.map(lambda v: v * 0.5, p))
+    router = FleetRouter.spawn(artifact["dir"], replicas=2, workers=1,
+                               queue_size=16,
+                               golden_feed=artifact["feed8"])
+    errors, results = [], []
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            try:
+                results.append(router.run(artifact["feed8"], timeout=60))
+            except ServerOverloaded:
+                pass
+            except BaseException as e:
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=pump)
+    t.start()
+    try:
+        time.sleep(0.05)
+        gens = router.reload(d2)
+        assert gens == {"r0": 2, "r1": 2}
+        assert router.dirname == d2
+        stop.set()
+        t.join(timeout=60)
+        assert not errors                # zero dropped in-flight
+        assert len(results) >= 1
+        assert router.report()["reloads"] == 1
+        # a sibling's off-path reload must not read as a request-path
+        # recompile: the router re-pins the whole fleet (the AOT
+        # counter is process-wide)
+        for n in router.replica_names:
+            assert router.replica(n).report()["compiles_since_warmup"] == 0
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        router.close(drain=True, timeout=30)
+
+
+def test_rolling_reload_failed_canary_rolls_back_fleet_wide(
+        artifact, tmp_path):
+    d_nan = _export_variant(
+        artifact, tmp_path, "vnan",
+        lambda p: jax.tree.map(lambda v: np.full_like(v, np.nan), p))
+    router = FleetRouter.spawn(artifact["dir"], replicas=2, workers=1,
+                               queue_size=16,
+                               golden_feed=artifact["feed8"])
+    inflight = []
+    try:
+        inflight = [router.submit(artifact["feed8"]) for _ in range(3)]
+        with pytest.raises(ReloadFailed, match="non-finite"):
+            router.reload(d_nan)
+        # fleet untouched: every replica still generation 1, previous
+        # artifact still on record, in-flight requests all complete
+        assert all(router.replica(n).generation == 1
+                   for n in router.replica_names)
+        assert router.dirname == artifact["dir"]
+        for p in inflight:
+            p.result(timeout=60)
+        assert router.report()["reload_failures"] == 1
+    finally:
+        router.close(drain=True, timeout=30)
+
+
+def test_rolling_reload_mid_rollout_failure_rolls_back(artifact, tmp_path,
+                                                       pred):
+    """Canary passes, a LATER replica rejects → every already-swapped
+    replica is rolled back to the previous artifact."""
+    d2 = _export_variant(artifact, tmp_path, "v2mid",
+                         lambda p: jax.tree.map(lambda v: v * 0.5, p))
+    golden_v1 = np.asarray(pred.run(artifact["feed8"])["logits"])
+    servers = {
+        "r0": PredictorServer(pred.clone(), workers=1, queue_size=8,
+                              golden_feed=artifact["feed8"]),
+        # r1 vetoes every candidate: the mid-rollout failure
+        "r1": PredictorServer(pred.clone(), workers=1, queue_size=8,
+                              golden_feed=artifact["feed8"],
+                              canary_check=lambda out: False),
+    }
+    router = FleetRouter(servers, dirname=artifact["dir"])
+    try:
+        with pytest.raises(ReloadFailed, match="rolled back"):
+            router.reload(d2)
+        # r0 swapped to v2 then back to v1: generation 3, v1 outputs
+        assert router.replica("r0").generation == 3
+        got = np.asarray(
+            router.replica("r0").run(artifact["feed8"],
+                                     timeout=60)["logits"])
+        assert got.tobytes() == golden_v1.tobytes()
+        assert router.dirname == artifact["dir"]
+        assert router.report()["reload_rollbacks"] == 1
+    finally:
+        router.close(drain=True, timeout=30)
+
+
+# -- aggregated telemetry -----------------------------------------------------
+
+
+def test_fleet_metrics_merge_replica_labels_and_validate_clean(pred):
+    servers = {"a": PredictorServer(pred, workers=1, queue_size=8),
+               "b": PredictorServer(pred.clone(), workers=1, queue_size=8)}
+    router = FleetRouter(servers)
+    try:
+        feed8 = _feed(8)
+        for _ in range(3):
+            router.run(feed8, timeout=60)
+        fams = router.metrics_families()
+        assert telemetry.validate_families(fams) == []
+        by_name = {f.name: f for f in fams}
+        sub = by_name["paddle_tpu_serving_submitted_total"]
+        assert {s[0]["replica"] for s in sub.samples} == {"a", "b"}
+        assert sum(v for _, v in sub.samples) == 3
+        routed = by_name["paddle_tpu_fleet_routed_total"]
+        assert all(s[0]["replica"] in ("a", "b", "router")
+                   for s in routed.samples)
+        # the endpoint serves the merged export, text AND json
+        ts = router.serve_metrics()
+        text = urllib.request.urlopen(ts.url + "/metrics").read().decode()
+        assert 'replica="a"' in text and 'replica="b"' in text
+        assert "paddle_tpu_fleet_submitted_total" in text
+        js = json.loads(urllib.request.urlopen(
+            ts.url + "/metrics?format=json").read().decode())
+        assert "paddle_tpu_fleet_routed_total" in js
+        health = json.loads(urllib.request.urlopen(
+            ts.url + "/healthz").read().decode())
+        assert health["state"] == "ready"
+        assert health["replicas_ready"] == 2
+    finally:
+        router.close(drain=True, timeout=30)
+
+
+def test_merge_exports_unit():
+    from paddle_tpu.telemetry.registry import counter_family, merge_exports
+
+    fams = merge_exports(
+        {"r0": [counter_family("paddle_tpu_x_y_total", "h",
+                               [({"inst": "0"}, 1)])],
+         "r1": [counter_family("paddle_tpu_x_y_total", "h",
+                               [({"inst": "0"}, 2)])]})
+    assert len(fams) == 1
+    assert sorted((s[0]["replica"], s[1]) for s in fams[0].samples) == \
+        [("r0", 1), ("r1", 2)]
+    # pre-stamped labels survive (nested merges don't re-stamp)
+    fams = merge_exports(
+        {"outer": [counter_family("paddle_tpu_x_y_total", "h",
+                                  [({"replica": "inner"}, 5)])]})
+    assert fams[0].samples[0][0]["replica"] == "inner"
+    with pytest.raises(ValueError, match="label"):
+        merge_exports({}, label="BAD LABEL")
+
+
+# -- decode workload ----------------------------------------------------------
+
+
+def test_batched_int8_kv_decode_equals_sequential(tmp_path):
+    """ROADMAP item (c): incremental decoding with the int8 KV cache
+    as a SERVED workload — N single-prompt requests coalesced by the
+    batching scheduler emit exactly the tokens each prompt gets from a
+    sequential pad-alone decode, with zero request-path compiles."""
+    from paddle_tpu.fleet import decode as fdecode
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.base_config(vocab_size=16, max_len=32, d_model=32,
+                          d_inner=64, num_heads=4, num_layers=2,
+                          use_flash=False, fused_ce=False,
+                          kv_cache_dtype="int8")
+    d = str(tmp_path / "decoder")
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(3, 16, (4, 8)).astype(np.int32)
+    fdecode.export_decoder(d, cfg, max_new_tokens=6,
+                           example_prompt=prompts, batch_buckets=[1, 4])
+    pred = pio.load_inference_model(d)
+    sequential = [np.asarray(pred.run({"prompt_ids": prompts[i:i + 1]})
+                             ["ids"]) for i in range(4)]
+    srv = fdecode.decode_server(d, max_wait_ms=50.0, workers=1)
+    try:
+        pends = [srv.submit({"prompt_ids": prompts[i:i + 1]})
+                 for i in range(4)]
+        outs = [np.asarray(p.result(timeout=120)["ids"]) for p in pends]
+        for i in range(4):
+            np.testing.assert_array_equal(outs[i], sequential[i])
+        rep = srv.report()
+        assert rep["compiles_since_warmup"] == 0
+        assert rep["coalesced_requests"] >= 2
+    finally:
+        srv.close(drain=True, timeout=60)
+
+
+# -- the drill tool (tier-1) --------------------------------------------------
+
+
+def test_fleet_drill_tool_passes():
+    from tools import fleet_drill
+
+    assert fleet_drill.main(["--replicas", "2", "--requests", "45"]) == 0
+
+
+def test_fleet_drill_tool_rejects_unknown_drill():
+    from tools import fleet_drill
+
+    assert fleet_drill.main(["--drills", "nope"]) == 3

@@ -349,6 +349,72 @@ def test_journal_sink_safe_under_concurrent_emitters(tmp_path):
     assert seqs == list(range(1, n_threads * per + 1))
 
 
+def test_journal_sampling_deterministic_and_span_consistent():
+    """The high-QPS pressure valve: per-kind sampling is keyed on the
+    span's hash, so one request's submit→dispatch→complete events share
+    a fate (a sampled-in submit KEEPS its lifecycle), the same traffic
+    journals the same events every run (no random), unconfigured kinds
+    always keep, and dropped events still consume a seq."""
+    j = RunJournal(sample={"serving": 0.5})
+    spans = [j.new_span() for _ in range(40)]
+    for s in spans:
+        j.emit("serving.submit", span=s)
+        j.emit("serving.dispatch", span=s)
+        j.emit("serving.complete", span=s)
+        j.emit("trainer.dispatch", span=s)     # unconfigured: always kept
+    events = j.recent()
+    per_span = {}
+    for e in events:
+        per_span.setdefault(e["span"], []).append(e["kind"])
+    kept = {s for s, ks in per_span.items()
+            if any(k.startswith("serving.") for k in ks)}
+    assert 0 < len(kept) < 40                  # some sampled out
+    for s in kept:                             # span-consistent: all 3
+        assert [k for k in per_span[s] if k.startswith("serving.")] == \
+            ["serving.submit", "serving.dispatch", "serving.complete"]
+    assert all("trainer.dispatch" in ks for ks in per_span.values())
+    assert j.dropped_sampled == 3 * (40 - len(kept))
+    # dropped events consume seqs: the last seq counts every emit
+    assert j.seq == 4 * 40
+    # deterministic: a fresh journal with the same spans keeps the same
+    j2 = RunJournal(sample={"serving": 0.5})
+    for s in spans:
+        j2.emit("serving.submit", span=s)
+    assert {e["span"] for e in j2.recent()} == kept
+    # rate 0/1 edges + longest-prefix matching + the catch-all
+    assert j.sample_rate("serving.submit") == 0.5
+    j.set_sample({"serving": 0.0, "serving.hang": 1.0, "*": 0.25})
+    assert j.sample_rate("serving.hang") == 1.0     # exact beats prefix
+    assert j.sample_rate("serving.submit") == 0.0
+    assert j.sample_rate("ps.push") == 0.25         # catch-all
+    before = len(j.recent())
+    j.emit("serving.submit", span=j.new_span())
+    assert len(j.recent()) == before                # rate 0 drops
+    j.emit("serving.hang", span=j.new_span())
+    assert j.recent()[-1]["kind"] == "serving.hang"  # rate 1 keeps
+
+
+def test_journal_sampling_env_knob(monkeypatch):
+    from paddle_tpu.telemetry.journal import parse_sample
+
+    assert parse_sample("serving=0.01, ps=0.5") == \
+        {"serving": 0.01, "ps": 0.5}
+    # malformed entries are skipped, rates clamp to [0, 1]
+    assert parse_sample("bad, x=zz, y=3.0, z=-1") == {"y": 1.0, "z": 0.0}
+    assert parse_sample(None) == {} and parse_sample("") == {}
+    # the process journal honors PDTPU_JOURNAL_SAMPLE at creation
+    monkeypatch.setenv("PDTPU_JOURNAL_SAMPLE", "serving=0.0")
+    old = telemetry.set_journal(None)
+    try:
+        j = telemetry.get_journal()
+        j.emit("serving.submit", span=j.new_span())
+        j.emit("other.kind")
+        assert [e["kind"] for e in j.recent()] == ["other.kind"]
+        assert j.dropped_sampled == 1
+    finally:
+        telemetry.set_journal(old)
+
+
 # ---------------------------------------------------------------------------
 # flight recorder + dump tool
 # ---------------------------------------------------------------------------

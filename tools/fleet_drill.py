@@ -1,0 +1,335 @@
+#!/usr/bin/env python
+"""Scripted kill/hang/reload drill over a local in-process serving
+fleet — the fire-drill for ``paddle_tpu.fleet.FleetRouter``'s
+availability contracts, using ``paddle_tpu.testing.faults`` injectors
+(deterministic: no subprocess roulette, no signal timing).
+
+    python tools/fleet_drill.py                        # all three drills
+    python tools/fleet_drill.py --drills kill,reload
+    python tools/fleet_drill.py --replicas 3 --requests 90
+
+Drills (each builds its own fresh fleet over a throwaway MNIST-MLP
+artifact, continuous batching on, driven at ~3x measured saturation):
+
+- **kill** — ``faults.kill_server`` on one replica mid-load: every
+  ACCEPTED request must either complete or surface a structured
+  at-most-once error (``ReplicaDied``/``WorkerHung``) exactly once;
+  a surfaced ``ServerClosed`` is a dropped never-dispatched request
+  (the router failed to reroute it) and fails the drill. Fleet
+  ``health()`` must degrade during the outage and recover after
+  ``replace()``; the flight recorder must hold a ``replica_killed``
+  dump carrying an in-flight span.
+- **hang** — a wedged executable on one replica: the hung request
+  surfaces ``WorkerHung`` exactly once, the replica's watchdog +
+  breaker contain the fault, and traffic completes on the rest of the
+  fleet.
+- **reload** — rolling reload under load: a good artifact swaps every
+  replica (generation bumps fleet-wide) with zero request errors; a
+  canary-failing artifact (NaN weights) is rejected with the fleet
+  still on the previous generation — also zero errors.
+
+Exit status: **0** all drills pass; **2** a drill dropped an accepted
+request or failed its contract (each violation printed); **3** the
+drill harness itself crashed (never a verdict).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import threading
+import time
+import traceback
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+EXIT_CLEAN, EXIT_DROPPED, EXIT_INTERNAL = 0, 2, 3
+
+
+def _build_artifact(root, mutate=None, name="model"):
+    """Throwaway MNIST-MLP artifact with bucket set {4, 8}."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import io as pio
+    from paddle_tpu.models import mnist
+
+    d = os.path.join(root, name)
+    prog = pt.build(mnist.mlp)
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.randn(8, 784).astype(np.float32),
+            "label": rng.randint(0, 10, (8, 1)).astype(np.int64)}
+    params, state = prog.init(jax.random.PRNGKey(0), **feed)
+    params = jax.tree.map(np.asarray, params)
+    if mutate is not None:
+        params = mutate(params)
+    pio.save_inference_model(d, prog, params, state, feed,
+                             batch_buckets=[4, 8])
+    return d, feed
+
+
+def _spawn_fleet(dirname, feed, replicas, **kw):
+    from paddle_tpu.fleet import BatchPolicy, FleetRouter
+
+    kw.setdefault("workers", 1)
+    kw.setdefault("queue_size", 16)
+    kw.setdefault("golden_feed", feed)
+    kw.setdefault("batch_policy", BatchPolicy(max_wait_ms=2.0))
+    return FleetRouter.spawn(dirname, replicas=replicas, **kw)
+
+
+def _single_feed(feed, i):
+    import numpy as np
+    return {k: np.asarray(v)[i % 8:i % 8 + 1] for k, v in feed.items()}
+
+
+def _saturation_rate(router, feed):
+    """~3x the fleet's measured capacity (requests/s)."""
+    for _ in range(2):
+        router.run(feed, timeout=120)
+    t0 = time.perf_counter()
+    iters = 8
+    for _ in range(iters):
+        router.run(feed, timeout=120)
+    svc = (time.perf_counter() - t0) / iters
+    total_workers = sum(
+        router.replica(n).num_workers for n in router.replica_names)
+    return 3.0 * total_workers / max(svc, 1e-6)
+
+
+def _drive(router, feed, n, rate, act_at=None, act=None):
+    """Open-loop driver: ``n`` single-row submits at ``rate`` req/s;
+    runs ``act()`` after submit ``act_at``. Returns (accepted pendings,
+    submit-rejected count)."""
+    from paddle_tpu import serving
+
+    pending, rejected = [], 0
+    interval = 1.0 / rate
+    next_t = time.perf_counter()
+    for i in range(n):
+        now = time.perf_counter()
+        if now < next_t:
+            time.sleep(next_t - now)
+        next_t += interval
+        try:
+            pending.append(router.submit(_single_feed(feed, i)))
+        except (serving.ServerOverloaded, serving.CircuitOpen,
+                serving.ServingError):
+            rejected += 1
+        if act is not None and i == act_at:
+            act()
+    return pending, rejected
+
+
+def _collect(pending):
+    """{outcome class name or "ok": count} plus the dropped list."""
+    from paddle_tpu import serving
+
+    outcomes = {"ok": 0}
+    dropped = []
+    for p in pending:
+        try:
+            p.result(timeout=120)
+            outcomes["ok"] += 1
+        except serving.ServerClosed as e:
+            # an accepted-then-dropped request: the router had a live
+            # replica and still surfaced the never-dispatched signal
+            outcomes.setdefault("ServerClosed", 0)
+            outcomes["ServerClosed"] += 1
+            dropped.append(repr(e))
+        except serving.ServingError as e:
+            outcomes.setdefault(type(e).__name__, 0)
+            outcomes[type(e).__name__] += 1
+        except BaseException as e:
+            outcomes.setdefault(f"UNTYPED:{type(e).__name__}", 0)
+            outcomes[f"UNTYPED:{type(e).__name__}"] += 1
+            dropped.append(repr(e))
+    return outcomes, dropped
+
+
+def drill_kill(root, replicas, requests):
+    from paddle_tpu.telemetry import get_recorder
+    from paddle_tpu.testing import faults
+
+    dirname, feed = _build_artifact(root, name="model_kill")
+    router = _spawn_fleet(dirname, feed, replicas)
+    violations = []
+    try:
+        rate = _saturation_rate(router, feed)
+        victim = router.replica_names[1 % len(router.replica_names)]
+        seen_degraded = []
+
+        def kill():
+            faults.kill_server(router.replica(victim))
+            seen_degraded.append(router.health()["state"])
+
+        pending, rejected = _drive(router, feed, requests, rate,
+                                   act_at=requests // 3, act=kill)
+        outcomes, dropped = _collect(pending)
+        print(f"  kill: accepted={len(pending)} shed={rejected} "
+              f"outcomes={outcomes}")
+        if dropped:
+            violations.append(f"dropped accepted request(s): {dropped[:3]}")
+        if seen_degraded and seen_degraded[0] not in ("degraded",
+                                                      "unavailable"):
+            violations.append(
+                f"health did not degrade on kill (saw {seen_degraded[0]})")
+        router.replace(victim)
+        state = router.health()["state"]
+        if state != "ready":
+            violations.append(f"health did not recover after replace "
+                              f"(state={state})")
+        dumps = [d for d in get_recorder().dumps if "replica_killed" in d]
+        if not dumps:
+            violations.append("no replica_killed flight dump recorded")
+    finally:
+        router.close(drain=False, timeout=10)
+    return violations
+
+
+def drill_hang(root, replicas, requests):
+    from paddle_tpu import io as pio, serving
+    from paddle_tpu.fleet import BatchPolicy, FleetRouter
+    from paddle_tpu.testing import faults
+
+    dirname, feed = _build_artifact(root, name="model_hang")
+    release = threading.Event()
+    base = pio.load_inference_model(dirname)
+    kw = dict(workers=1, queue_size=16, warmup=False,
+              batch_policy=BatchPolicy(max_wait_ms=2.0),
+              watchdog_timeout=0.3)
+    servers = {"r0": serving.PredictorServer(
+        faults.hanging_predictor(base, release, hang_calls=1), **kw)}
+    for i in range(1, replicas):
+        servers[f"r{i}"] = serving.PredictorServer(base.clone(), **kw)
+    router = FleetRouter(servers, dirname=dirname)
+    violations = []
+    try:
+        pending, rejected = _drive(router, feed, requests, 200.0)
+        outcomes, dropped = _collect(pending)
+        release.set()
+        print(f"  hang: accepted={len(pending)} shed={rejected} "
+              f"outcomes={outcomes}")
+        if dropped:
+            violations.append(f"dropped accepted request(s): {dropped[:3]}")
+        hung = outcomes.get("WorkerHung", 0)
+        if hung > 1:
+            violations.append(f"hang surfaced {hung} times (must be once)")
+        hangs = router.replica("r0").metrics.snapshot()["hangs"]
+        if hangs != 1:
+            violations.append(f"watchdog recorded {hangs} hangs (expect 1)")
+    finally:
+        release.set()
+        router.close(drain=False, timeout=10)
+    return violations
+
+
+def drill_reload(root, replicas, requests):
+    import numpy as np
+
+    import jax
+    from paddle_tpu import serving
+
+    dirname, feed = _build_artifact(root, name="model_reload")
+    d_v2, _ = _build_artifact(
+        root, name="model_reload_v2",
+        mutate=lambda p: jax.tree.map(lambda v: v * 0.5, p))
+    d_nan, _ = _build_artifact(
+        root, name="model_reload_nan",
+        mutate=lambda p: jax.tree.map(lambda v: np.full_like(v, np.nan), p))
+    router = _spawn_fleet(dirname, feed, replicas)
+    violations = []
+    errors = []
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            try:
+                router.run(feed, timeout=120)
+            except serving.ServerOverloaded:
+                pass
+            except BaseException as e:
+                errors.append(repr(e))
+                return
+
+    t = threading.Thread(target=pump)
+    t.start()
+    try:
+        time.sleep(0.05)
+        gens = router.reload(d_v2)
+        if sorted(gens) != sorted(router.replica_names) or \
+                any(g != 2 for g in gens.values()):
+            violations.append(f"rolling reload did not reach every "
+                              f"replica: {gens}")
+        try:
+            router.reload(d_nan)
+            violations.append("NaN canary was accepted")
+        except (serving.ReloadFailed, Exception) as e:
+            if not isinstance(e, serving.ReloadFailed):
+                violations.append(f"canary failure surfaced untyped: {e!r}")
+        still = {n: router.replica(n).generation
+                 for n in router.replica_names}
+        if any(g != 2 for g in still.values()):
+            violations.append(f"failed canary moved the fleet: {still}")
+        stop.set()
+        t.join(timeout=120)
+        if errors:
+            violations.append(f"in-flight request dropped during reload: "
+                              f"{errors[:3]}")
+        print(f"  reload: generations={still} pump_errors={len(errors)}")
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        router.close(drain=True, timeout=30)
+    return violations
+
+
+DRILLS = {"kill": drill_kill, "hang": drill_hang, "reload": drill_reload}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="kill/hang/reload drill over a local serving fleet")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=90)
+    ap.add_argument("--drills", default="kill,hang,reload",
+                    help="comma list from: kill,hang,reload")
+    args = ap.parse_args(argv)
+    names = [n.strip() for n in args.drills.split(",") if n.strip()]
+    unknown = [n for n in names if n not in DRILLS]
+    if unknown:
+        print(f"fleet_drill: unknown drill(s) {unknown} "
+              f"(know: {sorted(DRILLS)})", file=sys.stderr)
+        return EXIT_INTERNAL
+    try:
+        failed = False
+        with tempfile.TemporaryDirectory(prefix="fleet_drill_") as root:
+            for name in names:
+                print(f"drill: {name}")
+                violations = DRILLS[name](root, args.replicas,
+                                          args.requests)
+                if violations:
+                    failed = True
+                    for v in violations:
+                        print(f"  FAIL: {v}")
+                else:
+                    print("  PASS")
+        if failed:
+            print("fleet_drill: contract violation (exit 2)",
+                  file=sys.stderr)
+            return EXIT_DROPPED
+        print("fleet_drill: all drills passed")
+        return EXIT_CLEAN
+    except Exception:
+        traceback.print_exc()
+        print("fleet_drill: internal error (exit 3) — the harness "
+              "crashed; this is NOT a drill verdict", file=sys.stderr)
+        return EXIT_INTERNAL
+
+
+if __name__ == "__main__":
+    sys.exit(main())
