@@ -21,20 +21,31 @@ every request alone. This package is the next tier:
 - :mod:`decode` — the decode-side serving workload: batched
   incremental decoding with the int8 KV cache served through the
   batching scheduler.
+- :mod:`remote` + :mod:`replica_main` — the **cross-process** fleet:
+  ``FleetRouter.spawn(..., remote=True)`` launches each replica as a
+  separate OS process serving the submit/health/kill/reload surface
+  over the length-prefixed framed wire (trace tokens ride the header;
+  the at-most-once ``ReplicaDied`` contract is re-proven against real
+  SIGKILL and TCP partitions; probe-latency demotion degrades
+  slow-but-alive replicas gracefully).
 
-Drills: ``tools/fleet_drill.py`` (kill/hang/reload over a local fleet,
-exit 0/2). See MIGRATION.md "Serving fleet & continuous batching".
+Drills: ``tools/fleet_drill.py`` (kill/hang/reload over a local
+in-process fleet, pkill/partition over a process fleet, exit 0/2).
+See MIGRATION.md "Serving fleet & continuous batching" and
+"Cross-process fleet".
 """
 
 from .batching import BatchPolicy
 
 _ROUTER_NAMES = ("FleetRouter", "FleetPending", "NoReplicaAvailable")
 _DECODE_NAMES = ("export_decoder", "decode_server")
+_REMOTE_NAMES = ("RemoteReplica", "RemotePending", "ReplicaProcess",
+                 "spawn_replica", "spawn_fleet")
 
 
 def __getattr__(name):
-    # router/decode import serving (which imports batching above):
-    # resolving them lazily keeps the package importable from
+    # router/decode/remote import serving (which imports batching
+    # above): resolving them lazily keeps the package importable from
     # serving.py without a cycle
     if name in _ROUTER_NAMES:
         from . import router
@@ -42,7 +53,10 @@ def __getattr__(name):
     if name in _DECODE_NAMES:
         from . import decode
         return getattr(decode, name)
+    if name in _REMOTE_NAMES:
+        from . import remote
+        return getattr(remote, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
-__all__ = ["BatchPolicy", *_ROUTER_NAMES, *_DECODE_NAMES]
+__all__ = ["BatchPolicy", *_ROUTER_NAMES, *_DECODE_NAMES, *_REMOTE_NAMES]

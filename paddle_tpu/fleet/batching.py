@@ -48,10 +48,38 @@ class BatchPolicy:
 
     ``max_requests``: optional cap on requests per coalesced dispatch
     (None = bounded only by the largest precompiled bucket).
-    """
+
+    ``slo_queue_threshold``: opt-in **SLO-aware batch sizing** (None =
+    legacy always-fill). When the queue depth at coalesce time is BELOW
+    the threshold (low load), the scheduler stops filling at the
+    smallest precompiled bucket that covers the work already here and
+    spends ZERO idle wait — a lone request at low QPS dispatches
+    immediately into the smallest bucket instead of paying
+    ``max_wait_ms`` hoping to fill the largest. At or above the
+    threshold (saturated) the legacy plan applies unchanged, so
+    saturated throughput is untouched. The decision is
+    :meth:`plan` — pure and unit-testable."""
 
     max_wait_ms: float = 2.0
     max_requests: Optional[int] = None
+    slo_queue_threshold: Optional[int] = None
+
+    def plan(self, queue_depth: int, first_rows: int,
+             buckets: Sequence[int]) -> Tuple[int, float]:
+        """The coalescing plan for a dispatch forming NOW: ``(target_
+        rows, idle_wait_ms)``. ``queue_depth`` is the requests still
+        queued behind the seed request, ``first_rows`` the seed's rows.
+        Saturated (or no ``slo_queue_threshold``): fill toward the
+        largest bucket within ``max_wait_ms``. Low load: target the
+        smallest bucket covering the seed plus a row per queued
+        request (already-queued work is still taken for free — the
+        queue drain in the worker loop ignores idle wait), no idle
+        hold."""
+        if self.slo_queue_threshold is None or \
+                queue_depth >= self.slo_queue_threshold:
+            return int(buckets[-1]), self.max_wait_ms
+        want = min(int(first_rows) + int(queue_depth), int(buckets[-1]))
+        return pick_bucket(want, buckets), 0.0
 
 
 def pick_bucket(total_rows: int, buckets: Sequence[int]) -> int:

@@ -150,7 +150,10 @@ class FleetRouter:
 
     def __init__(self, replicas, default_deadline: Optional[float] = None,
                  dirname: Optional[str] = None,
-                 server_kw: Optional[Dict[str, Any]] = None):
+                 server_kw: Optional[Dict[str, Any]] = None,
+                 probe_timeout: Optional[float] = None,
+                 remote: bool = False,
+                 remote_kw: Optional[Dict[str, Any]] = None):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
         if not isinstance(replicas, dict):
@@ -160,6 +163,16 @@ class FleetRouter:
         self.default_deadline = default_deadline
         self.dirname = dirname
         self._server_kw: Dict[str, Any] = dict(server_kw or {})
+        # probe_timeout bounds EVERY replica health probe the router
+        # takes (aggregation and routing): a probe that never returns
+        # (a wedged in-process health(), a partitioned remote whose own
+        # socket bound misbehaves) is abandoned at the bound and the
+        # replica marked unavailable — the router stays responsive.
+        # None (the in-process default) keeps probes inline and free.
+        self.probe_timeout = probe_timeout
+        self._remote = bool(remote)
+        self._remote_kw: Dict[str, Any] = dict(remote_kw or {})
+        self._journal_ship_seq: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._reload_lock = threading.Lock()
         self._rr = 0                     # round-robin tie-breaker
@@ -169,24 +182,60 @@ class FleetRouter:
             "reload_failures": 0}
         self._routed: Dict[str, int] = {n: 0 for n in self._replicas}
         self._telemetry_server = None
-        from ..telemetry import get_journal, get_registry
-        self.journal = get_journal()
+        from ..telemetry import get_registry
         self.telemetry_inst = get_registry().next_instance("fleet")
         self._telemetry_cid = get_registry().add_collector(
             FleetRouter._own_families, owner=self)
+
+    @property
+    def journal(self):
+        # resolved per use, not cached at construction: the process
+        # journal can be swapped (tests, re-rooted sinks) after a
+        # long-lived router was built
+        from ..telemetry import get_journal
+        return get_journal()
 
     # -- construction --------------------------------------------------------
 
     @classmethod
     def spawn(cls, dirname: str, replicas: int = 2,
               default_deadline: Optional[float] = None,
+              remote: bool = False,
+              remote_kw: Optional[Dict[str, Any]] = None,
+              probe_timeout: Optional[float] = None,
               **server_kw) -> "FleetRouter":
-        """Build an in-process fleet from one artifact: the model is
-        loaded (and AOT-compiled) ONCE, then each replica gets its own
-        ``PredictorServer`` over a ``Predictor.clone()`` — executables
-        and device weights shared, queues/workers/breakers per
-        replica. ``server_kw`` (workers, queue_size, batch_policy,
-        golden_feed, ...) applies to every replica."""
+        """Build a fleet from one artifact.
+
+        In-process (default): the model is loaded (and AOT-compiled)
+        ONCE, then each replica gets its own ``PredictorServer`` over a
+        ``Predictor.clone()`` — executables and device weights shared,
+        queues/workers/breakers per replica.
+
+        ``remote=True``: each replica is a separate OS process
+        (:mod:`paddle_tpu.fleet.remote` — ``replica_main`` serving the
+        framed wire), launched concurrently and adopted as
+        :class:`~paddle_tpu.fleet.remote.RemoteReplica` proxies. Each
+        process pays its own artifact load + AOT compile but owns its
+        GIL and dies for real (SIGKILL, partitions). ``remote_kw``
+        tunes the proxies (probe_timeout, slow_after, submit_timeout,
+        ...); the router's ``probe_timeout`` defaults to 2s for a
+        remote fleet so health aggregation is bounded even when a
+        probe wedges.
+
+        ``server_kw`` (workers, queue_size, batch_policy, golden_feed,
+        ...) applies to every replica either way — for a remote fleet
+        it is shipped to the child processes (and re-used verbatim by
+        :meth:`replace` respawns)."""
+        if remote:
+            from . import remote as _remote
+
+            servers = _remote.spawn_fleet(dirname, replicas=replicas,
+                                          remote_kw=remote_kw, **server_kw)
+            return cls(servers, default_deadline=default_deadline,
+                       dirname=dirname, server_kw=server_kw,
+                       probe_timeout=(2.0 if probe_timeout is None
+                                      else probe_timeout),
+                       remote=True, remote_kw=remote_kw)
         from ..io import load_inference_model
 
         base = load_inference_model(dirname)
@@ -195,7 +244,8 @@ class FleetRouter:
             servers[f"r{i}"] = PredictorServer(
                 base if i == 0 else base.clone(), **server_kw)
         return cls(servers, default_deadline=default_deadline,
-                   dirname=dirname, server_kw=server_kw)
+                   dirname=dirname, server_kw=server_kw,
+                   probe_timeout=probe_timeout)
 
     # -- replica access ------------------------------------------------------
 
@@ -226,16 +276,31 @@ class FleetRouter:
                     "the replacement comes up with PredictorServer "
                     "defaults; pass server_kw to FleetRouter to respawn "
                     "with the fleet's real config", name)
-            from ..io import load_inference_model
-            server = PredictorServer(load_inference_model(self.dirname),
-                                     **self._server_kw)
+            if self._remote:
+                # a remote fleet respawns a PROCESS from the artifact —
+                # the recovery half of the process-kill drill
+                from . import remote as _remote
+                server = _remote.spawn_replica(
+                    self.dirname, remote_kw=dict(self._remote_kw,
+                                                 name=name),
+                    **self._server_kw)
+            else:
+                from ..io import load_inference_model
+                server = PredictorServer(
+                    load_inference_model(self.dirname), **self._server_kw)
         with self._lock:
             old = self._replicas.get(name)
             self._replicas[name] = _Replica(name, server)
             self._routed.setdefault(name, 0)
+            self._journal_ship_seq.pop(name, None)
             self._counters["replicas_replaced"] += 1
-        if old is not None and old.server.health()["state"] != "stopped":
-            old.server.kill(reason=f"replaced by router ({name})")
+        if old is not None:
+            try:
+                old_state = old.server.health()["state"]
+            except Exception:  # a dead remote probes as unreachable
+                old_state = "unreachable"
+            if old_state != "stopped":
+                old.server.kill(reason=f"replaced by router ({name})")
         # the replacement's artifact load moved the process-wide AOT
         # counter: re-pin the SIBLINGS' compiles_since_warmup so the
         # off-path load doesn't read as a request-path recompile
@@ -324,10 +389,47 @@ class FleetRouter:
             raise CircuitOpen(min(e.retry_after for e in errors))
         raise NoReplicaAvailable(states)
 
+    def _probe(self, rep: _Replica) -> Dict[str, Any]:
+        """One health probe, bounded by ``probe_timeout`` when set: the
+        probe runs on a throwaway daemon thread that is ABANDONED at
+        the bound (a probe that never returns — a wedged in-process
+        ``health()``, a pathological adoptee — must not wedge routing
+        or ``/healthz`` with it). A replica that declares
+        ``probe_bounded`` (``RemoteReplica``: socket timeout + capped
+        backoff retries + down-verdict cache) is probed INLINE — no
+        thread per health check on the routing hot path."""
+        if self.probe_timeout is None or \
+                getattr(rep.server, "probe_bounded", False):
+            return rep.server.health()
+        box: Dict[str, Any] = {}
+
+        def _go():
+            try:
+                box["h"] = rep.server.health()
+            except BaseException as e:
+                box["e"] = e
+
+        t = threading.Thread(target=_go, daemon=True,
+                             name=f"pdtpu-fleet-probe-{rep.name}")
+        t.start()
+        t.join(self.probe_timeout)
+        if "h" in box:
+            return box["h"]
+        if "e" in box:
+            raise box["e"]
+        raise TimeoutError(
+            f"health probe of replica {rep.name} did not return within "
+            f"{self.probe_timeout}s (probe abandoned)")
+
     def _ranked(self, exclude: set) -> List[Tuple[_Replica, Dict[str, Any]]]:
         """Replicas with their health snapshots, least-loaded first
-        (ready before not-ready; load = queued + busy workers; ties
-        broken round-robin so equal-load replicas share traffic)."""
+        (ready before not-ready; among ready ones probe-latency
+        DEMOTION applies first — a slow-but-alive replica (health
+        ``slow``, set by a remote proxy whose probe exceeded
+        ``slow_after``) ranks after every healthy one but before the
+        dead, graceful degradation instead of dead-or-alive; then
+        load = queued + busy workers; ties broken round-robin so
+        equal-load replicas share traffic)."""
         with self._lock:
             reps = [r for n, r in self._replicas.items() if n not in exclude]
             rr = self._rr
@@ -335,15 +437,15 @@ class FleetRouter:
         scored = []
         for i, rep in enumerate(reps):
             try:
-                h = rep.server.health()
+                h = self._probe(rep)
             except Exception:  # a torn-down replica must not break routing
                 h = {"ready": False, "live": False, "state": "unreachable",
                      "queue_depth": 0, "workers_busy": 0}
             load = h.get("queue_depth", 0) + h.get("workers_busy", 0)
-            scored.append((not h.get("ready"), load, (i + rr) % max(len(reps), 1),
-                           rep, h))
-        scored.sort(key=lambda s: s[:3])
-        return [(rep, h) for _, _, _, rep, h in scored]
+            scored.append((not h.get("ready"), bool(h.get("slow")), load,
+                           (i + rr) % max(len(reps), 1), rep, h))
+        scored.sort(key=lambda s: s[:4])
+        return [(rep, h) for *_, rep, h in scored]
 
     # -- rolling reload ------------------------------------------------------
 
@@ -359,8 +461,10 @@ class FleetRouter:
         ``{name: generation}`` after the rollout."""
         with self._reload_lock:
             with self._lock:
-                order = [r for r in self._replicas.values()
-                         if r.server.health()["live"]]
+                reps = dict(self._replicas)
+            probes = self._probe_all(reps)
+            order = [r for n, r in reps.items()
+                     if probes.get(n, {}).get("live")]
             if not order:
                 raise ReloadFailed(dirname, "no live replica to reload")
             prev = self.dirname
@@ -383,13 +487,43 @@ class FleetRouter:
                     _log().warning(
                         "fleet reload of %s: canary %s rejected (%s) — "
                         "fleet untouched", dirname, canary.name, e)
+                    # a connection-shaped canary failure (remote link
+                    # died after the RELOAD left the socket) leaves the
+                    # canary's generation unknown: best-effort roll it
+                    # back so a swapped-then-partitioned canary does
+                    # not serve the rejected artifact once healed —
+                    # probing first, like _rollback, so a still-
+                    # partitioned canary is skipped instead of wedging
+                    # reload() for another reload_timeout
+                    if prev is not None and isinstance(
+                            e, (ConnectionError, OSError, TimeoutError)):
+                        try:
+                            self._probe(canary)
+                            canary.server.reload(prev, block=True)
+                        except BaseException as e2:
+                            _log().error(
+                                "rollback of canary %s to %s failed/"
+                                "skipped: %s", canary.name, prev, e2)
                     raise
                 swapped = [canary]
                 for rep in order[1:]:
                     try:
                         rep.server.reload(dirname, block=True)
                     except BaseException as e:
-                        self._rollback(swapped, prev, dirname, e)
+                        # an in-process failure is typed and the
+                        # replica provably did NOT swap; a connection-
+                        # shaped failure (a partitioned remote, a reply
+                        # lost after send) leaves the replica's state
+                        # UNKNOWN — it may have swapped before the link
+                        # died, so it joins the rollback (best-effort:
+                        # still partitioned means still unreachable,
+                        # logged, and the operator's replace() is the
+                        # recovery — but a healed link rolls back here)
+                        back = list(swapped)
+                        if isinstance(e, (ConnectionError, OSError,
+                                          TimeoutError)):
+                            back.append(rep)
+                        self._rollback(back, prev, dirname, e)
                         raise ReloadFailed(
                             dirname, f"replica {rep.name} failed "
                             f"mid-rollout ({type(e).__name__}: {e}); "
@@ -425,6 +559,19 @@ class FleetRouter:
                 dirname, len(swapped))
             return
         for rep in swapped:
+            # a bounded probe first: rolling back an UNREACHABLE
+            # replica (the partitioned one that just failed the
+            # rollout) would stall the whole rollback for its reload
+            # timeout — skip it, log it; replace()/a healed retry is
+            # its recovery path
+            try:
+                self._probe(rep)
+            except Exception as e:
+                _log().error(
+                    "rollback of replica %s to %s skipped: unreachable "
+                    "(%s) — replace() it or retry once the link heals",
+                    rep.name, prev, e)
+                continue
             try:
                 rep.server.reload(prev, block=True)
             except BaseException as e:  # pragma: no cover - best effort
@@ -433,21 +580,61 @@ class FleetRouter:
 
     # -- health + lifecycle --------------------------------------------------
 
+    def _probe_all(self, reps: Dict[str, _Replica]) -> Dict[str, Dict]:
+        """Health snapshots for a replica set. With ``probe_timeout``
+        set the probes run CONCURRENTLY and the whole aggregation is
+        bounded by ONE probe_timeout (not N of them): a probe that
+        never returns is abandoned and its replica reported
+        ``probe_timeout`` / unavailable — ``/healthz`` answers even
+        while a replica is partitioned."""
+        if self.probe_timeout is None:
+            out: Dict[str, Dict] = {}
+            for name, rep in reps.items():
+                try:
+                    out[name] = rep.server.health()
+                except Exception as e:
+                    out[name] = {"live": False, "ready": False,
+                                 "state": f"unreachable:{type(e).__name__}"}
+            return out
+        results: Dict[str, Dict] = {}
+        lock = threading.Lock()
+
+        def _go(name, rep):
+            try:
+                h = rep.server.health()
+            except Exception as e:
+                h = {"live": False, "ready": False,
+                     "state": f"unreachable:{type(e).__name__}"}
+            with lock:
+                results[name] = h
+
+        threads = [threading.Thread(target=_go, args=(n, r), daemon=True,
+                                    name=f"pdtpu-fleet-probe-{n}")
+                   for n, r in reps.items()]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + self.probe_timeout
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        with lock:
+            out = dict(results)
+        for name in reps:
+            out.setdefault(name, {"live": False, "ready": False,
+                                  "state": "probe_timeout"})
+        return out
+
     def health(self) -> Dict[str, Any]:
         """Fleet readiness/liveness over the replicas' own state
         machines: ``ready`` (every replica ready) → ``degraded`` (some
         down, at least one ready — the fleet serves at reduced
         capacity) → ``unavailable`` (live replicas, none ready) →
-        ``stopped``."""
+        ``stopped``. Probes are bounded and concurrent when
+        ``probe_timeout`` is set (see :meth:`_probe_all`) — a replica
+        whose probe never returns is reported unavailable instead of
+        wedging the aggregation."""
         with self._lock:
             reps = dict(self._replicas)
-        health = {}
-        for name, rep in reps.items():
-            try:
-                health[name] = rep.server.health()
-            except Exception as e:
-                health[name] = {"live": False, "ready": False,
-                                "state": f"unreachable:{type(e).__name__}"}
+        health = self._probe_all(reps)
         live = [n for n, h in health.items() if h.get("live")]
         ready = [n for n, h in health.items() if h.get("ready")]
         if ready and len(ready) == len(health):
@@ -470,12 +657,55 @@ class FleetRouter:
         with self._lock:
             out: Dict[str, Any] = dict(self._counters)
             out["routed"] = dict(self._routed)
-        out["health"] = self.health()
+        health = self.health()
+        out["health"] = health
         with self._lock:
             reps = dict(self._replicas)
-        out["replicas"] = {n: r.server.report() for n, r in reps.items()
-                           if r.server.health()["live"]}
+        out["replicas"] = {}
+        for n, r in reps.items():
+            if not health["replicas"].get(n, {}).get("live"):
+                continue
+            try:
+                out["replicas"][n] = r.server.report()
+            except Exception:  # died between the probe and the report
+                continue
         return out
+
+    # -- journal shipping ----------------------------------------------------
+
+    def ship_journals(self) -> int:
+        """Pull every remote replica's NEW journal events over the
+        framed control link and ingest them into this process's
+        journal (``RunJournal.ingest`` — events keep their origin run
+        id + seq and gain an ``origin`` field naming the replica), so
+        one local ring/JSONL sink holds the fleet-wide timeline and
+        ``tools/flight_dump.py --span`` renders a request's full
+        cross-process lifecycle. Incremental: per-replica high-water
+        seq marks make repeated calls ship only what is new. Replicas
+        without a journal wire (in-process ones share the journal
+        already) and unreachable replicas are skipped. Returns the
+        number of events ingested."""
+        with self._lock:
+            reps = dict(self._replicas)
+        total = 0
+        for name, rep in reps.items():
+            fetch = getattr(rep.server, "journal_events", None)
+            if fetch is None:
+                continue
+            with self._lock:
+                since = self._journal_ship_seq.get(name, 0)
+            try:
+                events = fetch(since_seq=since)
+            except Exception:  # partitioned/dead: ship on a later call
+                continue
+            if not events:
+                continue
+            high = max(int(e.get("seq", 0)) for e in events)
+            total += self.journal.ingest(events, origin=name)
+            with self._lock:
+                self._journal_ship_seq[name] = max(
+                    self._journal_ship_seq.get(name, 0), high)
+        return total
 
     def close(self, drain: bool = True,
               timeout: Optional[float] = None) -> None:

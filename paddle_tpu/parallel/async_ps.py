@@ -95,7 +95,15 @@ class PServerProcess:
             pass
 
 
-class PushUndelivered(ConnectionError):
+class ReplyLost(ConnectionError):
+    """A NON-idempotent request was SENT but the connection died before
+    the peer's reply arrived: the request may or may not have applied
+    remotely. The client reconnects for subsequent requests but never
+    RESENDS this one — at-most-once semantics (a resend could
+    double-apply)."""
+
+
+class PushUndelivered(ReplyLost):
     """A push was SENT but the connection died before the server's
     reply arrived: the update may or may not have applied server-side.
     The client reconnects for subsequent requests but never RESENDS the
@@ -103,27 +111,53 @@ class PushUndelivered(ConnectionError):
     gradient; losing one is ordinary async-SGD staleness)."""
 
 
-class PSClient:
-    """Socket client for the pserver protocol. Dense params are flat f32
-    buffers keyed by name; sparse pushes update [rows, dim] params
-    row-wise (the distributed-lookup-table update path).
+def read_line(sock: socket.socket) -> str:
+    """Read one ``\\n``-terminated ASCII header line off a framed-
+    protocol socket (the pserver / fleet-replica wire discipline)."""
+    buf = bytearray()
+    while True:
+        c = sock.recv(1)
+        if not c:
+            raise ConnectionError("peer closed connection")
+        if c == b"\n":
+            return buf.decode()
+        buf += c
+
+
+def read_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly ``n`` bytes (a framed body) or raise
+    ``ConnectionError`` on EOF mid-frame."""
+    out = bytearray()
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        out += chunk
+    return bytes(out)
+
+
+class FramedClient:
+    """Transport base for the length-prefixed framed protocols
+    (``native/pserver.cc`` verbs, the fleet replica wire): one ASCII
+    header line, an optional binary body of a length named in the
+    header, and a reply of the same shape.
 
     **Reconnect-with-backoff** (the ``data.master.MasterClient``
-    discipline): a dead connection or restarted pserver is retried
-    transparently with exponential backoff for IDEMPOTENT requests —
-    ``pull``/``init_param`` (first-writer-wins makes a resend a no-op)/
-    ``status``/``save``. ``push``/``push_quantized``/``push_rows`` are
-    NOT idempotent: the request is sent at most once; connection
-    establishment still retries, but a reply lost after a completed send
-    raises :class:`PushUndelivered` instead of resending (see
-    :class:`AsyncPSTrainer.step`, which drops that step's gradient and
-    keeps training)."""
+    discipline): a dead connection or restarted peer is retried
+    transparently with exponential backoff for IDEMPOTENT requests.
+    Non-idempotent requests are sent at most once: connection
+    establishment still retries, but a reply lost after a completed
+    send raises :class:`ReplyLost` (subclasses override
+    :meth:`_make_reply_lost` for a typed error — ``PSClient`` raises
+    :class:`PushUndelivered`) instead of resending."""
 
-    def __init__(self, addr: Tuple[str, int], trainer_id: int = 0,
+    peer_name = "peer"
+
+    def __init__(self, addr: Tuple[str, int],
                  timeout: float = 30.0, retries: int = 30,
-                 retry_backoff: float = 0.05, retry_backoff_max: float = 2.0):
+                 retry_backoff: float = 0.05, retry_backoff_max: float = 2.0,
+                 connect: bool = True):
         self.addr = tuple(addr)
-        self.trainer_id = int(trainer_id)
         self.timeout = timeout
         self.retries = max(1, int(retries))
         self.retry_backoff = retry_backoff
@@ -132,16 +166,15 @@ class PSClient:
         # resilience counters (surfaced by report(), not bare pokes):
         # connects counts every successful TCP establish (reconnects =
         # connects - 1), retry_attempts every request re-issued after a
-        # transport failure, pushes_undelivered the at-most-once pushes
+        # transport failure, replies_lost the at-most-once requests
         # whose reply was lost (never resent)
         self.requests_sent = 0
         self.retry_attempts = 0
         self.connects = 0
-        self.pushes_sent = 0
-        self.pulls = 0
-        self.pushes_undelivered = 0
+        self.replies_lost = 0
         self.last_reply: Optional[str] = None
-        self._connect()  # fail fast on misconfigured addr
+        if connect:
+            self._connect()  # fail fast on misconfigured addr
 
     # -- transport ----------------------------------------------------------
     def _connect(self):
@@ -159,31 +192,33 @@ class PSClient:
             self._sock = None
 
     def _readline(self) -> str:
-        buf = bytearray()
-        while True:
-            c = self._sock.recv(1)
-            if not c:
-                raise ConnectionError("pserver closed connection")
-            if c == b"\n":
-                return buf.decode()
-            buf += c
+        return read_line(self._sock)
 
     def _read_exact(self, n: int) -> bytes:
-        out = bytearray()
-        while len(out) < n:
-            chunk = self._sock.recv(n - len(out))
-            if not chunk:
-                raise ConnectionError("pserver closed connection")
-            out += chunk
-        return bytes(out)
+        return read_exact(self._sock, n)
+
+    def _on_err_reply(self, resp: str):
+        """An ``ERR ...`` header arrived — raise it typed. The base
+        protocol carries no body after ERR; subclasses whose protocol
+        frames an error detail body read it here BEFORE raising (the
+        persistent connection must stay in sync)."""
+        raise RuntimeError(f"{self.peer_name}: {resp}")
+
+    def _make_reply_lost(self, cause: Exception) -> ReplyLost:
+        return ReplyLost(
+            f"reply lost after send ({cause}); NOT resending — the "
+            "request may have applied remotely")
 
     def _request(self, line: str, payload: bytes = b"",
-                 idempotent: bool = True, body_len=None):
+                 idempotent: bool = True, body_len=None,
+                 timeout: Optional[float] = None):
         """One protocol round trip with reconnect/backoff. ``body_len``
         (resp → byte count) reads a framed payload INSIDE the retry
         scope, so a connection lost mid-body retries the whole request
-        (idempotent case) instead of desyncing. Returns ``resp`` or
-        ``(resp, body)``."""
+        (idempotent case) instead of desyncing. ``timeout`` overrides
+        the socket timeout for this round trip only (a RELOAD takes
+        seconds, a health probe must fail in fractions of one).
+        Returns ``resp`` or ``(resp, body)``."""
         delay = self.retry_backoff
         last_err: Optional[Exception] = None
         for attempt in range(self.retries):
@@ -199,29 +234,33 @@ class PSClient:
                 continue
             sent = False
             try:
-                self._sock.sendall(line.encode() + b"\n" + payload)
-                sent = True
-                self.requests_sent += 1
-                resp = self._readline()
-                self.last_reply = resp
-                if resp.startswith("ERR"):
-                    raise RuntimeError(f"pserver: {resp}")
-                if body_len is None:
-                    return resp
-                return resp, self._read_exact(body_len(resp))
+                if timeout is not None:
+                    self._sock.settimeout(timeout)
+                try:
+                    self._sock.sendall(line.encode() + b"\n" + payload)
+                    sent = True
+                    self.requests_sent += 1
+                    resp = self._readline()
+                    self.last_reply = resp
+                    if resp.startswith("ERR"):
+                        self._on_err_reply(resp)
+                    if body_len is None:
+                        return resp
+                    return resp, self._read_exact(body_len(resp))
+                finally:
+                    if timeout is not None and self._sock is not None:
+                        self._sock.settimeout(self.timeout)
             except (OSError, ConnectionError) as e:
                 self._drop_sock()
                 last_err = e
                 if sent and not idempotent:
-                    self.pushes_undelivered += 1
-                    raise PushUndelivered(
-                        f"push reply lost after send ({e}); NOT resending — "
-                        "the update may have applied server-side") from e
+                    self.replies_lost += 1
+                    raise self._make_reply_lost(e) from e
                 time.sleep(delay)
                 delay = min(delay * 2, self.retry_backoff_max)
         raise ConnectionError(
-            f"pserver unreachable at {self.addr} after {self.retries} "
-            f"attempts: {last_err}")
+            f"{self.peer_name} unreachable at {self.addr} after "
+            f"{self.retries} attempts: {last_err}")
 
     def close(self):
         if self._sock is None:
@@ -231,6 +270,45 @@ class PSClient:
         except OSError:
             pass
         self._drop_sock()
+
+
+class PSClient(FramedClient):
+    """Socket client for the pserver protocol. Dense params are flat f32
+    buffers keyed by name; sparse pushes update [rows, dim] params
+    row-wise (the distributed-lookup-table update path).
+
+    Transport semantics come from :class:`FramedClient`
+    (reconnect-with-backoff for IDEMPOTENT requests —
+    ``pull``/``init_param`` (first-writer-wins makes a resend a no-op)/
+    ``status``/``save``). ``push``/``push_quantized``/``push_rows`` are
+    NOT idempotent: the request is sent at most once; connection
+    establishment still retries, but a reply lost after a completed send
+    raises :class:`PushUndelivered` instead of resending (see
+    :class:`AsyncPSTrainer.step`, which drops that step's gradient and
+    keeps training)."""
+
+    peer_name = "pserver"
+
+    def __init__(self, addr: Tuple[str, int], trainer_id: int = 0,
+                 timeout: float = 30.0, retries: int = 30,
+                 retry_backoff: float = 0.05, retry_backoff_max: float = 2.0):
+        self.trainer_id = int(trainer_id)
+        self.pushes_sent = 0
+        self.pulls = 0
+        super().__init__(addr, timeout=timeout, retries=retries,
+                         retry_backoff=retry_backoff,
+                         retry_backoff_max=retry_backoff_max)
+
+    @property
+    def pushes_undelivered(self) -> int:
+        """At-most-once pushes whose reply was lost (never resent) —
+        the pserver-flavored name of ``replies_lost``."""
+        return self.replies_lost
+
+    def _make_reply_lost(self, cause: Exception) -> ReplyLost:
+        return PushUndelivered(
+            f"push reply lost after send ({cause}); NOT resending — "
+            "the update may have applied server-side")
 
     # -- param API ----------------------------------------------------------
     @staticmethod

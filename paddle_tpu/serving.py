@@ -653,7 +653,10 @@ class PredictorServer:
             if w.abandoned:
                 continue   # wedged in a dispatch; daemon thread, no join
             if w.thread is not None and w.thread is not threading.current_thread():
-                w.thread.join(timeout=5.0)
+                try:
+                    w.thread.join(timeout=5.0)
+                except RuntimeError:   # raced a spawn: daemon exits solo
+                    pass
         # abandoned workers never run their loop-exit cleanup: fail any
         # carried (never-dispatched) request they still hold
         for w in self._workers:
@@ -743,22 +746,27 @@ class PredictorServer:
     # -- request path --------------------------------------------------------
 
     def submit(self, feed: Dict[str, Any],
-               deadline: Optional[float] = None) -> PendingResult:
+               deadline: Optional[float] = None,
+               span: Optional[str] = None) -> PendingResult:
         """Validate + enqueue one request; returns a
         :class:`PendingResult`. ``deadline`` is seconds from now (falls
         back to ``default_deadline``); raises :class:`InvalidRequest`,
         :class:`CircuitOpen`, :class:`ServerOverloaded`, or
-        :class:`ServerClosed` — all typed, all naming the reason."""
+        :class:`ServerClosed` — all typed, all naming the reason.
+        ``span`` adopts an externally-minted trace id (the wire trace
+        token of a cross-process front door) instead of minting one —
+        both processes' journals then carry ONE id end to end."""
         with self._state_lock:
             state = self._state
         if state in ("draining", "stopping", "stopped"):
             raise ServerClosed(f"server is {state}")
         if state == "starting":
             raise ServerClosed("server not started (call start())")
-        # the request's trace id is minted HERE, at submit: every
-        # journal event of its life (queue, worker dispatch, outcome,
-        # a watchdog hang) carries it — PendingResult.span exposes it
-        span = self.journal.new_span()
+        # the request's trace id is minted HERE, at submit (unless the
+        # front door handed one over the wire): every journal event of
+        # its life (queue, worker dispatch, outcome, a watchdog hang)
+        # carries it — PendingResult.span exposes it
+        span = span or self.journal.new_span()
         token = self.breaker.acquire()
         if token is None:
             self.metrics.bump("rejected_breaker")
@@ -832,8 +840,14 @@ class PredictorServer:
         w.thread = threading.Thread(target=self._worker_loop, args=(w,),
                                     daemon=True,
                                     name=f"pdtpu-serving-worker-{index}")
-        self._workers.append(w)
+        # started BEFORE it is registered: close() joins every
+        # registered worker, and joining a not-yet-started thread
+        # raises RuntimeError — a close() racing the watchdog's
+        # replacement spawn must never see one (the daemon loop polls
+        # the stop flag, so a started-but-unregistered worker still
+        # shuts down cleanly on its own)
         w.thread.start()
+        self._workers.append(w)
         return w
 
     def _admit(self, req: _Request) -> Optional[_Request]:
@@ -882,12 +896,21 @@ class PredictorServer:
         with self._model_lock:
             pred = self._predictor
         buckets = pred.batch_buckets
-        max_rows = buckets[-1]
+        # the policy's plan: target bucket + idle-wait budget. An
+        # SLO-aware policy (slo_queue_threshold) stops at a SMALL
+        # bucket with zero idle wait at low load — p50 at low QPS no
+        # longer pays the full-bucket hold; saturated plans are the
+        # legacy largest-bucket fill, unchanged
+        if hasattr(pol, "plan"):
+            max_rows, wait_ms = pol.plan(self._queue.qsize(), first.n,
+                                         buckets)
+        else:  # duck-typed policy without the SLO planner
+            max_rows, wait_ms = buckets[-1], pol.max_wait_ms
         group = [first]
         total = first.n
         key = _batching.nonbatched_key(first.feed, pred.feed_names,
                                        pred.batched_feeds)
-        hold_until = first.submitted + pol.max_wait_ms / 1e3
+        hold_until = first.submitted + wait_ms / 1e3
         while total < max_rows and not self._stop.is_set():
             if pol.max_requests is not None and \
                     len(group) >= pol.max_requests:

@@ -89,8 +89,11 @@ class RunJournal:
         self._ring: deque = deque(maxlen=ring_size)
         self._files: List[Any] = []
         self._sample: Dict[str, float] = dict(sample or {})
+        self._subscribers: Dict[int, Any] = {}
+        self._next_sub = 0
         self.dropped_sink_writes = 0
         self.dropped_sampled = 0
+        self.ingested = 0
 
     # -- spans -------------------------------------------------------------
     @staticmethod
@@ -162,6 +165,30 @@ class RunJournal:
             except OSError:
                 pass
 
+    # -- subscribers -------------------------------------------------------
+    def subscribe(self, fn) -> int:
+        """Register a live-event callback: ``fn(event)`` is called for
+        EVERY event — per-kind sampling does NOT apply (sampling is the
+        ring/sink pressure valve; a subscriber is a live observation
+        channel, and the fleet wire's ``DISPATCHED`` ordering hangs off
+        it — a sampled-out ``serving.dispatch`` must still fire it).
+        Called AFTER the ring append (or sampling drop) and OUTSIDE the
+        journal lock — a subscriber may emit or read without
+        deadlocking, at the cost of cross-thread callback ordering not
+        being seq-strict; it runs on the EMITTER's thread, so keep it
+        cheap and never let it block unboundedly. Exceptions are
+        swallowed (telemetry never takes down the run it observes).
+        Returns a handle for :meth:`unsubscribe`."""
+        with self._lock:
+            sid = self._next_sub
+            self._next_sub += 1
+            self._subscribers[sid] = fn
+            return sid
+
+    def unsubscribe(self, sid: int) -> None:
+        with self._lock:
+            self._subscribers.pop(sid, None)
+
     # -- emission ----------------------------------------------------------
     def emit(self, kind: str, span: Optional[str] = None,
              **fields) -> Dict[str, Any]:
@@ -172,6 +199,7 @@ class RunJournal:
         mid-line nor land out of ``seq`` order in the JSONL file. A
         failing file sink is counted, never raised — telemetry must
         not take down the run it observes."""
+        subs: List[Any] = []
         with self._lock:
             self._seq += 1
             event: Dict[str, Any] = {"run": self.run_id, "seq": self._seq,
@@ -179,32 +207,73 @@ class RunJournal:
             if span is not None:
                 event["span"] = span
             event.update(fields)
+            if self._subscribers:
+                subs = list(self._subscribers.values())
             rate = self._rate_locked(kind)
-            if rate < 1.0 and not self._sampled_in(
-                    span if span is not None else f"{self.run_id}:{self._seq}",
-                    rate):
+            sampled_out = rate < 1.0 and not self._sampled_in(
+                span if span is not None else f"{self.run_id}:{self._seq}",
+                rate)
+            if sampled_out:
                 # sampled out: the seq is consumed (sink gaps read as
                 # sampling, not corruption) but neither ring nor sinks
-                # see the event — the high-QPS pressure valve
+                # see the event — the high-QPS pressure valve.
+                # Subscribers still fire below: they are not a sink.
                 self.dropped_sampled += 1
-                return event
-            self._ring.append(event)
-            if self._files:
-                try:
-                    line = json.dumps(event, sort_keys=True,
-                                      default=_json_default) + "\n"
-                except (TypeError, ValueError):
-                    line = json.dumps(
-                        {"run": self.run_id, "seq": event["seq"],
-                         "t": event["t"], "kind": kind,
-                         "unserializable": True}) + "\n"
-                for f in self._files:
-                    try:
-                        f.write(line)
-                        f.flush()
-                    except (OSError, ValueError):
-                        self.dropped_sink_writes += 1
+            else:
+                self._ring.append(event)
+                self._write_sinks_locked(event, kind)
+        for fn in subs:
+            try:
+                fn(event)
+            except Exception:
+                pass
         return event
+
+    def _write_sinks_locked(self, event: Dict[str, Any], kind: str) -> None:
+        if not self._files:
+            return
+        try:
+            line = json.dumps(event, sort_keys=True,
+                              default=_json_default) + "\n"
+        except (TypeError, ValueError):
+            line = json.dumps(
+                {"run": event.get("run", self.run_id), "seq": event["seq"],
+                 "t": event["t"], "kind": kind,
+                 "unserializable": True}) + "\n"
+        for f in self._files:
+            try:
+                f.write(line)
+                f.flush()
+            except (OSError, ValueError):
+                self.dropped_sink_writes += 1
+
+    def ingest(self, events, origin: Optional[str] = None) -> int:
+        """Absorb ANOTHER process's journal events into this one — the
+        off-host shipping half of the cross-process fleet: a router
+        pulls each remote replica's retained ring over the framed
+        control link (``JOURNAL`` verb) and ingests it here, so one
+        local ring (and one JSONL sink) holds the fleet-wide timeline.
+
+        Shipped events keep their own ``run`` id and ``seq`` (the
+        origin process's sequencing is the truth; this journal's
+        ``seq`` is NOT consumed) and gain an ``origin`` field when one
+        is given (the replica name). Spans correlate across processes
+        by construction — the front door mints the span and the wire
+        trace token hands it to the replica. Returns the number of
+        events ingested."""
+        n = 0
+        with self._lock:
+            for event in events:
+                if not isinstance(event, dict) or "kind" not in event:
+                    continue
+                event = dict(event)
+                if origin is not None:
+                    event.setdefault("origin", origin)
+                self._ring.append(event)
+                self._write_sinks_locked(event, str(event["kind"]))
+                n += 1
+            self.ingested += n
+        return n
 
     # -- reads -------------------------------------------------------------
     def recent(self, n: Optional[int] = None,

@@ -25,6 +25,14 @@ no timing races):
   never-dispatched requests fail retryable, dispatched ones fail
   at-most-once — drives ``FleetRouter``'s reroute contract and
   ``tools/fleet_drill.py``).
+- **Wire faults** (the cross-process fleet): :class:`LinkProxy` — a
+  deterministic localhost TCP proxy a ``RemoteReplica`` routes
+  through — with :func:`partition` (drop both ways, half-open
+  sockets), :func:`heal`, and :func:`slow_link` (per-chunk delay, the
+  slow-but-alive replica behind probe-latency demotion); plus
+  :func:`kill_process` (real SIGKILL of a replica process, no
+  cleanup) — re-proving the in-process kill contracts against real
+  process death and real TCP partitions.
 - **Membership changes**: :func:`visible_devices` /
   :func:`membership_meshes` build deterministic shrunk/grown device
   meshes (the preempted-worker / rejoined-worker analog on the CPU
@@ -49,7 +57,9 @@ from __future__ import annotations
 
 import contextlib
 import os
+import socket
 import threading
+import time
 from typing import Callable, Dict, Iterator, Optional
 
 import numpy as np
@@ -310,3 +320,178 @@ def failing_predictor(base, fail_calls: int = 1_000_000,
         return b.run(feed)
 
     return FaultyPredictor(base, behavior)
+
+
+# -- wire faults (the cross-process fleet) ------------------------------------
+
+
+class LinkProxy:
+    """Deterministic TCP link fault injector for the cross-process
+    fleet: a localhost forwarding proxy a :class:`~paddle_tpu.fleet.
+    remote.RemoteReplica` is pointed THROUGH (``RemoteReplica(
+    proxy.addr, proc=proc)``), whose forwarding can be scripted:
+
+    - :meth:`partition` — stop forwarding BOTH ways without closing
+      either side's socket: a real half-open connection. The
+      endpoints' ``send()`` keeps succeeding into kernel buffers and
+      no reply ever arrives — exactly the observable behavior of a
+      network partition, with none of the iptables/root — until
+      :meth:`heal` resumes delivery (buffered bytes then arrive, like
+      a healed route).
+    - :meth:`slow` — delay every forwarded chunk by ``delay_ms``: the
+      slow-but-alive replica that drives the router's probe-latency
+      demotion.
+
+    All state changes are instant and exact (a flag the pump threads
+    read per chunk) — no packet-loss roulette, reproducible from
+    tier-1 tests."""
+
+    def __init__(self, target: "tuple", host: str = "127.0.0.1"):
+        self.target = (str(target[0]), int(target[1]))
+        self._mode = "pass"
+        self._delay_ms = 0.0
+        self._lock = threading.Lock()
+        self._conns: list = []
+        self._ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._ls.bind((host, 0))
+        self._ls.listen(64)
+        self.addr = (host, self._ls.getsockname()[1])
+        self._closed = False
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="pdtpu-linkproxy-accept").start()
+
+    # -- fault script --------------------------------------------------------
+    def partition(self) -> "LinkProxy":
+        """Blackhole the link both ways (half-open: sockets stay
+        open, nothing is delivered)."""
+        with self._lock:
+            self._mode = "partition"
+        return self
+
+    def heal(self) -> "LinkProxy":
+        with self._lock:
+            self._mode = "pass"
+        return self
+
+    def slow(self, delay_ms: float) -> "LinkProxy":
+        """Delay each forwarded chunk by ``delay_ms`` (0 restores)."""
+        with self._lock:
+            self._delay_ms = float(delay_ms)
+        return self
+
+    # -- plumbing ------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._ls.accept()
+            except OSError:
+                return
+            try:
+                up = socket.create_connection(self.target, timeout=5.0)
+            except OSError:
+                conn.close()
+                continue
+            with self._lock:
+                self._conns += [conn, up]
+            for a, b in ((conn, up), (up, conn)):
+                threading.Thread(target=self._pump, args=(a, b),
+                                 daemon=True,
+                                 name="pdtpu-linkproxy-pump").start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+        while True:
+            with self._lock:
+                mode, delay = self._mode, self._delay_ms
+            if mode == "partition":
+                # do not even read: bytes pile up in kernel buffers on
+                # the sender's side of the blackhole, delivered only
+                # if/when the link heals
+                time.sleep(0.01)
+                continue
+            try:
+                src.settimeout(0.05)
+                data = src.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not data:
+                break
+            if delay > 0:
+                time.sleep(delay / 1e3)
+            # a read that raced the partition flip HOLDS its chunk
+            # until heal — dropping it would desync the framed byte
+            # stream for the healed link (a partition delays bytes,
+            # it never corrupts the stream)
+            while not self._closed:
+                with self._lock:
+                    if self._mode != "partition":
+                        break
+                time.sleep(0.01)
+            try:
+                dst.sendall(data)
+            except OSError:
+                break
+        for s in (src, dst):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._ls.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for s in conns:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "LinkProxy":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def partition(link: LinkProxy) -> LinkProxy:
+    """Drop everything both ways on a :class:`LinkProxy` link — a real
+    half-open TCP partition (sockets stay open, sends succeed, replies
+    never come). Pair with :func:`heal`."""
+    return link.partition()
+
+
+def heal(link: LinkProxy) -> LinkProxy:
+    """Resume delivery on a partitioned/slowed link."""
+    return link.heal().slow(0.0)
+
+
+def slow_link(link: LinkProxy, delay_ms: float) -> LinkProxy:
+    """Delay every chunk on the link by ``delay_ms`` — the
+    slow-but-alive failure mode behind probe-latency demotion."""
+    return link.slow(delay_ms)
+
+
+def kill_process(replica) -> None:
+    """SIGKILL a fleet replica PROCESS, no cleanup, no warning — the
+    real thing, unlike :func:`kill_server`'s in-process stand-in.
+    Accepts a :class:`~paddle_tpu.fleet.remote.RemoteReplica`, a
+    :class:`~paddle_tpu.fleet.remote.ReplicaProcess`, or a bare pid.
+    Deterministic: the kill lands exactly where the drill calls it
+    (the kernel delivers bytes the victim already wrote — which is
+    what makes the never-dispatched/dispatched classification on the
+    surviving side exact)."""
+    proc = getattr(replica, "proc", replica)
+    if isinstance(proc, int):
+        os.kill(proc, 9)
+        return
+    kill = getattr(proc, "kill", None)
+    if kill is None:
+        raise TypeError(f"kill_process: cannot kill {replica!r}")
+    kill()

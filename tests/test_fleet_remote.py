@@ -1,0 +1,571 @@
+"""Cross-process serving fleet acceptance suite: out-of-process
+replicas over the framed transport, surviving REAL kills and
+partitions (no in-process stand-ins — SIGKILL is SIGKILL, a partition
+is a blackholed TCP link).
+
+The acceptance contracts:
+
+  * a remote fleet serves bit-identically to a local pad-alone
+    ``Predictor.run`` (same artifact, same buckets, over the wire);
+  * SIGKILL of a replica process under load loses ZERO
+    accepted-but-undispatched requests (transparently rerouted) and
+    surfaces ``ReplicaDied`` exactly once for dispatched ones;
+    ``replace()`` respawns a fresh process from the artifact;
+  * a reply lost on a real half-open connection (partitioned link,
+    process alive) surfaces ``ReplicaDied`` exactly once and is NEVER
+    resent — the replica's journal shows at most one submit for the
+    span (mirroring ``PSClient.push``'s ``PushUndelivered``);
+  * health probes are bounded: a probe that never returns (wedged
+    in-process ``health()``, partitioned remote) marks the replica
+    unavailable within the probe timeout and the router stays
+    responsive;
+  * a slow-but-alive replica (probe latency past ``slow_after``) is
+    DEMOTED below healthy replicas, not treated as dead;
+  * one trace id crosses the process boundary: the front door mints
+    the span, the wire trace token hands it to the replica, and both
+    processes' journals carry it (``ship_journals`` merges them);
+  * SLO-aware batch sizing: at low load the policy picks the smallest
+    covering bucket with zero idle wait (p50 drops), at saturation the
+    plan is the legacy largest-bucket fill (throughput untouched);
+  * ``tools/fleet_drill.py`` passes its process-level drills (pkill +
+    partition during rolling reload, exit 0).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import paddle_tpu as pt
+from paddle_tpu import io as pio
+from paddle_tpu import serving, telemetry
+from paddle_tpu.fleet import BatchPolicy, FleetRouter
+from paddle_tpu.fleet import batching as fbatch
+from paddle_tpu.fleet import remote as fremote
+from paddle_tpu.serving import (DeadlineExceeded, PredictorServer,
+                                ReloadFailed, ReplicaDied, ServerClosed,
+                                ServerOverloaded)
+from paddle_tpu.telemetry.journal import RunJournal
+from paddle_tpu.testing import faults
+
+REMOTE_KW = dict(probe_timeout=0.5, down_cooldown=0.4, submit_timeout=3.0,
+                 connect_timeout=1.0, reload_timeout=12.0)
+
+
+def _feed(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"image": rng.randn(n, 784).astype(np.float32),
+            "label": rng.randint(0, 10, (n, 1)).astype(np.int64)}
+
+
+def _single(feed, i):
+    return {k: np.asarray(v)[i % 8:i % 8 + 1] for k, v in feed.items()}
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    from paddle_tpu.models import mnist
+
+    d = str(tmp_path_factory.mktemp("rfleet") / "model")
+    prog = pt.build(mnist.mlp)
+    feed8 = _feed(8)
+    params, state = prog.init(jax.random.PRNGKey(0), **feed8)
+    pio.save_inference_model(d, prog, jax.tree.map(np.asarray, params),
+                             state, feed8, batch_buckets=[4, 8])
+    return {"dir": d, "prog": prog, "params": params, "state": state,
+            "feed8": feed8}
+
+
+@pytest.fixture()
+def fresh_journal():
+    old = telemetry.set_journal(RunJournal())
+    try:
+        yield telemetry.get_journal()
+    finally:
+        telemetry.set_journal(old)
+
+
+# -- pure units: wire packing, typed errors, SLO plan -------------------------
+
+
+def test_pack_unpack_roundtrip():
+    feed = {"image": np.arange(8, dtype=np.float32).reshape(2, 4),
+            "label": np.array([[3], [7]], dtype=np.int64),
+            "scalar": np.float32(2.5)}
+    meta, payload = fremote.pack_tree(feed)
+    back = fremote.unpack_tree(meta, payload)
+    assert sorted(back) == sorted(feed)
+    for k in feed:
+        np.testing.assert_array_equal(np.asarray(back[k]),
+                                      np.asarray(feed[k]))
+        assert back[k].dtype == np.asarray(feed[k]).dtype
+    single = np.arange(3, dtype=np.int32)
+    np.testing.assert_array_equal(
+        fremote.unpack_tree(*fremote.pack_tree(single)), single)
+    tup = (np.zeros((2, 2), np.float32), np.ones(3, np.float64))
+    back_t = fremote.unpack_tree(*fremote.pack_tree(tup))
+    assert isinstance(back_t, tuple) and len(back_t) == 2
+
+
+def test_remote_error_roundtrip():
+    from paddle_tpu.resilience import CheckpointCorrupt
+
+    cases = [
+        pio.InvalidRequest("image", "shape drift"),
+        ServerOverloaded(9, 8),
+        serving.CircuitOpen(1.25),
+        ReloadFailed("/tmp/x", "canary failed"),
+        CheckpointCorrupt("/tmp/y", "torn write"),
+        DeadlineExceeded("too late"),
+        serving.WorkerHung("wedged"),
+        ServerClosed("closed"),
+        ReplicaDied("gone"),
+    ]
+    for e in cases:
+        name, detail = fremote.error_payload(e)
+        back = fremote.build_remote_error(name, detail)
+        assert type(back) is type(e), (e, back)
+    back = fremote.build_remote_error("SomethingNovel", {"message": "m"})
+    assert isinstance(back, serving.ServingError)
+    over = fremote.build_remote_error(*fremote.error_payload(
+        ServerOverloaded(9, 8)))
+    assert (over.queue_depth, over.capacity) == (9, 8)
+
+
+def test_batch_policy_plan_units():
+    buckets = [4, 8, 16]
+    legacy = BatchPolicy(max_wait_ms=5.0)
+    assert legacy.plan(0, 1, buckets) == (16, 5.0)
+    assert legacy.plan(100, 1, buckets) == (16, 5.0)
+    slo = BatchPolicy(max_wait_ms=5.0, slo_queue_threshold=4)
+    # low load: smallest covering bucket, zero idle wait
+    assert slo.plan(0, 1, buckets) == (4, 0.0)
+    assert slo.plan(2, 1, buckets) == (4, 0.0)
+    assert slo.plan(3, 3, buckets) == (8, 0.0)
+    # saturated: the legacy plan, bit-for-bit — throughput untouched
+    assert slo.plan(4, 1, buckets) == legacy.plan(4, 1, buckets)
+    assert slo.plan(50, 1, buckets) == legacy.plan(50, 1, buckets)
+    # the target never exceeds the largest bucket
+    assert slo.plan(3, 16, buckets) == (16, 0.0)
+
+
+def test_slo_policy_drops_low_qps_latency(artifact):
+    """A lone request at low QPS must NOT pay the coalescer's idle
+    hold when the policy is SLO-aware (the full-bucket wait was the
+    p50 cost the ROADMAP named)."""
+    base = pio.load_inference_model(artifact["dir"])
+    wait_ms = 150.0
+
+    def p50(policy):
+        srv = PredictorServer(base.clone(), workers=1, queue_size=8,
+                              batch_policy=policy, warmup=False)
+        try:
+            srv.run(_single(artifact["feed8"], 0), timeout=30)  # warm
+            lats = []
+            for i in range(3):
+                t0 = time.monotonic()
+                srv.run(_single(artifact["feed8"], i), timeout=30)
+                lats.append(time.monotonic() - t0)
+            return sorted(lats)[1]
+        finally:
+            srv.close(drain=False)
+
+    slow = p50(BatchPolicy(max_wait_ms=wait_ms))
+    fast = p50(BatchPolicy(max_wait_ms=wait_ms, slo_queue_threshold=2))
+    assert slow >= wait_ms / 1e3 * 0.8, (slow, fast)
+    assert fast < wait_ms / 1e3 * 0.5, (slow, fast)
+
+
+def test_journal_subscribe_and_ingest():
+    j = RunJournal(run_id="local")
+    seen = []
+    sid = j.subscribe(seen.append)
+    j.emit("x.one", span="s1")
+    assert [e["kind"] for e in seen] == ["x.one"]
+    j.unsubscribe(sid)
+    j.emit("x.two")
+    assert len(seen) == 1
+    foreign = [{"run": "remoterun", "seq": 7, "t": 1.0,
+                "kind": "serving.submit", "span": "abc"}]
+    assert j.ingest(foreign, origin="r1") == 1
+    got = [e for e in j.recent() if e.get("origin") == "r1"]
+    assert got and got[0]["run"] == "remoterun" and got[0]["seq"] == 7
+    # this journal's own seq was NOT consumed by the shipped event
+    assert j.seq == 2
+    assert j.ingest([{"no": "kind"}]) == 0
+    # subscribers are a live channel, NOT a sink: per-kind sampling
+    # must not silence them — the replica wire's DISPATCHED ordering
+    # hangs off a serving.dispatch subscriber even under
+    # PDTPU_JOURNAL_SAMPLE=serving=0
+    js = RunJournal(sample={"serving": 0.0})
+    seen_s = []
+    js.subscribe(seen_s.append)
+    js.emit("serving.dispatch", span="s1")
+    assert [e["kind"] for e in seen_s] == ["serving.dispatch"]
+    assert js.recent() == [] and js.dropped_sampled == 1
+
+
+# -- the remote fleet ---------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def remote_fleet(artifact):
+    router = FleetRouter.spawn(
+        artifact["dir"], replicas=2, remote=True,
+        remote_kw=dict(REMOTE_KW), workers=1, queue_size=16,
+        golden_feed=artifact["feed8"],
+        batch_policy=BatchPolicy(max_wait_ms=2.0))
+    yield router
+    router.close(drain=False, timeout=10)
+
+
+def test_remote_fleet_serves_bit_identical(remote_fleet, artifact):
+    base = pio.load_inference_model(artifact["dir"])
+    for i in range(4):
+        feed = _single(artifact["feed8"], i)
+        out = remote_fleet.run(feed, timeout=60)
+        padded = {k: np.concatenate(
+            [v, np.zeros((3,) + np.asarray(v).shape[1:],
+                         np.asarray(v).dtype)])
+            for k, v in feed.items()}
+        ref = base.run(padded)
+        np.testing.assert_array_equal(np.asarray(out["logits"]),
+                                      np.asarray(ref["logits"])[:1])
+    h = remote_fleet.health()
+    assert h["state"] == "ready" and h["replicas_ready"] == 2
+    rep = remote_fleet.report()
+    assert sorted(rep["replicas"]) == ["r0", "r1"]
+    assert all(r["compiles_since_warmup"] == 0
+               for r in rep["replicas"].values())
+
+
+def test_remote_metrics_aggregation(remote_fleet):
+    from paddle_tpu.telemetry.registry import validate_families
+
+    fams = remote_fleet.metrics_families()
+    by_name = {f.name: f for f in fams}
+    assert "paddle_tpu_serving_submitted_total" in by_name
+    replicas = {lab.get("replica")
+                for f in fams for lab, _ in f.samples}
+    assert {"r0", "r1", "router"} <= replicas
+    assert validate_families(fams) == []
+
+
+def test_cross_process_journal_one_trace_id(remote_fleet, fresh_journal):
+    """Satellite: one trace id from front-door submit through remote
+    dispatch to completion, asserted against BOTH processes'
+    journals."""
+    p = remote_fleet.submit(_single(_feed(8), 0))
+    p.result(timeout=60)
+    span = p.span
+    assert span
+    # parent-side journal: the front door's submit event carries it
+    parent_kinds = {e["kind"] for e in fresh_journal.recent(span=span)}
+    assert "fleet.remote_submit" in parent_kinds
+    # replica-side journal (pulled over the same framed link): the
+    # serving lifecycle carries the SAME id
+    rep = remote_fleet.replica(p.replica)
+    events = rep.journal_events()
+    rep_kinds = {e["kind"] for e in events if e.get("span") == span}
+    assert {"serving.submit", "serving.dispatch",
+            "serving.complete"} <= rep_kinds, rep_kinds
+    # shipping merges them into the local ring, origin-tagged, spans
+    # intact — one ring now holds the cross-process timeline
+    assert remote_fleet.ship_journals() > 0
+    shipped = [e for e in fresh_journal.recent(span=span)
+               if e.get("origin")]
+    assert {"serving.submit", "serving.complete"} <= {
+        e["kind"] for e in shipped}
+    # incremental: a second ship with no new replica traffic is empty
+    assert remote_fleet.ship_journals() == 0
+
+
+def test_sigkill_zero_drop_and_at_most_once(artifact):
+    """Acceptance drill core, pinned directly: SIGKILL a replica
+    process with requests in flight — every accepted request either
+    completes (rerouted transparently if never dispatched) or surfaces
+    ReplicaDied exactly once; ServerClosed NEVER reaches the caller;
+    replace() respawns a process and health recovers."""
+    router = FleetRouter.spawn(
+        artifact["dir"], replicas=2, remote=True,
+        remote_kw=dict(REMOTE_KW), workers=1, queue_size=16,
+        golden_feed=artifact["feed8"],
+        batch_policy=BatchPolicy(max_wait_ms=2.0))
+    try:
+        for _ in range(2):
+            router.run(_single(artifact["feed8"], 0), timeout=60)
+        pending = [router.submit(_single(artifact["feed8"], i))
+                   for i in range(24)]
+        victim = pending[0].replica
+        faults.kill_process(router.replica(victim))
+        outcomes = {"ok": 0}
+        for p in pending:
+            try:
+                p.result(timeout=60)
+                outcomes["ok"] += 1
+            except BaseException as e:
+                outcomes[type(e).__name__] = \
+                    outcomes.get(type(e).__name__, 0) + 1
+        # zero drops: only clean completions and at-most-once surfaces
+        assert set(outcomes) <= {"ok", "ReplicaDied"}, outcomes
+        assert outcomes["ok"] >= 1
+        # the kill was mid-load: the router rerouted in-queue work
+        assert router.report()["rerouted"] + outcomes["ok"] >= 1
+        state = router.health()["state"]
+        assert state in ("degraded", "unavailable"), state
+        router.replace(victim)   # respawn a fresh process
+        h = router.health()
+        assert h["state"] == "ready", h
+        assert router.replica(victim).proc.poll() is None
+        router.run(_single(artifact["feed8"], 1), timeout=60)
+    finally:
+        router.close(drain=False, timeout=10)
+
+
+def test_half_open_reply_lost_surfaces_once_never_resent(artifact,
+                                                         fresh_journal):
+    """The at-most-once contract re-proven on a REAL half-open
+    connection: the submit leaves the socket, the partition eats the
+    reply, the process stays alive → ReplicaDied exactly once, and the
+    replica's journal shows the request was never resent (at most one
+    submit for the span — delivered late by the healed link, not
+    duplicated)."""
+    # the long coalescer hold (max_wait_ms=2500, no SLO threshold)
+    # gives the stall half of the test a deterministic window where a
+    # request is ACCEPTED but no lifecycle bytes flow yet
+    proc = fremote.ReplicaProcess(
+        artifact["dir"], server_kw=dict(
+            workers=1, queue_size=16, golden_feed=artifact["feed8"],
+            batch_policy=BatchPolicy(max_wait_ms=2500.0)))
+    proxy = None
+    try:
+        proc.wait_ready()
+        proxy = faults.LinkProxy(proc.addr)
+        rep = fremote.RemoteReplica(
+            proxy.addr, proc=proc, name="r0",
+            **dict(REMOTE_KW, submit_timeout=0.6))
+        rep.run(_single(artifact["feed8"], 0), timeout=60)  # link works
+        faults.partition(proxy)
+        t0 = time.monotonic()
+        with pytest.raises(ReplicaDied, match="never resent|not resending"):
+            rep.submit(_single(artifact["feed8"], 1))
+        assert time.monotonic() - t0 < 5.0
+        # the span the front door minted for the lost submit
+        lost = [e for e in fresh_journal.recent(kind="fleet.remote_submit")]
+        span = lost[-1]["span"]
+        faults.heal(proxy)
+        time.sleep(4.0)   # the healed link delivers the buffered bytes
+        inspect = fremote.RemoteReplica(proc.addr, proc=proc,
+                                        **dict(REMOTE_KW))
+        events = inspect.journal_events()
+        submits = [e for e in events if e["kind"] == "serving.submit"
+                   and e.get("span") == span]
+        assert len(submits) <= 1, submits   # at-most-once on the wire
+        assert proc.poll() is None          # the replica never died
+        # -- the silent-stall half: ACCEPTED, then the partition eats
+        # the lifecycle. The socket never errors — the client must
+        # detect the stall (submit_timeout of silence), probe, and
+        # classify at-most-once instead of hanging to the deadline.
+        p = rep.submit(_single(artifact["feed8"], 2))   # accepted (OK id)
+        faults.partition(proxy)
+        t0 = time.monotonic()
+        with pytest.raises(ReplicaDied):
+            p.result(timeout=30)
+        assert time.monotonic() - t0 < 10.0
+        assert proc.poll() is None          # still a partition, not death
+    finally:
+        if proxy is not None:
+            proxy.close()
+        proc.stop()
+
+
+def test_bounded_probe_partitioned_replica(artifact):
+    """Satellite fix: health aggregation tolerates a probe that never
+    returns — the partitioned replica is marked unavailable within the
+    bound and the router keeps routing."""
+    procs = [fremote.ReplicaProcess(
+        artifact["dir"], server_kw=dict(workers=1, queue_size=16))
+        for _ in range(2)]
+    proxy = None
+    try:
+        for p in procs:
+            p.wait_ready()
+        proxy = faults.LinkProxy(procs[1].addr)
+        reps = {
+            "good": fremote.RemoteReplica(procs[0].addr, proc=procs[0],
+                                          name="good", **REMOTE_KW),
+            "cut": fremote.RemoteReplica(proxy.addr, proc=procs[1],
+                                         name="cut", **REMOTE_KW),
+        }
+        router = FleetRouter(reps, dirname=artifact["dir"],
+                             probe_timeout=0.8, remote=True)
+        faults.partition(proxy)
+        t0 = time.monotonic()
+        h = router.health()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 4.0, elapsed
+        assert h["state"] == "degraded", h
+        assert not h["replicas"]["cut"]["ready"]
+        assert h["replicas"]["cut"]["state"].startswith(
+            ("unreachable", "probe_timeout"))
+        # routing stays responsive: traffic lands on the good replica
+        out = router.run(_single(artifact["feed8"], 0), timeout=60)
+        assert "logits" in out
+        assert router.report()["routed"]["good"] >= 1
+        router.close(drain=False, timeout=5)
+    finally:
+        if proxy is not None:
+            proxy.close()
+        for p in procs:
+            p.stop()
+
+
+def test_wedged_inprocess_health_probe_bounded(artifact):
+    """The same satellite for an ADOPTED in-process replica whose
+    health() never returns: the router's own probe bound abandons it
+    and stays responsive."""
+    import threading
+
+    base = pio.load_inference_model(artifact["dir"])
+    good = PredictorServer(base, workers=1, queue_size=16, warmup=False)
+
+    class Wedged:
+        def health(self):
+            threading.Event().wait()   # never returns
+
+        def close(self, **kw):
+            pass
+
+        def kill(self, **kw):
+            pass
+
+        def repin_compiles(self):
+            pass
+
+    router = FleetRouter({"good": good, "wedged": Wedged()},
+                         probe_timeout=0.3)
+    try:
+        t0 = time.monotonic()
+        h = router.health()
+        assert time.monotonic() - t0 < 2.0
+        assert h["replicas"]["wedged"]["state"] == "probe_timeout"
+        assert h["state"] == "degraded"
+        out = router.run(_single(artifact["feed8"], 0), timeout=30)
+        assert "logits" in out
+    finally:
+        router.close(drain=False, timeout=5)
+
+
+def test_slow_link_probe_latency_demotion(artifact):
+    """Graceful degradation: a slow-but-alive replica (probe latency
+    past slow_after) is demoted below healthy ones — traffic prefers
+    the fast replica, but the slow one still counts as ready."""
+    procs = [fremote.ReplicaProcess(
+        artifact["dir"], server_kw=dict(workers=1, queue_size=16))
+        for _ in range(2)]
+    proxy = None
+    try:
+        for p in procs:
+            p.wait_ready()
+        proxy = faults.LinkProxy(procs[1].addr)
+        kw = dict(REMOTE_KW, slow_after=0.05, health_ttl=0.0)
+        reps = {
+            "fast": fremote.RemoteReplica(procs[0].addr, proc=procs[0],
+                                          name="fast", **kw),
+            "slow": fremote.RemoteReplica(proxy.addr, proc=procs[1],
+                                          name="slow", **kw),
+        }
+        faults.slow_link(proxy, 80.0)
+        router = FleetRouter(reps, dirname=artifact["dir"], remote=True)
+        for i in range(4):
+            router.run(_single(artifact["feed8"], i), timeout=60)
+        routed = router.report()["routed"]
+        assert routed["fast"] == 4 and routed["slow"] == 0, routed
+        assert router.health()["replicas"]["slow"]["ready"]
+        assert router.health()["replicas"]["slow"]["slow"] is True
+        router.close(drain=False, timeout=5)
+    finally:
+        if proxy is not None:
+            proxy.close()
+        for p in procs:
+            p.stop()
+
+
+def test_remote_rolling_reload_and_partition_rollback(artifact, tmp_path):
+    """Rolling reload across processes coordinated by artifact
+    generation — and the acceptance partition drill pinned directly: a
+    TCP partition mid-rollout rolls the swapped replicas back to the
+    previous artifact with the router's dirname unchanged."""
+    params = jax.tree.map(np.asarray, artifact["params"])
+    d_v2 = str(tmp_path / "v2")
+    pio.save_inference_model(
+        d_v2, artifact["prog"], jax.tree.map(lambda v: v * 0.5, params),
+        artifact["state"], artifact["feed8"], batch_buckets=[4, 8])
+    server_kw = dict(workers=1, queue_size=16,
+                     golden_feed=artifact["feed8"])
+    procs = [fremote.ReplicaProcess(artifact["dir"], server_kw=server_kw)
+             for _ in range(2)]
+    proxy = None
+    try:
+        for p in procs:
+            p.wait_ready()
+        proxy = faults.LinkProxy(procs[1].addr)
+        # a long health TTL makes the partition-mid-rollout timing
+        # deterministic: the rollout's liveness scan reads the cached
+        # pre-partition snapshot, so r1 IS in the rollout order and
+        # the failure provably lands on its partitioned RELOAD
+        kw = dict(REMOTE_KW, health_ttl=30.0, reload_timeout=8.0)
+        reps = {
+            "r0": fremote.RemoteReplica(procs[0].addr, proc=procs[0],
+                                        name="r0", **kw),
+            "r1": fremote.RemoteReplica(proxy.addr, proc=procs[1],
+                                        name="r1", **kw),
+        }
+        router = FleetRouter(reps, dirname=artifact["dir"],
+                             server_kw=server_kw, probe_timeout=1.0,
+                             remote=True, remote_kw=dict(REMOTE_KW))
+        # a clean rolling reload first: every process swaps
+        gens = router.reload(d_v2)
+        assert sorted(gens) == ["r0", "r1"]
+        assert all(g == 2 for g in gens.values()), gens
+        # now partition r1 and roll back to the original artifact:
+        # the rollout must fail typed and r0 must roll back (gen 4:
+        # 2 → 3 on the v1 swap → 4 on the rollback to v2 — the canary
+        # swapped to v1 before r1's reload hit the partition)
+        router.health()          # refresh the cache pre-partition
+        faults.partition(proxy)
+        with pytest.raises(ReloadFailed, match="rolled back"):
+            router.reload(artifact["dir"])
+        assert router.dirname == d_v2          # previous artifact kept
+        assert reps["r0"].generation == 4       # v1 swap + rollback
+        out = router.run(_single(artifact["feed8"], 0), timeout=60)
+        assert "logits" in out                  # fleet still serving
+        faults.heal(proxy)
+        router.replace("r1")                    # fresh process, v2
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                router.health()["state"] != "ready":
+            time.sleep(0.1)
+        assert router.health()["state"] == "ready"
+        router.close(drain=False, timeout=10)
+    finally:
+        if proxy is not None:
+            proxy.close()
+        for p in procs:
+            p.stop()
+
+
+def test_fleet_drill_process_drills_pass():
+    """The process-level drills (SIGKILL mid-stream at ~3x saturation;
+    partition during rolling reload) hold their contracts end to end
+    (exit 0; exit-code contract 0/2/3 preserved)."""
+    from tools import fleet_drill
+
+    assert fleet_drill.main(["--drills", "pkill,partition",
+                             "--replicas", "2", "--requests", "24"]) == 0

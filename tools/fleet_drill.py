@@ -28,6 +28,23 @@ artifact, continuous batching on, driven at ~3x measured saturation):
   canary-failing artifact (NaN weights) is rejected with the fleet
   still on the previous generation — also zero errors.
 
+Process-level drills (each spawns a REAL cross-process fleet —
+``FleetRouter.spawn(remote=True)``, one OS process per replica over
+the framed wire — and injects real faults, not in-process stand-ins):
+
+- **pkill** — ``faults.kill_process`` (SIGKILL, no cleanup) on one
+  replica process mid-stream at ~3x saturation: zero
+  accepted-but-undispatched requests lost (transparently rerouted —
+  a surfaced ``ServerClosed`` fails the drill), dispatched ones
+  surface ``ReplicaDied`` exactly once; fleet health degrades during
+  the outage and recovers after ``replace()`` respawns a process from
+  the artifact.
+- **partition** — ``faults.partition`` blackholes one replica's link
+  (half-open TCP, sockets stay open) mid-rolling-reload: the rollout
+  fails on the partitioned replica, the already-swapped replicas roll
+  back to the previous artifact, zero accepted in-flight requests are
+  dropped, and after ``heal`` + ``replace`` the fleet is ready again.
+
 Exit status: **0** all drills pass; **2** a drill dropped an accepted
 request or failed its contract (each violation printed); **3** the
 drill harness itself crashed (never a verdict).
@@ -288,7 +305,169 @@ def drill_reload(root, replicas, requests):
     return violations
 
 
-DRILLS = {"kill": drill_kill, "hang": drill_hang, "reload": drill_reload}
+REMOTE_KW = dict(probe_timeout=0.5, down_cooldown=0.5, submit_timeout=5.0,
+                 connect_timeout=1.0, reload_timeout=10.0)
+
+
+def _spawn_remote_fleet(dirname, feed, replicas, **kw):
+    from paddle_tpu.fleet import FleetRouter
+    from paddle_tpu.fleet.batching import BatchPolicy
+
+    kw.setdefault("workers", 1)
+    kw.setdefault("queue_size", 16)
+    kw.setdefault("golden_feed", feed)
+    kw.setdefault("batch_policy", BatchPolicy(max_wait_ms=2.0))
+    return FleetRouter.spawn(dirname, replicas=replicas, remote=True,
+                             remote_kw=dict(REMOTE_KW), **kw)
+
+
+def drill_pkill(root, replicas, requests):
+    from paddle_tpu.testing import faults
+
+    dirname, feed = _build_artifact(root, name="model_pkill")
+    router = _spawn_remote_fleet(dirname, feed, replicas)
+    violations = []
+    try:
+        rate = _saturation_rate(router, feed)
+        victim = router.replica_names[1 % len(router.replica_names)]
+        seen_degraded = []
+
+        def kill():
+            faults.kill_process(router.replica(victim))
+            time.sleep(0.1)  # let probes notice before sampling health
+            seen_degraded.append(router.health()["state"])
+
+        pending, rejected = _drive(router, feed, requests, rate,
+                                   act_at=requests // 3, act=kill)
+        outcomes, dropped = _collect(pending)
+        print(f"  pkill: accepted={len(pending)} shed={rejected} "
+              f"outcomes={outcomes}")
+        if dropped:
+            violations.append(f"dropped accepted request(s): {dropped[:3]}")
+        if seen_degraded and seen_degraded[0] not in ("degraded",
+                                                      "unavailable"):
+            violations.append(
+                f"health did not degrade on process kill "
+                f"(saw {seen_degraded[0]})")
+        router.replace(victim)   # respawns a fresh PROCESS
+        state = router.health()["state"]
+        if state != "ready":
+            violations.append(f"health did not recover after replace "
+                              f"(state={state})")
+        shipped = router.ship_journals()
+        if not shipped:
+            violations.append("journal shipping returned no events from "
+                              "the surviving replicas")
+    finally:
+        router.close(drain=False, timeout=10)
+    return violations
+
+
+def drill_partition(root, replicas, requests):
+    import numpy as np
+
+    import jax
+    from paddle_tpu import serving
+    from paddle_tpu.fleet import FleetRouter
+    from paddle_tpu.fleet.batching import BatchPolicy
+    from paddle_tpu.fleet.remote import RemoteReplica, ReplicaProcess
+    from paddle_tpu.testing import faults
+
+    dirname, feed = _build_artifact(root, name="model_part")
+    d_v2, _ = _build_artifact(
+        root, name="model_part_v2",
+        mutate=lambda p: jax.tree.map(lambda v: v * 0.5, p))
+    server_kw = dict(workers=1, queue_size=16, golden_feed=feed,
+                     batch_policy=BatchPolicy(max_wait_ms=2.0))
+    procs = [ReplicaProcess(dirname, server_kw=server_kw)
+             for _ in range(replicas)]
+    for p in procs:
+        p.wait_ready()
+    victim = f"r{replicas - 1}"   # LAST in rollout order, deterministic
+    proxy = faults.LinkProxy(procs[-1].addr)
+    reps = {}
+    for i, proc in enumerate(procs):
+        addr = proxy.addr if i == replicas - 1 else proc.addr
+        reps[f"r{i}"] = RemoteReplica(addr, proc=proc, name=f"r{i}",
+                                      num_workers=1, **REMOTE_KW)
+    router = FleetRouter(reps, dirname=dirname, server_kw=server_kw,
+                         probe_timeout=1.0, remote=True,
+                         remote_kw=dict(REMOTE_KW))
+    violations = []
+    errors = []
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            try:
+                router.run(feed, timeout=120)
+            except (serving.ServerOverloaded, serving.ReplicaDied):
+                pass   # shed / at-most-once during the partition: legal
+            except serving.ServerClosed as e:
+                errors.append(f"dropped: {e!r}")
+            except BaseException as e:
+                errors.append(repr(e))
+
+    def watch_canary_then_partition():
+        # the canary (r0) swaps first; partition the victim's link the
+        # moment it does, so the rollout provably fails ON the victim
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if router.replica("r0").generation >= 2:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.02)
+        faults.partition(proxy)
+
+    t = threading.Thread(target=pump)
+    w = threading.Thread(target=watch_canary_then_partition)
+    t.start()
+    try:
+        time.sleep(0.05)
+        w.start()
+        try:
+            router.reload(d_v2)
+            violations.append("rolling reload SUCCEEDED through a "
+                              "partitioned replica")
+        except serving.ReloadFailed:
+            pass
+        except BaseException as e:
+            violations.append(f"mid-rollout partition surfaced untyped: "
+                              f"{e!r}")
+        w.join(timeout=60)
+        for name in router.replica_names:
+            if name == victim:
+                continue
+            gen = router.replica(name).generation
+            if gen != 3:   # 1 → 2 (v2 swap) → 3 (rollback to prev)
+                violations.append(f"replica {name} not rolled back "
+                                  f"(generation {gen}, want 3)")
+        if router.dirname != dirname:
+            violations.append(f"router artifact moved to {router.dirname}")
+        stop.set()
+        t.join(timeout=120)
+        if errors:
+            violations.append(f"in-flight request dropped during "
+                              f"partitioned reload: {errors[:3]}")
+        faults.heal(proxy)
+        router.replace(victim)   # fresh process on the rolled-back artifact
+        state = router.health()["state"]
+        if state != "ready":
+            violations.append(f"fleet not ready after heal+replace "
+                              f"(state={state})")
+        print(f"  partition: pump_errors={len(errors)} final={state}")
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        router.close(drain=False, timeout=10)
+        proxy.close()
+    return violations
+
+
+DRILLS = {"kill": drill_kill, "hang": drill_hang, "reload": drill_reload,
+          "pkill": drill_pkill, "partition": drill_partition}
 
 
 def main(argv=None) -> int:
@@ -297,9 +476,13 @@ def main(argv=None) -> int:
     ap.add_argument("--replicas", type=int, default=3)
     ap.add_argument("--requests", type=int, default=90)
     ap.add_argument("--drills", default="kill,hang,reload",
-                    help="comma list from: kill,hang,reload")
+                    help="comma list from: kill,hang,reload,pkill,"
+                         "partition (the last two spawn a real "
+                         "cross-process fleet); 'all' runs every drill")
     args = ap.parse_args(argv)
     names = [n.strip() for n in args.drills.split(",") if n.strip()]
+    if names == ["all"]:
+        names = list(DRILLS)
     unknown = [n for n in names if n not in DRILLS]
     if unknown:
         print(f"fleet_drill: unknown drill(s) {unknown} "
