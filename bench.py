@@ -134,6 +134,7 @@ def _time_trainer(trainer, host_batches, warmup=3, iters=20,
                 yield host_batches[i % len(host_batches)]
 
         tel0 = get_registry().counter_values()
+        sh, ship0 = _shipper_snapshot()
         t0 = time.perf_counter()
         for feed in DeviceFeeder(gen, put_fn=trainer._put_feed, capacity=2):
             out = trainer.step(feed)
@@ -143,6 +144,12 @@ def _time_trainer(trainer, host_batches, warmup=3, iters=20,
         # the row's `telemetry` snapshot (_result picks this up)
         trainer._bench_telemetry = counter_deltas(
             tel0, get_registry().counter_values(), per=iters)
+        if sh is not None:
+            # a collector is attached (PDTPU_TELEMETRY_ADDR): the row
+            # also records what shipping COST over the window — events
+            # shipped/dropped + flush seconds per step
+            trainer._bench_shipper = counter_deltas(
+                ship0, sh.counters(), per=iters)
 
         staged = [trainer._put_feed(b) for b in host_batches[:2]]
         out = trainer.step(staged[0])
@@ -173,6 +180,7 @@ def _time_trainer(trainer, host_batches, warmup=3, iters=20,
                           put_stacked_fn=lambda d: trainer._put_feed(
                               d, stacked=True))
     tel0 = get_registry().counter_values()
+    sh, ship0 = _shipper_snapshot()
     t0 = time.perf_counter()
     for n, feed in feeder:
         out = trainer.run_steps(feed, k=n) if n > 1 else trainer.step(feed)
@@ -180,6 +188,9 @@ def _time_trainer(trainer, host_batches, warmup=3, iters=20,
     dt_pipe = (time.perf_counter() - t0) / steps
     trainer._bench_telemetry = counter_deltas(
         tel0, get_registry().counter_values(), per=steps)
+    if sh is not None:
+        trainer._bench_shipper = counter_deltas(ship0, sh.counters(),
+                                                per=steps)
 
     # feeds are NOT donated (only the training carry is), so pre-staged
     # super-batches can be reused across dispatches like the k=1 path
@@ -192,6 +203,16 @@ def _time_trainer(trainer, host_batches, warmup=3, iters=20,
     _sync(out)
     dt_comp = (time.perf_counter() - t0) / steps
     return dt_pipe, dt_comp
+
+
+def _shipper_snapshot():
+    """(active shipper, its counters) when a telemetry collector is
+    attached to this process, else (None, None) — the bench rows'
+    shipping-cost snapshot source."""
+    from paddle_tpu.telemetry import shipper as _tshipper
+
+    sh = _tshipper.active_shipper()
+    return (sh, sh.counters()) if sh is not None else (None, None)
 
 
 def _result(n_per_step, unit, dt_pipe, dt_comp, flops_per_step, peak,
@@ -213,6 +234,12 @@ def _result(n_per_step, unit, dt_pipe, dt_comp, flops_per_step, peak,
         tel = getattr(trainer, "_bench_telemetry", None)
         if tel is not None:
             out["telemetry"] = tel
+        # shipping-cost deltas ride along only when a collector was
+        # attached during the measured window (PDTPU_TELEMETRY_ADDR):
+        # events shipped/dropped + flush seconds per step
+        ship = getattr(trainer, "_bench_shipper", None)
+        if ship is not None:
+            out["shipper"] = ship
     if feed is not None:
         # the honest h2d numerator: WIRE bytes (what actually crosses
         # the link under the trainer's feed_wire table), alongside the
@@ -893,6 +920,7 @@ def bench_serving(peak, batch_size=64, requests=240, workers=2,
     reject_rate = {}
     offered = {}
     telemetry = {}
+    shipper = {}
     for variant, (pred, feed) in sorted(_serving_predictors(batch_size).items()):
         server = _make_server(pred, workers, queue_size)
         try:
@@ -900,6 +928,7 @@ def bench_serving(peak, batch_size=64, requests=240, workers=2,
             capacity = workers / svc            # req/s the pool sustains
             steady_rate = max(1.0, 0.6 * capacity)
             tel0 = get_registry().counter_values()
+            sh, ship0 = _shipper_snapshot()
             lats, _ = _drive_serving(server, feed, requests, steady_rate)
             # steady-phase registry COUNTER deltas per REQUEST — the
             # serving row's `telemetry` snapshot (submitted/completed/
@@ -907,6 +936,12 @@ def bench_serving(peak, batch_size=64, requests=240, workers=2,
             # deliberately excluded — latency lives in latency_ms)
             telemetry[variant] = counter_deltas(
                 tel0, get_registry().counter_values(), per=requests)
+            if sh is not None:
+                # collector attached: record what shipping cost over
+                # the steady phase (events shipped/dropped, flush
+                # seconds) per request
+                shipper[variant] = counter_deltas(ship0, sh.counters(),
+                                                  per=requests)
             sat_rate = 3.0 * capacity
             _, rejected = _drive_serving(server, feed, requests, sat_rate)
         finally:
@@ -919,7 +954,7 @@ def bench_serving(peak, batch_size=64, requests=240, workers=2,
         reject_rate[variant] = round(rejected / requests, 4)
         offered[variant] = {"steady_rps": round(steady_rate, 2),
                             "saturated_rps": round(sat_rate, 2)}
-    return {
+    out = {
         "value": latency["fp32"]["p99"],
         "unit": f"ms p99 steady-state served latency (fp32, bs={batch_size}, "
                 "0.6x capacity offered load)",
@@ -932,6 +967,9 @@ def bench_serving(peak, batch_size=64, requests=240, workers=2,
         "queue_size": queue_size,
         "batch_size": batch_size,
     }
+    if shipper:
+        out["shipper"] = shipper
+    return out
 
 
 def _fleet_artifact(batch_size):

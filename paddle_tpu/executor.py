@@ -323,6 +323,12 @@ class Trainer:
         self.telemetry_inst = get_registry().next_instance("trainer")
         self.guard_incident_total = 0
         self._telemetry_server = None
+        # push shipping: with PDTPU_TELEMETRY_ADDR set, this process
+        # streams its journal + registry snapshots to the telemetry
+        # collector — zero code beyond the env var (ship_to() is the
+        # explicit door); never raises into training
+        from .telemetry.shipper import maybe_auto_ship
+        maybe_auto_ship()
         # per-dispatch wall-time accounting (profiling.steptime):
         # always-on — two clock reads per dispatch, <2% of step time
         # test-pinned — and merged with pipeline_metrics by
@@ -1230,6 +1236,17 @@ class Trainer:
             srv = self._telemetry_server = _serve(health_fn=health,
                                                   port=port, host=host)
         return srv
+
+    def ship_to(self, addr, origin=None, **kw):
+        """Attach the PROCESS telemetry shipper to a collector at
+        ``addr`` (``"host:port"`` or a tuple): journal events + registry
+        snapshots stream there in the background — the push mirror of
+        :meth:`serve_metrics` (``PDTPU_TELEMETRY_ADDR`` does the same
+        with zero code). Returns the :class:`~paddle_tpu.telemetry.
+        shipper.Shipper`."""
+        from .telemetry.shipper import ship_to as _ship_to
+
+        return _ship_to(addr, origin=origin, **kw)
 
     def _put_feed_impl(self, feed: Feed, stacked, metrics):
         if self.feed_wire is not None:

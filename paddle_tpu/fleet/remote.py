@@ -78,8 +78,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..parallel.async_ps import (FramedClient, ReplyLost, read_exact,
-                                 read_line)
+from ..parallel.async_ps import (FramedClient, ReplyLost, child_python_env,
+                                 read_exact, read_line)
 from ..serving import (CircuitOpen, DeadlineExceeded, ReloadFailed,
                        ReplicaDied, ServerClosed, ServerOverloaded,
                        ServingError, WorkerHung)
@@ -241,10 +241,11 @@ class ReplicaProcess:
         cfg_path = os.path.join(self._cfg_dir, "replica.json")
         with open(cfg_path, "w", encoding="utf-8") as f:
             json.dump(cfg, f)
-        env = dict(os.environ)
-        env["PYTHONPATH"] = os.pathsep.join(
-            [p for p in sys.path if p] +
-            [env[k] for k in ("PYTHONPATH",) if env.get(k)])
+        # PDTPU_TELEMETRY_ADDR is deliberately KEPT (each replica
+        # process ships to the collector on its own) but the ORIGIN
+        # override is not — it names ONE process, and inheriting it
+        # would collapse the whole fleet onto a single origin
+        env = child_python_env(pop=("PDTPU_TELEMETRY_ORIGIN",))
         self._proc = subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.fleet.replica_main",
              cfg_path],
@@ -815,18 +816,11 @@ class RemoteReplica:
         over the control link and rebuilt as families — what the
         router's ``merge_exports`` aggregation consumes, exactly as it
         would an in-process replica's."""
-        from ..telemetry.registry import MetricFamily
+        from ..telemetry.registry import families_from_snapshot
 
         with self._ctl_lock:
             snap = self._ctl.call("METRICS", timeout=self.probe_timeout * 5)
-        fams = []
-        for fname in sorted(snap or {}):
-            d = snap[fname]
-            fam = MetricFamily(fname, d["type"], d["help"])
-            for s in d["samples"]:
-                fam.add(s["labels"], s["value"])
-            fams.append(fam)
-        return fams
+        return families_from_snapshot(snap or {})
 
     def journal_events(self, since_seq: int = 0) -> List[Dict[str, Any]]:
         """The replica's retained journal ring (events with ``seq`` >

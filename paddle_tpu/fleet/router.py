@@ -183,9 +183,15 @@ class FleetRouter:
         self._routed: Dict[str, int] = {n: 0 for n in self._replicas}
         self._telemetry_server = None
         from ..telemetry import get_registry
+        from ..telemetry.shipper import maybe_auto_ship
         self.telemetry_inst = get_registry().next_instance("fleet")
         self._telemetry_cid = get_registry().add_collector(
             FleetRouter._own_families, owner=self)
+        # push shipping: PDTPU_TELEMETRY_ADDR streams the router
+        # process's journal + registry (its fleet_* series included)
+        # to the telemetry collector; remote replicas inherit the env
+        # var and ship per-process on their own
+        maybe_auto_ship()
 
     @property
     def journal(self):
@@ -814,6 +820,18 @@ class FleetRouter:
                 registry=FamiliesView(self.metrics_families),
                 health_fn=self.health, port=port, host=host)
         return self._telemetry_server
+
+    def ship_to(self, addr, origin=None, **kw):
+        """Attach THIS process's telemetry shipper to a collector at
+        ``addr`` (``PDTPU_TELEMETRY_ADDR`` does the same with zero
+        code). Remote replicas are separate processes — they ship on
+        their own via the inherited env var; in-process replicas share
+        this process's registry/journal and are covered by this one
+        shipper. Returns the :class:`~paddle_tpu.telemetry.shipper.
+        Shipper`."""
+        from ..telemetry.shipper import ship_to as _ship_to
+
+        return _ship_to(addr, origin=origin, **kw)
 
 
 __all__ = ["FleetPending", "FleetRouter", "NoReplicaAvailable"]

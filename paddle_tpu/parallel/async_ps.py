@@ -111,6 +111,29 @@ class PushUndelivered(ReplyLost):
     gradient; losing one is ordinary async-SGD staleness)."""
 
 
+def child_python_env(pop: Sequence[str] = ()) -> Dict[str, str]:
+    """Environment for spawning a python child that must import this
+    package: the parent's env with ``sys.path`` folded into
+    ``PYTHONPATH`` (the child resolves ``paddle_tpu`` exactly as the
+    parent did), minus the ``pop``'d variables — a spawned collector
+    must not inherit ``PDTPU_TELEMETRY_ADDR`` and ship to itself, and
+    a spawned replica must not inherit ``PDTPU_TELEMETRY_ORIGIN`` or
+    every process in the fleet collapses onto ONE collector origin
+    (colliding series, absence alerts that can never fire). Shared by
+    every framed-wire process spawner (fleet replicas, the telemetry
+    collector daemon)."""
+    import os
+    import sys
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in sys.path if p] +
+        [env[k] for k in ("PYTHONPATH",) if env.get(k)])
+    for k in pop:
+        env.pop(k, None)
+    return env
+
+
 def read_line(sock: socket.socket) -> str:
     """Read one ``\\n``-terminated ASCII header line off a framed-
     protocol socket (the pserver / fleet-replica wire discipline)."""

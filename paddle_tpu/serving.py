@@ -554,6 +554,12 @@ class PredictorServer:
         self.journal = get_journal()
         self.telemetry_inst = get_registry().next_instance("serving")
         self._telemetry_server = None
+        # push shipping: PDTPU_TELEMETRY_ADDR streams this process's
+        # journal + registry snapshots to the telemetry collector (a
+        # remote replica inherits the env var and ships on its own) —
+        # ship_to() is the explicit door; never raises into serving
+        from .telemetry.shipper import maybe_auto_ship
+        maybe_auto_ship()
         self.breaker = CircuitBreaker(breaker, on_trip=self._on_breaker_trip)
         self._workers: List[_Worker] = []
         self._watchdog: Optional[threading.Thread] = None
@@ -1404,6 +1410,16 @@ class PredictorServer:
             self._telemetry_server = _serve(health_fn=self.health,
                                             port=port, host=host)
         return self._telemetry_server
+
+    def ship_to(self, addr, origin=None, **kw):
+        """Attach the PROCESS telemetry shipper to a collector at
+        ``addr`` — journal events + registry snapshots stream there in
+        the background (``PDTPU_TELEMETRY_ADDR`` does the same with
+        zero code, including inside spawned replica processes).
+        Returns the :class:`~paddle_tpu.telemetry.shipper.Shipper`."""
+        from .telemetry.shipper import ship_to as _ship_to
+
+        return _ship_to(addr, origin=origin, **kw)
 
     def report(self) -> Dict[str, Any]:
         """Metrics + health in one dict (the serving mirror of

@@ -26,9 +26,24 @@ operator points Prometheus (and post-mortem tooling) at:
 - :mod:`http` — the opt-in stdlib-only ``GET /metrics`` +
   ``GET /healthz`` endpoint both ``Trainer.serve_metrics()`` and
   ``PredictorServer.serve_metrics()`` expose.
+- :mod:`collector` — the **collector daemon**: a standalone (or
+  in-process) sink ANY process pushes its journal + registry snapshots
+  to over the framed wire, maintaining per-origin time series, a
+  fleet-wide journal, and ``/metrics`` (merged under ``origin``),
+  ``/alerts``, ``/timeline?trace=<span>`` read endpoints.
+- :mod:`alerts` — the **declarative alert engine** the collector
+  evaluates: threshold / rate-over-window / absence / histogram-
+  quantile rules with ``for_s`` durations and a firing→resolved state
+  machine, plus the preset pack over the metric name table
+  (``tools/alert_check.py`` lints rule files offline).
+- :mod:`shipper` — the **push pipeline**: a background thread shipping
+  journal-ring deltas + periodic snapshots to a collector, auto-
+  started by ``PDTPU_TELEMETRY_ADDR`` (or ``ship_to(addr)``), bounded
+  buffering, the hot path never blocks.
 
 See MIGRATION.md "Telemetry" for the metric name table, journal event
-schema, and flight-recorder trigger/dump format.
+schema, flight-recorder trigger/dump format, the collector wire verbs,
+and the alert-rule grammar + preset table.
 """
 
 from .journal import (RunJournal, get_journal, new_run_id, parse_sample,
@@ -37,17 +52,29 @@ from .recorder import (FlightRecorder, default_flight_dir, flight_dump,
                        get_recorder)
 from .registry import (Counter, FamiliesView, Gauge, Histogram, MetricFamily,
                        MetricsRegistry, counter_deltas, counter_family,
-                       families_snapshot, gauge_family, get_registry,
+                       families_from_snapshot, families_snapshot,
+                       gauge_family, get_registry,
                        histogram_family, merge_exports,
                        render_families_prometheus, validate_families)
 from .http import TelemetryServer, serve_metrics
+from .alerts import (AlertEngine, AlertRule, PRESET_PACK, lint_rules,
+                     load_rules, parse_rule, preset_rules)
+from .collector import (CollectorProcess, SeriesStore, TelemetryCollector,
+                        assemble_timeline, render_timeline_text)
+from .shipper import (Shipper, active_shipper, maybe_auto_ship, ship_to,
+                      stop_shipping)
 
 __all__ = [
-    "Counter", "FamiliesView", "FlightRecorder", "Gauge", "Histogram",
-    "MetricFamily", "MetricsRegistry", "RunJournal", "TelemetryServer",
-    "counter_deltas", "counter_family", "default_flight_dir",
+    "AlertEngine", "AlertRule", "CollectorProcess", "Counter",
+    "FamiliesView", "FlightRecorder", "Gauge", "Histogram",
+    "MetricFamily", "MetricsRegistry", "PRESET_PACK", "RunJournal",
+    "SeriesStore", "Shipper", "TelemetryCollector", "TelemetryServer",
+    "active_shipper", "assemble_timeline", "counter_deltas",
+    "counter_family", "default_flight_dir", "families_from_snapshot",
     "families_snapshot", "flight_dump", "gauge_family", "get_journal",
-    "get_recorder", "get_registry", "histogram_family", "merge_exports",
-    "new_run_id", "parse_sample", "render_families_prometheus",
-    "serve_metrics", "set_journal", "validate_families",
+    "get_recorder", "get_registry", "histogram_family", "lint_rules",
+    "load_rules", "maybe_auto_ship", "merge_exports", "new_run_id",
+    "parse_rule", "parse_sample", "preset_rules",
+    "render_families_prometheus", "render_timeline_text", "serve_metrics",
+    "set_journal", "ship_to", "stop_shipping", "validate_families",
 ]

@@ -1,0 +1,933 @@
+"""Telemetry collector daemon: fleet-wide time series, alerts, and
+cross-process trace timelines from pushed telemetry.
+
+Everything before this module is pull-only and per-process: each
+trainer/replica serves its own ``/metrics``, and journal shipping
+exists only for fleet-OWNED replicas (``FleetRouter.ship_journals``).
+The collector inverts the direction: ANY process — a trainer, an
+out-of-process serving replica, a router — runs a background
+:class:`~paddle_tpu.telemetry.shipper.Shipper` (auto-started by
+``PDTPU_TELEMETRY_ADDR``, or ``ship_to(addr)``) that PUSHES its
+journal-ring deltas and periodic registry snapshots here over the
+same length-prefixed framed wire the async-PS path speaks
+(:class:`~paddle_tpu.parallel.async_ps.FramedClient` reuse).
+
+Wire verbs (shipper → collector; one ASCII header line + one json
+body; replies ``OK <n>`` / ``ERR <reason>``)::
+
+    PING
+    EVENTS <origin> <len>    + {"run": ..., "events": [...]}
+    SNAPSHOT <origin> <len>  + {"t": ..., "families": families_snapshot}
+
+``EVENTS`` ingestion is idempotent: events are deduplicated by a
+per-``(origin, run)`` high-water ``seq``, so a shipper whose reply was
+lost simply resends the batch (no at-most-once dance needed on a
+telemetry path — double-counting is prevented server-side).
+
+The collector maintains:
+
+- a :class:`SeriesStore` — per-origin bounded time-series rings over
+  every pushed metric sample (counters/gauges as ``(t, value)``,
+  histograms as ``(t, bucket counts)``), the substrate the
+  :class:`~paddle_tpu.telemetry.alerts.AlertEngine` evaluates every
+  ``eval_interval`` and an autoscaler can read;
+- its OWN :class:`~paddle_tpu.telemetry.journal.RunJournal` holding
+  the ingested fleet-wide event stream (every event keeps its origin
+  run/seq and gains ``origin=``) — one ring answers "what was the
+  whole fleet doing around this span";
+- HTTP read endpoints (:meth:`TelemetryCollector.serve_http`):
+  ``/metrics`` (every origin's latest snapshot merged under an
+  ``origin`` label — naming-contract clean), ``/alerts`` (JSON,
+  firing + pending + recently-resolved), and ``/timeline?trace=<span>``
+  (the cross-process waterfall of one trace id, assembled from the
+  ingested journals; ``&format=text`` renders it).
+
+An alert transition journals ``alert.firing``/``alert.resolved`` and
+— for ``page``-severity rules (or all, with ``dump_on_fire=True``) —
+triggers a local flight dump carrying the fleet-wide ring, so the
+evidence is on disk the moment the pager goes off.
+
+Run in-process (``TelemetryCollector()``) or standalone::
+
+    python -m paddle_tpu.telemetry.collector [--port N] [--http-port N]
+        [--rules rules.json] [--eval-interval S] [--flight-root DIR]
+
+The daemon prints ``PORT <n>`` and ``HTTP <n>`` once listening (the
+:class:`CollectorProcess` handshake, same discipline as
+``replica_main``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import socket
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import alerts as _alerts
+from .journal import RunJournal
+from .recorder import FlightRecorder
+from .registry import (MetricFamily, _series_key, counter_family,
+                       families_from_snapshot, gauge_family, merge_exports)
+
+
+def _log():
+    import logging
+    return logging.getLogger("paddle_tpu.telemetry.collector")
+
+
+# -- per-origin time series ---------------------------------------------------
+
+
+class SeriesStore:
+    """Bounded time-series rings over pushed metric snapshots, keyed by
+    series (name + labels, the pushing origin stamped as an ``origin``
+    label). Counters/gauges ring ``(t, value)``; histograms ring
+    ``(t, bucket counts, sum, count)`` so windowed quantiles come from
+    bucket DELTAS. Origins that stop pushing for ``origin_expiry_s``
+    are retired wholesale (their series and last-push mark dropped) —
+    which is what lets a replica-down absence alert RESOLVE once the
+    operator replaced the process."""
+
+    def __init__(self, max_points: int = 512, origin_expiry_s: float = 60.0,
+                 value_ttl_s: float = 60.0):
+        self.max_points = int(max_points)
+        self.origin_expiry_s = float(origin_expiry_s)
+        # a sample older than this yields NO threshold verdict (and a
+        # rate window with no sample inside it yields none either): a
+        # dead origin's last breaker_open=1 gauge must not keep paging
+        # until origin expiry — staleness is the absence alert's job
+        self.value_ttl_s = float(value_ttl_s)
+        self._lock = threading.Lock()
+        # series key -> ring; meta: key -> (name, labels, type[, bounds])
+        self._rings: Dict[str, deque] = {}
+        self._meta: Dict[str, Tuple[str, Dict[str, str], str, Any]] = {}
+        self._by_origin: Dict[str, set] = {}
+        # metric name -> series keys: rule matching must not scan every
+        # stored series under the lock on every eval tick
+        self._by_name: Dict[str, set] = {}
+        self._latest_snap: Dict[str, Dict[str, Any]] = {}
+        self.last_push: Dict[str, float] = {}
+
+    # -- writes --------------------------------------------------------------
+
+    @staticmethod
+    def _sanitize(snapshot) -> Dict[str, Any]:
+        """Coerce a PUSHED snapshot into the strict families_snapshot
+        shape BEFORE storing it: a version-skewed or buggy client must
+        not be able to poison every later ``/metrics`` read (a family
+        missing ``help`` becomes a visible ``validate_families``
+        violation, never a 500 on scrape). VALUES are validated too —
+        a scalar sample must be float-coercible and a histogram sample
+        a well-formed bounds/counts dict, or the SAMPLE is dropped:
+        one bad process must never make the fleet-wide scrape
+        unrenderable."""
+        out: Dict[str, Any] = {}
+        for name, fam in (snapshot or {}).items():
+            if not isinstance(fam, dict):
+                continue
+            ftype = str(fam.get("type", "untyped"))
+            samples = []
+            for s in fam.get("samples") or []:
+                if not isinstance(s, dict) or "value" not in s:
+                    continue
+                value = s["value"]
+                if ftype == "histogram":
+                    if not isinstance(value, dict):
+                        continue
+                    try:
+                        bounds = [float(b) for b in
+                                  value.get("bounds") or []]
+                        counts = [int(c) for c in
+                                  value.get("counts") or []]
+                        value = {"bounds": bounds, "counts": counts,
+                                 "sum": float(value.get("sum", 0.0)),
+                                 "count": int(value.get("count", 0))}
+                    except (TypeError, ValueError):
+                        continue
+                    if len(counts) != len(bounds) + 1:
+                        continue
+                else:
+                    try:
+                        value = float(value)
+                    except (TypeError, ValueError):
+                        continue
+                labels = s.get("labels")
+                samples.append(
+                    {"labels": ({str(k): str(v)
+                                 for k, v in labels.items()}
+                                if isinstance(labels, dict) else {}),
+                     "value": value})
+            out[str(name)] = {"type": ftype,
+                              "help": str(fam.get("help", "")),
+                              "samples": samples}
+        return out
+
+    def ingest(self, origin: str, snapshot: Dict[str, Any],
+               t: Optional[float] = None) -> int:
+        """Absorb one origin's ``families_snapshot`` dict (sanitized —
+        see :meth:`_sanitize`); returns the number of samples
+        ringed."""
+        t = time.time() if t is None else t
+        snapshot = self._sanitize(snapshot)
+        n = 0
+        with self._lock:
+            self._latest_snap[origin] = snapshot
+            self.last_push[origin] = t
+            keys = self._by_origin.setdefault(origin, set())
+            for name, fam in snapshot.items():
+                ftype = fam.get("type", "untyped")
+                for s in fam.get("samples", []):
+                    labels = dict(s.get("labels", {}))
+                    labels.setdefault("origin", origin)
+                    key = _series_key(name, labels)
+                    ring = self._rings.get(key)
+                    if ring is None:
+                        ring = self._rings[key] = deque(
+                            maxlen=self.max_points)
+                    value = s.get("value")
+                    if ftype == "histogram" and isinstance(value, dict):
+                        self._meta[key] = (name, labels, ftype,
+                                           tuple(value.get("bounds", ())))
+                        ring.append((t, tuple(value.get("counts", ())),
+                                     float(value.get("sum", 0.0)),
+                                     int(value.get("count", 0))))
+                    else:
+                        try:
+                            v = float(value)
+                        except (TypeError, ValueError):
+                            continue
+                        self._meta[key] = (name, labels, ftype, None)
+                        ring.append((t, v))
+                    keys.add(key)
+                    self._by_name.setdefault(name, set()).add(key)
+                    n += 1
+        return n
+
+    def mark_push(self, origin: str, t: Optional[float] = None) -> None:
+        """An EVENTS-only push still proves the origin alive."""
+        with self._lock:
+            self.last_push[origin] = time.time() if t is None else t
+            self._by_origin.setdefault(origin, set())
+
+    def expire(self, now: Optional[float] = None) -> List[str]:
+        """Retire origins silent past ``origin_expiry_s``; returns the
+        retired names."""
+        now = time.time() if now is None else now
+        with self._lock:
+            stale = [o for o, t in self.last_push.items()
+                     if now - t > self.origin_expiry_s]
+            for origin in stale:
+                self.last_push.pop(origin, None)
+                self._latest_snap.pop(origin, None)
+                for key in self._by_origin.pop(origin, set()):
+                    self._rings.pop(key, None)
+                    meta = self._meta.pop(key, None)
+                    if meta is not None:
+                        named = self._by_name.get(meta[0])
+                        if named is not None:
+                            named.discard(key)
+                            if not named:
+                                del self._by_name[meta[0]]
+        return stale
+
+    # -- reads ---------------------------------------------------------------
+
+    def origins(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self.last_push)
+
+    def latest_snapshots(self) -> Dict[str, Dict[str, Any]]:
+        """Per-origin latest ``families_snapshot`` dicts (copied under
+        the store lock) — the raw material of :meth:`latest_families`
+        and the collector's merged export."""
+        with self._lock:
+            return dict(self._latest_snap)
+
+    def latest_families(self) -> List[MetricFamily]:
+        """Every origin's latest snapshot, merged under ``origin`` —
+        the fleet-wide ``/metrics`` body (same primitive as the fleet
+        router's ``replica`` merge, so the naming contract holds)."""
+        return merge_exports(
+            {origin: families_from_snapshot(snap)
+             for origin, snap in self.latest_snapshots().items()},
+            label="origin")
+
+    def _match_locked(self, metric: str,
+                      labels: Dict[str, str]) -> List[str]:
+        out = []
+        for key in self._by_name.get(metric, ()):
+            slabels = self._meta[key][1]
+            if all(slabels.get(k) == v for k, v in labels.items()):
+                out.append(key)
+        return sorted(out)
+
+    # -- the AlertEngine store interface -------------------------------------
+
+    def latest_values(self, metric: str, labels: Dict[str, str],
+                      now: Optional[float] = None
+                      ) -> List[Tuple[str, Optional[float]]]:
+        """Latest sample per matching series — skipping samples older
+        than ``value_ttl_s`` (a dead origin's frozen gauge yields no
+        verdict; its silence is the absence alert's signal)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            out = []
+            for key in self._match_locked(metric, labels):
+                ring = self._rings.get(key)
+                if not ring or self._meta[key][2] == "histogram":
+                    continue
+                t1, v1 = ring[-1][0], ring[-1][1]
+                if now - t1 > self.value_ttl_s:
+                    continue
+                out.append((key, v1))
+            return out
+
+    def rates(self, metric: str, labels: Dict[str, str], window_s: float,
+              now: float) -> List[Tuple[str, Optional[float]]]:
+        """Per-second increase over the window: newest sample vs the
+        newest sample at/just before the window start (so a window
+        spanning exactly two flushes still rates). A decrease (process
+        restart reset the counter) clamps to the post-reset value over
+        the window rather than going negative. A series with NO sample
+        inside the window yields no verdict — a dead origin's last
+        burst must not keep a rate alert firing on wholly-stale data
+        (the quantile form's idle-window contract, applied here
+        too)."""
+        with self._lock:
+            out = []
+            for key in self._match_locked(metric, labels):
+                ring = self._rings.get(key)
+                if not ring or self._meta[key][2] == "histogram":
+                    continue
+                pts = list(ring)
+                t1, v1 = pts[-1][0], pts[-1][1]
+                if t1 < now - window_s:
+                    continue  # every sample predates the window
+                base = None
+                for t0, v0 in reversed(pts[:-1]):
+                    base = (t0, v0)
+                    if t0 <= now - window_s:
+                        break
+                if base is None or base[0] >= t1:
+                    continue  # a single sample rates nothing
+                dv = v1 - base[1]
+                if dv < 0:
+                    dv = v1  # counter reset: count from zero
+                out.append((key, dv / (t1 - base[0])))
+            return out
+
+    def quantiles(self, metric: str, labels: Dict[str, str], q: float,
+                  window_s: float, now: float
+                  ) -> List[Tuple[str, Optional[float]]]:
+        """Histogram quantile from the bucket-count DELTA across the
+        window (upper-bound estimate, the ``histogram_quantile``
+        discipline); a window with no observations yields no verdict
+        (the series is skipped, not compared against stale totals)."""
+        with self._lock:
+            out = []
+            for key in self._match_locked(metric, labels):
+                meta = self._meta[key]
+                if meta[2] != "histogram":
+                    continue
+                ring = self._rings.get(key)
+                if not ring:
+                    continue
+                pts = list(ring)
+                t1, c1 = pts[-1][0], pts[-1][1]
+                if t1 < now - window_s:
+                    continue  # every sample predates the window
+                base = None
+                for p in reversed(pts[:-1]):
+                    base = p
+                    if p[0] <= now - window_s:
+                        break
+                if base is None:
+                    # a single ringed sample: its counts are ALL-TIME
+                    # totals, not a window delta — no verdict (the
+                    # contract above), never a spurious cold-start p99
+                    continue
+                c0 = base[1]
+                if len(c0) != len(c1):
+                    c0 = (0,) * len(c1)
+                delta = [max(0, a - b) for a, b in zip(c1, c0)]
+                value = _quantile_from_counts(meta[3] or (), delta, q)
+                if value is not None:
+                    out.append((key, value))
+            return out
+
+    def staleness(self, metric: str, labels: Dict[str, str], now: float
+                  ) -> List[Tuple[str, float]]:
+        with self._lock:
+            out = []
+            for key in self._match_locked(metric, labels):
+                ring = self._rings.get(key)
+                if ring:
+                    out.append((key, now - ring[-1][0]))
+            return out
+
+    def origin_staleness(self, now: float) -> List[Tuple[str, float]]:
+        with self._lock:
+            return sorted((origin, now - t)
+                          for origin, t in self.last_push.items())
+
+
+def _quantile_from_counts(bounds, counts, q: float) -> Optional[float]:
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            return float(bounds[i]) if i < len(bounds) else math.inf
+    return math.inf
+
+
+# -- timeline assembly --------------------------------------------------------
+
+
+def assemble_timeline(events: List[Dict[str, Any]],
+                      span: str) -> Dict[str, Any]:
+    """The cross-process waterfall of one trace id: every journal
+    event carrying ``span``, sorted by wall clock, with per-event
+    offsets from the first — the feeder fill → fused dispatch → PS
+    wire → serving submit/dispatch/complete lifecycle laid out across
+    however many processes shipped it."""
+    rows = sorted((e for e in events if e.get("span") == span),
+                  key=lambda e: (e.get("t", 0.0), e.get("seq", 0)))
+    if not rows:
+        return {"span": span, "events": [], "origins": [],
+                "duration_s": 0.0}
+    t0 = rows[0].get("t", 0.0)
+    out_rows = []
+    for e in rows:
+        out_rows.append({
+            "t": e.get("t"),
+            "offset_s": round(float(e.get("t", t0)) - t0, 6),
+            "origin": e.get("origin", "local"),
+            "run": e.get("run"),
+            "seq": e.get("seq"),
+            "kind": e.get("kind"),
+            "detail": {k: v for k, v in e.items()
+                       if k not in ("t", "origin", "run", "seq", "kind",
+                                    "span")},
+        })
+    origins = sorted({r["origin"] for r in out_rows})
+    return {"span": span,
+            "t0": t0,
+            "duration_s": round(rows[-1].get("t", t0) - t0, 6),
+            "origins": origins,
+            "events": out_rows}
+
+
+def render_timeline_text(tl: Dict[str, Any], width: int = 40) -> str:
+    """ASCII waterfall of :func:`assemble_timeline`'s output — shared
+    by the collector's ``/timeline?format=text`` and the offline
+    ``tools/trace_timeline.py``."""
+    rows = tl.get("events", [])
+    if not rows:
+        return f"span {tl.get('span')}: no events\n"
+    dur = max(tl.get("duration_s") or 0.0, 1e-9)
+    lines = [f"span {tl['span']}: {len(rows)} event(s) across "
+             f"{len(tl['origins'])} origin(s) "
+             f"({', '.join(tl['origins'])}), {dur * 1e3:.2f} ms"]
+    owidth = max(len(r["origin"]) for r in rows)
+    kwidth = max(len(str(r["kind"])) for r in rows)
+    for r in rows:
+        pos = min(width - 1, int(r["offset_s"] / dur * (width - 1)))
+        bar = "." * pos + "|" + "." * (width - 1 - pos)
+        detail = ""
+        if r["detail"]:
+            short = {k: r["detail"][k] for k in sorted(r["detail"])[:3]}
+            detail = " " + json.dumps(short, sort_keys=True,
+                                      default=repr)[:60]
+        lines.append(f"  {r['offset_s'] * 1e3:9.3f}ms [{bar}] "
+                     f"{r['origin']:<{owidth}} {str(r['kind']):<{kwidth}}"
+                     f"{detail}")
+    return "\n".join(lines) + "\n"
+
+
+# -- the daemon ---------------------------------------------------------------
+
+
+class TelemetryCollector:
+    """The push-ingest + alert-eval + read-endpoint daemon (in-process
+    form; ``python -m paddle_tpu.telemetry.collector`` wraps exactly
+    this). See the module docstring for the wire and HTTP surfaces."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 rules: Optional[List[_alerts.AlertRule]] = None,
+                 eval_interval: float = 0.25,
+                 journal_ring: int = 16384,
+                 max_points: int = 512,
+                 origin_expiry_s: float = 60.0,
+                 dump_on_fire=None,
+                 flight_root: Optional[str] = None):
+        self.store = SeriesStore(max_points=max_points,
+                                 origin_expiry_s=origin_expiry_s)
+        # the collector's OWN journal (never the process default): it
+        # holds the INGESTED fleet-wide stream plus alert transitions,
+        # and a collector embedded in a test/trainer process must not
+        # bleed into that process's journal
+        self.journal = RunJournal(ring_size=journal_ring)
+        self.engine = _alerts.AlertEngine(
+            rules if rules is not None else _alerts.preset_rules(),
+            on_transition=self._on_transition)
+        self.eval_interval = float(eval_interval)
+        # dump_on_fire: True = every firing transition dumps, False =
+        # never, None (default) = page-severity rules dump
+        self.dump_on_fire = dump_on_fire
+        self._recorder = FlightRecorder(journal=self.journal,
+                                        root=flight_root)
+        self._lock = threading.Lock()
+        # serializes one EVENTS batch's whole read-filter-ingest-update
+        # against another's: a stalled handler thread racing its own
+        # retry must not double-ingest (the idempotency contract)
+        self._ingest_lock = threading.Lock()
+        # (origin, run) -> (high-water ship-seq, last touch): EVENTS
+        # dedupe (idempotent ingest makes shipper retries safe
+        # server-side). Entries untouched for origin_expiry_s are
+        # pruned by the eval loop: a STABLY-NAMED origin that restarts
+        # mints a new run id per incarnation and must not leak a dead
+        # run's entry per restart forever
+        self._high: Dict[Tuple[str, str], Tuple[int, float]] = {}
+        self._counters = {"events": 0, "snapshots": 0, "event_batches": 0,
+                          "dup_events": 0, "bad_requests": 0}
+        self._stop = threading.Event()
+        self._http: Optional[Any] = None
+
+        self._ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._ls.bind((host, int(port)))
+        self._ls.listen(64)
+        self.host = host
+        self.port = self._ls.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="pdtpu-collector-accept")
+        self._accept_thread.start()
+        self._eval_thread = threading.Thread(
+            target=self._eval_loop, daemon=True, name="pdtpu-collector-eval")
+        self._eval_thread.start()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._ls.close()
+        except OSError:
+            pass
+        if self._http is not None:
+            self._http.close()
+            self._http = None
+        self._eval_thread.join(timeout=5.0)
+
+    def __enter__(self) -> "TelemetryCollector":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- push wire -----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._ls.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True,
+                             name="pdtpu-collector-conn").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        from ..parallel.async_ps import read_exact, read_line
+
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.settimeout(30.0)
+            while not self._stop.is_set():
+                try:
+                    line = read_line(conn)
+                except (ConnectionError, OSError):
+                    return
+                parts = line.split()
+                if not parts or parts[0] == "QUIT":
+                    return
+                try:
+                    reply = self._dispatch(parts, conn, read_exact)
+                except (ConnectionError, OSError):
+                    return
+                except Exception as e:
+                    # a malformed header/body may have left its framed
+                    # payload UNREAD: reply ERR and close — keeping the
+                    # connection would parse leftover body bytes as the
+                    # next header and desync every later request (the
+                    # shipper's FramedClient reconnects transparently)
+                    with self._lock:
+                        self._counters["bad_requests"] += 1
+                    reply = f"ERR {type(e).__name__}: {e}"[:200].replace(
+                        "\n", " ")
+                    try:
+                        conn.sendall(reply.encode() + b"\n")
+                    except OSError:
+                        pass
+                    return
+                try:
+                    conn.sendall(reply.encode() + b"\n")
+                except OSError:
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, parts: List[str], conn, read_exact) -> str:
+        verb = parts[0]
+        if verb == "PING":
+            return "OK 0"
+        if verb in ("EVENTS", "SNAPSHOT") and parts[1] == "collector":
+            # reserved: the merged export stamps the collector's OWN
+            # series under this origin — a pusher claiming it would be
+            # silently overwritten there while still feeding the rings
+            # (scrape and alert state would disagree)
+            raise ValueError("origin 'collector' is reserved")
+        if verb == "EVENTS":
+            origin, blen = parts[1], int(parts[2])
+            body = json.loads(read_exact(conn, blen))
+            return f"OK {self._ingest_events(origin, body)}"
+        if verb == "SNAPSHOT":
+            origin, blen = parts[1], int(parts[2])
+            body = json.loads(read_exact(conn, blen))
+            n = self.store.ingest(origin, body.get("families") or {})
+            with self._lock:
+                self._counters["snapshots"] += 1
+            return f"OK {n}"
+        # raised (not returned) so the connection CLOSES: an unknown
+        # verb from a newer client may carry a framed body this
+        # version cannot size — reading on would desync the stream
+        raise ValueError(f"unknown verb {verb!r}")
+
+    def _ingest_events(self, origin: str, body: Dict[str, Any]) -> int:
+        run = str(body.get("run", ""))
+        events = [e for e in body.get("events", [])
+                  if isinstance(e, dict) and "kind" in e]
+        key = (origin, run)
+        # the dedupe mark: a shipper stamps each event with ``sseq``
+        # (assigned at buffer-append time, monotonic in ship order
+        # even when journal subscribers fire out of journal-seq order,
+        # stable across retries); a third-party pusher without it
+        # falls back to the journal seq — correct as long as it ships
+        # in order
+        with self._ingest_lock:
+            with self._lock:
+                high = self._high.get(key, (0, 0.0))[0]
+            fresh = []
+            for e in events:
+                mark = e.pop("sseq", None)
+                if mark is None:
+                    mark = e.get("seq")
+                if mark is None:
+                    # no dedupe mark at all: ingest rather than drop
+                    # (dedupe is impossible for such a pusher — a
+                    # retried unmarked batch may duplicate, which is
+                    # the pusher's trade, not silent loss here)
+                    fresh.append(e)
+                    continue
+                if int(mark) > high:
+                    fresh.append(e)
+                    high = max(high, int(mark))
+            dup = len(events) - len(fresh)
+            n = self.journal.ingest(fresh, origin=origin) if fresh else 0
+            with self._lock:
+                self._counters["events"] += n
+                self._counters["dup_events"] += dup
+                self._counters["event_batches"] += 1
+                self._high[key] = (max(self._high.get(key, (0, 0.0))[0],
+                                       high), time.time())
+        self.store.mark_push(origin)
+        return n
+
+    # -- alert evaluation ----------------------------------------------------
+
+    def _eval_loop(self) -> None:
+        while not self._stop.wait(self.eval_interval):
+            try:
+                self.evaluate_once()
+            except Exception as e:  # the watchtower must not fall over
+                _log().warning("alert evaluation failed: %s: %s",
+                               type(e).__name__, e)
+
+    def evaluate_once(self, now: Optional[float] = None
+                      ) -> List[Dict[str, Any]]:
+        """One expiry + evaluation tick (the eval thread's body; tests
+        and drills call it directly for deterministic timing)."""
+        now = time.time() if now is None else now
+        retired = self.store.expire(now)
+        for origin in retired:
+            self.journal.emit("collector.origin_retired", origin=origin)
+        # dedupe marks are TTL-pruned, not only origin-retired: a
+        # stably-named origin that restarts mints a new run id per
+        # incarnation while keeping its last_push fresh, so dead runs'
+        # entries would otherwise leak forever on a long-lived
+        # collector (a rejoining run re-ships its ring and dedupes
+        # from scratch — idempotent-safe)
+        gone = set(retired)
+        with self._lock:
+            for key in [k for k, (_, touched) in self._high.items()
+                        if k[0] in gone or
+                        now - touched > self.store.origin_expiry_s]:
+                del self._high[key]
+        return self.engine.evaluate(self.store, now)
+
+    def _on_transition(self, t: Dict[str, Any]) -> None:
+        self.journal.emit(f"alert.{t['state']}", rule=t["rule"],
+                          key=t["key"], value=t.get("value"),
+                          severity=t["severity"], expr=t["expr"])
+        _log().warning("alert %s: %s on %s (value=%s)", t["state"],
+                       t["rule"], t["key"], t.get("value"))
+        if t["state"] == "firing" and (
+                self.dump_on_fire is True or
+                (self.dump_on_fire is None and t["severity"] == "page")):
+            # the pager moment: flush the fleet-wide ring to disk so
+            # the evidence exists even if the collector dies next
+            self._recorder.dump(f"alert_{t['rule']}", detail=t,
+                                span=None)
+
+    # -- read surfaces -------------------------------------------------------
+
+    def families(self) -> List[MetricFamily]:
+        """ONE merged export: every origin's latest snapshot + the
+        collector's own series (stamped ``origin="collector"``) through
+        a single :func:`merge_exports` pass, so family declarations
+        never repeat and the naming contract holds."""
+        with self._lock:
+            c = dict(self._counters)
+        snap = self.engine.snapshot()
+        firing = len(snap["firing"])
+        trans = snap["transitions_total"]
+        own = [
+            counter_family("paddle_tpu_collector_events_total",
+                           "Journal events ingested from shippers",
+                           [({}, c["events"])]),
+            counter_family("paddle_tpu_collector_snapshots_total",
+                           "Metric snapshots ingested from shippers",
+                           [({}, c["snapshots"])]),
+            gauge_family("paddle_tpu_collector_origins",
+                         "Origins currently pushing telemetry",
+                         [({}, len(self.store.origins()))]),
+            gauge_family("paddle_tpu_collector_alerts_firing",
+                         "Alert instances currently firing",
+                         [({}, firing)]),
+            counter_family("paddle_tpu_collector_alert_transitions_total",
+                           "Alert state transitions (by state)",
+                           [({"state": s}, v)
+                            for s, v in sorted(trans.items())]),
+        ]
+        named = {origin: families_from_snapshot(snap)
+                 for origin, snap in self.store.latest_snapshots().items()}
+        named["collector"] = own
+        return merge_exports(named, label="origin")
+
+    def alerts_json(self) -> Dict[str, Any]:
+        return self.engine.snapshot()
+
+    def timeline(self, span: str) -> Dict[str, Any]:
+        return assemble_timeline(self.journal.recent(), span)
+
+    def serve_http(self, port: int = 0, host: Optional[str] = None):
+        """Start the read endpoint: ``/metrics`` + ``/healthz`` +
+        ``/alerts`` + ``/timeline?trace=<span>[&format=text]``.
+        Idempotent; returns the :class:`~paddle_tpu.telemetry.http.
+        TelemetryServer` (``.url``/``.port``)."""
+        from .http import serve_metrics
+        from .registry import FamiliesView
+
+        if self._http is not None:
+            return self._http
+
+        def health():
+            return {"live": not self._stop.is_set(), "role": "collector",
+                    "origins": sorted(self.store.origins()),
+                    "alerts_firing": len(self.engine.firing())}
+
+        def alerts_route(query: str):
+            body = json.dumps(self.alerts_json(), sort_keys=True,
+                              default=repr).encode()
+            return 200, "application/json", body
+
+        def timeline_route(query: str):
+            params = dict(p.partition("=")[::2]
+                          for p in query.split("&") if p)
+            span = params.get("trace") or params.get("span")
+            if not span:
+                return (400, "text/plain; charset=utf-8",
+                        b"need ?trace=<span>\n")
+            tl = self.timeline(span)
+            if params.get("format") == "text":
+                return (200, "text/plain; charset=utf-8",
+                        render_timeline_text(tl).encode())
+            return (200, "application/json",
+                    json.dumps(tl, sort_keys=True, default=repr).encode())
+
+        self._http = serve_metrics(
+            registry=FamiliesView(self.families), health_fn=health,
+            port=port, host=host or self.host,
+            extra_routes={"/alerts": alerts_route,
+                          "/timeline": timeline_route})
+        return self._http
+
+
+# -- out-of-process spawn -----------------------------------------------------
+
+
+class CollectorProcess:
+    """Spawn-and-own a standalone collector daemon (``python -m
+    paddle_tpu.telemetry.collector``); parses the ``PORT``/``HTTP``
+    handshake. ``addr`` is the push wire, ``http_port`` the read
+    endpoint."""
+
+    def __init__(self, rules_path: Optional[str] = None,
+                 host: str = "127.0.0.1", args: Tuple[str, ...] = (),
+                 timeout: float = 300.0):
+        # timeout matches ReplicaProcess.wait_ready: the child's cold
+        # interpreter + package import can take minutes on a machine
+        # already saturated by a test suite or a training fleet
+        import select
+        import subprocess
+        import sys
+
+        from ..parallel.async_ps import child_python_env
+
+        argv = [sys.executable, "-m", "paddle_tpu.telemetry.collector",
+                "--host", host, "--port", "0", "--http-port", "0"]
+        if rules_path:
+            argv += ["--rules", rules_path]
+        argv += list(args)
+        # a collector child must never ship to itself (or to whatever
+        # collector the PARENT ships to — its metrics are its own)
+        env = child_python_env(pop=("PDTPU_TELEMETRY_ADDR",
+                                    "PDTPU_TELEMETRY_ORIGIN"))
+        self._proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                      text=True, env=env)
+        self.host = host
+        self.port: Optional[int] = None
+        self.http_port: Optional[int] = None
+        # the pipe is select()ed so the deadline holds even when the
+        # child hangs WITHOUT printing (the wait_ready discipline) —
+        # and a stalled handshake must not orphan the live daemon the
+        # caller has no handle to
+        deadline = time.monotonic() + timeout
+        while self.port is None or self.http_port is None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self.stop()
+                raise TimeoutError(
+                    f"collector did not hand shake in {timeout:g}s")
+            ready, _, _ = select.select([self._proc.stdout], [], [],
+                                        min(remaining, 1.0))
+            if not ready:
+                continue
+            line = self._proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"collector process exited rc={self._proc.poll()} "
+                    "before its handshake")
+            if line.startswith("PORT "):
+                self.port = int(line.split()[1])
+            elif line.startswith("HTTP "):
+                self.http_port = int(line.split()[1])
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    @property
+    def http_url(self) -> str:
+        return f"http://{self.host}:{self.http_port}"
+
+    def stop(self) -> None:
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5.0)
+            except Exception:
+                self._proc.kill()
+
+    def __enter__(self) -> "CollectorProcess":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.telemetry.collector",
+        description="telemetry collector daemon: push ingest wire + "
+                    "/metrics /alerts /timeline")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="push wire port (0 picks free)")
+    ap.add_argument("--http-port", type=int, default=0,
+                    help="read endpoint port (0 picks free)")
+    ap.add_argument("--rules", default="",
+                    help="JSON alert-rule file (default: the preset pack)")
+    ap.add_argument("--eval-interval", type=float, default=0.25)
+    ap.add_argument("--origin-expiry", type=float, default=60.0)
+    ap.add_argument("--flight-root", default="",
+                    help="flight-dump root for alert-triggered dumps")
+    ap.add_argument("--dump-on-fire", action="store_true",
+                    help="flight-dump on EVERY firing transition "
+                         "(default: page-severity rules only)")
+    args = ap.parse_args(argv)
+
+    rules = _alerts.load_rules(args.rules) if args.rules else None
+    col = TelemetryCollector(
+        host=args.host, port=args.port, rules=rules,
+        eval_interval=args.eval_interval,
+        origin_expiry_s=args.origin_expiry,
+        dump_on_fire=True if args.dump_on_fire else None,
+        flight_root=args.flight_root or None)
+    http = col.serve_http(port=args.http_port)
+    print(f"PORT {col.port}", flush=True)
+    print(f"HTTP {http.port}", flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda *a: stop.set())
+        except ValueError:  # not the main thread (embedded call)
+            break
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        col.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
+
+
+__all__ = [
+    "CollectorProcess", "SeriesStore", "TelemetryCollector",
+    "assemble_timeline", "render_timeline_text",
+]

@@ -11,6 +11,16 @@ replica look identical to the scraper. ``/healthz`` returns 200 while
 shape fleet load-balancer probes expect. No third-party dependency:
 ``http.server.ThreadingHTTPServer`` on a daemon thread, port 0 picks a
 free port (``.port`` reports it).
+
+``extra_routes`` adds endpoints beyond the two built-ins (the
+telemetry collector serves ``/alerts`` and ``/timeline`` through it):
+``{path: fn(query_string) -> (status, content_type, body_bytes)}``.
+
+A scraper that disconnects mid-write (curl ^C, a Prometheus timeout)
+raises ``BrokenPipeError``/``ConnectionResetError`` on the handler
+thread; that is the CLIENT's problem, so it is swallowed and counted
+(``paddle_tpu_telemetry_scrape_aborted_total``) instead of spewing a
+traceback from the daemon thread.
 """
 
 from __future__ import annotations
@@ -18,24 +28,53 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from .registry import MetricsRegistry, get_registry
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+# an extra route: fn(query_string) -> (status, content_type, body)
+RouteFn = Callable[[str], Tuple[int, str, bytes]]
+
+
+def _scrape_aborted() -> None:
+    """Count one scrape whose client vanished mid-write. The counter
+    lives in the PROCESS registry regardless of which registry/view
+    the aborted endpoint was serving — it describes this process's
+    endpoint threads, not the scraped data."""
+    try:
+        get_registry().counter(
+            "paddle_tpu_telemetry_scrape_aborted_total",
+            "Scrapes aborted by the client disconnecting mid-write").inc()
+    except Exception:  # pragma: no cover - counting must never raise
+        pass
+
 
 class TelemetryServer:
-    """``/metrics`` + ``/healthz`` over a registry (daemon thread)."""
+    """``/metrics`` + ``/healthz`` (+ ``extra_routes``) over a registry
+    (daemon thread)."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
-                 port: int = 0, host: str = "127.0.0.1"):
+                 port: int = 0, host: str = "127.0.0.1",
+                 extra_routes: Optional[Dict[str, RouteFn]] = None):
         self.registry = registry if registry is not None else get_registry()
         self.health_fn = health_fn
+        self.extra_routes = dict(extra_routes or {})
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            def handle(self):  # noqa: A003 (stdlib handler name)
+                # backstop for disconnect-shaped errors OUTSIDE _reply
+                # (handle_one_request's final wfile.flush is unguarded
+                # upstream): a vanished scraper must not traceback the
+                # daemon thread
+                try:
+                    super().handle()
+                except (BrokenPipeError, ConnectionResetError):
+                    _scrape_aborted()
+
             def do_GET(self):  # noqa: N802 (stdlib handler name)
                 path, _, query = self.path.partition("?")
                 if path == "/metrics":
@@ -64,16 +103,39 @@ class TelemetryServer:
                         self._reply(503, "application/json",
                                     json.dumps({"live": False,
                                                 "error": repr(e)}).encode())
+                elif path in outer.extra_routes:
+                    try:
+                        code, ctype, body = outer.extra_routes[path](query)
+                        self._reply(code, ctype, body)
+                    except Exception as e:
+                        self._reply(500, "text/plain; charset=utf-8",
+                                    f"route {path} failed: {e}\n".encode())
                 else:
                     self._reply(404, "text/plain; charset=utf-8",
-                                b"only /metrics and /healthz live here\n")
+                                b"unknown path (have /metrics, /healthz"
+                                + (b", " + ", ".join(
+                                    sorted(outer.extra_routes)).encode()
+                                   if outer.extra_routes else b"")
+                                + b")\n")
 
             def _reply(self, code: int, ctype: str, body: bytes):
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                # a scraper disconnecting mid-write is routine (curl
+                # ^C, scrape timeout): swallow + count, never let it
+                # escape the handler as a daemon-thread traceback.
+                # Other OSErrors are swallowed too (no traceback) but
+                # NOT counted as aborted scrapes — they may be
+                # server-side socket problems worth not masking
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    _scrape_aborted()
+                    self.close_connection = True
+                except OSError:
+                    self.close_connection = True
 
             def log_message(self, *a):  # scrapes must not spam stderr
                 pass
@@ -108,10 +170,12 @@ class TelemetryServer:
 
 def serve_metrics(registry: Optional[MetricsRegistry] = None,
                   health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
-                  port: int = 0, host: str = "127.0.0.1") -> TelemetryServer:
+                  port: int = 0, host: str = "127.0.0.1",
+                  extra_routes: Optional[Dict[str, RouteFn]] = None
+                  ) -> TelemetryServer:
     """Start a :class:`TelemetryServer`; port 0 picks a free port."""
     return TelemetryServer(registry=registry, health_fn=health_fn,
-                           port=port, host=host)
+                           port=port, host=host, extra_routes=extra_routes)
 
 
 __all__ = ["PROMETHEUS_CONTENT_TYPE", "TelemetryServer", "serve_metrics"]

@@ -401,6 +401,22 @@ def families_snapshot(fams: Iterable[MetricFamily]) -> Dict[str, Any]:
     return out
 
 
+def families_from_snapshot(snap: Dict[str, Any]) -> List[MetricFamily]:
+    """Rebuild a :func:`families_snapshot` dict into metric families —
+    the inverse used wherever a registry export crossed a process
+    boundary as JSON (a remote replica's ``METRICS`` verb, a shipper's
+    ``SNAPSHOT`` push) and must be merged/validated/re-rendered like a
+    live collection."""
+    fams: List[MetricFamily] = []
+    for fname in sorted(snap or {}):
+        d = snap[fname]
+        fam = MetricFamily(fname, d["type"], d["help"])
+        for s in d["samples"]:
+            fam.add(dict(s["labels"]), s["value"])
+        fams.append(fam)
+    return fams
+
+
 def validate_families(fams: Iterable[MetricFamily]) -> List[str]:
     """Naming-convention violations of a family list (empty == clean);
     the per-family half of ``MetricsRegistry.validate``, shared with
@@ -572,7 +588,8 @@ def get_registry() -> MetricsRegistry:
 __all__ = [
     "Counter", "FamiliesView", "Gauge", "Histogram", "MetricFamily",
     "MetricsRegistry", "METRIC_NAME_RE", "DEFAULT_TIME_BUCKETS",
-    "counter_deltas", "counter_family", "families_snapshot", "gauge_family",
+    "counter_deltas", "counter_family", "families_from_snapshot",
+    "families_snapshot", "gauge_family",
     "get_registry", "histogram_family", "merge_exports",
     "render_families_prometheus", "validate_families",
 ]

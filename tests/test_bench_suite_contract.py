@@ -189,6 +189,73 @@ def test_train_rows_carry_telemetry_snapshot():
     assert "telemetry" not in row and row["value"] > 0
 
 
+def test_rows_carry_shipper_deltas_when_collector_attached(monkeypatch):
+    """With a telemetry collector attached (PDTPU_TELEMETRY_ADDR /
+    ship_to), train and serving rows additionally record the measured
+    window's SHIPPER counter deltas (events shipped/dropped, flush
+    seconds) under `shipper`; without one the key is absent — never a
+    crash."""
+
+    # train row: _time_trainer snapshots into trainer._bench_shipper
+    class _T:
+        feed_wire = None
+        _bench_telemetry = {'paddle_tpu_trainer_steps_total{inst="0"}': 1.0}
+        _bench_shipper = {"events_shipped": 1.0, "events_dropped": 0.0,
+                          "flush_seconds": 0.0002}
+
+    row = bench._result(8, "samples/sec", 1e-3, 1e-3, 1e6, 1e12,
+                        trainer=_T())
+    assert row["shipper"] == _T._bench_shipper
+
+    class _Bare:
+        feed_wire = None
+
+    row = bench._result(8, "samples/sec", 1e-3, 1e-3, 1e6, 1e12,
+                        trainer=_Bare())
+    assert "shipper" not in row
+
+    # serving row: per-variant deltas, keyed like `telemetry`
+    class _FakeShipper:
+        def __init__(self):
+            self.n = 0
+
+        def counters(self):
+            self.n += 1
+            return {"events_shipped": 40.0 * self.n,
+                    "events_dropped": 0.0,
+                    "flush_seconds": 0.002 * self.n}
+
+    class _Server:
+        def close(self, drain=True, timeout=None):
+            pass
+
+    fake = _FakeShipper()
+    monkeypatch.setattr(bench, "_shipper_snapshot",
+                        lambda: (fake, fake.counters()))
+    monkeypatch.setattr(bench, "_serving_predictors",
+                        lambda bs: {"fp32": ("P32", {"x": 1}),
+                                    "int8": ("P8", {"x": 1})})
+    monkeypatch.setattr(bench, "_make_server",
+                        lambda pred, workers, queue_size: _Server())
+    monkeypatch.setattr(bench, "_calibrate_serving",
+                        lambda server, feed, iters=8: 0.002)
+    monkeypatch.setattr(bench, "_drive_serving",
+                        lambda server, feed, n, rate: ([0.004] * n, 0))
+    row = bench.bench_serving(1.0, batch_size=8, requests=20, workers=2,
+                              queue_size=4)
+    assert set(row["shipper"]) == {"fp32", "int8"}
+    for ship in row["shipper"].values():
+        assert isinstance(ship, dict)
+        assert all(isinstance(v, float) for v in ship.values())
+        assert ship["events_shipped"] == 40.0 / 20   # delta per request
+
+    # no shipper active: the serving row omits the key
+    monkeypatch.setattr(bench, "_shipper_snapshot", lambda: (None, None))
+    row = bench.bench_serving(1.0, batch_size=8, requests=20, workers=2,
+                              queue_size=4)
+    assert "shipper" not in row
+
+
 def test_telemetry_counter_deltas_math():
     """counter_deltas is the snapshot's whole math: only moved series,
     normalized by the measured step/request count."""

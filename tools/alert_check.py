@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Offline alert-rule linter: validate a rule file against the known
+metric name table BEFORE a collector ever loads it.
+
+    python tools/alert_check.py rules.json          # lint a rule file
+    python tools/alert_check.py --preset            # lint the preset pack
+    python tools/alert_check.py rules.json --json   # machine-readable
+
+A rule that names a metric this build does not export, a label its
+publisher never stamps, or an expression the grammar rejects is a
+named finding (``alert:unknown-metric`` / ``alert:unknown-label`` /
+``alert:malformed-expr`` / ``alert:type-mismatch`` /
+``alert:bad-duration`` / ``alert:duplicate-name``) — caught here in
+CI, not at 3am when the collector silently evaluates a rule that can
+never fire. The preset pack (``paddle_tpu.telemetry.alerts.
+PRESET_PACK``) ships through this gate as a tier-1 test.
+
+Exit status (same contract as ``lint_gate.py`` / ``python -m
+paddle_tpu.analysis``):
+
+- **0** — every rule parses and names only known metrics/labels;
+- **1** — findings, each printed one per line;
+- **3** — the linter itself crashed (never a lint verdict).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import traceback
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+EXIT_CLEAN, EXIT_FINDINGS, EXIT_INTERNAL = 0, 1, 3
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/alert_check.py",
+        description="offline alert-rule linter vs the metric name table")
+    ap.add_argument("rules", nargs="?", default="",
+                    help="JSON rule file: [{name, expr, severity?, "
+                         "annotations?}, ...] (or {'rules': [...]})")
+    ap.add_argument("--preset", action="store_true",
+                    help="lint the built-in preset pack instead of a file")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as a JSON list")
+    args = ap.parse_args(argv)
+
+    if bool(args.rules) == bool(args.preset):
+        ap.error("pass exactly one of: a rules file, or --preset")
+
+    try:
+        from paddle_tpu.telemetry import alerts
+
+        if args.preset:
+            specs = alerts.PRESET_PACK
+            source = "<preset pack>"
+        else:
+            source = args.rules
+            with open(args.rules, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+            specs = doc.get("rules", []) if isinstance(doc, dict) else doc
+            if not isinstance(specs, list):
+                print(f"alert_check: {source}: expected a JSON list of "
+                      "rules (or {'rules': [...]})", file=sys.stderr)
+                return EXIT_FINDINGS
+        findings = alerts.lint_rules(specs)
+        if args.json:
+            print(json.dumps(findings, indent=1))
+        elif findings:
+            print(f"alert_check: {len(findings)} finding(s) in {source}:")
+            for f in findings:
+                print(f"  {f}")
+        else:
+            print(f"alert_check clean: {len(specs)} rule(s) in {source} "
+                  f"({len(alerts.METRIC_TABLE)} known metrics)")
+        return EXIT_FINDINGS if findings else EXIT_CLEAN
+    except Exception:
+        traceback.print_exc()
+        print("alert_check: internal error (exit 3) — the linter crashed; "
+              "this is NOT a lint verdict", file=sys.stderr)
+        return EXIT_INTERNAL
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,15 @@ the framed wire — and injects real faults, not in-process stand-ins):
   fails on the partitioned replica, the already-swapped replicas roll
   back to the previous artifact, zero accepted in-flight requests are
   dropped, and after ``heal`` + ``replace`` the fleet is ready again.
+- **alert** — the paging loop end to end: a telemetry collector is
+  attached (``PDTPU_TELEMETRY_ADDR``; every replica process ships on
+  its own), one replica process is SIGKILLed under load, and the
+  preset replica-down absence alert (``origin_down``, run on a
+  seconds-scale clock via ``preset_rules(for_s=, window_s=)``) must
+  FIRE for exactly the victim's origin within its window + ``for_s``
+  (+ flush/eval slack), then RESOLVE after ``replace()`` respawns a
+  process and the dead origin is retired — with the usual zero-drop /
+  at-most-once request contract holding throughout.
 
 Exit status: **0** all drills pass; **2** a drill dropped an accepted
 request or failed its contract (each violation printed); **3** the
@@ -466,8 +475,144 @@ def drill_partition(root, replicas, requests):
     return violations
 
 
+def _wait_alert(col, rule, want, deadline_s, key=None):
+    """Poll the collector until ``rule`` reaches ``want`` ("firing" |
+    "resolved"); returns (entry, seconds waited) or (None, waited)."""
+    t0 = time.monotonic()
+    deadline = t0 + deadline_s
+    while time.monotonic() < deadline:
+        snap = col.alerts_json()
+        if want == "firing":
+            for a in snap["firing"]:
+                if a["rule"] == rule and (key is None or a["key"] == key):
+                    return a, time.monotonic() - t0
+        else:
+            still = [a for a in snap["firing"]
+                     if a["rule"] == rule and
+                     (key is None or a["key"] == key)]
+            if not still:
+                for a in snap["resolved"]:
+                    if a["rule"] == rule and \
+                            (key is None or a["key"] == key):
+                        return a, time.monotonic() - t0
+        time.sleep(0.1)
+    return None, time.monotonic() - t0
+
+
+def drill_alert(root, replicas, requests):
+    from paddle_tpu.telemetry import alerts
+    from paddle_tpu.telemetry import collector as tcollector
+    from paddle_tpu.telemetry import shipper as tshipper
+    from paddle_tpu.testing import faults
+
+    # expiry is deliberately generous: collecting the in-flight
+    # outcomes after the kill can take several seconds (stalled
+    # submits to the dead process resolve via the stall probe), and
+    # the origin must not be retired before the drill observed the
+    # alert firing
+    window_s, for_s, expiry_s = 2.0, 1.0, 15.0
+    dirname, feed = _build_artifact(root, name="model_alert")
+    col = tcollector.TelemetryCollector(
+        rules=alerts.preset_rules(for_s=for_s, window_s=window_s),
+        eval_interval=0.1, origin_expiry_s=expiry_s)
+    prev_addr = os.environ.get("PDTPU_TELEMETRY_ADDR")
+    os.environ["PDTPU_TELEMETRY_ADDR"] = f"{col.host}:{col.port}"
+    # the drill's origin assertions are pid-based: an operator's
+    # exported PDTPU_TELEMETRY_ORIGIN would rename this process's
+    # shipper and fail the registration barrier spuriously
+    prev_origin = os.environ.pop("PDTPU_TELEMETRY_ORIGIN", None)
+    router = None
+    violations = []
+    try:
+        router = _spawn_remote_fleet(dirname, feed, replicas)
+        # absence detection can only cover origins the collector has
+        # SEEN: barrier on the whole fleet (router process + every
+        # replica process) registering before the fault is injected —
+        # a production fleet runs long before anything dies
+        expected = {f"pid-{os.getpid()}"} | {
+            f"pid-{router.replica(n).proc.pid}"
+            for n in router.replica_names}
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and \
+                not expected <= set(col.store.origins()):
+            time.sleep(0.1)
+        missing = expected - set(col.store.origins())
+        if missing:
+            violations.append(
+                f"fleet never registered with the collector: {sorted(missing)}"
+                f" absent after 20s (have {sorted(col.store.origins())})")
+            return violations
+        rate = _saturation_rate(router, feed)
+        victim = router.replica_names[1 % len(router.replica_names)]
+        victim_origin = f"pid-{router.replica(victim).proc.pid}"
+        killed_at = []
+
+        def kill():
+            faults.kill_process(router.replica(victim))
+            killed_at.append(time.monotonic())
+
+        pending, rejected = _drive(router, feed, requests, rate,
+                                   act_at=requests // 3, act=kill)
+        outcomes, dropped = _collect(pending)
+        if dropped:
+            violations.append(f"dropped accepted request(s): {dropped[:3]}")
+        # the pager: the victim's origin goes silent -> origin_down
+        # must fire for exactly that origin within window + for_s
+        # (+ shipper-flush/eval slack)
+        budget = window_s + for_s + 4.0
+        fired, waited = _wait_alert(
+            col, "origin_down", "firing",
+            deadline_s=max(0.5, budget - (time.monotonic()
+                                          - killed_at[0])),
+            key=victim_origin)
+        if fired is None:
+            # collecting outcomes may have outlived the firing window:
+            # an already-resolved instance still proves it fired
+            fired = next((a for a in col.alerts_json()["resolved"]
+                          if a["rule"] == "origin_down"
+                          and a["key"] == victim_origin), None)
+        print(f"  alert: accepted={len(pending)} shed={rejected} "
+              f"outcomes={outcomes} fired={bool(fired)} "
+              f"(+{waited:.1f}s after drive)")
+        if fired is None:
+            violations.append(
+                f"origin_down did not fire for {victim_origin} within "
+                f"{budget:.1f}s of the kill "
+                f"(origins={sorted(col.store.origins())}, "
+                f"alerts={col.alerts_json()['firing']})")
+        router.replace(victim)   # fresh process, fresh origin
+        resolved, waited = _wait_alert(
+            col, "origin_down", "resolved",
+            deadline_s=expiry_s + 6.0, key=victim_origin)
+        if resolved is None:
+            violations.append(
+                f"origin_down did not resolve within {expiry_s + 6.0:.1f}s "
+                f"of replace() (firing={col.alerts_json()['firing']})")
+        state = router.health()["state"]
+        if state != "ready":
+            violations.append(f"health did not recover after replace "
+                              f"(state={state})")
+        if fired is not None:
+            print(f"  alert: origin_down fired on {fired['key']} "
+                  f"(value={fired['value']:.2f}s stale), resolved "
+                  f"{waited:.1f}s after replace")
+    finally:
+        if prev_addr is None:
+            os.environ.pop("PDTPU_TELEMETRY_ADDR", None)
+        else:
+            os.environ["PDTPU_TELEMETRY_ADDR"] = prev_addr
+        if prev_origin is not None:
+            os.environ["PDTPU_TELEMETRY_ORIGIN"] = prev_origin
+        if router is not None:
+            router.close(drain=False, timeout=10)
+        tshipper.stop_shipping()
+        col.close()
+    return violations
+
+
 DRILLS = {"kill": drill_kill, "hang": drill_hang, "reload": drill_reload,
-          "pkill": drill_pkill, "partition": drill_partition}
+          "pkill": drill_pkill, "partition": drill_partition,
+          "alert": drill_alert}
 
 
 def main(argv=None) -> int:
@@ -477,8 +622,9 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=90)
     ap.add_argument("--drills", default="kill,hang,reload",
                     help="comma list from: kill,hang,reload,pkill,"
-                         "partition (the last two spawn a real "
-                         "cross-process fleet); 'all' runs every drill")
+                         "partition,alert (the last three spawn a real "
+                         "cross-process fleet; alert also attaches a "
+                         "telemetry collector); 'all' runs every drill")
     args = ap.parse_args(argv)
     names = [n.strip() for n in args.drills.split(",") if n.strip()]
     if names == ["all"]:

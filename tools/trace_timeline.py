@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Offline cross-process trace timeline: render one span's waterfall
+from journal JSONL — the same assembly the collector's
+``/timeline?trace=<span>`` endpoint serves, usable post-mortem on a
+flight dump's ``events.jsonl`` or any ``PDTPU_JOURNAL_PATH`` sink.
+
+    python tools/trace_timeline.py events.jsonl --span 39390ddf00000001
+    python tools/trace_timeline.py dump/events.jsonl other.jsonl --list
+    python tools/trace_timeline.py events.jsonl --span ID --json
+
+Multiple files merge into one event set (a trainer's sink + a shipped
+replica ring dump side by side); events keep whatever ``origin`` field
+ingestion stamped, defaulting to the file's basename so two processes'
+sinks stay distinguishable. ``--list`` prints the spans present (event
+count, origins, duration) newest-first instead of rendering one.
+
+Exit status: **0** rendered (or listed); **2** the span has no events
+/ no readable input; **3** the tool itself crashed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import traceback
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+EXIT_CLEAN, EXIT_EMPTY, EXIT_INTERNAL = 0, 2, 3
+
+
+def _load_events(paths):
+    events, bad = [], 0
+    for path in paths:
+        tag = os.path.basename(path).rsplit(".", 1)[0]
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        e = json.loads(line)
+                    except ValueError:
+                        bad += 1
+                        continue
+                    if isinstance(e, dict) and "kind" in e:
+                        e.setdefault("origin", tag)
+                        events.append(e)
+        except OSError as err:
+            print(f"trace_timeline: cannot read {path}: {err}",
+                  file=sys.stderr)
+    return events, bad
+
+
+def _list_spans(events):
+    by_span = {}
+    for e in events:
+        span = e.get("span")
+        if span is None:
+            continue
+        d = by_span.setdefault(span, {"n": 0, "origins": set(),
+                                      "t0": None, "t1": None})
+        d["n"] += 1
+        d["origins"].add(e.get("origin", "local"))
+        t = e.get("t")
+        if t is not None:
+            d["t0"] = t if d["t0"] is None else min(d["t0"], t)
+            d["t1"] = t if d["t1"] is None else max(d["t1"], t)
+    rows = sorted(by_span.items(), key=lambda kv: kv[1]["t1"] or 0,
+                  reverse=True)
+    for span, d in rows:
+        dur = ((d["t1"] - d["t0"]) * 1e3
+               if d["t0"] is not None and d["t1"] is not None else 0.0)
+        print(f"{span}  {d['n']:4d} event(s)  {dur:9.3f} ms  "
+              f"origins={','.join(sorted(d['origins']))}")
+    print(f"{len(rows)} span(s) across {len(events)} event(s)")
+    return bool(rows)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/trace_timeline.py",
+        description="render one trace span's cross-process waterfall "
+                    "from journal JSONL")
+    ap.add_argument("files", nargs="+", help="journal JSONL file(s) "
+                    "(flight-dump events.jsonl, PDTPU_JOURNAL_PATH sinks)")
+    ap.add_argument("--span", default="", help="trace id to render")
+    ap.add_argument("--list", action="store_true",
+                    help="list spans present instead of rendering one")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the assembled timeline as JSON")
+    ap.add_argument("--width", type=int, default=40,
+                    help="waterfall bar width (text mode)")
+    args = ap.parse_args(argv)
+
+    try:
+        from paddle_tpu.telemetry.collector import (assemble_timeline,
+                                                    render_timeline_text)
+
+        events, bad = _load_events(args.files)
+        if bad:
+            print(f"trace_timeline: skipped {bad} unparseable line(s)",
+                  file=sys.stderr)
+        if not events:
+            print("trace_timeline: no journal events found",
+                  file=sys.stderr)
+            return EXIT_EMPTY
+        if args.list:
+            return EXIT_CLEAN if _list_spans(events) else EXIT_EMPTY
+        if not args.span:
+            ap.error("pass --span <id> (or --list to see what exists)")
+        tl = assemble_timeline(events, args.span)
+        if not tl["events"]:
+            print(f"trace_timeline: no events carry span {args.span!r}",
+                  file=sys.stderr)
+            return EXIT_EMPTY
+        if args.json:
+            print(json.dumps(tl, sort_keys=True, default=repr, indent=1))
+        else:
+            sys.stdout.write(render_timeline_text(tl, width=args.width))
+        return EXIT_CLEAN
+    except SystemExit:
+        raise
+    except Exception:
+        traceback.print_exc()
+        print("trace_timeline: internal error (exit 3)", file=sys.stderr)
+        return EXIT_INTERNAL
+
+
+if __name__ == "__main__":
+    sys.exit(main())
