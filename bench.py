@@ -135,6 +135,7 @@ def _time_trainer(trainer, host_batches, warmup=3, iters=20,
 
         tel0 = get_registry().counter_values()
         sh, ship0 = _shipper_snapshot()
+        store0 = _store_snapshot(sh)
         t0 = time.perf_counter()
         for feed in DeviceFeeder(gen, put_fn=trainer._put_feed, capacity=2):
             out = trainer.step(feed)
@@ -150,6 +151,12 @@ def _time_trainer(trainer, host_batches, warmup=3, iters=20,
             # shipped/dropped + flush seconds per step
             trainer._bench_shipper = counter_deltas(
                 ship0, sh.counters(), per=iters)
+            store1 = _store_snapshot(sh)
+            if store0 is not None and store1 is not None:
+                # ...and, when the collector persists, what the store's
+                # ingest-writes cost (appends/bytes/seconds per step)
+                trainer._bench_store = counter_deltas(store0, store1,
+                                                      per=iters)
 
         staged = [trainer._put_feed(b) for b in host_batches[:2]]
         out = trainer.step(staged[0])
@@ -181,6 +188,7 @@ def _time_trainer(trainer, host_batches, warmup=3, iters=20,
                               d, stacked=True))
     tel0 = get_registry().counter_values()
     sh, ship0 = _shipper_snapshot()
+    store0 = _store_snapshot(sh)
     t0 = time.perf_counter()
     for n, feed in feeder:
         out = trainer.run_steps(feed, k=n) if n > 1 else trainer.step(feed)
@@ -191,6 +199,10 @@ def _time_trainer(trainer, host_batches, warmup=3, iters=20,
     if sh is not None:
         trainer._bench_shipper = counter_deltas(ship0, sh.counters(),
                                                 per=steps)
+        store1 = _store_snapshot(sh)
+        if store0 is not None and store1 is not None:
+            trainer._bench_store = counter_deltas(store0, store1,
+                                                  per=steps)
 
     # feeds are NOT donated (only the training carry is), so pre-staged
     # super-batches can be reused across dispatches like the k=1 path
@@ -213,6 +225,23 @@ def _shipper_snapshot():
 
     sh = _tshipper.active_shipper()
     return (sh, sh.counters()) if sh is not None else (None, None)
+
+
+def _store_snapshot(sh):
+    """The attached collector's store counters (appends/bytes/
+    append_seconds) when it runs WITH persistence, else None — the
+    `collector_store` row key deltas these over the measured window,
+    so a round records what the durable series store's ingest-writes
+    cost alongside the shipping cost."""
+    stats_fn = getattr(sh, "collector_stats", None)
+    if stats_fn is None:
+        return None
+    stats = stats_fn()
+    if not stats or not stats.get("persistence"):
+        return None
+    store = stats.get("store") or {}
+    return {k: float(store.get(k, 0.0))
+            for k in ("appends", "bytes", "append_seconds")}
 
 
 def _result(n_per_step, unit, dt_pipe, dt_comp, flops_per_step, peak,
@@ -240,6 +269,11 @@ def _result(n_per_step, unit, dt_pipe, dt_comp, flops_per_step, peak,
         ship = getattr(trainer, "_bench_shipper", None)
         if ship is not None:
             out["shipper"] = ship
+        # the durable store's ingest-write cost per step, present only
+        # when the attached collector persists (store_dir)
+        store = getattr(trainer, "_bench_store", None)
+        if store is not None:
+            out["collector_store"] = store
     if feed is not None:
         # the honest h2d numerator: WIRE bytes (what actually crosses
         # the link under the trainer's feed_wire table), alongside the
@@ -921,6 +955,7 @@ def bench_serving(peak, batch_size=64, requests=240, workers=2,
     offered = {}
     telemetry = {}
     shipper = {}
+    collector_store = {}
     for variant, (pred, feed) in sorted(_serving_predictors(batch_size).items()):
         server = _make_server(pred, workers, queue_size)
         try:
@@ -929,6 +964,7 @@ def bench_serving(peak, batch_size=64, requests=240, workers=2,
             steady_rate = max(1.0, 0.6 * capacity)
             tel0 = get_registry().counter_values()
             sh, ship0 = _shipper_snapshot()
+            store0 = _store_snapshot(sh)
             lats, _ = _drive_serving(server, feed, requests, steady_rate)
             # steady-phase registry COUNTER deltas per REQUEST — the
             # serving row's `telemetry` snapshot (submitted/completed/
@@ -942,6 +978,12 @@ def bench_serving(peak, batch_size=64, requests=240, workers=2,
                 # seconds) per request
                 shipper[variant] = counter_deltas(ship0, sh.counters(),
                                                   per=requests)
+                store1 = _store_snapshot(sh)
+                if store0 is not None and store1 is not None:
+                    # persistence on: the store's ingest-write cost
+                    # per request rides the row too
+                    collector_store[variant] = counter_deltas(
+                        store0, store1, per=requests)
             sat_rate = 3.0 * capacity
             _, rejected = _drive_serving(server, feed, requests, sat_rate)
         finally:
@@ -969,6 +1011,8 @@ def bench_serving(peak, batch_size=64, requests=240, workers=2,
     }
     if shipper:
         out["shipper"] = shipper
+    if collector_store:
+        out["collector_store"] = collector_store
     return out
 
 

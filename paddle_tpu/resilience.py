@@ -236,6 +236,112 @@ def validate_checkpoint(dirname: str) -> Optional[Dict[str, Any]]:
     return man
 
 
+# -- append-only segment log helpers -----------------------------------------
+# The telemetry series store (telemetry/store.py) persists through
+# segmented append-only logs: every record is CRC-framed so a torn or
+# bit-flipped record is detected and SKIPPED (never crashes recovery),
+# and a finished segment is committed with an atomically-written CRC
+# sidecar — the same tmp+fsync+replace discipline write_manifest uses
+# for checkpoints. The framing/sealing primitives live HERE so
+# durability stays one discipline: anything that must survive kill -9
+# goes through resilience, whether it is a parameter tensor or a
+# telemetry sample.
+
+SEGMENT_META_SUFFIX = ".meta.json"
+
+
+def frame_record(payload: bytes) -> bytes:
+    """CRC-frame one record for an append-only segment log: one text
+    line ``<crc32:08x> <len> <payload>\\n``. The payload must not
+    contain raw newlines (JSON without indent qualifies) — framing is
+    line-based so a reader can resync after a corrupt record."""
+    if b"\n" in payload:
+        raise ValueError("segment record payload must be newline-free")
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return b"%08x %d " % (crc, len(payload)) + payload + b"\n"
+
+
+def iter_records(path: str) -> Iterator[Tuple[bool, Any]]:
+    """Stream a segment file's records: yields ``(True, payload_bytes)``
+    for every intact record and ``(False, reason)`` for every line that
+    fails its frame (bad header, length mismatch, CRC mismatch, torn
+    tail with no newline). Corruption never raises — the caller counts
+    and skips, recovery continues on the next line."""
+    with open(path, "rb") as f:
+        for raw in f:
+            if not raw.endswith(b"\n"):
+                yield False, "torn tail (no trailing newline)"
+                continue
+            line = raw[:-1]
+            head = line.split(b" ", 2)
+            if len(head) != 3:
+                yield False, f"malformed record header ({line[:32]!r}...)"
+                continue
+            crc_s, len_s, payload = head
+            try:
+                want_crc = int(crc_s, 16)
+                want_len = int(len_s)
+            except ValueError:
+                yield False, f"malformed record header ({line[:32]!r}...)"
+                continue
+            if len(payload) != want_len:
+                yield False, (f"record length mismatch ({len(payload)} "
+                              f"bytes vs {want_len} declared)")
+                continue
+            if zlib.crc32(payload) & 0xFFFFFFFF != want_crc:
+                yield False, "record CRC mismatch (bit flip)"
+                continue
+            yield True, payload
+
+
+def seal_segment(path: str, meta: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+    """Commit a finished segment: fsync the data file, then atomically
+    write ``<path>.meta.json`` carrying the whole-file CRC32 + size
+    (plus caller ``meta`` — first/last timestamps, record count). The
+    sidecar is written tmp+fsync+replace (the write_manifest
+    discipline), so its presence implies the segment it describes was
+    fully written; a segment without a sidecar is either active or a
+    kill artifact and is recovered record-by-record instead."""
+    with open(path, "rb") as f:
+        os.fsync(f.fileno())
+    crc, size = _crc32_file(path)
+    doc = dict(meta or {})
+    doc.update({"crc32": crc, "size": size,
+                "format_version": MANIFEST_VERSION})
+    tmp = path + SEGMENT_META_SUFFIX + ".part"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path + SEGMENT_META_SUFFIX)
+    return doc
+
+
+def check_segment(path: str) -> Tuple[bool, str]:
+    """Validate a SEALED segment against its sidecar: ``(True, "")``
+    when size and whole-file CRC match, else ``(False, reason)``. A
+    missing/unreadable sidecar is a finding too — sealed segments are
+    committed WITH one."""
+    mpath = path + SEGMENT_META_SUFFIX
+    try:
+        with open(mpath) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"unreadable segment sidecar {mpath}: {e}"
+    try:
+        crc, size = _crc32_file(path)
+    except OSError as e:
+        return False, f"unreadable segment {path}: {e}"
+    if size != meta.get("size"):
+        return False, (f"segment truncated/grown: {size} bytes on disk vs "
+                       f"{meta.get('size')} in sidecar")
+    if crc != meta.get("crc32"):
+        return False, (f"segment checksum mismatch: crc32 {crc:#010x} on "
+                       f"disk vs {meta.get('crc32'):#010x} in sidecar")
+    return True, ""
+
+
 # -- checkpoint-directory scanning ------------------------------------------
 
 
@@ -677,9 +783,10 @@ def record_incident(incidents: List[Incident], step: int,
 
 __all__ = [
     "CheckpointCorrupt", "CheckpointInfo", "GuardPolicy", "Incident",
-    "InjectedCrash", "PreemptionHandler", "ReshardError", "crash_point",
-    "crash_points", "feed_digest", "list_checkpoints", "mesh_axes",
+    "InjectedCrash", "PreemptionHandler", "ReshardError", "check_segment",
+    "crash_point", "crash_points", "feed_digest", "frame_record",
+    "iter_records", "list_checkpoints", "mesh_axes",
     "normalize_mesh_axes", "read_manifest", "reshard_restore",
-    "restore_latest", "sweep_tmp_dirs", "trainer_mesh_axes",
-    "validate_checkpoint", "write_manifest",
+    "restore_latest", "seal_segment", "sweep_tmp_dirs",
+    "trainer_mesh_axes", "validate_checkpoint", "write_manifest",
 ]

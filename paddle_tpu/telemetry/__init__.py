@@ -30,7 +30,14 @@ operator points Prometheus (and post-mortem tooling) at:
   in-process) sink ANY process pushes its journal + registry snapshots
   to over the framed wire, maintaining per-origin time series, a
   fleet-wide journal, and ``/metrics`` (merged under ``origin``),
-  ``/alerts``, ``/timeline?trace=<span>`` read endpoints.
+  ``/alerts``, ``/timeline?trace=<span>``, ``/query`` read endpoints;
+  alert rules hot-reload via SIGHUP / ``POST /rules``.
+- :mod:`store` — the **durable series store**: a segmented, CRC-framed,
+  retention-bounded (time AND bytes) append-only log the collector
+  writes every ingest through; a restart — or a standby collector
+  promoting over the shared log — replays it to rebuild the rings,
+  dedupe high-water marks, fleet journal, and alert firing/pending
+  state, and ``GET /query`` range reads serve from it.
 - :mod:`alerts` — the **declarative alert engine** the collector
   evaluates: threshold / rate-over-window / absence / histogram-
   quantile rules with ``for_s`` durations and a firing→resolved state
@@ -61,20 +68,22 @@ from .alerts import (AlertEngine, AlertRule, PRESET_PACK, lint_rules,
                      load_rules, parse_rule, preset_rules)
 from .collector import (CollectorProcess, SeriesStore, TelemetryCollector,
                         assemble_timeline, render_timeline_text)
-from .shipper import (Shipper, active_shipper, maybe_auto_ship, ship_to,
-                      stop_shipping)
+from .store import SegmentStore, downsample
+from .shipper import (Shipper, active_shipper, maybe_auto_ship, parse_addrs,
+                      ship_to, stop_shipping)
 
 __all__ = [
     "AlertEngine", "AlertRule", "CollectorProcess", "Counter",
     "FamiliesView", "FlightRecorder", "Gauge", "Histogram",
     "MetricFamily", "MetricsRegistry", "PRESET_PACK", "RunJournal",
-    "SeriesStore", "Shipper", "TelemetryCollector", "TelemetryServer",
-    "active_shipper", "assemble_timeline", "counter_deltas",
-    "counter_family", "default_flight_dir", "families_from_snapshot",
+    "SegmentStore", "SeriesStore", "Shipper", "TelemetryCollector",
+    "TelemetryServer", "active_shipper", "assemble_timeline",
+    "counter_deltas", "counter_family", "default_flight_dir",
+    "downsample", "families_from_snapshot",
     "families_snapshot", "flight_dump", "gauge_family", "get_journal",
     "get_recorder", "get_registry", "histogram_family", "lint_rules",
     "load_rules", "maybe_auto_ship", "merge_exports", "new_run_id",
-    "parse_rule", "parse_sample", "preset_rules",
+    "parse_addrs", "parse_rule", "parse_sample", "preset_rules",
     "render_families_prometheus", "render_timeline_text", "serve_metrics",
     "set_journal", "ship_to", "stop_shipping", "validate_families",
 ]

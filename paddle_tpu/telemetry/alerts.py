@@ -57,7 +57,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 # ``origin`` (collector merge), ``replica`` (fleet merge), and ``inst``
 # are legal on ANY series — see UNIVERSAL_LABELS.
 
-UNIVERSAL_LABELS = frozenset({"origin", "replica", "inst"})
+# ``stale`` is stamped by the collector's merged export on origins
+# past half their expiry (it never reaches the SeriesStore rings, but
+# a rule matcher naming it must not lint as unknown)
+UNIVERSAL_LABELS = frozenset({"origin", "replica", "inst", "stale"})
 
 METRIC_TABLE: Dict[str, Tuple[str, frozenset]] = {
     # trainer / fit / resilience
@@ -126,6 +129,15 @@ METRIC_TABLE: Dict[str, Tuple[str, frozenset]] = {
     "paddle_tpu_collector_alerts_firing": ("gauge", frozenset()),
     "paddle_tpu_collector_alert_transitions_total":
         ("counter", frozenset({"state"})),
+    # the durable series store (collector-side persistence)
+    "paddle_tpu_collector_segments_corrupt_total": ("counter", frozenset()),
+    "paddle_tpu_collector_store_appends_total": ("counter", frozenset()),
+    "paddle_tpu_collector_store_bytes_total": ("counter", frozenset()),
+    "paddle_tpu_collector_store_append_seconds_total":
+        ("counter", frozenset()),
+    "paddle_tpu_collector_store_append_failures_total":
+        ("counter", frozenset()),
+    "paddle_tpu_collector_store_segments": ("gauge", frozenset()),
     "paddle_tpu_telemetry_scrape_aborted_total": ("counter", frozenset()),
 }
 
@@ -585,6 +597,82 @@ class AlertEngine:
                 "since": st.get("since"),
                 "annotations": dict(rule.annotations)}
 
+    # -- durable state (the collector's on-disk store) -----------------------
+
+    def state(self) -> Dict[str, Any]:
+        """JSON-able dump of the firing/pending/resolved state — what
+        the collector's segment log persists so a restart (or a standby
+        promotion) resumes every ``for_s`` clock and firing instance
+        instead of re-arming from scratch."""
+        with self._lock:
+            return {
+                "active": [[rname, key, dict(st, value=_json_value(
+                    st.get("value")))]
+                           for (rname, key), st in sorted(
+                               self._active.items())],
+                "resolved": [dict(r, value=_json_value(r.get("value")))
+                             for r in self._resolved],
+                "transitions_total": dict(self.transitions_total),
+            }
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        """Silently adopt a :meth:`state` dump: firing instances come
+        back FIRING (their original ``since``/``fired_at`` clocks
+        intact, NO ``firing`` transition emitted — the pager already
+        went off before the restart), pending ones keep their held
+        time, the resolved list and transition counters carry over.
+        Instances of rules this engine no longer has are dropped."""
+        known = {r.name for r in self.rules}
+        with self._lock:
+            self._active = {
+                (rname, key): dict(st)
+                for rname, key, st in (state.get("active") or [])
+                if rname in known}
+            self._resolved = [dict(r) for r in state.get("resolved") or []
+                              if r.get("rule") in known]
+            for k, v in (state.get("transitions_total") or {}).items():
+                self.transitions_total[k] = int(v)
+
+    def set_rules(self, rules: List[AlertRule]) -> List[Dict[str, Any]]:
+        """Hot-swap the rule list (SIGHUP / ``POST /rules``). State is
+        keyed by rule NAME, so a rule that persists across the reload
+        keeps its firing/pending instances (an edited threshold takes
+        effect at the next evaluation); instances of rules that
+        vanished are closed — firing ones emit a ``resolved``
+        transition (returned AND handed to ``on_transition``), pending
+        ones are dropped silently."""
+        import time as _time
+
+        names = [r.name for r in rules]
+        if len(set(names)) != len(names):
+            raise AlertRuleError(f"duplicate rule names in {sorted(names)}")
+        now = _time.time()
+        transitions: List[Dict[str, Any]] = []
+        with self._lock:
+            old_by_name = {r.name: r for r in self.rules}
+            keep = set(names)
+            for (rname, key) in [k for k in self._active
+                                 if k[0] not in keep]:
+                st = self._active.pop((rname, key))
+                if st["state"] == "firing":
+                    rule = old_by_name[rname]
+                    st["resolved_at"] = now
+                    st.update(rule=rname, key=key, severity=rule.severity,
+                              expr=rule.expr)
+                    self._resolved.append(st)
+                    transitions.append(self._transition(rule, key, st,
+                                                        "resolved", now))
+            for t in transitions:
+                self.transitions_total[t["state"]] += 1
+            self.rules = list(rules)
+        for t in transitions:
+            if self.on_transition is not None:
+                try:
+                    self.on_transition(t)
+                except Exception:
+                    pass
+        return transitions
+
     # -- reads ---------------------------------------------------------------
 
     def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
@@ -593,14 +681,21 @@ class AlertEngine:
         import time as _time
 
         now = _time.time() if now is None else now
-        by_name = {r.name: r for r in self.rules}
         firing, pending = [], []
         with self._lock:
+            # rules are copied under the SAME lock as the instance
+            # table: set_rules()/restore() mutate both at runtime now,
+            # and a scrape racing a hot-reload/promotion must see one
+            # consistent pair (plus .get below: recovery assigns
+            # .rules outside the engine lock by design)
+            by_name = {r.name: r for r in self.rules}
             active = {k: dict(v) for k, v in self._active.items()}
             resolved_src = [dict(r) for r in self._resolved]
             trans = dict(self.transitions_total)
         for (rname, key), st in sorted(active.items()):
-            rule = by_name[rname]
+            rule = by_name.get(rname)
+            if rule is None:
+                continue  # instance of a rule mid-swap: next tick's view
             entry = {"rule": rname, "key": key, "state": st["state"],
                      "since": st["since"], "held_s": round(now - st["since"],
                                                            3),

@@ -18,6 +18,7 @@ body; replies ``OK <n>`` / ``ERR <reason>``)::
     PING
     EVENTS <origin> <len>    + {"run": ..., "events": [...]}
     SNAPSHOT <origin> <len>  + {"t": ..., "families": families_snapshot}
+    STATS                    (reply: ``OK {json}`` — ingest/store ctrs)
 
 ``EVENTS`` ingestion is idempotent: events are deduplicated by a
 per-``(origin, run)`` high-water ``seq``, so a shipper whose reply was
@@ -46,6 +47,23 @@ An alert transition journals ``alert.firing``/``alert.resolved`` and
 — for ``page``-severity rules (or all, with ``dump_on_fire=True``) —
 triggers a local flight dump carrying the fleet-wide ring, so the
 evidence is on disk the moment the pager goes off.
+
+**Durability & HA** (``store_dir=``): every ingest is written through
+to a :class:`~paddle_tpu.telemetry.store.SegmentStore` — a segmented,
+CRC-framed, retention-bounded (time AND bytes) append-only log. A
+restart replays it: rings, dedupe high-water marks, the fleet journal,
+and alert firing/pending state all come back (a firing alert stays
+firing with its original clock — no re-fire, no resolve flap), and
+``GET /query?metric=...&labels=...&from=...&to=...&step=...`` range
+reads serve from the log so history survives the process. A SECOND
+collector started with ``standby=True`` over the same (shared-
+filesystem) ``store_dir`` ingests nothing until the first failed-over
+push arrives — the shipper's comma-separated ``PDTPU_TELEMETRY_ADDR``
+failover list routes pushes to it once the primary dies — at which
+point it PROMOTES by replaying the log. Alert rules hot-reload via
+SIGHUP (the daemon re-lints ``--rules``) or ``POST /rules``; findings
+from :func:`~paddle_tpu.telemetry.alerts.lint_rules` REJECT the
+reload, success journals ``alert.rules_reloaded``.
 
 Run in-process (``TelemetryCollector()``) or standalone::
 
@@ -167,12 +185,15 @@ class SeriesStore:
         return out
 
     def ingest(self, origin: str, snapshot: Dict[str, Any],
-               t: Optional[float] = None) -> int:
+               t: Optional[float] = None, sanitized: bool = False) -> int:
         """Absorb one origin's ``families_snapshot`` dict (sanitized —
-        see :meth:`_sanitize`); returns the number of samples
-        ringed."""
+        see :meth:`_sanitize`; ``sanitized=True`` skips the pass for a
+        snapshot that already went through it, e.g. a segment-log
+        replay of a previously-sanitized push); returns the number of
+        samples ringed."""
         t = time.time() if t is None else t
-        snapshot = self._sanitize(snapshot)
+        if not sanitized:
+            snapshot = self._sanitize(snapshot)
         n = 0
         with self._lock:
             self._latest_snap[origin] = snapshot
@@ -221,18 +242,28 @@ class SeriesStore:
             stale = [o for o, t in self.last_push.items()
                      if now - t > self.origin_expiry_s]
             for origin in stale:
-                self.last_push.pop(origin, None)
-                self._latest_snap.pop(origin, None)
-                for key in self._by_origin.pop(origin, set()):
-                    self._rings.pop(key, None)
-                    meta = self._meta.pop(key, None)
-                    if meta is not None:
-                        named = self._by_name.get(meta[0])
-                        if named is not None:
-                            named.discard(key)
-                            if not named:
-                                del self._by_name[meta[0]]
+                self._retire_locked(origin)
         return stale
+
+    def retire(self, origin: str) -> None:
+        """Drop one origin wholesale regardless of its push age — the
+        segment-log replay path for a persisted ``retire`` record (an
+        expiry that already happened must not resurrect on restart)."""
+        with self._lock:
+            self._retire_locked(origin)
+
+    def _retire_locked(self, origin: str) -> None:
+        self.last_push.pop(origin, None)
+        self._latest_snap.pop(origin, None)
+        for key in self._by_origin.pop(origin, set()):
+            self._rings.pop(key, None)
+            meta = self._meta.pop(key, None)
+            if meta is not None:
+                named = self._by_name.get(meta[0])
+                if named is not None:
+                    named.discard(key)
+                    if not named:
+                        del self._by_name[meta[0]]
 
     # -- reads ---------------------------------------------------------------
 
@@ -359,6 +390,31 @@ class SeriesStore:
                     out.append((key, value))
             return out
 
+    def range_query(self, metric: str,
+                    labels: Optional[Dict[str, str]] = None,
+                    start: float = 0.0, end: Optional[float] = None,
+                    step: float = 0.0) -> Dict[str, Any]:
+        """In-memory range read over the bounded rings — the ``/query``
+        fallback for a collector WITHOUT persistence (same response
+        shape as :meth:`~paddle_tpu.telemetry.store.SegmentStore.query`,
+        but the horizon is the ring, not the retention window)."""
+        from .store import downsample
+
+        labels = dict(labels or {})
+        end = time.time() if end is None else end
+        out = []
+        with self._lock:
+            for key in self._match_locked(metric, labels):
+                if self._meta[key][2] == "histogram":
+                    continue
+                pts = [(t, v) for t, v in self._rings.get(key, ())
+                       if start <= t <= end]
+                out.append({"key": key, "labels": dict(self._meta[key][1]),
+                            "points": [[round(t, 6), v] for t, v in
+                                       downsample(pts, start, step)]})
+        return {"metric": metric, "matchers": labels, "from": start,
+                "to": end, "step": step, "series": out}
+
     def staleness(self, metric: str, labels: Dict[str, str], now: float
                   ) -> List[Tuple[str, float]]:
         with self._lock:
@@ -467,7 +523,14 @@ class TelemetryCollector:
                  max_points: int = 512,
                  origin_expiry_s: float = 60.0,
                  dump_on_fire=None,
-                 flight_root: Optional[str] = None):
+                 flight_root: Optional[str] = None,
+                 store_dir: Optional[str] = None,
+                 retention_s: float = 24 * 3600.0,
+                 retention_bytes: int = 256 << 20,
+                 segment_max_bytes: int = 4 << 20,
+                 segment_max_s: float = 600.0,
+                 standby: bool = False,
+                 takeover_s: float = 5.0):
         self.store = SeriesStore(max_points=max_points,
                                  origin_expiry_s=origin_expiry_s)
         # the collector's OWN journal (never the process default): it
@@ -497,9 +560,46 @@ class TelemetryCollector:
         # run's entry per restart forever
         self._high: Dict[Tuple[str, str], Tuple[int, float]] = {}
         self._counters = {"events": 0, "snapshots": 0, "event_batches": 0,
-                          "dup_events": 0, "bad_requests": 0}
+                          "dup_events": 0, "bad_requests": 0,
+                          "segments_corrupt": 0}
         self._stop = threading.Event()
         self._http: Optional[Any] = None
+
+        # -- durable series store (telemetry/store.py) -------------------
+        # With store_dir every ingest is written through to a
+        # segmented, CRC-framed, retention-bounded log; a restart (or a
+        # standby promotion) replays it to rebuild rings, dedupe
+        # high-water marks, the fleet journal, and alert firing/pending
+        # state. _seg_lock makes [counter update → log append] atomic
+        # across threads so a 'state' record's absolute counters always
+        # agree with its position in the log (replay = baseline +
+        # increments, exact).
+        self._seg: Optional[Any] = None
+        self._seg_lock = threading.Lock()
+        self._promote_lock = threading.Lock()
+        self._standby = bool(standby)
+        # the split-brain fence: a standby only promotes once the
+        # active writer's heartbeat (stamped every eval tick, removed
+        # on clean close) has been silent this long — a transient
+        # primary stall that made ONE flush fail over must not create
+        # two writers on the shared store_dir
+        self.takeover_s = float(takeover_s)
+        self._last_retention = 0.0
+        if store_dir:
+            from .store import SegmentStore
+            self._seg = SegmentStore(
+                store_dir, retention_s=retention_s,
+                retention_bytes=retention_bytes,
+                segment_max_bytes=segment_max_bytes,
+                segment_max_s=segment_max_s,
+                state_fn=self._state_payload)
+            if not self._standby:
+                self._recover()
+                self._seg.open()
+        elif self._standby:
+            raise ValueError("standby=True needs a store_dir to promote "
+                             "from (a standby without a shared segment "
+                             "log has no history to adopt)")
 
         self._ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -531,6 +631,160 @@ class TelemetryCollector:
             self._http.close()
             self._http = None
         self._eval_thread.join(timeout=5.0)
+        if self._seg is not None:
+            # a final state record makes a CLEAN shutdown bit-exact on
+            # restart even when the last eval tick predates the last
+            # ingest; then fsync-close the active segment and drop the
+            # writer heartbeat so a standby may take over immediately
+            with self._seg_lock:
+                if not self._standby:
+                    self._seg.append(self._state_record())
+            self._seg.close()
+            if not self._standby:
+                self._seg.clear_heartbeat()
+
+    # -- durable store: write-through, recovery, promotion -------------------
+
+    @property
+    def persistent(self) -> bool:
+        return self._seg is not None
+
+    @property
+    def is_standby(self) -> bool:
+        return self._standby
+
+    def _state_payload(self) -> Dict[str, Any]:
+        """The 'state' record body (minus the ``k`` tag): absolute
+        ingest counters, EVENTS dedupe high-water marks, and the alert
+        engine's firing/pending/resolved state — everything replay
+        cannot reconstruct from snap/ev records alone."""
+        with self._lock:
+            ctrs = dict(self._counters)
+            high = [[o, r, hw, touched]
+                    for (o, r), (hw, touched) in sorted(self._high.items())]
+        # rule SPECS ride along so a hot-reloaded pack survives restart
+        # and standby promotion (the log, not the boot-time --rules
+        # file, is the source of truth for a recovering collector)
+        specs = [{"name": r.name, "expr": r.expr, "severity": r.severity,
+                  "annotations": dict(r.annotations)}
+                 for r in self.engine.rules]
+        return {"ctrs": ctrs, "high": high, "rules": specs,
+                "engine": self.engine.state()}
+
+    def _state_record(self) -> Dict[str, Any]:
+        rec = self._state_payload()
+        rec["k"] = "state"
+        rec["t"] = time.time()
+        return rec
+
+    def _apply_record(self, kind: str, doc: Dict[str, Any]) -> None:
+        """Replay one persisted record into the in-memory planes (the
+        ``SegmentStore.recover`` callback)."""
+        if kind == "snap":
+            self.store.ingest(str(doc.get("o", "")), doc.get("f") or {},
+                              t=doc.get("t"), sanitized=True)
+            self._counters["snapshots"] += 1
+        elif kind == "ev":
+            origin = str(doc.get("o", ""))
+            events = doc.get("e") or []
+            self.journal.ingest(events, origin=origin)
+            key = (origin, str(doc.get("r", "")))
+            hw = int(doc.get("hw", 0))
+            t = doc.get("t")
+            t = float(t) if isinstance(t, (int, float)) else time.time()
+            old = self._high.get(key, (0, 0.0))[0]
+            self._high[key] = (max(old, hw), t)
+            self.store.mark_push(origin, t=t)
+            self._counters["events"] += len(events)
+            self._counters["event_batches"] += 1
+        elif kind == "retire":
+            self.store.retire(str(doc.get("o", "")))
+        elif kind == "state":
+            for k, v in (doc.get("ctrs") or {}).items():
+                # segments_corrupt is NOT restored from the baseline:
+                # a still-retained corrupt record is re-detected (and
+                # re-counted) by every recovery pass, so carrying the
+                # old count forward would grow the monotonic counter
+                # by one per restart with zero new corruption
+                if k in self._counters and k != "segments_corrupt":
+                    self._counters[k] = type(self._counters[k])(v)
+            self._high = {(str(o), str(r)): (int(hw), float(touched))
+                          for o, r, hw, touched in doc.get("high") or []}
+            specs = doc.get("rules")
+            if specs:
+                try:
+                    # assigned directly (not set_rules): replay must
+                    # never EMIT transitions, and restore() below
+                    # replaces the instance table wholesale anyway
+                    self.engine.rules = _alerts.parse_rules(specs)
+                except _alerts.AlertRuleError:
+                    pass  # keep the boot-time rules
+            self.engine.restore(doc.get("engine") or {})
+
+    def _recover(self) -> int:
+        """Replay the retained segment log oldest → newest. Counter
+        exactness: every segment begins with a 'state' record (absolute
+        baseline) and subsequent snap/ev records increment, so any
+        retained SUFFIX of history recovers the exact pre-restart
+        counts. Corrupt records were already skipped (and counted) by
+        the store's reader."""
+        n = self._seg.recover(self._apply_record)
+        self._counters["segments_corrupt"] += \
+            self._seg.counters["corrupt_records"]
+        if n:
+            _log().info("recovered %d telemetry record(s) from %s "
+                        "(%d origin(s), %d corrupt record(s) skipped)",
+                        n, self._seg.root, len(self.store.origins()),
+                        self._seg.counters["corrupt_records"])
+        return n
+
+    def promote(self, force: bool = False) -> bool:
+        """Standby → active: replay the shared segment log (rings,
+        journal, dedupe marks, alert state — firing instances come back
+        firing WITHOUT a new transition) and take over appending to it.
+        Idempotent; called automatically on the first data push a
+        standby receives (the shipper failed over), or explicitly by an
+        operator (``force=True`` skips the fence). Returns True if
+        this call did the promotion.
+
+        The fence: promotion REFUSES (raises — the push gets an ERR,
+        the shipper re-buffers and retries) while the active writer's
+        heartbeat is fresher than ``takeover_s``. One transiently
+        stalled primary flush must not let a standby seize the shared
+        log out from under a live writer (split-brain: two appenders,
+        duplicate alerts, a sidecar CRC committed over a file the
+        primary still has open). A dead primary stops stamping, so the
+        fence clears within ``takeover_s``; a CLEAN shutdown removes
+        the stamp and the takeover is immediate."""
+        with self._promote_lock:
+            if not self._standby:
+                return False
+            if self._seg is not None:
+                if not force:
+                    age = self._seg.heartbeat_age()
+                    if age is not None and age < self.takeover_s:
+                        raise RuntimeError(
+                            f"standby not promoting: the active "
+                            f"writer's heartbeat is {age:.1f}s old "
+                            f"(< takeover_s={self.takeover_s:g}) — "
+                            "retry after it goes silent")
+                self._recover()
+                self._seg.open()
+            self._standby = False
+            self.journal.emit("collector.promoted",
+                              store=self._seg.root if self._seg else None)
+            _log().warning("standby collector promoted "
+                           "(store=%s, %d origin(s), %d firing alert(s) "
+                           "restored)",
+                           self._seg.root if self._seg else None,
+                           len(self.store.origins()),
+                           len(self.engine.firing()))
+            return True
+
+    def _seg_append(self, record: Dict[str, Any]) -> None:
+        if self._seg is not None and not self._standby:
+            with self._seg_lock:
+                self._seg.append(record)
 
     def __enter__(self) -> "TelemetryCollector":
         return self
@@ -597,12 +851,24 @@ class TelemetryCollector:
         verb = parts[0]
         if verb == "PING":
             return "OK 0"
+        if verb == "STATS":
+            # ingest/store counters as one JSON object riding the reply
+            # line — the bench rows' store-overhead delta source (and a
+            # doctor read for operators without the HTTP port)
+            return "OK " + json.dumps(self.stats(), sort_keys=True,
+                                      separators=(",", ":"))
         if verb in ("EVENTS", "SNAPSHOT") and parts[1] == "collector":
             # reserved: the merged export stamps the collector's OWN
             # series under this origin — a pusher claiming it would be
             # silently overwritten there while still feeding the rings
             # (scrape and alert state would disagree)
             raise ValueError("origin 'collector' is reserved")
+        if verb in ("EVENTS", "SNAPSHOT") and self._standby:
+            # first data push to a standby: the shippers failed over,
+            # so the primary is gone — replay the shared log and take
+            # over BEFORE applying this push (its dedupe depends on
+            # the replayed high-water marks)
+            self.promote()
         if verb == "EVENTS":
             origin, blen = parts[1], int(parts[2])
             body = json.loads(read_exact(conn, blen))
@@ -610,9 +876,15 @@ class TelemetryCollector:
         if verb == "SNAPSHOT":
             origin, blen = parts[1], int(parts[2])
             body = json.loads(read_exact(conn, blen))
-            n = self.store.ingest(origin, body.get("families") or {})
-            with self._lock:
-                self._counters["snapshots"] += 1
+            t = time.time()
+            snap = SeriesStore._sanitize(body.get("families") or {})
+            n = self.store.ingest(origin, snap, t=t, sanitized=True)
+            with self._seg_lock:
+                with self._lock:
+                    self._counters["snapshots"] += 1
+                if self._seg is not None and not self._standby:
+                    self._seg.append({"k": "snap", "o": origin, "t": t,
+                                      "f": snap})
             return f"OK {n}"
         # raised (not returned) so the connection CLOSES: an unknown
         # verb from a newer client may carry a framed body this
@@ -650,13 +922,22 @@ class TelemetryCollector:
                     high = max(high, int(mark))
             dup = len(events) - len(fresh)
             n = self.journal.ingest(fresh, origin=origin) if fresh else 0
-            with self._lock:
-                self._counters["events"] += n
-                self._counters["dup_events"] += dup
-                self._counters["event_batches"] += 1
-                self._high[key] = (max(self._high.get(key, (0, 0.0))[0],
-                                       high), time.time())
-        self.store.mark_push(origin)
+            now = time.time()
+            with self._seg_lock:
+                with self._lock:
+                    self._counters["events"] += n
+                    self._counters["dup_events"] += dup
+                    self._counters["event_batches"] += 1
+                    self._high[key] = (max(self._high.get(key, (0, 0.0))[0],
+                                           high), now)
+                if self._seg is not None and not self._standby:
+                    # written BEFORE the OK reply goes out: an event
+                    # batch the shipper saw acknowledged is durable, so
+                    # a standby replaying this log dedupes the resend a
+                    # failed-over shipper makes of anything UNACKED
+                    self._seg.append({"k": "ev", "o": origin, "t": now,
+                                      "r": run, "hw": high, "e": fresh})
+        self.store.mark_push(origin, t=now)
         return n
 
     # -- alert evaluation ----------------------------------------------------
@@ -672,11 +953,18 @@ class TelemetryCollector:
     def evaluate_once(self, now: Optional[float] = None
                       ) -> List[Dict[str, Any]]:
         """One expiry + evaluation tick (the eval thread's body; tests
-        and drills call it directly for deterministic timing)."""
+        and drills call it directly for deterministic timing). A
+        standby does NOTHING here: it must not expire origins, fire
+        alerts, or touch the shared log the primary is writing."""
+        if self._standby:
+            return []
         now = time.time() if now is None else now
         retired = self.store.expire(now)
         for origin in retired:
             self.journal.emit("collector.origin_retired", origin=origin)
+            # persisted so replay does not resurrect the retired
+            # origin's series from its older snap records
+            self._seg_append({"k": "retire", "o": origin, "t": now})
         # dedupe marks are TTL-pruned, not only origin-retired: a
         # stably-named origin that restarts mints a new run id per
         # incarnation while keeping its last_push fresh, so dead runs'
@@ -689,7 +977,33 @@ class TelemetryCollector:
                         if k[0] in gone or
                         now - touched > self.store.origin_expiry_s]:
                 del self._high[key]
-        return self.engine.evaluate(self.store, now)
+        transitions = self.engine.evaluate(self.store, now)
+        if self._seg is not None:
+            self._seg.touch_heartbeat()
+            # retention re-lists the dir and re-reads every sealed
+            # sidecar — a per-tick sweep would be hundreds of
+            # syscalls/s under the store lock for a bound that moves
+            # on the scale of segments, so it runs every ~10s
+            if now - self._last_retention >= 10.0:
+                self._last_retention = now
+                self._seg.enforce_retention(now)
+            self._persist_state_if_changed()
+        return transitions
+
+    def _persist_state_if_changed(self) -> None:
+        """Append a 'state' record when anything it captures moved
+        since the last tick (ingest counters, dedupe marks, alert
+        instances) — idle collectors write nothing, loaded ones write
+        one small record per eval tick."""
+        with self._seg_lock:
+            rec = self._state_record()
+            fp = json.dumps({"ctrs": rec["ctrs"], "high": rec["high"],
+                             "engine": rec["engine"]},
+                            sort_keys=True, default=repr)
+            if fp == getattr(self, "_last_state_fp", None):
+                return
+            self._last_state_fp = fp
+            self._seg.append(rec)
 
     def _on_transition(self, t: Dict[str, Any]) -> None:
         self.journal.emit(f"alert.{t['state']}", rule=t["rule"],
@@ -707,11 +1021,19 @@ class TelemetryCollector:
 
     # -- read surfaces -------------------------------------------------------
 
-    def families(self) -> List[MetricFamily]:
+    def families(self, now: Optional[float] = None) -> List[MetricFamily]:
         """ONE merged export: every origin's latest snapshot + the
         collector's own series (stamped ``origin="collector"``) through
         a single :func:`merge_exports` pass, so family declarations
-        never repeat and the naming contract holds."""
+        never repeat and the naming contract holds.
+
+        An origin silent past HALF its expiry scrapes with a
+        ``stale="true"`` label on every sample (the JSON form carries
+        the same label): its gauges are the last thing a dead process
+        said, and an autoscaler reading the merged export must be able
+        to tell a fresh 'queue_depth 0' from a frozen one BEFORE the
+        origin is retired wholesale."""
+        now = time.time() if now is None else now
         with self._lock:
             c = dict(self._counters)
         snap = self.engine.snapshot()
@@ -735,10 +1057,123 @@ class TelemetryCollector:
                            [({"state": s}, v)
                             for s, v in sorted(trans.items())]),
         ]
-        named = {origin: families_from_snapshot(snap)
-                 for origin, snap in self.store.latest_snapshots().items()}
+        if self._seg is not None:
+            sc = dict(self._seg.counters)
+            own += [
+                counter_family(
+                    "paddle_tpu_collector_segments_corrupt_total",
+                    "Corrupt segment records detected and skipped by "
+                    "recovery (CRC mismatch, torn tail, bitrot)",
+                    [({}, c["segments_corrupt"])]),
+                counter_family(
+                    "paddle_tpu_collector_store_appends_total",
+                    "Records appended to the on-disk series store",
+                    [({}, sc["appends"])]),
+                counter_family(
+                    "paddle_tpu_collector_store_bytes_total",
+                    "Bytes appended to the on-disk series store",
+                    [({}, sc["bytes"])]),
+                counter_family(
+                    "paddle_tpu_collector_store_append_seconds_total",
+                    "Seconds spent in store appends (ingest-write "
+                    "overhead)",
+                    [({}, round(sc["append_seconds"], 6))]),
+                counter_family(
+                    "paddle_tpu_collector_store_append_failures_total",
+                    "Store appends that failed (disk full/IO error) — "
+                    "pushes were still ACKed from memory, so a nonzero "
+                    "rate means the durable log is falling behind",
+                    [({}, sc["append_failures"])]),
+                gauge_family(
+                    "paddle_tpu_collector_store_segments",
+                    "Retained segments on disk (active included)",
+                    [({}, len(self._seg.segment_paths()))]),
+            ]
+        stale_after = self.store.origin_expiry_s / 2.0
+        ages = self.store.origins()
+        named = {}
+        for origin, osnap in self.store.latest_snapshots().items():
+            fams = families_from_snapshot(osnap)
+            if now - ages.get(origin, now) > stale_after:
+                for fam in fams:
+                    fam.samples = [(dict(labels, stale="true"), value)
+                                   for labels, value in fam.samples]
+            named[origin] = fams
         named["collector"] = own
         return merge_exports(named, label="origin")
+
+    def stats(self) -> Dict[str, Any]:
+        """Flat ingest/store counters (the ``STATS`` wire verb body —
+        the bench rows delta these to price store ingest-writes)."""
+        with self._lock:
+            out: Dict[str, Any] = dict(self._counters)
+        out["origins"] = len(self.store.origins())
+        out["standby"] = self._standby
+        out["persistence"] = self._seg is not None
+        if self._seg is not None:
+            sc = dict(self._seg.counters)
+            out["store"] = {
+                "appends": sc["appends"], "bytes": sc["bytes"],
+                "append_seconds": round(sc["append_seconds"], 6),
+                "append_failures": sc["append_failures"],
+                "segments_sealed": sc["segments_sealed"],
+                "segments_deleted": sc["segments_deleted"],
+                "segments": len(self._seg.segment_paths()),
+            }
+        return out
+
+    def query(self, metric: str, labels: Optional[Dict[str, str]] = None,
+              start: float = 0.0, end: Optional[float] = None,
+              step: float = 0.0) -> Dict[str, Any]:
+        """Range-read one metric (the ``GET /query`` body): from the
+        durable segment log when persistence is on — the answer then
+        survives this collector — else from the bounded in-memory
+        rings."""
+        if self._seg is not None:
+            return self._seg.query(metric, labels, start=start, end=end,
+                                   step=step)
+        return self.store.range_query(metric, labels, start=start, end=end,
+                                      step=step)
+
+    def reload_rules(self, specs: Optional[List[Dict[str, Any]]] = None,
+                     path: Optional[str] = None) -> List[str]:
+        """Hot-reload the alert rule pack (SIGHUP / ``POST /rules``):
+        lint first (:func:`~paddle_tpu.telemetry.alerts.lint_rules`),
+        REJECT on any finding (returned; the running rules stay in
+        force), else swap via ``AlertEngine.set_rules`` — state keyed
+        by rule name survives, firing instances of removed rules
+        resolve — and journal ``alert.rules_reloaded``."""
+        if (specs is None) == (path is None):
+            raise ValueError("pass exactly one of specs= or path=")
+        if path is not None:
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    doc = json.load(f)
+            except (OSError, ValueError) as e:
+                return [f"alert:malformed-expr {path}: unreadable rule "
+                        f"file: {e}"]
+            specs = doc.get("rules", []) if isinstance(doc, dict) else doc
+        if not isinstance(specs, list):
+            return ["alert:malformed-expr expected a JSON list of rules "
+                    "(or {'rules': [...]})"]
+        findings = _alerts.lint_rules(specs)
+        if findings:
+            self.journal.emit("alert.rules_rejected", findings=len(findings),
+                              source=path or "<inline>")
+            _log().warning("alert rule reload REJECTED (%d finding(s); "
+                           "running rules stay in force)", len(findings))
+            return findings
+        rules = _alerts.parse_rules(specs)
+        self.engine.set_rules(rules)
+        self.journal.emit("alert.rules_reloaded", rules=len(rules),
+                          names=sorted(r.name for r in rules),
+                          source=path or "<inline>")
+        _log().info("alert rules reloaded: %d rule(s)", len(rules))
+        if self._seg is not None and not self._standby:
+            with self._seg_lock:
+                self._last_state_fp = None
+                self._seg.append(self._state_record())
+        return []
 
     def alerts_json(self) -> Dict[str, Any]:
         return self.engine.snapshot()
@@ -758,7 +1193,9 @@ class TelemetryCollector:
             return self._http
 
         def health():
-            return {"live": not self._stop.is_set(), "role": "collector",
+            return {"live": not self._stop.is_set(),
+                    "role": "standby" if self._standby else "collector",
+                    "persistence": self._seg is not None,
                     "origins": sorted(self.store.origins()),
                     "alerts_firing": len(self.engine.firing())}
 
@@ -781,11 +1218,51 @@ class TelemetryCollector:
             return (200, "application/json",
                     json.dumps(tl, sort_keys=True, default=repr).encode())
 
+        def query_route(query: str):
+            params = dict(p.partition("=")[::2]
+                          for p in query.split("&") if p)
+            metric = params.get("metric")
+            if not metric:
+                return (400, "text/plain; charset=utf-8",
+                        b"need ?metric=<name>[&labels=k=v,k2=v2]"
+                        b"[&from=T][&to=T][&step=S]\n")
+            try:
+                labels = _alerts._parse_labels(params.get("labels"))
+                start = float(params.get("from", 0.0))
+                end = (float(params["to"]) if params.get("to") is not None
+                       and params.get("to") != "" else None)
+                step = float(params.get("step", 0.0))
+            except (ValueError, _alerts.AlertRuleError) as e:
+                return (400, "text/plain; charset=utf-8",
+                        f"bad query parameter: {e}\n".encode())
+            doc = self.query(metric, labels, start=start, end=end,
+                             step=step)
+            return (200, "application/json",
+                    json.dumps(doc, sort_keys=True, default=repr).encode())
+
+        def rules_post(query: str, body: bytes):
+            try:
+                specs = json.loads(body.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError) as e:
+                return (400, "application/json",
+                        json.dumps({"accepted": False, "findings": [
+                            f"alert:malformed-expr body is not JSON: {e}"
+                        ]}).encode())
+            if isinstance(specs, dict):
+                specs = specs.get("rules", [])
+            findings = self.reload_rules(specs=specs)
+            doc = {"accepted": not findings, "findings": findings,
+                   "rules": [r.describe() for r in self.engine.rules]}
+            return (200 if not findings else 422, "application/json",
+                    json.dumps(doc, sort_keys=True).encode())
+
         self._http = serve_metrics(
             registry=FamiliesView(self.families), health_fn=health,
             port=port, host=host or self.host,
             extra_routes={"/alerts": alerts_route,
-                          "/timeline": timeline_route})
+                          "/timeline": timeline_route,
+                          "/query": query_route},
+            post_routes={"/rules": rules_post})
         return self._http
 
 
@@ -800,10 +1277,12 @@ class CollectorProcess:
 
     def __init__(self, rules_path: Optional[str] = None,
                  host: str = "127.0.0.1", args: Tuple[str, ...] = (),
+                 store_dir: Optional[str] = None,
                  timeout: float = 300.0):
         # timeout matches ReplicaProcess.wait_ready: the child's cold
         # interpreter + package import can take minutes on a machine
         # already saturated by a test suite or a training fleet
+        import os
         import select
         import subprocess
         import sys
@@ -814,6 +1293,8 @@ class CollectorProcess:
                 "--host", host, "--port", "0", "--http-port", "0"]
         if rules_path:
             argv += ["--rules", rules_path]
+        if store_dir:
+            argv += ["--store-dir", store_dir]
         argv += list(args)
         # a collector child must never ship to itself (or to whatever
         # collector the PARENT ships to — its metrics are its own)
@@ -827,27 +1308,39 @@ class CollectorProcess:
         # the pipe is select()ed so the deadline holds even when the
         # child hangs WITHOUT printing (the wait_ready discipline) —
         # and a stalled handshake must not orphan the live daemon the
-        # caller has no handle to
+        # caller has no handle to. Reads are raw os.read on the fd,
+        # NOT readline(): when the PORT and HTTP lines land in one
+        # pipe chunk, readline() would buffer the second line inside
+        # the TextIOWrapper where select() cannot see it — and the
+        # handshake would hang on a pipe that already delivered
+        # everything (a real observed flake, timing-dependent).
         deadline = time.monotonic() + timeout
+        fd = self._proc.stdout.fileno()
+        buf = b""
         while self.port is None or self.http_port is None:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 self.stop()
                 raise TimeoutError(
                     f"collector did not hand shake in {timeout:g}s")
-            ready, _, _ = select.select([self._proc.stdout], [], [],
+            ready, _, _ = select.select([fd], [], [],
                                         min(remaining, 1.0))
             if not ready:
                 continue
-            line = self._proc.stdout.readline()
-            if not line:
+            chunk = os.read(fd, 4096)
+            if not chunk:
                 raise RuntimeError(
                     f"collector process exited rc={self._proc.poll()} "
                     "before its handshake")
-            if line.startswith("PORT "):
-                self.port = int(line.split()[1])
-            elif line.startswith("HTTP "):
-                self.http_port = int(line.split()[1])
+            buf += chunk
+            while b"\n" in buf and (self.port is None or
+                                    self.http_port is None):
+                line, _, buf = buf.partition(b"\n")
+                text = line.decode("utf-8", "replace")
+                if text.startswith("PORT "):
+                    self.port = int(text.split()[1])
+                elif text.startswith("HTTP "):
+                    self.http_port = int(text.split()[1])
 
     @property
     def addr(self) -> Tuple[str, int]:
@@ -856,6 +1349,25 @@ class CollectorProcess:
     @property
     def http_url(self) -> str:
         return f"http://{self.host}:{self.http_port}"
+
+    @property
+    def pid(self) -> int:
+        return self._proc.pid
+
+    def kill(self) -> None:
+        """SIGKILL, no cleanup — the HA drill's primary-death injector
+        (``stop()`` is the graceful path)."""
+        import signal as _signal
+
+        if self._proc.poll() is None:
+            try:
+                self._proc.send_signal(_signal.SIGKILL)
+            except OSError:
+                pass
+            try:
+                self._proc.wait(timeout=5.0)
+            except Exception:
+                pass
 
     def stop(self) -> None:
         if self._proc.poll() is None:
@@ -886,7 +1398,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--http-port", type=int, default=0,
                     help="read endpoint port (0 picks free)")
     ap.add_argument("--rules", default="",
-                    help="JSON alert-rule file (default: the preset pack)")
+                    help="JSON alert-rule file (default: the preset pack; "
+                         "SIGHUP re-lints and hot-reloads it)")
     ap.add_argument("--eval-interval", type=float, default=0.25)
     ap.add_argument("--origin-expiry", type=float, default=60.0)
     ap.add_argument("--flight-root", default="",
@@ -894,6 +1407,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--dump-on-fire", action="store_true",
                     help="flight-dump on EVERY firing transition "
                          "(default: page-severity rules only)")
+    ap.add_argument("--store-dir", default="",
+                    help="segmented on-disk series store root (empty: "
+                         "in-memory only, a restart loses history)")
+    ap.add_argument("--retention-s", type=float, default=24 * 3600.0,
+                    help="store retention by time (oldest sealed "
+                         "segments past this are deleted)")
+    ap.add_argument("--retention-bytes", type=int, default=256 << 20,
+                    help="store retention by size (oldest-first "
+                         "deletion past this)")
+    ap.add_argument("--segment-max-bytes", type=int, default=4 << 20)
+    ap.add_argument("--standby", action="store_true",
+                    help="start as an HA standby over the shared "
+                         "--store-dir: no ingestion/eval until the "
+                         "first failed-over push promotes it (replaying "
+                         "the segment log)")
+    ap.add_argument("--takeover-s", type=float, default=5.0,
+                    help="standby promotion fence: refuse to promote "
+                         "while the active writer's heartbeat is "
+                         "fresher than this (0 disables)")
     args = ap.parse_args(argv)
 
     rules = _alerts.load_rules(args.rules) if args.rules else None
@@ -902,20 +1434,52 @@ def main(argv: Optional[List[str]] = None) -> int:
         eval_interval=args.eval_interval,
         origin_expiry_s=args.origin_expiry,
         dump_on_fire=True if args.dump_on_fire else None,
-        flight_root=args.flight_root or None)
+        flight_root=args.flight_root or None,
+        store_dir=args.store_dir or None,
+        retention_s=args.retention_s,
+        retention_bytes=args.retention_bytes,
+        segment_max_bytes=args.segment_max_bytes,
+        standby=args.standby, takeover_s=args.takeover_s)
     http = col.serve_http(port=args.http_port)
-    print(f"PORT {col.port}", flush=True)
-    print(f"HTTP {http.port}", flush=True)
-
     stop = threading.Event()
+    hup = threading.Event()
+    # handlers are installed BEFORE the PORT/HTTP handshake prints:
+    # the handshake means "ready", and an operator (or drill) may
+    # SIGHUP the instant it lands — with the default disposition still
+    # in place that signal would KILL the daemon (a real observed
+    # race: the HTTP thread can hold the GIL through a first scrape
+    # while the main thread has not reached signal.signal yet)
     for sig in (signal.SIGTERM, signal.SIGINT):
         try:
             signal.signal(sig, lambda *a: stop.set())
         except ValueError:  # not the main thread (embedded call)
             break
     try:
+        # the SIGHUP contract: re-lint the --rules file and hot-swap
+        # the pack; findings REJECT the reload and the running rules
+        # stay in force (the reload never leaves the engine rule-less)
+        signal.signal(signal.SIGHUP, lambda *a: hup.set())
+    except (ValueError, AttributeError):  # embedded call / no SIGHUP
+        pass
+    print(f"PORT {col.port}", flush=True)
+    print(f"HTTP {http.port}", flush=True)
+    import sys as _sys
+    try:
         while not stop.wait(0.5):
-            pass
+            if hup.is_set():
+                hup.clear()
+                # reload chatter goes to STDERR: stdout is the
+                # handshake pipe a CollectorProcess parent never
+                # drains past PORT/HTTP — enough SIGHUPs printing
+                # there would fill the pipe and wedge this loop
+                if args.rules:
+                    findings = col.reload_rules(path=args.rules)
+                    for f in findings:
+                        print(f"rules reload rejected: {f}",
+                              file=_sys.stderr, flush=True)
+                else:
+                    print("SIGHUP ignored: no --rules file to reload",
+                          file=_sys.stderr, flush=True)
     finally:
         col.close()
     return 0

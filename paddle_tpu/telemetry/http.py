@@ -13,8 +13,12 @@ shape fleet load-balancer probes expect. No third-party dependency:
 free port (``.port`` reports it).
 
 ``extra_routes`` adds endpoints beyond the two built-ins (the
-telemetry collector serves ``/alerts`` and ``/timeline`` through it):
-``{path: fn(query_string) -> (status, content_type, body_bytes)}``.
+telemetry collector serves ``/alerts``, ``/timeline``, and ``/query``
+through it): ``{path: fn(query_string) -> (status, content_type,
+body_bytes)}``. ``post_routes`` is the write-side analog — ``{path:
+fn(query_string, body_bytes) -> ...}`` — used by the collector's
+``POST /rules`` hot-reload door; POST bodies are bounded (1 MiB) so a
+runaway client cannot balloon the daemon.
 
 A scraper that disconnects mid-write (curl ^C, a Prometheus timeout)
 raises ``BrokenPipeError``/``ConnectionResetError`` on the handler
@@ -36,6 +40,10 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 # an extra route: fn(query_string) -> (status, content_type, body)
 RouteFn = Callable[[str], Tuple[int, str, bytes]]
+# a POST route: fn(query_string, body) -> (status, content_type, body)
+PostRouteFn = Callable[[str, bytes], Tuple[int, str, bytes]]
+
+MAX_POST_BODY = 1 << 20
 
 
 def _scrape_aborted() -> None:
@@ -58,10 +66,12 @@ class TelemetryServer:
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
                  port: int = 0, host: str = "127.0.0.1",
-                 extra_routes: Optional[Dict[str, RouteFn]] = None):
+                 extra_routes: Optional[Dict[str, RouteFn]] = None,
+                 post_routes: Optional[Dict[str, PostRouteFn]] = None):
         self.registry = registry if registry is not None else get_registry()
         self.health_fn = health_fn
         self.extra_routes = dict(extra_routes or {})
+        self.post_routes = dict(post_routes or {})
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -118,6 +128,38 @@ class TelemetryServer:
                                    if outer.extra_routes else b"")
                                 + b")\n")
 
+            def do_POST(self):  # noqa: N802 (stdlib handler name)
+                path, _, query = self.path.partition("?")
+                fn = outer.post_routes.get(path)
+                if fn is None:
+                    self._reply(404, "text/plain; charset=utf-8",
+                                b"unknown POST path (have "
+                                + ", ".join(
+                                    sorted(outer.post_routes)).encode()
+                                + b")\n" if outer.post_routes else
+                                b"no POST endpoints\n")
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    length = -1
+                if not 0 <= length <= MAX_POST_BODY:
+                    self._reply(413, "text/plain; charset=utf-8",
+                                f"POST body must declare 0..{MAX_POST_BODY}"
+                                " bytes\n".encode())
+                    self.close_connection = True
+                    return
+                try:
+                    body = self.rfile.read(length)
+                    code, ctype, out = fn(query, body)
+                    self._reply(code, ctype, out)
+                except (BrokenPipeError, ConnectionResetError):
+                    _scrape_aborted()
+                    self.close_connection = True
+                except Exception as e:
+                    self._reply(500, "text/plain; charset=utf-8",
+                                f"route {path} failed: {e}\n".encode())
+
             def _reply(self, code: int, ctype: str, body: bytes):
                 # a scraper disconnecting mid-write is routine (curl
                 # ^C, scrape timeout): swallow + count, never let it
@@ -171,11 +213,13 @@ class TelemetryServer:
 def serve_metrics(registry: Optional[MetricsRegistry] = None,
                   health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
                   port: int = 0, host: str = "127.0.0.1",
-                  extra_routes: Optional[Dict[str, RouteFn]] = None
+                  extra_routes: Optional[Dict[str, RouteFn]] = None,
+                  post_routes: Optional[Dict[str, PostRouteFn]] = None
                   ) -> TelemetryServer:
     """Start a :class:`TelemetryServer`; port 0 picks a free port."""
     return TelemetryServer(registry=registry, health_fn=health_fn,
-                           port=port, host=host, extra_routes=extra_routes)
+                           port=port, host=host, extra_routes=extra_routes,
+                           post_routes=post_routes)
 
 
 __all__ = ["PROMETHEUS_CONTENT_TYPE", "TelemetryServer", "serve_metrics"]

@@ -30,6 +30,17 @@ set (the env var is inherited by spawned replica processes, so a
 remote fleet ships per-process automatically). Explicit attachment is
 :func:`ship_to` — also exposed as ``.ship_to(addr)`` on all three.
 
+**Collector HA**: ``PDTPU_TELEMETRY_ADDR`` (and every addr-taking
+door here) accepts a comma-separated failover list —
+``"host1:p1,host2:p2"``. Flushes stick to the first address that
+accepts them; a flush error rotates to the next and retries within
+the SAME tick (counted as ``paddle_tpu_shipper_flushes_total{outcome=
+"failover"}``). The server-side ``(origin, run, sseq)`` dedupe that
+makes retries safe makes failover safe too: a standby collector that
+replayed the shared segment log carries the same high-water marks, so
+the batch a dead primary never acknowledged is resent to the standby
+and lands exactly once.
+
 Knobs (env defaults in parentheses): ``origin`` — the label this
 process's series carry at the collector (``PDTPU_TELEMETRY_ORIGIN``,
 else ``pid-<pid>``); ``flush_interval``
@@ -67,6 +78,24 @@ def parse_addr(addr: AddrLike) -> Tuple[str, int]:
         return (host, int(port))
     host, port = addr
     return (str(host), int(port))
+
+
+def parse_addrs(addr) -> Tuple[Tuple[str, int], ...]:
+    """The HA shape: a comma-separated failover list
+    (``"h1:p1,h2:p2"`` — what ``PDTPU_TELEMETRY_ADDR`` accepts), a
+    list/tuple of addr-likes, or one addr. Order is priority: the
+    shipper sticks to the first address that accepts flushes and fails
+    over down (then around) the list on flush errors."""
+    if isinstance(addr, str):
+        parts = [p.strip() for p in addr.split(",") if p.strip()]
+        if not parts:
+            raise ValueError(f"bad telemetry collector addr {addr!r}")
+        return tuple(parse_addr(p) for p in parts)
+    if isinstance(addr, (list, tuple)):
+        if len(addr) == 2 and isinstance(addr[1], int):
+            return (parse_addr(addr),)   # one (host, port) pair
+        return tuple(parse_addr(a) for a in addr)
+    return (parse_addr(addr),)
 
 
 class ShipperClient:
@@ -110,6 +139,13 @@ class ShipperClient:
     def ping(self) -> None:
         self._cli._request("PING")
 
+    def stats(self) -> Dict[str, Any]:
+        """The collector's ``STATS`` verb: its ingest/store counters as
+        one JSON object riding the reply line (``OK {...}``) — what the
+        bench rows delta to price store ingest-writes."""
+        resp = self._cli._request("STATS")
+        return json.loads(resp.split(" ", 1)[1])
+
     def close(self) -> None:
         self._cli.close()
 
@@ -125,7 +161,12 @@ class Shipper:
                  snapshot_interval: Optional[float] = None,
                  buffer_events: Optional[int] = None,
                  client_timeout: float = 5.0):
-        self.addr = parse_addr(addr)
+        # the HA failover list: flushes go to addrs[_addr_i]; a flush
+        # error rotates to the next address and retries ONCE in the
+        # same tick (server-side idempotent dedupe is what makes the
+        # resend — to either collector — safe)
+        self.addrs = parse_addrs(addr)
+        self._addr_i = 0
         origin = origin or os.environ.get("PDTPU_TELEMETRY_ORIGIN") \
             or f"pid-{os.getpid()}"
         if any(c.isspace() for c in origin):
@@ -160,7 +201,8 @@ class Shipper:
         self._c_lock = threading.Lock()
         self._counts = {"events_shipped": 0, "events_dropped": 0,
                         "snapshots": 0, "flushes": 0, "flush_failures": 0,
-                        "flush_seconds": 0.0}
+                        "failovers": 0, "flush_seconds": 0.0}
+        self._client_timeout = client_timeout
         self._client = ShipperClient(self.addr, timeout=client_timeout)
         self._stop = threading.Event()
         self._wake = threading.Event()
@@ -181,6 +223,26 @@ class Shipper:
         # starts, so absence alerts cover even a process that dies
         # young — and operators see a spawned fleet appear promptly
         self._wake.set()
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        """The address flushes currently go to (failover rotates it)."""
+        return self.addrs[self._addr_i]
+
+    def _failover_locked(self) -> None:
+        """Rotate to the next collector in the list (called under
+        ``_flush_lock`` after a flush error). The dead primary comes
+        back into rotation if every other address fails too — a
+        recovered primary is re-adopted within one lap."""
+        try:
+            self._client.close()
+        except Exception:
+            pass
+        self._addr_i = (self._addr_i + 1) % len(self.addrs)
+        self._client = ShipperClient(self.addr,
+                                     timeout=self._client_timeout)
+        with self._c_lock:
+            self._counts["failovers"] += 1
 
     # -- hot path ------------------------------------------------------------
 
@@ -231,7 +293,8 @@ class Shipper:
         if not batch and not want_snap:
             return
         t0 = time.perf_counter()
-        try:
+
+        def _send():
             if batch:
                 self._client.ship_events(
                     self.origin, self.journal.run_id,
@@ -239,6 +302,20 @@ class Shipper:
             if want_snap:
                 self._client.ship_snapshot(self.origin,
                                            self.registry.snapshot())
+
+        try:
+            try:
+                _send()
+            except Exception:
+                if len(self.addrs) < 2:
+                    raise
+                # the HA half: fail over to the next collector and
+                # retry THIS flush (a resend of an already-applied
+                # batch is deduped server-side by the sseq high-water,
+                # on the standby too once it has replayed the log)
+                self._failover_locked()
+                _send()
+            if want_snap:
                 self._last_snapshot = now
             with self._c_lock:
                 self._counts["events_shipped"] += len(batch)
@@ -290,7 +367,20 @@ class Shipper:
             out["buffered"] = len(self._buf)
         out["origin"] = self.origin
         out["addr"] = f"{self.addr[0]}:{self.addr[1]}"
+        out["addrs"] = [f"{h}:{p}" for h, p in self.addrs]
         return out
+
+    def collector_stats(self) -> Optional[Dict[str, Any]]:
+        """The attached collector's ingest/store counters (``STATS``
+        wire verb), or None when it is unreachable — serialized against
+        the flush loop (one framed socket). The bench rows delta this
+        to price the collector-side store ingest-writes a measured
+        window caused."""
+        with self._flush_lock:
+            try:
+                return self._client.stats()
+            except Exception:
+                return None
 
     def _families(self):
         from .registry import counter_family
@@ -310,11 +400,15 @@ class Shipper:
                            "Registry snapshots shipped to the collector",
                            [(labels, c["snapshots"])]),
             counter_family("paddle_tpu_shipper_flushes_total",
-                           "Shipper flush attempts (by outcome)",
+                           "Shipper flush attempts (by outcome; a "
+                           "'failover' marks a flush that rotated to "
+                           "the next collector in the HA list)",
                            [({**labels, "outcome": "ok"},
                              c["flushes"] - c["flush_failures"]),
                             ({**labels, "outcome": "failed"},
-                             c["flush_failures"])]),
+                             c["flush_failures"]),
+                            ({**labels, "outcome": "failover"},
+                             c["failovers"])]),
             counter_family("paddle_tpu_shipper_flush_seconds_total",
                            "Shipper thread seconds spent flushing",
                            [(labels, round(c["flush_seconds"], 6))]),
@@ -350,7 +444,7 @@ def ship_to(addr: AddrLike, origin: Optional[str] = None,
 def _ship(addr: AddrLike, origin: Optional[str], explicit: bool,
           **kw) -> Shipper:
     global _active, _explicit
-    target = parse_addr(addr)
+    target = parse_addrs(addr)
     # construction happens UNDER the lock (it is cheap: no connect —
     # the client is lazy), so two racing first-time callers (a Trainer
     # and a PredictorServer built concurrently, both auto-shipping)
@@ -359,7 +453,7 @@ def _ship(addr: AddrLike, origin: Optional[str], explicit: bool,
     # thread) happens outside.
     with _lock:
         if _active is not None:
-            if _active.addr == target and \
+            if _active.addrs == target and \
                     (origin is None or origin == _active.origin):
                 _explicit = _explicit or explicit
                 return _active
@@ -413,4 +507,4 @@ def maybe_auto_ship() -> Optional[Shipper]:
 
 
 __all__ = ["Shipper", "ShipperClient", "active_shipper", "maybe_auto_ship",
-           "parse_addr", "ship_to", "stop_shipping"]
+           "parse_addr", "parse_addrs", "ship_to", "stop_shipping"]

@@ -256,6 +256,90 @@ def test_rows_carry_shipper_deltas_when_collector_attached(monkeypatch):
     assert "shipper" not in row
 
 
+def test_rows_carry_collector_store_deltas_when_persistence_on(monkeypatch):
+    """With a PERSISTING collector attached (store_dir), train and
+    serving rows additionally record the store's ingest-write cost
+    over the measured window (appends/bytes/append_seconds per step or
+    request) under `collector_store`; a collector without persistence
+    — or a shipper without a reachable collector — omits the key."""
+
+    # train row: _time_trainer snapshots into trainer._bench_store
+    class _T:
+        feed_wire = None
+        _bench_telemetry = {'paddle_tpu_trainer_steps_total{inst="0"}': 1.0}
+        _bench_shipper = {"events_shipped": 1.0}
+        _bench_store = {"appends": 0.5, "bytes": 120.0,
+                        "append_seconds": 1e-5}
+
+    row = bench._result(8, "samples/sec", 1e-3, 1e-3, 1e6, 1e12,
+                        trainer=_T())
+    assert row["collector_store"] == _T._bench_store
+
+    class _NoStore:
+        feed_wire = None
+        _bench_shipper = {"events_shipped": 1.0}
+
+    row = bench._result(8, "samples/sec", 1e-3, 1e-3, 1e6, 1e12,
+                        trainer=_NoStore())
+    assert "collector_store" not in row and row["shipper"]
+
+    # the snapshot source: persistence off (or stats unreachable) -> None
+    class _FakeShipper:
+        def __init__(self, stats):
+            self._stats = stats
+            self.n = 0
+
+        def counters(self):
+            self.n += 1
+            return {"events_shipped": 10.0 * self.n}
+
+        def collector_stats(self):
+            if self._stats is not None:
+                self._stats = dict(self._stats)
+                store = self._stats.get("store")
+                if store:
+                    self._stats["store"] = {
+                        k: v * 2 for k, v in store.items()}
+            return self._stats
+
+    assert bench._store_snapshot(None) is None
+    assert bench._store_snapshot(_FakeShipper(None)) is None
+    assert bench._store_snapshot(
+        _FakeShipper({"persistence": False})) is None
+    snap = bench._store_snapshot(_FakeShipper(
+        {"persistence": True,
+         "store": {"appends": 4, "bytes": 100, "append_seconds": 0.001,
+                   "segments": 2}}))
+    assert snap == {"appends": 8.0, "bytes": 200.0,
+                    "append_seconds": 0.002}
+
+    # serving row: per-variant deltas keyed like `shipper`
+    class _Server:
+        def close(self, drain=True, timeout=None):
+            pass
+
+    fake = _FakeShipper({"persistence": True,
+                         "store": {"appends": 4.0, "bytes": 100.0,
+                                   "append_seconds": 0.001}})
+    monkeypatch.setattr(bench, "_shipper_snapshot",
+                        lambda: (fake, fake.counters()))
+    monkeypatch.setattr(bench, "_serving_predictors",
+                        lambda bs: {"fp32": ("P32", {"x": 1}),
+                                    "int8": ("P8", {"x": 1})})
+    monkeypatch.setattr(bench, "_make_server",
+                        lambda pred, workers, queue_size: _Server())
+    monkeypatch.setattr(bench, "_calibrate_serving",
+                        lambda server, feed, iters=8: 0.002)
+    monkeypatch.setattr(bench, "_drive_serving",
+                        lambda server, feed, n, rate: ([0.004] * n, 0))
+    row = bench.bench_serving(1.0, batch_size=8, requests=20, workers=2,
+                              queue_size=4)
+    assert set(row["collector_store"]) == {"fp32", "int8"}
+    for store in row["collector_store"].values():
+        assert set(store) == {"appends", "bytes", "append_seconds"}
+        assert all(isinstance(v, float) for v in store.values())
+
+
 def test_telemetry_counter_deltas_math():
     """counter_deltas is the snapshot's whole math: only moved series,
     normalized by the measured step/request count."""

@@ -508,6 +508,57 @@ def test_malformed_push_cannot_poison_metrics_or_desync(fresh):
         s.close()
 
 
+def test_merged_metrics_marks_stale_origins(fresh):
+    """An origin silent past HALF its expiry scrapes with stale="true"
+    on every sample instead of posing as fresh — the window where a
+    dead process's frozen gauges would otherwise read as live truth
+    (retirement only happens at the FULL expiry). The label is
+    naming-contract legal, rides the JSON form too, and clears if the
+    origin pushes again."""
+    from paddle_tpu.telemetry.registry import (families_snapshot,
+                                               render_families_prometheus)
+
+    with TelemetryCollector(eval_interval=3600, origin_expiry_s=60.0) as col:
+        cli = tshipper.ShipperClient(col.addr)
+        cli.ship_snapshot("fresh1", _snap("paddle_tpu_serving_queue_depth",
+                                         0, labels={"inst": "0"},
+                                         type_="gauge"))
+        cli.ship_snapshot("dead1", _snap("paddle_tpu_serving_queue_depth",
+                                        7, labels={"inst": "0"},
+                                        type_="gauge"))
+        cli.close()
+        now = time.time()
+        # age dead1 past half its expiry (30s) without touching fresh1
+        col.store.last_push["dead1"] = now - 31.0
+        text = render_families_prometheus(col.families(now=now))
+        assert ('paddle_tpu_serving_queue_depth'
+                '{inst="0",origin="dead1",stale="true"} 7') in text
+        assert ('paddle_tpu_serving_queue_depth'
+                '{inst="0",origin="fresh1"} 0') in text
+        assert 'origin="fresh1",stale' not in text
+        # the merged export stays naming-contract clean with the label
+        assert validate_families(col.families(now=now)) == []
+        # the JSON form (families_snapshot shape) carries it too
+        snap = families_snapshot(col.families(now=now))
+        dead = [s for s in
+                snap["paddle_tpu_serving_queue_depth"]["samples"]
+                if s["labels"].get("origin") == "dead1"]
+        assert dead[0]["labels"]["stale"] == "true"
+        # a rule matcher naming the label lints clean (universal label)
+        assert alerts.lint_rules([{
+            "name": "x",
+            "expr": 'paddle_tpu_serving_queue_depth{stale="true"} > 0 '
+                    "for 5s"}]) == []
+        # a new push clears the mark
+        cli = tshipper.ShipperClient(col.addr)
+        cli.ship_snapshot("dead1", _snap("paddle_tpu_serving_queue_depth",
+                                        8, labels={"inst": "0"},
+                                        type_="gauge"))
+        cli.close()
+        text = render_families_prometheus(col.families(now=time.time()))
+        assert 'origin="dead1",stale' not in text
+
+
 def test_alert_firing_triggers_flight_dump(fresh, tmp_path):
     rule = alerts.parse_rule(
         "hot", "paddle_tpu_serving_queue_depth > 5 for 0s",

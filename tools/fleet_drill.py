@@ -53,6 +53,19 @@ the framed wire — and injects real faults, not in-process stand-ins):
   (+ flush/eval slack), then RESOLVE after ``replace()`` respawns a
   process and the dead origin is retired — with the usual zero-drop /
   at-most-once request contract holding throughout.
+- **collector_failover** — collector HA under real SIGKILL: a PRIMARY
+  collector process (``--store-dir``, durable segment log) and an
+  in-drill STANDBY over the same store dir; the whole fleet (and a
+  synthetic alert source) ships to the comma-separated failover list.
+  A threshold alert fires on the primary; the primary is SIGKILLed
+  mid-stream; the shippers fail over, the standby PROMOTES by
+  replaying the shared log, and the drill asserts alert continuity
+  (the firing alert is STILL firing on the standby with no re-fire
+  and no resolve flap — zero ``alert.*`` transitions for its key),
+  zero shipped-event loss (a numbered event stream lands exactly once
+  across both collectors, deduped by the replayed high-water marks),
+  the failover recorded in ``paddle_tpu_shipper_flushes_total{outcome=
+  "failover"}``, and the zero-drop request contract throughout.
 
 Exit status: **0** all drills pass; **2** a drill dropped an accepted
 request or failed its contract (each violation printed); **3** the
@@ -610,9 +623,187 @@ def drill_alert(root, replicas, requests):
     return violations
 
 
+def drill_collector_failover(root, replicas, requests):
+    import json as _json
+    import signal as _signal
+
+    from paddle_tpu.telemetry import alerts
+    from paddle_tpu.telemetry import collector as tcollector
+    from paddle_tpu.telemetry import shipper as tshipper
+    from paddle_tpu.telemetry.journal import RunJournal
+    from paddle_tpu.telemetry.registry import MetricsRegistry
+
+    dirname, feed = _build_artifact(root, name="model_colfail")
+    store_dir = os.path.join(root, "colfail_store")
+    rules_path = os.path.join(root, "colfail_rules.json")
+    # a deterministic page: the synthetic source below pins this gauge
+    # above threshold for the whole drill, so the alert must stay
+    # FIRING straight through the failover (origin_down-style absence
+    # is the `alert` drill's job; HERE the contract is continuity)
+    with open(rules_path, "w") as f:
+        _json.dump([{"name": "drill_breaker", "severity": "page",
+                     "expr": "paddle_tpu_serving_breaker_open > 0 "
+                             "for 0.5s"}], f)
+    primary = tcollector.CollectorProcess(
+        rules_path=rules_path, store_dir=store_dir,
+        args=("--eval-interval", "0.1", "--origin-expiry", "30"))
+    standby = tcollector.TelemetryCollector(
+        rules=alerts.load_rules(rules_path), eval_interval=0.1,
+        origin_expiry_s=30.0, store_dir=store_dir, standby=True)
+    addr_list = (f"{primary.host}:{primary.port},"
+                 f"{standby.host}:{standby.port}")
+    prev_addr = os.environ.get("PDTPU_TELEMETRY_ADDR")
+    os.environ["PDTPU_TELEMETRY_ADDR"] = addr_list
+    prev_origin = os.environ.pop("PDTPU_TELEMETRY_ORIGIN", None)
+
+    # the synthetic alert source + numbered zero-loss event stream,
+    # shipping on the same failover list as the fleet
+    sig_journal = RunJournal()
+    sig_reg = MetricsRegistry()
+    sig_reg.gauge("paddle_tpu_serving_breaker_open", "h").set(1)
+    sig = tshipper.Shipper(addr_list, origin="drillsig",
+                           journal=sig_journal, registry=sig_reg,
+                           flush_interval=0.1, client_timeout=1.0)
+    router = None
+    violations = []
+    ticks_sent = [0]
+    stop_ticks = threading.Event()
+
+    def tick_pump():
+        while not stop_ticks.is_set():
+            sig_journal.emit("drill.tick", i=ticks_sent[0])
+            ticks_sent[0] += 1
+            time.sleep(0.005)
+
+    def _http_alerts(url):
+        import urllib.request
+        with urllib.request.urlopen(url + "/alerts", timeout=5) as r:
+            return _json.loads(r.read())
+
+    ticker = threading.Thread(target=tick_pump)
+    try:
+        router = _spawn_remote_fleet(dirname, feed, replicas)
+        rate = _saturation_rate(router, feed)
+        ticker.start()
+        # barrier: the alert must be FIRING on the primary before the
+        # kill (the continuity contract needs pre-kill state to carry)
+        deadline = time.monotonic() + 30
+        fired = None
+        while time.monotonic() < deadline and fired is None:
+            snap = _http_alerts(primary.http_url)
+            fired = next((a for a in snap["firing"]
+                          if a["rule"] == "drill_breaker"), None)
+            if fired is None:
+                time.sleep(0.1)
+        if fired is None:
+            violations.append("drill_breaker never fired on the primary "
+                              "collector within 30s")
+            return violations
+        fired_since = fired["since"]
+
+        def kill_primary():
+            os.kill(primary.pid, _signal.SIGKILL)
+
+        pending, rejected = _drive(router, feed, requests, rate,
+                                   act_at=requests // 3, act=kill_primary)
+        outcomes, dropped = _collect(pending)
+        print(f"  collector_failover: accepted={len(pending)} "
+              f"shed={rejected} outcomes={outcomes}")
+        if dropped:
+            violations.append(f"dropped accepted request(s): {dropped[:3]}")
+
+        # the standby must promote (first failed-over push triggers the
+        # shared-log replay) and the pre-kill firing alert must be
+        # firing THERE with its original clock — no re-fire transition,
+        # no resolve flap
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and standby.is_standby:
+            time.sleep(0.1)
+        if standby.is_standby:
+            violations.append("standby never promoted within 20s of the "
+                              "primary SIGKILL")
+            return violations
+        deadline = time.monotonic() + 10
+        still = None
+        while time.monotonic() < deadline and still is None:
+            still = next((a for a in standby.engine.firing()
+                          if a["rule"] == "drill_breaker"), None)
+            if still is None:
+                time.sleep(0.1)
+        if still is None:
+            violations.append(
+                "drill_breaker not firing on the promoted standby "
+                f"(alerts={standby.alerts_json()['firing']})")
+        elif still["since"] != fired_since:
+            violations.append(
+                f"firing clock restarted across failover "
+                f"(since {fired_since} -> {still['since']})")
+        flaps = [e["kind"] for e in standby.journal.recent(kind="alert.")
+                 if e.get("key") == (still or {}).get("key")]
+        if flaps:
+            violations.append(f"alert transitions journaled on the "
+                              f"standby for the carried alert: {flaps} "
+                              "(must be none: restored, not re-fired)")
+
+        # zero shipped-event loss: stop the numbered stream, flush, and
+        # require every tick exactly once on the standby (pre-kill
+        # ticks via the replayed log, post-kill via failover, overlap
+        # deduped by the replayed high-water marks)
+        stop_ticks.set()
+        ticker.join(timeout=10)
+        sig.flush()
+        total = ticks_sent[0]
+        deadline = time.monotonic() + 10
+        seen = []
+        while time.monotonic() < deadline:
+            seen = [e["i"] for e in standby.journal.recent(kind="drill.")
+                    if e.get("origin") == "drillsig"]
+            if len(seen) >= total:
+                break
+            sig.flush()
+            time.sleep(0.2)
+        if seen != list(range(total)):
+            missing = sorted(set(range(total)) - set(seen))[:5]
+            extra = len(seen) - len(set(seen))
+            violations.append(
+                f"shipped-event loss across failover: {len(seen)}/{total} "
+                f"ticks on the standby (first missing {missing}, "
+                f"{extra} duplicate(s))")
+        c = sig.counters()
+        if c["failovers"] < 1:
+            violations.append("shipper recorded no failover "
+                              f"(counters={c})")
+        fams = {f.name: f for f in sig._families()}
+        flush_outcomes = {labels["outcome"]: v for labels, v in
+                          fams["paddle_tpu_shipper_flushes_total"].samples}
+        if flush_outcomes.get("failover", 0) < 1:
+            violations.append("flushes_total{outcome=failover} did not "
+                              f"record the failover ({flush_outcomes})")
+        print(f"  collector_failover: ticks={total} failovers="
+              f"{c['failovers']} alert_carried={still is not None}")
+    finally:
+        stop_ticks.set()
+        if ticker.is_alive():
+            ticker.join(timeout=5)
+        if prev_addr is None:
+            os.environ.pop("PDTPU_TELEMETRY_ADDR", None)
+        else:
+            os.environ["PDTPU_TELEMETRY_ADDR"] = prev_addr
+        if prev_origin is not None:
+            os.environ["PDTPU_TELEMETRY_ORIGIN"] = prev_origin
+        if router is not None:
+            router.close(drain=False, timeout=10)
+        tshipper.stop_shipping()
+        sig.close(timeout=5)
+        standby.close()
+        primary.kill()
+    return violations
+
+
 DRILLS = {"kill": drill_kill, "hang": drill_hang, "reload": drill_reload,
           "pkill": drill_pkill, "partition": drill_partition,
-          "alert": drill_alert}
+          "alert": drill_alert,
+          "collector_failover": drill_collector_failover}
 
 
 def main(argv=None) -> int:
@@ -622,9 +813,10 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=90)
     ap.add_argument("--drills", default="kill,hang,reload",
                     help="comma list from: kill,hang,reload,pkill,"
-                         "partition,alert (the last three spawn a real "
-                         "cross-process fleet; alert also attaches a "
-                         "telemetry collector); 'all' runs every drill")
+                         "partition,alert,collector_failover (the last "
+                         "four spawn a real cross-process fleet; alert/"
+                         "collector_failover also attach telemetry "
+                         "collectors); 'all' runs every drill")
     args = ap.parse_args(argv)
     names = [n.strip() for n in args.drills.split(",") if n.strip()]
     if names == ["all"]:

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Offline inspector for a collector's on-disk series store
+(``telemetry/store.py`` segment logs) — the post-mortem reader that
+needs no live collector:
+
+    python tools/series_dump.py STORE_DIR --list
+    python tools/series_dump.py STORE_DIR --metric paddle_tpu_serving_queue_depth
+    python tools/series_dump.py STORE_DIR --metric M --labels origin=r0 \\
+        --from 1700000000 --to 1700003600 --step 60 --format csv
+    python tools/series_dump.py STORE_DIR --validate
+
+``--list`` prints every distinct series in the retained log (type,
+sample count, time span). ``--metric`` dumps one metric's points —
+optionally label-filtered (``k=v,k2=v2`` superset match), range-bounded
+(``--from``/``--to``, unix seconds), and downsampled
+(``--step`` seconds, last-sample-per-bucket) — as JSON (the
+``GET /query`` response shape) or CSV (``key,t,value`` rows).
+``--validate`` is the CRC sweep: sealed segments against their atomic
+sidecars, then every record's frame — a torn tail, a bit-flipped byte,
+or a missing sidecar is a named finding.
+
+Exit status (the lint_gate/flight_dump contract): **0** clean output;
+**2** findings — a torn/corrupt segment under ``--validate``, or
+nothing to dump (no store, no matching series/span); **3** the tool
+itself crashed (never a verdict).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import traceback
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+EXIT_CLEAN, EXIT_FINDINGS, EXIT_INTERNAL = 0, 2, 3
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/series_dump.py",
+        description="offline reader/validator for a collector's on-disk "
+                    "series store")
+    ap.add_argument("store", help="the collector's --store-dir")
+    ap.add_argument("--list", action="store_true",
+                    help="list every series in the retained log")
+    ap.add_argument("--metric", default="",
+                    help="dump one metric's points")
+    ap.add_argument("--labels", default="",
+                    help="label filter: k=v,k2=v2 (superset match)")
+    ap.add_argument("--from", dest="start", type=float, default=0.0,
+                    help="range start (unix seconds; default 0)")
+    ap.add_argument("--to", dest="end", type=float, default=None,
+                    help="range end (unix seconds; default now)")
+    ap.add_argument("--step", type=float, default=0.0,
+                    help="downsample bucket seconds (0 = raw points)")
+    ap.add_argument("--format", choices=("json", "csv"), default="json")
+    ap.add_argument("--validate", action="store_true",
+                    help="CRC sweep of every segment (sidecars + "
+                         "record frames)")
+    args = ap.parse_args(argv)
+
+    if sum(bool(x) for x in (args.list, args.metric, args.validate)) != 1:
+        ap.error("pass exactly one of: --list, --metric, --validate")
+
+    try:
+        # the live /query endpoint's matcher parser, not a copy — the
+        # offline tool and the collector must accept identical syntax
+        from paddle_tpu.telemetry.alerts import _parse_labels
+        from paddle_tpu.telemetry.store import SegmentStore
+
+        if not os.path.isdir(args.store):
+            print(f"series_dump: {args.store} is not a directory",
+                  file=sys.stderr)
+            return EXIT_FINDINGS
+        store = SegmentStore(args.store)
+        if not store.segment_paths():
+            print(f"series_dump: no segments under {args.store} (not a "
+                  "store dir, or retention emptied it)", file=sys.stderr)
+            return EXIT_FINDINGS
+
+        if args.validate:
+            findings = store.validate()
+            if findings:
+                print(f"series_dump: {len(findings)} finding(s) in "
+                      f"{args.store}:")
+                for f in findings:
+                    print(f"  {f}")
+                return EXIT_FINDINGS
+            n = len(store.segment_paths())
+            print(f"series_dump clean: {n} segment(s) under {args.store}")
+            return EXIT_CLEAN
+
+        if args.list:
+            series = store.list_series()
+            if not series:
+                print("series_dump: no series in the retained log",
+                      file=sys.stderr)
+                return EXIT_FINDINGS
+            for s in series:
+                span = ""
+                if s["first_t"] is not None:
+                    span = (f"  [{s['first_t']:.3f} .. "
+                            f"{s['last_t']:.3f}]")
+                print(f"{s['key']}  ({s['type']}, {s['samples']} "
+                      f"sample(s)){span}")
+            return EXIT_CLEAN
+
+        try:
+            labels = _parse_labels(args.labels)
+        except ValueError as e:
+            print(f"series_dump: {e}", file=sys.stderr)
+            return EXIT_FINDINGS
+        doc = store.query(args.metric, labels, start=args.start,
+                          end=args.end, step=args.step)
+        if not doc["series"]:
+            print(f"series_dump: no samples for {args.metric!r} "
+                  f"(labels={labels or '{}'}) in range", file=sys.stderr)
+            return EXIT_FINDINGS
+        if args.format == "csv":
+            print("key,t,value")
+            for s in doc["series"]:
+                for t, v in s["points"]:
+                    print(f'"{s["key"]}",{t!r},{v!r}')
+        else:
+            print(json.dumps(doc, indent=1, sort_keys=True))
+        return EXIT_CLEAN
+    except SystemExit:
+        raise
+    except BaseException:
+        traceback.print_exc()
+        print("series_dump: internal error (exit 3) — the tool crashed; "
+              "this is NOT a store verdict", file=sys.stderr)
+        return EXIT_INTERNAL
+
+
+if __name__ == "__main__":
+    sys.exit(main())
