@@ -795,6 +795,129 @@ def bench_input_pipeline(peak, batch_size=256, iters=24, k=16):
     }
 
 
+def bench_device_cache(peak, batch_size=256, iters=24, k=16,
+                       link_delay_ms=None):
+    """Device-resident data path A/B (the ROADMAP "kill the host-link
+    bottleneck" gate): the MNIST MLP config with a uint8 wire feed,
+    measured three ways —
+
+    - ``streamed``: every epoch crosses the link (DeviceFeeder, K-chunk
+      stacking — the PR 4 baseline);
+    - ``cached``: epoch 1 streams AND admits into the HBM dataset
+      cache, the measured epoch serves device-to-device (zero h2d wire
+      bytes, pinned in the row);
+    - ``compute_only``: pre-staged feeds (the ceiling).
+
+    ``value`` is cached-epoch throughput as a fraction of compute-only
+    — the acceptance gate is ≥ 0.9× for any dataset that fits residual
+    HBM. ``overlap_vs_blocking`` drives the same pipeline through a
+    ``testing.faults.slow_h2d`` throttled link (delay auto-sized to
+    dominate the chunk compute unless ``link_delay_ms`` pins it) with
+    the 2-deep staging ring vs the blocking put — the ring pipelines
+    two in-flight transfers and keeps host work off the critical path,
+    so the delta is ~2x on a latency-dominated link."""
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.data.device_cache import DeviceCache
+    from paddle_tpu.data.feeder import DeviceFeeder, stack_batches
+    from paddle_tpu.data.wire import WireSpec
+    from paddle_tpu.models import mnist
+    from paddle_tpu.testing import faults
+
+    iters = max(k, iters // k * k)  # whole chunks at K
+    rng = np.random.RandomState(0)
+    feeds = [{"image": rng.randint(0, 256, (batch_size, 784)).astype(np.uint8),
+              "label": rng.randint(0, 10, (batch_size, 1)).astype(np.int64)}
+             for _ in range(4)]
+
+    tr = pt.Trainer(pt.build(mnist.mlp), opt.SGD(0.01), loss_name="loss",
+                    fetch_list=["loss"],
+                    feed_wire={"image": WireSpec.image_uint8()})
+    tr.startup(sample_feed=feeds[0])
+    metrics = tr.pipeline_metrics
+
+    def gen():
+        for i in range(iters):
+            yield feeds[i % len(feeds)]
+
+    def stream_epoch(cache=None, wait_fn=None, overlap_depth=2):
+        feeder = DeviceFeeder(
+            gen, put_fn=tr._put_feed, capacity=2, stack_k=k,
+            put_stacked_fn=lambda d: tr._put_feed(d, stacked=True),
+            wait_fn=wait_fn, overlap_depth=overlap_depth)
+        t0 = time.perf_counter()
+        for n, feed in feeder:
+            out = tr.run_steps(feed, k=n) if n > 1 else tr.step(feed)
+            if cache is not None:
+                cache.offer(n, feed)
+        _sync(out)
+        return (time.perf_counter() - t0) / iters
+
+    def cached_epoch(cache):
+        t0 = time.perf_counter()
+        for n, feed in cache.chunks(metrics=metrics):
+            out = tr.run_steps(feed, k=n)
+        _sync(out)
+        return (time.perf_counter() - t0) / iters
+
+    # warmup compiles both step programs
+    stream_epoch()
+
+    # compute-only ceiling: pre-staged, alternating super-batches
+    staged = [tr._put_feed(stack_batches([feeds[j % len(feeds)]
+                                          for j in range(i, i + k)]),
+                           stacked=True) for i in range(2)]
+    out = tr.run_steps(staged[0], k=k)
+    _sync(out)
+    t0 = time.perf_counter()
+    for i in range(iters // k):
+        out = tr.run_steps(staged[i % 2], k=k)
+    _sync(out)
+    dt_comp = (time.perf_counter() - t0) / iters
+
+    dt_streamed = min(stream_epoch() for _ in range(2))
+
+    # cache admission epoch (CPU has no HBM budget to estimate against:
+    # the row states an explicit one, sized to hold the whole dataset)
+    cache = DeviceCache(budget_bytes=1 << 32, trainer=tr)
+    h2d0 = metrics.h2d_bytes
+    stream_epoch(cache=cache)
+    cache.seal(iters)
+    h2d_epoch1 = metrics.h2d_bytes - h2d0
+    h2d0 = metrics.h2d_bytes
+    dt_cached = min(cached_epoch(cache) for _ in range(2))
+    h2d_epoch2 = metrics.h2d_bytes - h2d0  # the zero-wire-bytes pin
+
+    # overlap A/B under a throttled link: delay sized so the simulated
+    # transfer dominates the chunk compute (the slow-link regime)
+    delay_ms = (float(link_delay_ms) if link_delay_ms
+                else max(2.5 * dt_comp * k * 1e3, 20.0))
+    wait = faults.slow_h2d(delay_ms)
+    dt_block = stream_epoch(wait_fn=wait, overlap_depth=1)
+    dt_overlap = stream_epoch(wait_fn=wait, overlap_depth=2)
+
+    return {
+        "value": round(dt_comp / dt_cached, 3),
+        "unit": "x of compute-only throughput (HBM-cached epoch 2+)",
+        "step_time_ms": {
+            "streamed": round(dt_streamed * 1e3, 4),
+            "cached": round(dt_cached * 1e3, 4),
+            "compute_only": round(dt_comp * 1e3, 4),
+        },
+        "cached_vs_streamed_x": round(dt_streamed / dt_cached, 3),
+        "h2d_bytes_epoch1": int(h2d_epoch1),
+        "h2d_bytes_epoch2": int(h2d_epoch2),
+        "overlap_vs_blocking": {
+            "blocking_step_ms": round(dt_block * 1e3, 4),
+            "overlap_step_ms": round(dt_overlap * 1e3, 4),
+            "speedup_x": round(dt_block / dt_overlap, 3),
+            "link_delay_ms": round(delay_ms, 3),
+        },
+        "cache": cache.report(),
+        "steps_per_dispatch": k,
+    }
+
+
 def bench_elastic_reshard(peak, batch_size=64, iters=3, n_from=4, n_to=2):
     """Elastic-reshard suite row: wall time + bytes re-placed of a
     checkpoint restore ACROSS a dp N→M mesh change
@@ -1496,7 +1619,7 @@ def _suite_names():
 
     names = [*TRAIN_CONFIGS, *INFER_CONFIGS, "gpt_decode",
              "dispatch_overhead", "guard_overhead", "input_pipeline",
-             "serving", "serving_fleet", "fusion_profile",
+             "device_cache", "serving", "serving_fleet", "fusion_profile",
              "elastic_reshard"]
     # the BASELINE five first, then the reference's headline serving
     # rows, then gpt — a driver that kills the suite early (the partial
@@ -1555,6 +1678,10 @@ def _run_one(name: str, peak: float, quick: bool = False, batch_size=None):
         if quick:
             kw.update(iters=8, k=4)
         return bench_input_pipeline(peak, **kw)
+    if name == "device_cache":
+        if quick:
+            kw.update(iters=8, k=4, link_delay_ms=20.0)
+        return bench_device_cache(peak, **kw)
     if name == "serving":
         if quick:
             kw.update(requests=40)

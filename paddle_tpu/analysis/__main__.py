@@ -101,6 +101,17 @@ def main(argv=None) -> int:
                     help="lint this pipeline schedule shape "
                          "(pipeline:* family) against --batch / --mesh")
     ap.add_argument("--pp-interleave", type=int, default=1)
+    ap.add_argument("--num-epochs", type=int, default=0,
+                    help="fit epochs the program will run (arms the "
+                         "feed:cacheable-dataset rule with "
+                         "--dataset-batches/--cache-budget-mb)")
+    ap.add_argument("--dataset-batches", type=int, default=0,
+                    help="batches per epoch, for the dataset's wire-byte "
+                         "total")
+    ap.add_argument("--cache-budget-mb", type=float, default=0.0,
+                    help="residual-HBM budget for the device dataset "
+                         "cache, in MB (explicit here — the CLI has no "
+                         "live trainer to estimate the step's appetite)")
     ap.add_argument("--fail-on", default="warning",
                     choices=("info", "warning", "error"),
                     help="exit 1 when findings at/above this severity exist")
@@ -158,7 +169,11 @@ def main(argv=None) -> int:
                   or None)
         report = check(program, feed, mesh=mesh, rules=rules,
                        strategy=strategy, amp=args.amp or None,
-                       loss_name=args.loss_name, select=select)
+                       loss_name=args.loss_name, select=select,
+                       num_epochs=args.num_epochs or None,
+                       dataset_batches=args.dataset_batches or None,
+                       cache_budget_bytes=(int(args.cache_budget_mb * 1e6)
+                                           if args.cache_budget_mb else None))
         apply_severity(report, overrides)
 
         if args.write_baseline:

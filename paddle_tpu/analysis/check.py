@@ -63,6 +63,10 @@ def check(
     large_param_bytes: int = 1 << 20,
     select: Optional[set] = None,
     feed_wire=None,
+    num_epochs: Optional[int] = None,
+    dataset_batches: Optional[int] = None,
+    cache_budget_bytes: Optional[int] = None,
+    device_cache: bool = False,
 ) -> LintReport:
     """Statically lint ``program``. ``sample_feed`` supplies example
     inputs (arrays or ShapeDtypeStructs) keyed by the program fn's arg
@@ -76,7 +80,16 @@ def check(
     ``feed_wire`` (a ``FeedWire`` or ``{name: WireSpec}``) maps a
     wire-typed sample feed to its logical dtypes for the trace and
     keeps the ``feed:wire-candidate`` rule from re-suggesting fields
-    already carried in a wire format."""
+    already carried in a wire format.
+
+    ``num_epochs`` + ``dataset_batches`` + ``cache_budget_bytes``
+    describe the fit the program will run under and arm the
+    ``feed:cacheable-dataset`` rule: a multi-epoch run whose encoded
+    dataset fits the residual-HBM budget but streams every epoch
+    (``device_cache=False``) is flagged. At this (program-level) door
+    the residual budget is EXPLICIT — there is no live trainer to
+    estimate the step's appetite from; ``check_trainer`` computes it
+    from the advisor."""
     from ..framework import amp_guard
     import contextlib
 
@@ -145,6 +158,12 @@ def check(
             wired = set(feed_wire.specs) if feed_wire is not None else set()
             _rules.check_feed_wire(closed, invar_names, report,
                                    already_wired=wired)
+    if fam("feed"):
+        # multi-epoch streaming of a dataset that would fit residual
+        # HBM: needs no jaxpr, only the sample batch's wire byte math
+        _rules.check_cacheable_dataset(
+            sample_feed, feed_wire, num_epochs, dataset_batches,
+            cache_budget_bytes, report, cache_enabled=bool(device_cache))
     if fam("moe"):
         _rules.check_moe_capacity(moe_configs, report)
     if fam("sharding"):
@@ -180,6 +199,13 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
       all-reduces inside while bodies are caught directly instead of
       inferred from config. OFF by default (it compiles the step a
       second time); enable with ``hlo=True`` or ``select={"hlo",...}``.
+
+    Pass ``num_epochs=`` + ``dataset_batches=`` (the fit shape this
+    trainer will run under) to arm ``feed:cacheable-dataset``: a
+    multi-epoch run whose encoded dataset fits the advisor's
+    residual-HBM estimate but streams every epoch with the device
+    cache off is flagged (``device_cache=True|False`` overrides the
+    trainer-attribute detection).
     """
     enforce(trainer._step_fn is not None,
             "check_trainer: call Trainer.startup() first (the lint walks "
@@ -189,6 +215,11 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
     hbm_budget_bytes = kwargs.pop("hbm_budget_bytes", None)
     replicated_optstate_bytes = kwargs.pop("replicated_optstate_bytes",
                                            64 << 20)
+    # feed:cacheable-dataset inputs: the fit shape this trainer will
+    # run under (unknown to startup-time lint unless the caller says)
+    num_epochs = kwargs.pop("num_epochs", None)
+    dataset_batches = kwargs.pop("dataset_batches", None)
+    device_cache_on = kwargs.pop("device_cache", None)
     amp = kwargs.get("amp")
     want_coll = select is None or "collective" in select
     want_donation = select is None or "donation" in select
@@ -231,6 +262,28 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
     if want_coll or want_donation or step_dtype:
         _check_step_jaxpr(trainer, sample_feed, report, rules, amp,
                           want_coll, want_donation, step_dtype, kwargs)
+    # feed:cacheable-dataset at the trainer door: the residual budget
+    # comes from the advisor (device budget or hbm_budget_bytes minus
+    # the step's estimated appetite) — the program-level door takes it
+    # explicitly instead
+    if (select is None or "feed" in select) and sample_feed is not None \
+            and num_epochs and dataset_batches:
+        try:
+            from ..data.device_cache import residual_hbm_bytes
+            residual = residual_hbm_bytes(
+                trainer, sample_feed, hbm_budget_bytes=hbm_budget_bytes)
+            cache_on = (device_cache_on
+                        if device_cache_on is not None
+                        else getattr(trainer, "device_cache", None)
+                        is not None)
+            _rules.check_cacheable_dataset(
+                sample_feed, getattr(trainer, "feed_wire", None),
+                num_epochs, dataset_batches, residual, report,
+                cache_enabled=bool(cache_on))
+        except Exception as e:
+            report.add("feed:cacheable-dataset-failed", "info",
+                       f"could not estimate the residual-HBM cache "
+                       f"budget ({type(e).__name__}: {e})")
     # families that reach PAST the jaxpr — both need a sample feed to
     # trace/compile with, and both degrade to a finding on failure (the
     # lint surface must never crash the startup path it guards)

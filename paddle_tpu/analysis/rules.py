@@ -712,6 +712,45 @@ def check_feed_wire(closed_flat, invar_names, report: LintReport,
             first_uses=sorted({e.primitive.name for e in consumers}))
 
 
+def check_cacheable_dataset(sample_feed, feed_wire, num_epochs,
+                            dataset_batches, residual_hbm_bytes,
+                            report: LintReport,
+                            cache_enabled: bool = False) -> None:
+    """``feed:cacheable-dataset`` — a multi-epoch ``fit`` whose
+    dataset's ENCODED wire bytes (``dataset_batches`` ×
+    ``feed_wire_nbytes`` of the sample batch) fit the residual-HBM
+    estimate (device budget minus the advisor's params + opt state +
+    activations appetite), running with the device cache OFF: every
+    epoch after the first re-sends bytes the device could simply keep
+    (``fit(device_cache=True)``, data/device_cache.py). Advisory
+    severity, like ``feed:wire-candidate`` — the reader must be
+    epoch-stable for the cache to be sound, which only the caller
+    knows."""
+    if cache_enabled or not num_epochs or int(num_epochs) <= 1:
+        return
+    if not dataset_batches or residual_hbm_bytes is None \
+            or not sample_feed:
+        return
+    from ..data.wire import feed_wire_nbytes
+    per_batch = feed_wire_nbytes(sample_feed, feed_wire)
+    total = per_batch * int(dataset_batches)
+    if total <= 0 or total > int(residual_hbm_bytes):
+        return
+    report.add(
+        "feed:cacheable-dataset", "info",
+        f"{num_epochs}-epoch fit streams the full dataset "
+        f"({dataset_batches} batches × {per_batch / 1e6:.3f} MB wire = "
+        f"{total / 1e6:.1f} MB) across the host→device link EVERY "
+        f"epoch, but it fits the {residual_hbm_bytes / 1e6:.1f} MB "
+        "residual-HBM estimate — fit(device_cache=True) would keep the "
+        "encoded epoch on device and feed epoch 2+ device-to-device "
+        "with zero h2d bytes (requires an epoch-stable reader; see "
+        "MIGRATION.md \"Device-resident data path\")",
+        where="device_cache", dataset_wire_bytes=int(total),
+        residual_hbm_bytes=int(residual_hbm_bytes),
+        num_epochs=int(num_epochs), dataset_batches=int(dataset_batches))
+
+
 # --------------------------------------------------------------------------
 # 10. MoE routing capacity
 # --------------------------------------------------------------------------

@@ -2,7 +2,9 @@
 python/paddle/reader/, python/paddle/dataset/, fluid data_feeder.py,
 operators/reader/*)."""
 
-from . import datasets, feeder, image, reader, wire
+from . import augment, datasets, device_cache, feeder, image, reader, wire
+from .augment import AugmentSpec, FeedAugment
+from .device_cache import DeviceCache
 from .feeder import DataFeeder, DeviceFeeder, PipelineMetrics
 from .reader import (Fake, PipeReader, batch, buffered, cache, chain, compose,
                      fake, firstn, map_readers, multiprocess_reader, shuffle,
@@ -10,9 +12,9 @@ from .reader import (Fake, PipeReader, batch, buffered, cache, chain, compose,
 from .wire import FeedWire, WireSpec
 
 __all__ = [
-    "datasets", "feeder", "reader", "wire",
+    "augment", "datasets", "device_cache", "feeder", "reader", "wire",
     "DataFeeder", "DeviceFeeder", "PipelineMetrics",
-    "FeedWire", "WireSpec",
+    "FeedWire", "WireSpec", "AugmentSpec", "FeedAugment", "DeviceCache",
     "batch", "buffered", "cache", "chain", "compose", "firstn",
     "map_readers", "shuffle", "xmap_readers",
 ]

@@ -1,0 +1,249 @@
+"""On-device data augmentation, traced into the step program.
+
+The wire formats (:mod:`.wire`) moved the decode/normalize onto the
+device; this module moves the AUGMENTATION there too, so the link (or
+the HBM dataset cache, :mod:`.device_cache`) carries raw uint8 exactly
+once and crop/flip/normalize run as elementwise/gather ops that XLA
+fuses into the first consumers of the feed ("Operator Fusion in XLA",
+PAPERS.md) — no host-side per-epoch re-augmentation, no second copy of
+the dataset in augmented form.
+
+An :class:`AugmentSpec` is an ordered pipeline of ops for one feed
+field::
+
+    aug = {"image": AugmentSpec()
+               .random_crop(padding=4, axes=(1, 2))
+               .random_flip(axis=2)
+               .normalize(mean=127.0, std=64.0)}
+    trainer = pt.Trainer(program, opt, augment=aug)
+
+applied INSIDE the compiled step right after the wire decode:
+
+- ``normalize(mean, std)`` — deterministic ``(x - mean) / std`` (cast
+  to the decode dtype first), applied in train AND eval;
+- ``random_flip(axis, p)`` — per-SAMPLE coin flip along ``axis``
+  (train only);
+- ``random_crop(padding, axes)`` — zero-pad ``padding`` on each side
+  of the spatial ``axes`` then crop back to the original shape at a
+  per-sample random offset (train only; shapes are static so the step
+  never retraces).
+
+**Randomness discipline** (the fused-equals-sequential contract): the
+per-step key is the step's own rng — ``fold_in(base, global_step+i)``
+inside ``run_steps``'s scan, the SAME stream ``step()`` draws — salted
+per field and per op. K fused steps therefore augment exactly like K
+sequential steps (pinned in tests/test_device_cache.py), and a resumed
+run reproduces the uninterrupted augmentation stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core.dtypes import convert_dtype
+from ..core.errors import enforce
+
+# rng salt separating the augmentation stream from the model's own use
+# of the step rng (dropout folds/splits the same key)
+_AUG_SALT = 0x41554730
+
+_KINDS = ("normalize", "random_flip", "random_crop")
+
+
+@dataclasses.dataclass(frozen=True)
+class _Op:
+    kind: str
+    params: Tuple[Tuple[str, Any], ...]
+
+    def get(self, name):
+        return dict(self.params)[name]
+
+
+class AugmentSpec:
+    """Ordered on-device augmentation pipeline for one feed field.
+    Builder methods return a NEW spec (value semantics, like WireSpec),
+    so a spec can be shared and extended safely."""
+
+    def __init__(self, ops: Tuple[_Op, ...] = ()):
+        self.ops = tuple(ops)
+
+    def _with(self, op: _Op) -> "AugmentSpec":
+        return AugmentSpec(self.ops + (op,))
+
+    # -- builders ------------------------------------------------------------
+    def normalize(self, mean: float = 0.0, std: float = 1.0,
+                  dtype: str = "float32") -> "AugmentSpec":
+        enforce(float(std) != 0.0, "AugmentSpec.normalize: std must be != 0")
+        dt = np.dtype(convert_dtype(dtype))
+        enforce(np.issubdtype(dt, np.floating),
+                f"AugmentSpec.normalize: dtype {dtype!r} must be floating")
+        return self._with(_Op("normalize", (("mean", float(mean)),
+                                            ("std", float(std)),
+                                            ("dtype", str(dt)))))
+
+    def random_flip(self, axis: int = -2, p: float = 0.5) -> "AugmentSpec":
+        enforce(axis != 0, "AugmentSpec.random_flip: axis 0 is the batch "
+                           "dim — flipping it would shuffle samples")
+        enforce(0.0 < float(p) <= 1.0,
+                f"AugmentSpec.random_flip: p must be in (0, 1], got {p}")
+        return self._with(_Op("random_flip", (("axis", int(axis)),
+                                              ("p", float(p)))))
+
+    def random_crop(self, padding: int,
+                    axes: Tuple[int, ...] = (1, 2)) -> "AugmentSpec":
+        enforce(int(padding) > 0,
+                f"AugmentSpec.random_crop: padding must be > 0, got {padding}")
+        axes = tuple(int(a) for a in axes)
+        enforce(axes and all(a > 0 for a in axes),
+                "AugmentSpec.random_crop: axes are positive batch-relative "
+                "dims (the batch dim 0 cannot be cropped)")
+        return self._with(_Op("random_crop", (("padding", int(padding)),
+                                              ("axes", axes))))
+
+    # -- properties ----------------------------------------------------------
+    @property
+    def has_random(self) -> bool:
+        return any(op.kind != "normalize" for op in self.ops)
+
+    def logical_dtype(self, dtype) -> np.dtype:
+        """The dtype this field holds AFTER augmentation: a normalize
+        casts integer input to its float dtype (so ``Program.init``
+        sees the model-facing dtype, the ``FeedWire.logical_feed``
+        analog)."""
+        dt = np.dtype(dtype)
+        for op in self.ops:
+            if op.kind == "normalize":
+                dt = np.dtype(op.get("dtype"))
+        return dt
+
+    # -- traced apply --------------------------------------------------------
+    def apply(self, x, key, training: bool):
+        """Run the pipeline on a per-step ``(batch, ...)`` device array
+        inside the traced step (the fused K-step scan slices its K axis
+        before the step body runs, so dim 0 is always the batch here).
+        ``key`` is the per-step rng (required when ``training`` and the
+        spec has random ops); eval applies only the deterministic
+        ops."""
+        import jax
+        import jax.numpy as jnp
+
+        enforce(not (training and self.has_random and key is None),
+                "AugmentSpec.apply: random ops need the step rng")
+        for i, op in enumerate(self.ops):
+            if op.kind == "normalize":
+                dt = np.dtype(op.get("dtype"))
+                x = (x.astype(dt) - op.get("mean")) / op.get("std")
+                continue
+            if not training:
+                continue
+            k = jax.random.fold_in(key, _AUG_SALT + i)
+            if op.kind == "random_flip":
+                axis = op.get("axis") % x.ndim
+                enforce(axis != 0, "random_flip resolved to the batch dim")
+                coin = jax.random.bernoulli(k, op.get("p"), (x.shape[0],))
+                mask = coin.reshape((-1,) + (1,) * (x.ndim - 1))
+                x = jnp.where(mask, jnp.flip(x, axis=axis), x)
+            elif op.kind == "random_crop":
+                pad, axes = op.get("padding"), op.get("axes")
+                enforce(max(axes) < x.ndim,
+                        f"random_crop axes {axes} out of range for a "
+                        f"rank-{x.ndim} feed")
+                widths = [(0, 0)] * x.ndim
+                for a in axes:
+                    widths[a] = (pad, pad)
+                padded = jnp.pad(x, widths)
+                offs = jax.random.randint(k, (x.shape[0], len(axes)),
+                                          0, 2 * pad + 1)
+                out_shape = x.shape[1:]
+
+                def crop_one(img, off):
+                    starts = [jnp.zeros((), jnp.int32)] * img.ndim
+                    for j, a in enumerate(axes):
+                        starts[a - 1] = off[j]
+                    return jax.lax.dynamic_slice(img, starts, out_shape)
+
+                x = jax.vmap(crop_one)(padded, offs)
+        return x
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, AugmentSpec) and self.ops == other.ops
+
+    def __hash__(self):
+        return hash(self.ops)
+
+    def __repr__(self):
+        return f"AugmentSpec({[op.kind for op in self.ops]})"
+
+
+class FeedAugment:
+    """A per-field table of :class:`AugmentSpec`s for one feed dict —
+    the :class:`~paddle_tpu.data.wire.FeedWire` shape, applied on
+    device right after the wire decode inside the step program."""
+
+    def __init__(self, specs: Dict[str, AugmentSpec]):
+        for name, spec in specs.items():
+            enforce(isinstance(spec, AugmentSpec),
+                    f"FeedAugment: field {name!r} maps to "
+                    f"{type(spec).__name__}, expected an AugmentSpec")
+        self.specs = dict(specs)
+
+    @classmethod
+    def make(cls, obj) -> Optional["FeedAugment"]:
+        """Normalize ``None`` | ``FeedAugment`` | ``{name:
+        AugmentSpec}``."""
+        if obj is None or isinstance(obj, FeedAugment):
+            return obj
+        enforce(isinstance(obj, dict),
+                f"augment: expected a FeedAugment or a dict of "
+                f"AugmentSpec, got {type(obj).__name__}")
+        return cls(obj)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, FeedAugment) and self.specs == other.specs
+
+    def __repr__(self) -> str:
+        return f"FeedAugment({self.specs!r})"
+
+    def apply(self, feed: Dict[str, Any], rng, training: bool
+              ) -> Dict[str, Any]:
+        """Augment every spec'd field (traced into the step — fused by
+        XLA into the feed's first consumers). Field keys are salted off
+        the step rng by a stable hash of the FIELD NAME — never by
+        table position — so adding or removing a field cannot perturb
+        another field's augmentation stream (extending the table on a
+        resumed run keeps existing fields reproducible)."""
+        import jax
+        import zlib
+
+        out = dict(feed)
+        for name in sorted(self.specs):
+            if name not in out:
+                continue
+            salt = zlib.crc32(name.encode("utf-8")) & 0x7FFFFFFF
+            key = (jax.random.fold_in(rng, _AUG_SALT ^ salt)
+                   if rng is not None else None)
+            out[name] = self.specs[name].apply(out[name], key, training)
+        return out
+
+    def logical_feed(self, feed: Dict[str, Any]) -> Dict[str, Any]:
+        """Map a sample feed to post-augmentation avals for
+        ``Program.init`` (the ``FeedWire.logical_feed`` analog): a
+        normalize op means the model sees float, same shape — crops and
+        flips preserve shape by construction."""
+        import jax
+
+        out = {}
+        for k, v in feed.items():
+            spec = self.specs.get(k)
+            if spec is None:
+                out[k] = v
+                continue
+            shape = tuple(getattr(v, "shape", np.shape(v)))
+            dtype = np.dtype(getattr(v, "dtype", np.asarray(v).dtype))
+            ldt = spec.logical_dtype(dtype)
+            out[k] = (jax.ShapeDtypeStruct(shape, ldt)
+                      if ldt != dtype else v)
+        return out

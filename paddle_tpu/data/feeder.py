@@ -52,7 +52,22 @@ class PipelineMetrics:
     thread waited for a batch (the input-bound signal). ``h2d_bytes``
     counts WIRE bytes (what actually crossed the link);
     ``encode_saved_bytes`` accumulates logical-minus-wire so the report
-    can state the reduction honestly."""
+    can state the reduction honestly.
+
+    Two overlap-era attributions (PR 15):
+
+    - ``overlap_hidden_s`` — transfer seconds that ran CONCURRENTLY
+      with host work / the consumer's dispatches under the
+      :class:`_StagingRing` (the h2d stage keeps the full
+      submit→complete transfer wall, so ``h2d_mbps`` still measures
+      the link; hidden vs exposed says how much of it the pipeline
+      actually waited for);
+    - ``cache_hit_bytes`` / ``cache_hits`` — chunks served
+      device-to-device from the HBM dataset cache
+      (:class:`~paddle_tpu.data.device_cache.DeviceCache`). Cache hits
+      touch neither ``h2d_bytes`` nor the h2d clock, so ``h2d_mbps``
+      stays an honest LINK estimate that excludes cache-served chunks
+      (they would otherwise report an infinite link)."""
 
     _STAGES = ("reader", "encode", "stack", "h2d", "dispatch")
 
@@ -68,6 +83,9 @@ class PipelineMetrics:
             self.consumer_starved_s = 0.0
             self.batches = 0
             self.chunks = 0
+            self.overlap_hidden_s = 0.0
+            self.cache_hit_bytes = 0
+            self.cache_hits = 0
 
     def add(self, stage: str, seconds: float):
         with self._lock:
@@ -79,11 +97,28 @@ class PipelineMetrics:
             self.stage_s["encode"] += seconds
             self.encode_saved_bytes += max(0, logical_nbytes - wire_nbytes)
 
-    def record_h2d(self, nbytes: int, seconds: float):
+    def record_h2d(self, nbytes: int, seconds: float,
+                   exposed_s: Optional[float] = None):
+        """One completed transfer: ``seconds`` is the submit→complete
+        wall. ``exposed_s`` (staging-ring path) is how long the fill
+        thread actually stalled for it — the rest ran hidden under
+        other work and accumulates as ``overlap_hidden_s``. ``None``
+        (the blocking put / direct-step paths) means fully exposed."""
         with self._lock:
             self.stage_s["h2d"] += seconds
+            if exposed_s is not None:
+                self.overlap_hidden_s += max(0.0, seconds - exposed_s)
             self.h2d_bytes += nbytes
             self.chunks += 1
+
+    def record_cache_hit(self, nbytes: int):
+        """A chunk served device-to-device from the HBM dataset cache:
+        ``nbytes`` of wire data did NOT cross the link. Deliberately
+        touches neither ``h2d_bytes`` nor the h2d clock — see the class
+        docstring's honesty note on ``h2d_mbps``."""
+        with self._lock:
+            self.cache_hit_bytes += nbytes
+            self.cache_hits += 1
 
     def record_batch(self, reader_seconds: float):
         with self._lock:
@@ -106,6 +141,8 @@ class PipelineMetrics:
             h2d_bytes, saved = self.h2d_bytes, self.encode_saved_bytes
             starved = self.consumer_starved_s
             batches, chunks = self.batches, self.chunks
+            hidden = self.overlap_hidden_s
+            cache_b, cache_n = self.cache_hit_bytes, self.cache_hits
         labels = {"inst": inst}
         return [
             counter_family(
@@ -132,20 +169,40 @@ class PipelineMetrics:
                 "paddle_tpu_feeder_consumer_starved_seconds_total",
                 "Training-loop seconds spent waiting for input",
                 [(labels, round(starved, 6))]),
+            counter_family(
+                "paddle_tpu_feeder_overlap_hidden_seconds_total",
+                "Transfer seconds hidden under host work / compute by "
+                "the double-buffered staging ring",
+                [(labels, round(hidden, 6))]),
+            counter_family(
+                "paddle_tpu_feeder_cache_hit_bytes_total",
+                "Wire bytes served device-to-device from the HBM "
+                "dataset cache (never crossed the host link)",
+                [(labels, cache_b)]),
+            counter_family(
+                "paddle_tpu_feeder_cache_hits_total",
+                "Chunks served from the HBM dataset cache",
+                [(labels, cache_n)]),
         ]
 
     def report(self) -> Dict[str, Any]:
         """Per-stage attribution + an effective-link estimate:
-        ``h2d_mbps`` is wire bytes over time spent in the put,
-        ``bottleneck`` names the stage with the most accumulated time,
-        and ``input_bound`` says whether the training loop starved for
-        data more than the fill thread waited on it."""
+        ``h2d_mbps`` is wire bytes over transfer wall time — an honest
+        LINK estimate that excludes cache-served chunks (they add
+        neither bytes nor h2d seconds); ``overlap_hidden_s`` /
+        ``h2d_exposed_s`` split the transfer wall into the part the
+        staging ring hid under other work vs the part the pipeline
+        stalled for; ``bottleneck`` names the stage with the most
+        accumulated time, and ``input_bound`` says whether the training
+        loop starved for data more than the fill thread waited on it."""
         with self._lock:
             stages = dict(self.stage_s)
             h2d_bytes = self.h2d_bytes
             saved = self.encode_saved_bytes
             starved = self.consumer_starved_s
             batches, chunks = self.batches, self.chunks
+            hidden = self.overlap_hidden_s
+            cache_b, cache_n = self.cache_hit_bytes, self.cache_hits
         logical = h2d_bytes + saved
         h2d_s = stages["h2d"]
         return {
@@ -156,6 +213,10 @@ class PipelineMetrics:
                                if h2d_bytes else None),
             "h2d_mbps": (round(h2d_bytes / 1e6 / h2d_s, 2)
                          if h2d_s > 0 and h2d_bytes else None),
+            "overlap_hidden_s": round(hidden, 6),
+            "h2d_exposed_s": round(max(0.0, h2d_s - hidden), 6),
+            "cache_hit_bytes": int(cache_b),
+            "cache_hits": cache_n,
             "batches": batches,
             "chunks": chunks,
             "consumer_starved_s": round(starved, 6),
@@ -251,6 +312,123 @@ def iter_chunked(batches: Iterator[Dict[str, np.ndarray]], k: int,
         yield n, (put_stacked_fn(hb) if n > 1 else put_fn(hb))
 
 
+class _StagingRing:
+    """Depth-bounded asynchronous h2d staging — the device-side half of
+    the double_buffer analog. ``submit`` dispatches the put and returns
+    immediately, so the fill thread reads/encodes/stacks chunk N+1
+    while chunk N's transfer is still in flight; a waiter thread waits
+    each transfer to completion in submission order (the device-event
+    wait — ``jax.block_until_ready``, not a wall-clock of the submit)
+    and only then delivers the chunk downstream, so a consumer never
+    dispatches on a half-arrived batch and the recorded h2d time is the
+    transfer's true submit→complete wall.
+
+    At most ``depth`` transfers are in flight: the fill thread blocks
+    in ``submit`` only when the ring is full. That stall (plus the
+    submit call itself) is the EXPOSED transfer time; the rest of each
+    transfer ran hidden under host work and the consumer's dispatches
+    and accumulates as ``PipelineMetrics.overlap_hidden_s``.
+
+    Donation-safe by construction: staged buffers are feed arrays, and
+    the step programs never donate feeds — only the training carry
+    (params/opt_state/state/loss-scale) is donated, so a buffer parked
+    in the ring can never be aliased away under an in-flight transfer.
+
+    ``wait_fn(dev, t_submit)`` is the completion wait;
+    ``testing.faults.slow_h2d`` substitutes a throttled one to make a
+    slow host→device link deterministic in tests and bench."""
+
+    _END = object()
+
+    def __init__(self, depth: int, deliver: Callable, stop: threading.Event,
+                 metrics: Optional[PipelineMetrics] = None,
+                 wait_fn: Optional[Callable] = None, journal=None,
+                 on_error: Optional[Callable] = None):
+        self.depth = max(1, int(depth))
+        self._deliver = deliver      # (dev, n, span) -> bool (False: stop)
+        self._stop = stop
+        self._metrics = metrics
+        self._wait_fn = wait_fn or (
+            lambda dev, t_submit: jax.block_until_ready(dev))
+        self._journal = journal
+        self._on_error = on_error
+        self._sem = threading.Semaphore(self.depth)
+        self._q: _queue.Queue = _queue.Queue()
+        self._lock = threading.Lock()
+        self._stall_s = 0.0          # fill-thread seconds blocked here
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _take_stall(self) -> float:
+        with self._lock:
+            s, self._stall_s = self._stall_s, 0.0
+            return s
+
+    def submit(self, n: int, host_feed, putter: Callable) -> bool:
+        """Dispatch one chunk's put into the ring. Returns False when
+        the stop flag fired (the chunk was not submitted)."""
+        t_a = time.perf_counter()
+        while not self._sem.acquire(timeout=0.1):
+            if self._stop.is_set():
+                return False
+        stall = time.perf_counter() - t_a
+        span = self._journal.new_span() if self._journal is not None else None
+        nbytes = host_feed_nbytes(host_feed)
+        t0 = time.perf_counter()
+        dev = putter(host_feed)
+        t1 = time.perf_counter()
+        with self._lock:
+            # the submit call is exposed too: the fill thread paid it
+            self._stall_s += stall + (t1 - t0)
+        self._q.put((dev, n, span, t0, nbytes))
+        return True
+
+    def finish(self):
+        """Fill-thread end-of-stream: let in-flight transfers deliver,
+        then return (immediately once the stop flag fires — deliveries
+        can no longer land on a closed consumer)."""
+        self._q.put(self._END)
+        while self._thread.is_alive():
+            self._thread.join(timeout=0.1)
+            if self._stop.is_set():
+                return
+
+    def _drain(self):
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+            except _queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            if item is self._END:
+                return
+            dev, n, span, t0, nbytes = item
+            try:
+                self._wait_fn(dev, t0)
+            except BaseException as e:  # surfaced on the consumer side
+                if self._on_error is not None:
+                    self._on_error(e)
+                # fire the stop flag: a dead waiter releases no more
+                # ring slots, so a fill thread parked in submit() (and
+                # the consumer waiting on deliveries) must be unblocked
+                # — the recorded error then propagates at __next__
+                self._stop.set()
+                self._sem.release()
+                return
+            seconds = time.perf_counter() - t0
+            if self._metrics is not None:
+                self._metrics.record_h2d(nbytes, seconds,
+                                         exposed_s=self._take_stall())
+            if self._journal is not None:
+                self._journal.emit("feeder.fill", span=span, num_steps=n,
+                                   nbytes=nbytes, put_s=round(seconds, 6))
+            ok = self._deliver(dev, n, span)
+            self._sem.release()
+            if not ok:
+                return
+
+
 class DeviceFeeder:
     """Double-buffered host→device prefetch (py_reader + double_buffer
     analog). Wraps an iterator of feed dicts; ``__iter__`` yields dicts
@@ -286,6 +464,18 @@ class DeviceFeeder:
     ``put_fn`` that does not itself record (``Trainer._put_feed``
     with ``record=False``) or the h2d stage double-counts.
 
+    With ``overlap_depth >= 2`` (the default) and metrics attached, the
+    put goes through a :class:`_StagingRing` instead of blocking the
+    fill thread on ``block_until_ready``: transfers run up to
+    ``overlap_depth`` deep while the fill thread keeps
+    reading/encoding/stacking, completion time is recorded via a
+    device-event wait on a waiter thread (the honest ``h2d_mbps``),
+    and the hidden-vs-exposed split lands in
+    ``PipelineMetrics.overlap_hidden_s``. ``overlap_depth=1`` restores
+    the old blocking put (the bench A/B's "blocking" arm). ``wait_fn``
+    overrides the completion wait — ``testing.faults.slow_h2d``
+    simulates a slow link deterministically through it.
+
     ``journal`` (a :class:`paddle_tpu.telemetry.RunJournal`) correlates
     the pipeline with the dispatches it feeds: the fill thread mints a
     span id per chunk and emits a ``feeder.fill`` event when the
@@ -302,7 +492,8 @@ class DeviceFeeder:
                  encode_fn: Optional[Callable] = None,
                  metrics: Optional[PipelineMetrics] = None,
                  logical_nbytes_fn: Optional[Callable] = None,
-                 journal=None):
+                 journal=None, overlap_depth: int = 2,
+                 wait_fn: Optional[Callable] = None):
         self.batches = batches
         self.put_fn = put_fn or (lambda d: jax.device_put(d))
         self.put_stacked_fn = put_stacked_fn or self.put_fn
@@ -311,6 +502,8 @@ class DeviceFeeder:
         self.encode_fn = encode_fn
         self.metrics = metrics
         self.journal = journal
+        self.overlap_depth = max(1, int(overlap_depth))
+        self.wait_fn = wait_fn
         self.last_span: Optional[str] = None
         # spec-aware logical-byte counter (FeedWire.logical_nbytes):
         # counts already-wire-dtype reader output at its DECODED width
@@ -347,16 +540,27 @@ class DeviceFeeder:
             yield b
 
     def _timed_put(self, fn, host_feed):
-        if self.metrics is None:
+        if self.metrics is None and self.wait_fn is None:
             return fn(host_feed)
         nbytes = host_feed_nbytes(host_feed)
         t0 = time.perf_counter()
         out = fn(host_feed)
-        # device_put is ASYNC on accelerators: wait for the transfer so
-        # h2d_mbps measures the link, not the submission. This blocks
-        # only the fill thread — the capacity queue keeps the consumer
-        # overlapped — and is what makes the report's bottleneck
-        # attribution honest on a slow host→device link.
+        if self.wait_fn is not None:
+            # injected completion wait (testing.faults.slow_h2d): the
+            # blocking arm of the overlap A/B pays the same simulated
+            # link the staging ring does
+            self.wait_fn(out, t0)
+            if self.metrics is not None:
+                self.metrics.record_h2d(nbytes,
+                                        time.perf_counter() - t0)
+            return out
+        # the BLOCKING put (overlap_depth=1 only): wait for the
+        # transfer inline so h2d_mbps measures the link, not the
+        # submission. It serializes the fill thread's host work behind
+        # each transfer and caps in-flight transfers at one — the
+        # default path is the _StagingRing, which records the same
+        # honest completion time via a device-event wait on a waiter
+        # thread while transfers pipeline overlap_depth deep.
         jax.block_until_ready(out)
         self.metrics.record_h2d(nbytes, time.perf_counter() - t0)
         return out
@@ -412,31 +616,50 @@ class DeviceFeeder:
                          put_s=round(time.perf_counter() - t0, 6))
             return dev, span
 
+        # the staging ring replaces the blocking put when overlap is on
+        # and there is something for it to do (metrics to keep honest,
+        # or an injected wait_fn to obey); the legacy inline put remains
+        # the overlap_depth=1 path and the metrics-less fast path
+        ring = None
+        if self.overlap_depth >= 2 and (metrics is not None
+                                        or self.wait_fn is not None):
+            def deliver(dev, n, span):
+                payload = (n, dev) if self.stack_k > 1 else dev
+                return put((payload, span))
+
+            ring = _StagingRing(self.overlap_depth, deliver, stop,
+                                metrics=metrics, wait_fn=self.wait_fn,
+                                journal=journal, on_error=err.append)
+            self._threads.append(ring._thread)
+
         def fill():
             try:
-                if self.stack_k > 1:
-                    for n, hb in _host_chunks(self._instrumented_batches(),
-                                              self.stack_k, metrics=metrics):
-                        if stop.is_set():
+                chunks = (_host_chunks(self._instrumented_batches(),
+                                       self.stack_k, metrics=metrics)
+                          if self.stack_k > 1
+                          else ((1, b) for b in
+                                self._instrumented_batches()))
+                for n, hb in chunks:
+                    if stop.is_set():
+                        return
+                    putter = (lambda b, _n=n: (
+                        self.put_stacked_fn if _n > 1 else self.put_fn)(b))
+                    if ring is not None:
+                        if not ring.submit(n, hb, putter):
                             return
-                        dev, span = fill_event(
-                            n, hb, (lambda b, _n=n: self._timed_put(
-                                self.put_stacked_fn if _n > 1
-                                else self.put_fn, b)))
-                        if not put(((n, dev), span)):
-                            return
-                else:
-                    for b in self._instrumented_batches():
-                        if stop.is_set():
-                            return
-                        dev, span = fill_event(
-                            1, b,
-                            lambda hb: self._timed_put(self.put_fn, hb))
-                        if not put((dev, span)):
-                            return
+                        continue
+                    dev, span = fill_event(
+                        n, hb, lambda b, _p=putter: self._timed_put(_p, b))
+                    payload = (n, dev) if self.stack_k > 1 else dev
+                    if not put((payload, span)):
+                        return
             except BaseException as e:  # surfaced on the consumer side
                 err.append(e)
             finally:
+                # END must trail every in-flight staged transfer, or the
+                # consumer would see end-of-epoch with chunks undelivered
+                if ring is not None:
+                    ring.finish()
                 # END delivery is shutdown, not dispatch wait — untimed
                 if not put(END, timed=False):
                     # stop was set (close() possibly from ANOTHER thread

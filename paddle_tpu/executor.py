@@ -256,6 +256,7 @@ class Trainer:
         fetch_list: Optional[Sequence[str]] = None,
         guard=None,
         feed_wire=None,
+        augment=None,
     ):
         self.program = program
         self.optimizer = optimizer
@@ -307,12 +308,20 @@ class Trainer:
         self._guard_pending = None    # (mask, feed, base_step, k) to examine
         # feed wire formats (data/wire.py): host-side encode in
         # _put_feed / the DeviceFeeder fill thread, device-side decode
-        # traced into the step program (fused — no extra dispatch)
+        # traced into the step program (fused — no extra dispatch).
+        # augment (data/augment.py): on-device crop/flip/normalize
+        # appended to the decode inside the same traced step, per-step
+        # randomness off the step rng (fused K == sequential).
+        from .data.augment import FeedAugment
         from .data.feeder import PipelineMetrics
         from .data.wire import FeedWire
         from .profiling.steptime import StepTimer
         from .telemetry import get_journal, get_registry
         self.feed_wire = FeedWire.make(feed_wire)
+        self.feed_augment = FeedAugment.make(augment)
+        # the HBM dataset cache fit(device_cache=...) binds here, so
+        # reload/reshard can invalidate it without knowing about fit
+        self.device_cache = None
         self.pipeline_metrics = PipelineMetrics()
         # unified telemetry (paddle_tpu.telemetry): every trainer
         # publishes into the process registry through ONE scrape-time
@@ -372,6 +381,10 @@ class Trainer:
             # the model at its LOGICAL dtype — the decode runs before
             # the model ever sees the feed
             feed = self.feed_wire.logical_feed(feed)
+        if self.feed_augment is not None:
+            # an augmentation normalize likewise casts the feed before
+            # the model sees it (shape-preserving by construction)
+            feed = self.feed_augment.logical_feed(feed)
         params, state = self.program.init(rng, **feed)
         params = self._interleave_stacked_params(params)
         sd = getattr(self.strategy, "opt_state_dtype", None) if self.strategy else None
@@ -674,6 +687,11 @@ class Trainer:
         # wire dtype and costs no extra device launch to decode. Use
         # set_feed_wire() to change it after startup (rebuilds).
         wire = self.feed_wire
+        # on-device augmentation rides the same trace, directly after
+        # the decode: crop/flip/normalize fuse into the feed's first
+        # consumers, keyed off the step rng (fold_in(base, step+i)) so
+        # fused K-step augmentation equals sequential exactly
+        augment = self.feed_augment
         # validate the exchange mode UNCONDITIONALLY: a typo'd or
         # inapplicable knob must fail loudly, never silently no-op
         # (the _warn_unconsumed lesson)
@@ -707,6 +725,8 @@ class Trainer:
             self._trace_count += 1  # trace-time only: counts compilations
             if wire is not None:
                 feed = wire.decode(feed)
+            if augment is not None:
+                feed = augment.apply(feed, rng, training=True)
             def loss_and_aux(p, st, r, f):
                 loss, aux = self._loss_and_aux(p, st, r, f)
                 if scaler is not None:
@@ -851,6 +871,10 @@ class Trainer:
         def eval_step(params, state, feed):
             if wire is not None:
                 feed = wire.decode(feed)
+            if augment is not None:
+                # deterministic ops only (normalize): eval never flips
+                # or crops randomly
+                feed = augment.apply(feed, None, training=False)
             # With the interleaved rest layout (pp_interleave>1) the
             # stacked rows are only meaningful through the pipeline
             # schedule, so eval must enter the same pipeline ctx as
@@ -1149,6 +1173,20 @@ class Trainer:
         if self._step_fn is not None:
             self._build_step()
 
+    def set_augment(self, augment) -> None:
+        """Install (or change) the on-device augmentation table
+        (``{name: AugmentSpec}`` or a FeedAugment) — the
+        :meth:`set_feed_wire` contract: after ``startup`` the
+        step/eval programs rebuild so the augmentation is traced in
+        (one recompile on the next dispatch)."""
+        from .data.augment import FeedAugment
+        aug = FeedAugment.make(augment)
+        if aug == self.feed_augment:
+            return
+        self.feed_augment = aug
+        if self._step_fn is not None:
+            self._build_step()
+
     def pipeline_report(self) -> Dict[str, Any]:
         """Input-pipeline stage attribution accumulated since startup
         (or the last ``pipeline_metrics.reset()``): per-stage seconds
@@ -1249,6 +1287,17 @@ class Trainer:
         return _ship_to(addr, origin=origin, **kw)
 
     def _put_feed_impl(self, feed: Feed, stacked, metrics):
+        # device-resident fast path (the cache-served epoch): a feed of
+        # nothing but jax.Arrays has no host bytes to encode or move
+        # (encode and the byte accounting both skip device arrays), so
+        # the single-device put — a no-op device_put per field — can be
+        # skipped wholesale. MESH feeds always go through put_batch:
+        # its per-array same-sharding passthrough serves cached chunks
+        # for free, while a user-staged array with a different layout
+        # still gets re-placed to the batch sharding as before.
+        if self.mesh is None \
+                and all(isinstance(v, jax.Array) for v in feed.values()):
+            return feed
         if self.feed_wire is not None:
             t0 = _time.perf_counter()
             encoded = self.feed_wire.encode(feed)
@@ -1339,7 +1388,8 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
         prefetch: bool = True, steps_per_dispatch: int = 1,
         resume: bool = False, elastic: bool = False,
         preemption: Optional[bool] = None,
-        feed_wire=None, profile_interval_steps: int = 0):
+        feed_wire=None, profile_interval_steps: int = 0,
+        device_cache=None, augment=None):
     """High-level train loop (contrib.trainer.Trainer.train analog):
     reader → DataFeeder → (optional double-buffered prefetch) →
     trainer.step, with event callbacks and periodic checkpoints.
@@ -1375,6 +1425,27 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
     wire bytes, effective link MB/s) accumulate either way and ride the
     ``end_epoch``/``preempted`` events as ``Event.pipeline``
     (``trainer.pipeline_report()`` at any time).
+
+    **Device-resident data path** (MIGRATION.md section of that name):
+
+    - ``device_cache=True|"auto"|<bytes>|DeviceCache`` arms the HBM
+      dataset cache (``data/device_cache.py``): epoch 1 streams
+      normally but retains each encoded chunk on device (admission
+      budgeted against the advisor's residual-HBM estimate; the
+      explicit int budget is for CPU/tests); epoch 2+ feeds the step
+      device-to-device — ZERO h2d wire bytes, bit-identical losses.
+      Degrades to partial (cache a prefix, stream the rest) or off
+      (no budget / dataset too big). Invalidated on resume-restore and
+      elastic reshard; assumes an epoch-stable reader (a per-epoch
+      shuffle would replay epoch-1 order — don't cache one).
+    - ``augment={name: AugmentSpec}`` traces on-device
+      crop/flip/normalize into the step right after the wire decode
+      (``trainer.set_augment``); per-step randomness follows the
+      ``fold_in(base, global_step+i)`` discipline, so fused K-step
+      equals sequential and resume reproduces the stream.
+    - transfers run through the DeviceFeeder's 2-deep staging ring:
+      chunk N+1's h2d overlaps chunk N's K-step scan, with the
+      hidden-vs-exposed split reported as ``overlap_hidden_s``.
 
     **Fault tolerance** (MIGRATION.md "Fault tolerance & resume"):
 
@@ -1418,7 +1489,8 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
         return _fit_impl(trainer, reader, num_epochs, feed_names, dtypes,
                          event_handler, checkpoint_config, prefetch,
                          steps_per_dispatch, resume, elastic, preemption,
-                         feed_wire, profile_interval_steps)
+                         feed_wire, profile_interval_steps, device_cache,
+                         augment)
     except resilience.InjectedCrash:
         raise  # models abrupt process death: a real kill -9 dumps nothing
     except FloatingPointError:
@@ -1439,7 +1511,8 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
 def _fit_impl(trainer, reader, num_epochs, feed_names, dtypes,
               event_handler, checkpoint_config, prefetch,
               steps_per_dispatch, resume, elastic, preemption,
-              feed_wire, profile_interval_steps):
+              feed_wire, profile_interval_steps, device_cache=None,
+              augment=None):
     import contextlib as _contextlib
     import os
     import shutil
@@ -1447,6 +1520,7 @@ def _fit_impl(trainer, reader, num_epochs, feed_names, dtypes,
     from .core.errors import enforce as _enforce
     from . import io as _io
     from . import resilience
+    from .data.device_cache import DeviceCache
     from .data.feeder import DataFeeder, DeviceFeeder, iter_chunked
     from .telemetry import flight_dump, get_registry
 
@@ -1461,6 +1535,12 @@ def _fit_impl(trainer, reader, num_epochs, feed_names, dtypes,
              "need >= 0 (0 disables interval profile events)")
     if feed_wire is not None:
         trainer.set_feed_wire(feed_wire)
+    if augment is not None:
+        trainer.set_augment(augment)
+    # the HBM dataset cache: bound to the trainer so reload/reshard
+    # paths can invalidate it without knowing about this loop
+    cache = DeviceCache.make(device_cache, trainer=trainer)
+    trainer.device_cache = cache
     feeder = DataFeeder(feed_names, dtypes)
 
     _enforce(resume or not elastic,
@@ -1490,6 +1570,12 @@ def _fit_impl(trainer, reader, num_epochs, feed_names, dtypes,
             trainer.journal.emit("ckpt.restore",
                                  global_step=trainer.global_step,
                                  epoch=start_epoch, epoch_step=skip_steps)
+            if cache is not None:
+                # a restore lands mid-epoch / possibly on a new mesh:
+                # any cached prefix no longer aligns with what the
+                # epoch will consume (reshard_restore invalidates on
+                # its own for direct callers)
+                cache.invalidate("checkpoint restore")
 
     # rebuild the rotation list from disk (oldest first) so pre-existing
     # checkpoints rotate out across restarts instead of accumulating,
@@ -1547,14 +1633,34 @@ def _fit_impl(trainer, reader, num_epochs, feed_names, dtypes,
             if event_handler:
                 event_handler(Event("begin_epoch", epoch, trainer.global_step))
 
-            def batches(_skip=skip):
+            # device-cache disposition for THIS epoch. Serving and
+            # admission both require the epoch to start at batch 0 (a
+            # resume lands mid-epoch — the cached prefix would not
+            # align); an invalidated cache re-arms on the next clean
+            # epoch start.
+            serve_cache = False
+            admitting = False
+            cached_steps = 0
+            if cache is not None and skip == 0:
+                if cache.state == "invalid":
+                    cache.reset()
+                serve_cache = cache.ready
+                admitting = (not serve_cache
+                             and cache.state in ("cold", "admitting"))
+                cached_steps = cache.cached_steps if serve_cache else 0
+
+            def batches(_skip=skip + cached_steps):
                 for i, samples in enumerate(reader()):
                     if i < _skip:
                         continue
                     yield feeder.feed(samples)
 
             device_feeder = None
-            if prefetch:
+            if serve_cache and cache.complete:
+                # the whole epoch is resident: no reader, no fill
+                # thread, zero h2d wire bytes
+                iterator = iter(())
+            elif prefetch:
                 # the feeder owns the stage timing (put_fn record=False
                 # so h2d isn't double-counted) and runs the wire encode
                 # on the fill thread, per batch, before stacking
@@ -1581,15 +1687,32 @@ def _fit_impl(trainer, reader, num_epochs, feed_names, dtypes,
                                                      stacked=True))
             else:
                 iterator = map(trainer._put_feed, batches())
-            preempted = False
-            try:
+            def epoch_items():
+                """(n, feed, span, streamed): cache-served chunks first
+                (device-to-device, span-less, hit bytes attributed),
+                then the streamed remainder."""
+                if serve_cache:
+                    for n, feed in cache.chunks(
+                            metrics=trainer.pipeline_metrics):
+                        yield n, feed, None, False
                 for item in iterator:
-                    n, feed = item if steps_per_dispatch > 1 else (1, item)
+                    n, feed = (item if steps_per_dispatch > 1
+                               else (1, item))
                     # the chunk's trace id, minted by the fill thread:
-                    # its dispatch event correlates with the feeder.fill
-                    # event that produced this batch
+                    # its dispatch event correlates with the
+                    # feeder.fill event that produced this batch
                     span = (device_feeder.last_span
                             if device_feeder is not None else None)
+                    yield n, feed, span, True
+
+            preempted = False
+            try:
+                for n, feed, span, streamed in epoch_items():
+                    if admitting and streamed:
+                        # epoch-1 tee: retain the encoded device chunk
+                        # (feeds are never donated, so the buffers
+                        # survive the dispatch untouched)
+                        cache.offer(n, feed)
                     gs_before = trainer.global_step
                     if event_handler:
                         event_handler(Event("begin_step", epoch, gs_before,
@@ -1627,6 +1750,14 @@ def _fit_impl(trainer, reader, num_epochs, feed_names, dtypes,
                 # fill thread must not stay blocked holding device buffers
                 if device_feeder is not None:
                     device_feeder.close()
+            if admitting:
+                if preempted:
+                    # a half-observed epoch must not seal: the next fit
+                    # resumes mid-epoch and appending its chunks after
+                    # this prefix would interleave two epochs
+                    cache.invalidate("preempted mid-admission")
+                else:
+                    cache.seal(steps_in_epoch)
             if preempted:
                 # preemption flow: boundary checkpoint, drain the parked
                 # guard bitmask and async orbax writes, clean exit (the

@@ -138,8 +138,11 @@ def profile_report(trainer, fusion: Optional[Dict[str, Any]] = None
     - ``steps`` / ``dispatches`` / ``avg_step_ms`` / ``span_s`` — from
       the per-dispatch :class:`StepTimer`;
     - ``breakdown`` — seconds per attribution bucket: ``compute_s``
-      (training-loop thread inside dispatch calls), ``h2d_s`` (device
-      puts), ``host_encode_s`` (wire encode), ``reader_s`` (host reader
+      (training-loop thread inside dispatch calls), ``h2d_s`` (the
+      EXPOSED transfer time — what the pipeline actually stalled for;
+      the staging ring's hidden portion rides separately as
+      ``overlap_hidden_s`` and must not crown h2d the bottleneck),
+      ``host_encode_s`` (wire encode), ``reader_s`` (host reader
       wait), ``starved_s`` (loop thread waiting for input). With
       prefetch the feeder buckets overlap compute — ``starved_s`` is
       the non-overlapped input-bound signal;
@@ -152,9 +155,10 @@ def profile_report(trainer, fusion: Optional[Dict[str, Any]] = None
     st = trainer.step_timer.report()
     pipe = trainer.pipeline_report()
     stages = pipe.get("stages_s", {})
+    hidden = pipe.get("overlap_hidden_s", 0.0)
     breakdown = {
         "compute_s": st["dispatch_s"],
-        "h2d_s": stages.get("h2d", 0.0),
+        "h2d_s": max(0.0, stages.get("h2d", 0.0) - hidden),
         "host_encode_s": stages.get("encode", 0.0),
         "reader_s": stages.get("reader", 0.0),
         "starved_s": pipe.get("consumer_starved_s", 0.0),
@@ -164,6 +168,7 @@ def profile_report(trainer, fusion: Optional[Dict[str, Any]] = None
     return {
         **st,
         "breakdown": {k: round(v, 6) for k, v in breakdown.items()},
+        "overlap_hidden_s": round(hidden, 6),
         "bottleneck": bottleneck,
         "input_bound": pipe.get("input_bound", False),
         "pipeline": pipe,

@@ -560,6 +560,12 @@ def reshard_restore(checkpoint_dir: str, trainer,
         _flight_reshard(err)
         raise err
     _io.load_trainer(checkpoint_dir, trainer, allow_reshard=True)
+    # the HBM dataset cache holds arrays laid out for the OLD mesh —
+    # an elastic rejoin must drop them or epoch 2 would feed stale
+    # shardings into the rebuilt step
+    dc = getattr(trainer, "device_cache", None)
+    if dc is not None:
+        dc.invalidate("reshard_restore")
     from .telemetry import get_registry
     get_registry().counter(
         "paddle_tpu_resilience_reshards_total",

@@ -478,6 +478,31 @@ def slow_link(link: LinkProxy, delay_ms: float) -> LinkProxy:
     return link.slow(delay_ms)
 
 
+def slow_h2d(delay_ms: float):
+    """The :func:`slow_link` analog for the HOST→DEVICE link: a
+    ``DeviceFeeder(wait_fn=...)`` completion wait under which each
+    chunk's transfer completes ``delay_ms`` after its submission,
+    independently of other chunks — a latency-dominated link, the
+    regime the 2-deep staging ring pipelines (two in-flight transfers
+    → two completions per delay window), and the regime the BLOCKING
+    put serializes (one transfer at a time, host work stalled behind
+    each). Deterministic: no bandwidth model, no jitter — the same
+    feed script produces the same timeline, so the
+    ``overlap_vs_blocking`` A/B (bench ``device_cache`` row,
+    tests/test_device_cache.py) measures the ring, not the scheduler."""
+    import jax
+
+    delay_s = float(delay_ms) / 1e3
+
+    def wait(dev, t_submit):
+        remaining = t_submit + delay_s - time.perf_counter()
+        if remaining > 0:
+            time.sleep(remaining)
+        jax.block_until_ready(dev)
+
+    return wait
+
+
 def kill_process(replica) -> None:
     """SIGKILL a fleet replica PROCESS, no cleanup, no warning — the
     real thing, unlike :func:`kill_server`'s in-process stand-in.

@@ -28,8 +28,8 @@ def test_priority_order_leads_with_baseline_configs():
     # every registered config appears exactly once
     expect = (set(bench.TRAIN_CONFIGS) | set(bench.INFER_CONFIGS)
               | {"gpt_decode", "dispatch_overhead", "guard_overhead",
-                 "input_pipeline", "serving", "serving_fleet",
-                 "fusion_profile", "elastic_reshard"})
+                 "input_pipeline", "device_cache", "serving",
+                 "serving_fleet", "fusion_profile", "elastic_reshard"})
     assert set(names) == expect and len(names) == len(expect)
 
 
@@ -93,6 +93,41 @@ def test_input_pipeline_quick_overrides(monkeypatch):
     bench._run_one("input_pipeline", 1.0, quick=True)
     assert seen == {"iters": 8, "k": 4}
     assert bench._result_key("input_pipeline") == "input_pipeline"
+
+
+def test_device_cache_quick_overrides(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(bench, "bench_device_cache",
+                        lambda peak, **kw: seen.update(kw) or {"v": 1})
+    bench._run_one("device_cache", 1.0, quick=True)
+    assert seen == {"iters": 8, "k": 4, "link_delay_ms": 20.0}
+    assert bench._result_key("device_cache") == "device_cache"
+
+
+def test_device_cache_row_schema():
+    """The device_cache row (HBM-cached vs streamed vs compute-only +
+    the slow-link overlap A/B) pins its schema: the round records are
+    read for the ROADMAP gate (delivered >= 0.9x compute-only when the
+    dataset fits residual HBM) and the overlap delta, so the keys and
+    the zero-wire-bytes pin must not drift. Runs the real row at a
+    tiny config — the cells are the contract, not the magnitudes."""
+    row = bench.bench_device_cache(1e12, batch_size=8, iters=4, k=2,
+                                   link_delay_ms=15.0)
+    for key in ("value", "unit", "step_time_ms", "cached_vs_streamed_x",
+                "h2d_bytes_epoch1", "h2d_bytes_epoch2",
+                "overlap_vs_blocking", "cache", "steps_per_dispatch"):
+        assert key in row, key
+    assert set(row["step_time_ms"]) == {"streamed", "cached",
+                                        "compute_only"}
+    ob = row["overlap_vs_blocking"]
+    assert set(ob) == {"blocking_step_ms", "overlap_step_ms",
+                       "speedup_x", "link_delay_ms"}
+    # the cache really served epoch 2: zero wire bytes moved
+    assert row["h2d_bytes_epoch1"] > 0
+    assert row["h2d_bytes_epoch2"] == 0
+    assert row["cache"]["state"] == "full"
+    assert row["cache"]["hits"] > 0
+    assert row["steps_per_dispatch"] == 2
 
 
 def test_serving_quick_overrides(monkeypatch):

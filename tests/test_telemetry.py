@@ -962,6 +962,33 @@ def test_registry_naming_contract_after_train_and_serve(fresh_telemetry,
         assert any(k.startswith("paddle_tpu_feeder_") for k in series)
 
 
+def test_feeder_cache_and_overlap_counter_families(fresh_telemetry):
+    """The device-resident data path's counters (PR 15) export through
+    the same scrape-time collector as every feeder stage: cache hit
+    bytes/chunks and ring-hidden transfer seconds, naming-contract
+    clean, and numerically equal to the PipelineMetrics accumulators
+    they render (the can-never-disagree property)."""
+    from paddle_tpu.data.feeder import PipelineMetrics
+    from paddle_tpu.telemetry.registry import METRIC_NAME_RE
+
+    m = PipelineMetrics()
+    m.record_h2d(1_000, 0.25, exposed_s=0.1)   # 0.15 s hidden
+    m.record_cache_hit(4_096)
+    m.record_cache_hit(4_096)
+    fams = {f.name: f for f in m.telemetry_families(inst="7")}
+    for name, want in [
+            ("paddle_tpu_feeder_overlap_hidden_seconds_total", 0.15),
+            ("paddle_tpu_feeder_cache_hit_bytes_total", 8_192),
+            ("paddle_tpu_feeder_cache_hits_total", 2)]:
+        assert name in fams, sorted(fams)
+        assert METRIC_NAME_RE.match(name), name
+        fam = fams[name]
+        assert fam.help.strip()
+        (labels, value), = fam.samples
+        assert labels == {"inst": "7"}
+        assert value == pytest.approx(want)
+
+
 def test_telemetry_overhead_under_2pct_at_k16(fresh_telemetry):
     """The hot-path budget (same direct-cost method as the PR-6
     StepTimer pin): the per-dispatch cost of the telemetry-bearing
