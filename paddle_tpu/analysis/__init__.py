@@ -41,16 +41,30 @@ programs, checkpoint manifests, serving artifacts, and mesh specs —
 ``ckpt:*`` / ``artifact:*`` findings whose runtime counterparts are
 crashes (``CheckpointCorrupt``, ``ReloadFailed``, sharding aborts).
 
+Beyond the program level, :mod:`.runtime` (:func:`check_runtime`) turns
+the same finding machinery on the framework's OWN Python source: lock-
+discipline rules (``thread:unguarded-access`` / ``callback-under-lock``
+/ ``lock-order`` / ``join-unstarted``, :mod:`.concurrency`) and framed-
+wire contract rules (``wire:schema-drift`` / ``retry-unsafe`` /
+``unknown-verb``, :mod:`.wire_contracts`) over the three client↔server
+verb surfaces, including the C side of ``native/pserver.cc``.
+
 Four front doors: programmatic :func:`check` / :func:`check_trainer` /
-:func:`check_artifacts`, ``Trainer.startup(lint="warn"|"error")``, the
-CLI ``python -m paddle_tpu.analysis --model mnist`` (also
-``tools/lint_program.py``), and the CI gate ``tools/lint_gate.py --ci``
-(stable finding fingerprints + a committed baseline file + SARIF).
+:func:`check_artifacts` / :func:`check_runtime`,
+``Trainer.startup(lint="warn"|"error")``, the CLI ``python -m
+paddle_tpu.analysis --model mnist`` (also ``tools/lint_program.py``;
+``--wire-table`` prints the extracted verb table), and the CI gate
+``tools/lint_gate.py --ci`` (stable finding fingerprints + a committed
+baseline file + SARIF), whose ``--runtime`` sweep runs the source-level
+rules.
 """
 
 from .check import check, check_trainer
 from .contracts import (check_artifacts, check_reload_compat, serving_spec,
                         trainer_specs)
+from .runtime import check_runtime, lock_edges, runtime_sources
+from .wire_contracts import (check_wire, render_verb_table_md,
+                             scrape_surface, verb_table)
 from .report import (Finding, LintError, LintReport, LintWarning,
                      active_report, apply_severity, baseline_key,
                      collect_into, load_baseline, new_findings, to_sarif,
@@ -63,6 +77,8 @@ __all__ = [
     "check", "check_trainer",
     "check_artifacts", "check_reload_compat", "serving_spec",
     "trainer_specs",
+    "check_runtime", "lock_edges", "runtime_sources",
+    "check_wire", "render_verb_table_md", "scrape_surface", "verb_table",
     "Finding", "LintError", "LintReport", "LintWarning",
     "active_report", "collect_into",
     "apply_severity", "baseline_key", "load_baseline", "new_findings",

@@ -5,6 +5,8 @@
         --mesh fsdp=8 --rules fsdp --fail-on warning --format json
     python -m paddle_tpu.analysis --model gpt --amp bfloat16 --ci \
         --baseline tools/analysis_baseline.json
+    python -m paddle_tpu.analysis --wire-table          # markdown
+    python -m paddle_tpu.analysis --wire-table --format json
 
 Exit status (CI contract, also the ``tools/lint_gate.py`` contract):
 
@@ -80,8 +82,13 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="static jaxpr-level lint of a model-zoo program")
-    ap.add_argument("--model", required=True,
+    ap.add_argument("--model", default="",
                     help="zoo model: mnist | transformer | moe_transformer | gpt")
+    ap.add_argument("--wire-table", action="store_true",
+                    help="print the framed-verb wire-contract table "
+                         "extracted from both sides of every surface "
+                         "(markdown; --format json for the raw rows) "
+                         "and exit — no model build")
     ap.add_argument("--variant", default="",
                     help="model variant (mnist: mlp|conv; "
                          "moe_transformer: tight)")
@@ -140,6 +147,25 @@ def main(argv=None) -> int:
                          "CLI runs of the same config)")
     args = ap.parse_args(argv)
     overrides = _parse_severity(args.severity)
+
+    if args.wire_table:
+        # pure source extraction — no model build, no jax: still "the
+        # checker ran", so a scraper crash is exit 3
+        try:
+            from .wire_contracts import render_verb_table_md, verb_table
+            rows = verb_table()
+            if args.format == "json":
+                print(json.dumps(rows, indent=1))
+            else:
+                print(render_verb_table_md(rows))
+        except Exception:
+            traceback.print_exc()
+            print("analysis: internal error (exit 3) — the checker "
+                  "crashed; this is NOT a lint verdict", file=sys.stderr)
+            return EXIT_INTERNAL
+        return EXIT_CLEAN
+    if not args.model:
+        raise _usage_error("--model is required (or use --wire-table)")
 
     from .report import (apply_severity, load_baseline, new_findings,
                          to_sarif, write_baseline)

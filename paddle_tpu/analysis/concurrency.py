@@ -1,0 +1,790 @@
+"""Lock-discipline static analyzer over the framework's own source.
+
+The runtime half of the checker (``analysis.runtime``): where the jaxpr
+walker proves properties of the *compiled program*, this module proves
+properties of the host runtime that dispatches it — the serving
+workers, feeder threads, shipper loops and fleet routers whose bug
+classes (unguarded shared-state reads, callbacks fired under a lock,
+threads registered before ``.start()``) recur in every review pass.
+
+It is a pure-``ast`` pass over Python source; nothing is imported or
+executed. Per class it infers the *guarded-field set* — attributes
+whose every non-``__init__`` write happens under ``with self._lock:``
+— augments it with the explicit ``# guarded-by: <lock>`` annotation
+convention, then checks four rules:
+
+- ``thread:unguarded-access`` — a guarded field read/written without
+  its lock in a method reachable from a thread entry point
+  (``Thread(target=self.m)``, a registered callback reference) or in a
+  method that itself takes locks;
+- ``thread:callback-under-lock`` — a user/subscriber callback invoked
+  while any lock is held (the breaker ``on_trip`` / alert-rule
+  subscriber bug class);
+- ``thread:lock-order`` — the package-wide lock-acquisition graph has
+  a cycle (emitted by the aggregator in :mod:`.runtime`; this module
+  contributes the per-file edges);
+- ``thread:join-unstarted`` — a ``Thread`` published into a shared
+  ``self.*`` container before ``.start()``, or joined without ever
+  being started.
+
+Suppression is by source annotation, not config: ``# lint:
+allow(<rule>)`` on the offending line, its ``def`` line, or its
+``class`` line; ``# guarded-by: <lock>`` both declares intent and
+overrides inference for that field.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from typing import Dict, List, Optional, Set, Tuple
+
+from .report import LintReport
+
+# attribute factories whose result is "a lock" for `with` tracking
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore")
+
+# names that smell like a user-supplied callback when called under a lock
+_CALLBACK_NAME_RE = re.compile(
+    r"(^on_|_callback$|_callbacks$|_cb$|_cbs$|_hook$|_hooks$|"
+    r"^callbacks?$|_listeners?$|_subscribers?$|_waiters$)")
+
+_GUARDED_BY_RE = re.compile(r"guarded-by:\s*([A-Za-z_]\w*)")
+_ALLOW_RE = re.compile(r"lint:\s*allow\(([^)]*)\)")
+
+# container methods that mutate the receiver (a write to the field for
+# guarded-set inference; `self._buf.append(x)` is a write to `_buf`)
+_MUTATORS = {"append", "extend", "add", "remove", "discard", "pop",
+             "popleft", "popitem", "appendleft", "clear", "update",
+             "setdefault", "insert", "sort"}
+
+
+def _comment_maps(src: str) -> Tuple[Dict[int, str], Dict[int, Set[str]]]:
+    """Scan comments → ({lineno: lock-name} for ``guarded-by:``,
+    {lineno: {rules}} for ``lint: allow(...)``)."""
+    guarded: Dict[int, str] = {}
+    allows: Dict[int, Set[str]] = {}
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(src).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            line = tok.start[0]
+            m = _GUARDED_BY_RE.search(tok.string)
+            if m:
+                guarded[line] = m.group(1)
+            m = _ALLOW_RE.search(tok.string)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                allows.setdefault(line, set()).update(rules)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return guarded, allows
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` → ``"X"``, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _is_lock_factory(call: ast.AST) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    fn = call.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else "")
+    return name in _LOCK_FACTORIES
+
+
+def _is_thread_ctor(call: ast.AST) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    fn = call.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else "")
+    return name in ("Thread", "Timer")
+
+
+@dataclasses.dataclass
+class Access:
+    field: str
+    kind: str                 # "read" | "write" (reassign) | "mutate"
+    lineno: int
+    held: Tuple[str, ...]     # lock attrs held at the site (innermost last)
+
+
+@dataclasses.dataclass
+class CallbackCall:
+    desc: str                 # what was called, for the message
+    lineno: int
+    held: Tuple[str, ...]
+
+
+@dataclasses.dataclass
+class SelfCall:
+    callee: str
+    lineno: int
+    held: Tuple[str, ...]
+
+
+@dataclasses.dataclass
+class MethodInfo:
+    name: str
+    lineno: int
+    accesses: List[Access] = dataclasses.field(default_factory=list)
+    self_calls: List[SelfCall] = dataclasses.field(default_factory=list)
+    callback_calls: List[CallbackCall] = dataclasses.field(
+        default_factory=list)
+    escapes: Set[str] = dataclasses.field(default_factory=set)
+    # locks acquired while no other class lock is held (for the one-level
+    # cross-method lock-order expansion)
+    toplevel_locks: Set[str] = dataclasses.field(default_factory=set)
+    join_findings: List[Tuple[str, int, str]] = dataclasses.field(
+        default_factory=list)     # (message, lineno, thread var)
+
+
+class _MethodScanner(ast.NodeVisitor):
+    """One method body: tracks the held-lock stack through ``with``
+    blocks and records accesses / self-calls / callback calls / thread
+    lifecycle events."""
+
+    def __init__(self, cls: "_ClassInfo", method: str, lineno: int):
+        self.cls = cls
+        self.info = MethodInfo(name=method, lineno=lineno)
+        self.held: List[str] = []
+        # locals derived from shared self-state (loop vars over
+        # self._subs, `fn = self._waiters.pop(k)` ...): calling one of
+        # these under a lock is the callback-under-lock shape
+        self.derived: Set[str] = set()
+        # ctor-param callables stored on self are tracked class-wide
+        # locals bound to a Thread(...) ctor in this function
+        self.threads: Dict[str, dict] = {}
+
+    # -- helpers -----------------------------------------------------------
+
+    def _access(self, field: str, kind: str, lineno: int) -> None:
+        if field in self.cls.locks or field in self.cls.methods:
+            return
+        self.info.accesses.append(Access(field, kind, lineno,
+                                         tuple(self.held)))
+
+    def _rooted_in_self(self, node: ast.AST) -> bool:
+        """Does this expression read shared ``self.*`` state (possibly
+        through a subscript / ``.get()`` / ``.pop()``)?"""
+        while True:
+            if _self_attr(node) is not None:
+                return True
+            if isinstance(node, ast.Subscript):
+                node = node.value
+            elif isinstance(node, ast.Attribute):
+                node = node.value
+            elif isinstance(node, ast.Call):
+                node = node.func
+            else:
+                return False
+
+    def _visit_target(self, node: ast.AST) -> None:
+        """Assignment target: classify writes."""
+        field = _self_attr(node)
+        if field is not None:
+            self._access(field, "write", node.lineno)
+            return
+        if isinstance(node, ast.Subscript):
+            base = _self_attr(node.value)
+            if base is not None:
+                # self._rules[k] = v mutates the container; the
+                # REFERENCE stays stable (distinct from a reassignment)
+                self._access(base, "mutate", node.lineno)
+            else:
+                self.visit(node.value)
+            self.visit(node.slice)
+            return
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                self._visit_target(elt)
+            return
+        if isinstance(node, ast.Starred):
+            self._visit_target(node.value)
+            return
+        self.visit(node)
+
+    # -- statements --------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        for t in node.targets:
+            self._visit_target(t)
+        # bookkeeping on simple `name = ...` bindings
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if _is_thread_ctor(node.value):
+                self.threads[name] = {"line": node.lineno, "started": False,
+                                      "registered": 0}
+            elif self._rooted_in_self(node.value):
+                self.derived.add(name)
+        # publishing a local Thread into shared state before .start()
+        for t in node.targets:
+            self._note_registration(t, node.value, node.lineno)
+
+    def _note_registration(self, target: ast.AST, value: ast.AST,
+                           lineno: int) -> None:
+        if not (isinstance(value, ast.Name) and value.id in self.threads):
+            return
+        rec = self.threads[value.id]
+        stored_shared = False
+        if isinstance(target, ast.Subscript):
+            stored_shared = self._rooted_in_self(target.value)
+        if stored_shared and not rec["started"]:
+            rec["registered"] = lineno
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        field = _self_attr(node.target)
+        if field is not None:
+            # += is a read-modify-write; record the write (stricter)
+            self._access(field, "write", node.lineno)
+        else:
+            self._visit_target(node.target)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self.visit(node.value)
+        self._visit_target(node.target)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                base = _self_attr(t.value)
+                if base is not None:
+                    self._access(base, "mutate", t.lineno)
+                    self.visit(t.slice)
+                    continue
+            self._visit_target(t)
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: List[str] = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            lock = self._lock_of(item.context_expr)
+            if lock is not None:
+                if self.held:
+                    self.cls.lock_edges.append(
+                        (self.held[-1], lock, node.lineno))
+                else:
+                    self.info.toplevel_locks.add(lock)
+                self.held.append(lock)
+                acquired.append(lock)
+            if item.optional_vars is not None:
+                self._visit_target(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        field = _self_attr(expr)
+        if field is not None and field in self.cls.locks:
+            return field
+        # `with self._lock.acquire_timeout():`-style helpers are not
+        # tracked; neither are non-self locks (module-level singletons)
+        return None
+
+    def visit_For(self, node: ast.For) -> None:
+        self.visit(node.iter)
+        if (isinstance(node.target, ast.Name)
+                and self._rooted_in_self(node.iter)):
+            self.derived.add(node.target.id)
+        self._visit_target(node.target)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested function (closure): scanned as a pseudo-method named
+        # `outer.inner`; if the name escapes (Thread target, submitted
+        # to an executor) its accesses are thread-reachable
+        sub = _MethodScanner(self.cls, f"{self.info.name}.{node.name}",
+                             node.lineno)
+        sub.derived = set(self.derived)
+        for stmt in node.body:
+            sub.visit(stmt)
+        sub._finish_threads()
+        self.cls.methods[sub.info.name] = sub.info
+        self.cls.nested_of.setdefault(self.info.name, set()).add(
+            sub.info.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self.visit(node.body)
+
+    # -- calls and reads ---------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        handled_func = False
+        field = _self_attr(fn)
+        if field is not None:
+            if field in self.cls.methods or field in self.cls.method_names:
+                self.info.self_calls.append(
+                    SelfCall(field, node.lineno, tuple(self.held)))
+            else:
+                # calling a callable stored on self: a read, and — under
+                # a lock — a callback-under-lock candidate when the
+                # field was injected via the ctor or smells like a hook
+                self._access(field, "read", node.lineno)
+                if self.held and (field in self.cls.ctor_param_attrs
+                                  or _CALLBACK_NAME_RE.search(field)):
+                    self.info.callback_calls.append(CallbackCall(
+                        f"self.{field}", node.lineno, tuple(self.held)))
+            handled_func = True
+        elif isinstance(fn, ast.Attribute):
+            base = _self_attr(fn.value)
+            if base is not None and base not in self.cls.locks:
+                kind = "mutate" if fn.attr in _MUTATORS else "read"
+                self._access(base, kind, fn.value.lineno)
+                if fn.attr in _MUTATORS:
+                    # self._workers.append(t): publishing a local Thread
+                    # into shared state counts as a registration
+                    for arg in node.args:
+                        if (isinstance(arg, ast.Name)
+                                and arg.id in self.threads
+                                and not self.threads[arg.id]["started"]):
+                            self.threads[arg.id]["registered"] = node.lineno
+                handled_func = True
+            elif isinstance(fn.value, ast.Name):
+                name = fn.value.id
+                if name in self.threads:
+                    if fn.attr == "start":
+                        self.threads[name]["started"] = True
+                        if self.threads[name]["registered"]:
+                            pass   # registration already noted
+                    elif fn.attr == "join":
+                        self.threads[name]["joined"] = node.lineno
+                    handled_func = True
+        elif isinstance(fn, ast.Name):
+            if self.held and fn.id in self.derived:
+                self.info.callback_calls.append(CallbackCall(
+                    fn.id, node.lineno, tuple(self.held)))
+
+        if not handled_func:
+            self.visit(fn)
+        for arg in node.args:
+            self.visit(arg)
+        for kw in node.keywords:
+            self.visit(kw.value)
+        # a Thread bound to a kwarg-visible local target method makes
+        # that method a thread entry point — handled via escapes below
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        field = _self_attr(node)
+        if field is None:
+            self.visit(node.value)
+            return
+        if field in self.cls.locks:
+            return
+        if field in self.cls.method_names:
+            if field in self.cls.properties:
+                # property read = a self-call into the getter
+                self.info.self_calls.append(
+                    SelfCall(field, node.lineno, tuple(self.held)))
+            else:
+                # bare method reference (Thread target, subscribe arg,
+                # route-table value): the method escapes this class and
+                # becomes a thread entry point
+                self.info.escapes.add(field)
+            return
+        kind = "write" if isinstance(node.ctx, (ast.Store, ast.Del)) \
+            else "read"
+        self._access(field, kind, node.lineno)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        pass
+
+    # -- wrap-up -----------------------------------------------------------
+
+    def _finish_threads(self) -> None:
+        for name, rec in self.threads.items():
+            if rec["registered"]:
+                # registered into shared state; if start() came after
+                # the registration line (or never), a concurrent reader
+                # (close()/join sweep) can see a never-started Thread
+                self.info.join_findings.append((
+                    f"Thread {name!r} published into shared state at line "
+                    f"{rec['registered']} before .start()",
+                    rec["registered"], name))
+            joined = rec.get("joined")
+            if joined and not rec["started"]:
+                self.info.join_findings.append((
+                    f"Thread {name!r} joined at line {joined} but never "
+                    f"started in this function", joined, name))
+
+
+class _ClassInfo:
+    def __init__(self, name: str):
+        self.name = name
+        self.locks: Set[str] = set()
+        self.method_names: Set[str] = set()
+        self.properties: Set[str] = set()
+        self.methods: Dict[str, MethodInfo] = {}
+        self.nested_of: Dict[str, Set[str]] = {}
+        self.ctor_param_attrs: Set[str] = set()
+        self.lock_edges: List[Tuple[str, str, int]] = []
+        self.annotations: Dict[str, str] = {}   # field -> lock (guarded-by)
+        self.field_allows: Dict[str, Set[str]] = {}  # field -> allowed rules
+        self.lineno = 0
+
+
+def _prescan_class(node: ast.ClassDef, guarded_lines: Dict[int, str],
+                   allow_lines: Optional[Dict[int, Set[str]]] = None
+                   ) -> _ClassInfo:
+    cls = _ClassInfo(node.name)
+    allow_lines = allow_lines or {}
+    cls.lineno = node.lineno
+    for stmt in node.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cls.method_names.add(stmt.name)
+            for dec in stmt.decorator_list:
+                dname = dec.attr if isinstance(dec, ast.Attribute) else (
+                    dec.id if isinstance(dec, ast.Name) else "")
+                if dname in ("property", "cached_property"):
+                    cls.properties.add(stmt.name)
+    init = next((s for s in node.body
+                 if isinstance(s, ast.FunctionDef) and s.name == "__init__"),
+                None)
+    init_params = set()
+    if init is not None:
+        init_params = {a.arg for a in init.args.args + init.args.kwonlyargs
+                       if a.arg != "self"}
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Assign):
+            continue
+        for t in sub.targets:
+            field = _self_attr(t)
+            if field is None:
+                continue
+            if _is_lock_factory(sub.value):
+                cls.locks.add(field)
+            if sub.lineno in guarded_lines:
+                cls.annotations[field] = guarded_lines[sub.lineno]
+            if sub.lineno in allow_lines:
+                # an allow on the field's assignment line opts the whole
+                # FIELD out of that rule (one annotation, not one per
+                # read site)
+                cls.field_allows.setdefault(field, set()).update(
+                    allow_lines[sub.lineno])
+            # `self.on_trip = on_trip` (possibly `x or default`)
+            v = sub.value
+            if isinstance(v, ast.BoolOp):
+                v = v.values[0]
+            if isinstance(v, ast.Name) and v.id in init_params \
+                    and v.id == field:
+                cls.ctor_param_attrs.add(field)
+    return cls
+
+
+# --------------------------------------------------------------------------
+# per-file analysis
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FileAnalysis:
+    """Everything extracted from one module: the per-file report plus
+    the lock-order edges the package aggregator consumes."""
+    report: LintReport
+    lock_edges: List[Tuple[str, str, str]]   # (ClassA.lock, ClassB.lock, loc)
+
+
+class _Allower:
+    """Answers "is this rule suppressed at this site" from the comment
+    map: the offending line, its def line, its class line, or a
+    module-wide allow on lines 1-2."""
+
+    def __init__(self, allows: Dict[int, Set[str]]):
+        self.allows = allows
+        self.module_rules: Set[str] = set()
+        for line in (1, 2):
+            self.module_rules |= allows.get(line, set())
+
+    @staticmethod
+    def _matches(rule: str, entries: Set[str]) -> bool:
+        fam = rule.split(":")[0]
+        return rule in entries or fam in entries or "all" in entries
+
+    def __call__(self, rule: str, *linenos: int) -> bool:
+        if self._matches(rule, self.module_rules):
+            return True
+        for ln in linenos:
+            if ln and self._matches(rule, self.allows.get(ln, set())):
+                return True
+        return False
+
+
+def check_source(src: str, filename: str = "<source>",
+                 subject: str = "runtime") -> FileAnalysis:
+    """Analyze one module's source → :class:`FileAnalysis`."""
+    report = LintReport(subject)
+    guarded_lines, allow_lines = _comment_maps(src)
+    allowed = _Allower(allow_lines)
+    tree = ast.parse(src, filename=filename)
+
+    edges: List[Tuple[str, str, str]] = []
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            cls = _analyze_class(node, guarded_lines, allow_lines)
+            _report_class(cls, report, allowed, filename)
+            for a, b, line in cls.lock_edges:
+                edges.append((f"{cls.name}.{a}", f"{cls.name}.{b}",
+                              f"{filename}:{line}"))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # module-level function: thread-lifecycle rules still apply
+            dummy = _ClassInfo("")
+            scanner = _MethodScanner(dummy, node.name, node.lineno)
+            for stmt in node.body:
+                scanner.visit(stmt)
+            scanner._finish_threads()
+            for msg, line, _ in scanner.info.join_findings:
+                if not allowed("thread:join-unstarted", line, node.lineno):
+                    report.add("thread:join-unstarted", "warning", msg,
+                               where=node.name, line=line)
+    return FileAnalysis(report=report, lock_edges=edges)
+
+
+def check_file(path: str, subject: str = "") -> FileAnalysis:
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    return check_source(src, filename=path, subject=subject or path)
+
+
+def _analyze_class(node: ast.ClassDef, guarded_lines: Dict[int, str],
+                   allow_lines: Optional[Dict[int, Set[str]]] = None
+                   ) -> _ClassInfo:
+    cls = _prescan_class(node, guarded_lines, allow_lines)
+    for stmt in node.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scanner = _MethodScanner(cls, stmt.name, stmt.lineno)
+            for inner in stmt.body:
+                scanner.visit(inner)
+            scanner._finish_threads()
+            cls.methods[stmt.name] = scanner.info
+            # guarded-by annotations can also sit on a write line inside
+            # any method, not just __init__
+            for acc in scanner.info.accesses:
+                if acc.kind == "write" and acc.lineno in guarded_lines:
+                    cls.annotations.setdefault(acc.field,
+                                               guarded_lines[acc.lineno])
+    # one-level cross-method lock-order expansion: caller holds A and
+    # calls a method whose body acquires B at top level → A→B
+    for info in cls.methods.values():
+        for call in info.self_calls:
+            if not call.held:
+                continue
+            callee = cls.methods.get(call.callee)
+            if callee is None:
+                continue
+            for inner_lock in callee.toplevel_locks:
+                cls.lock_edges.append(
+                    (call.held[-1], inner_lock, call.lineno))
+    return cls
+
+
+def _guarded_fields(cls: _ClassInfo) -> Dict[str, str]:
+    """field → lock. Annotation wins; otherwise inferred when every
+    non-``__init__`` write happens under exactly one lock."""
+    inferred: Dict[str, str] = dict(cls.annotations)
+    if not cls.locks:
+        return inferred
+    writes_under: Dict[str, Set[str]] = {}
+    writes_bare: Set[str] = set()
+    for mname, info in cls.methods.items():
+        if mname == "__init__" or mname.endswith("_locked"):
+            # `*_locked` names the repo's caller-holds-the-lock
+            # convention: its writes are lock-held by contract, but we
+            # cannot attribute WHICH lock — they neither prove nor
+            # disprove guarding
+            continue
+        for acc in info.accesses:
+            if acc.kind == "read":
+                continue
+            if acc.held:
+                writes_under.setdefault(acc.field, set()).add(acc.held[-1])
+            else:
+                writes_bare.add(acc.field)
+    for field, locks in writes_under.items():
+        if field in inferred or field in writes_bare or len(locks) != 1:
+            continue
+        inferred[field] = next(iter(locks))
+    return inferred
+
+
+def _reachable_methods(cls: _ClassInfo) -> Set[str]:
+    """Methods that can run on a non-constructor thread: escapes
+    (Thread targets, registered callbacks) closed over the self-call
+    graph, plus any method that itself takes a class lock (it declared
+    itself concurrency-aware)."""
+    entries: Set[str] = set()
+    for info in cls.methods.values():
+        entries |= info.escapes & set(cls.methods)
+        if info.toplevel_locks or any(a.held for a in info.accesses):
+            entries.add(info.name)
+        # nested closures that escape by name (Thread(target=loop))
+        for nested in cls.nested_of.get(info.name, ()):
+            entries.add(nested)
+    entries.discard("__init__")
+    seen: Set[str] = set()
+    frontier = list(entries)
+    while frontier:
+        m = frontier.pop()
+        if m in seen or m == "__init__":
+            continue
+        seen.add(m)
+        info = cls.methods.get(m)
+        if info is None:
+            continue
+        for call in info.self_calls:
+            if call.callee not in seen:
+                frontier.append(call.callee)
+    return seen
+
+
+def _report_class(cls: _ClassInfo, report: LintReport, allowed: _Allower,
+                  filename: str) -> None:
+    guarded = _guarded_fields(cls)
+    reachable = _reachable_methods(cls)
+    # fields whose REFERENCE is reassigned outside __init__: plain reads
+    # of those can observe a torn compound update, so they are flagged.
+    # A field only ever container-mutated keeps a stable reference —
+    # reading it (`if self._seg is not None`) is the deliberate
+    # check-then-lock idiom, not a race; only its unguarded *mutations*
+    # are findings. An explicit `# guarded-by:` opts into strict mode
+    # (every unguarded access flagged).
+    reassigned = {acc.field
+                  for mname, info in cls.methods.items()
+                  if mname != "__init__"
+                  for acc in info.accesses if acc.kind == "write"}
+    reassigned |= set(cls.annotations)
+
+    for mname, info in cls.methods.items():
+        if mname == "__init__":
+            # ctor runs single-threaded; closures defined IN it
+            # (`__init__.loop` pseudo-methods) do not and are checked
+            continue
+        if any(seg.endswith("_locked") for seg in mname.split(".")):
+            # caller-holds-the-lock convention (see _guarded_fields)
+            in_scope = False
+        else:
+            in_scope = mname in reachable
+        for acc in info.accesses:
+            lock = guarded.get(acc.field)
+            if lock is None or not in_scope:
+                continue
+            if lock in acc.held:
+                continue
+            if acc.kind == "read" and acc.field not in reassigned:
+                continue
+            if "thread:unguarded-access" in cls.field_allows.get(
+                    acc.field, ()) or "thread" in cls.field_allows.get(
+                    acc.field, ()):
+                continue
+            if allowed("thread:unguarded-access", acc.lineno, info.lineno,
+                       cls.lineno):
+                continue
+            report.add(
+                "thread:unguarded-access", "warning",
+                f"{acc.kind} of {cls.name}.{acc.field} (guarded by "
+                f"self.{lock}) without holding it "
+                f"({filename}:{acc.lineno})",
+                where=f"{cls.name}.{mname}:{acc.field}",
+                line=acc.lineno, lock=lock)
+        for cb in info.callback_calls:
+            if allowed("thread:callback-under-lock", cb.lineno, info.lineno,
+                       cls.lineno):
+                continue
+            report.add(
+                "thread:callback-under-lock", "warning",
+                f"{cb.desc}() invoked while holding self.{cb.held[-1]} — "
+                f"user callbacks must run outside the lock "
+                f"({filename}:{cb.lineno})",
+                where=f"{cls.name}.{mname}",
+                line=cb.lineno, lock=cb.held[-1])
+        for msg, line, _ in info.join_findings:
+            if allowed("thread:join-unstarted", line, info.lineno,
+                       cls.lineno):
+                continue
+            report.add(
+                "thread:join-unstarted", "warning",
+                f"{msg} ({filename}:{line})",
+                where=f"{cls.name}.{mname}", line=line)
+
+
+# --------------------------------------------------------------------------
+# package-wide lock-order graph
+# --------------------------------------------------------------------------
+
+
+def lock_cycles(edges: List[Tuple[str, str, str]]
+                ) -> List[List[str]]:
+    """Find elementary cycles in the acquisition digraph (iterative
+    DFS; the graphs here are tiny). Each cycle is returned as a node
+    list rotated so its lexicographically-smallest node leads — a
+    stable identity for fingerprints."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b, _ in edges:
+        if a != b:
+            graph.setdefault(a, set()).add(b)
+    cycles: List[List[str]] = []
+    seen_keys: Set[Tuple[str, ...]] = set()
+
+    def dfs(node: str, path: List[str], on_path: Set[str],
+            visited: Set[str]) -> None:
+        visited.add(node)
+        on_path.add(node)
+        path.append(node)
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_path:
+                cyc = path[path.index(nxt):]
+                i = cyc.index(min(cyc))
+                canon = tuple(cyc[i:] + cyc[:i])
+                if canon not in seen_keys:
+                    seen_keys.add(canon)
+                    cycles.append(list(canon))
+            elif nxt not in visited:
+                dfs(nxt, path, on_path, visited)
+        path.pop()
+        on_path.discard(node)
+
+    visited: Set[str] = set()
+    for start in sorted(graph):
+        if start not in visited:
+            dfs(start, [], set(), visited)
+    return cycles
+
+
+def lock_order_report(edges: List[Tuple[str, str, str]],
+                      subject: str = "runtime:locks") -> LintReport:
+    """Package-level ``thread:lock-order`` findings from the merged
+    per-file edge lists."""
+    report = LintReport(subject)
+    by_pair: Dict[Tuple[str, str], str] = {}
+    for a, b, loc in edges:
+        by_pair.setdefault((a, b), loc)
+    for cyc in lock_cycles(edges):
+        ring = " -> ".join(cyc + [cyc[0]])
+        locs = [by_pair.get((cyc[i], cyc[(i + 1) % len(cyc)]), "?")
+                for i in range(len(cyc))]
+        report.add(
+            "thread:lock-order", "warning",
+            f"inconsistent lock acquisition order: {ring} "
+            f"(acquisition sites: {', '.join(locs)})",
+            where=ring, path=ring)
+    return report

@@ -1,0 +1,83 @@
+"""Runtime static analyzer: the package's own source as the subject.
+
+Orchestrates the two runtime rule families over the framework itself:
+
+- :mod:`.concurrency` sweeps every module under ``paddle_tpu/`` for
+  lock-discipline findings (``thread:unguarded-access``,
+  ``thread:callback-under-lock``, ``thread:join-unstarted``) and
+  contributes per-file lock-acquisition edges, which are merged here
+  into the package-wide graph for ``thread:lock-order`` cycle
+  detection;
+- :mod:`.wire_contracts` extracts and diffs the framed-verb schemas of
+  all three wire surfaces (``wire:schema-drift`` /
+  ``wire:retry-unsafe`` / ``wire:unknown-verb``).
+
+The result is ``(subject, LintReport)`` pairs in the exact shape
+``tools/lint_gate.py`` consumes — same fingerprints, baseline keys,
+SARIF and exit-code machinery as the jaxpr/zoo sweep. Subjects:
+``runtime:<relpath>`` per module, ``runtime:locks`` for the package
+lock graph, ``wire:<surface>`` per wire surface.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+from . import concurrency, wire_contracts
+from .report import LintReport
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def runtime_sources(root: Optional[str] = None) -> List[str]:
+    """Every ``.py`` module under ``paddle_tpu/`` (sorted, stable)."""
+    root = root or PKG_ROOT
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__",))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def _subject_for(path: str, root: str) -> str:
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    return f"runtime:{rel[:-3] if rel.endswith('.py') else rel}"
+
+
+def check_runtime(root: Optional[str] = None,
+                  files: Optional[List[str]] = None,
+                  wire: bool = True) -> List[Tuple[str, LintReport]]:
+    """The ``--runtime`` sweep: concurrency lint per module + the
+    package lock-order graph + the wire-contract diff. Modules with no
+    findings are dropped (the aggregate subjects are always present so
+    a baseline diff can see the sweep ran)."""
+    root = root or PKG_ROOT
+    reports: List[Tuple[str, LintReport]] = []
+    edges: List[Tuple[str, str, str]] = []
+    for path in (files if files is not None else runtime_sources(root)):
+        subject = _subject_for(path, root)
+        analysis = concurrency.check_file(path, subject=subject)
+        edges.extend(analysis.lock_edges)
+        if analysis.report.findings:
+            reports.append((subject, analysis.report))
+    reports.append(("runtime:locks", concurrency.lock_order_report(edges)))
+    if wire:
+        reports.extend(wire_contracts.check_wire())
+    return reports
+
+
+def lock_edges(root: Optional[str] = None,
+               files: Optional[List[str]] = None
+               ) -> List[Tuple[str, str, str]]:
+    """The package-wide lock-acquisition edge list (``tools/
+    lock_order.py``'s data source): ``(Class.lockA, Class.lockB,
+    file:line)`` meaning A was held while B was acquired."""
+    root = root or PKG_ROOT
+    out: List[Tuple[str, str, str]] = []
+    for path in (files if files is not None else runtime_sources(root)):
+        out.extend(concurrency.check_file(path).lock_edges)
+    return out

@@ -1,0 +1,659 @@
+"""Framed-verb wire-contract extraction and drift checking.
+
+The repo speaks one framed protocol on three surfaces — async-PS
+(``parallel/async_ps.py`` ↔ ``native/pserver.cc``), the fleet control
+plane (``fleet/remote.py`` ↔ ``fleet/replica_main.py``), and telemetry
+shipping (``telemetry/shipper.py`` ↔ ``telemetry/collector.py``): one
+ASCII header line (``VERB arg1 arg2 ... [trace=<id>]``) followed by
+zero or more length-prefixed binary bodies, with an optional framed
+reply body.
+
+This module *extracts each verb's frame schema from both sides* —
+the Python client's ``_request``/``call`` f-string headers and payload
+concatenations, the Python server's ``verb == "X"`` dispatch branches
+(``parts[i]`` arity, ``read_exact`` body reads, ``_reply_json``
+replies), and the C server's ``sscanf`` format table — into one
+machine-readable verb table, then diffs the two sides:
+
+- ``wire:schema-drift`` (error) — client and server disagree on header
+  arity, request-body count, or reply-body count. The PR-8 IMPORT bug
+  (client sends ``value``/``accum`` as two concatenated bodies, server
+  read one combined body) is exactly this finding.
+- ``wire:retry-unsafe`` (error) — the server declares a verb
+  ``at-most-once`` (``# retry: at-most-once`` / ``// retry:
+  at-most-once`` annotation) but the client sends it on a retrying
+  path (``idempotent=True``).
+- ``wire:unknown-verb`` (warning) — a verb spoken on only one side.
+
+Retry classification comes from the client's ``idempotent=`` kwarg
+(per-wrapper defaults below) plus the explicit ``retry:`` comment
+annotation convention on either side.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .report import LintReport
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_VERB_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+_RETRY_RE = re.compile(r"(?:#|//)\s*retry:\s*(at-most-once|idempotent)")
+
+IDEMPOTENT, AT_MOST_ONCE = "idempotent", "at-most-once"
+
+
+@dataclasses.dataclass
+class VerbSide:
+    """One side's view of one verb's frame schema."""
+    verb: str
+    args: int                 # header tokens after the verb (trace excluded)
+    bodies: int               # framed request bodies
+    reply_bodies: int         # framed reply bodies (0 or 1)
+    trace: bool = False       # optional `` trace=<id>`` token supported
+    retry: str = IDEMPOTENT   # retry classification on this side
+    where: str = ""           # file:line provenance
+
+    def frame(self) -> Tuple[int, int, int]:
+        return (self.args, self.bodies, self.reply_bodies)
+
+
+# --------------------------------------------------------------------------
+# shared helpers
+# --------------------------------------------------------------------------
+
+
+def _read(path_or_src: str) -> str:
+    if "\n" in path_or_src or not os.path.exists(path_or_src):
+        return path_or_src
+    with open(path_or_src, encoding="utf-8") as fh:
+        return fh.read()
+
+
+def _retry_annotations(src: str) -> Dict[int, str]:
+    """lineno → retry class for every ``# retry:`` comment."""
+    out = {}
+    for i, line in enumerate(src.splitlines(), 1):
+        m = _RETRY_RE.search(line)
+        if m:
+            out[i] = m.group(1)
+    return out
+
+
+def _func_retry(annotations: Dict[int, str], start: int,
+                end: int) -> Optional[str]:
+    for ln, cls in annotations.items():
+        if start <= ln <= end:
+            return cls
+    return None
+
+
+def _merge(table: Dict[str, VerbSide], side: VerbSide) -> None:
+    """Merge one extraction into the per-side verb table. Multiple
+    callsites of the same verb keep the widest schema (they should
+    agree; the cross-side diff is what matters)."""
+    prev = table.get(side.verb)
+    if prev is None:
+        table[side.verb] = side
+        return
+    prev.args = max(prev.args, side.args)
+    prev.bodies = max(prev.bodies, side.bodies)
+    prev.reply_bodies = max(prev.reply_bodies, side.reply_bodies)
+    prev.trace = prev.trace or side.trace
+    if AT_MOST_ONCE in (prev.retry, side.retry):
+        prev.retry = AT_MOST_ONCE
+
+
+# --------------------------------------------------------------------------
+# Python client scraper
+# --------------------------------------------------------------------------
+
+
+def _is_trace_expr(expr: ast.AST, localmap: Dict[str, ast.AST]) -> bool:
+    """Is this placeholder the optional trace suffix? Either a direct
+    ``self._trace_suffix(...)`` call or a local bound to one."""
+    if isinstance(expr, ast.Name):
+        expr = localmap.get(expr.id, expr)
+    if isinstance(expr, ast.Call):
+        fn = expr.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        return name == "_trace_suffix"
+    return False
+
+
+def _header_tokens(node: ast.AST, localmap: Dict[str, ast.AST]):
+    """Parse a header template (Constant str or JoinedStr) → (verb,
+    args, trace) or None when it is not a verb header."""
+    pieces: List[Tuple[str, object]] = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        pieces = [("lit", node.value)]
+    elif isinstance(node, ast.JoinedStr):
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                pieces.append(("lit", str(v.value)))
+            elif isinstance(v, ast.FormattedValue):
+                pieces.append(("ph", v.value))
+    else:
+        return None
+
+    tokens: List[List[Tuple[str, object]]] = [[]]
+    for kind, val in pieces:
+        if kind == "lit":
+            for part in re.split(r"(\s+)", val):
+                if not part:
+                    continue
+                if part.isspace():
+                    tokens.append([])
+                else:
+                    tokens[-1].append(("lit", part))
+        else:
+            tokens[-1].append(("ph", val))
+    tokens = [t for t in tokens if t]
+    if not tokens:
+        return None
+    head = tokens[0]
+    if not (len(head) == 1 and head[0][0] == "lit"
+            and _VERB_RE.match(str(head[0][1]))):
+        return None
+    verb = str(head[0][1])
+
+    args, trace = 0, False
+    for tok in tokens[1:]:
+        # a literal `trace=` piece marks the WHOLE token as the optional
+        # trace field (``trace={span}``); a `_trace_suffix(...)`
+        # placeholder glued onto another token (``{name}{suffix}``) only
+        # removes itself
+        if any(kind == "lit" and str(val).startswith("trace=")
+               for kind, val in tok):
+            trace = True
+            continue
+        kept = [(kind, val) for kind, val in tok
+                if not (kind == "ph" and _is_trace_expr(val, localmap))]
+        if len(kept) < len(tok):
+            trace = True
+        if kept:
+            args += 1
+    return verb, args, trace
+
+
+def _body_count(expr: Optional[ast.AST], localmap: Dict[str, ast.AST],
+                depth: int = 0) -> int:
+    """Framed request bodies = ``+``-concatenated bytes segments in the
+    payload expression (this is what catches a combined-body read on
+    the other side: ``v.tobytes() + a.tobytes()`` is TWO bodies)."""
+    if expr is None:
+        return 0
+    if isinstance(expr, ast.Constant) and expr.value in (b"", "", None):
+        return 0
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        return (_body_count(expr.left, localmap, depth)
+                + _body_count(expr.right, localmap, depth))
+    if isinstance(expr, ast.Name) and depth < 3:
+        bound = localmap.get(expr.id)
+        if bound is not None:
+            return _body_count(bound, localmap, depth + 1)
+    return 1
+
+
+#: per-wrapper request-call defaults: (idempotent default, reply-body
+#: policy). Policy: "body_len" = framed reply iff a body_len kwarg is
+#: passed; "always"/"never" = the wrapper itself decides; extra_args /
+#: bodies = tokens the wrapper appends beyond the template.
+DEFAULT_REQUEST_FUNCS = {
+    "_request": {"idempotent": True, "reply": "body_len"},
+    "call": {"idempotent": True, "reply": "always"},
+    "_one_shot": {"idempotent": False, "reply": "always"},
+    "_call": {"idempotent": True, "reply": "never",
+              "extra_args": 1, "bodies": 1},
+}
+
+
+def scrape_python_client(path_or_src: str, filename: str = "",
+                         request_funcs: Optional[dict] = None
+                         ) -> Dict[str, VerbSide]:
+    src = _read(path_or_src)
+    filename = filename or (path_or_src if "\n" not in path_or_src
+                            else "<client>")
+    funcs_cfg = request_funcs if request_funcs is not None \
+        else DEFAULT_REQUEST_FUNCS
+    tree = ast.parse(src, filename=filename)
+    annotations = _retry_annotations(src)
+    table: Dict[str, VerbSide] = {}
+
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        localmap: Dict[str, ast.AST] = {}
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name):
+                localmap.setdefault(sub.targets[0].id, sub.value)
+        fn_retry = _func_retry(annotations, fn.lineno,
+                               getattr(fn, "end_lineno", fn.lineno))
+
+        headers_in_calls = set()
+        for call in [n for n in ast.walk(fn) if isinstance(n, ast.Call)]:
+            cfn = call.func
+            cname = cfn.attr if isinstance(cfn, ast.Attribute) else (
+                cfn.id if isinstance(cfn, ast.Name) else "")
+            if cname not in funcs_cfg or not call.args:
+                continue
+            cfg = funcs_cfg[cname]
+            parsed = _header_tokens(call.args[0], localmap)
+            if parsed is None:
+                continue
+            headers_in_calls.add(id(call.args[0]))
+            verb, args, trace = parsed
+            payload = call.args[1] if len(call.args) > 1 else None
+            if payload is None:
+                for kw in call.keywords:
+                    if kw.arg in ("payload", "body", "data"):
+                        payload = kw.value
+            idempotent = cfg["idempotent"]
+            body_len_kw = False
+            for kw in call.keywords:
+                if kw.arg == "idempotent" and isinstance(kw.value,
+                                                         ast.Constant):
+                    idempotent = bool(kw.value.value)
+                if kw.arg == "body_len":
+                    body_len_kw = True
+            reply = {"always": 1, "never": 0}.get(
+                cfg["reply"], 1 if body_len_kw else 0)
+            retry = fn_retry or (IDEMPOTENT if idempotent else AT_MOST_ONCE)
+            _merge(table, VerbSide(
+                verb=verb, args=args + cfg.get("extra_args", 0),
+                bodies=cfg.get("bodies", _body_count(payload, localmap)),
+                reply_bodies=reply, trace=trace, retry=retry,
+                where=f"{filename}:{call.lineno}"))
+
+        # manually-framed headers: an f-string verb header assigned to a
+        # local and sent via sock.sendall(header + body1 + body2 ...)
+        _scrape_manual(fn, localmap, headers_in_calls, annotations,
+                       filename, table)
+    return table
+
+
+def _flatten_add(expr: ast.AST) -> List[ast.AST]:
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        return _flatten_add(expr.left) + _flatten_add(expr.right)
+    return [expr]
+
+
+def _scrape_manual(fn, localmap, headers_in_calls, annotations, filename,
+                   table: Dict[str, VerbSide]) -> None:
+    fn_retry = _func_retry(annotations, fn.lineno,
+                           getattr(fn, "end_lineno", fn.lineno))
+    header_vars: Dict[str, Tuple[str, int, bool, int]] = {}
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                and isinstance(sub.targets[0], ast.Name):
+            for j in ast.walk(sub.value):
+                if isinstance(j, ast.JoinedStr) and id(j) not in \
+                        headers_in_calls:
+                    parsed = _header_tokens(j, localmap)
+                    if parsed is not None:
+                        verb, args, trace = parsed
+                        header_vars[sub.targets[0].id] = (
+                            verb, args, trace, sub.lineno)
+    for call in [n for n in ast.walk(fn) if isinstance(n, ast.Call)]:
+        cfn = call.func
+        if not (isinstance(cfn, ast.Attribute) and cfn.attr == "sendall"
+                and call.args):
+            continue
+        arg = call.args[0]
+        # raw transport verb: sendall(b"QUIT\n")
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, bytes):
+            m = re.match(rb"^([A-Z][A-Z0-9_]*)\n$", arg.value)
+            if m:
+                _merge(table, VerbSide(
+                    verb=m.group(1).decode(), args=0, bodies=0,
+                    reply_bodies=0, retry=fn_retry or IDEMPOTENT,
+                    where=f"{filename}:{call.lineno}"))
+            continue
+        leaves = _flatten_add(arg)
+        hdr = next((l for l in leaves if isinstance(l, ast.Name)
+                    and l.id in header_vars), None)
+        if hdr is None:
+            continue
+        verb, args, trace, line = header_vars[hdr.id]
+        bodies = sum(_body_count(l, localmap) for l in leaves
+                     if l is not hdr
+                     and not (isinstance(l, ast.Constant)
+                              and l.value == b"\n"))
+        _merge(table, VerbSide(
+            verb=verb, args=args, bodies=bodies, reply_bodies=0,
+            trace=trace, retry=fn_retry or IDEMPOTENT,
+            where=f"{filename}:{line}"))
+
+
+# --------------------------------------------------------------------------
+# Python server scraper
+# --------------------------------------------------------------------------
+
+
+def scrape_python_server(path_or_src: str, filename: str = "",
+                         dispatchers: Tuple[str, ...] = (),
+                         parts_var: str = "parts",
+                         body_reader: str = "read_exact",
+                         reply_marker: str = "_reply_json"
+                         ) -> Dict[str, VerbSide]:
+    src = _read(path_or_src)
+    filename = filename or (path_or_src if "\n" not in path_or_src
+                            else "<server>")
+    tree = ast.parse(src, filename=filename)
+    annotations = _retry_annotations(src)
+    funcs: Dict[str, ast.FunctionDef] = {
+        n.name: n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    table: Dict[str, VerbSide] = {}
+
+    for dname in dispatchers:
+        disp = funcs.get(dname)
+        if disp is None:
+            continue
+        for branch in ast.walk(disp):
+            if not isinstance(branch, ast.If):
+                continue
+            verbs = _branch_verbs(branch.test)
+            if not verbs:
+                continue
+            contrib = _scan_branch(branch.body, parts_var, body_reader,
+                                   reply_marker, funcs, annotations, src,
+                                   branch_lineno=branch.lineno)
+            args, bodies, reply, trace, retry, line = contrib
+            for verb in verbs:
+                _merge(table, VerbSide(
+                    verb=verb, args=args, bodies=bodies,
+                    reply_bodies=reply, trace=trace,
+                    retry=retry or IDEMPOTENT,
+                    where=f"{filename}:{line}"))
+    return table
+
+
+def _branch_verbs(test: ast.AST) -> List[str]:
+    """CAPS string comparands in a dispatch test: ``verb == "X"``,
+    ``parts[0] == "X"``, ``verb in ("X", "Y")`` — including inside
+    ``and``/``or`` guards."""
+    verbs: List[str] = []
+    for cmp in [n for n in ast.walk(test) if isinstance(n, ast.Compare)]:
+        for comparator in cmp.comparators:
+            consts = [comparator] if isinstance(comparator, ast.Constant) \
+                else (list(comparator.elts)
+                      if isinstance(comparator, (ast.Tuple, ast.List,
+                                                 ast.Set)) else [])
+            for c in consts:
+                if isinstance(c, ast.Constant) and isinstance(c.value, str) \
+                        and _VERB_RE.match(c.value):
+                    verbs.append(c.value)
+    return verbs
+
+
+def _scan_branch(stmts, parts_var, body_reader, reply_marker, funcs,
+                 annotations, src, branch_lineno: int = 0):
+    args, bodies, reply, trace = 0, 0, 0, False
+    retry: Optional[str] = None
+    line = stmts[0].lineno if stmts else 0
+    regions: List[Tuple[int, int]] = []
+
+    def scan(nodes, pvar):
+        nonlocal args, bodies, reply, trace
+        for node in nodes:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Subscript) and \
+                        isinstance(sub.value, ast.Name) and \
+                        sub.value.id == pvar:
+                    sl = sub.slice
+                    if isinstance(sl, ast.Constant) and \
+                            isinstance(sl.value, int):
+                        args = max(args, sl.value)
+                    elif isinstance(sl, ast.Slice):
+                        trace = True
+                elif isinstance(sub, ast.Call):
+                    fname = sub.func.attr \
+                        if isinstance(sub.func, ast.Attribute) else (
+                            sub.func.id if isinstance(sub.func, ast.Name)
+                            else "")
+                    if fname == body_reader:
+                        bodies += 1
+                    elif fname == reply_marker:
+                        reply = 1
+
+    scan(stmts, parts_var)
+    # the region opens at the `if` line, not the first statement: a
+    # `# retry:` comment sitting right under the dispatch test (before
+    # any statement) still belongs to the branch
+    start = branch_lineno or (min(s.lineno for s in stmts) if stmts else 0)
+    end = max(getattr(s, "end_lineno", s.lineno) for s in stmts) \
+        if stmts else 0
+    regions.append((start, end))
+
+    # one-level expansion into self.handle_*(...) — the branch passes
+    # `parts` (mapped to the callee's matching param) and the callee
+    # does the body reads / json reply
+    for node in stmts:
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)):
+                continue
+            callee = funcs.get(sub.func.attr)
+            if callee is None or sub.func.attr in (body_reader,
+                                                   reply_marker):
+                continue
+            pvar = parts_var
+            params = [a.arg for a in callee.args.args if a.arg != "self"]
+            for pos, argnode in enumerate(sub.args):
+                if isinstance(argnode, ast.Name) \
+                        and argnode.id == parts_var and pos < len(params):
+                    pvar = params[pos]
+            scan(callee.body, pvar)
+            regions.append((callee.lineno,
+                            getattr(callee, "end_lineno", callee.lineno)))
+
+    for rs, re_ in regions:
+        cls = _func_retry(annotations, rs, re_)
+        if cls == AT_MOST_ONCE or (cls and retry is None):
+            retry = cls
+    return args, bodies, reply, trace, retry, line
+
+
+# --------------------------------------------------------------------------
+# C server scraper (native/pserver.cc)
+# --------------------------------------------------------------------------
+
+_C_SSCANF_RE = re.compile(
+    r'sscanf\(line\.c_str\(\),\s*"([A-Z][A-Z0-9_]*)((?:\s+%[^"\s]+)*)"',
+    re.S)
+_C_EQ_RE = re.compile(r'line\s*==\s*"([A-Z][A-Z0-9_]*)"')
+
+
+def scrape_c_server(path_or_src: str, filename: str = ""
+                    ) -> Dict[str, VerbSide]:
+    """Scrape the C side's verb table out of ``ServeClient``'s
+    ``sscanf``-format dispatch chain. The ``line.rfind(...)``
+    error-backstop branch is deliberately NOT a verb definition (it
+    only classifies malformed headers) and is ignored: only ``sscanf``
+    formats and ``line == "VERB"`` equality branches define verbs."""
+    text = _read(path_or_src)
+    filename = filename or (path_or_src if "\n" not in path_or_src
+                            else "<pserver.cc>")
+    start = text.find("ServeClient")
+    end = text.find("int main(")
+    region_text = text[max(start, 0):end if end > 0 else len(text)]
+    offset = max(start, 0)
+
+    anchors: List[Tuple[int, str, int]] = []   # (pos, verb, args)
+    for m in _C_SSCANF_RE.finditer(region_text):
+        fmt_args = m.group(2).count("%")
+        anchors.append((m.start(), m.group(1), fmt_args))
+    for m in _C_EQ_RE.finditer(region_text):
+        anchors.append((m.start(), m.group(1), 0))
+    anchors.sort()
+
+    table: Dict[str, VerbSide] = {}
+    for i, (pos, verb, args) in enumerate(anchors):
+        nxt = anchors[i + 1][0] if i + 1 < len(anchors) else len(region_text)
+        branch = region_text[pos:nxt]
+        line = text.count("\n", 0, offset + pos) + 1
+        retry_m = _RETRY_RE.search(branch)
+        _merge(table, VerbSide(
+            verb=verb, args=args,
+            bodies=branch.count("ReadBody("),
+            reply_bodies=1 if "&payload" in branch else 0,
+            trace="WithTrace(" in branch,
+            retry=retry_m.group(1) if retry_m else IDEMPOTENT,
+            where=f"{filename}:{line}"))
+    return table
+
+
+# --------------------------------------------------------------------------
+# surfaces, comparison, verb table
+# --------------------------------------------------------------------------
+
+#: verbs owned by the shared framed transport (FramedClient.close), not
+#: by any one surface's client module
+TRANSPORT_VERBS = ("QUIT",)
+
+SURFACES = {
+    "ps": {
+        "client": os.path.join(_PKG_ROOT, "parallel", "async_ps.py"),
+        "server": os.path.join(_PKG_ROOT, "native", "pserver.cc"),
+        "server_kind": "c",
+    },
+    "fleet": {
+        "client": os.path.join(_PKG_ROOT, "fleet", "remote.py"),
+        "server": os.path.join(_PKG_ROOT, "fleet", "replica_main.py"),
+        "server_kind": "py",
+        "dispatchers": ("serve_conn",),
+    },
+    "telemetry": {
+        "client": os.path.join(_PKG_ROOT, "telemetry", "shipper.py"),
+        "server": os.path.join(_PKG_ROOT, "telemetry", "collector.py"),
+        "server_kind": "py",
+        "dispatchers": ("_serve_conn", "_dispatch"),
+    },
+}
+
+#: the transport client file scanned for TRANSPORT_VERBS on surfaces
+#: whose client module rides FramedClient
+_TRANSPORT_CLIENT = os.path.join(_PKG_ROOT, "parallel", "async_ps.py")
+
+
+def scrape_surface(name: str, cfg: Optional[dict] = None
+                   ) -> Tuple[Dict[str, VerbSide], Dict[str, VerbSide]]:
+    cfg = cfg or SURFACES[name]
+    client = scrape_python_client(cfg["client"])
+    if cfg.get("server_kind", "py") == "c":
+        server = scrape_c_server(cfg["server"])
+    else:
+        server = scrape_python_server(
+            cfg["server"], dispatchers=cfg.get("dispatchers", ()),
+            parts_var=cfg.get("parts_var", "parts"),
+            body_reader=cfg.get("body_reader", "read_exact"),
+            reply_marker=cfg.get("reply_marker", "_reply_json"))
+    # fleet/telemetry clients inherit the framed transport's QUIT
+    if cfg.get("server_kind") != "c" and cfg["client"] != _TRANSPORT_CLIENT \
+            and os.path.exists(_TRANSPORT_CLIENT):
+        base = scrape_python_client(_TRANSPORT_CLIENT)
+        for verb in TRANSPORT_VERBS:
+            if verb in base and verb not in client:
+                client[verb] = base[verb]
+    return client, server
+
+
+def compare_tables(surface: str, client: Dict[str, VerbSide],
+                   server: Dict[str, VerbSide]) -> LintReport:
+    report = LintReport(f"wire:{surface}")
+    for verb in sorted(set(client) | set(server)):
+        c, s = client.get(verb), server.get(verb)
+        if c is None or s is None:
+            side = "server" if c is None else "client"
+            have = (s or c)
+            report.add(
+                "wire:unknown-verb", "warning",
+                f"{verb} is spoken only by the {side} ({have.where}) — "
+                f"the other side will reject or desync on it",
+                where=f"{verb}", path=side)
+            continue
+        for field, cv, sv in (("arity", c.args, s.args),
+                              ("bodies", c.bodies, s.bodies),
+                              ("reply", c.reply_bodies, s.reply_bodies)):
+            if cv != sv:
+                report.add(
+                    "wire:schema-drift", "error",
+                    f"{verb}: client {field}={cv} ({c.where}) but server "
+                    f"{field}={sv} ({s.where}) — the framed stream "
+                    f"desyncs or truncates",
+                    where=f"{verb}:{field}", expected=sv, got=cv)
+        if s.retry == AT_MOST_ONCE and c.retry == IDEMPOTENT:
+            report.add(
+                "wire:retry-unsafe", "error",
+                f"{verb}: server declares at-most-once ({s.where}) but "
+                f"the client path retries (idempotent=True, {c.where}) — "
+                f"a lost reply re-applies a non-idempotent effect",
+                where=verb, expected=AT_MOST_ONCE, got="retrying-client")
+    return report
+
+
+def check_wire() -> List[Tuple[str, LintReport]]:
+    """All three surfaces → ``(subject, report)`` pairs for the gate."""
+    out = []
+    for name in SURFACES:
+        client, server = scrape_surface(name)
+        out.append((f"wire:{name}", compare_tables(name, client, server)))
+    return out
+
+
+def verb_table() -> List[dict]:
+    """The merged machine-readable verb table across all surfaces —
+    what ``python -m paddle_tpu.analysis --wire-table`` renders and
+    MIGRATION.md's "Wire contracts" section is generated from."""
+    rows = []
+    for name in SURFACES:
+        client, server = scrape_surface(name)
+        for verb in sorted(set(client) | set(server)):
+            c, s = client.get(verb), server.get(verb)
+            both = c is not None and s is not None
+            ref = s or c
+            retry = AT_MOST_ONCE if AT_MOST_ONCE in (
+                (c.retry if c else None), (s.retry if s else None)) \
+                else IDEMPOTENT
+            rows.append({
+                "surface": name, "verb": verb,
+                "sides": "both" if both else
+                ("client-only" if s is None else "server-only"),
+                "args": ref.args,
+                "bodies": ref.bodies,
+                "reply_bodies": ref.reply_bodies,
+                "trace": bool((c and c.trace) or (s and s.trace)),
+                "retry": retry,
+                "client": c.where if c else "-",
+                "server": s.where if s else "-",
+            })
+    return rows
+
+
+def render_verb_table_md(rows: Optional[List[dict]] = None) -> str:
+    """Markdown for MIGRATION.md's "Wire contracts" section."""
+    rows = verb_table() if rows is None else rows
+    out = ["<!-- generated by: python -m paddle_tpu.analysis"
+           " --wire-table -->", ""]
+    for surface in dict.fromkeys(r["surface"] for r in rows):
+        out.append(f"### `{surface}` surface")
+        out.append("")
+        out.append("| verb | sides | header args | request bodies "
+                   "| reply bodies | trace | retry |")
+        out.append("|---|---|---|---|---|---|---|")
+        for r in rows:
+            if r["surface"] != surface:
+                continue
+            out.append(
+                f"| `{r['verb']}` | {r['sides']} | {r['args']} "
+                f"| {r['bodies']} | {r['reply_bodies']} "
+                f"| {'yes' if r['trace'] else '—'} | {r['retry']} |")
+        out.append("")
+    return "\n".join(out)

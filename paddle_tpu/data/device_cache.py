@@ -252,9 +252,10 @@ class DeviceCache:
         budget = self._resolve_budget(n, device_feed)
         if budget is None:
             with self._lock:
-                self._off_reason = "no HBM budget (CPU backend? pass " \
-                                   "an explicit device_cache byte budget)"
-            _log().info("device cache off: %s", self._off_reason)
+                reason = "no HBM budget (CPU backend? pass " \
+                         "an explicit device_cache byte budget)"
+                self._off_reason = reason
+            _log().info("device cache off: %s", reason)
             return False
         per_dev = device_feed_resident_nbytes(device_feed)
         wire_b = device_feed_nbytes(device_feed)
@@ -279,12 +280,13 @@ class DeviceCache:
             if self._invalid_reason is not None or not self._chunks:
                 return
             self._sealed = True
-            self._complete = (not self._rejected
-                              and sum(n for n, _, _ in self._chunks)
-                              == int(epoch_steps))
+            complete = (not self._rejected
+                        and sum(n for n, _, _ in self._chunks)
+                        == int(epoch_steps))
+            self._complete = complete
         _log().info(
             "device cache sealed: %s, %d steps / %d bytes resident per "
-            "device", "full" if self._complete else "partial",
+            "device", "full" if complete else "partial",
             self.cached_steps, self.resident_bytes)
 
     # -- epoch-2+ serving ----------------------------------------------------

@@ -672,8 +672,8 @@ class DeviceFeeder:
                         pass
 
         t = threading.Thread(target=fill, daemon=True)
-        self._threads.append(t)
         t.start()
+        self._threads.append(t)
         try:
             while True:
                 t_wait = time.perf_counter()

@@ -356,7 +356,10 @@ class RemotePending:
         self.rid = rid
         self._span = span
         self._lock = threading.Lock()
-        self.dispatched = False
+        # monotonic bool (False -> True once, under _lock): _classify's
+        # lock-free read can only be STALE-False, which classifies a
+        # lost connection conservatively (ReplicaDied, never resent)
+        self.dispatched = False   # lint: allow(thread:unguarded-access)
         self._done = threading.Event()
         self._value = None
         self._error: Optional[BaseException] = None
@@ -679,6 +682,7 @@ class RemoteReplica:
         span = self.journal.new_span()
         meta, payload = pack_tree(feed)
         dl = "-" if deadline is None else repr(float(deadline))
+        # retry: at-most-once
         header = (f"SUBMIT {len(meta)} {len(payload)} {dl} "
                   f"trace={span}").encode() + b"\n"
         budget = self.connect_timeout

@@ -94,6 +94,7 @@ class _ReplicaService:
     # -- verbs ---------------------------------------------------------------
 
     def handle_submit(self, conn: socket.socket, parts) -> None:
+        # retry: at-most-once — a replayed SUBMIT runs inference twice
         from ..parallel.async_ps import read_exact
         from .remote import error_payload, pack_tree, unpack_tree
 

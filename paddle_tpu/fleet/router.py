@@ -161,7 +161,10 @@ class FleetRouter:
         self._replicas: Dict[str, _Replica] = {
             name: _Replica(name, srv) for name, srv in replicas.items()}
         self.default_deadline = default_deadline
-        self.dirname = dirname
+        # reassigned (whole-reference) under the router lock on reload;
+        # replace()'s lock-free read may spawn from the previous
+        # artifact during a concurrent reload — stale but never torn
+        self.dirname = dirname   # lint: allow(thread:unguarded-access)
         self._server_kw: Dict[str, Any] = dict(server_kw or {})
         # probe_timeout bounds EVERY replica health probe the router
         # takes (aggregation and routing): a probe that never returns

@@ -475,17 +475,20 @@ void ServeClient(PServer* ps, int fd) {
     } else if (sscanf(line.c_str(), "PULL %lld %255s", &a, name) == 2) {
       resp = WithTrace(ps->Pull(int(a), name, &payload), line);
     } else if (sscanf(line.c_str(), "PUSH %lld %255s %lld", &a, name, &b) == 3) {
+      // retry: at-most-once — replaying a gradient double-applies it
       std::string body;
       if (!ReadBody(fd, b, &body)) break;
       resp = WithTrace(ps->Push(int(a), name, body), line);
     } else if (float scale = 0.f;
                sscanf(line.c_str(), "PUSHQ %lld %255s %lld %f",
                       &a, name, &b, &scale) == 4) {
+      // retry: at-most-once
       std::string body;
       if (b < 0 || !ReadBody(fd, size_t(b), &body)) break;
       resp = WithTrace(ps->PushQuantized(int(a), name, b, scale, body), line);
     } else if (sscanf(line.c_str(), "PUSHROWS %lld %255s %lld %lld",
                       &a, name, &b, &c) == 4) {
+      // retry: at-most-once
       // reject before the size_t casts: a huge b or c would wrap the
       // b*c*sizeof(float) product past 2^64 to a tiny length, slipping
       // under the 512MB ReadBody cap while PushRows later indexes far

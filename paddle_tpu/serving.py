@@ -526,8 +526,11 @@ class PredictorServer:
         from . import io as _io
 
         self._io = _io
-        self._predictor = predictor
-        self._generation = 1
+        # published atomically under _model_lock; reads are deliberately
+        # lock-free reference snapshots (reloads are serialized by
+        # _reload_lock, so any read sees a complete predictor)
+        self._predictor = predictor   # lint: allow(thread:unguarded-access)
+        self._generation = 1          # lint: allow(thread:unguarded-access)
         self._model_lock = threading.Lock()
         self._reload_lock = threading.Lock()
         self._last_reload_error: Optional[BaseException] = None

@@ -486,7 +486,10 @@ class AlertEngine:
         names = [r.name for r in rules]
         if len(set(names)) != len(names):
             raise AlertRuleError(f"duplicate rule names in {sorted(names)}")
-        self.rules = list(rules)
+        # swapped as a whole list under _lock (load_rules); readers
+        # iterate whichever complete snapshot reference they grabbed —
+        # per-instance alert STATE is what _lock actually guards
+        self.rules = list(rules)   # lint: allow(thread:unguarded-access)
         self.on_transition = on_transition
         self.resolved_keep_s = float(resolved_keep_s)
         # guards _active/_resolved/transitions_total: the eval thread

@@ -577,7 +577,11 @@ class TelemetryCollector:
         self._seg: Optional[Any] = None
         self._seg_lock = threading.Lock()
         self._promote_lock = threading.Lock()
-        self._standby = bool(standby)
+        # one-way flag (True -> False exactly once, in promote() under
+        # _promote_lock): the hot paths read it lock-free and promote()
+        # re-checks under the lock, so a stale True only costs one extra
+        # promote() call
+        self._standby = bool(standby)   # lint: allow(thread:unguarded-access)
         # the split-brain fence: a standby only promotes once the
         # active writer's heartbeat (stamped every eval tick, removed
         # on clean close) has been silent this long — a transient

@@ -1,0 +1,75 @@
+"""Planted-defect fixture for the runtime concurrency analyzer.
+
+Every class below carries exactly ONE deliberate instance of a
+``thread:*`` rule — the golden findings ``tests/test_analysis_runtime.py``
+pins (rule, ``where``, fingerprint stability). This module is analyzed
+as SOURCE (``paddle_tpu.analysis.concurrency`` never imports it); it is
+import-safe only so pytest collection machinery can't trip over it.
+
+Never "fix" these: each one is the test oracle for its rule.
+"""
+
+import threading
+
+
+class GuardedCounter:
+    """Planted: ``thread:unguarded-access`` (snapshot reads ``_count``
+    bare) and ``thread:callback-under-lock`` (``on_full`` fires inside
+    the lock)."""
+
+    def __init__(self, on_full=None):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._routes = {}
+        self.on_full = on_full
+
+    def start(self):
+        # snapshot escapes into a route table -> thread-reachable
+        self._routes["snapshot"] = self.snapshot
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    def _loop(self):
+        with self._lock:
+            self._count += 1
+            if self._count >= 10 and self.on_full is not None:
+                self.on_full()          # planted: callback-under-lock
+
+    def snapshot(self):
+        return self._count              # planted: unguarded-access
+
+
+class RegisterBeforeStart:
+    """Planted: ``thread:join-unstarted`` — the worker Thread is
+    published into ``self._workers`` before ``.start()`` (the
+    ``_spawn_worker`` bug class)."""
+
+    def __init__(self):
+        self._workers = []
+
+    def spawn(self):
+        t = threading.Thread(target=self._run, daemon=True)
+        self._workers.append(t)         # planted: registered unstarted
+        t.start()
+
+    def _run(self):
+        pass
+
+
+class InvertedLocks:
+    """Planted: ``thread:lock-order`` — ``transfer`` takes a then b,
+    ``refund`` takes b then a."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def transfer(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def refund(self):
+        with self._b:
+            with self._a:
+                pass

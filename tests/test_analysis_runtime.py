@@ -1,0 +1,541 @@
+"""analysis.runtime — the source-level analyzer (thread:* lock
+discipline + wire:* framed-verb contracts) and its CI surface
+(tools/lint_gate --runtime, tools/lock_order, --wire-table).
+
+Three layers of acceptance:
+
+- **golden findings** — ``tests/runtime_lint_fixture.py`` plants one
+  instance of every ``thread:*`` rule; the pins here are the oracle
+  (rule code, ``where``, fingerprint stability under line shifts —
+  the property that keeps committed baselines alive across edits);
+- **historical regressions** — pre-fix reconstructions of four bug
+  shapes this repo actually shipped and later fixed (AlertEngine
+  snapshot race, CircuitBreaker on_trip under the lock, _spawn_worker
+  register-before-start, the IMPORT combined-body read) must each be
+  detected;
+- **contracts** — the extracted verb table covers every verb on all
+  three live wire surfaces with zero findings, and the gate/tool exit
+  codes follow the shared 0/1/3 (tools: 0/2/3) convention.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from paddle_tpu.analysis import concurrency, runtime, wire_contracts
+from paddle_tpu.analysis.report import LintReport
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from tools import lint_gate, lock_order
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "runtime_lint_fixture.py")
+
+
+def _fixture_reports():
+    return runtime.check_runtime(root=os.path.dirname(FIXTURE),
+                                 files=[FIXTURE], wire=False)
+
+
+def _findings(reports):
+    return [(subj, f) for subj, rep in reports for f in rep.findings]
+
+
+# --------------------------------------------------------------------------
+# golden findings: one planted instance of every thread:* rule
+# --------------------------------------------------------------------------
+
+
+class TestGoldenFindings:
+    def test_every_thread_rule_fires_once(self):
+        found = _findings(_fixture_reports())
+        by_code = {}
+        for _, f in found:
+            by_code.setdefault(f.code, []).append(f)
+        assert sorted(by_code) == ["thread:callback-under-lock",
+                                   "thread:join-unstarted",
+                                   "thread:lock-order",
+                                   "thread:unguarded-access"]
+        assert all(len(v) == 1 for v in by_code.values()), by_code
+
+    def test_unguarded_access_names_method_and_field(self):
+        found = _findings(_fixture_reports())
+        (f,) = [f for _, f in found if f.code == "thread:unguarded-access"]
+        assert f.where == "GuardedCounter.snapshot:_count"
+        assert f.data["lock"] == "_lock"
+
+    def test_callback_under_lock_names_the_callback(self):
+        found = _findings(_fixture_reports())
+        (f,) = [f for _, f in found
+                if f.code == "thread:callback-under-lock"]
+        assert f.where == "GuardedCounter._loop"
+        assert "on_full" in f.message and "_lock" in f.message
+
+    def test_join_unstarted_names_registration_site(self):
+        found = _findings(_fixture_reports())
+        (f,) = [f for _, f in found if f.code == "thread:join-unstarted"]
+        assert f.where == "RegisterBeforeStart.spawn"
+        assert "before .start()" in f.message
+
+    def test_lock_order_ring_is_canonical(self):
+        found = _findings(_fixture_reports())
+        (subj, f), = [(s, f) for s, f in found
+                      if f.code == "thread:lock-order"]
+        assert subj == "runtime:locks"
+        assert f.where == ("InvertedLocks._a -> InvertedLocks._b "
+                           "-> InvertedLocks._a")
+
+    def test_fingerprints_stable_under_line_shift(self):
+        """The property committed baselines depend on: moving code up
+        or down a file must not invalidate a suppression."""
+        with open(FIXTURE, encoding="utf-8") as fh:
+            src = fh.read()
+        base = concurrency.check_source(src, filename=FIXTURE)
+        shifted = concurrency.check_source("# pad\n\n\n" + src,
+                                           filename=FIXTURE)
+        assert ({f.fingerprint for f in base.report.findings}
+                == {f.fingerprint for f in shifted.report.findings})
+        assert base.report.findings   # the set wasn't trivially empty
+
+
+# --------------------------------------------------------------------------
+# suppression conventions
+# --------------------------------------------------------------------------
+
+
+_COUNTER_TEMPLATE = '''
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0{field_allow}
+
+    def start(self):
+        self._routes = {{"peek": self.peek}}
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):{def_allow}
+        return self._n{line_allow}
+'''
+
+
+def _counter_src(field_allow="", def_allow="", line_allow=""):
+    return _COUNTER_TEMPLATE.format(field_allow=field_allow,
+                                    def_allow=def_allow,
+                                    line_allow=line_allow)
+
+
+class TestSuppression:
+    def test_unsuppressed_baseline_fires(self):
+        rep = concurrency.check_source(_counter_src()).report
+        assert [f.code for f in rep.findings] == ["thread:unguarded-access"]
+
+    def test_line_level_allow(self):
+        rep = concurrency.check_source(_counter_src(
+            line_allow="   # lint: allow(thread:unguarded-access)")).report
+        assert not rep.findings
+
+    def test_field_level_allow_on_init_line(self):
+        rep = concurrency.check_source(_counter_src(
+            field_allow="   # lint: allow(thread:unguarded-access)")).report
+        assert not rep.findings
+
+    def test_family_allow_on_def_line(self):
+        rep = concurrency.check_source(_counter_src(
+            def_allow="   # lint: allow(thread)")).report
+        assert not rep.findings
+
+    def test_guarded_by_annotation_declares_strict_mode(self):
+        """A mutate-only container field's plain reads pass inference
+        (stable-reference check-then-lock idiom) — until ``guarded-by:``
+        opts the field into strict mode."""
+        src = '''
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {{}}{anno}
+
+    def start(self):
+        self._routes = {{"peek": self.peek}}
+
+    def put(self, k, v):
+        with self._lock:
+            self._items[k] = v
+
+    def peek(self, k):
+        return self._items.get(k)
+'''
+        lax = concurrency.check_source(src.format(anno="")).report
+        assert not lax.findings
+        strict = concurrency.check_source(src.format(
+            anno="   # guarded-by: _lock")).report
+        assert [f.where for f in strict.findings] == ["C.peek:_items"]
+
+
+# --------------------------------------------------------------------------
+# historical regressions: pre-fix reconstructions must be detected
+# --------------------------------------------------------------------------
+
+
+class TestHistoricalRegressions:
+    def test_alert_engine_snapshot_race(self):
+        """The AlertEngine snapshot bug shape: evaluate/restore write
+        ``_state`` under the engine lock while a route-registered
+        snapshot iterates it bare — the KeyError race."""
+        src = '''
+import threading
+
+class AlertEngine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+        self._routes = {}
+
+    def subscribe(self, server):
+        self._routes["alerts"] = self.snapshot
+
+    def evaluate(self, samples):
+        with self._lock:
+            for name in list(self._state):
+                if name not in samples:
+                    del self._state[name]
+            self._state["last"] = samples
+
+    def restore(self, saved):
+        with self._lock:
+            self._state = dict(saved)
+
+    def snapshot(self):
+        out = {}
+        for name in self._state:
+            out[name] = self._state[name]
+        return out
+'''
+        rep = concurrency.check_source(src).report
+        wheres = [f.where for f in rep.findings
+                  if f.code == "thread:unguarded-access"]
+        assert "AlertEngine.snapshot:_state" in wheres
+
+    def test_circuit_breaker_on_trip_under_lock(self):
+        """The breaker bug shape: the user's on_trip callback (a ctor
+        param stored on self) fires inside the breaker lock."""
+        src = '''
+import threading
+
+class CircuitBreaker:
+    def __init__(self, threshold, on_trip=None):
+        self._lock = threading.Lock()
+        self._threshold = threshold
+        self._failures = 0
+        self.on_trip = on_trip
+
+    def record_failure(self):
+        with self._lock:
+            self._failures += 1
+            if self._failures >= self._threshold and self.on_trip:
+                self.on_trip()
+'''
+        rep = concurrency.check_source(src).report
+        cbs = [f for f in rep.findings
+               if f.code == "thread:callback-under-lock"]
+        assert [f.where for f in cbs] == ["CircuitBreaker.record_failure"]
+        assert "on_trip" in cbs[0].message
+
+    def test_spawn_worker_register_before_start(self):
+        """The serving worker-pool bug shape: the Thread lands in the
+        shared worker list before ``.start()`` — a concurrent join
+        sweep sees a never-started Thread."""
+        src = '''
+import threading
+
+class PredictorServer:
+    def __init__(self):
+        self._workers = []
+
+    def _spawn_worker(self):
+        t = threading.Thread(target=self._worker_loop, daemon=True)
+        self._workers.append(t)
+        t.start()
+
+    def _worker_loop(self):
+        pass
+'''
+        rep = concurrency.check_source(src).report
+        js = [f for f in rep.findings if f.code == "thread:join-unstarted"]
+        assert [f.where for f in js] == ["PredictorServer._spawn_worker"]
+
+    def test_import_combined_body_drift(self):
+        """The IMPORT migration bug shape: the client concatenates
+        value+accum as TWO framed bodies while the pre-fix server read
+        ONE combined body — schema drift on the body count."""
+        client_src = '''
+class PSClient:
+    def _request(self, line, payload=b"", idempotent=True, body_len=None):
+        pass
+
+    def import_param(self, name, value, accum, dim):
+        v = value.tobytes()
+        a = accum.tobytes()
+        self._request(
+            f"IMPORT {name} {len(v)} {len(a)} {dim}", v + a)
+'''
+        server_src = '''
+void ServeClient(PServer* ps, int fd) {
+  std::string line;
+  while (ReadLine(fd, &line)) {
+    std::string resp, payload;
+    char name[256];
+    long long a = 0, b = 0, c = 0;
+    if (sscanf(line.c_str(), "IMPORT %255s %lld %lld %lld",
+               name, &a, &b, &c) == 4) {
+      std::string body;
+      if (!ReadBody(fd, (a + b) * sizeof(float), &body)) break;
+      resp = ps->Import(name, a, b, c, body);
+    }
+  }
+}
+int main() { return 0; }
+'''
+        client = wire_contracts.scrape_python_client(client_src)
+        server = wire_contracts.scrape_c_server(server_src)
+        assert client["IMPORT"].bodies == 2
+        assert server["IMPORT"].bodies == 1
+        rep = wire_contracts.compare_tables("fixture", client, server)
+        drifts = [f for f in rep.findings if f.code == "wire:schema-drift"]
+        assert [f.where for f in drifts] == ["IMPORT:bodies"]
+        assert drifts[0].severity == "error"
+        assert drifts[0].data == {"expected": 1, "got": 2}
+
+
+# --------------------------------------------------------------------------
+# wire rules on planted fixtures
+# --------------------------------------------------------------------------
+
+
+_WIRE_CLIENT = '''
+class Client:
+    def _request(self, line, payload=b"", idempotent=True):
+        pass
+
+    def push(self, name, data):
+        return self._request(f"PUSH {name} {len(data)}", data)
+
+    def flush(self):
+        return self._request("FLUSH")
+'''
+
+_WIRE_SERVER = '''
+class Server:
+    def serve(self, conn, parts, verb):
+        if verb == "PUSH":
+            # retry: at-most-once
+            name = parts[1]
+            n = int(parts[2])
+            body = read_exact(conn, n)
+'''
+
+
+class TestWireFixtures:
+    def _report(self):
+        client = wire_contracts.scrape_python_client(_WIRE_CLIENT)
+        server = wire_contracts.scrape_python_server(
+            _WIRE_SERVER, dispatchers=("serve",))
+        return wire_contracts.compare_tables("fixture", client, server)
+
+    def test_retry_unsafe_is_an_error(self):
+        unsafe = [f for f in self._report().findings
+                  if f.code == "wire:retry-unsafe"]
+        assert [f.where for f in unsafe] == ["PUSH"]
+        assert unsafe[0].severity == "error"
+
+    def test_unknown_verb_is_a_warning(self):
+        unknown = [f for f in self._report().findings
+                   if f.code == "wire:unknown-verb"]
+        assert [f.where for f in unknown] == ["FLUSH"]
+        assert unknown[0].severity == "warning"
+        assert unknown[0].data["path"] == "client"
+
+    def test_agreeing_schema_has_no_drift(self):
+        assert not [f for f in self._report().findings
+                    if f.code == "wire:schema-drift"]
+
+
+# --------------------------------------------------------------------------
+# the live tree: full verb coverage, zero findings
+# --------------------------------------------------------------------------
+
+
+EXPECTED_VERBS = {
+    "ps": {"DELETE", "EXPORT", "IMPORT", "INIT", "PULL", "PUSH", "PUSHQ",
+           "PUSHROWS", "QUIT", "SAVE", "STATUS"},
+    "fleet": {"HEALTH", "JOURNAL", "KILL", "METRICS", "QUIT", "RELOAD",
+              "REPORT", "SHUTDOWN", "SUBMIT"},
+    "telemetry": {"EVENTS", "PING", "QUIT", "SNAPSHOT", "STATS"},
+}
+
+
+class TestLiveTree:
+    def test_verb_table_covers_every_surface_verb_on_both_sides(self):
+        rows = wire_contracts.verb_table()
+        by_surface = {}
+        for r in rows:
+            by_surface.setdefault(r["surface"], {})[r["verb"]] = r
+        assert {s: set(v) for s, v in by_surface.items()} == EXPECTED_VERBS
+        for s, verbs in by_surface.items():
+            for verb, r in verbs.items():
+                assert r["sides"] == "both", (s, verb, r)
+
+    def test_verb_table_pins_the_at_most_once_set(self):
+        rows = wire_contracts.verb_table()
+        amo = {(r["surface"], r["verb"]) for r in rows
+               if r["retry"] == wire_contracts.AT_MOST_ONCE}
+        assert amo == {("ps", "PUSH"), ("ps", "PUSHQ"), ("ps", "PUSHROWS"),
+                       ("fleet", "SUBMIT"), ("fleet", "RELOAD"),
+                       ("fleet", "KILL"), ("fleet", "SHUTDOWN")}
+
+    def test_wire_surfaces_are_clean(self):
+        for subj, rep in wire_contracts.check_wire():
+            assert not rep.findings, (subj, rep.findings)
+
+    def test_runtime_sweep_is_clean_and_always_reports_aggregates(self):
+        reports = runtime.check_runtime()
+        subjects = [s for s, _ in reports]
+        assert "runtime:locks" in subjects
+        assert {"wire:ps", "wire:fleet", "wire:telemetry"} <= set(subjects)
+        assert not _findings(reports)
+
+
+# --------------------------------------------------------------------------
+# CLI: python -m paddle_tpu.analysis --wire-table
+# --------------------------------------------------------------------------
+
+
+class TestWireTableCli:
+    def test_markdown_output(self, capsys):
+        from paddle_tpu.analysis.__main__ import main
+        assert main(["--wire-table"]) == 0
+        out = capsys.readouterr().out
+        assert "generated by: python -m paddle_tpu.analysis" in out
+        for surface in EXPECTED_VERBS:
+            assert f"### `{surface}` surface" in out
+        assert "| `SUBMIT` | both | 3 | 2 | 0 | yes | at-most-once |" in out
+
+    def test_json_output_round_trips(self, capsys):
+        from paddle_tpu.analysis.__main__ import main
+        assert main(["--wire-table", "--format", "json"]) == 0
+        rows = json.loads(capsys.readouterr().out)
+        assert {r["surface"] for r in rows} == set(EXPECTED_VERBS)
+
+    def test_model_still_required_without_wire_table(self):
+        from paddle_tpu.analysis.__main__ import main
+        with pytest.raises(SystemExit) as exc:
+            main([])
+        assert exc.value.code == 2
+
+
+# --------------------------------------------------------------------------
+# tools/lint_gate.py --runtime: shared 0/1/3 contract
+# --------------------------------------------------------------------------
+
+
+def _injected_runtime_report():
+    rep = LintReport("runtime:fixture")
+    rep.add("thread:unguarded-access", "warning",
+            "read of Fixture._n without holding self._lock",
+            where="Fixture.peek:_n", lock="_lock")
+    return [("runtime:fixture", rep)]
+
+
+class TestLintGateRuntime:
+    def test_clean_on_committed_tree(self, capsys):
+        assert lint_gate.main(["--runtime"]) == 0
+        assert "lint gate clean" in capsys.readouterr().out
+
+    def test_exit1_on_new_runtime_finding(self, monkeypatch, tmp_path,
+                                          capsys):
+        monkeypatch.setattr(lint_gate, "run_runtime_gate",
+                            _injected_runtime_report)
+        rc = lint_gate.main(["--runtime",
+                             "--baseline", str(tmp_path / "empty.json")])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "runtime:fixture::thread:unguarded-access" in out
+        assert "--write-baseline" in out
+
+    def test_write_baseline_roundtrip(self, monkeypatch, tmp_path):
+        monkeypatch.setattr(lint_gate, "run_runtime_gate",
+                            _injected_runtime_report)
+        path = str(tmp_path / "baseline.json")
+        assert lint_gate.main(["--runtime", "--write-baseline", path]) == 0
+        assert lint_gate.main(["--runtime", "--baseline", path]) == 0
+
+    def test_exit3_on_checker_crash(self, monkeypatch, capsys):
+        def boom():
+            raise RuntimeError("scanner exploded")
+        monkeypatch.setattr(lint_gate, "run_runtime_gate", boom)
+        assert lint_gate.main(["--runtime"]) == 3
+        assert "internal error" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------------
+# tools/lock_order.py: 0 clean / 2 cycle / 3 crash contract
+# --------------------------------------------------------------------------
+
+
+_CYCLE_SRC = '''
+import threading
+
+class InvertedLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def transfer(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def refund(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+
+
+class TestLockOrderTool:
+    def test_clean_on_committed_tree(self, capsys):
+        assert lock_order.main([]) == 0
+        out = capsys.readouterr().out
+        assert "no cycles" in out
+        assert "lock-acquisition edge(s)" in out
+
+    def test_exit2_on_cycle_with_ring_named(self, tmp_path, capsys):
+        (tmp_path / "inverted.py").write_text(_CYCLE_SRC)
+        assert lock_order.main(["--root", str(tmp_path)]) == 2
+        out = capsys.readouterr().out
+        assert ("InvertedLocks._a -> InvertedLocks._b -> InvertedLocks._a"
+                in out)
+
+    def test_dot_output_marks_cycle_edges(self, tmp_path, capsys):
+        (tmp_path / "inverted.py").write_text(_CYCLE_SRC)
+        assert lock_order.main(["--root", str(tmp_path), "--dot"]) == 2
+        out = capsys.readouterr().out
+        assert out.startswith("digraph lock_order")
+        assert '"InvertedLocks._a" -> "InvertedLocks._b" [color=red' in out
+
+    def test_exit3_on_crash(self, monkeypatch, capsys):
+        import paddle_tpu.analysis.runtime as rt
+
+        def boom(root=None, files=None):
+            raise RuntimeError("walker exploded")
+        monkeypatch.setattr(rt, "lock_edges", boom)
+        assert lock_order.main([]) == 3
+        assert "internal error" in capsys.readouterr().err

@@ -2,17 +2,25 @@
 """CI lint gate: the whole analysis zoo vs a committed baseline.
 
     python tools/lint_gate.py --ci                      # the CI entry point
+    python tools/lint_gate.py --runtime                 # source rules only
     python tools/lint_gate.py --write-baseline tools/analysis_baseline.json
     python tools/lint_gate.py --ci --sarif lint.sarif   # + CI annotations
 
-Runs the static checker (``paddle_tpu.analysis.check``) over every
-:data:`GATE_CONFIGS` entry — the model-zoo sweep that is this repo's
-acceptance surface — and compares the findings' stable fingerprints
-against the committed baseline file. A PR that introduces a NEW finding
-on any zoo program fails fast with the fingerprint named; the findings
-already frozen in the baseline (the gpt amp-leak golden, the tight-MoE
-capacity golden) stay accepted debt until someone fixes them and
-re-writes the baseline.
+Runs TWO sweeps against the committed baseline file:
+
+- the **zoo sweep** — the static checker (``paddle_tpu.analysis.check``)
+  over every :data:`GATE_CONFIGS` entry, the model-zoo acceptance
+  surface;
+- the **runtime sweep** (``paddle_tpu.analysis.check_runtime``) — the
+  lock-discipline (``thread:*``) and framed-wire contract (``wire:*``)
+  rules over the framework's OWN Python/C source.
+
+``--ci`` (the default behavior) runs both; ``--runtime`` restricts the
+run to the source-level sweep (fast: no model builds, no jax tracing).
+A PR that introduces a NEW finding on either sweep fails fast with the
+fingerprint named; the findings already frozen in the baseline (the gpt
+amp-leak golden, the tight-MoE capacity golden) stay accepted debt
+until someone fixes them and re-writes the baseline.
 
 Exit status (same contract as ``python -m paddle_tpu.analysis``):
 
@@ -76,6 +84,15 @@ def run_gate(configs=None):
     return out
 
 
+def run_runtime_gate():
+    """The source-level sweep — lock-discipline and wire-contract rules
+    over the framework's own source → ``(subject, LintReport)`` pairs
+    (``runtime:<module>`` / ``runtime:locks`` / ``wire:<surface>``
+    subjects)."""
+    from paddle_tpu.analysis.runtime import check_runtime
+    return check_runtime()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tools/lint_gate.py",
@@ -83,10 +100,17 @@ def main(argv=None) -> int:
     ap.add_argument("--ci", action="store_true",
                     help="gate mode (the default behavior; the flag "
                          "documents intent in CI scripts)")
+    ap.add_argument("--runtime", action="store_true",
+                    help="run ONLY the source-level runtime sweep "
+                         "(thread:* / wire:* rules) — no model builds")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help=f"baseline file (default {DEFAULT_BASELINE})")
     ap.add_argument("--write-baseline", default="", metavar="PATH",
-                    help="freeze the current findings to PATH and exit 0")
+                    help="freeze the current findings to PATH and exit 0 "
+                         "(covers the sweeps this run selects — under "
+                         "--runtime that is the runtime sweep only, so "
+                         "regenerate the committed baseline WITHOUT "
+                         "--runtime)")
     ap.add_argument("--sarif", default="", metavar="PATH",
                     help="also write a SARIF 2.1.0 report to PATH")
     ap.add_argument("--fail-on", default="warning",
@@ -102,7 +126,10 @@ def main(argv=None) -> int:
                                                 to_sarif, write_baseline)
 
         overrides = _parse_severity(args.severity)
-        reports = run_gate()
+        # both sweeps share one baseline file and one exit contract —
+        # --runtime narrows the run, never changes the semantics
+        reports = [] if args.runtime else run_gate()
+        reports += run_runtime_gate()
         for _, report in reports:
             apply_severity(report, overrides)
 

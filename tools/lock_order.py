@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Lock-acquisition-order graph dump for the whole package.
+
+    python tools/lock_order.py                  # text: edges + cycles
+    python tools/lock_order.py --dot > locks.dot
+    python tools/lock_order.py --root paddle_tpu/telemetry
+
+The runtime concurrency analyzer (``paddle_tpu.analysis.concurrency``)
+records an edge ``A -> B`` whenever lock ``A`` (``Class.lockname``) is
+held at the point lock ``B`` is acquired — lexical ``with`` nesting
+plus one level of cross-method expansion. This tool dumps the merged
+package-wide digraph for humans: ``--dot`` emits Graphviz (cycle edges
+drawn red, bold) for rendering, the default text form lists every edge
+with its acquisition site and then any cycles. The cycle check itself
+also runs in CI (``tools/lint_gate.py --runtime`` →
+``thread:lock-order``); this tool is the post-mortem/review view of the
+same graph.
+
+Exit status (the series_dump/flight_dump contract): **0** clean —
+graph dumped, no cycle; **2** findings — at least one acquisition-order
+cycle (the dump still prints, with the rings named); **3** the tool
+itself crashed (never a verdict).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import traceback
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+EXIT_CLEAN, EXIT_FINDINGS, EXIT_INTERNAL = 0, 2, 3
+
+
+def render_text(edges, cycles) -> str:
+    out = [f"{len(edges)} lock-acquisition edge(s):"]
+    by_pair = {}
+    for a, b, loc in edges:
+        by_pair.setdefault((a, b), []).append(loc)
+    for (a, b), locs in sorted(by_pair.items()):
+        out.append(f"  {a} -> {b}   [{', '.join(sorted(set(locs)))}]")
+    if cycles:
+        out.append(f"{len(cycles)} acquisition-order cycle(s):")
+        for cyc in cycles:
+            out.append("  " + " -> ".join(cyc + [cyc[0]]))
+    else:
+        out.append("no cycles")
+    return "\n".join(out)
+
+
+def render_dot(edges, cycles) -> str:
+    cycle_pairs = {(cyc[i], cyc[(i + 1) % len(cyc)])
+                   for cyc in cycles for i in range(len(cyc))}
+    out = ["digraph lock_order {", "  rankdir=LR;",
+           '  node [shape=box, fontname="monospace"];']
+    pairs = sorted({(a, b) for a, b, _ in edges})
+    for a, b in pairs:
+        attrs = ' [color=red, penwidth=2]' if (a, b) in cycle_pairs else ""
+        out.append(f'  "{a}" -> "{b}"{attrs};')
+    out.append("}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/lock_order.py",
+        description="dump the package-wide lock-acquisition-order graph")
+    ap.add_argument("--root", default="",
+                    help="package subtree to scan (default: the whole "
+                         "paddle_tpu package)")
+    ap.add_argument("--dot", action="store_true",
+                    help="emit Graphviz dot instead of text")
+    args = ap.parse_args(argv)
+
+    try:
+        from paddle_tpu.analysis.concurrency import lock_cycles
+        from paddle_tpu.analysis.runtime import lock_edges
+
+        root = os.path.abspath(args.root) if args.root else None
+        edges = lock_edges(root=root)
+        cycles = lock_cycles(edges)
+        print(render_dot(edges, cycles) if args.dot
+              else render_text(edges, cycles))
+        return EXIT_FINDINGS if cycles else EXIT_CLEAN
+    except Exception:
+        # NOT BaseException: a ^C stays a cancelled run, never a verdict
+        traceback.print_exc()
+        print("lock_order: internal error (exit 3) — the tool crashed; "
+              "this is NOT a verdict", file=sys.stderr)
+        return EXIT_INTERNAL
+
+
+if __name__ == "__main__":
+    sys.exit(main())
