@@ -1,0 +1,43 @@
+"""Forward-compat shims for older jax releases.
+
+The codebase targets the modern spellings (``jax.shard_map`` with
+``check_vma=``, ``jax.lax.axis_size``). On a jax that predates them
+(<0.5: shard_map still lives in jax.experimental and the replication
+check is spelled ``check_rep``), install equivalent aliases ON the jax
+modules so every call site — ours and the test-suite's — works
+unchanged. Imported first from ``paddle_tpu/__init__`` so the shims are
+in place before any submodule (or user code that imported us) touches
+them. No-ops entirely on a jax that already has the real thing.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kw):
+        if check_vma is not None:
+            kw.setdefault("check_rep", check_vma)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map
+
+if not hasattr(jax.lax, "axis_size"):
+    def _axis_size(axis_name):
+        # psum of a literal 1 is folded to the (static) axis size
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = _axis_size
+
+if not hasattr(jax.lax, "pcast") and not hasattr(jax.lax, "pvary"):
+    def _pvary(x, axis_names=None):
+        # pre-vma jax has no device-varying bookkeeping to update:
+        # replication consistency is handled by check_rep, so marking
+        # a value varying is the identity
+        return x
+
+    jax.lax.pvary = _pvary

@@ -317,12 +317,23 @@ def _check_step_jaxpr(trainer, sample_feed, report, rules, amp,
     if want_coll:
         _rules.check_accum_exchange(trainer.strategy, trainer.mesh,
                                     trainer.scope.params, report)
+        # advisory needs profile EVIDENCE of a link-bound run, so it
+        # only applies once the trainer has dispatched steps
+        profile = (trainer.profile_report()
+                   if getattr(trainer.step_timer, "steps", 0) > 0 else None)
+        _rules.check_quantized_exchange(trainer.strategy, trainer.mesh,
+                                        trainer.scope.params, report,
+                                        profile=profile)
     if sample_feed is None:
         return
     feed = _concrete_feed(sample_feed)
     ls = getattr(trainer.scope, "loss_scale_state", None) or {}
     args = (trainer.scope.params, trainer.scope.opt_state,
             trainer.scope.state, jax.random.PRNGKey(0), feed, ls)
+    # the quantized-exchange error-feedback residual grows the step
+    # signature by one trailing arg (executor._build_step)
+    if getattr(trainer, "_quant_ef", False):
+        args = args + (trainer.scope.quant_resid,)
     # ONE trace of the raw step body serves all three families: the same
     # collective eqns the jitted wrapper would show (minus the pjit
     # shell), the invar→outvar identity the donation rule needs (the
@@ -368,7 +379,8 @@ def _check_step_jaxpr(trainer, sample_feed, report, rules, amp,
         _check_step_donation(trainer, args, closed, out_shape, report)
 
 
-_STEP_ARGNAMES = ("params", "opt_state", "state", "rng", "feed", "loss_scale")
+_STEP_ARGNAMES = ("params", "opt_state", "state", "rng", "feed",
+                  "loss_scale", "quant_resid")
 
 
 def _check_step_donation(trainer, args, closed, out_shape,

@@ -231,9 +231,13 @@ def _lower_step(trainer, feed):
     # profile_report publishes
     feed = trainer._put_feed(feed, record=False)
     ls = getattr(trainer.scope, "loss_scale_state", None) or {}
-    return trainer._step_fn.lower(trainer.scope.params, trainer.scope.opt_state,
-                                  trainer.scope.state, jax.random.PRNGKey(0),
-                                  feed, ls)
+    args = (trainer.scope.params, trainer.scope.opt_state,
+            trainer.scope.state, jax.random.PRNGKey(0), feed, ls)
+    if getattr(trainer, "_quant_ef", False):
+        # error-feedback residual: the quantized-exchange step carries
+        # one extra trailing arg (executor._build_step)
+        args = args + (trainer.scope.quant_resid,)
+    return trainer._step_fn.lower(*args)
 
 
 def collective_report(trainer, feed) -> Dict[str, Any]:

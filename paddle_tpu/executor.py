@@ -345,6 +345,11 @@ class Trainer:
         self.step_timer = StepTimer(journal=self.journal,
                                     inst=self.telemetry_inst)
         self._fusion_report = None  # cache: fusion_report(feed) result
+        # quantized-exchange state (resolved at _build_step): whether
+        # the step signature carries the error-feedback residual, and
+        # the static bytes-on-wire attribution of the grad exchange
+        self._quant_ef = False
+        self.collective_bytes = None
         self.loss_scaler = None
         if strategy is not None and (getattr(strategy, "loss_scale", None)
                                      or getattr(strategy, "dynamic_loss_scale", False)):
@@ -409,6 +414,31 @@ class Trainer:
             else:
                 ls = jax.device_put(ls, self.place.device())
             self.scope.loss_scale_state = ls
+        # error-feedback residual for the quantized exchange: one f32
+        # slot per data-parallel rank per param — global shape
+        # (dshard,) + param.shape, sharded on the leading axis so each
+        # rank owns (and only ever touches) its own slot. Zeros at
+        # init/restore: EF telescoping simply restarts, which costs one
+        # step of correction and nothing else (deliberately NOT
+        # persisted by io.save).
+        self.scope.quant_resid = None
+        qmode = ((getattr(self.strategy, "quantized_allreduce", "none")
+                  if self.strategy else "none") or "none")
+        if qmode in ("int8", "int4") and bool(
+                getattr(self.strategy, "error_feedback", True)):
+            axes = self._local_exchange_axes(
+                f"quantized_allreduce={qmode!r}")
+            dshard = 1
+            for a in axes:
+                dshard *= self.mesh.shape[a]
+            from jax.sharding import NamedSharding, PartitionSpec
+            bshard = axes if len(axes) > 1 else axes[0]
+            self.scope.quant_resid = {
+                name: jax.device_put(
+                    jnp.zeros((dshard,) + tuple(leaf.shape), jnp.float32),
+                    NamedSharding(self.mesh, PartitionSpec(
+                        bshard, *([None] * len(leaf.shape)))))
+                for name, leaf in self.scope.params.items()}
         self._build_step()
         self.lint_report = None
         if lint != "off":
@@ -575,45 +605,149 @@ class Trainer:
         """Validate and resolve DistStrategy.accum_exchange="hoisted":
         the shard_map-local accumulation that exchanges gradients ONCE
         per optimizer step (the wire lever SCALING.md §2 names as the
-        follow-up to the measured in-loop GSPMD exchange). Only sound
-        when the model trace is collective-free per shard, so every
+        follow-up to the measured in-loop GSPMD exchange)."""
+        return self._local_exchange_axes("accum_exchange='hoisted'")
+
+    def _local_exchange_axes(self, why: str):
+        """Validate and resolve a shard_map-LOCAL gradient path (the
+        hoisted exchange and the quantized collective both run the
+        model per data shard and exchange explicitly). Only sound when
+        the model trace is collective-free per shard, so every
         precondition is enforced loudly rather than silently computing
         something else."""
         enforce(self.mesh is not None,
-                "accum_exchange='hoisted' needs a mesh (it is the "
-                "cross-shard exchange policy)")
+                f"{why} needs a mesh (it is the cross-shard exchange "
+                "policy)")
         axes = tuple(a for a in ("dp", "fsdp") if a in self.mesh.axis_names
                      and self.mesh.shape[a] > 1)
-        enforce(axes, "accum_exchange='hoisted': mesh has no data axis")
+        enforce(axes, f"{why}: mesh has no data axis")
         pp_m, _ = self._pp_settings()
         enforce(pp_m == 0 and not getattr(self.strategy, "sequence_parallel",
                                           False),
-                "accum_exchange='hoisted' composes only with pure data "
-                "parallelism (no pp/sp: their shard_map schedules cannot "
-                "nest inside the local accumulation)")
+                f"{why} composes only with pure data parallelism (no "
+                "pp/sp: their shard_map schedules cannot nest inside "
+                "the local gradient path)")
         enforce(not self.scope.state,
-                "accum_exchange='hoisted' requires stateless models: "
-                "per-shard mutable state (e.g. BN running stats) would "
-                "silently diverge across shards")
+                f"{why} requires stateless models: per-shard mutable "
+                "state (e.g. BN running stats) would silently diverge "
+                "across shards")
         from jax.sharding import PartitionSpec
         for name, leaf in self.scope.params.items():
             spec = (self.sharding_rules.spec_for(name, leaf.shape, self.mesh)
                     if self.sharding_rules is not None else PartitionSpec())
             enforce(all(e is None for e in spec),
-                    f"accum_exchange='hoisted' requires fully replicated "
+                    f"{why} requires fully replicated "
                     f"params; {name} is sharded {spec} (use fsdp/tp with "
                     "the default gspmd exchange instead)")
         return axes
 
+    def _collective_bytes_summary(self, quant, axes):
+        """Static bytes-on-wire attribution of the per-optimizer-step
+        gradient exchange (the ``collective`` line of
+        :meth:`profile_report` / ``collective_bytes`` in
+        :meth:`fusion_report`): per-device ring-all-reduce bytes summed
+        over every gradient leaf and data axis, fp32 baseline vs the
+        configured wire format. ``None`` off-mesh or when the mesh has
+        no data axis; with ``quantized_allreduce="none"`` the entry is
+        still present (reduction 1.0) so dashboards can diff runs.
+        Counts ONE exchange per step — the gspmd-accum path's
+        per-microbatch exchanges cost ``accum_steps``× this."""
+        if self.mesh is None:
+            return None
+        if axes is None:
+            axes = tuple(a for a in ("dp", "fsdp")
+                         if a in self.mesh.axis_names
+                         and self.mesh.shape[a] > 1)
+        if not axes:
+            return None
+        from .parallel import quantized_collectives as qc
+        sizes = [int(np.prod(p.shape)) if p.shape else 1
+                 for p in jax.tree.leaves(self.scope.params)]
+        ranks = {a: int(self.mesh.shape[a]) for a in axes}
+        fp32 = sum(qc.ring_wire_bytes(n, p)
+                   for n in sizes for p in ranks.values())
+        wire = fp32 if quant is None else sum(
+            qc.ring_wire_bytes(n, p, bits=quant["bits"],
+                               block_size=quant["block_size"])
+            for n in sizes for p in ranks.values())
+        return {
+            "mode": "none" if quant is None else f"int{quant['bits']}",
+            "bits": None if quant is None else quant["bits"],
+            "block_size": None if quant is None else quant["block_size"],
+            "error_feedback": bool(quant and quant["error_feedback"]),
+            "axes": axes,
+            "ranks": ranks,
+            "grad_elems": int(sum(sizes)),
+            "fp32_bytes_per_step": int(fp32),
+            "wire_bytes_per_step": int(wire),
+            "reduction": (float(fp32) / wire) if wire else 1.0,
+        }
+
+    def _quantized_exchange(self, gsum, accum_steps, axes, dshard, r,
+                            res, quant, unscale):
+        """The quantized replacement of the hoisted path's pmean,
+        traced INSIDE the shard_map body: per gradient leaf, mean over
+        microbatches, locally unscale (loss scaling — the residual
+        must live in unscaled units or a dynamic-scale change between
+        steps corrupts it), add the error-feedback residual, and ring-
+        exchange through parallel.quantized_collectives over each data
+        axis. With EF the leaf is roundtripped through the wire grid
+        FIRST: the exchange then carries the already-quantized value
+        (re-encoding is integer-exact — the ring chunk grid is padded
+        to the block grid), so ``v - deq`` is exactly the information
+        this rank failed to put on the wire, carried to the next step.
+        Stochastic rounding keys derive from the shard-folded step rng
+        (per-leaf, per-axis folds)."""
+        from .parallel import quantized_collectives as qc
+
+        bits, block = quant["bits"], quant["block_size"]
+        sr = quant["stochastic_rounding"]
+        leaves, treedef = jax.tree.flatten(gsum)
+        res_leaves = (jax.tree.leaves(res) if res is not None
+                      else [None] * len(leaves))
+        qkey = jax.random.fold_in(r, 0x7157) if sr else None
+        outg, outres = [], []
+        for i, (g, rs) in enumerate(zip(leaves, res_leaves)):
+            g = g / accum_steps
+            if unscale is not None:
+                g = unscale(g)
+            key = jax.random.fold_in(qkey, i) if sr else None
+            if rs is not None:
+                v = g + rs
+                x = qc.block_roundtrip(v, bits=bits, block_size=block,
+                                       rng=key)
+                outres.append(v - x)
+                key = None  # the ring re-encodes x exactly; SR is spent
+            else:
+                x = g
+            for j, a in enumerate(axes):
+                x = qc.quantized_psum(
+                    x, a, bits=bits, block_size=block,
+                    rng=(jax.random.fold_in(key, j)
+                         if key is not None else None))
+            outg.append(x / dshard)
+        grads = jax.tree.unflatten(treedef, outg)
+        new_res = (jax.tree.unflatten(treedef, outres)
+                   if res is not None else None)
+        return grads, new_res
+
     def _hoisted_accum(self, loss_and_aux, axes, accum_steps, params,
-                       state, rng, feed):
+                       state, rng, feed, resid=None, quant=None,
+                       unscale=None):
         """shard_map-local gradient accumulation: each data shard scans
         its accum_steps microbatches with NO cross-shard traffic, then
         the summed gradients are pmean'd ONCE — the hoisted exchange
         GSPMD will not produce on its own (SCALING.md §2). Params enter
         replicated (enforced), the model trace is collective-free per
         shard, float outputs are pmean'd to match the GSPMD path's
-        global means."""
+        global means.
+
+        With ``quant`` (DistStrategy.quantized_allreduce) the single
+        pmean becomes the block-scaled quantized ring exchange; a
+        non-None ``resid`` additionally threads the per-shard error-
+        feedback residual — global shape ``(dshard,) + param.shape``,
+        sharded on the leading axis so each rank owns its own slot —
+        through the shard_map and back out (returned as a 4th value)."""
         import functools
 
         from jax.sharding import PartitionSpec as P
@@ -628,7 +762,7 @@ class Trainer:
                 f"({accum_steps}*{dshard}) for hoisted accumulation")
         bshard = axes if len(axes) > 1 else axes[0]
 
-        def body(p, f, r):
+        def body(p, f, r, *res_args):
             # per-shard rng: fold the shard position in so dropout
             # masks decorrelate across shards (same-in-distribution as
             # the GSPMD path's globally-sharded masks)
@@ -651,8 +785,18 @@ class Trainer:
                                       {"rng": rngs, "feed": f_m})
             pmean_all = functools.partial(
                 functools.reduce, lambda v, a: jax.lax.pmean(v, a), axes)
-            grads = jax.tree.map(
-                lambda g: pmean_all(g / accum_steps), gsum)
+            new_res = None
+            if quant is None:
+                grads = jax.tree.map(
+                    lambda g: pmean_all(g / accum_steps), gsum)
+            else:
+                # each rank sees its (1, ...) leading slot of the
+                # sharded residual
+                res = (jax.tree.map(lambda x: x[0], res_args[0])
+                       if res_args else None)
+                grads, new_res = self._quantized_exchange(
+                    gsum, accum_steps, axes, dshard, r, res, quant,
+                    unscale)
             # outputs leave the shard_map replicated (out_specs=P()), so
             # only FLOAT SCALARS are sound: a pmean of per-sample arrays
             # (logits) would average across shards' DIFFERENT samples,
@@ -669,10 +813,21 @@ class Trainer:
                         "to prune per-sample or integer outputs")
             out = jax.tree.map(
                 lambda x: pmean_all(jnp.mean(x, axis=0)), outs)
+            if new_res is not None:
+                return grads, out, jax.tree.map(lambda x: x[None], new_res)
             return grads, out
 
         feed_specs = jax.tree.map(
             lambda x: P(bshard, *([None] * (x.ndim - 1))), feed)
+        if resid is not None:
+            res_specs = jax.tree.map(
+                lambda x: P(bshard, *([None] * (x.ndim - 1))), resid)
+            grads, out, new_resid = jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), feed_specs, P(), res_specs),
+                out_specs=(P(), P(), res_specs), check_vma=False)(
+                    params, feed, rng, resid)
+            return grads, out, state, new_resid
         grads, out = jax.shard_map(
             body, mesh=mesh, in_specs=(P(), feed_specs, P()),
             out_specs=P(), check_vma=False)(params, feed, rng)
@@ -704,6 +859,35 @@ class Trainer:
                 "misconfiguration (there is no loop to hoist out of)")
         hoist_axes = (self._hoisted_accum_axes() if mode == "hoisted"
                       else None)
+        # quantized gradient exchange (EQuARX lineage): resolved ONCE
+        # here like the guard — bits/block/EF are compiled into the
+        # step program. "none" keeps today's exchange bit-identically
+        # (no quant code on the trace at all).
+        qmode = ((getattr(self.strategy, "quantized_allreduce", "none")
+                  if self.strategy else "none") or "none")
+        enforce(qmode in ("none", "int8", "int4"),
+                f"DistStrategy.quantized_allreduce={qmode!r} "
+                "(none|int8|int4)")
+        quant_cfg = quant_axes = None
+        if qmode != "none":
+            from .parallel import quantized_collectives as qc
+            qbits = 8 if qmode == "int8" else 4
+            qblock = int(getattr(self.strategy, "quant_block_size", 256))
+            qc.wire_block_bytes(1, bits=qbits, block_size=qblock)  # validate
+            quant_cfg = {
+                "bits": qbits,
+                "block_size": qblock,
+                "error_feedback": bool(getattr(self.strategy,
+                                               "error_feedback", True)),
+                "stochastic_rounding": bool(getattr(
+                    self.strategy, "quant_stochastic_rounding", False)),
+            }
+            quant_axes = self._local_exchange_axes(
+                f"quantized_allreduce={qmode!r}")
+        qef = bool(quant_cfg and quant_cfg["error_feedback"])
+        self._quant_ef = qef
+        self.collective_bytes = self._collective_bytes_summary(
+            quant_cfg, quant_axes)
         # guard resolution happens ONCE here: the detection is compiled
         # into the step program, so the check_nan_inf flag is read at
         # build time (set it before startup). An explicit GuardPolicy
@@ -721,7 +905,7 @@ class Trainer:
                                 defer_readback=False)
         self._guard = guard
 
-        def train_step(params, opt_state, state, rng, feed, ls):
+        def _step_impl(params, opt_state, state, rng, feed, ls, qresid):
             self._trace_count += 1  # trace-time only: counts compilations
             if wire is not None:
                 feed = wire.decode(feed)
@@ -733,7 +917,28 @@ class Trainer:
                     loss = scaler.scale_loss(loss, ls)
                 return loss, aux
 
-            if accum_steps > 1 and hoist_axes is not None:
+            new_qresid = None
+            if quant_cfg is not None:
+                # quantized exchange: the model runs shard_map-local
+                # (same schedule as the hoisted path, at any
+                # accum_steps>=1) so the ONE per-step gradient exchange
+                # is the block-scaled quantized ring instead of a GSPMD
+                # f32 all-reduce. Loss unscaling happens INSIDE the
+                # body, before encode (the EF residual lives in
+                # unscaled units).
+                unscale = ((lambda g: scaler.unscale(g, ls))
+                           if scaler is not None else None)
+                if qef:
+                    grads, out, new_state, new_qresid = self._hoisted_accum(
+                        loss_and_aux, quant_axes, accum_steps, params,
+                        state, rng, feed, resid=qresid, quant=quant_cfg,
+                        unscale=unscale)
+                else:
+                    grads, out, new_state = self._hoisted_accum(
+                        loss_and_aux, quant_axes, accum_steps, params,
+                        state, rng, feed, quant=quant_cfg,
+                        unscale=unscale)
+            elif accum_steps > 1 and hoist_axes is not None:
                 grads, out, new_state = self._hoisted_accum(
                     loss_and_aux, hoist_axes, accum_steps, params, state,
                     rng, feed)
@@ -764,7 +969,10 @@ class Trainer:
                     loss_and_aux, has_aux=True)(params, state, rng, feed)
 
             if scaler is not None:
-                grads = scaler.unscale(grads, ls)
+                if quant_cfg is None:
+                    # the quant path already unscaled inside the
+                    # shard_map body (pre-encode)
+                    grads = scaler.unscale(grads, ls)
                 finite = scaler.all_finite(grads)
                 new_params, new_opt = self.optimizer.update(
                     grads, opt_state, params, self.program.param_info)
@@ -772,6 +980,11 @@ class Trainer:
                 new_params = scaler.select(finite, new_params, params)
                 new_opt = scaler.select(finite, new_opt, opt_state)
                 new_state = scaler.select(finite, new_state, state)
+                if new_qresid is not None:
+                    # a skipped step must not bank a NaN-poisoned (or
+                    # phantom) residual: EF state rolls back with the
+                    # rest of the carry
+                    new_qresid = scaler.select(finite, new_qresid, qresid)
                 new_ls = scaler.update(ls, finite)
                 out = dict(out)
                 out["loss_scale"] = new_ls["scale"]
@@ -820,12 +1033,32 @@ class Trainer:
                 new_params = LossScaler.select(finite, new_params, params)
                 new_opt = LossScaler.select(finite, new_opt, opt_state)
                 new_state = LossScaler.select(finite, new_state, state)
+                if new_qresid is not None:
+                    new_qresid = LossScaler.select(finite, new_qresid,
+                                                   qresid)
                 self._guard_bit_names = tuple(names)  # trace-time capture
                 out = dict(out)
                 out["guard_nonfinite"] = mask
+            if qef:
+                return (new_params, new_opt, new_state, out, new_ls,
+                        new_qresid)
             return new_params, new_opt, new_state, out, new_ls
 
-        donate = (0, 1, 2, 5) if self.donate else ()
+        # the public step signature only grows the error-feedback
+        # residual arg when the knob asks for it — quantized_allreduce=
+        # "none" keeps today's 6-arg step (and its donation map)
+        # byte-identically
+        if qef:
+            def train_step(params, opt_state, state, rng, feed, ls, qresid):
+                return _step_impl(params, opt_state, state, rng, feed, ls,
+                                  qresid)
+        else:
+            def train_step(params, opt_state, state, rng, feed, ls):
+                return _step_impl(params, opt_state, state, rng, feed, ls,
+                                  None)
+
+        donate = ((0, 1, 2, 5, 6) if qef else (0, 1, 2, 5)) \
+            if self.donate else ()
         # kept for the fused driver and the donation lint: the raw
         # python step body (check_trainer traces it to see input→output
         # passthrough aliasing that the jitted wrapper hides)
@@ -839,27 +1072,54 @@ class Trainer:
         else:
             self._step_fn = jax.jit(train_step, donate_argnums=donate)
 
-        def run_k_steps(params, opt_state, state, base_rng, step0, feed_k, ls):
-            """Fused multi-step driver: ONE device launch runs K
-            optimizer steps under lax.scan with the full training carry
-            (params, opt_state, state, loss-scale state) resident on
-            device between updates — per-step rng keys reproduce the
-            sequential ``step()`` stream exactly (fold_in of the same
-            base key at the same global step)."""
-            k = jax.tree.leaves(feed_k)[0].shape[0]
+        if qef:
+            def run_k_steps(params, opt_state, state, base_rng, step0,
+                            feed_k, ls, qresid):
+                """Fused multi-step driver, error-feedback variant: the
+                quantization residual rides the scan carry, so over the
+                K fused steps the compression error TELESCOPES (each
+                step's encode sees what the last one dropped) while the
+                program stays one device launch."""
+                k = jax.tree.leaves(feed_k)[0].shape[0]
 
-            def body(carry, x):
-                p, o, s, ls_ = carry
-                r = jax.random.fold_in(base_rng, step0 + x["i"])
-                p, o, s, out, ls_ = train_step(p, o, s, r, x["feed"], ls_)
-                return (p, o, s, ls_), out
+                def body(carry, x):
+                    p, o, s, ls_, qr = carry
+                    r = jax.random.fold_in(base_rng, step0 + x["i"])
+                    p, o, s, out, ls_, qr = train_step(p, o, s, r,
+                                                       x["feed"], ls_, qr)
+                    return (p, o, s, ls_, qr), out
 
-            (p, o, s, new_ls), outs = jax.lax.scan(
-                body, (params, opt_state, state, ls),
-                {"i": jnp.arange(k, dtype=jnp.int32), "feed": feed_k})
-            return p, o, s, outs, new_ls
+                (p, o, s, new_ls, new_qr), outs = jax.lax.scan(
+                    body, (params, opt_state, state, ls, qresid),
+                    {"i": jnp.arange(k, dtype=jnp.int32), "feed": feed_k})
+                return p, o, s, outs, new_ls, new_qr
 
-        kdonate = (0, 1, 2, 6) if self.donate else ()
+            kdonate = (0, 1, 2, 6, 7) if self.donate else ()
+        else:
+            def run_k_steps(params, opt_state, state, base_rng, step0,
+                            feed_k, ls):
+                """Fused multi-step driver: ONE device launch runs K
+                optimizer steps under lax.scan with the full training
+                carry (params, opt_state, state, loss-scale state)
+                resident on device between updates — per-step rng keys
+                reproduce the sequential ``step()`` stream exactly
+                (fold_in of the same base key at the same global
+                step)."""
+                k = jax.tree.leaves(feed_k)[0].shape[0]
+
+                def body(carry, x):
+                    p, o, s, ls_ = carry
+                    r = jax.random.fold_in(base_rng, step0 + x["i"])
+                    p, o, s, out, ls_ = train_step(p, o, s, r, x["feed"],
+                                                   ls_)
+                    return (p, o, s, ls_), out
+
+                (p, o, s, new_ls), outs = jax.lax.scan(
+                    body, (params, opt_state, state, ls),
+                    {"i": jnp.arange(k, dtype=jnp.int32), "feed": feed_k})
+                return p, o, s, outs, new_ls
+
+            kdonate = (0, 1, 2, 6) if self.donate else ()
         if self.mesh is not None:
             from .parallel import api as par_api
             self._multi_step_fn = par_api.jit_sharded_step(
@@ -975,8 +1235,16 @@ class Trainer:
         base_step = self.global_step
         t0 = _time.perf_counter()
         with profiler.record_event("trainer.step"):
-            p, o, s, out, new_ls = self._step_fn(self.scope.params, self.scope.opt_state,
-                                                 self.scope.state, rng, feed, ls)
+            if self._quant_ef:
+                p, o, s, out, new_ls, new_qr = self._step_fn(
+                    self.scope.params, self.scope.opt_state,
+                    self.scope.state, rng, feed, ls,
+                    self.scope.quant_resid)
+                self.scope.quant_resid = new_qr
+            else:
+                p, o, s, out, new_ls = self._step_fn(
+                    self.scope.params, self.scope.opt_state,
+                    self.scope.state, rng, feed, ls)
         self.step_timer.record_dispatch(t0, _time.perf_counter(), 1, "step",
                                         span=span, base_step=base_step)
         self._log_compile_cache("train step")
@@ -1028,9 +1296,16 @@ class Trainer:
         step0 = np.int32(self.global_step)
         t0 = _time.perf_counter()
         with profiler.record_event("trainer.run_steps"):
-            p, o, s, outs, new_ls = self._multi_step_fn(
-                self.scope.params, self.scope.opt_state, self.scope.state,
-                rng, step0, feed, ls)
+            if self._quant_ef:
+                p, o, s, outs, new_ls, new_qr = self._multi_step_fn(
+                    self.scope.params, self.scope.opt_state,
+                    self.scope.state, rng, step0, feed, ls,
+                    self.scope.quant_resid)
+                self.scope.quant_resid = new_qr
+            else:
+                p, o, s, outs, new_ls = self._multi_step_fn(
+                    self.scope.params, self.scope.opt_state,
+                    self.scope.state, rng, step0, feed, ls)
         self.step_timer.record_dispatch(t0, _time.perf_counter(), k,
                                         "run_steps", span=span,
                                         base_step=int(step0))
@@ -1219,6 +1494,9 @@ class Trainer:
         along in :meth:`profile_report`."""
         from .profiling import fusion_report as _fusion_report
         self._fusion_report = _fusion_report(self, feed, top_k=top_k)
+        # bytes-on-wire attribution of the grad exchange rides along so
+        # one report answers "is the win link bytes or compute"
+        self._fusion_report["collective_bytes"] = self.collective_bytes
         return self._fusion_report
 
     def profile_report(self) -> Dict[str, Any]:

@@ -32,6 +32,13 @@
 //   PUSHQ <trainer> <name> <n> <scale>\n<i8 bytes>        -> OK <version>
 //       (int8-quantized gradient: g[i] = q[i]*scale/127 — 4x less wire
 //        than PUSH; quantized-collective lineage, EQuARX-style)
+//   PUSHQB <trainer> <name> <n> <bits> <block>\n<f32 scales><codes> -> OK <v>
+//       (block-scaled quantized gradient: one f32 abs-max scale per
+//        <block> elements, codes int8 or packed int4 nibble pairs when
+//        <bits>=4 — 4-8x less wire than PUSH with outliers contained to
+//        their own block; same codec as parallel/quantized_collectives.
+//        <n> is the UNPADDED element count; scale/code lengths derive
+//        from n/bits/block server-side)
 //   PUSHROWS <trainer> <name> <nrows> <rowdim>\n<i32 ids><f32 vals> -> OK <v>
 //   EXPORT <name>                   -> OK <vlen> <alen> <version>\n
 //                                      <f32 value><f32 accum>
@@ -54,7 +61,7 @@
 //   QUIT                            -> closes the connection
 //
 // Optional trace field: a client may append " trace=<id>" (no
-// whitespace in <id>) to a PULL/PUSH/PUSHQ/PUSHROWS header line. The
+// whitespace in <id>) to a PULL/PUSH/PUSHQ/PUSHQB/PUSHROWS header line. The
 // field rides AFTER the positionally-parsed tokens, so an old server's
 // sscanf ignores it (and an old client simply never sends it); this
 // server echoes it at the end of the OK reply line ("OK <v>
@@ -146,6 +153,48 @@ class PServer {
     std::vector<float> grad(static_cast<size_t>(n));
     const float inv = scale / 127.0f;
     for (int64_t i = 0; i < n; ++i) grad[i] = q[i] * inv;
+    std::lock_guard<std::mutex> g(mu_);
+    std::string resp = ApplyDense(trainer, name, size_t(n), grad.data());
+    if (resp.rfind("OK", 0) == 0) ++qpushes_;
+    return resp;
+  }
+
+  // Block-scaled quantized dense push: one f32 abs-max scale per
+  // `block` elements, codes int8 or packed int4 nibble pairs (bias-8,
+  // lo | hi<<4) — the PUSHQB wire verb, sharing its codec with the
+  // trainer-side parallel/quantized_collectives encoder. Dequantized
+  // into a staging buffer and fed through the SAME update path as
+  // Push. A non-finite scale (the encoder poisons blocks that held
+  // NaN/Inf) dequantizes its whole block to NaN and surfaces through
+  // the update exactly like a NaN f32 push would.
+  std::string PushQuantizedBlocks(int trainer, const std::string& name,
+                                  int64_t n, int64_t bits, int64_t block,
+                                  const std::string& scales_b,
+                                  const std::string& codes_b) {
+    if (n < 0 || block <= 0 || (bits != 8 && bits != 4) ||
+        (bits == 4 && block % 2 != 0))
+      return "ERR bad quant header\n";
+    int64_t padded = ((n > 0 ? n : 1) + block - 1) / block * block;
+    int64_t nblk = padded / block;
+    int64_t codes_len = bits == 8 ? padded : padded / 2;
+    if (scales_b.size() != size_t(nblk) * sizeof(float) ||
+        codes_b.size() != size_t(codes_len))
+      return "ERR size mismatch\n";
+    const float* scales = reinterpret_cast<const float*>(scales_b.data());
+    const float qmax = bits == 8 ? 127.0f : 7.0f;
+    std::vector<float> grad(static_cast<size_t>(n));
+    if (bits == 8) {
+      const int8_t* q = reinterpret_cast<const int8_t*>(codes_b.data());
+      for (int64_t i = 0; i < n; ++i)
+        grad[i] = q[i] * (scales[i / block] / qmax);
+    } else {
+      const uint8_t* q = reinterpret_cast<const uint8_t*>(codes_b.data());
+      for (int64_t i = 0; i < n; ++i) {
+        uint8_t byte = q[i >> 1];
+        int code = int((i & 1) ? (byte >> 4) & 0xF : byte & 0xF) - 8;
+        grad[i] = code * (scales[i / block] / qmax);
+      }
+    }
     std::lock_guard<std::mutex> g(mu_);
     std::string resp = ApplyDense(trainer, name, size_t(n), grad.data());
     if (resp.rfind("OK", 0) == 0) ++qpushes_;
@@ -467,7 +516,7 @@ void ServeClient(PServer* ps, int fd) {
   while (ReadLine(fd, &line)) {
     std::string resp, payload;
     char name[256];
-    long long a = 0, b = 0, c = 0;
+    long long a = 0, b = 0, c = 0, d = 0;
     if (sscanf(line.c_str(), "INIT %255s %lld", name, &a) == 2) {
       std::string body;
       if (!ReadBody(fd, a, &body)) break;
@@ -479,6 +528,24 @@ void ServeClient(PServer* ps, int fd) {
       std::string body;
       if (!ReadBody(fd, b, &body)) break;
       resp = WithTrace(ps->Push(int(a), name, body), line);
+    } else if (sscanf(line.c_str(), "PUSHQB %lld %255s %lld %lld %lld",
+                      &a, name, &b, &c, &d) == 5) {
+      // retry: at-most-once
+      // header sanity BEFORE sizing the reads: bits/block combinations
+      // the codec cannot produce close the connection (body lengths
+      // would be unknowable), and kMaxElems bounds keep every size_t
+      // product below 2^64 (same overflow discipline as PUSHROWS)
+      const long long kMaxElems = (512ll << 20) / int(sizeof(float));
+      if (b < 0 || b > kMaxElems || d <= 0 || d > kMaxElems ||
+          (c != 8 && c != 4) || (c == 4 && d % 2 != 0))
+        break;
+      long long padded = ((b > 0 ? b : 1) + d - 1) / d * d;
+      std::string scales, codes;
+      if (!ReadBody(fd, size_t(padded / d) * sizeof(float), &scales)) break;
+      if (!ReadBody(fd, size_t(c == 8 ? padded : padded / 2), &codes)) break;
+      resp = WithTrace(
+          ps->PushQuantizedBlocks(int(a), name, b, c, d, scales, codes),
+          line);
     } else if (float scale = 0.f;
                sscanf(line.c_str(), "PUSHQ %lld %255s %lld %f",
                       &a, name, &b, &scale) == 4) {

@@ -401,6 +401,30 @@ class PSClient(FramedClient):
         self.pushes_sent += 1
         return int(resp.split()[1])
 
+    def push_quantized_blocks(self, name: str, grad: np.ndarray,
+                              span: Optional[str] = None, bits: int = 8,
+                              block: int = 256) -> int:
+        """Block-scaled quantized dense push (PUSHQB): one f32 abs-max
+        scale per ``block`` elements instead of :meth:`push_quantized`'s
+        single per-tensor scale — an outlier only flattens its own
+        block — and optional int4 packing (two codes per byte) for
+        ~8× less wire. Shares its codec with the in-graph quantized
+        collective (``parallel.quantized_collectives``): zero blocks
+        encode exactly to zeros, non-finite blocks poison only their
+        own scale. Dequantized server-side before the identical update
+        path. The body is scales then codes; n is the UNPADDED element
+        count (the server derives the padded/packed lengths from
+        n/bits/block, pinned by the wire-contract analyzer)."""
+        from .quantized_collectives import encode_wire_blocks
+        g = np.ascontiguousarray(grad, dtype=np.float32).reshape(-1)
+        q, scales = encode_wire_blocks(g, bits=bits, block_size=block)
+        resp = self._request(
+            f"PUSHQB {self.trainer_id} {self._check_name(name)} {g.size} "
+            f"{int(bits)} {int(block)}{self._trace_suffix(span)}",
+            scales.tobytes() + q.tobytes(), idempotent=False)
+        self.pushes_sent += 1
+        return int(resp.split()[1])
+
     def push_rows(self, name: str, row_ids: np.ndarray,
                   row_grads: np.ndarray,
                   span: Optional[str] = None) -> int:
@@ -584,6 +608,12 @@ class PSShardGroup:
                        span: Optional[str] = None) -> int:
         return self._client(self.owner(name)).push_quantized(name, grad,
                                                              span=span)
+
+    def push_quantized_blocks(self, name: str, grad: np.ndarray,
+                              span: Optional[str] = None, bits: int = 8,
+                              block: int = 256) -> int:
+        return self._client(self.owner(name)).push_quantized_blocks(
+            name, grad, span=span, bits=bits, block=block)
 
     def push_rows(self, name: str, row_ids, row_grads,
                   span: Optional[str] = None) -> int:
@@ -794,7 +824,7 @@ class AsyncPSTrainer:
     def __init__(self, program, addr, loss_name: str = "loss",
                  trainer_id: int = 0, pull_interval: int = 1,
                  fetch_list: Optional[Sequence[str]] = None,
-                 compress_grads: bool = False):
+                 compress_grads: bool = False, strategy=None):
         import jax
 
         self.program = program
@@ -802,6 +832,19 @@ class AsyncPSTrainer:
         self.client = _make_ps_client(addr, trainer_id)
         self.pull_interval = max(1, int(pull_interval))
         self.compress_grads = bool(compress_grads)
+        # DistStrategy.quantized_allreduce routes pushes through the
+        # SAME block-scaled encoder the collective path uses (PUSHQB
+        # verb): the one strategy knob covers both link crossings.
+        # Legacy compress_grads=True keeps the per-tensor PUSHQ verb.
+        qmode = ((getattr(strategy, "quantized_allreduce", "none")
+                  if strategy is not None else "none") or "none")
+        enforce(qmode in ("none", "int8", "int4"),
+                f"DistStrategy.quantized_allreduce={qmode!r} "
+                "(none|int8|int4)")
+        self.quant_bits = (None if qmode == "none"
+                           else (8 if qmode == "int8" else 4))
+        self.quant_block = int(getattr(strategy, "quant_block_size", 256)
+                               ) if strategy is not None else 256
         self.fetch_list = list(fetch_list) if fetch_list is not None else None
         self.params = None
         self.state = None
@@ -879,8 +922,15 @@ class AsyncPSTrainer:
         if self.global_step % self.pull_interval == 0:
             self.params = self._pull_into(self.params, span=span)
         grads, out, self.state = self._grad_fn(self.params, self.state, rng, feed)
-        send = (self.client.push_quantized if self.compress_grads
-                else self.client.push)
+        if self.quant_bits is not None:
+            import functools
+            send = functools.partial(self.client.push_quantized_blocks,
+                                     bits=self.quant_bits,
+                                     block=self.quant_block)
+        elif self.compress_grads:
+            send = self.client.push_quantized
+        else:
+            send = self.client.push
         for name, leaf in _named_leaves(jax.device_get(grads)):
             try:
                 send(name, leaf, span=span)

@@ -1,51 +1,186 @@
-"""Quantized ring collectives — int8-compressed all-reduce.
+"""Quantized ring collectives — block-scaled int8/int4 all-reduce.
 
 EQuARX-inspired (PAPERS.md: "Efficient Quantized AllReduce in XLA"):
-a ring all-reduce whose every hop carries int8 payloads with one f32
-abs-max scale per chunk instead of f32/bf16 — ~4× less wire at ~1%-of-
-max per-hop quantization error. XLA's native collectives (what GSPMD
-inserts for the rule-table shardings) remain the default everywhere;
-this exists for custom ``shard_map`` training loops on bandwidth-
-limited axes — the DCN data axis of a multi-host mesh, where the
-reference's gRPC pserver transport was the analogous bottleneck
-(grpc_bytebuffer_stream.cc zero-copy serde solved transport overhead;
-quantization attacks the byte count itself).
+a ring all-reduce whose every hop carries int8 (or packed int4)
+payloads with f32 abs-max scales instead of f32/bf16 — ~4× (int8) to
+~8× (int4) less wire at ~1%-of-max per-hop quantization error. XLA's
+native collectives (what GSPMD inserts for the rule-table shardings)
+remain the default everywhere; this exists for custom ``shard_map``
+training loops on bandwidth-limited axes — the DCN data axis of a
+multi-host mesh, where the reference's gRPC pserver transport was the
+analogous bottleneck (grpc_bytebuffer_stream.cc zero-copy serde solved
+transport overhead; quantization attacks the byte count itself).
+
+Scale granularity: ``block_size=None`` keeps the original per-chunk
+scalar scale (one f32 per ring chunk); an integer ``block_size`` B
+switches to BLOCK scaling — one f32 abs-max scale per B contiguous
+elements — so a single outlier only flattens the resolution of its own
+block instead of the whole tensor. Scales are zero/NaN-safe: an
+all-zero block encodes exactly to zeros (scale pinned to 1.0, no
+epsilon-floored division blowing tiny gradients away), and a block
+containing non-finite values is POISONED via its wire scale (the whole
+block dequantizes to NaN) so overflow detection downstream (loss
+scaler / NaN guard) still fires, while every other block stays intact
+— containment at block granularity instead of the historical
+whole-tensor scale collapse.
+
+``bits=4`` packs two codes per byte on the wire (bias-8 nibbles);
+``rng`` enables stochastic rounding (floor(x + u), u~U[0,1)) on the
+reduce-scatter-phase encodes — the all-gather phase always rounds
+deterministically so every rank still ends bitwise identical.
 
 Usage (inside shard_map, like lax.psum)::
 
-    grads = quantized_psum(local_grads, "dp")
+    grads = quantized_psum(local_grads, "dp", bits=8, block_size=256)
+
+The module also hosts the HOST-side numpy codec
+(:func:`encode_wire_blocks` / :func:`decode_wire_blocks`) the async-PS
+``PUSHQB`` wire verb shares with the jnp in-graph encoder — one block
+format, whether the link crossing is an ICI/DCN collective hop or a
+trainer→pserver TCP push — and :func:`ring_wire_bytes`, the
+bytes-on-wire accounting ``profile_report()``'s collective line uses.
 """
 
 from __future__ import annotations
 
+from typing import Optional, Tuple
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from ..quantize import _quant_dynamic
-
-
-def _quantize(v):
-    q, scale = _quant_dynamic(v, axes=tuple(range(v.ndim)))
-    return q, scale.reshape(())
+from ..core.errors import enforce
 
 
-def _dequantize(q, scale, qmax=127.0):
-    return q.astype(jnp.float32) * (scale / qmax)
+def _qmax(bits: int) -> float:
+    enforce(bits in (8, 4), f"quantized collectives carry int8 or int4 "
+            f"payloads, not int{bits}")
+    return float(2 ** (bits - 1) - 1)  # 127 / 7
 
 
-def quantized_psum(x, axis_name: str):
-    """Ring all-reduce of ``x`` over ``axis_name`` with int8-quantized
-    hops. Drop-in for ``lax.psum`` inside ``shard_map`` when wire bytes
-    matter more than exactness; accumulation stays f32, each of the
-    2(P-1) hops quantizes its payload (error per hop ≤ max/127 of the
-    partial being carried).
+def _align(bits: int, block_size: Optional[int]) -> int:
+    """Element alignment an encoded vector needs: the block grid, and
+    an even count for int4 (two codes share a byte)."""
+    a = int(block_size) if block_size else 1
+    if bits == 4 and a % 2:
+        a *= 2
+    return a
+
+
+def _check_block(bits: int, block_size: Optional[int]) -> None:
+    _qmax(bits)
+    if block_size is not None:
+        enforce(int(block_size) >= 1,
+                f"quant block_size must be >= 1, got {block_size}")
+        enforce(bits != 4 or int(block_size) % 2 == 0,
+                f"int4 packs two codes per byte: block_size must be even, "
+                f"got {block_size}")
+
+
+def _pack4(q):
+    """int8 codes in [-7, 7] (even count) → uint8, two bias-8 nibbles
+    per byte: lo | hi<<4."""
+    u = (q.astype(jnp.int32) + 8).astype(jnp.uint8)
+    return u[0::2] | (u[1::2] << 4)
+
+
+def _unpack4(payload):
+    """Inverse of :func:`_pack4` (returns 2× the payload length)."""
+    lo = (payload & 0xF).astype(jnp.int32) - 8
+    hi = ((payload >> 4) & 0xF).astype(jnp.int32) - 8
+    return jnp.stack([lo, hi], axis=1).reshape(-1).astype(jnp.int8)
+
+
+def _safe_scales(v2):
+    """Per-row (code_scale, wire_scale) for a (nblk, B) f32 grid.
+
+    code_scale is always finite/positive (abs-max over the FINITE
+    elements, 1.0 for all-zero blocks — zeros encode to exact zeros);
+    wire_scale equals code_scale except for blocks containing any
+    non-finite element, which get NaN so the whole block dequantizes
+    to NaN — non-finiteness survives the wire without poisoning the
+    neighbours."""
+    finite = jnp.isfinite(v2)
+    amax = jnp.max(jnp.where(finite, jnp.abs(v2), 0.0), axis=1)
+    safe = jnp.where(amax > 0, amax, 1.0).astype(jnp.float32)
+    wire = jnp.where(jnp.all(finite, axis=1), safe,
+                     jnp.float32(jnp.nan))
+    return safe, wire
+
+
+def _encode(flat, bits, block_size, rng=None):
+    """Aligned flat f32 vector → (wire payload, scales). Payload is
+    int8 codes (bits=8) or packed uint8 nibble pairs (bits=4); scales
+    are one f32 scalar (block_size=None) or f32[nblk]."""
+    qmax = _qmax(bits)
+    v2 = flat[None, :] if block_size is None else \
+        flat.reshape(-1, int(block_size))
+    safe, wire = _safe_scales(v2)
+    x = jnp.where(jnp.isfinite(v2), v2, 0.0) / safe[:, None] * qmax
+    q = jnp.round(x) if rng is None else \
+        jnp.floor(x + jax.random.uniform(rng, x.shape))
+    q = jnp.clip(q, -qmax, qmax).astype(jnp.int8).reshape(-1)
+    scales = wire.reshape(()) if block_size is None else wire
+    return (_pack4(q) if bits == 4 else q), scales
+
+
+def _decode(payload, scales, bits, block_size):
+    qmax = _qmax(bits)
+    q = (_unpack4(payload) if bits == 4 else payload).astype(jnp.float32)
+    if block_size is None:
+        return q * (scales / qmax)
+    return (q.reshape(-1, int(block_size))
+            * (scales[:, None] / qmax)).reshape(-1)
+
+
+def _ring_chunk(n: int, p: int, bits: int, block_size: Optional[int]) -> int:
+    """Per-rank chunk length of the ring: ceil(n/p) rounded up to the
+    encode alignment, so block boundaries never straddle chunks (the
+    block grid of a whole-tensor roundtrip and of the ring encodes
+    coincide — what makes error feedback compose with the ring)."""
+    chunk = -(-n // p)
+    a = _align(bits, block_size)
+    return -(-chunk // a) * a
+
+
+def block_roundtrip(x, *, bits: int = 8, block_size: Optional[int] = None,
+                    rng=None):
+    """Quantize-dequantize ``x`` through the wire grid WITHOUT an
+    exchange: the value a rank's contribution becomes on the wire.
+    ``x - block_roundtrip(x)`` is the local compression error — the
+    error-feedback residual the Trainer carries in its scan carry.
+    Alignment matches :func:`quantized_psum`'s chunk grid, so feeding
+    the roundtripped value into the ring re-encodes to the same codes
+    (abs-max quantization is idempotent per block)."""
+    _check_block(bits, block_size)
+    flat = x.astype(jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    a = _align(bits, block_size)
+    flat = jnp.pad(flat, (0, -(-n // a) * a - n))
+    out = _decode(*_encode(flat, bits, block_size, rng), bits, block_size)
+    return out[:n].reshape(x.shape).astype(x.dtype)
+
+
+def quantized_psum(x, axis_name: str, *, bits: int = 8,
+                   block_size: Optional[int] = None, rng=None):
+    """Ring all-reduce of ``x`` over ``axis_name`` with int8/int4-
+    quantized hops. Drop-in for ``lax.psum`` inside ``shard_map`` when
+    wire bytes matter more than exactness; accumulation stays f32,
+    each of the 2(P-1) hops quantizes its payload (error per hop ≤
+    max/qmax of the partial being carried, per scale block).
 
     Ring schedule (reduce-scatter then all-gather, one neighbor
     ppermute per step): rank r first forwards chunk (r+1)%P, adds its
     own contribution to the partial arriving at step k (chunk
     (r-k+1)%P), and after P-1 steps owns fully-reduced chunk (r+2)%P;
     the all-gather phase circulates the reduced chunks back around.
+
+    ``rng`` (optional) applies stochastic rounding to the reduce-
+    scatter-phase encodes only; the owner's roundtrip and the
+    all-gather hops stay deterministic so the across-rank bitwise-
+    identity contract holds regardless.
     """
+    _check_block(bits, block_size)
     p = jax.lax.axis_size(axis_name)
     if p == 1:
         return x
@@ -55,33 +190,35 @@ def quantized_psum(x, axis_name: str):
     orig_shape, orig_dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).reshape(-1)
     n = flat.shape[0]
-    chunk = -(-n // p)
+    chunk = _ring_chunk(n, p, bits, block_size)
     flat = jnp.pad(flat, (0, chunk * p - n))
     chunks = flat.reshape(p, chunk)
 
     def take(idx):
         return jax.lax.dynamic_index_in_dim(chunks, idx, 0, keepdims=False)
 
-    def hop(v):
-        q, s = _quantize(v)
+    def hop(v, key=None):
+        q, s = _encode(v, bits, block_size, key)
         q = jax.lax.ppermute(q, axis_name, perm)
         s = jax.lax.ppermute(s, axis_name, perm)
-        return _dequantize(q, s)
+        return _decode(q, s, bits, block_size)
 
     # reduce-scatter: after the loop `carry` is chunk (r+2)%p summed
     # over every rank
     carry = take((r + 1) % p)
     for k in range(1, p):
-        carry = hop(carry) + take((r - k + 1) % p)
+        key = jax.random.fold_in(rng, k) if rng is not None else None
+        carry = hop(carry, key) + take((r - k + 1) % p)
 
     # all-gather: circulate the reduced chunks; rank r receives chunk
     # owned by rank r-k, i.e. ((r-k)+2)%p, at step k. The OWNER also
     # stores the quantized roundtrip of its chunk, not the exact f32:
-    # abs-max quantization is idempotent (the max maps to exactly ±127,
-    # so every further hop re-encodes to the same codes), which makes
-    # the final result BITWISE IDENTICAL on every rank — the all-reduce
-    # contract DP replicas rely on to not drift.
-    carry = _dequantize(*_quantize(carry))
+    # abs-max quantization is idempotent per scale block (the block max
+    # maps to exactly ±qmax, so every further hop re-encodes to the
+    # same codes), which makes the final result BITWISE IDENTICAL on
+    # every rank — the all-reduce contract DP replicas rely on to not
+    # drift.
+    carry = _decode(*_encode(carry, bits, block_size), bits, block_size)
     out = jnp.zeros_like(chunks)
     out = jax.lax.dynamic_update_index_in_dim(out, carry, (r + 2) % p, 0)
     recv = carry
@@ -92,7 +229,98 @@ def quantized_psum(x, axis_name: str):
     return out.reshape(-1)[:n].reshape(orig_shape).astype(orig_dtype)
 
 
-def quantized_pmean(x, axis_name: str):
+def quantized_pmean(x, axis_name: str, *, bits: int = 8,
+                    block_size: Optional[int] = None, rng=None):
     """Mean-reduction sibling of :func:`quantized_psum` (the gradient
     averaging form data-parallel training actually uses)."""
-    return quantized_psum(x, axis_name) / jax.lax.axis_size(axis_name)
+    return quantized_psum(x, axis_name, bits=bits, block_size=block_size,
+                          rng=rng) / jax.lax.axis_size(axis_name)
+
+
+# --------------------------------------------------------------------------
+# host-side wire codec (the async-PS PUSHQB verb) + byte accounting
+# --------------------------------------------------------------------------
+
+
+def encode_wire_blocks(arr, *, bits: int = 8, block_size: int = 256
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Numpy twin of the in-graph encoder, for host wire crossings
+    (``PSClient.push_quantized_blocks``): flat gradient → (payload,
+    scales). Payload is int8 codes (bits=8) or packed bias-8 nibble
+    pairs as uint8 (bits=4), input padded with zeros to the block
+    grid; scales are f32[nblk] with the same zero/NaN-safe semantics
+    as the collective's (:func:`_safe_scales`)."""
+    enforce(block_size and int(block_size) >= 1,
+            f"encode_wire_blocks needs a positive block_size, "
+            f"got {block_size}")
+    _check_block(bits, block_size)
+    b = int(block_size)
+    qmax = _qmax(bits)
+    g = np.ascontiguousarray(arr, dtype=np.float32).reshape(-1)
+    n = g.size
+    padded = -(-max(n, 1) // b) * b
+    g = np.pad(g, (0, padded - n))
+    v2 = g.reshape(-1, b)
+    finite = np.isfinite(v2)
+    amax = np.max(np.abs(np.where(finite, v2, 0.0)), axis=1)
+    safe = np.where(amax > 0, amax, 1.0).astype(np.float32)
+    wire = np.where(finite.all(axis=1), safe,
+                    np.float32(np.nan)).astype(np.float32)
+    q = np.clip(np.rint(np.where(finite, v2, 0.0) / safe[:, None] * qmax),
+                -qmax, qmax).astype(np.int8).reshape(-1)
+    if bits == 4:
+        u = (q.astype(np.int32) + 8).astype(np.uint8)
+        q = (u[0::2] | (u[1::2] << 4)).astype(np.uint8)
+    return q, wire
+
+
+def decode_wire_blocks(payload, scales, n: int, *, bits: int = 8,
+                       block_size: int = 256) -> np.ndarray:
+    """Inverse of :func:`encode_wire_blocks` (the pserver's dequant,
+    in numpy — parity-tested against both the C++ server and the jnp
+    in-graph decoder)."""
+    _check_block(bits, block_size)
+    b = int(block_size)
+    qmax = _qmax(bits)
+    q = np.asarray(payload)
+    if bits == 4:
+        u = q.view(np.uint8) if q.dtype != np.uint8 else q
+        lo = (u & 0xF).astype(np.int32) - 8
+        hi = ((u >> 4) & 0xF).astype(np.int32) - 8
+        q = np.stack([lo, hi], axis=1).reshape(-1)
+    s = np.asarray(scales, dtype=np.float32)
+    out = (q.astype(np.float32).reshape(-1, b)
+           * (s[:, None] / qmax)).reshape(-1)
+    return out[:n]
+
+
+def wire_block_bytes(n: int, *, bits: int = 8, block_size: int = 256
+                     ) -> Tuple[int, int]:
+    """(payload_bytes, scales_bytes) :func:`encode_wire_blocks` puts on
+    the wire for ``n`` elements — what both the PUSHQB header contract
+    and the C++ server's body-length computation derive from."""
+    _check_block(bits, block_size)
+    b = int(block_size)
+    padded = -(-max(int(n), 1) // b) * b
+    nblk = padded // b
+    return (padded if bits == 8 else padded // 2), 4 * nblk
+
+
+def ring_wire_bytes(n: int, p: int, *, bits: Optional[int] = None,
+                    block_size: Optional[int] = None) -> int:
+    """Per-device bytes-on-wire of ONE ring all-reduce of ``n``
+    elements over a ``p``-ring: 2(p-1) hops, each carrying one chunk's
+    payload (+ scales when quantized). ``bits=None`` is the f32
+    baseline — the same ring schedule at 4 bytes/element, the apples-
+    to-apples denominator of the collective-bytes attribution in
+    ``profile_report()``."""
+    n, p = int(n), int(p)
+    if p <= 1 or n <= 0:
+        return 0
+    if bits is None:
+        return 2 * (p - 1) * (-(-n // p)) * 4
+    _check_block(bits, block_size)
+    chunk = _ring_chunk(n, p, bits, block_size)
+    codes = chunk if bits == 8 else chunk // 2
+    scales = 4 * (chunk // int(block_size) if block_size else 1)
+    return 2 * (p - 1) * (codes + scales)

@@ -75,6 +75,33 @@ class DistStrategy:
     # inner kernel).
     sequence_parallel: bool = False
     sp_impl: str = "ring"
+    # quantized gradient exchange (EQuARX lineage, PAPERS.md): "int8" /
+    # "int4" replaces the per-step gradient all-reduce with the block-
+    # scaled quantized ring (parallel.quantized_collectives) — and, in
+    # async PS mode, routes gradient pushes through the block-scaled
+    # PUSHQB wire verb. "none" (default) keeps today's exact exchange,
+    # bit-identically. The collective path runs the grad exchange
+    # shard_map-local over the data axes (same preconditions as
+    # accum_exchange="hoisted": fully replicated params, stateless
+    # model, divisible batch) so the ring carries int8/int4 on the wire
+    # instead of letting GSPMD insert a f32 all-reduce.
+    quantized_allreduce: str = "none"
+    # elements per f32 abs-max scale block; one outlier only flattens
+    # its own block's resolution. Smaller = tighter error, more scale
+    # bytes (overhead 4/block_size of the int8 payload).
+    quant_block_size: int = 256
+    # carry the per-rank quantization error (grad - its wire roundtrip)
+    # in the step/scan carry and add it back into the NEXT step's
+    # gradient before encoding — error telescopes across the fused
+    # K-step program instead of compounding (1-bit SGD / EF-SGD
+    # lineage). Residual lives in UNSCALED gradient units and is rolled
+    # back on skipped (non-finite) steps.
+    error_feedback: bool = True
+    # stochastic rounding on the encode path, keyed off the step rng:
+    # floor(x/scale*qmax + u), unbiased per element. Applied to the
+    # initial quantization and reduce-scatter hops only — all-gather
+    # hops stay deterministic, preserving cross-rank bitwise identity.
+    quant_stochastic_rounding: bool = False
     # async parameter-server mode (listen_and_serv RunAsyncLoop analog):
     # barrier-free grad push / param pull through the C++ pserver
     # (parallel.async_ps) instead of SPMD collectives. Set by

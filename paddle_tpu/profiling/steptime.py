@@ -150,7 +150,11 @@ def profile_report(trainer, fusion: Optional[Dict[str, Any]] = None
       from the pipeline report;
     - ``pipeline`` — the full ``pipeline_report()``;
     - ``fusion`` — the top-k fusion table when one has been computed
-      (``Trainer.fusion_report``), else None.
+      (``Trainer.fusion_report``), else None;
+    - ``collective`` — static bytes-on-wire attribution of the per-step
+      gradient exchange (``Trainer.collective_bytes``: fp32 baseline vs
+      the configured quantized wire format, per data axis), or None
+      off-mesh.
     """
     st = trainer.step_timer.report()
     pipe = trainer.pipeline_report()
@@ -173,6 +177,7 @@ def profile_report(trainer, fusion: Optional[Dict[str, Any]] = None
         "input_bound": pipe.get("input_bound", False),
         "pipeline": pipe,
         "fusion": fusion,
+        "collective": getattr(trainer, "collective_bytes", None),
     }
 
 

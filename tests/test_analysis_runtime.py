@@ -375,7 +375,7 @@ class TestWireFixtures:
 
 EXPECTED_VERBS = {
     "ps": {"DELETE", "EXPORT", "IMPORT", "INIT", "PULL", "PUSH", "PUSHQ",
-           "PUSHROWS", "QUIT", "SAVE", "STATUS"},
+           "PUSHQB", "PUSHROWS", "QUIT", "SAVE", "STATUS"},
     "fleet": {"HEALTH", "JOURNAL", "KILL", "METRICS", "QUIT", "RELOAD",
               "REPORT", "SHUTDOWN", "SUBMIT"},
     "telemetry": {"EVENTS", "PING", "QUIT", "SNAPSHOT", "STATS"},
@@ -397,9 +397,10 @@ class TestLiveTree:
         rows = wire_contracts.verb_table()
         amo = {(r["surface"], r["verb"]) for r in rows
                if r["retry"] == wire_contracts.AT_MOST_ONCE}
-        assert amo == {("ps", "PUSH"), ("ps", "PUSHQ"), ("ps", "PUSHROWS"),
-                       ("fleet", "SUBMIT"), ("fleet", "RELOAD"),
-                       ("fleet", "KILL"), ("fleet", "SHUTDOWN")}
+        assert amo == {("ps", "PUSH"), ("ps", "PUSHQ"), ("ps", "PUSHQB"),
+                       ("ps", "PUSHROWS"), ("fleet", "SUBMIT"),
+                       ("fleet", "RELOAD"), ("fleet", "KILL"),
+                       ("fleet", "SHUTDOWN")}
 
     def test_wire_surfaces_are_clean(self):
         for subj, rep in wire_contracts.check_wire():

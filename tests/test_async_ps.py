@@ -265,6 +265,58 @@ def test_push_quantized_math(sgd_server):
     c.close()
 
 
+def test_push_quantized_blocks_math(sgd_server):
+    """PUSHQB: the block-scaled wire format. Server dequant must be
+    BIT-EXACT against the host codec (decode_wire_blocks) — the pserver
+    sees the same gradient the trainer's own roundtrip produces — and
+    int4 payloads ride at two codes per byte."""
+    from paddle_tpu.parallel import quantized_collectives as qc
+
+    c = PSClient(sgd_server.addr)
+    rng = np.random.RandomState(9)
+    for bits, name in ((8, "wb8"), (4, "wb4")):
+        w0 = rng.randn(300).astype(np.float32)  # not a block multiple
+        g = (rng.randn(300) * 2).astype(np.float32)
+        c.init_param(name, w0)
+        c.push_quantized_blocks(name, g, bits=bits, block=128)
+        got = c.pull(name, (300,))
+        payload, scales = qc.encode_wire_blocks(g, bits=bits,
+                                                block_size=128)
+        deq = qc.decode_wire_blocks(payload, scales, g.size, bits=bits,
+                                    block_size=128)
+        np.testing.assert_array_equal(got, w0 - np.float32(0.1) * deq)
+    # malformed headers close cleanly with an error, not a wedge
+    with pytest.raises(RuntimeError, match="size mismatch"):
+        c.push_quantized_blocks("wb8", np.ones(301, np.float32))
+    c.close()
+
+
+def test_async_trainer_strategy_routes_quantized_blocks(sgd_server):
+    """AsyncPSTrainer(strategy=DistStrategy(quantized_allreduce=...))
+    sends PUSHQB instead of PUSH — pinned via the server's qpushes
+    counter and a pull that shows the block-dequantized update."""
+    from paddle_tpu.parallel import DistStrategy
+
+    c = PSClient(sgd_server.addr)
+    before = c.status().get("qpushes", 0)
+
+    prog = pt.build(mnist.mlp)
+    tr = AsyncPSTrainer(prog, sgd_server.addr,
+                        strategy=DistStrategy(quantized_allreduce="int8",
+                                              quant_block_size=64))
+    assert tr.quant_bits == 8 and tr.quant_block == 64
+    rng = np.random.RandomState(11)
+    feed = {"image": rng.randn(8, 784).astype(np.float32),
+            "label": rng.randint(0, 10, (8, 1)).astype(np.int64)}
+    tr.startup(sample_feed=feed)
+    tr.step(feed)
+    after = c.status().get("qpushes", 0)
+    nparams = len(tr.params)
+    tr.client.close()
+    c.close()
+    assert after - before >= nparams, (before, after, nparams)
+
+
 @pytest.mark.slow
 def test_compressed_async_training_converges():
     """compress_grads=True: int8 gradient pushes, same learnable task —

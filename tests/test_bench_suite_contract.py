@@ -28,8 +28,9 @@ def test_priority_order_leads_with_baseline_configs():
     # every registered config appears exactly once
     expect = (set(bench.TRAIN_CONFIGS) | set(bench.INFER_CONFIGS)
               | {"gpt_decode", "dispatch_overhead", "guard_overhead",
-                 "input_pipeline", "device_cache", "serving",
-                 "serving_fleet", "fusion_profile", "elastic_reshard"})
+                 "quantized_allreduce", "input_pipeline", "device_cache",
+                 "serving", "serving_fleet", "fusion_profile",
+                 "elastic_reshard"})
     assert set(names) == expect and len(names) == len(expect)
 
 
@@ -84,6 +85,15 @@ def test_guard_overhead_quick_overrides(monkeypatch):
                         lambda peak, **kw: seen.update(kw) or {"v": 1})
     bench._run_one("guard_overhead", 1.0, quick=True)
     assert seen == {"iters": 8, "k": 4}
+
+
+def test_quantized_allreduce_quick_overrides(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(bench, "bench_quantized_allreduce",
+                        lambda peak, **kw: seen.update(kw) or {"v": 1})
+    bench._run_one("quantized_allreduce", 1.0, quick=True)
+    assert seen == {"iters": 8, "k": 4}
+    assert bench._result_key("quantized_allreduce") == "quantized_allreduce"
 
 
 def test_input_pipeline_quick_overrides(monkeypatch):
