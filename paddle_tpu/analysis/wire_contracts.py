@@ -526,7 +526,12 @@ SURFACES = {
     },
     "fleet": {
         "client": os.path.join(_PKG_ROOT, "fleet", "remote.py"),
-        "server": os.path.join(_PKG_ROOT, "fleet", "replica_main.py"),
+        # the fleet control plane has TWO server processes on one
+        # client module: the replica (SUBMIT/RELOAD/... + the artifact
+        # door) and the per-host agent (SPAWN/STOP/PS + the same
+        # artifact door) — both dispatch in a serve_conn loop
+        "server": [os.path.join(_PKG_ROOT, "fleet", "replica_main.py"),
+                   os.path.join(_PKG_ROOT, "fleet", "agent.py")],
         "server_kind": "py",
         "dispatchers": ("serve_conn",),
     },
@@ -550,11 +555,21 @@ def scrape_surface(name: str, cfg: Optional[dict] = None
     if cfg.get("server_kind", "py") == "c":
         server = scrape_c_server(cfg["server"])
     else:
-        server = scrape_python_server(
-            cfg["server"], dispatchers=cfg.get("dispatchers", ()),
-            parts_var=cfg.get("parts_var", "parts"),
-            body_reader=cfg.get("body_reader", "read_exact"),
-            reply_marker=cfg.get("reply_marker", "_reply_json"))
+        # "server" may be ONE path or a list of server modules that
+        # speak the same surface (fleet: replica + per-host agent);
+        # their verb tables merge exactly like multiple callsites do
+        paths = cfg["server"]
+        if isinstance(paths, str):
+            paths = [paths]
+        server = {}
+        for path in paths:
+            one = scrape_python_server(
+                path, dispatchers=cfg.get("dispatchers", ()),
+                parts_var=cfg.get("parts_var", "parts"),
+                body_reader=cfg.get("body_reader", "read_exact"),
+                reply_marker=cfg.get("reply_marker", "_reply_json"))
+            for side in one.values():
+                _merge(server, side)
     # fleet/telemetry clients inherit the framed transport's QUIT
     if cfg.get("server_kind") != "c" and cfg["client"] != _TRANSPORT_CLIENT \
             and os.path.exists(_TRANSPORT_CLIENT):

@@ -102,25 +102,54 @@ def _np_dtype(name: str):
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def pack_tree(obj) -> Tuple[bytes, bytes]:
+def _wire_spec(spec):
+    """Normalize a ``WireSpec`` | its field dict (the JSON shape that
+    rides a SUBMIT meta item) into a ``WireSpec``."""
+    from ..data.wire import WireSpec
+
+    if isinstance(spec, WireSpec):
+        return spec
+    if isinstance(spec, dict):
+        return WireSpec(**spec)
+    raise TypeError(f"feed wire spec: expected WireSpec or dict, "
+                    f"got {type(spec).__name__}")
+
+
+def pack_tree(obj, wire: Optional[Dict[str, Any]] = None) \
+        -> Tuple[bytes, bytes]:
     """Encode a feed dict / output tree of arrays as ``(meta_json,
     payload)``: the meta names each leaf's place, shape, and dtype; the
     payload is the leaves' contiguous bytes concatenated in meta
     order. Supported shapes: dict of arrays, single array, list/tuple
-    of arrays (scalars ride as 0-d arrays)."""
+    of arrays (scalars ride as 0-d arrays).
+
+    ``wire`` (dict-shaped feeds only) maps field names to
+    :class:`~paddle_tpu.data.wire.WireSpec`s: those fields cross the
+    link in the narrower wire dtype (the 53 MB/s lesson applied to
+    serving SUBMITs), with the spec embedded in the meta item so the
+    replica's :func:`unpack_tree` decodes back to the logical value —
+    the wire schema itself is unchanged (same two bodies)."""
     chunks: List[bytes] = []
 
-    def leaf(v) -> Dict[str, Any]:
+    def leaf(v, spec=None) -> Dict[str, Any]:
         a = np.ascontiguousarray(np.asarray(v))
+        extra: Dict[str, Any] = {}
+        if spec is not None and spec.kind != "passthrough":
+            a = np.ascontiguousarray(spec.encode(a))
+            extra["wire"] = {
+                "kind": spec.kind, "wire_dtype": spec.wire_dtype,
+                "decode_dtype": spec.decode_dtype,
+                "scale": spec.scale, "zero_point": spec.zero_point}
         b = a.tobytes()
         chunks.append(b)
         return {"shape": list(a.shape), "dtype": a.dtype.name,
-                "nbytes": len(b)}
+                "nbytes": len(b), **extra}
 
     if isinstance(obj, dict):
+        specs = {k: _wire_spec(s) for k, s in (wire or {}).items()}
         meta: Dict[str, Any] = {
             "kind": "dict",
-            "items": [{"name": str(k), **leaf(obj[k])}
+            "items": [{"name": str(k), **leaf(obj[k], specs.get(str(k)))}
                       for k in sorted(obj, key=str)]}
     elif isinstance(obj, (list, tuple)):
         meta = {"kind": "list" if isinstance(obj, list) else "tuple",
@@ -130,17 +159,34 @@ def pack_tree(obj) -> Tuple[bytes, bytes]:
     return json.dumps(meta).encode(), b"".join(chunks)
 
 
-def unpack_tree(meta_bytes: bytes, payload: bytes):
-    """Inverse of :func:`pack_tree`."""
+def unpack_tree(meta_bytes: bytes, payload: bytes,
+                counters: Optional[Dict[str, int]] = None):
+    """Inverse of :func:`pack_tree`: wire-encoded items (a ``"wire"``
+    spec in the meta) are decoded back to their logical dtype.
+    ``counters`` (optional dict) accumulates ``wire_bytes`` (what
+    actually crossed the link) and ``logical_bytes`` (what a
+    passthrough transfer of the same values would have cost) — the
+    replica's serving report reads them."""
     meta = json.loads(meta_bytes)
     leaves = []
     off = 0
+    wire_bytes = logical_bytes = 0
     for item in meta["items"]:
         n = int(item["nbytes"])
         a = np.frombuffer(payload[off:off + n],
                           dtype=_np_dtype(item["dtype"]))
-        leaves.append(a.reshape(item["shape"]).copy())
+        a = a.reshape(item["shape"]).copy()
+        w = item.get("wire")
+        if w is not None:
+            a = np.asarray(_wire_spec(w).decode(a))
+        wire_bytes += n
+        logical_bytes += int(a.nbytes)
+        leaves.append(a)
         off += n
+    if counters is not None:
+        counters["wire_bytes"] = counters.get("wire_bytes", 0) + wire_bytes
+        counters["logical_bytes"] = (counters.get("logical_bytes", 0)
+                                     + logical_bytes)
     if meta["kind"] == "dict":
         return {item["name"]: leaf
                 for item, leaf in zip(meta["items"], leaves)}
@@ -221,6 +267,223 @@ class _ControlClient(FramedClient):
         return json.loads(body) if body else None
 
 
+# -- artifact distribution ----------------------------------------------------
+
+ARTIFACT_CHUNK = 1 << 18   # 256 KiB ARTIFACT chunk frames
+
+
+def parse_hostport(addr) -> Tuple[str, int]:
+    """``"host:port"`` or ``(host, port)`` → ``(host, port)``."""
+    if isinstance(addr, str):
+        host, _, port = addr.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"bad host address {addr!r} (want host:port)")
+        return (host, int(port))
+    host, port = addr
+    return (str(host), int(port))
+
+
+def ship_artifact(addr: Tuple[str, int], dirname: str,
+                  timeout: float = 600.0,
+                  chunk_bytes: int = ARTIFACT_CHUNK) -> str:
+    """Stream a committed ``save_inference_model`` dir to the artifact
+    store behind ``addr`` (a replica or a host agent — both speak the
+    same door) and return the RECEIVER-side committed path.
+
+    Protocol: ``FETCH`` negotiates (the manifest's file/CRC table under
+    a content-addressed token — an already-committed token is a
+    zero-byte no-op, and the reply's have-map resumes a torn transfer
+    where it stopped), ``ARTIFACT`` frames carry per-chunk-CRC'd file
+    bytes with no reply (pipelined), and a final ``FETCH commit``
+    CRC-validates every staged file against the manifest before the
+    receiver's atomic rename — a connection lost mid-stream leaves only
+    a resumable staging dir, never a half-written artifact. Raises
+    ``ConnectionError`` (connection-shaped, so the router's reload
+    rollback machinery engages) when the receiver stays unreachable."""
+    import zlib
+
+    from ..io import artifact_fingerprint
+    from ..resilience import MANIFEST_NAME, _crc32_file
+
+    path = os.path.abspath(dirname)
+    man, token = artifact_fingerprint(path)
+    mf_crc, mf_size = _crc32_file(os.path.join(path, MANIFEST_NAME))
+    # the manifest file ships verbatim like any other member, so the
+    # committed copy is byte-identical to the source dir
+    expected = {name: {"crc32": int(spec["crc32"]),
+                       "size": int(spec["size"])}
+                for name, spec in man["files"].items()}
+    expected[MANIFEST_NAME] = {"crc32": mf_crc, "size": mf_size}
+    negotiate = json.dumps({"token": token, "files": expected,
+                            "commit": False}).encode()
+    commit = json.dumps({"token": token, "commit": True}).encode()
+    last_err: Optional[BaseException] = None
+    for _attempt in range(3):
+        cli = _ControlClient(tuple(addr), timeout=timeout, retries=2,
+                             retry_backoff=0.05, connect=False)
+        try:
+            st = cli.call(f"FETCH {token} {len(negotiate)}", negotiate,
+                          timeout=timeout)
+            if st.get("complete"):
+                return st["path"]
+            have = dict(st.get("have") or {})
+            sock = cli._sock
+            for fname in sorted(expected):
+                start = int(have.get(fname, 0))
+                if start >= expected[fname]["size"]:
+                    continue
+                with open(os.path.join(path, fname), "rb") as f:
+                    f.seek(start)
+                    off = start
+                    while True:
+                        data = f.read(chunk_bytes)
+                        if not data:
+                            break
+                        crc = zlib.crc32(data) & 0xFFFFFFFF
+                        hdr = (f"ARTIFACT {token} {fname} {off} "
+                               f"{len(data)} {crc:08x}\n").encode()
+                        sock.sendall(hdr + data)
+                        off += len(data)
+            st = cli.call(f"FETCH {token} {len(commit)}", commit,
+                          timeout=timeout)
+            if st.get("complete"):
+                return st["path"]
+            # receiver rejected some staged files (corrupted in
+            # flight): the next lap renegotiates and re-ships exactly
+            # the files its have-map no longer covers
+            last_err = ConnectionError(
+                f"artifact {token} commit rejected by {addr}: "
+                f"bad={st.get('bad')}")
+        except (OSError, ConnectionError) as e:
+            last_err = e
+        finally:
+            try:
+                cli.close()
+            except Exception:
+                pass
+    raise ConnectionError(
+        f"could not ship artifact {dirname!r} to {addr}: {last_err}")
+
+
+class ArtifactStore:
+    """Receiver half of the FETCH/ARTIFACT pair: a per-host artifact
+    cache keyed by content-addressed token. Chunks land in a
+    ``<token>.staging`` sibling (resumable — the negotiate reply's
+    have-map is just the staged sizes); commit CRC-validates every file
+    against the negotiated table and renames the staging dir into place
+    atomically, so the cache either holds a fully-validated artifact at
+    ``<root>/<token>`` or nothing there at all. A bad chunk never
+    errors the stream (ARTIFACT frames have no reply, the sender is
+    pipelining): the staged file is dropped and the commit reply's
+    ``bad`` list makes the sender re-ship it."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._expected: Dict[str, Dict[str, Any]] = {}
+
+    def _paths(self, token: str) -> Tuple[str, str]:
+        if not token or "/" in token or "\\" in token or ".." in token:
+            raise ValueError(f"bad artifact token {token!r}")
+        return (os.path.join(self.root, token),
+                os.path.join(self.root, token + ".staging"))
+
+    @staticmethod
+    def _safe_name(fname: str) -> bool:
+        return bool(fname) and "/" not in fname and "\\" not in fname \
+            and ".." not in fname and not fname.startswith(".")
+
+    def handle_fetch(self, token: str, body: bytes) -> Dict[str, Any]:
+        """One FETCH round trip: negotiate (``commit: false``) or
+        commit (``commit: true``)."""
+        req = json.loads(body or b"{}")
+        final, staging = self._paths(token)
+        with self._lock:
+            if req.get("commit"):
+                return self._commit_locked(token, final, staging)
+            files = {name: spec
+                     for name, spec in dict(req.get("files") or {}).items()
+                     if self._safe_name(name)}
+            return self._begin_locked(token, final, staging, files)
+
+    def _begin_locked(self, token, final, staging, files):
+        if os.path.isdir(final):
+            return {"complete": True, "path": final}
+        self._expected[token] = files
+        os.makedirs(staging, exist_ok=True)
+        have = {}
+        for name in os.listdir(staging):
+            p = os.path.join(staging, name)
+            if os.path.isfile(p):
+                have[name] = os.path.getsize(p)
+        return {"complete": False, "have": have, "path": final}
+
+    def handle_chunk(self, token: str, fname: str, off: int,
+                     crc: int, data: bytes) -> None:
+        """One ARTIFACT frame: append iff the chunk CRC matches and it
+        lands exactly at the staged tail; anything else poisons the
+        staged file (dropped, re-shipped after commit reports it)."""
+        import zlib
+
+        _, staging = self._paths(token)
+        if not self._safe_name(fname):
+            return
+        with self._lock:
+            if not os.path.isdir(staging):
+                return    # no negotiation for this token: drop
+            p = os.path.join(staging, fname)
+            size = os.path.getsize(p) if os.path.exists(p) else 0
+            if (zlib.crc32(data) & 0xFFFFFFFF) != crc or off != size:
+                if os.path.exists(p):
+                    os.unlink(p)
+                return
+            with open(p, "ab") as f:
+                f.write(data)
+
+    def _commit_locked(self, token, final, staging):
+        from .. import resilience
+
+        if os.path.isdir(final):
+            return {"complete": True, "path": final}
+        expected = self._expected.get(token)
+        if expected is None or not os.path.isdir(staging):
+            return {"complete": False, "bad": ["<no staging session>"],
+                    "have": {}}
+        bad = []
+        for name, spec in expected.items():
+            p = os.path.join(staging, name)
+            try:
+                crc, size = resilience._crc32_file(p)
+            except OSError:
+                bad.append(name)
+                continue
+            if size != int(spec["size"]) or crc != int(spec["crc32"]):
+                os.unlink(p)
+                bad.append(name)
+        if bad:
+            have = {}
+            for name in expected:
+                p = os.path.join(staging, name)
+                if name not in bad and os.path.exists(p):
+                    have[name] = os.path.getsize(p)
+            return {"complete": False, "bad": sorted(bad), "have": have}
+        for name in expected:
+            with open(os.path.join(staging, name), "rb") as f:
+                os.fsync(f.fileno())
+        os.rename(staging, final)
+        try:
+            dfd = os.open(self.root, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+        self._expected.pop(token, None)
+        return {"complete": True, "path": final}
+
+
 # -- the replica process ------------------------------------------------------
 
 
@@ -234,10 +497,16 @@ class ReplicaProcess:
     awaited together (they AOT-compile concurrently)."""
 
     def __init__(self, dirname: str, server_kw: Optional[Dict] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 artifact_root: Optional[str] = None,
+                 bind: Optional[str] = None):
         self.dirname = dirname
         self._cfg_dir = tempfile.mkdtemp(prefix="pdtpu_replica_")
         cfg = self._build_config(dirname, dict(server_kw or {}), host, port)
+        if artifact_root:
+            cfg["artifact_root"] = artifact_root
+        if bind:
+            cfg["bind"] = bind
         cfg_path = os.path.join(self._cfg_dir, "replica.json")
         with open(cfg_path, "w", encoding="utf-8") as f:
             json.dump(cfg, f)
@@ -571,10 +840,26 @@ class RemoteReplica:
                  slow_after: Optional[float] = None,
                  submit_timeout: float = 30.0,
                  connect_timeout: float = 1.0,
-                 reload_timeout: float = 600.0):
+                 reload_timeout: float = 600.0,
+                 agent: Optional["AgentClient"] = None,
+                 pid: Optional[int] = None,
+                 ship_artifacts: bool = False,
+                 feed_wire: Optional[Dict[str, Any]] = None):
         self.addr = tuple(addr)
         self.proc = proc
         self.name = name
+        # cross-host adoption: `agent` is the per-host launcher that
+        # owns the replica process (the waitpid oracle a proxied link
+        # can't be), `pid` its pid THERE, `ship_artifacts` makes
+        # reload() stream the dir over FETCH/ARTIFACT first (the
+        # replica's filesystem has never seen the router's paths), and
+        # `feed_wire` ({field: WireSpec}) narrows SUBMIT payloads
+        self.agent = agent
+        self.pid = pid if pid is not None else \
+            (proc.pid if proc is not None else None)
+        self.ship_artifacts = bool(ship_artifacts)
+        self.feed_wire = ({k: _wire_spec(s) for k, s in feed_wire.items()}
+                          if feed_wire else None)
         self.num_workers = int(num_workers)
         self.probe_timeout = probe_timeout
         self.down_cooldown = down_cooldown
@@ -607,9 +892,21 @@ class RemoteReplica:
 
     def _provably_dead(self) -> bool:
         """True only when the replica PROCESS is known dead — an owned
-        child that exited, or a fresh connect refused. A timeout (a
-        partition, a half-open link) proves nothing and returns
-        False."""
+        child that exited, a host agent reporting its pid reaped, or a
+        fresh probe refused/EOF'd. A timeout (a partition, a half-open
+        link) proves nothing and returns False.
+
+        Across a PROXIED link (testing/faults.LinkProxy, or any real
+        LB) "connect succeeded" means nothing — the proxy always
+        accepts — and "connect refused" never happens. Two proofs
+        replace waitpid there: (a) the replica's host agent IS a
+        waitpid oracle for children it spawned; (b) probe-EOF — a
+        fresh connection that accepts a probe and then closes cleanly
+        before a single reply byte is a proxy whose backend connect
+        was refused (LinkProxy and real proxies both do this), i.e.
+        nothing is listening where the process was. A partitioned
+        link times out instead of EOF'ing, so it still proves
+        nothing."""
         if self._killed:
             return True
         if self.proc is not None:
@@ -618,15 +915,36 @@ class RemoteReplica:
                 return True
             except Exception:
                 return False
+        if self.agent is not None and self.pid is not None:
+            try:
+                procs = {int(p.get("pid", -1)): p
+                         for p in self.agent.ps().get("procs", [])}
+                p = procs.get(int(self.pid))
+                # untracked => the agent reaped it; tracked+exited =>
+                # dead; tracked+alive => provably NOT dead
+                return p is None or not p.get("alive", False)
+            except Exception:
+                pass   # agent unreachable (whole-host kill): probe below
         try:
             s = socket.create_connection(self.addr,
                                          timeout=self.probe_timeout)
-            s.close()
-            return False
         except ConnectionRefusedError:
             return True
         except OSError:
             return False
+        try:
+            s.settimeout(self.probe_timeout)
+            probe = b"HEALTH\n"
+            s.sendall(probe)
+            first = s.recv(1)
+            return not first   # orderly EOF before any reply byte
+        except OSError:
+            return False       # timeout/reset: cannot prove death
+        finally:
+            try:
+                s.close()
+            except OSError:
+                pass
 
     # -- health --------------------------------------------------------------
 
@@ -680,7 +998,7 @@ class RemoteReplica:
         journals the same trace id. Never resends: a reply lost after
         the header left the socket is classified at-most-once."""
         span = self.journal.new_span()
-        meta, payload = pack_tree(feed)
+        meta, payload = pack_tree(feed, wire=self.feed_wire)
         dl = "-" if deadline is None else repr(float(deadline))
         # retry: at-most-once
         header = (f"SUBMIT {len(meta)} {len(payload)} {dl} "
@@ -759,15 +1077,30 @@ class RemoteReplica:
         ``CheckpointCorrupt``) re-raise exactly; a reply lost after
         send raises :class:`~paddle_tpu.parallel.async_ps.ReplyLost`
         (a ``ConnectionError``) — the replica MAY have swapped, which
-        the router's rollback treats as swapped-unknown."""
-        body = json.dumps({"dirname": dirname}).encode()
+        the router's rollback treats as swapped-unknown.
+
+        With ``ship_artifacts`` the dir is streamed over
+        FETCH/ARTIFACT first (content-addressed: an artifact the
+        replica's host already holds is a zero-byte negotiation) and
+        RELOAD points at the replica-side committed copy; a mid-ship
+        partition raises connection-shaped errors, which the router's
+        canary/rollback machinery converts to a typed ``ReloadFailed``
+        — and the receiver's atomic commit means there is never a
+        half-written artifact dir to roll back."""
         try:
+            if self.ship_artifacts:
+                dirname = ship_artifact(self.addr, dirname,
+                                        timeout=self.reload_timeout)
+            body = json.dumps({"dirname": dirname}).encode()
             return self._one_shot(f"RELOAD {len(body)}", body,
                                   timeout=self.reload_timeout)
         finally:
             # success bumped the generation; a lost reply left it
-            # UNKNOWN — either way the cached health snapshot is stale
-            # (and a router rollback's next probe must be real)
+            # UNKNOWN; a failed artifact ship means the link itself is
+            # suspect — in every case the cached health snapshot is
+            # stale (and a router rollback's next probe must be real,
+            # else a long health_ttl keeps routing to a replica whose
+            # wire just proved unreachable)
             with self._health_lock:
                 self._health_cache = None
 
@@ -786,6 +1119,11 @@ class RemoteReplica:
             pass
         if self.proc is not None:
             self.proc.stop()
+        if self.agent is not None and self.pid is not None:
+            try:
+                self.agent.stop(self.pid)
+            except Exception:
+                pass
 
     def close(self, drain: bool = True,
               timeout: Optional[float] = None) -> None:
@@ -807,6 +1145,11 @@ class RemoteReplica:
                 self.proc.wait(timeout=10.0)
             except Exception:
                 self.proc.stop()
+        if self.agent is not None and self.pid is not None:
+            try:
+                self.agent.stop(self.pid)
+            except Exception:
+                pass
         self._ctl.close()
 
     # -- observability -------------------------------------------------------
@@ -844,7 +1187,134 @@ class RemoteReplica:
 
     def __repr__(self) -> str:
         return (f"RemoteReplica({self.addr[0]}:{self.addr[1]}, "
-                f"pid={self.proc.pid if self.proc else '?'})")
+                f"pid={self.pid if self.pid is not None else '?'})")
+
+
+# -- the per-host agent, client side ------------------------------------------
+
+
+def encode_server_kw(kw: Dict[str, Any]) -> Dict[str, Any]:
+    """JSON-safe ``server_kw`` for the SPAWN body: dataclass policies
+    become dicts and the golden feed rides as base64 npz bytes — the
+    agent's host has no shared filesystem to read an npz path from."""
+    import base64
+    import io as _io
+
+    kw = dict(kw)
+    for key in ("batch_policy", "breaker"):
+        v = kw.get(key)
+        if v is not None and dataclasses.is_dataclass(v):
+            kw[key] = dataclasses.asdict(v)
+    golden = kw.pop("golden_feed", None)
+    if golden is not None:
+        buf = _io.BytesIO()
+        np.savez(buf, **{k: np.asarray(v) for k, v in golden.items()})
+        kw["golden_feed_npz"] = base64.b64encode(buf.getvalue()).decode()
+    return kw
+
+
+class AgentClient:
+    """Client for one per-host fleet agent (``python -m
+    paddle_tpu.fleet.agent``): SPAWN/STOP/PS over the framed wire plus
+    the same FETCH/ARTIFACT artifact door every replica has — ship an
+    artifact to a host once, spawn any number of replicas over it.
+    ``ps()`` doubles as the death oracle :meth:`RemoteReplica.
+    _provably_dead` consults for agent-managed replicas."""
+
+    def __init__(self, addr, timeout: float = 30.0):
+        self.addr = parse_hostport(addr)
+        self._timeout = timeout
+        self._cli = _ControlClient(self.addr, timeout=timeout, retries=3,
+                                   retry_backoff=0.05, connect=False)
+        self._lock = threading.Lock()
+
+    def ship(self, dirname: str, timeout: Optional[float] = None) -> str:
+        """Push an artifact into the agent's host cache; returns the
+        host-side committed path (a no-op when the token is cached)."""
+        return ship_artifact(self.addr, dirname,
+                             timeout=timeout or max(self._timeout, 600.0))
+
+    def spawn(self, dirname: str, server_kw: Optional[Dict] = None,
+              name: Optional[str] = None,
+              timeout: float = 600.0) -> Dict[str, Any]:
+        """Launch one replica process over a HOST-side artifact dir
+        (usually a :meth:`ship` result); blocks until its listener is
+        up. At-most-once: a spawn is never blindly resent — a lost
+        reply surfaces (the orphan, if any, is visible in ``ps()``)."""
+        body = json.dumps({"dirname": dirname, "name": name,
+                           "server_kw": encode_server_kw(
+                               dict(server_kw or {}))}).encode()
+        with self._lock:
+            return self._cli.call(f"SPAWN {len(body)}", body,
+                                  idempotent=False, timeout=timeout)
+
+    def stop(self, pid: int) -> Dict[str, Any]:
+        body = json.dumps({"pid": int(pid)}).encode()
+        with self._lock:
+            return self._cli.call(f"STOP {len(body)}", body,
+                                  timeout=self._timeout)
+
+    def ps(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._cli.call("PS", timeout=self._timeout)
+
+    def close(self) -> None:
+        try:
+            self._cli.close()
+        except Exception:
+            pass
+
+    def __repr__(self) -> str:
+        return f"AgentClient({self.addr[0]}:{self.addr[1]})"
+
+
+def adopt_replica(agent: AgentClient, dirname: str, name: str,
+                  remote_kw: Optional[Dict[str, Any]] = None,
+                  link=None, **server_kw) -> RemoteReplica:
+    """Ship ``dirname`` into ``agent``'s host cache (content-addressed
+    no-op when already there), SPAWN a replica over the host-side
+    copy, and wrap it in a :class:`RemoteReplica` that uses the agent
+    as its death oracle and ships artifacts on reload. ``link``
+    optionally maps the replica's advertised addr (tests route every
+    cross-"host" connection through a ``LinkProxy``)."""
+    path = agent.ship(dirname)
+    info = agent.spawn(path, server_kw=server_kw, name=name)
+    addr = (str(info["addr"][0]), int(info["addr"][1]))
+    if link is not None:
+        addr = tuple(link(addr))
+    kw = dict(remote_kw or {})
+    kw.setdefault("name", name)
+    return RemoteReplica(addr, proc=None, agent=agent,
+                         pid=int(info["pid"]), ship_artifacts=True,
+                         num_workers=int(server_kw.get("workers", 2)),
+                         **kw)
+
+
+def spawn_host_fleet(dirname: str, hosts, replicas: int = 2,
+                     remote_kw: Optional[Dict[str, Any]] = None,
+                     link=None, **server_kw):
+    """Adopt ``replicas`` agent-managed replicas round-robin across
+    ``hosts`` (each a ``host:port`` fleet agent). Returns ``(agents,
+    {name: RemoteReplica})`` — the router keeps the agents for
+    ``replace()`` respawns after a host dies."""
+    agents = [a if isinstance(a, AgentClient) else AgentClient(a)
+              for a in hosts]
+    out: Dict[str, RemoteReplica] = {}
+    try:
+        for i in range(int(replicas)):
+            out[f"r{i}"] = adopt_replica(
+                agents[i % len(agents)], dirname, f"r{i}",
+                remote_kw=remote_kw, link=link, **server_kw)
+    except BaseException:
+        for rep in out.values():
+            try:
+                rep.kill()
+            except Exception:
+                pass
+        for a in agents:
+            a.close()
+        raise
+    return agents, out
 
 
 # -- spawning -----------------------------------------------------------------
@@ -889,7 +1359,9 @@ def spawn_fleet(dirname: str, replicas: int = 2,
 
 
 __all__ = [
-    "RemotePending", "RemoteReplica", "ReplicaProcess", "build_remote_error",
-    "error_payload", "pack_tree", "spawn_fleet", "spawn_replica",
+    "AgentClient", "ArtifactStore", "RemotePending", "RemoteReplica",
+    "ReplicaProcess", "adopt_replica", "build_remote_error",
+    "encode_server_kw", "error_payload", "pack_tree", "parse_hostport",
+    "ship_artifact", "spawn_fleet", "spawn_host_fleet", "spawn_replica",
     "unpack_tree",
 ]

@@ -56,9 +56,24 @@ def _reply_err(conn: socket.socket, exc: BaseException) -> None:
 class _ReplicaService:
     """The verb dispatcher around one local ``PredictorServer``."""
 
-    def __init__(self, server, journal):
+    def __init__(self, server, journal, artifact_root: Optional[str] = None):
+        from .remote import ArtifactStore
+
         self.server = server
         self.journal = journal
+        # the host-side artifact cache behind FETCH/ARTIFACT: a router
+        # on another machine streams save_inference_model dirs here
+        # before RELOADing them (agent-spawned replicas share the
+        # agent's cache, so one ship covers every replica on the host)
+        if artifact_root is None:
+            import tempfile
+            artifact_root = os.path.join(tempfile.gettempdir(),
+                                         f"pdtpu_artifacts_{os.getpid()}")
+        self.artifacts = ArtifactStore(artifact_root)
+        # SUBMIT feed byte accounting: wire (what crossed the link)
+        # vs logical (what a passthrough transfer would have cost)
+        self._wire_lock = threading.Lock()
+        self._wire_counters = {"wire_bytes": 0, "logical_bytes": 0}
         self._rid_lock = threading.Lock()
         self._next_rid = 0
         # span -> fire callback, armed by SUBMIT handlers, invoked by
@@ -110,8 +125,12 @@ class _ReplicaService:
             # hangs off it) — mint the span server-side so the
             # dispatch subscriber has something to match
             span = self.journal.new_span()
+        counters: Dict[str, int] = {}
         feed = unpack_tree(read_exact(conn, meta_len),
-                           read_exact(conn, payload_len))
+                           read_exact(conn, payload_len), counters=counters)
+        with self._wire_lock:
+            for k, v in counters.items():
+                self._wire_counters[k] = self._wire_counters.get(k, 0) + v
         rid = self._rid()
         wlock = threading.Lock()   # serializes every write on this conn
         state = {"ok_sent": False, "fire_early": False,
@@ -203,7 +222,10 @@ class _ReplicaService:
         _reply_json(conn, h)
 
     def handle_report(self, conn: socket.socket) -> None:
-        _reply_json(conn, self.server.report())
+        rep = self.server.report()
+        with self._wire_lock:
+            rep["feed_wire"] = dict(self._wire_counters)
+        _reply_json(conn, rep)
 
     def handle_metrics(self, conn: socket.socket) -> None:
         from ..telemetry import get_registry
@@ -214,6 +236,25 @@ class _ReplicaService:
         events = [e for e in self.journal.recent()
                   if int(e.get("seq", 0)) > since]
         _reply_json(conn, {"run": self.journal.run_id, "events": events})
+
+    def handle_fetch(self, conn: socket.socket, parts) -> None:
+        """Artifact negotiate/commit (see ``remote.ArtifactStore``)."""
+        from ..parallel.async_ps import read_exact
+
+        token = parts[1]
+        body = read_exact(conn, int(parts[2]))
+        _reply_json(conn, self.artifacts.handle_fetch(token, body))
+
+    def handle_artifact(self, conn: socket.socket, parts) -> None:
+        """One pipelined artifact chunk frame — no reply (the sender
+        streams; commit-time CRC validation reports bad files)."""
+        from ..parallel.async_ps import read_exact
+
+        token, fname = parts[1], parts[2]
+        off, nbytes = int(parts[3]), int(parts[4])
+        crc = int(parts[5], 16)
+        data = read_exact(conn, nbytes)
+        self.artifacts.handle_chunk(token, fname, off, crc, data)
 
     def handle_reload(self, conn: socket.socket, body: bytes) -> None:
         dirname = json.loads(body)["dirname"]
@@ -279,6 +320,10 @@ class _ReplicaService:
                     elif verb == "JOURNAL":
                         self.handle_journal(
                             conn, int(parts[1]) if len(parts) > 1 else 0)
+                    elif verb == "FETCH":
+                        self.handle_fetch(conn, parts)
+                    elif verb == "ARTIFACT":
+                        self.handle_artifact(conn, parts)
                     elif verb == "RELOAD":
                         self.handle_reload(conn,
                                            read_exact(conn, int(parts[1])))
@@ -340,10 +385,15 @@ def main(argv=None) -> int:
         traceback.print_exc()
         print(f"REPLICA_FAILED {cfg.get('dirname')!r}", file=sys.stderr)
         return 1
-    service = _ReplicaService(server, get_journal())
+    service = _ReplicaService(server, get_journal(),
+                              artifact_root=cfg.get("artifact_root"))
     ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    ls.bind((cfg.get("host", "127.0.0.1"), int(cfg.get("port", 0))))
+    # the bind knob: off-host reachability is opt-in (config "bind" or
+    # PDTPU_BIND_ADDR, e.g. "0.0.0.0"); the default stays loopback
+    bind = (cfg.get("bind") or os.environ.get("PDTPU_BIND_ADDR")
+            or cfg.get("host", "127.0.0.1"))
+    ls.bind((bind, int(cfg.get("port", 0))))
     ls.listen(128)
     # the readiness handshake: the parent blocks on this exact line
     print(f"PORT {ls.getsockname()[1]}", flush=True)

@@ -40,6 +40,7 @@ text, ``?format=json`` for JSON) with the fleet ``health()`` behind
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -153,7 +154,9 @@ class FleetRouter:
                  server_kw: Optional[Dict[str, Any]] = None,
                  probe_timeout: Optional[float] = None,
                  remote: bool = False,
-                 remote_kw: Optional[Dict[str, Any]] = None):
+                 remote_kw: Optional[Dict[str, Any]] = None,
+                 agents: Optional[List[Any]] = None,
+                 link=None):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
         if not isinstance(replicas, dict):
@@ -175,6 +178,12 @@ class FleetRouter:
         self.probe_timeout = probe_timeout
         self._remote = bool(remote)
         self._remote_kw: Dict[str, Any] = dict(remote_kw or {})
+        # cross-host adoption (spawn(hosts=...)): the per-host agents
+        # replace() respawns through, and the link factory that maps a
+        # replica's advertised addr (drills route every cross-"host"
+        # connection through a LinkProxy; production may NAT)
+        self._agents: List[Any] = list(agents or [])
+        self._link = link
         self._journal_ship_seq: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._reload_lock = threading.Lock()
@@ -212,6 +221,8 @@ class FleetRouter:
               remote: bool = False,
               remote_kw: Optional[Dict[str, Any]] = None,
               probe_timeout: Optional[float] = None,
+              hosts: Optional[List[Any]] = None,
+              link=None,
               **server_kw) -> "FleetRouter":
         """Build a fleet from one artifact.
 
@@ -234,7 +245,28 @@ class FleetRouter:
         ``server_kw`` (workers, queue_size, batch_policy, golden_feed,
         ...) applies to every replica either way — for a remote fleet
         it is shipped to the child processes (and re-used verbatim by
-        :meth:`replace` respawns)."""
+        :meth:`replace` respawns).
+
+        ``hosts=["host:port", ...]`` (implies remote): adopt replicas
+        from per-host fleet agents (``python -m paddle_tpu.fleet.
+        agent``) round-robin — the artifact is shipped to each host
+        over FETCH/ARTIFACT (no shared filesystem assumed), the agents
+        are kept for :meth:`replace` respawns (a replica whose whole
+        host died respawns via a SURVIVING host's agent, artifact
+        re-shipped as needed), and ``link`` optionally wraps every
+        replica addr (drills: a ``LinkProxy`` per link)."""
+        if hosts:
+            from . import remote as _remote
+
+            agents, servers = _remote.spawn_host_fleet(
+                dirname, hosts, replicas=replicas, remote_kw=remote_kw,
+                link=link, **server_kw)
+            return cls(servers, default_deadline=default_deadline,
+                       dirname=dirname, server_kw=server_kw,
+                       probe_timeout=(2.0 if probe_timeout is None
+                                      else probe_timeout),
+                       remote=True, remote_kw=remote_kw, agents=agents,
+                       link=link)
         if remote:
             from . import remote as _remote
 
@@ -285,7 +317,22 @@ class FleetRouter:
                     "the replacement comes up with PredictorServer "
                     "defaults; pass server_kw to FleetRouter to respawn "
                     "with the fleet's real config", name)
-            if self._remote:
+            if self._remote and self._agents:
+                # cross-host: respawn through a LIVE host agent —
+                # preferring the dead replica's own host, falling back
+                # to any surviving one — with the artifact shipped over
+                # FETCH (a content-addressed no-op when that host's
+                # cache already holds it)
+                from . import remote as _remote
+                with self._lock:
+                    cur = self._replicas.get(name)
+                prefer = getattr(getattr(cur, "server", None), "agent", None)
+                agent = self._pick_agent(prefer=prefer)
+                server = _remote.adopt_replica(
+                    agent, self.dirname, name,
+                    remote_kw=dict(self._remote_kw), link=self._link,
+                    **self._server_kw)
+            elif self._remote:
                 # a remote fleet respawns a PROCESS from the artifact —
                 # the recovery half of the process-kill drill
                 from . import remote as _remote
@@ -317,6 +364,26 @@ class FleetRouter:
         self.journal.emit("fleet.replace", inst=self.telemetry_inst,
                           replica=name)
         return server
+
+    def _pick_agent(self, prefer=None):
+        """First host agent that answers a PS probe (``prefer`` tried
+        first — respawning on the replica's own host reuses its warm
+        artifact cache). A whole-host kill takes that host's agent
+        with it; the surviving agents are exactly the hosts replace()
+        may respawn on."""
+        agents = list(self._agents)
+        if prefer is not None and prefer in agents:
+            agents.remove(prefer)
+            agents.insert(0, prefer)
+        errors = []
+        for agent in agents:
+            try:
+                agent.ps()
+                return agent
+            except Exception as e:
+                errors.append(f"{agent!r}: {type(e).__name__}: {e}")
+        raise ConnectionError(
+            f"no live fleet agent to respawn on: {'; '.join(errors)}")
 
     def _repin_all(self) -> None:
         with self._lock:
@@ -727,6 +794,11 @@ class FleetRouter:
                 rep.server.close(drain=drain, timeout=timeout)
             except Exception:  # pragma: no cover - teardown best effort
                 pass
+        for agent in self._agents:
+            try:
+                agent.close()
+            except Exception:
+                pass
         if self._telemetry_server is not None:
             self._telemetry_server.close()
             self._telemetry_server = None
@@ -808,16 +880,19 @@ class FleetRouter:
                 continue
         return merge_exports(named, label="replica")
 
-    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
+    def serve_metrics(self, port: int = 0, host: Optional[str] = None):
         """The fleet-aggregated scrape endpoint: ``GET /metrics``
         (Prometheus text of :meth:`metrics_families`; ``?format=json``
         for the JSON snapshot) + ``GET /healthz`` (the fleet
         :meth:`health`, 503 once no replica is ready). One scrape
         covers every replica — the series differ only by ``replica``
-        label."""
+        label. ``host`` defaults to ``PDTPU_BIND_ADDR`` (else
+        loopback) so an off-host Prometheus can scrape it."""
         from ..telemetry import serve_metrics as _serve
         from ..telemetry.registry import FamiliesView
 
+        if host is None:
+            host = os.environ.get("PDTPU_BIND_ADDR") or "127.0.0.1"
         if self._telemetry_server is None:
             self._telemetry_server = _serve(
                 registry=FamiliesView(self.metrics_families),

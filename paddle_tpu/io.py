@@ -731,6 +731,29 @@ def save_inference_model(dirname: str, program, params: Dict[str, jax.Array],
         shutil.rmtree(old, ignore_errors=True)
 
 
+def artifact_fingerprint(dirname: str) -> Tuple[Dict[str, Any], str]:
+    """(manifest, token) of a committed ``save_inference_model`` dir.
+
+    The token is content-addressed — ``<basename>-<crc32:08x>`` over the
+    sorted ``name:crc:size`` lines of the manifest's file table — so two
+    hosts can agree an artifact is already present without moving bytes:
+    the fleet's FETCH/ARTIFACT distribution keys its receive cache on it,
+    making re-ships of an unchanged artifact a no-op negotiation."""
+    import zlib
+
+    from . import resilience
+
+    path = os.path.abspath(dirname)
+    man = resilience.read_manifest(path)
+    enforce(man is not None,
+            f"artifact_fingerprint: {dirname!r} has no manifest — only "
+            "committed save_inference_model dirs can be distributed")
+    lines = "\n".join(f"{name}:{spec['crc32']}:{spec['size']}"
+                      for name, spec in sorted(man["files"].items()))
+    crc = zlib.crc32(lines.encode()) & 0xFFFFFFFF
+    return man, f"{os.path.basename(path)}-{crc:08x}"
+
+
 def save_train_artifact(dirname: str, trainer, example_feed: Dict[str, Any]) -> None:
     """Export ONE optimizer step of a started Trainer as a StableHLO
     artifact the Python-free native trainer (native/trainer.cc) can

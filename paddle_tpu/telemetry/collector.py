@@ -19,6 +19,10 @@ body; replies ``OK <n>`` / ``ERR <reason>``)::
     EVENTS <origin> <len>    + {"run": ..., "events": [...]}
     SNAPSHOT <origin> <len>  + {"t": ..., "families": families_snapshot}
     STATS                    (reply: ``OK {json}`` — ingest/store ctrs)
+    SEGMENTS <len>           + {"list": true} | {"fetch": name,
+                             "offset": k[, "limit": n]} (framed reply
+                             body: listing json / raw segment bytes —
+                             the cross-host standby's replication pull)
 
 ``EVENTS`` ingestion is idempotent: events are deduplicated by a
 per-``(origin, run)`` high-water ``seq``, so a shipper whose reply was
@@ -60,7 +64,14 @@ collector started with ``standby=True`` over the same (shared-
 filesystem) ``store_dir`` ingests nothing until the first failed-over
 push arrives — the shipper's comma-separated ``PDTPU_TELEMETRY_ADDR``
 failover list routes pushes to it once the primary dies — at which
-point it PROMOTES by replaying the log. Alert rules hot-reload via
+point it PROMOTES by replaying the log. A standby on ANOTHER machine
+(no shared filesystem) passes ``replicate_from="host:port"`` instead:
+it continuously pulls the primary's sealed segments and open-segment
+tail over the ``SEGMENTS`` verb into its OWN ``store_dir``
+(CRC-re-verified against each segment's sidecar on receipt), and the
+promotion fence moves from heartbeat-file stamps to the replication
+stream — a standby refuses to promote while its replication source
+still answers a direct probe, so a returning primary keeps the pen. Alert rules hot-reload via
 SIGHUP (the daemon re-lints ``--rules``) or ``POST /rules``; findings
 from :func:`~paddle_tpu.telemetry.alerts.lint_rules` REJECT the
 reload, success journals ``alert.rules_reloaded``.
@@ -95,6 +106,17 @@ from .registry import (MetricFamily, _series_key, counter_family,
 def _log():
     import logging
     return logging.getLogger("paddle_tpu.telemetry.collector")
+
+
+def _reply_json(conn: socket.socket, payload) -> None:
+    """Framed reply body: ``OK <len>\\n`` + payload. A dict/list is
+    JSON-encoded; raw bytes pass through (the SEGMENTS fetch form ships
+    segment-file bytes verbatim — their integrity rides the CRC
+    sidecar, not the frame)."""
+    if not isinstance(payload, (bytes, bytearray)):
+        payload = json.dumps(payload, sort_keys=True,
+                             separators=(",", ":")).encode()
+    conn.sendall(b"OK %d\n" % len(payload) + bytes(payload))
 
 
 # -- per-origin time series ---------------------------------------------------
@@ -530,7 +552,9 @@ class TelemetryCollector:
                  segment_max_bytes: int = 4 << 20,
                  segment_max_s: float = 600.0,
                  standby: bool = False,
-                 takeover_s: float = 5.0):
+                 takeover_s: float = 5.0,
+                 replicate_from: Optional[Any] = None,
+                 replicate_interval: float = 0.5):
         self.store = SeriesStore(max_points=max_points,
                                  origin_expiry_s=origin_expiry_s)
         # the collector's OWN journal (never the process default): it
@@ -605,6 +629,33 @@ class TelemetryCollector:
                              "from (a standby without a shared segment "
                              "log has no history to adopt)")
 
+        # -- cross-host replication (telemetry catch-up) -----------------
+        # A standby on ANOTHER machine cannot share the primary's
+        # store_dir; replicate_from="host:port" (the primary's push
+        # wire) makes it pull sealed segments + the open-segment tail
+        # over the SEGMENTS verb into its OWN store_dir, continuously.
+        # Promotion then replays the local replica — and the fence
+        # moves from heartbeat-file stamps (meaningless across hosts)
+        # to the replication stream: a standby refuses to promote
+        # while its replication source still answers a direct probe.
+        self._repl_addr: Optional[Tuple[str, int]] = None
+        self._repl_cli: Optional[Any] = None
+        self._repl_interval = float(replicate_interval)
+        self._repl_last_contact: Optional[float] = None
+        self._repl_lock = threading.Lock()
+        if replicate_from:
+            if not self._standby or self._seg is None:
+                raise ValueError(
+                    "replicate_from= needs standby=True and a (local) "
+                    "store_dir — replication is the cross-host standby's "
+                    "copy of the primary's segment log")
+            from .shipper import parse_addr
+            self._repl_addr = parse_addr(replicate_from)
+            self._repl_thread = threading.Thread(
+                target=self._replicate_loop, daemon=True,
+                name="pdtpu-collector-repl")
+            self._repl_thread.start()
+
         self._ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._ls.bind((host, int(port)))
@@ -635,6 +686,12 @@ class TelemetryCollector:
             self._http.close()
             self._http = None
         self._eval_thread.join(timeout=5.0)
+        if self._repl_cli is not None:
+            try:
+                self._repl_cli.close()
+            except Exception:
+                pass
+            self._repl_cli = None
         if self._seg is not None:
             # a final state record makes a CLEAN shutdown bit-exact on
             # restart even when the last eval tick predates the last
@@ -742,6 +799,81 @@ class TelemetryCollector:
                         self._seg.counters["corrupt_records"])
         return n
 
+    # -- cross-host replication (standby pull over SEGMENTS) -----------------
+
+    def _repl_client(self):
+        if self._repl_cli is None:
+            from .shipper import ReplicationClient
+            self._repl_cli = ReplicationClient(self._repl_addr)
+        return self._repl_cli
+
+    def _replicate_loop(self) -> None:
+        while not self._stop.wait(self._repl_interval):
+            if not self._standby:
+                return  # promoted: this collector writes its own log now
+            try:
+                self._replicate_once()
+            except Exception as e:
+                # primary unreachable (dead, partitioned): nothing to
+                # pull — retry next tick; promotion decides liveness
+                _log().debug("segment replication pull failed: %s: %s",
+                             type(e).__name__, e)
+
+    def _replicate_once(self) -> int:
+        """One replication pull: list the primary's segments, adopt
+        every sealed segment we lack (sidecar-CRC-verified; a segment
+        corrupted in flight is rejected and re-requested next cycle),
+        then extend the open-segment mirror by exact byte offset.
+        Returns the number of sealed segments adopted."""
+        with self._repl_lock:
+            cli = self._repl_client()
+            listing = cli.listing()
+            n = 0
+            have = self._seg.sealed_names()
+            for ent in listing.get("segments") or []:
+                name = str(ent.get("name"))
+                if name in have:
+                    continue
+                data = cli.fetch(name)
+                if self._seg.ingest_sealed(name, data,
+                                           ent.get("meta") or {}):
+                    n += 1
+            op = listing.get("open")
+            if op and op.get("name"):
+                name, psize = str(op["name"]), int(op.get("size", 0))
+                local = self._seg.mirror_size(name)
+                while local < psize:
+                    chunk = cli.fetch(name, offset=local,
+                                      limit=psize - local)
+                    if not chunk:
+                        break
+                    new = self._seg.ingest_open_tail(name, local, chunk)
+                    if new <= local:
+                        break
+                    local = new
+            self._repl_last_contact = time.monotonic()
+            return n
+
+    def _primary_reachable(self) -> bool:
+        """One direct probe of the replication source — the cross-host
+        half of the split-brain fence. True means a live (or returned)
+        primary still owns the pen; a standby must not promote over
+        it."""
+        if self._repl_addr is None:
+            return False
+        from .shipper import ReplicationClient
+        try:
+            cli = ReplicationClient(self._repl_addr,
+                                    timeout=min(1.0, max(self.takeover_s,
+                                                         0.1)))
+            try:
+                cli.ping()
+                return True
+            finally:
+                cli.close()
+        except Exception:
+            return False
+
     def promote(self, force: bool = False) -> bool:
         """Standby → active: replay the shared segment log (rings,
         journal, dedupe marks, alert state — firing instances come back
@@ -772,6 +904,26 @@ class TelemetryCollector:
                             f"writer's heartbeat is {age:.1f}s old "
                             f"(< takeover_s={self.takeover_s:g}) — "
                             "retry after it goes silent")
+                    # the cross-host fence: with replicate_from the
+                    # heartbeat file lives in the PRIMARY's store_dir
+                    # on another machine — liveness is the replication
+                    # stream itself. A returning primary that answers
+                    # a direct probe keeps the pen; exactly one writer.
+                    if self._primary_reachable():
+                        raise RuntimeError(
+                            "standby not promoting: the replication "
+                            f"source at {self._repl_addr} still answers "
+                            "its wire — a live primary keeps the pen "
+                            "(force=True overrides)")
+                if self._repl_addr is not None:
+                    # final catch-up pull: anything the primary sealed
+                    # or appended after our last tick and before its
+                    # death. Best-effort — a dead primary fails fast
+                    # and we promote from what already replicated.
+                    try:
+                        self._replicate_once()
+                    except Exception:
+                        pass
                 self._recover()
                 self._seg.open()
             self._standby = False
@@ -841,6 +993,8 @@ class TelemetryCollector:
                     except OSError:
                         pass
                     return
+                if reply is None:
+                    continue  # the branch replied itself (SEGMENTS)
                 try:
                     conn.sendall(reply.encode() + b"\n")
                 except OSError:
@@ -851,10 +1005,30 @@ class TelemetryCollector:
             except OSError:
                 pass
 
-    def _dispatch(self, parts: List[str], conn, read_exact) -> str:
+    def _dispatch(self, parts: List[str], conn, read_exact
+                  ) -> Optional[str]:
         verb = parts[0]
         if verb == "PING":
             return "OK 0"
+        if verb == "SEGMENTS":
+            # segment replication (standby pull): {"list": true} → the
+            # sealed-segment + open-tail listing; {"fetch": name,
+            # "offset": k[, "limit": n]} → raw segment bytes. The
+            # branch frames its own reply body (json OR raw bytes) and
+            # returns None so _serve_conn sends nothing further.
+            if self._seg is None:
+                raise ValueError("SEGMENTS needs a collector with a "
+                                 "store_dir (no segment log here)")
+            req = json.loads(read_exact(conn, int(parts[1])))
+            if req.get("fetch"):
+                limit = req.get("limit")
+                data = self._seg.read_segment(
+                    str(req["fetch"]), offset=int(req.get("offset", 0)),
+                    limit=None if limit is None else int(limit))
+                _reply_json(conn, data)
+            else:
+                _reply_json(conn, self._seg.replication_listing())
+            return None
         if verb == "STATS":
             # ingest/store counters as one JSON object riding the reply
             # line — the bench rows' store-overhead delta source (and a
@@ -1123,7 +1297,17 @@ class TelemetryCollector:
                 "segments_sealed": sc["segments_sealed"],
                 "segments_deleted": sc["segments_deleted"],
                 "segments": len(self._seg.segment_paths()),
+                "repl_segments": sc["repl_segments"],
+                "repl_bytes": sc["repl_bytes"],
+                "repl_corrupt": sc["repl_corrupt"],
             }
+        out["replicating"] = self._repl_addr is not None
+        if self._repl_addr is not None:
+            with self._repl_lock:
+                last = self._repl_last_contact
+            out["repl_contact_age_s"] = (
+                None if last is None
+                else round(time.monotonic() - last, 3))
         return out
 
     def query(self, metric: str, labels: Optional[Dict[str, str]] = None,
@@ -1397,6 +1581,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="telemetry collector daemon: push ingest wire + "
                     "/metrics /alerts /timeline")
     ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--bind", default="",
+                    help="listener bind address for the push wire AND "
+                         "the HTTP endpoint (also PDTPU_BIND_ADDR; "
+                         "overrides --host; default loopback)")
     ap.add_argument("--port", type=int, default=0,
                     help="push wire port (0 picks free)")
     ap.add_argument("--http-port", type=int, default=0,
@@ -1430,11 +1618,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="standby promotion fence: refuse to promote "
                          "while the active writer's heartbeat is "
                          "fresher than this (0 disables)")
+    ap.add_argument("--replicate-from", default="",
+                    help="primary collector push-wire addr (host:port) "
+                         "to replicate the segment log from — the "
+                         "cross-host standby form (needs --standby and "
+                         "a LOCAL --store-dir)")
+    ap.add_argument("--replicate-interval", type=float, default=0.5,
+                    help="seconds between replication pulls")
     args = ap.parse_args(argv)
 
+    import os as _os
+    host = args.bind or _os.environ.get("PDTPU_BIND_ADDR") or args.host
     rules = _alerts.load_rules(args.rules) if args.rules else None
     col = TelemetryCollector(
-        host=args.host, port=args.port, rules=rules,
+        host=host, port=args.port, rules=rules,
         eval_interval=args.eval_interval,
         origin_expiry_s=args.origin_expiry,
         dump_on_fire=True if args.dump_on_fire else None,
@@ -1443,7 +1640,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         retention_s=args.retention_s,
         retention_bytes=args.retention_bytes,
         segment_max_bytes=args.segment_max_bytes,
-        standby=args.standby, takeover_s=args.takeover_s)
+        standby=args.standby, takeover_s=args.takeover_s,
+        replicate_from=args.replicate_from or None,
+        replicate_interval=args.replicate_interval)
     http = col.serve_http(port=args.http_port)
     stop = threading.Event()
     hup = threading.Event()

@@ -212,11 +212,18 @@ class TelemetryServer:
 
 def serve_metrics(registry: Optional[MetricsRegistry] = None,
                   health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
-                  port: int = 0, host: str = "127.0.0.1",
+                  port: int = 0, host: Optional[str] = None,
                   extra_routes: Optional[Dict[str, RouteFn]] = None,
                   post_routes: Optional[Dict[str, PostRouteFn]] = None
                   ) -> TelemetryServer:
-    """Start a :class:`TelemetryServer`; port 0 picks a free port."""
+    """Start a :class:`TelemetryServer`; port 0 picks a free port.
+    ``host=None`` binds ``PDTPU_BIND_ADDR`` when set (the cross-host
+    knob — a scrape endpoint other machines must reach), else
+    loopback."""
+    import os
+
+    if host is None:
+        host = os.environ.get("PDTPU_BIND_ADDR") or "127.0.0.1"
     return TelemetryServer(registry=registry, health_fn=health_fn,
                            port=port, host=host, extra_routes=extra_routes,
                            post_routes=post_routes)

@@ -43,9 +43,15 @@ and lands exactly once.
 
 Knobs (env defaults in parentheses): ``origin`` — the label this
 process's series carry at the collector (``PDTPU_TELEMETRY_ORIGIN``,
-else ``pid-<pid>``); ``flush_interval``
-(``PDTPU_TELEMETRY_FLUSH_S``, 0.25s); ``buffer_events``
-(``PDTPU_TELEMETRY_BUFFER``, 4096).
+else ``<hostname>-<pid>`` — pids collide across machines the moment a
+fleet spans hosts, so the default origin carries the sanitized
+hostname); ``flush_interval`` (``PDTPU_TELEMETRY_FLUSH_S``, 0.25s);
+``buffer_events`` (``PDTPU_TELEMETRY_BUFFER``, 4096).
+
+:class:`ReplicationClient` is the OTHER puller on this wire: a
+cross-host standby collector's client for the primary's ``SEGMENTS``
+verb (segment-log listing + raw segment/tail fetches — see
+``telemetry/collector.py``'s replication story).
 """
 
 from __future__ import annotations
@@ -66,6 +72,21 @@ AddrLike = Union[str, Tuple[str, int]]
 def _log():
     import logging
     return logging.getLogger("paddle_tpu.telemetry.shipper")
+
+
+def default_origin() -> str:
+    """``<hostname>-<pid>``: the origin a shipper uses when neither
+    ``origin=`` nor ``PDTPU_TELEMETRY_ORIGIN`` names one. Pids are
+    only unique per machine — two replicas on different hosts of a
+    cross-host fleet can share a pid, and their series must not merge
+    under one origin label. The hostname is sanitized to the label
+    charset (anything outside ``[A-Za-z0-9._-]`` becomes ``-``) so
+    the merged ``/metrics`` naming contract holds."""
+    import socket as _socket
+
+    host = "".join(c if (c.isalnum() or c in "._-") else "-"
+                   for c in _socket.gethostname()) or "host"
+    return f"{host}-{os.getpid()}"
 
 
 def parse_addr(addr: AddrLike) -> Tuple[str, int]:
@@ -150,6 +171,55 @@ class ShipperClient:
         self._cli.close()
 
 
+class ReplicationClient:
+    """A cross-host standby collector's puller for the primary's
+    ``SEGMENTS`` verb: one framed request (``SEGMENTS <len>`` + json)
+    per call, one framed reply body back — the segment-log listing
+    (json) or raw segment bytes, depending on the request form. The
+    bytes are NOT trusted off the wire: the standby re-verifies every
+    sealed segment against the sidecar CRC the listing carried before
+    anything touches its store."""
+
+    def __init__(self, addr: Tuple[str, int], timeout: float = 10.0):
+        from ..parallel.async_ps import FramedClient
+
+        class _Client(FramedClient):
+            peer_name = "primary collector"
+
+        self._cli = _Client(addr, timeout=timeout, retries=2,
+                            retry_backoff=0.05, retry_backoff_max=0.2,
+                            connect=False)
+
+    def _segments(self, req: Dict[str, Any]) -> bytes:
+        body = json.dumps(req, separators=(",", ":")).encode()
+        resp, payload = self._cli._request(
+            f"SEGMENTS {len(body)}", body,
+            body_len=lambda r: int(r.split()[1]))
+        return payload
+
+    def listing(self) -> Dict[str, Any]:
+        """The primary's sealed segments (name + CRC sidecar doc each)
+        and its active segment's name/size."""
+        return json.loads(self._segments({"list": True}))
+
+    def fetch(self, name: str, offset: int = 0,
+              limit: Optional[int] = None) -> bytes:
+        """Raw bytes of one segment file from ``offset`` (the whole
+        file for a sealed segment, the unseen tail for the open
+        one)."""
+        req: Dict[str, Any] = {"fetch": name, "offset": int(offset)}
+        if limit is not None:
+            req["limit"] = int(limit)
+        return self._segments(req)
+
+    def ping(self) -> None:
+        """The promotion fence's liveness probe of the primary."""
+        self._cli._request("PING")
+
+    def close(self) -> None:
+        self._cli.close()
+
+
 class Shipper:
     """One process's push pipeline to a collector (see module
     docstring). ``close()`` flushes what it can and detaches."""
@@ -168,7 +238,7 @@ class Shipper:
         self.addrs = parse_addrs(addr)
         self._addr_i = 0
         origin = origin or os.environ.get("PDTPU_TELEMETRY_ORIGIN") \
-            or f"pid-{os.getpid()}"
+            or default_origin()
         if any(c.isspace() for c in origin):
             raise ValueError(f"origin {origin!r} must not contain "
                              "whitespace (it rides a framed header)")
@@ -506,5 +576,6 @@ def maybe_auto_ship() -> Optional[Shipper]:
         return None
 
 
-__all__ = ["Shipper", "ShipperClient", "active_shipper", "maybe_auto_ship",
+__all__ = ["ReplicationClient", "Shipper", "ShipperClient",
+           "active_shipper", "default_origin", "maybe_auto_ship",
            "parse_addr", "parse_addrs", "ship_to", "stop_shipping"]

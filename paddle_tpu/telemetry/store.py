@@ -141,10 +141,15 @@ class SegmentStore:
         self._active_first_t: Optional[float] = None
         self._active_last_t: Optional[float] = None
         self._active_records = 0
-        # monotonic counters (collector families + bench deltas)
+        # monotonic counters (collector families + bench deltas); the
+        # repl_* keys count the STANDBY-side replication ingest (a
+        # segment corrupted in flight is rejected and re-requested
+        # here — the primary's corrupt_records stays untouched)
         self.counters = {"appends": 0, "bytes": 0, "append_seconds": 0.0,
                          "append_failures": 0, "corrupt_records": 0,
-                         "segments_sealed": 0, "segments_deleted": 0}
+                         "segments_sealed": 0, "segments_deleted": 0,
+                         "repl_segments": 0, "repl_bytes": 0,
+                         "repl_corrupt": 0}
 
     # -- layout ---------------------------------------------------------------
 
@@ -227,6 +232,15 @@ class SegmentStore:
         corruption is preserved as evidence and keeps flagging."""
         src = os.path.join(self.root, name)
         dst = os.path.join(self.root, _segment_name(idx, active=False))
+        if os.path.exists(dst):
+            # a complete replicated sealed copy already landed (the
+            # standby adopted it while this partial mirror lingered):
+            # the partial must never clobber it
+            try:
+                os.remove(src)
+            except OSError:
+                pass
+            return
         try:
             try:
                 with open(src, "r+b") as f:
@@ -355,6 +369,146 @@ class SegmentStore:
             except OSError:
                 pass
             self._f = None
+
+    # -- cross-host replication (the SEGMENTS wire verb's two halves) ---------
+
+    def replication_listing(self) -> Dict[str, Any]:
+        """The PRIMARY side of segment replication: every sealed
+        segment with its full CRC sidecar doc, plus the active
+        segment's name and current flushed size. A standby diffs this
+        against its own store and pulls what it lacks
+        (:meth:`ingest_sealed` / :meth:`ingest_open_tail`). A segment
+        mid-seal (sidecar not committed yet) is omitted — it shows up
+        complete on the next cycle."""
+        with self._lock:
+            segs = self._scan()
+            active = (os.path.basename(self._active_path)
+                      if self._f is not None else None)
+        out: Dict[str, Any] = {"segments": [], "open": None}
+        for _, name in segs:
+            path = os.path.join(self.root, name)
+            if name.endswith(SEGMENT_SEALED):
+                try:
+                    with open(path + resilience.SEGMENT_META_SUFFIX) as f:
+                        meta = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                out["segments"].append({"name": name, "meta": meta})
+            elif name == active:
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    size = 0
+                out["open"] = {"name": name, "size": size}
+        return out
+
+    def read_segment(self, name: str, offset: int = 0,
+                     limit: Optional[int] = None) -> bytes:
+        """Raw bytes of one retained segment (the SEGMENTS fetch form).
+        Appends are flush-per-record, so any prefix of the ACTIVE
+        segment a reader sees is a valid record stream plus at most
+        one torn tail — which is exactly what the standby's mirror
+        tolerates."""
+        if _segment_index(name) is None:
+            raise ValueError(f"not a segment name: {name!r}")
+        with open(os.path.join(self.root, name), "rb") as f:
+            f.seek(int(offset))
+            return f.read() if limit is None else f.read(int(limit))
+
+    def ingest_sealed(self, name: str, data: bytes,
+                      meta: Dict[str, Any]) -> bool:
+        """The STANDBY side: adopt one replicated sealed segment.
+        The bytes are verified against the primary's sidecar (size +
+        whole-file CRC32) BEFORE anything touches disk; a mismatch —
+        corruption in flight — is counted (``repl_corrupt``) and
+        returns False so the caller re-requests, never poisoning the
+        local store. Data file and sidecar both commit tmp+rename, so
+        a standby killed mid-adopt leaves either nothing or a fully
+        valid sealed segment."""
+        import zlib
+
+        idx = _segment_index(name)
+        if idx is None or not name.endswith(SEGMENT_SEALED):
+            return False
+        if (len(data) != meta.get("size") or
+                zlib.crc32(data) & 0xFFFFFFFF != meta.get("crc32")):
+            self.counters["repl_corrupt"] += 1
+            _log().warning("replicated segment %s failed its sidecar "
+                           "CRC/size check — re-requesting", name)
+            return False
+        path = os.path.join(self.root, name)
+        # the partial .open mirror of the same index (the primary
+        # rotated since we started tailing it) is superseded — dropped
+        # BEFORE the sealed commit so a kill in between can only cost
+        # a refetch, never leave a leftover .open for open()'s
+        # leftover-seal to clobber the complete file with
+        try:
+            os.remove(os.path.join(self.root,
+                                   _segment_name(idx, active=True)))
+        except OSError:
+            pass
+        tmp = path + ".part"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            mtmp = path + resilience.SEGMENT_META_SUFFIX + ".part"
+            with open(mtmp, "w") as f:
+                json.dump(meta, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(mtmp, path + resilience.SEGMENT_META_SUFFIX)
+        except OSError as e:
+            _log().warning("could not adopt replicated segment %s: %s",
+                           name, e)
+            return False
+        self.counters["repl_segments"] += 1
+        self.counters["repl_bytes"] += len(data)
+        return True
+
+    def ingest_open_tail(self, name: str, offset: int, data: bytes) -> int:
+        """Mirror the primary's ACTIVE segment: append ``data`` iff
+        ``offset`` equals the local copy's size (the mirror is always
+        an exact byte prefix of the primary's file, so the only
+        possible damage is one torn final record — which
+        :meth:`open`'s leftover-seal trims at promotion). Returns the
+        local size after the call; a caller whose offset was stale
+        re-fetches from the returned size."""
+        if _segment_index(name) is None or \
+                not name.endswith(SEGMENT_ACTIVE):
+            raise ValueError(f"not an active segment name: {name!r}")
+        path = os.path.join(self.root, name)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        if int(offset) != size:
+            return size
+        try:
+            with open(path, "ab") as f:
+                f.write(data)
+                f.flush()
+        except OSError as e:
+            _log().warning("could not mirror open segment %s: %s", name, e)
+            return size
+        self.counters["repl_bytes"] += len(data)
+        return size + len(data)
+
+    def mirror_size(self, name: str) -> int:
+        """Current local byte size of one segment file (0 when absent)
+        — the standby's next open-tail fetch offset."""
+        try:
+            return os.path.getsize(os.path.join(self.root, name))
+        except OSError:
+            return 0
+
+    def sealed_names(self) -> set:
+        """Locally present sealed segment names (the standby's diff
+        base against :meth:`replication_listing`)."""
+        with self._lock:
+            return {n for _, n in self._scan() if n.endswith(SEGMENT_SEALED)}
 
     # -- retention ------------------------------------------------------------
 

@@ -376,9 +376,10 @@ class TestWireFixtures:
 EXPECTED_VERBS = {
     "ps": {"DELETE", "EXPORT", "IMPORT", "INIT", "PULL", "PUSH", "PUSHQ",
            "PUSHQB", "PUSHROWS", "QUIT", "SAVE", "STATUS"},
-    "fleet": {"HEALTH", "JOURNAL", "KILL", "METRICS", "QUIT", "RELOAD",
-              "REPORT", "SHUTDOWN", "SUBMIT"},
-    "telemetry": {"EVENTS", "PING", "QUIT", "SNAPSHOT", "STATS"},
+    "fleet": {"ARTIFACT", "FETCH", "HEALTH", "JOURNAL", "KILL", "METRICS",
+              "PS", "QUIT", "RELOAD", "REPORT", "SHUTDOWN", "SPAWN", "STOP",
+              "SUBMIT"},
+    "telemetry": {"EVENTS", "PING", "QUIT", "SEGMENTS", "SNAPSHOT", "STATS"},
 }
 
 
@@ -400,7 +401,7 @@ class TestLiveTree:
         assert amo == {("ps", "PUSH"), ("ps", "PUSHQ"), ("ps", "PUSHQB"),
                        ("ps", "PUSHROWS"), ("fleet", "SUBMIT"),
                        ("fleet", "RELOAD"), ("fleet", "KILL"),
-                       ("fleet", "SHUTDOWN")}
+                       ("fleet", "SHUTDOWN"), ("fleet", "SPAWN")}
 
     def test_wire_surfaces_are_clean(self):
         for subj, rep in wire_contracts.check_wire():

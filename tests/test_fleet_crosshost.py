@@ -1,0 +1,496 @@
+"""Cross-host fleet acceptance suite: artifact distribution over
+FETCH/ARTIFACT, per-host agent adoption, and the reload/death
+contracts that survive links no filesystem crosses.
+
+The acceptance contracts:
+
+  * the ``ArtifactStore`` receiver stages chunks resumably, drops
+    anything whose CRC or offset disagrees (the commit reply names it
+    for re-shipping), and commits atomically — the cache holds a
+    fully-validated artifact dir or nothing, never a half-write;
+  * ``ship_artifact`` → a real agent process is byte-identical,
+    resumes a torn transfer from the staged sizes, and a re-ship of a
+    committed token is a content-addressed no-op;
+  * an adopted (agent-managed) replica serves with ``feed_wire``
+    narrowing the SUBMIT payload (wire vs logical bytes in the serving
+    report), classifies a half-open partitioned link ``ReplicaDied``
+    exactly once while the agent's PS oracle proves the process alive,
+    and flips ``_provably_dead`` once the agent reports the pid reaped;
+  * a partition mid-artifact-fetch during a rolling cross-host reload
+    surfaces typed ``ReloadFailed``, rolls the canary back, and leaves
+    no half-written dir in the host cache (staging only);
+  * ``tools/fleet_drill.py host_kill`` passes: a two-"host" fleet +
+    collector pair survives SIGKILL of every process on one host
+    (slow tier — it spawns ~7 processes).
+"""
+
+import json
+import os
+import socket
+import sys
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+import jax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import paddle_tpu as pt
+from paddle_tpu import io as pio
+from paddle_tpu import resilience
+from paddle_tpu.data.wire import WireSpec
+from paddle_tpu.fleet import BatchPolicy, FleetRouter
+from paddle_tpu.fleet import remote as fremote
+from paddle_tpu.io import artifact_fingerprint
+from paddle_tpu.serving import ReloadFailed, ReplicaDied
+from paddle_tpu.testing import faults
+
+REMOTE_KW = dict(probe_timeout=0.5, down_cooldown=0.4, submit_timeout=3.0,
+                 connect_timeout=1.0, reload_timeout=12.0)
+
+
+def _feed(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"image": rng.randn(n, 784).astype(np.float32),
+            "label": rng.randint(0, 10, (n, 1)).astype(np.int64)}
+
+
+def _single(feed, i):
+    return {k: np.asarray(v)[i % 8:i % 8 + 1] for k, v in feed.items()}
+
+
+def _fake_artifact(root, name="model", blob_kb=192, seed=7):
+    """A manifest-committed dir that is NOT a real model — the wire
+    only needs the manifest, which is what makes these tests cheap."""
+    d = os.path.join(str(root), name)
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.RandomState(seed)
+    with open(os.path.join(d, "weights.bin"), "wb") as f:
+        f.write(rng.bytes(blob_kb * 1024))
+    with open(os.path.join(d, "program.json"), "w") as f:
+        json.dump({"name": name, "seed": seed}, f)
+    resilience.write_manifest(d, meta={"fake": True})
+    return d
+
+
+def _expected_table(d):
+    """The FETCH negotiate file table ``ship_artifact`` would send."""
+    man, token = artifact_fingerprint(d)
+    expected = {n: {"crc32": int(s["crc32"]), "size": int(s["size"])}
+                for n, s in man["files"].items()}
+    crc, size = resilience._crc32_file(
+        os.path.join(d, resilience.MANIFEST_NAME))
+    expected[resilience.MANIFEST_NAME] = {"crc32": crc, "size": size}
+    return token, expected
+
+
+def _read(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _dirs_identical(a, b):
+    names = sorted(os.listdir(a))
+    assert names == sorted(os.listdir(b))
+    for n in names:
+        assert _read(os.path.join(a, n)) == _read(os.path.join(b, n)), n
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    from paddle_tpu.models import mnist
+
+    d = str(tmp_path_factory.mktemp("xhost") / "model")
+    prog = pt.build(mnist.mlp)
+    feed8 = _feed(8)
+    params, state = prog.init(jax.random.PRNGKey(0), **feed8)
+    pio.save_inference_model(d, prog, jax.tree.map(np.asarray, params),
+                             state, feed8, batch_buckets=[4, 8])
+    return {"dir": d, "prog": prog, "params": params, "state": state,
+            "feed8": feed8}
+
+
+@pytest.fixture(scope="module")
+def host(tmp_path_factory):
+    """One real per-host agent process + its client, shared across the
+    module (the artifact cache is content-addressed, so tests don't
+    interfere)."""
+    from paddle_tpu.fleet.agent import AgentProcess
+
+    root = str(tmp_path_factory.mktemp("hostA"))
+    agent = AgentProcess(root)
+    agent.wait_ready()
+    cli = fremote.AgentClient(agent.addr)
+    yield {"agent": agent, "cli": cli,
+           "cache": os.path.join(root, "artifacts")}
+    cli.close()
+    agent.stop()
+
+
+# -- ArtifactStore units: staging, resume, corruption, atomic commit ----------
+
+
+def _chunks(path, fname, start=0, chunk=4096):
+    with open(path, "rb") as f:
+        f.seek(start)
+        off = start
+        while True:
+            data = f.read(chunk)
+            if not data:
+                return
+            yield fname, off, zlib.crc32(data) & 0xFFFFFFFF, data
+            off += len(data)
+
+
+def test_artifact_store_stages_resumes_and_commits_atomically(tmp_path):
+    src = _fake_artifact(tmp_path / "src", blob_kb=48)
+    token, expected = _expected_table(src)
+    store = fremote.ArtifactStore(str(tmp_path / "cache"))
+    negotiate = json.dumps({"token": token, "files": expected,
+                            "commit": False}).encode()
+
+    st = store.handle_fetch(token, negotiate)
+    assert st == {"complete": False, "have": {},
+                  "path": os.path.join(store.root, token)}
+    final, staging = st["path"], os.path.join(store.root,
+                                              token + ".staging")
+
+    # a torn transfer: only the first 8 KiB of the blob lands
+    for fname, off, crc, data in _chunks(
+            os.path.join(src, "weights.bin"), "weights.bin", chunk=4096):
+        if off >= 8192:
+            break
+        store.handle_chunk(token, fname, off, crc, data)
+    assert not os.path.isdir(final)          # nothing commits by itself
+
+    # re-negotiation resumes from the staged sizes, never from zero
+    st = store.handle_fetch(token, negotiate)
+    assert st["have"] == {"weights.bin": 8192}
+
+    # a premature commit names every incomplete file and keeps the
+    # intact staged prefix... except files whose CRC can't match yet
+    # are dropped (weights.bin staged partial fails the whole-file CRC)
+    st = store.handle_fetch(token, json.dumps(
+        {"token": token, "commit": True}).encode())
+    assert st["complete"] is False
+    assert sorted(st["bad"]) == sorted(expected)
+    assert not os.path.isdir(final)
+
+    # finish every file (negotiate again: the partial was dropped)
+    st = store.handle_fetch(token, negotiate)
+    for name in expected:
+        for fname, off, crc, data in _chunks(
+                os.path.join(src, name), name,
+                start=int(st["have"].get(name, 0))):
+            store.handle_chunk(token, fname, off, crc, data)
+    st = store.handle_fetch(token, json.dumps(
+        {"token": token, "commit": True}).encode())
+    assert st == {"complete": True, "path": final}
+    assert os.path.isdir(final) and not os.path.exists(staging)
+    _dirs_identical(src, final)
+
+    # an already-committed token is the zero-byte fast path
+    st = store.handle_fetch(token, negotiate)
+    assert st == {"complete": True, "path": final}
+
+
+def test_artifact_store_drops_corrupt_chunks_and_reships(tmp_path):
+    src = _fake_artifact(tmp_path / "src", blob_kb=16)
+    token, expected = _expected_table(src)
+    store = fremote.ArtifactStore(str(tmp_path / "cache"))
+    negotiate = json.dumps({"token": token, "files": expected,
+                            "commit": False}).encode()
+    commit = json.dumps({"token": token, "commit": True}).encode()
+    store.handle_fetch(token, negotiate)
+    staging = os.path.join(store.root, token + ".staging")
+
+    # ship everything, but flip one byte of one program.json chunk in
+    # flight (CRC now disagrees): the staged file is poisoned/dropped
+    for name in expected:
+        for fname, off, crc, data in _chunks(os.path.join(src, name), name):
+            if name == "program.json":
+                data = b"X" + data[1:]
+            store.handle_chunk(token, fname, off, crc, data)
+    assert not os.path.exists(os.path.join(staging, "program.json"))
+
+    # a chunk at the wrong offset is equally dropped (no silent gap)
+    good = _read(os.path.join(src, "program.json"))
+    store.handle_chunk(token, "program.json", 5,
+                       zlib.crc32(good) & 0xFFFFFFFF, good)
+    assert not os.path.exists(os.path.join(staging, "program.json"))
+
+    # commit names exactly the damaged file; the intact ones held
+    st = store.handle_fetch(token, commit)
+    assert st["complete"] is False and st["bad"] == ["program.json"]
+    assert set(st["have"]) == set(expected) - {"program.json"}
+
+    # the re-ship lap (what ship_artifact's next attempt does)
+    st = store.handle_fetch(token, negotiate)
+    for fname, off, crc, data in _chunks(
+            os.path.join(src, "program.json"), "program.json"):
+        store.handle_chunk(token, fname, off, crc, data)
+    st = store.handle_fetch(token, commit)
+    assert st["complete"] is True
+    _dirs_identical(src, st["path"])
+
+
+def test_artifact_store_rejects_unsafe_tokens_and_names(tmp_path):
+    store = fremote.ArtifactStore(str(tmp_path / "cache"))
+    for bad in ("", "../up", "a/b", "a\\b"):
+        with pytest.raises(ValueError):
+            store.handle_fetch(bad, b"{}")
+    # unsafe member names never negotiate in nor land on disk
+    st = store.handle_fetch("tok-1", json.dumps(
+        {"token": "tok-1", "commit": False,
+         "files": {"../evil": {"crc32": 0, "size": 1},
+                   ".hidden": {"crc32": 0, "size": 1},
+                   "ok.bin": {"crc32": 0, "size": 1}}}).encode())
+    assert st["complete"] is False
+    store.handle_chunk("tok-1", "../evil", 0,
+                       zlib.crc32(b"x") & 0xFFFFFFFF, b"x")
+    store.handle_chunk("tok-1", ".hidden", 0,
+                       zlib.crc32(b"x") & 0xFFFFFFFF, b"x")
+    staging = os.path.join(store.root, "tok-1.staging")
+    assert os.listdir(staging) == []
+    assert not os.path.exists(os.path.join(tmp_path, "evil"))
+    # a chunk for a token that never negotiated is dropped silently
+    store.handle_chunk("tok-ghost", "ok.bin", 0,
+                       zlib.crc32(b"x") & 0xFFFFFFFF, b"x")
+    assert not os.path.exists(os.path.join(store.root,
+                                           "tok-ghost.staging"))
+
+
+# -- SUBMIT feed narrowing (the WireSpec satellite) ---------------------------
+
+
+def test_pack_tree_wire_narrowing_and_unpack_counters():
+    feed = {"image": np.linspace(-1, 1, 784, dtype=np.float32)
+            .reshape(1, 784),
+            "label": np.array([[3]], dtype=np.int64)}
+    wire = {"image": WireSpec.cast("bfloat16")}
+    meta_p, payload_p = fremote.pack_tree(feed)
+    meta_w, payload_w = fremote.pack_tree(feed, wire=wire)
+    # bf16 halves the image bytes; the label rides passthrough
+    assert len(payload_w) == 784 * 2 + 8
+    assert len(payload_p) == 784 * 4 + 8
+    counters = {}
+    back = fremote.unpack_tree(meta_w, payload_w, counters=counters)
+    assert counters == {"wire_bytes": 784 * 2 + 8,
+                        "logical_bytes": 784 * 4 + 8}
+    # decode restores the logical dtype, within bf16 mantissa loss
+    assert back["image"].dtype == np.float32
+    np.testing.assert_allclose(back["image"], feed["image"],
+                               rtol=1e-2, atol=1e-2)
+    np.testing.assert_array_equal(back["label"], feed["label"])
+
+
+# -- the wire end to end: a real agent's artifact door ------------------------
+
+
+@pytest.mark.slow
+def test_agent_ship_resumes_torn_transfer_and_noops_when_cached(host,
+                                                                tmp_path):
+    src = _fake_artifact(tmp_path / "src", name="shipme", blob_kb=192)
+    token, expected = _expected_table(src)
+
+    # tear a transfer by hand: negotiate + one 64 KiB chunk, then drop
+    # the connection with no commit
+    cli = fremote._ControlClient(host["cli"].addr, timeout=10.0,
+                                 connect=False)
+    negotiate = json.dumps({"token": token, "files": expected,
+                            "commit": False}).encode()
+    st = cli.call(f"FETCH {token} {len(negotiate)}", negotiate)
+    assert st["complete"] is False and st["have"] == {}
+    data = _read(os.path.join(src, "weights.bin"))[:65536]
+    crc = zlib.crc32(data) & 0xFFFFFFFF
+    cli._sock.sendall(
+        f"ARTIFACT {token} weights.bin 0 {len(data)} {crc:08x}\n".encode()
+        + data)
+    # ARTIFACT frames have no reply; a round trip orders the check
+    cli.call(f"FETCH {token} {len(negotiate)}", negotiate)
+    cli.close()
+
+    # a fresh negotiation sees the staged bytes — the resume point
+    cli = fremote._ControlClient(host["cli"].addr, timeout=10.0,
+                                 connect=False)
+    st = cli.call(f"FETCH {token} {len(negotiate)}", negotiate)
+    assert st["have"] == {"weights.bin": 65536}
+    cli.close()
+
+    # ship_artifact picks the transfer up from there and commits
+    path = fremote.ship_artifact(host["cli"].addr, src,
+                                 chunk_bytes=65536)
+    assert path.startswith(host["cache"])
+    _dirs_identical(src, path)
+    man_c, token_c = artifact_fingerprint(path)
+    # a committed copy's dir is NAMED by the token, so its token
+    # regenerates prefixed — the CRC suffix is the identity
+    assert token_c.rsplit("-", 1)[1] == token.rsplit("-", 1)[1]
+
+    # content-addressed no-op: same bytes, same path, zero re-stream
+    assert host["cli"].ship(src) == path
+
+
+# -- adopted replicas: feed_wire, at-most-once, the agent death oracle --------
+
+
+@pytest.mark.slow
+def test_adopted_replica_feed_wire_half_open_and_agent_oracle(artifact,
+                                                              host):
+    proxy = faults.LinkProxy(("127.0.0.1", 1))   # retargeted below
+    rep = None
+    try:
+        # adopt with every cross-"host" byte routed through the proxy
+        def link(addr):
+            proxy.target = (str(addr[0]), int(addr[1]))
+            return proxy.addr
+
+        rep = fremote.adopt_replica(
+            host["cli"], artifact["dir"], "rw0",
+            remote_kw=dict(REMOTE_KW, submit_timeout=1.0,
+                           feed_wire={"image": WireSpec.cast("bfloat16")}),
+            link=link, workers=1, queue_size=16,
+            golden_feed=artifact["feed8"],
+            batch_policy=BatchPolicy(max_wait_ms=2.0))
+        assert rep.agent is host["cli"] and rep.pid is not None
+
+        out = rep.run(_single(artifact["feed8"], 0), timeout=60)
+        assert "logits" in out
+        # the serving report prices the narrowing: bf16 image + i64
+        # label on the wire vs the logical f32 feed
+        fw = rep.report()["feed_wire"]
+        assert fw["wire_bytes"] == 784 * 2 + 8
+        assert fw["logical_bytes"] == 784 * 4 + 8
+
+        # half-open partition: sent, no reply — ReplicaDied exactly
+        # once (the agent's PS oracle proves the process ALIVE, so
+        # this is never reclassified safe-to-resend)
+        proxy.partition()
+        with pytest.raises(ReplicaDied):
+            rep.run(_single(artifact["feed8"], 1), timeout=10)
+        assert rep._provably_dead() is False
+        ps = {p["pid"]: p for p in host["cli"].ps()["procs"]}
+        assert ps[rep.pid]["alive"] is True
+
+        # healed, the same replica serves again (at-most-once, not
+        # dead: nothing was torn down)
+        proxy.heal()
+        time.sleep(REMOTE_KW["down_cooldown"] + 0.1)
+        out = rep.run(_single(artifact["feed8"], 2), timeout=60)
+        assert "logits" in out
+
+        # the death oracle: agent STOP reaps the pid; PS keeps the
+        # corpse listed dead, which IS the proof across any proxy
+        host["cli"].stop(rep.pid)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not rep._provably_dead():
+            time.sleep(0.1)
+        assert rep._provably_dead() is True
+        ps = {p["pid"]: p for p in host["cli"].ps()["procs"]}
+        assert ps[rep.pid]["alive"] is False
+    finally:
+        if rep is not None:
+            rep.kill()
+        proxy.close()
+
+
+def _assert_no_half_written_dirs(cache_root):
+    """Every non-staging entry in a host artifact cache must be a
+    fully manifest-validated artifact dir — the atomic-commit
+    invariant a mid-fetch partition must not break."""
+    for name in os.listdir(cache_root):
+        path = os.path.join(cache_root, name)
+        if name.endswith(".staging") or not os.path.isdir(path):
+            continue
+        man = resilience.read_manifest(path)
+        assert man is not None, f"committed dir {name} has no manifest"
+        for fname, spec in man["files"].items():
+            crc, size = resilience._crc32_file(os.path.join(path, fname))
+            assert crc == int(spec["crc32"]), (name, fname)
+            assert size == int(spec["size"]), (name, fname)
+
+
+@pytest.mark.slow
+def test_crosshost_reload_midfetch_partition_rolls_back_typed(artifact,
+                                                              host,
+                                                              tmp_path):
+    params = jax.tree.map(np.asarray, artifact["params"])
+    d_v2 = str(tmp_path / "v2")
+    pio.save_inference_model(
+        d_v2, artifact["prog"], jax.tree.map(lambda v: v * 0.5, params),
+        artifact["state"], artifact["feed8"], batch_buckets=[4, 8])
+    server_kw = dict(workers=1, queue_size=16,
+                     golden_feed=artifact["feed8"])
+    # r1's every byte — health, SUBMIT, and the artifact fetch its
+    # reload ships through — crosses a LinkProxy; r0 is direct. A long
+    # health TTL keeps r1 in the rollout order after the partition;
+    # r1's short reload_timeout bounds how long the blackholed fetch
+    # is retried (r0 keeps the real budget for its actual swaps).
+    proxy = None
+    router = None
+    try:
+        r0 = fremote.adopt_replica(
+            host["cli"], artifact["dir"], "r0",
+            remote_kw=dict(REMOTE_KW, health_ttl=30.0), **server_kw)
+        proxy = faults.LinkProxy(("127.0.0.1", 1))
+
+        def link(addr):
+            proxy.target = (str(addr[0]), int(addr[1]))
+            return proxy.addr
+
+        r1 = fremote.adopt_replica(
+            host["cli"], artifact["dir"], "r1",
+            remote_kw=dict(REMOTE_KW, health_ttl=30.0,
+                           reload_timeout=0.5),
+            link=link, **server_kw)
+        router = FleetRouter({"r0": r0, "r1": r1},
+                             dirname=artifact["dir"], server_kw=server_kw,
+                             probe_timeout=1.0, remote=True,
+                             remote_kw=dict(REMOTE_KW),
+                             agents=[host["cli"]], link=link)
+        out_v1 = router.run(_single(artifact["feed8"], 0), timeout=60)
+        router.health()                     # refresh the cache pre-cut
+        proxy.partition()
+        # the canary (r0) ships + swaps to v2; r1's artifact fetch
+        # blackholes mid-stream → connection-shaped → typed rollback
+        with pytest.raises(ReloadFailed, match="rolled back"):
+            router.reload(d_v2)
+        assert router.dirname == artifact["dir"]
+        # canary rolled back: gen 1 → 2 (v2 swap) → 3 (rollback), and
+        # it serves the ORIGINAL weights again
+        assert r0.generation == 3
+        out_after = router.run(_single(artifact["feed8"], 0), timeout=60)
+        np.testing.assert_array_equal(np.asarray(out_v1["logits"]),
+                                      np.asarray(out_after["logits"]))
+        # the invariant the partition must not break: the host cache
+        # holds only fully-validated dirs (v2 committed whole by the
+        # canary's ship) — a torn fetch leaves staging, never a final
+        _assert_no_half_written_dirs(host["cache"])
+    finally:
+        if proxy is not None:
+            proxy.heal()   # close() must not hang on the blackhole
+        if router is not None:
+            router.close(drain=False, timeout=10)
+        if proxy is not None:
+            proxy.close()
+
+
+# -- the acceptance drill: whole-host SIGKILL ---------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_drill_host_kill_passes():
+    """Two-"host" fleet + primary/standby collector pair under ~3x
+    saturation survives SIGKILL of EVERY process on one host: zero
+    accepted-but-undispatched requests lost, ``ReplicaDied`` once per
+    in-flight casualty, ``replace()`` respawns via the surviving
+    host's agent, and the standby collector promotes from replicated
+    segments with zero tick loss + the firing alert carried over
+    (exit 0)."""
+    from tools import fleet_drill
+
+    assert fleet_drill.main(["--drills", "host_kill",
+                             "--replicas", "2", "--requests", "30"]) == 0

@@ -1,0 +1,356 @@
+"""Cross-host telemetry catch-up: SEGMENTS wire + standby replication.
+
+The contracts (all in-process, CPU, no real host dies — the drill does
+the SIGKILL half):
+
+  * the primary's ``SEGMENTS`` verb serves a listing (sealed names +
+    CRC sidecar docs + the open tail's name/size) and byte-exact
+    segment fetches that slice by offset/limit — what a cross-host
+    standby's pull loop is built from;
+  * a standby with ``replicate_from=`` adopts sealed segments and
+    mirrors the open tail into its OWN store, refuses to promote while
+    the primary still answers its wire (the cross-host split-brain
+    fence), and after the primary dies promotes with ZERO tick loss
+    and the pre-kill firing alert restored under its original
+    ``since`` — no transition flap;
+  * a standby joining MID-RETENTION (the oldest segments already
+    deleted) replicates the surviving contiguous suffix — never a
+    gapped history;
+  * a segment corrupted IN FLIGHT is rejected against the sidecar CRC
+    the listing carried (``repl_corrupt``), re-requested next cycle,
+    and the primary's own ``segments_corrupt`` stays zero — a bad wire
+    must not be misread as bad disks;
+  * ``serve_metrics`` honors the ``PDTPU_BIND_ADDR`` knob (satellite:
+    every listener in the fleet binds the same way).
+"""
+
+import os
+import sys
+import time
+import urllib.request
+import zlib
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from paddle_tpu import telemetry
+from paddle_tpu.telemetry import alerts
+from paddle_tpu.telemetry import shipper as tshipper
+from paddle_tpu.telemetry import store as tstore
+from paddle_tpu.telemetry.collector import TelemetryCollector
+from paddle_tpu.telemetry.http import serve_metrics
+from paddle_tpu.telemetry.journal import RunJournal
+from paddle_tpu.telemetry.registry import MetricsRegistry
+from paddle_tpu.telemetry.shipper import ReplicationClient
+
+
+@pytest.fixture()
+def fresh(tmp_path):
+    old = telemetry.set_journal(RunJournal())
+    try:
+        yield telemetry.get_journal()
+    finally:
+        tshipper.stop_shipping()
+        j = telemetry.set_journal(old)
+        if j is not None:
+            j.close()
+
+
+def _crash(col):
+    """Stop a collector WITHOUT the clean-close path (no final state
+    record, active segment left .open, heartbeat not removed, sockets
+    refused) — the in-process stand-in for a whole-host kill."""
+    col._stop.set()
+    try:
+        col._ls.close()
+    except OSError:
+        pass
+    col._eval_thread.join(timeout=5)
+    col._seg.close()
+
+
+def _ship_ticks(sh, j, lo, hi, every=5):
+    for i in range(lo, hi):
+        j.emit("rep.tick", i=i)
+        if (i + 1) % every == 0:
+            sh.flush()
+    sh.flush()
+
+
+def _ticks(col, origin="o1"):
+    return [e["i"] for e in col.journal.recent(kind="rep.")
+            if e.get("origin") == origin]
+
+
+def _sealed_bytes(store_dir, name):
+    with open(os.path.join(store_dir, name), "rb") as f:
+        return f.read()
+
+
+# ---------------------------------------------------------------------------
+# SEGMENTS wire: listing + byte-exact sliced fetch
+# ---------------------------------------------------------------------------
+
+
+def test_segments_wire_listing_fetch_and_slicing(fresh, tmp_path):
+    pd = str(tmp_path / "primary")
+    primary = TelemetryCollector(eval_interval=3600, rules=[],
+                                 store_dir=pd, segment_max_bytes=900)
+    j = RunJournal()
+    sh = tshipper.Shipper(f"{primary.host}:{primary.port}", origin="o1",
+                          journal=j, flush_interval=3600,
+                          client_timeout=2.0)
+    cli = ReplicationClient(primary.addr)
+    try:
+        _ship_ticks(sh, j, 0, 40)
+        assert primary.stats()["store"]["segments_sealed"] >= 2
+
+        cli.ping()   # the fence's liveness probe, while alive
+        lst = cli.listing()
+        sealed = lst["segments"]
+        assert len(sealed) >= 2
+        for ent in sealed:
+            name, meta = ent["name"], ent["meta"]
+            assert name.endswith(tstore.SEGMENT_SEALED)
+            data = cli.fetch(name)
+            # the sidecar doc the standby verifies against rides the
+            # listing, and the fetch is byte-exact vs the primary disk
+            assert len(data) == meta["size"]
+            assert zlib.crc32(data) == meta["crc32"]
+            assert data == _sealed_bytes(pd, name)
+
+        # sliced reads reassemble to the whole file; a read past EOF
+        # is empty, not an error (the open-tail mirror's stop signal)
+        name = sealed[0]["name"]
+        full = cli.fetch(name)
+        cut = min(100, len(full))
+        assert cli.fetch(name, offset=0, limit=cut) == full[:cut]
+        assert cli.fetch(name, offset=cut) == full[cut:]
+        assert cli.fetch(name, offset=len(full)) == b""
+
+        op = lst["open"]
+        assert op["name"].endswith(tstore.SEGMENT_ACTIVE)
+        tail = cli.fetch(op["name"], offset=0, limit=int(op["size"]))
+        assert tail == _sealed_bytes(pd, op["name"])[:int(op["size"])]
+    finally:
+        cli.close()
+        sh.close(timeout=5)
+        primary.close()
+
+
+# ---------------------------------------------------------------------------
+# standby: replicate -> fence -> promote (zero loss, alert continuity)
+# ---------------------------------------------------------------------------
+
+
+def test_standby_replicates_promotes_with_alert_and_tick_continuity(
+        fresh, tmp_path):
+    rule = alerts.parse_rule(
+        "hot", "paddle_tpu_serving_queue_depth > 5 for 0s",
+        severity="page")
+    pd, sd = str(tmp_path / "primary"), str(tmp_path / "standby")
+    primary = TelemetryCollector(eval_interval=3600, rules=[rule],
+                                 store_dir=pd, segment_max_bytes=1500)
+    standby = TelemetryCollector(
+        eval_interval=3600, rules=[rule], store_dir=sd, standby=True,
+        takeover_s=30.0, replicate_from=f"{primary.host}:{primary.port}",
+        replicate_interval=3600)
+    # replicate_from on a non-standby is a loud misconfiguration,
+    # not a silent no-op
+    with pytest.raises(ValueError):
+        TelemetryCollector(eval_interval=3600,
+                           store_dir=str(tmp_path / "x"),
+                           replicate_from="127.0.0.1:1")
+
+    j = RunJournal()
+    reg = MetricsRegistry()
+    reg.gauge("paddle_tpu_serving_queue_depth", "h").set(9)
+    sh = tshipper.Shipper(f"{primary.host}:{primary.port}", origin="o1",
+                          journal=j, registry=reg, flush_interval=3600,
+                          client_timeout=2.0)
+    try:
+        assert standby.is_standby
+        assert standby.stats()["replicating"] is True
+
+        _ship_ticks(sh, j, 0, 24, every=6)
+        trans = primary.evaluate_once()
+        assert [t["state"] for t in trans] == ["firing"]
+        fired_since = primary.engine.firing()[0]["since"]
+
+        # one pull adopts every sealed segment and mirrors the open
+        # tail to the primary's exact byte offset
+        adopted = standby._replicate_once()
+        st = standby.stats()["store"]
+        assert adopted >= 1 and st["repl_segments"] == adopted
+        assert st["repl_bytes"] > 0 and st["repl_corrupt"] == 0
+
+        # the cross-host split-brain fence: the replication source
+        # still answers its wire, so the standby keeps its hands off
+        with pytest.raises(RuntimeError, match="still answers"):
+            standby.promote()
+        assert standby.is_standby
+
+        # whole-host kill (no clean close): the wire goes dead, the
+        # fence clears, promotion replays the LOCAL replica
+        _crash(primary)
+        assert standby.promote() is True
+        assert not standby.is_standby
+        assert standby.promote() is False   # idempotent
+
+        # zero tick loss, exactly once, in order — through a segment
+        # boundary
+        assert _ticks(standby) == list(range(24))
+        # the pre-kill firing alert is FIRING under its original
+        # clock, with no transition flap journaled on the standby
+        firing = standby.engine.firing()
+        assert [a["rule"] for a in firing] == ["hot"]
+        assert firing[0]["since"] == fired_since
+        assert standby.journal.recent(kind="alert.") == []
+        standby.evaluate_once()
+        assert standby.journal.recent(kind="alert.") == []
+    finally:
+        sh.close(timeout=5)
+        standby.close()
+        primary.close()
+
+
+# ---------------------------------------------------------------------------
+# standby joining mid-retention: contiguous suffix, never a gap
+# ---------------------------------------------------------------------------
+
+
+def test_standby_joins_mid_retention_gets_contiguous_suffix(
+        fresh, tmp_path):
+    pd, sd = str(tmp_path / "primary"), str(tmp_path / "standby")
+    primary = TelemetryCollector(eval_interval=3600, rules=[],
+                                 store_dir=pd, segment_max_bytes=700,
+                                 retention_bytes=6000, retention_s=3600)
+    j = RunJournal()
+    sh = tshipper.Shipper(f"{primary.host}:{primary.port}", origin="o1",
+                          journal=j, flush_interval=3600,
+                          client_timeout=2.0)
+    standby = None
+    try:
+        _ship_ticks(sh, j, 0, 60)
+        assert primary.stats()["store"]["segments_sealed"] >= 4
+        deleted = primary._seg.enforce_retention()
+        assert deleted, "retention never deleted a segment"
+        assert primary.stats()["store"]["segments_deleted"] >= 1
+
+        # the standby joins AFTER the oldest segments are gone
+        standby = TelemetryCollector(
+            eval_interval=3600, rules=[], store_dir=sd, standby=True,
+            takeover_s=30.0,
+            replicate_from=f"{primary.host}:{primary.port}",
+            replicate_interval=3600)
+        standby._replicate_once()
+        _crash(primary)
+        assert standby.promote() is True
+
+        seen = _ticks(standby)
+        # retention trims whole oldest segments, so the replica is a
+        # CONTIGUOUS suffix of history ending at the newest tick —
+        # some head loss (expected), never an interior gap
+        assert seen == list(range(min(seen), 60))
+        assert 0 < min(seen) < 59
+    finally:
+        sh.close(timeout=5)
+        if standby is not None:
+            standby.close()
+        primary.close()
+
+
+# ---------------------------------------------------------------------------
+# in-flight corruption: rejected, re-requested, primary disks unblamed
+# ---------------------------------------------------------------------------
+
+
+def test_inflight_corruption_rejected_refetched_primary_untouched(
+        fresh, tmp_path):
+    pd, sd = str(tmp_path / "primary"), str(tmp_path / "standby")
+    primary = TelemetryCollector(eval_interval=3600, rules=[],
+                                 store_dir=pd, segment_max_bytes=700)
+    j = RunJournal()
+    sh = tshipper.Shipper(f"{primary.host}:{primary.port}", origin="o1",
+                          journal=j, flush_interval=3600,
+                          client_timeout=2.0)
+    standby = TelemetryCollector(
+        eval_interval=3600, rules=[], store_dir=sd, standby=True,
+        takeover_s=30.0, replicate_from=f"{primary.host}:{primary.port}",
+        replicate_interval=3600)
+    try:
+        _ship_ticks(sh, j, 0, 30)
+        assert primary.stats()["store"]["segments_sealed"] >= 2
+
+        # a lying wire: every sealed-segment fetch arrives with its
+        # last byte flipped (the listing's CRC sidecar doc does not)
+        cli = standby._repl_client()
+        real_fetch = cli.fetch
+
+        def lying_fetch(name, offset=0, limit=None):
+            data = real_fetch(name, offset=offset, limit=limit)
+            if name.endswith(tstore.SEGMENT_SEALED) and data:
+                return data[:-1] + bytes([data[-1] ^ 0xFF])
+            return data
+
+        cli.fetch = lying_fetch
+        assert standby._replicate_once() == 0
+        st = standby.stats()["store"]
+        assert st["repl_corrupt"] >= 2 and st["repl_segments"] == 0
+        # the primary's own store is NOT blamed: its recovery-side
+        # corruption counter and replication counters stay zero
+        pstats = primary.stats()
+        assert pstats["segments_corrupt"] == 0
+        assert pstats["store"]["repl_corrupt"] == 0
+
+        # the wire heals: the very next cycle re-requests and adopts
+        # every rejected segment, byte-identical to the primary's disk
+        cli.fetch = real_fetch
+        assert standby._replicate_once() >= 2
+        assert standby.stats()["store"]["repl_corrupt"] == st["repl_corrupt"]
+        for name in sorted(primary._seg.sealed_names()):
+            assert (_sealed_bytes(sd, name)
+                    == _sealed_bytes(pd, name)), name
+    finally:
+        sh.close(timeout=5)
+        standby.close()
+        primary.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: serve_metrics honors the fleet bind-address knob
+# ---------------------------------------------------------------------------
+
+
+def test_serve_metrics_binds_env_addr(monkeypatch):
+    reg = MetricsRegistry()
+    reg.counter("paddle_tpu_test_binds_total", "h").inc()
+
+    monkeypatch.setenv("PDTPU_BIND_ADDR", "0.0.0.0")
+    srv = serve_metrics(reg)
+    try:
+        assert srv.host == "0.0.0.0"
+        # reachable beyond loopback-only (here: via loopback, but the
+        # socket is bound wild — the cross-host scrape shape)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+            assert r.status == 200
+            assert b"paddle_tpu_test_binds_total" in r.read()
+    finally:
+        srv.close()
+
+    # an explicit host= wins over the env
+    srv = serve_metrics(reg, host="127.0.0.1")
+    try:
+        assert srv.host == "127.0.0.1"
+    finally:
+        srv.close()
+
+    # no env, no host: loopback, as before the knob existed
+    monkeypatch.delenv("PDTPU_BIND_ADDR")
+    srv = serve_metrics(reg)
+    try:
+        assert srv.host == "127.0.0.1"
+    finally:
+        srv.close()

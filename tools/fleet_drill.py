@@ -66,6 +66,19 @@ the framed wire — and injects real faults, not in-process stand-ins):
   across both collectors, deduped by the replayed high-water marks),
   the failover recorded in ``paddle_tpu_shipper_flushes_total{outcome=
   "failover"}``, and the zero-drop request contract throughout.
+- **host_kill** — the cross-host acceptance drill: two "hosts" with
+  separate base dirs and NO shared filesystem (one fleet agent each,
+  every cross-host link through a ``LinkProxy``), the PRIMARY
+  collector on host A, a standby on host B replicating the segment
+  log over the ``SEGMENTS`` wire. Every process on host A is
+  SIGKILLed mid-stream at ~3x saturation: zero
+  accepted-but-undispatched requests lost, ``ReplicaDied``
+  at-most-once per dispatched casualty, ``replace()`` respawns via
+  the surviving host's agent (artifact over FETCH/ARTIFACT), the
+  standby promotes from its replicated segments with zero tick loss
+  and the firing alert carried with its original ``since`` — and a
+  rolling cross-host reload under load then swaps artifacts over the
+  FETCH door with zero dropped requests.
 
 Exit status: **0** all drills pass; **2** a drill dropped an accepted
 request or failed its contract (each violation printed); **3** the
@@ -530,10 +543,11 @@ def drill_alert(root, replicas, requests):
         eval_interval=0.1, origin_expiry_s=expiry_s)
     prev_addr = os.environ.get("PDTPU_TELEMETRY_ADDR")
     os.environ["PDTPU_TELEMETRY_ADDR"] = f"{col.host}:{col.port}"
-    # the drill's origin assertions are pid-based: an operator's
-    # exported PDTPU_TELEMETRY_ORIGIN would rename this process's
-    # shipper and fail the registration barrier spuriously
+    # the drill's origin assertions are <hostname>-<pid>-based: an
+    # operator's exported PDTPU_TELEMETRY_ORIGIN would rename this
+    # process's shipper and fail the registration barrier spuriously
     prev_origin = os.environ.pop("PDTPU_TELEMETRY_ORIGIN", None)
+    hostpart = tshipper.default_origin().rsplit("-", 1)[0]
     router = None
     violations = []
     try:
@@ -542,8 +556,8 @@ def drill_alert(root, replicas, requests):
         # SEEN: barrier on the whole fleet (router process + every
         # replica process) registering before the fault is injected —
         # a production fleet runs long before anything dies
-        expected = {f"pid-{os.getpid()}"} | {
-            f"pid-{router.replica(n).proc.pid}"
+        expected = {tshipper.default_origin()} | {
+            f"{hostpart}-{router.replica(n).proc.pid}"
             for n in router.replica_names}
         deadline = time.monotonic() + 20
         while time.monotonic() < deadline and \
@@ -557,7 +571,7 @@ def drill_alert(root, replicas, requests):
             return violations
         rate = _saturation_rate(router, feed)
         victim = router.replica_names[1 % len(router.replica_names)]
-        victim_origin = f"pid-{router.replica(victim).proc.pid}"
+        victim_origin = f"{hostpart}-{router.replica(victim).proc.pid}"
         killed_at = []
 
         def kill():
@@ -800,10 +814,321 @@ def drill_collector_failover(root, replicas, requests):
     return violations
 
 
+def drill_host_kill(root, replicas, requests):
+    """Whole-host SIGKILL over a two-"host" fleet with NO shared
+    filesystem: one fleet agent + its replicas + the PRIMARY collector
+    live on "host A" (own base dir), the standby collector and the
+    drill's front door on "host B", and every cross-host connection
+    runs through a ``LinkProxy``. Mid-stream at ~3x saturation every
+    process on host A is SIGKILLed: zero accepted-but-undispatched
+    requests lost, ``ReplicaDied`` at-most-once for dispatched
+    casualties, ``replace()`` respawns host A's replicas via host B's
+    agent (artifact over FETCH), and the standby promotes from its
+    REPLICATED segments with zero tick loss and the firing alert
+    carried with its original ``since``. A rolling cross-host reload
+    under load then proves the recovered fleet swaps artifacts over
+    the FETCH/ARTIFACT door with zero dropped requests."""
+    import json as _json
+    import signal as _signal
+
+    import jax
+    from paddle_tpu import serving
+    from paddle_tpu.fleet import BatchPolicy, FleetRouter
+    from paddle_tpu.fleet.agent import AgentProcess
+    from paddle_tpu.fleet.remote import AgentClient
+    from paddle_tpu.telemetry import alerts
+    from paddle_tpu.telemetry import collector as tcollector
+    from paddle_tpu.telemetry import shipper as tshipper
+    from paddle_tpu.telemetry.journal import RunJournal
+    from paddle_tpu.telemetry.registry import MetricsRegistry
+    from paddle_tpu.testing import faults
+
+    dirname, feed = _build_artifact(root, name="model_hostkill")
+    host_a = os.path.join(root, "hostA")
+    host_b = os.path.join(root, "hostB")
+    os.makedirs(host_a, exist_ok=True)
+    os.makedirs(host_b, exist_ok=True)
+    rules_path = os.path.join(root, "hostkill_rules.json")
+    with open(rules_path, "w") as f:
+        _json.dump([{"name": "drill_breaker", "severity": "page",
+                     "expr": "paddle_tpu_serving_breaker_open > 0 "
+                             "for 0.5s"}], f)
+
+    proxies = []
+
+    def _proxy(addr):
+        p = faults.LinkProxy(tuple(addr))
+        proxies.append(p)
+        return p.addr
+
+    # host A: agent + primary collector (durable log in host A's dir)
+    agent_a = AgentProcess(host_a)
+    agent_b = AgentProcess(host_b)
+    primary = tcollector.CollectorProcess(
+        rules_path=rules_path,
+        store_dir=os.path.join(host_a, "colstore"),
+        args=("--eval-interval", "0.1", "--origin-expiry", "30"))
+    primary_wire = _proxy((primary.host, primary.port))
+    # host B: the standby replicates the primary's segment log over
+    # SEGMENTS into its OWN store dir — no shared filesystem
+    standby = tcollector.TelemetryCollector(
+        rules=alerts.load_rules(rules_path), eval_interval=0.1,
+        origin_expiry_s=30.0, store_dir=os.path.join(host_b, "colstore"),
+        standby=True, replicate_from=primary_wire,
+        replicate_interval=0.05)
+    addr_list = (f"{primary_wire[0]}:{primary_wire[1]},"
+                 f"{standby.host}:{standby.port}")
+    prev_addr = os.environ.get("PDTPU_TELEMETRY_ADDR")
+    os.environ["PDTPU_TELEMETRY_ADDR"] = addr_list
+    prev_origin = os.environ.pop("PDTPU_TELEMETRY_ORIGIN", None)
+
+    # the numbered zero-loss tick stream + deterministic page source
+    sig_journal = RunJournal()
+    sig_reg = MetricsRegistry()
+    sig_reg.gauge("paddle_tpu_serving_breaker_open", "h").set(1)
+    sig = tshipper.Shipper(addr_list, origin="drillsig",
+                           journal=sig_journal, registry=sig_reg,
+                           flush_interval=0.1, client_timeout=1.0)
+    ticks_sent = [0]
+    stop_ticks = threading.Event()
+
+    def tick_pump():
+        while not stop_ticks.is_set():
+            sig_journal.emit("drill.tick", i=ticks_sent[0])
+            ticks_sent[0] += 1
+            time.sleep(0.005)
+
+    def _http_alerts(url):
+        import urllib.request
+        with urllib.request.urlopen(url + "/alerts", timeout=5) as r:
+            return _json.loads(r.read())
+
+    router = None
+    violations = []
+    ticker = threading.Thread(target=tick_pump)
+    cli_a = cli_b = None
+    host_a_pids = []
+    try:
+        agent_a.wait_ready()
+        agent_b.wait_ready()
+        cli_a = AgentClient(_proxy(agent_a.addr))
+        cli_b = AgentClient(_proxy(agent_b.addr))
+        router = FleetRouter.spawn(
+            dirname, replicas=replicas, hosts=[cli_a, cli_b],
+            link=_proxy, remote_kw=dict(REMOTE_KW), workers=1,
+            queue_size=16, golden_feed=feed,
+            batch_policy=BatchPolicy(max_wait_ms=2.0))
+        victims = [n for n in router.replica_names
+                   if router.replica(n).agent is cli_a]
+        if not victims:
+            violations.append("round-robin adoption left host A empty "
+                              "(drill needs a casualty)")
+            return violations
+        ticker.start()
+        # barrier: the page must be FIRING on the primary pre-kill
+        deadline = time.monotonic() + 30
+        fired = None
+        while time.monotonic() < deadline and fired is None:
+            snap = _http_alerts(primary.http_url)
+            fired = next((a for a in snap["firing"]
+                          if a["rule"] == "drill_breaker"), None)
+            if fired is None:
+                time.sleep(0.1)
+        if fired is None:
+            violations.append("drill_breaker never fired on the primary "
+                              "collector within 30s")
+            return violations
+        fired_since = fired["since"]
+        # the fence, proven live: a standby must refuse to promote
+        # while its replication source still answers the wire
+        try:
+            standby.promote()
+            violations.append("standby promoted over a LIVE primary "
+                              "(the replication fence did not hold)")
+        except RuntimeError:
+            pass
+        if not standby.is_standby:
+            violations.append("fence check flipped the standby active")
+        rate = _saturation_rate(router, feed)
+        ps = cli_a.ps()
+        host_a_pids = [int(p["pid"]) for p in ps["procs"]
+                       if p.get("alive")]
+        host_a_pids += [agent_a.pid, primary.pid]
+
+        def kill_host_a():
+            # converge replication on everything the primary ACKED,
+            # with the tick shipper's flush lock held so no new batch
+            # can be acknowledged between the catch-up pull and the
+            # kill — then SIGKILL every process on host A. Ticks
+            # emitted meanwhile are unacked and fail over to the
+            # standby; acked ticks are already in its replica. Zero
+            # loss either way, deterministically.
+            sig.flush()
+            with sig._flush_lock:
+                try:
+                    standby._replicate_once()
+                except Exception as e:
+                    violations.append(f"pre-kill catch-up pull failed: "
+                                      f"{e!r}")
+                for pid in host_a_pids:
+                    try:
+                        os.kill(pid, _signal.SIGKILL)
+                    except OSError:
+                        pass
+
+        pending, rejected = _drive(router, feed, requests, rate,
+                                   act_at=requests // 3, act=kill_host_a)
+        outcomes, dropped = _collect(pending)
+        print(f"  host_kill: accepted={len(pending)} shed={rejected} "
+              f"outcomes={outcomes} casualties={victims}")
+        if dropped:
+            violations.append(f"dropped accepted request(s): {dropped[:3]}")
+        state = router.health()["state"]
+        if state not in ("degraded", "unavailable"):
+            violations.append(f"health did not degrade on the host kill "
+                              f"(state={state})")
+        # recovery: every host A replica respawns via host B's agent,
+        # the artifact crossing (or already in) host B's FETCH cache
+        for name in victims:
+            router.replace(name)
+            if router.replica(name).agent is not cli_b:
+                violations.append(f"replace({name!r}) did not respawn "
+                                  "through the surviving host's agent")
+        state = router.health()["state"]
+        if state != "ready":
+            violations.append(f"fleet not ready after replace "
+                              f"(state={state})")
+        router.run(_single_feed(feed, 0), timeout=120)
+
+        # the standby must have promoted (first failed-over push) from
+        # its REPLICATED log, alert carried with its original clock
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and standby.is_standby:
+            time.sleep(0.1)
+        if standby.is_standby:
+            violations.append("standby never promoted within 20s of the "
+                              "host kill")
+            return violations
+        deadline = time.monotonic() + 10
+        still = None
+        while time.monotonic() < deadline and still is None:
+            still = next((a for a in standby.engine.firing()
+                          if a["rule"] == "drill_breaker"), None)
+            if still is None:
+                time.sleep(0.1)
+        if still is None:
+            violations.append(
+                "drill_breaker not firing on the promoted standby "
+                f"(alerts={standby.alerts_json()['firing']})")
+        elif still["since"] != fired_since:
+            violations.append(
+                f"firing clock restarted across the host kill "
+                f"(since {fired_since} -> {still['since']})")
+        flaps = [e["kind"] for e in standby.journal.recent(kind="alert.")
+                 if e.get("key") == (still or {}).get("key")]
+        if flaps:
+            violations.append(f"alert transitions journaled on the "
+                              f"standby for the carried alert: {flaps}")
+        st = standby.stats()
+        if not st["store"].get("repl_bytes"):
+            violations.append("standby store shows zero replicated bytes "
+                              f"(stats={st['store']})")
+
+        # zero tick loss across the host kill: every numbered tick
+        # lands exactly once (replicated prefix + failed-over tail,
+        # deduped by the replicated high-water marks)
+        stop_ticks.set()
+        ticker.join(timeout=10)
+        sig.flush()
+        total = ticks_sent[0]
+        deadline = time.monotonic() + 10
+        seen = []
+        while time.monotonic() < deadline:
+            seen = [e["i"] for e in standby.journal.recent(kind="drill.")
+                    if e.get("origin") == "drillsig"]
+            if len(seen) >= total:
+                break
+            sig.flush()
+            time.sleep(0.2)
+        if seen != list(range(total)):
+            missing = sorted(set(range(total)) - set(seen))[:5]
+            extra = len(seen) - len(set(seen))
+            violations.append(
+                f"tick loss across the host kill: {len(seen)}/{total} "
+                f"on the standby (first missing {missing}, "
+                f"{extra} duplicate(s))")
+
+        # cross-host rolling reload under load on the recovered fleet:
+        # the artifact crosses the FETCH/ARTIFACT door, canaries, and
+        # swaps with zero dropped requests
+        d_v2, _ = _build_artifact(
+            root, name="model_hostkill_v2",
+            mutate=lambda p: jax.tree.map(lambda v: v * 0.5, p))
+        errors = []
+        gens = None
+        stop_pump = threading.Event()
+
+        def pump():
+            while not stop_pump.is_set():
+                try:
+                    router.run(feed, timeout=120)
+                except (serving.ServerOverloaded, serving.ReplicaDied):
+                    pass
+                except BaseException as e:
+                    errors.append(repr(e))
+
+        t = threading.Thread(target=pump)
+        t.start()
+        try:
+            time.sleep(0.05)
+            gens = router.reload(d_v2)
+            if sorted(gens) != sorted(router.replica_names):
+                violations.append(f"cross-host rolling reload missed "
+                                  f"replicas: {gens}")
+        finally:
+            stop_pump.set()
+            t.join(timeout=120)
+        if errors:
+            violations.append(f"request dropped during the cross-host "
+                              f"reload: {errors[:3]}")
+        print(f"  host_kill: ticks={total} promoted=True "
+              f"reload_gens={sorted((gens or {}).values())}")
+    finally:
+        stop_ticks.set()
+        if ticker.is_alive():
+            ticker.join(timeout=5)
+        if prev_addr is None:
+            os.environ.pop("PDTPU_TELEMETRY_ADDR", None)
+        else:
+            os.environ["PDTPU_TELEMETRY_ADDR"] = prev_addr
+        if prev_origin is not None:
+            os.environ["PDTPU_TELEMETRY_ORIGIN"] = prev_origin
+        if router is not None:
+            router.close(drain=False, timeout=10)
+        tshipper.stop_shipping()
+        sig.close(timeout=5)
+        standby.close()
+        primary.kill()
+        for a in (agent_a, agent_b):
+            a.stop()
+        for cli in (cli_a, cli_b):
+            if cli is not None:
+                cli.close()
+        for pid in host_a_pids:
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except OSError:
+                pass
+        for p in proxies:
+            p.close()
+    return violations
+
+
 DRILLS = {"kill": drill_kill, "hang": drill_hang, "reload": drill_reload,
           "pkill": drill_pkill, "partition": drill_partition,
           "alert": drill_alert,
-          "collector_failover": drill_collector_failover}
+          "collector_failover": drill_collector_failover,
+          "host_kill": drill_host_kill}
 
 
 def main(argv=None) -> int:
@@ -813,10 +1138,11 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=90)
     ap.add_argument("--drills", default="kill,hang,reload",
                     help="comma list from: kill,hang,reload,pkill,"
-                         "partition,alert,collector_failover (the last "
-                         "four spawn a real cross-process fleet; alert/"
-                         "collector_failover also attach telemetry "
-                         "collectors); 'all' runs every drill")
+                         "partition,alert,collector_failover,host_kill "
+                         "(the last five spawn a real cross-process "
+                         "fleet; alert/collector_failover/host_kill "
+                         "also attach telemetry collectors); 'all' "
+                         "runs every drill")
     args = ap.parse_args(argv)
     names = [n.strip() for n in args.drills.split(",") if n.strip()]
     if names == ["all"]:
