@@ -242,9 +242,16 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
     # the PRE-adaptation rule table: typo'd axes only exist there
     # (Trainer.__init__ adapts its working copy, stripping them)
     rules = getattr(trainer, "sharding_rules_raw", None) or trainer.sharding_rules
+    # a ZeRO trainer's scope holds (N, k) shard rows; the program-level
+    # rules (sharding audit, param stats, the dtype re-trace) reason
+    # over LOGICAL shapes — _logical_params() is scope.params verbatim
+    # otherwise
+    logical_params = (trainer._logical_params()
+                      if hasattr(trainer, "_logical_params")
+                      else trainer.scope.params)
     report = check(
         trainer.program, sample_feed,
-        params=trainer.scope.params, state=trainer.scope.state,
+        params=logical_params, state=trainer.scope.state,
         mesh=trainer.mesh, rules=rules,
         strategy=trainer.strategy, loss_name=trainer.loss_name,
         select=inner_select,
@@ -258,7 +265,8 @@ def check_trainer(trainer, sample_feed: Optional[Dict[str, Any]] = None,
         _rules.check_replicated_optstate(
             trainer.scope.params, trainer.scope.opt_state, trainer.mesh,
             rules, report,
-            replicated_optstate_bytes=replicated_optstate_bytes)
+            replicated_optstate_bytes=replicated_optstate_bytes,
+            zero_sharding=getattr(trainer, "_zero", None) is not None)
     if want_coll or want_donation or step_dtype:
         _check_step_jaxpr(trainer, sample_feed, report, rules, amp,
                           want_coll, want_donation, step_dtype, kwargs)

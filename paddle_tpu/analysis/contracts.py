@@ -94,14 +94,22 @@ def trainer_specs(trainer) -> Dict[str, Any]:
 
     from .. import resilience
 
-    arrays = {"params.npz": _io.flat_spec(scope.params),
-              "state.npz": _io.flat_spec(scope.state or {})}
-    if scope.opt_state is not None:
-        arrays["opt_state.npz"] = _io.flat_spec(scope.opt_state)
+    tz = getattr(trainer, "_zero", None)
+    if tz is not None:
+        # a ZeRO trainer's live trees hold per-replica (1, k) shard rows;
+        # its contract surface is the LOGICAL spec recorded at startup
+        # (the same spec meta["zero"]["arrays"] pins in its checkpoints)
+        arrays = {k: dict(v) for k, v in tz.arrays.items()}
+    else:
+        arrays = {"params.npz": _io.flat_spec(scope.params),
+                  "state.npz": _io.flat_spec(scope.state or {})}
+        if scope.opt_state is not None:
+            arrays["opt_state.npz"] = _io.flat_spec(scope.opt_state)
     return {
         "arrays": arrays,
         "has_loss_scaler": getattr(trainer, "loss_scaler", None) is not None,
         "mesh_axes": resilience.trainer_mesh_axes(trainer),
+        "zero_axes": dict(tz.axes_dict) if tz is not None else None,
     }
 
 
@@ -139,9 +147,69 @@ def _feed_shapes(sample_feed: Optional[Dict[str, Any]]) -> Dict[str, Tuple[int, 
 # --------------------------------------------------------------------------
 
 
+def _manifest_logical_arrays(manifest: Dict[str, Any]) -> Dict[str, Any]:
+    """The checkpoint's LOGICAL flat spec per collection. A plain
+    checkpoint records it directly in ``manifest["arrays"]``; a ZeRO
+    (shard-aware) checkpoint's manifest arrays are the real per-shard
+    row files (``params.zero{i}.npz``), so the logical spec lives in
+    ``meta["zero"]["arrays"]`` instead — that is what a trainer's
+    contract surface compares against."""
+    zero = (manifest.get("meta") or {}).get("zero")
+    if zero:
+        logical = dict(zero.get("arrays") or {})
+        # the replicated remainder (step counters, non-param-shaped
+        # accums) still lives in the base opt_state.npz spec; the
+        # logical opt spec recorded under meta["zero"] already covers
+        # the whole tree, so prefer it — but fall back to the base file
+        # for collections the zero meta does not record
+        for fname, spec in (manifest.get("arrays") or {}).items():
+            logical.setdefault(fname, spec)
+        return logical
+    return manifest.get("arrays") or {}
+
+
+def _check_zero(specs: Dict[str, Any], manifest: Dict[str, Any],
+                report: LintReport) -> None:
+    """ZeRO shard-layout agreement between a checkpoint and the trainer
+    that would restore it. The runtime counterpart is the
+    ``load_trainer`` gate that raises ``ReshardError`` on a layout
+    change; statically the same comparison is the ``ckpt:zero-mismatch``
+    finding (warning, not error — ``reshard_restore`` /
+    ``fit(resume=True, elastic=True)`` recover via an explicit
+    gather-then-repartition, so the restore is feasible, just not
+    shard-local)."""
+    from .. import resilience
+
+    saved = (manifest.get("meta") or {}).get("zero_axes") or {}
+    target = specs.get("zero_axes") or {}
+    if resilience.normalize_mesh_axes(saved) == \
+            resilience.normalize_mesh_axes(target):
+        return
+    if saved and not target:
+        msg = (f"checkpoint is ZeRO-sharded over {dict(saved)} but the "
+               "trainer runs with zero_sharding off — plain "
+               "load_trainer raises ReshardError; restore via "
+               "resilience.reshard_restore / fit(resume=True, "
+               "elastic=True) (gathers the shard rows, full logical "
+               "copy per device)")
+    elif target and not saved:
+        msg = (f"trainer shards its weight update over {dict(target)} "
+               "(zero_sharding=True) but the checkpoint stores plain "
+               "unsharded arrays — plain load_trainer raises "
+               "ReshardError; reshard_restore / elastic fit repartition "
+               "on load")
+    else:
+        msg = (f"checkpoint ZeRO layout {dict(saved)} != the trainer's "
+               f"{dict(target)} — shard-local restore is impossible; "
+               "reshard_restore / elastic fit fall back to "
+               "gather-then-repartition (bytes reported)")
+    report.add("ckpt:zero-mismatch", "warning", msg, where="meta.zero",
+               got=dict(saved), expected=dict(target))
+
+
 def _check_ckpt_arrays(specs: Dict[str, Any], manifest: Dict[str, Any],
                        report: LintReport) -> None:
-    arrays = manifest.get("arrays") or {}
+    arrays = _manifest_logical_arrays(manifest)
     for fname in _COLLECTIONS:
         want = specs["arrays"].get(fname)
         got = arrays.get(fname)
@@ -249,7 +317,9 @@ def _check_reshard(manifest: Dict[str, Any], mesh, rules,
                    sample_feed: Optional[Dict[str, Any]],
                    report: LintReport) -> None:
     """Restore-at-a-different-mesh feasibility. Checkpoint arrays are
-    stored UNSHARDED (fully gathered), so a mesh change is a question
+    stored unsharded (fully gathered) — except ZeRO checkpoints, whose
+    per-shard row files gather back to the same logical arrays on any
+    non-shard-local load — so a mesh change is a question
     about the *target* placement only: (a) every rule-sharded param dim
     must divide the target axes (a dropped rule silently replicates —
     HBM regression, not a crash), and (b) the per-step batch must
@@ -271,7 +341,7 @@ def _check_reshard(manifest: Dict[str, Any], mesh, rules,
         # load_trainer gate — the pinned pairwise agreement must hold
         # for {'dp': 2, 'pp': 1} vs {'dp': 2} too): nothing to reshard
         return
-    arrays = (manifest.get("arrays") or {}).get("params.npz") or {}
+    arrays = _manifest_logical_arrays(manifest).get("params.npz") or {}
     table = _adapt(rules, mesh)
     dropped = LintReport("reshard")
     with collect_into(dropped):
@@ -331,10 +401,13 @@ def _check_reshard(manifest: Dict[str, Any], mesh, rules,
                  if saved_axes is not None else
                  f"restore at mesh {target_axes} is (checkpoint predates "
                  "mesh metadata — the saved mesh is unknown)")
+        stored = ("as ZeRO shard rows (gathered on a non-shard-local "
+                  "load)" if (manifest.get("meta") or {}).get("zero")
+                  else "unsharded")
         report.add(
             "ckpt:mesh-reshard", "info",
             f"{claim} expressible: checkpoint arrays are stored "
-            "unsharded and re-placed per the rule table at load — "
+            f"{stored} and re-placed per the rule table at load — "
             "resilience.reshard_restore(checkpoint_dir, trainer) (or "
             "fit(resume=True, elastic=True)) performs it with bit-exact "
             "state"
@@ -401,7 +474,10 @@ def _check_artifact_vs_trainer(info: Dict[str, Any], trainer,
     meta = info["meta"]
     manifest = info["manifest"]
     if manifest is not None:
-        want = _io.flat_spec(trainer.scope.params)
+        tz = getattr(trainer, "_zero", None)
+        # ZeRO trainers hold shard rows live; artifacts export logical
+        want = (dict(tz.arrays["params.npz"]) if tz is not None
+                else _io.flat_spec(trainer.scope.params))
         got = (manifest.get("arrays") or {}).get("params.npz") or {}
         diverged = sorted(
             set(want) ^ set(got)
@@ -605,6 +681,8 @@ def check_artifacts(
         elif manifest is not None:
             if specs is not None:
                 _degrade(report, "ckpt:malformed", checkpoint_dir,
+                         _check_zero, specs, manifest, report)
+                _degrade(report, "ckpt:malformed", checkpoint_dir,
                          _check_ckpt_arrays, specs, manifest, report)
                 _degrade(report, "ckpt:malformed", checkpoint_dir,
                          _check_loss_scale, specs, manifest, report)
@@ -639,7 +717,8 @@ def check_artifacts(
         _rules.check_replicated_optstate(
             trainer.scope.params, trainer.scope.opt_state, mesh,
             sharding_rules, report,
-            replicated_optstate_bytes=replicated_optstate_bytes)
+            replicated_optstate_bytes=replicated_optstate_bytes,
+            zero_sharding=getattr(trainer, "_zero", None) is not None)
     return report
 
 

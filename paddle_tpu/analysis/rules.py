@@ -884,7 +884,8 @@ def check_moe_capacity(moe_configs, report: LintReport,
 
 def check_replicated_optstate(params, opt_state, mesh, rules,
                               report: LintReport,
-                              replicated_optstate_bytes: int = 64 << 20) -> None:
+                              replicated_optstate_bytes: int = 64 << 20,
+                              zero_sharding: bool = False) -> None:
     """``sharding:replicated-optstate`` — per-parameter optimizer
     accumulators (Adam moments etc.) that every device along a
     data-parallel axis holds a full copy of, totalling more than
@@ -896,7 +897,13 @@ def check_replicated_optstate(params, opt_state, mesh, rules,
     replicated N ways. That is exactly the redundancy the ZeRO /
     cross-replica-sharded weight update removes (each replica owns a
     1/N shard of opt state, all-gathers fresh params once per step):
-    this lint is the static trigger for that optimization."""
+    this lint is the static trigger for that optimization.
+
+    With ``zero_sharding=True`` (``DistStrategy.zero_sharding`` — the
+    optimization has been APPLIED) the trigger goes quiet and the
+    companion info verdict ``sharding:zero-active`` reports the
+    REALIZED per-device opt-state bytes instead (from the live arrays'
+    shard shapes, not a projection)."""
     if mesh is None or opt_state is None or not params:
         return
     from ..parallel import mesh as mesh_lib
@@ -905,6 +912,27 @@ def check_replicated_optstate(params, opt_state, mesh, rules,
                       if mesh.shape[a] > 1)
     data_n = mesh_lib.data_parallel_size(mesh)
     if data_n <= 1:
+        return
+    if zero_sharding:
+        per_dev = 0
+        leaves = 0
+        for v in jax.tree.leaves(opt_state):
+            shape = tuple(getattr(v, "shape", ()))
+            sharding = getattr(v, "sharding", None)
+            local = (sharding.shard_shape(shape)
+                     if sharding is not None and shape else shape)
+            per_dev += int(np.prod(local or (1,))) * np.dtype(v.dtype).itemsize
+            leaves += 1
+        axes_desc = "x".join(f"{a}={mesh.shape[a]}" for a in data_axes)
+        report.add(
+            "sharding:zero-active", "info",
+            f"ZeRO weight-update sharding is on: optimizer state is "
+            f"partitioned 1/{data_n} across the data axis ({axes_desc}) "
+            f"— {per_dev / 1e6:.1f} MB/device realized across "
+            f"{leaves} leaves",
+            where="opt_state",
+            opt_state_bytes_per_device=int(per_dev),
+            data_shards=data_n, leaves=leaves)
         return
     from ..parallel.api import _rules as _adapt
     table = _adapt(rules, mesh)

@@ -350,6 +350,11 @@ class Trainer:
         # the static bytes-on-wire attribution of the grad exchange
         self._quant_ef = False
         self.collective_bytes = None
+        # ZeRO weight-update sharding (strategy.zero_sharding): set by
+        # startup to a parallel.zero.ZeroSpec when active; the step's
+        # combine/partition hooks, io checkpointing, and the analysis/
+        # advisor stack all key off this attribute
+        self._zero = None
         self.loss_scaler = None
         if strategy is not None and (getattr(strategy, "loss_scale", None)
                                      or getattr(strategy, "dynamic_loss_scale", False)):
@@ -439,6 +444,23 @@ class Trainer:
                     NamedSharding(self.mesh, PartitionSpec(
                         bshard, *([None] * len(leaf.shape)))))
                 for name, leaf in self.scope.params.items()}
+        # ZeRO weight-update sharding: partition params + opt_state into
+        # (N, k) rows over the data axes — AFTER the EF residuals above
+        # (they are built from LOGICAL shapes) and BEFORE the step
+        # traces (its combine/partition hooks key off self._zero). Same
+        # preconditions as the shard_map-local gradient paths.
+        self._zero = None
+        if self.strategy is not None and getattr(self.strategy,
+                                                 "zero_sharding", False):
+            from .parallel import zero as zero_mod
+            zaxes = self._local_exchange_axes("zero_sharding=True")
+            zspec = zero_mod.make_spec(self.mesh, zaxes, self.scope.params,
+                                       self.scope.state, self.scope.opt_state)
+            self.scope.params = zero_mod.partition_params(
+                self.scope.params, zspec, self.mesh)
+            self.scope.opt_state = zero_mod.partition_opt_state(
+                self.scope.opt_state, zspec, self.mesh)
+            self._zero = zspec
         self._build_step()
         self.lint_report = None
         if lint != "off":
@@ -532,6 +554,18 @@ class Trainer:
         """Re-apply the interleaved rest layout to logical-order arrays
         (checkpoint restore into a running interleaved trainer)."""
         return self._apply_row_perm(params, opt_state, lambda perm: perm)
+
+    def _logical_params(self):
+        """The params at their LOGICAL shapes regardless of ZeRO
+        sharding — an eager all-gather of the (N, k) rows when
+        ``zero_sharding`` is on, ``scope.params`` verbatim otherwise.
+        For analysis traces, the advisor, and export paths; never the
+        training hot path (the step's in-trace combine covers that)."""
+        if getattr(self, "_zero", None) is None:
+            return self.scope.params
+        from .parallel import zero as zero_mod
+        return zero_mod.combine_params(self.scope.params, self._zero,
+                                       self.mesh)
 
     # ------------------------------------------------------------------
     def _ambient_mode(self, flag_desc: str, wanted: bool, axis: str, enter):
@@ -661,8 +695,14 @@ class Trainer:
         if not axes:
             return None
         from .parallel import quantized_collectives as qc
-        sizes = [int(np.prod(p.shape)) if p.shape else 1
-                 for p in jax.tree.leaves(self.scope.params)]
+        zero = getattr(self, "_zero", None)
+        if zero is not None:
+            # scope.params hold (N, k) shard rows under ZeRO; the grad
+            # exchange still moves LOGICAL gradient elements
+            sizes = [int(np.prod(s)) if s else 1 for s in zero.shapes.values()]
+        else:
+            sizes = [int(np.prod(p.shape)) if p.shape else 1
+                     for p in jax.tree.leaves(self.scope.params)]
         ranks = {a: int(self.mesh.shape[a]) for a in axes}
         fp32 = sum(qc.ring_wire_bytes(n, p)
                    for n in sizes for p in ranks.values())
@@ -670,7 +710,7 @@ class Trainer:
             qc.ring_wire_bytes(n, p, bits=quant["bits"],
                                block_size=quant["block_size"])
             for n in sizes for p in ranks.values())
-        return {
+        summary = {
             "mode": "none" if quant is None else f"int{quant['bits']}",
             "bits": None if quant is None else quant["bits"],
             "block_size": None if quant is None else quant["block_size"],
@@ -682,6 +722,18 @@ class Trainer:
             "wire_bytes_per_step": int(wire),
             "reduction": (float(fp32) / wire) if wire else 1.0,
         }
+        if zero is not None:
+            # the ZeRO top-of-step param all-gather rides the same link
+            # — attribute it on the collective line next to the grad
+            # exchange it complements
+            from .parallel import zero as zero_mod
+            summary["zero"] = {
+                "shards": zero.n,
+                "axes": zero.axes,
+                "allgather_bytes_per_step":
+                    zero_mod.allgather_bytes_per_step(zero),
+            }
+        return summary
 
     def _quantized_exchange(self, gsum, accum_steps, axes, dshard, r,
                             res, quant, unscale):
@@ -904,6 +956,9 @@ class Trainer:
                                 record_feed_digest=False,
                                 defer_readback=False)
         self._guard = guard
+        zspec = getattr(self, "_zero", None)
+        if zspec is not None:
+            from .parallel import zero as zero_mod
 
         def _step_impl(params, opt_state, state, rng, feed, ls, qresid):
             self._trace_count += 1  # trace-time only: counts compilations
@@ -911,6 +966,14 @@ class Trainer:
                 feed = wire.decode(feed)
             if augment is not None:
                 feed = augment.apply(feed, rng, training=True)
+            pshards = None
+            if zspec is not None:
+                # top-of-step all-gather: fresh logical params from this
+                # step's shard rows (GSPMD materializes the gather at
+                # the replicated constraint); the rows stay bound for
+                # the shard-local update below
+                pshards = params
+                params = zero_mod.combine_params(pshards, zspec, self.mesh)
             def loss_and_aux(p, st, r, f):
                 loss, aux = self._loss_and_aux(p, st, r, f)
                 if scaler is not None:
@@ -967,6 +1030,17 @@ class Trainer:
             else:
                 (loss, (out, new_state)), grads = jax.value_and_grad(
                     loss_and_aux, has_aux=True)(params, state, rng, feed)
+
+            if zspec is not None:
+                # reduce-scatter: the row constraint keeps only this
+                # replica's slice of the exchanged grads; rebinding the
+                # shard rows makes everything below — unscale,
+                # all_finite, optimizer.update, overflow/guard rollback
+                # — shard-local over matching (N, k) trees (grad pads
+                # are exact zeros, so norms and finiteness agree with
+                # the logical grads)
+                grads = zero_mod.partition_grads(grads, zspec, self.mesh)
+                params = pshards
 
             if scaler is not None:
                 if quant_cfg is None:
@@ -1129,6 +1203,10 @@ class Trainer:
             self._multi_step_fn = jax.jit(run_k_steps, donate_argnums=kdonate)
 
         def eval_step(params, state, feed):
+            if zspec is not None:
+                # eval sees the same all-gathered logical params the
+                # train step computes with
+                params = zero_mod.combine_params(params, zspec, self.mesh)
             if wire is not None:
                 feed = wire.decode(feed)
             if augment is not None:

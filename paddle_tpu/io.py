@@ -17,6 +17,16 @@ gated, not implicit: ``load_trainer`` raises a structured
 ``resilience.ReshardError`` on a ``meta.mesh_axes`` mismatch, and
 ``resilience.reshard_restore`` is the explicit elastic door (static
 feasibility proof + bit-exact re-placement).
+
+Exception to "saved unsharded": ``DistStrategy(zero_sharding=True)``
+checkpoints are SHARD-AWARE — params and partitioned optimizer leaves
+live in per-shard ``*.zero{i}.npz`` files (one ``(k,)`` row each,
+written gather-free from each owning device), with the shard count +
+logical flat spec in ``meta.zero``. Same-N restore is shard-local; any
+layout change (N→M, ZeRO↔replicated) trips the same ``ReshardError``
+gate and goes through the elastic door, which gathers the rows back to
+logical on the host (``load_persistables`` does this transparently)
+and repartitions for the target.
 """
 
 from __future__ import annotations
@@ -200,9 +210,133 @@ def save_persistables(dirname: str, params: Dict[str, jax.Array],
     return spec
 
 
+def _zero_split_flat(tree: Any, n: int, partitioned) -> Tuple[List[Dict[str, np.ndarray]],
+                                                              Dict[str, np.ndarray]]:
+    """Split a ZeRO-partitioned scope tree into n per-shard flat dicts
+    (one host ``(k,)`` row each, read from ``addressable_shards`` — no
+    all-gather on the save path) plus one flat dict of the replicated
+    leaves. ``partitioned`` is the ZeroSpec's mangled-key set."""
+    shard_flats: List[Dict[str, np.ndarray]] = [dict() for _ in range(n)]
+    base: Dict[str, np.ndarray] = {}
+
+    def walk(t, pfx):
+        if isinstance(t, dict):
+            for k, v in t.items():
+                walk(v, f"{pfx}{SEP}{k}" if pfx else str(k))
+            return
+        if t is None:
+            return
+        key, _ = _mangle_key(pfx, np.dtype(t.dtype))
+        if key not in partitioned:
+            k2, val = _mangle_leaf(pfx, np.asarray(jax.device_get(t)))
+            base[k2] = val
+            return
+        rows: List[Optional[np.ndarray]] = [None] * n
+        for s in t.addressable_shards:
+            lo = int(s.index[0].start or 0)
+            data = np.asarray(s.data)
+            for j in range(data.shape[0]):
+                if rows[lo + j] is None:
+                    rows[lo + j] = data[j]
+        enforce(all(r is not None for r in rows),
+                f"save_trainer(zero_sharding): shard rows of {pfx!r} are "
+                "not all process-addressable — multi-host ZeRO saves need "
+                "every host to write its own shard files (not implemented)")
+        for i in range(n):
+            shard_flats[i][key] = _mangle_leaf(pfx, rows[i])[1]
+
+    walk(tree, "")
+    return shard_flats, base
+
+
+def _save_zero_persistables(dirname: str, trainer, params, state, opt_state,
+                            meta) -> Dict[str, Dict[str, Any]]:
+    """ZeRO variant of :func:`save_persistables`: partitioned leaves go
+    to per-shard files ``params.zero{i}.npz`` / ``opt_state.zero{i}.npz``
+    (each member one ``(k,)`` row, gather-free), replicated opt leaves
+    keep the base ``opt_state.npz``. ``meta.zero`` records the shard
+    count + the LOGICAL flat spec (the N→M gather's reassembly map and
+    the contract checker's currency); the returned spec covers the REAL
+    files for the manifest CRC pass."""
+    os.makedirs(dirname, exist_ok=True)
+    zero = trainer._zero
+    spec: Dict[str, Dict[str, Any]] = {}
+
+    def _write(name, flat):
+        np.savez(os.path.join(dirname, name), **flat)
+        spec[name] = {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                      for k, v in flat.items()}
+
+    pshards, pbase = _zero_split_flat(params, zero.n,
+                                      zero.partitioned["params.npz"])
+    enforce(not pbase, "zero_sharding partitions every param leaf")
+    for i, flat in enumerate(pshards):
+        _write(f"params.zero{i}.npz", flat)
+    if state is not None:
+        _write("state.npz", _flatten(jax.device_get(state)))
+    if opt_state is not None:
+        oshards, obase = _zero_split_flat(opt_state, zero.n,
+                                          zero.partitioned["opt_state.npz"])
+        _write("opt_state.npz", obase)
+        if oshards[0]:
+            for i, flat in enumerate(oshards):
+                _write(f"opt_state.zero{i}.npz", flat)
+    with open(os.path.join(dirname, "meta.json"), "w") as f:
+        json.dump(meta or {}, f)
+    return spec
+
+
+def _merge_nested(dst: Dict[str, Any], src: Dict[str, Any]) -> Dict[str, Any]:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _merge_nested(dst[k], v)
+        else:
+            dst[k] = v
+    return dst
+
+
+def _gather_zero_collection(dirname: str, stem: str,
+                            zero_meta: Dict[str, Any]) -> Dict[str, Any]:
+    """Concatenate a ZeRO checkpoint's per-shard ``(k,)`` rows back into
+    logical leaves — the host-side gather of the N→M elastic fallback
+    (``load_persistables`` calls this transparently, so every consumer
+    of the gathered path — drift checks, reshard placement, predictors —
+    sees the same logical trees a replicated checkpoint yields).
+    Returns ``{}`` when the collection has no partitioned leaves."""
+    n = int(zero_meta["shards"])
+    spec = (zero_meta.get("arrays") or {}).get(f"{stem}.npz") or {}
+    paths = [os.path.join(dirname, f"{stem}.zero{i}.npz") for i in range(n)]
+    if not any(os.path.exists(p) for p in paths):
+        return {}
+    missing = [os.path.basename(p) for p in paths if not os.path.exists(p)]
+    if missing:
+        raise FileNotFoundError(
+            f"ZeRO checkpoint is missing shard files {missing[:3]} "
+            f"({len(missing)} of {n})")
+    flat: Dict[str, np.ndarray] = {}
+    flats: List[Dict[str, np.ndarray]] = []
+    for p in paths:
+        with np.load(p, allow_pickle=False) as z:
+            flats.append({k: np.array(z[k]) for k in z.files})
+    for key in flats[0]:
+        ent = spec.get(key)
+        if ent is None:
+            raise KeyError(
+                f"{stem} shard member {key!r} is absent from the "
+                "checkpoint's meta.zero.arrays spec")
+        shape = tuple(ent["shape"])
+        size = int(np.prod(shape)) if shape else 1
+        flat[key] = np.concatenate(
+            [f[key] for f in flats])[:size].reshape(shape)
+    return _unflatten(flat)
+
+
 def load_persistables(dirname: str) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray],
                                              Optional[Dict[str, Any]], Dict[str, Any]]:
-    """Load (params, state, opt_state, meta) (load_persistables analog)."""
+    """Load (params, state, opt_state, meta) (load_persistables analog).
+    ZeRO checkpoints (``meta.zero``) are gathered to logical shapes on
+    the host — the explicit N→M fallback; the gather-free same-N path
+    lives in ``load_trainer``."""
 
     def _load(name):
         p = os.path.join(dirname, name)
@@ -217,19 +351,29 @@ def load_persistables(dirname: str) -> Tuple[Dict[str, np.ndarray], Dict[str, np
             # fault-injection suite pins this via resume continuity)
             return _unflatten({k: np.array(z[k]) for k in z.files})
 
-    params = _load("params.npz") or {}
-    state = _load("state.npz") or {}
-    opt_state = _load("opt_state.npz")
-    if opt_state is not None:
-        # empty sub-dicts ("global"/"accums" for stateless optimizers)
-        # flatten to nothing on save — restore the keys
-        opt_state.setdefault("global", {})
-        opt_state.setdefault("accums", {})
     meta_path = os.path.join(dirname, "meta.json")
     meta = {}
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
+    zero = meta.get("zero")
+    if zero:
+        params = _gather_zero_collection(dirname, "params", zero)
+        state = _load("state.npz") or {}
+        opt_state = _load("opt_state.npz")
+        opart = _gather_zero_collection(dirname, "opt_state", zero)
+        if opart:
+            opt_state = _merge_nested(opt_state if opt_state is not None
+                                      else {}, opart)
+    else:
+        params = _load("params.npz") or {}
+        state = _load("state.npz") or {}
+        opt_state = _load("opt_state.npz")
+    if opt_state is not None:
+        # empty sub-dicts ("global"/"accums" for stateless optimizers)
+        # flatten to nothing on save — restore the keys
+        opt_state.setdefault("global", {})
+        opt_state.setdefault("accums", {})
     return params, state, opt_state, meta
 
 
@@ -295,6 +439,14 @@ def save_trainer(dirname: str, trainer,
     # trip the same ReshardError gate — only checkpoints that predate
     # this key (no mesh_axes at all) pass ungated
     meta["mesh_axes"] = resilience.trainer_mesh_axes(trainer) or {}
+    # ZeRO checkpoints are shard-aware: meta.zero_axes gates the
+    # implicit restore path (same-N only), meta.zero carries the shard
+    # count + LOGICAL flat spec the N→M gather fallback reassembles by
+    zero = getattr(trainer, "_zero", None)
+    if zero is not None:
+        meta["zero_axes"] = dict(zero.axes_dict)
+        meta["zero"] = {"shards": zero.n, "axes": dict(zero.axes_dict),
+                        "arrays": zero.arrays}
     if extra_meta:
         meta.update(extra_meta)
     # checkpoints always store logical layer order: undo the trainer's
@@ -310,8 +462,12 @@ def save_trainer(dirname: str, trainer,
     # dir at startup with the unfiltered form)
     resilience.sweep_tmp_dirs(parent, tag=os.path.basename(path))
     tmp = f"{path}{resilience.TMP_MARKER}{os.getpid()}"
-    spec = save_persistables(tmp, params, trainer.scope.state,
-                             opt_state, meta=meta)
+    if zero is not None:
+        spec = _save_zero_persistables(tmp, trainer, params,
+                                       trainer.scope.state, opt_state, meta)
+    else:
+        spec = save_persistables(tmp, params, trainer.scope.state,
+                                 opt_state, meta=meta)
     resilience.crash_point("save_trainer:files-written")
     _fsync_tree(tmp)
     resilience.write_manifest(tmp, meta=meta, arrays=spec)
@@ -374,7 +530,42 @@ def load_trainer(dirname: str, trainer, allow_reshard: bool = False) -> None:
                 "resilience.reshard_restore(checkpoint_dir, trainer) or "
                 "fit(resume=True, elastic=True) (or load_trainer("
                 "allow_reshard=True) to skip the feasibility check)")
+        # ZeRO gate: a shard-aware checkpoint restores implicitly only
+        # at the same shard layout. A zero<->replicated flip or a
+        # shard-count change (the static ckpt:zero-mismatch finding's
+        # runtime counterpart) goes through the explicit elastic door,
+        # which gathers the shards to logical and repartitions.
+        if meta_man is not None:
+            saved_zero = ((meta_man.get("meta") or {}).get("zero_axes")
+                          or {})
+            tz = getattr(trainer, "_zero", None)
+            target_zero = dict(tz.axes_dict) if tz is not None else {}
+            if resilience.normalize_mesh_axes(saved_zero) != \
+                    resilience.normalize_mesh_axes(target_zero):
+                raise resilience.ReshardError(
+                    dirname, saved_axes, target_axes,
+                    f"checkpoint zero_sharding axes "
+                    f"{saved_zero or None} differ from the target "
+                    f"trainer's {target_zero or None} — restoring across "
+                    "a ZeRO shard-layout change is an elastic reshard "
+                    "(gather-then-repartition); use "
+                    "resilience.reshard_restore(checkpoint_dir, trainer) "
+                    "or fit(resume=True, elastic=True) (or load_trainer("
+                    "allow_reshard=True) to skip the feasibility check)")
     manifest = resilience.validate_checkpoint(dirname)  # None for legacy
+    zero_meta = ((manifest or {}).get("meta") or {}).get("zero")
+    tz = getattr(trainer, "_zero", None)
+    if (tz is not None and zero_meta
+            and resilience.normalize_mesh_axes(zero_meta.get("axes") or {})
+            == resilience.normalize_mesh_axes(tz.axes_dict)
+            and resilience.normalize_mesh_axes(
+                ((manifest or {}).get("meta") or {}).get("mesh_axes") or {})
+            == resilience.normalize_mesh_axes(
+                resilience.trainer_mesh_axes(trainer) or {})):
+        # same-N same-mesh ZeRO→ZeRO: shard-local restore, no gather on
+        # the hot path (each device adopts its own rows)
+        _load_trainer_zero_local(dirname, trainer, manifest)
+        return
     try:
         params, state, opt_state, meta = load_persistables(dirname)
     except Exception as e:
@@ -384,7 +575,12 @@ def load_trainer(dirname: str, trainer, allow_reshard: bool = False) -> None:
         raise resilience.CheckpointCorrupt(
             dirname, "no parameters found (params.npz missing or empty)")
     if manifest:
-        _check_arrays_spec(manifest, dirname, params=params, state=state,
+        # a ZeRO manifest's "arrays" spec covers the per-shard files;
+        # the gathered trees compare against the LOGICAL spec in
+        # meta.zero.arrays instead
+        man_arr = (dict(manifest, arrays=zero_meta.get("arrays") or {})
+                   if zero_meta else manifest)
+        _check_arrays_spec(man_arr, dirname, params=params, state=state,
                            opt_state=opt_state)
     _check_trainer_param_drift(dirname, trainer, params)
     if opt_state is not None:
@@ -396,7 +592,18 @@ def load_trainer(dirname: str, trainer, allow_reshard: bool = False) -> None:
     # interleaved pipeline layout re-permutes on the way in (no-op
     # otherwise)
     params, opt_state = trainer.stacked_from_logical(params, opt_state)
-    if trainer.mesh is not None:
+    if tz is not None:
+        # repartition the gathered logical trees into this trainer's
+        # (N, k) rows — the second half of the N→M elastic fallback
+        from jax.sharding import NamedSharding, PartitionSpec
+        from .parallel import zero as zero_mod
+        params = zero_mod.partition_params(params, tz, trainer.mesh)
+        opt_state = (zero_mod.partition_opt_state(opt_state, tz,
+                                                  trainer.mesh)
+                     if opt_state is not None else None)
+        state = jax.device_put(
+            state, NamedSharding(trainer.mesh, PartitionSpec()))
+    elif trainer.mesh is not None:
         from .parallel import api as par_api
         params, state, opt_state = par_api.shard_scope(
             trainer.mesh, trainer.sharding_rules, params, state, opt_state)
@@ -413,6 +620,104 @@ def load_trainer(dirname: str, trainer, allow_reshard: bool = False) -> None:
     trainer.global_step = int(meta.get("global_step", 0))
     # kept for fit(resume=True): epoch/epoch_step and anything else the
     # saver stored ride here (resilience.restore_latest reads it)
+    trainer._last_loaded_meta = dict(meta)
+    _restore_loss_scale(trainer, meta, dirname)
+
+
+def _load_trainer_zero_local(dirname: str, trainer, manifest) -> None:
+    """Same-N, same-mesh restore of a ZeRO checkpoint: every device
+    adopts its own ``(k,)`` rows straight from the per-shard files via
+    ``jax.make_array_from_callback`` — no gather on the restore path,
+    mirroring the gather-free save. The CRC pass already ran
+    (``validate_checkpoint``); this adds the logical-spec drift gate
+    (same contract as :func:`_check_trainer_param_drift`)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from . import resilience
+    from .parallel import zero as zero_mod
+
+    zero = trainer._zero
+    meta = (manifest.get("meta") or {})
+    zm = meta.get("zero") or {}
+    n = int(zm.get("shards") or zero.n)
+    saved = (zm.get("arrays") or {}).get("params.npz") or {}
+    want = zero.arrays["params.npz"]
+    if {k: (tuple(v["shape"]), str(v["dtype"])) for k, v in saved.items()} \
+            != {k: (tuple(v["shape"]), str(v["dtype"]))
+                for k, v in want.items()}:
+        missing = sorted(set(want) - set(saved))[:3]
+        extra = sorted(set(saved) - set(want))[:3]
+        raise resilience.CheckpointCorrupt(
+            dirname, f"ZeRO checkpoint params diverge from the trainer's "
+            f"logical spec (missing: {missing}, unexpected: {extra}) — "
+            "the model config drifted since this checkpoint was written")
+
+    def shard_trees(stem):
+        paths = [os.path.join(dirname, f"{stem}.zero{i}.npz")
+                 for i in range(n)]
+        if not any(os.path.exists(p) for p in paths):
+            return None
+        out = []
+        for p in paths:
+            try:
+                with np.load(p, allow_pickle=False) as z:
+                    out.append(_unflatten({k: np.array(z[k])
+                                           for k in z.files}))
+            except Exception as e:
+                raise resilience.CheckpointCorrupt(
+                    dirname, f"unreadable shard file "
+                    f"{os.path.basename(p)}: {type(e).__name__}: {e}") from e
+        return out
+
+    ns = zero_mod.shard_sharding(trainer.mesh, zero.axes)
+    repl = NamedSharding(trainer.mesh, PartitionSpec())
+
+    def rows_to_array(*rows):
+        rows = [np.asarray(r) for r in rows]
+
+        def cb(index):
+            lo = int(index[0].start or 0)
+            hi = index[0].stop
+            hi = n if hi is None else int(hi)
+            return np.stack(rows[lo:hi])
+
+        return jax.make_array_from_callback((n,) + rows[0].shape, ns, cb)
+
+    ptrees = shard_trees("params")
+    if ptrees is None:
+        raise resilience.CheckpointCorrupt(
+            dirname, "ZeRO checkpoint has no params.zero*.npz shard files")
+    params = jax.tree.map(rows_to_array, *ptrees)
+
+    def _load_flat(name):
+        p = os.path.join(dirname, name)
+        if not os.path.exists(p):
+            return None
+        try:
+            with np.load(p, allow_pickle=False) as z:
+                return _unflatten({k: np.array(z[k]) for k in z.files})
+        except Exception as e:
+            raise resilience.CheckpointCorrupt(
+                dirname, f"unreadable collection {name}: "
+                f"{type(e).__name__}: {e}") from e
+
+    state = jax.device_put(_load_flat("state.npz") or {}, repl)
+    opt_state = _load_flat("opt_state.npz")
+    otrees = shard_trees("opt_state")
+    if opt_state is not None or otrees is not None:
+        opt_state = jax.device_put(opt_state or {}, repl)
+        if otrees is not None:
+            _merge_nested(opt_state, jax.tree.map(rows_to_array, *otrees))
+        opt_state.setdefault("global", {})
+        opt_state.setdefault("accums", {})
+        for k in zero.shapes:
+            opt_state["accums"].setdefault(k, {})
+        if "step" in opt_state:
+            opt_state["step"] = jax.device_put(
+                jnp.asarray(opt_state["step"], jnp.int32), repl)
+    trainer.scope.params, trainer.scope.state, trainer.scope.opt_state = \
+        params, state, opt_state
+    trainer.global_step = int(meta.get("global_step", 0))
     trainer._last_loaded_meta = dict(meta)
     _restore_loss_scale(trainer, meta, dirname)
 
@@ -437,8 +742,13 @@ def _check_trainer_param_drift(dirname: str, trainer, params) -> None:
         return
     # the trainer may hold the interleaved-pipeline row layout; that is
     # a row PERMUTATION of the logical layout — shapes/dtypes/names are
-    # identical, so the spec comparison is layout-agnostic
-    want, got = flat_spec(have), flat_spec(params)
+    # identical, so the spec comparison is layout-agnostic. A ZeRO
+    # trainer's scope holds (N, k) rows; its LOGICAL spec was recorded
+    # in the ZeroSpec at startup.
+    tz = getattr(trainer, "_zero", None)
+    want = (dict(tz.arrays["params.npz"]) if tz is not None
+            else flat_spec(have))
+    got = flat_spec(params)
     if set(want) != set(got):
         missing = sorted(set(want) - set(got))[:3]
         extra = sorted(set(got) - set(want))[:3]

@@ -102,6 +102,20 @@ class DistStrategy:
     # initial quantization and reduce-scatter hops only — all-gather
     # hops stay deterministic, preserving cross-rank bitwise identity.
     quant_stochastic_rounding: bool = False
+    # ZeRO-style cross-replica sharded weight update ("Automatic
+    # Cross-Replica Sharding of Weight Update in Data-Parallel
+    # Training", PAPERS.md): each data-parallel replica owns a 1/N
+    # flat shard of params + optimizer state, applies the optimizer
+    # update to its shard only, and fresh params are all-gathered at
+    # the top of every (fused-scan) step — optimizer HBM drops ~N×.
+    # Same preconditions as accum_exchange="hoisted": a mesh with data
+    # axes, fully replicated params (no fsdp/tp/pp/sp), stateless
+    # models. Composes with accum_exchange, quantized_allreduce,
+    # dynamic loss scaling, and remat; checkpoints become shard-aware
+    # (per-shard manifest entries, meta.zero_axes) with an explicit
+    # gather-then-repartition elastic door for N→M restores. False
+    # keeps today's replicated update bit-identically.
+    zero_sharding: bool = False
     # async parameter-server mode (listen_and_serv RunAsyncLoop analog):
     # barrier-free grad push / param pull through the C++ pserver
     # (parallel.async_ps) instead of SPMD collectives. Set by

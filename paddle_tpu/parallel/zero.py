@@ -1,0 +1,215 @@
+"""ZeRO-style cross-replica sharded weight update.
+
+The weight-update sharding of "Automatic Cross-Replica Sharding of
+Weight Update in Data-Parallel Training" (PAPERS.md, 2004.13336),
+expressed GSPMD-style: every param / float optimizer accumulator is
+flattened, zero-padded to a multiple of the data-shard count N, and
+reshaped to ``(N, k)`` with the leading axis sharded over the data mesh
+axes — each replica owns one ``(1, k)`` row. The optimizer update runs
+on the shard rows only (optimizer HBM drops ~N×); the step all-gathers
+fresh params back to logical shape at its top (``combine_params`` under
+a replicated sharding constraint → one all-gather per param per step,
+amortized across the fused K-step scan), and partitions the freshly
+reduced gradients down to rows right before the update
+(``partition_grads`` under the row constraint → GSPMD keeps only this
+replica's slice of the all-reduced grad, i.e. a reduce-scatter).
+
+Padding discipline: pad elements start at 0 and STAY 0 — gradients of
+pads are 0 (they never touch the loss), every built-in optimizer maps
+(p=0, g=0, acc=0) → 0, and weight decay multiplies 0. Global-norm
+quantities (grad clipping, LARS trust ratios) are therefore unaffected
+by pads; elementwise updates are bit-exact vs. the replicated update,
+norm-coupled ones agree to float tolerance (reduction order changes).
+
+The flat ``(N, k)`` layout (not per-dim sharding) is what makes the
+checkpoint story tractable: a shard file holds one ``(k,)`` row per
+leaf, and the N→M elastic restore is a concat + re-pad
+(``io.load_persistables`` gathers transparently; the general
+redistribution primitive is the ROADMAP ``parallel.redistribute``
+follow-up, 2112.01075).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, FrozenSet, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..io import SEP, flat_spec
+
+PARAMS_NPZ = "params.npz"
+OPT_NPZ = "opt_state.npz"
+STATE_NPZ = "state.npz"
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroSpec:
+    """Static description of one trainer's ZeRO partitioning: the data
+    axes and shard count, the LOGICAL flat shape/dtype spec per
+    checkpoint collection (what a non-ZeRO trainer of the same model
+    would save — the currency of the ``analysis.contracts`` checks and
+    of ``meta.zero.arrays``), the set of flat npz keys that are
+    partitioned (everything else in opt_state stays replicated), and
+    per-param logical shapes/dtypes for in-step combine."""
+
+    axes: Tuple[str, ...]
+    axes_dict: Dict[str, int]
+    n: int
+    arrays: Dict[str, Dict[str, Dict[str, Any]]]
+    partitioned: Dict[str, FrozenSet[str]]
+    shapes: Dict[str, Tuple[int, ...]]
+    dtypes: Dict[str, Any]
+
+
+def shard_pspec(axes: Tuple[str, ...]) -> P:
+    """Row-sharded PartitionSpec for the ``(N, k)`` layout."""
+    return P(axes if len(axes) > 1 else axes[0], None)
+
+
+def shard_sharding(mesh: Mesh, axes: Tuple[str, ...]) -> NamedSharding:
+    return NamedSharding(mesh, shard_pspec(axes))
+
+
+def row_size(shape, n: int) -> int:
+    """k: padded per-shard row length for a logical ``shape`` at N shards."""
+    size = int(np.prod(shape)) if len(shape) else 1
+    return -(-size // n)
+
+
+def partition_leaf(x, n: int):
+    """logical leaf -> (N, k) rows, zero-padded. Traceable (used inside
+    the step for gradients) and eager-safe (used at startup)."""
+    size = int(np.prod(x.shape)) if x.ndim else 1
+    k = -(-size // n)
+    flat = jnp.ravel(x)
+    if n * k != size:
+        flat = jnp.pad(flat, (0, n * k - size))
+    return flat.reshape(n, k)
+
+
+def combine_leaf(x2, shape):
+    """(N, k) rows -> logical leaf (drop padding)."""
+    size = int(np.prod(shape)) if len(shape) else 1
+    return x2.reshape(-1)[:size].reshape(tuple(shape))
+
+
+def _opt_partitioned_keys(opt_arrays: Dict[str, Dict[str, Any]],
+                          shapes: Dict[str, Tuple[int, ...]]) -> FrozenSet[str]:
+    """Flat opt_state npz keys that shard: accum leaves whose logical
+    shape equals their param's — mirroring ``parallel.api.shard_scope``'s
+    accums-inherit-the-param-spec rule. ``step``/``global`` scalars and
+    any non-param-shaped accum stay replicated."""
+    out = set()
+    for key, ent in opt_arrays.items():
+        parts = key.split(SEP)
+        if len(parts) >= 3 and parts[0] == "accums":
+            shape = shapes.get(parts[1])
+            if shape is not None and tuple(ent["shape"]) == shape:
+                out.add(key)
+    return frozenset(out)
+
+
+def make_spec(mesh: Mesh, axes: Tuple[str, ...], params: Dict[str, Any],
+              state: Any, opt_state: Any) -> ZeroSpec:
+    """Build the ZeroSpec from LOGICAL (pre-partition) scope trees."""
+    axes = tuple(axes)
+    axes_dict = {a: int(mesh.shape[a]) for a in axes}
+    n = int(np.prod(list(axes_dict.values())))
+    shapes = {name: tuple(leaf.shape) for name, leaf in params.items()}
+    dtypes = {name: jnp.dtype(leaf.dtype) for name, leaf in params.items()}
+    arrays = {PARAMS_NPZ: flat_spec(params), STATE_NPZ: flat_spec(state or {}),
+              OPT_NPZ: flat_spec(opt_state) if opt_state is not None else {}}
+    partitioned = {
+        PARAMS_NPZ: frozenset(arrays[PARAMS_NPZ]),
+        STATE_NPZ: frozenset(),
+        OPT_NPZ: _opt_partitioned_keys(arrays[OPT_NPZ], shapes),
+    }
+    return ZeroSpec(axes=axes, axes_dict=axes_dict, n=n, arrays=arrays,
+                    partitioned=partitioned, shapes=shapes, dtypes=dtypes)
+
+
+# -- eager placement (Trainer.startup / checkpoint restore) ------------------
+
+
+def partition_params(params: Dict[str, Any], spec: ZeroSpec,
+                     mesh: Mesh) -> Dict[str, Any]:
+    ns = shard_sharding(mesh, spec.axes)
+    return {name: jax.device_put(partition_leaf(jnp.asarray(leaf), spec.n), ns)
+            for name, leaf in params.items()}
+
+
+def partition_opt_state(opt_state: Any, spec: ZeroSpec, mesh: Mesh) -> Any:
+    """Partition the param-shaped accum leaves; re-place everything else
+    replicated. Walks ``accums`` at arbitrary depth below the param name
+    (built-in optimizers keep one slot level)."""
+    if opt_state is None:
+        return None
+    ns = shard_sharding(mesh, spec.axes)
+    repl = NamedSharding(mesh, P())
+
+    def walk(tree, shape):
+        if isinstance(tree, dict):
+            return {k: walk(v, shape) for k, v in tree.items()}
+        if tree is None:
+            return None
+        if shape is not None and tuple(tree.shape) == shape:
+            return jax.device_put(partition_leaf(jnp.asarray(tree), spec.n), ns)
+        return jax.device_put(tree, repl)
+
+    out = {}
+    for key, sub in opt_state.items():
+        if key == "accums" and isinstance(sub, dict):
+            out[key] = {pname: walk(acc, spec.shapes.get(pname))
+                        for pname, acc in sub.items()}
+        else:
+            out[key] = walk(sub, None)
+    return out
+
+
+# -- traced combine/partition (inside the jitted step) -----------------------
+
+
+def combine_params(pshards: Dict[str, Any], spec: ZeroSpec,
+                   mesh: Mesh = None) -> Dict[str, Any]:
+    """Shard rows -> logical params. Under jit the replicated constraint
+    makes GSPMD materialize the all-gather here — the top-of-step
+    "fresh params" gather of the paper."""
+    repl = NamedSharding(mesh, P()) if mesh is not None else None
+    out = {}
+    for name, leaf in pshards.items():
+        full = combine_leaf(leaf, spec.shapes[name])
+        if repl is not None:
+            full = jax.lax.with_sharding_constraint(full, repl)
+        out[name] = full
+    return out
+
+
+def partition_grads(grads: Dict[str, Any], spec: ZeroSpec,
+                    mesh: Mesh = None) -> Dict[str, Any]:
+    """Logical (all-reduced) grads -> shard rows. The row constraint
+    tells GSPMD each replica only needs its own slice, so the grad
+    exchange + slice fuses into a reduce-scatter-shaped program."""
+    ns = shard_sharding(mesh, spec.axes) if mesh is not None else None
+    out = {}
+    for name, g in grads.items():
+        g2 = partition_leaf(g, spec.n)
+        if ns is not None:
+            g2 = jax.lax.with_sharding_constraint(g2, ns)
+        out[name] = g2
+    return out
+
+
+def allgather_bytes_per_step(spec: ZeroSpec) -> int:
+    """Per-device wire bytes the top-of-step param all-gather moves:
+    ring all-gather sends (N-1) row-sized hops per leaf per data axis."""
+    total = 0
+    for name, shape in spec.shapes.items():
+        k = row_size(shape, spec.n)
+        itemsize = jnp.dtype(spec.dtypes[name]).itemsize
+        for size in spec.axes_dict.values():
+            total += (size - 1) * k * itemsize
+    return int(total)

@@ -105,8 +105,46 @@ def _leaf_bytes(v) -> int:
 
 def _scope_bytes_per_device(trainer) -> Dict[str, float]:
     """Per-device param + optimizer-state bytes from the live scope,
-    spec-aware under sharding rules."""
+    spec-aware under sharding rules. Under ZeRO weight-update sharding
+    the live leaves ARE the realized per-device placement (1/N shard
+    rows), so both param and opt-state bytes come straight from each
+    leaf's ``shard_shape`` — that is the N× optimizer-HBM dividend the
+    strategy buys — while the logical figures come from the spec
+    recorded at startup."""
     import jax
+
+    tz = getattr(trainer, "_zero", None)
+    if tz is not None:
+        def _realized(tree):
+            total = 0
+            for v in jax.tree.leaves(tree or {}):
+                shape = getattr(v, "shape", None)
+                dtype = getattr(v, "dtype", None)
+                if shape is None or dtype is None:
+                    continue
+                sh = getattr(v, "sharding", None)
+                local = (sh.shard_shape(tuple(shape))
+                         if sh is not None and shape else tuple(shape))
+                try:
+                    total += (int(np.prod(local or (1,)))
+                              * np.dtype(dtype).itemsize)
+                except TypeError:
+                    continue
+            return total
+
+        def _logical(spec):
+            return sum(int(np.prod(e["shape"] or [1]))
+                       * np.dtype(e["dtype"]).itemsize
+                       for e in spec.values())
+
+        return {
+            "param_bytes": int(_realized(trainer.scope.params)),
+            "param_bytes_logical": int(_logical(tz.arrays["params.npz"])),
+            "opt_state_bytes": int(_realized(trainer.scope.opt_state or {})),
+            "opt_state_bytes_logical": int(
+                _logical(tz.arrays.get("opt_state.npz") or {})),
+            "zero_shards": int(tz.n),
+        }
 
     mesh, rules = trainer.mesh, trainer.sharding_rules
     param_b = param_logical = 0
@@ -146,9 +184,14 @@ def _activation_sum_bytes(trainer, feed) -> int:
         # LOGICAL dtype, the way Trainer.startup initializes the model
         feed = fw.logical_feed(feed)
     cfeed = _concrete_feed(feed)
+    # under ZeRO the scope holds (1/N, k) shard rows — the loss must
+    # trace against the logical (combined) params
+    params = (trainer._logical_params()
+              if hasattr(trainer, "_logical_params")
+              else trainer.scope.params)
     closed = jax.make_jaxpr(
         lambda p, s, r, f: trainer._loss_and_aux(p, s, r, f)[0])(
-            trainer.scope.params, trainer.scope.state,
+            params, trainer.scope.state,
             jax.random.PRNGKey(0), cfeed)
 
     total = [0]

@@ -233,6 +233,24 @@ def validate_checkpoint(dirname: str) -> Optional[Dict[str, Any]]:
             raise CheckpointCorrupt(
                 dirname, f"{name!r} checksum mismatch: crc32 {crc:#010x} "
                 f"on disk vs {spec.get('crc32'):#010x} in manifest")
+    if ((man.get("meta") or {}).get("zero")):
+        # shard-aware checkpoints are all-or-nothing: a shard file on
+        # disk that the manifest does not cover is a leftover from a
+        # DIFFERENT checkpoint generation (partial overwrite, manual
+        # copy) — loading it would stitch a Frankenstein mix of two
+        # saves, so the whole directory is treated as corrupt and the
+        # restore scanner falls back to the previous checkpoint as a
+        # unit (torn shards are already caught by the CRC pass above)
+        covered = set(man.get("files") or {})
+        stray = sorted(name for name in os.listdir(dirname)
+                       if ".zero" in name and name.endswith(".npz")
+                       and os.path.isfile(os.path.join(dirname, name))
+                       and name not in covered)
+        if stray:
+            raise CheckpointCorrupt(
+                dirname, f"shard files {stray[:3]} on disk are not in the "
+                "manifest — a mix of two checkpoint generations; refusing "
+                "to restore any of it")
     return man
 
 

@@ -28,9 +28,9 @@ def test_priority_order_leads_with_baseline_configs():
     # every registered config appears exactly once
     expect = (set(bench.TRAIN_CONFIGS) | set(bench.INFER_CONFIGS)
               | {"gpt_decode", "dispatch_overhead", "guard_overhead",
-                 "quantized_allreduce", "input_pipeline", "device_cache",
-                 "serving", "serving_fleet", "fusion_profile",
-                 "elastic_reshard"})
+                 "quantized_allreduce", "zero_sharding", "input_pipeline",
+                 "device_cache", "serving", "serving_fleet",
+                 "fusion_profile", "elastic_reshard"})
     assert set(names) == expect and len(names) == len(expect)
 
 
@@ -94,6 +94,15 @@ def test_quantized_allreduce_quick_overrides(monkeypatch):
     bench._run_one("quantized_allreduce", 1.0, quick=True)
     assert seen == {"iters": 8, "k": 4}
     assert bench._result_key("quantized_allreduce") == "quantized_allreduce"
+
+
+def test_zero_sharding_quick_overrides(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(bench, "bench_zero_sharding",
+                        lambda peak, **kw: seen.update(kw) or {"v": 1})
+    bench._run_one("zero_sharding", 1.0, quick=True)
+    assert seen == {"iters": 8, "k": 4}
+    assert bench._result_key("zero_sharding") == "zero_sharding"
 
 
 def test_input_pipeline_quick_overrides(monkeypatch):
