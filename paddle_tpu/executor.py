@@ -1743,7 +1743,7 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
         checkpoint_config: Optional[CheckpointConfig] = None,
         prefetch: bool = True, steps_per_dispatch: int = 1,
         resume: bool = False, elastic: bool = False,
-        preemption: Optional[bool] = None,
+        preemption: Optional[bool] = None, resize=None,
         feed_wire=None, profile_interval_steps: int = 0,
         device_cache=None, augment=None):
     """High-level train loop (contrib.trainer.Trainer.train analog):
@@ -1831,6 +1831,17 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
       checkpoint at the next chunk boundary: fit saves
       ``step_<global_step>``, drains async orbax saves, fires a
       ``"preempted"`` event, and returns cleanly.
+    - ``resize=`` (a path or a ``resilience.ResizeRequest``) is the
+      SCHEDULED elastic grow/shrink — the autoscaler's trainer-side
+      analog. When the resize-request file appears (or its optional
+      signal arrives), fit exits at the same chunk boundary with the
+      same boundary checkpoint, but journals ``fit.resized`` (with the
+      request's advisory target) and fires a ``"resized"`` event
+      instead: the launcher reads the event, ``consume()``s the
+      request, and relaunches at the new worker count with
+      ``fit(elastic=True, resume=True)`` — the mesh change rides the
+      reshard-restore path above. A concurrent SIGTERM wins: a real
+      preemption must never be reported as a planned resize.
     """
     import os
 
@@ -1846,7 +1857,7 @@ def fit(trainer: "Trainer", reader, num_epochs: int, feed_names: Sequence[str],
                          event_handler, checkpoint_config, prefetch,
                          steps_per_dispatch, resume, elastic, preemption,
                          feed_wire, profile_interval_steps, device_cache,
-                         augment)
+                         augment, resize)
     except resilience.InjectedCrash:
         raise  # models abrupt process death: a real kill -9 dumps nothing
     except FloatingPointError:
@@ -1868,7 +1879,7 @@ def _fit_impl(trainer, reader, num_epochs, feed_names, dtypes,
               event_handler, checkpoint_config, prefetch,
               steps_per_dispatch, resume, elastic, preemption,
               feed_wire, profile_interval_steps, device_cache=None,
-              augment=None):
+              augment=None, resize=None):
     import contextlib as _contextlib
     import os
     import shutil
@@ -1977,8 +1988,13 @@ def _fit_impl(trainer, reader, num_epochs, feed_names, dtypes,
                    else checkpoint_config is not None)
     preempt_ctx = (resilience.PreemptionHandler() if use_preempt
                    else _contextlib.nullcontext())
+    # scheduled elastic resize: a path becomes a ResizeRequest; an
+    # existing handler (caller already holds the signal) is used as-is
+    resize_ctx = (resilience.ResizeRequest(resize)
+                  if isinstance(resize, (str, os.PathLike)) else resize)
     si = checkpoint_config.step_interval if checkpoint_config else 0
-    with preempt_ctx as ph:
+    with preempt_ctx as ph, (resize_ctx if resize_ctx is not None
+                             else _contextlib.nullcontext()) as rz:
         for epoch in range(start_epoch, num_epochs):
             # resume lands mid-epoch: fast-forward past the batches the
             # restored checkpoint already consumed (1 batch == 1 step)
@@ -2062,6 +2078,7 @@ def _fit_impl(trainer, reader, num_epochs, feed_names, dtypes,
                     yield n, feed, span, True
 
             preempted = False
+            resized = False
             try:
                 for n, feed, span, streamed in epoch_items():
                     if admitting and streamed:
@@ -2100,6 +2117,10 @@ def _fit_impl(trainer, reader, num_epochs, feed_names, dtypes,
                              steps_in_epoch)
                     if ph is not None and ph.requested:
                         preempted = True
+                        break
+                    if rz is not None and rz.requested:
+                        preempted = True
+                        resized = True
                         break
             finally:
                 # consumer abandoned mid-epoch (exception/early exit): the
@@ -2141,22 +2162,39 @@ def _fit_impl(trainer, reader, num_epochs, feed_names, dtypes,
                 # boundary save so the dump's ring contains the
                 # ckpt.save event (and any guard incidents drained
                 # above) — the black box explains the exit
+                if ph is not None and ph.requested:
+                    # a SIGTERM that landed after the resize poll wins:
+                    # a real preemption is never reported as planned
+                    resized = False
                 signum = getattr(ph, "signum", None)
-                trainer.journal.emit("fit.preempted", epoch=epoch,
-                                     global_step=trainer.global_step,
-                                     signum=signum)
-                get_registry().counter(
-                    "paddle_tpu_trainer_preemptions_total",
-                    "SIGTERM/SIGINT preemptions handled by fit").inc()
-                flight_dump("preempted",
-                            detail={"global_step": trainer.global_step,
-                                    "epoch": epoch, "signum": signum})
+                if resized:
+                    target = rz.target if rz is not None else {}
+                    trainer.journal.emit("fit.resized", epoch=epoch,
+                                         global_step=trainer.global_step,
+                                         target=target)
+                    get_registry().counter(
+                        "paddle_tpu_trainer_resizes_total",
+                        "Scheduled elastic resizes handled by fit").inc()
+                    flight_dump("resized",
+                                detail={"global_step": trainer.global_step,
+                                        "epoch": epoch, "target": target})
+                else:
+                    trainer.journal.emit("fit.preempted", epoch=epoch,
+                                         global_step=trainer.global_step,
+                                         signum=signum)
+                    get_registry().counter(
+                        "paddle_tpu_trainer_preemptions_total",
+                        "SIGTERM/SIGINT preemptions handled by fit").inc()
+                    flight_dump("preempted",
+                                detail={"global_step": trainer.global_step,
+                                        "epoch": epoch, "signum": signum})
                 if event_handler:
                     # ONE profile snapshot: Event.pipeline aliases its
                     # pipeline section, so handlers comparing the two
                     # never see the fill thread advance between them
                     profile = trainer.profile_report()
-                    event_handler(Event("preempted", epoch,
+                    event_handler(Event("resized" if resized
+                                        else "preempted", epoch,
                                         trainer.global_step,
                                         pipeline=profile["pipeline"],
                                         profile=profile))

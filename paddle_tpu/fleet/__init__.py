@@ -29,10 +29,17 @@ every request alone. This package is the next tier:
   SIGKILL and TCP partitions; probe-latency demotion degrades
   slow-but-alive replicas gracefully).
 
+- :mod:`autoscaler` — the **closed loop**: a control thread watching
+  the telemetry collector's ``/query`` trends and ``/alerts``
+  transitions and sizing the fleet within a band via
+  ``FleetRouter.grow()`` / ``retire(drain=True)`` — pure decision core
+  (:class:`AutoscalePolicy`: hysteresis, per-direction cooldowns,
+  anti-flap, quorum floor, fail-static on stale data).
+
 Drills: ``tools/fleet_drill.py`` (kill/hang/reload over a local
-in-process fleet, pkill/partition over a process fleet, exit 0/2).
-See MIGRATION.md "Serving fleet & continuous batching" and
-"Cross-process fleet".
+in-process fleet, pkill/partition over a process fleet, a diurnal
+autoscale replay, exit 0/2). See MIGRATION.md "Serving fleet &
+continuous batching", "Cross-process fleet", and "Autoscaler".
 """
 
 from .batching import BatchPolicy
@@ -41,6 +48,9 @@ _ROUTER_NAMES = ("FleetRouter", "FleetPending", "NoReplicaAvailable")
 _DECODE_NAMES = ("export_decoder", "decode_server")
 _REMOTE_NAMES = ("RemoteReplica", "RemotePending", "ReplicaProcess",
                  "spawn_replica", "spawn_fleet")
+_AUTOSCALER_NAMES = ("Autoscaler", "AutoscalePolicy", "HttpCollectorReader",
+                     "LocalCollectorReader", "ScaleDecision", "ScaleSignals",
+                     "complete_buckets")
 
 
 def __getattr__(name):
@@ -56,7 +66,11 @@ def __getattr__(name):
     if name in _REMOTE_NAMES:
         from . import remote
         return getattr(remote, name)
+    if name in _AUTOSCALER_NAMES:
+        from . import autoscaler
+        return getattr(autoscaler, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
-__all__ = ["BatchPolicy", *_ROUTER_NAMES, *_DECODE_NAMES, *_REMOTE_NAMES]
+__all__ = ["BatchPolicy", *_ROUTER_NAMES, *_DECODE_NAMES, *_REMOTE_NAMES,
+           *_AUTOSCALER_NAMES]

@@ -14,7 +14,7 @@ Wire verbs (client → agent)::
 
     SPAWN <len> + json   {"dirname", "name", "server_kw"} → replica addr/pid
     STOP  <len> + json   {"pid"} → SIGKILL + reap (idempotent)
-    PS                   → every child ever spawned, with liveness
+    PS                   → spawned children (bounded history), liveness
     FETCH / ARTIFACT     the artifact door (same protocol as a replica)
     QUIT
 
@@ -23,7 +23,10 @@ died stays in the table marked dead. That makes the agent a waitpid
 oracle for :meth:`~paddle_tpu.fleet.remote.RemoteReplica.
 _provably_dead` across proxied links — "tracked and exited" or "no
 longer tracked" is proof of death where "connect refused" can no
-longer be.
+longer be. The dead-entry history is bounded (``--max-dead``, default
+256): the oldest dead children are evicted first and live pids are
+never evicted, and since "no longer tracked" already reads as
+dead, eviction preserves the oracle's verdicts.
 
 Prints ``PORT <n>`` on stdout once the listener is up (the
 ``AgentProcess.wait_ready`` handshake, same as a replica's).
@@ -71,7 +74,7 @@ class AgentService:
     artifact cache."""
 
     def __init__(self, root: str, child_bind: Optional[str] = None,
-                 advertise: str = "127.0.0.1"):
+                 advertise: str = "127.0.0.1", max_dead: int = 256):
         from .remote import ArtifactStore
 
         self.root = os.path.abspath(root)
@@ -80,11 +83,27 @@ class AgentService:
         self._child_bind = child_bind
         self._advertise = advertise
         self._lock = threading.Lock()
-        # pid -> {"name", "proc", "addr"}; entries are NEVER removed —
-        # PS reporting a spawned pid as dead (or not at all) is the
-        # death proof remote._provably_dead builds on
+        # pid -> {"name", "proc", "addr"}, insertion-ordered (= spawn
+        # order). PS is a death oracle, not a process list: a dead
+        # child STAYS in the table, but the dead-entry history is
+        # BOUNDED — once more than ``max_dead`` dead children
+        # accumulate, the oldest dead ones are evicted (live pids are
+        # never evicted). Eviction is oracle-compatible: remote.
+        # _provably_dead reads "no longer tracked" as reaped-therefore-
+        # dead, which is exactly what an evicted entry was — so an
+        # autoscaling host churning replicas for weeks holds a bounded
+        # table without weakening the at-most-once death proof.
         self._procs: Dict[int, Dict[str, Any]] = {}
+        self.max_dead = int(max_dead)
         self.stopping = threading.Event()
+
+    def _prune_dead_locked(self) -> None:
+        """Evict the oldest dead children beyond ``max_dead``. Caller
+        holds ``self._lock``."""  # guarded-by: self._lock
+        dead = [pid for pid, info in self._procs.items()
+                if info["proc"].poll() is not None]
+        for pid in dead[:max(0, len(dead) - self.max_dead)]:
+            del self._procs[pid]
 
     # -- verbs ---------------------------------------------------------------
 
@@ -113,6 +132,7 @@ class AgentService:
         info = {"name": req.get("name"), "proc": proc, "addr": addr}
         with self._lock:
             self._procs[proc.pid] = info
+            self._prune_dead_locked()
         _reply_json(conn, {"name": req.get("name"), "pid": proc.pid,
                            "addr": [self._advertise, addr[1]]})
 
@@ -131,6 +151,7 @@ class AgentService:
 
     def handle_ps(self, conn: socket.socket) -> None:
         with self._lock:
+            self._prune_dead_locked()
             procs = [{"name": info["name"], "pid": pid,
                       "alive": info["proc"].poll() is None,
                       "addr": [self._advertise, info["addr"][1]]}
@@ -301,12 +322,17 @@ def main(argv=None) -> int:
                    help="host address spawned replicas are advertised at "
                         "(default: the bind address, or loopback)")
     p.add_argument("--port", type=int, default=0)
+    p.add_argument("--max-dead", type=int, default=256,
+                   help="dead-children history cap for PS (oldest dead "
+                        "entries evicted beyond this; live pids never "
+                        "evicted)")
     args = p.parse_args(argv)
     root = args.root or tempfile.mkdtemp(prefix="pdtpu_agent_")
     bind = args.bind or os.environ.get("PDTPU_BIND_ADDR") or "127.0.0.1"
     advertise = args.advertise or (bind if bind != "0.0.0.0"
                                    else "127.0.0.1")
-    service = AgentService(root, child_bind=args.bind, advertise=advertise)
+    service = AgentService(root, child_bind=args.bind, advertise=advertise,
+                           max_dead=args.max_dead)
     ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     ls.bind((bind, int(args.port)))

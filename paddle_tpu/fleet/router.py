@@ -190,7 +190,8 @@ class FleetRouter:
         self._rr = 0                     # round-robin tie-breaker
         self._counters: Dict[str, float] = {
             "submitted": 0, "rerouted": 0, "shed": 0,
-            "replicas_replaced": 0, "reloads": 0, "reload_rollbacks": 0,
+            "replicas_replaced": 0, "replicas_grown": 0,
+            "replicas_retired": 0, "reloads": 0, "reload_rollbacks": 0,
             "reload_failures": 0}
         self._routed: Dict[str, int] = {n: 0 for n in self._replicas}
         self._telemetry_server = None
@@ -307,43 +308,7 @@ class FleetRouter:
         live; routing picks the replacement up on the next submit —
         the recovery half of the kill drill."""
         if server is None:
-            if self.dirname is None:
-                raise ValueError(
-                    f"replace({name!r}) needs an explicit server for an "
-                    "adopted fleet (no artifact dirname on record)")
-            if not self._server_kw:
-                _log().warning(
-                    "replace(%r): no server_kw on record (adopted fleet) — "
-                    "the replacement comes up with PredictorServer "
-                    "defaults; pass server_kw to FleetRouter to respawn "
-                    "with the fleet's real config", name)
-            if self._remote and self._agents:
-                # cross-host: respawn through a LIVE host agent —
-                # preferring the dead replica's own host, falling back
-                # to any surviving one — with the artifact shipped over
-                # FETCH (a content-addressed no-op when that host's
-                # cache already holds it)
-                from . import remote as _remote
-                with self._lock:
-                    cur = self._replicas.get(name)
-                prefer = getattr(getattr(cur, "server", None), "agent", None)
-                agent = self._pick_agent(prefer=prefer)
-                server = _remote.adopt_replica(
-                    agent, self.dirname, name,
-                    remote_kw=dict(self._remote_kw), link=self._link,
-                    **self._server_kw)
-            elif self._remote:
-                # a remote fleet respawns a PROCESS from the artifact —
-                # the recovery half of the process-kill drill
-                from . import remote as _remote
-                server = _remote.spawn_replica(
-                    self.dirname, remote_kw=dict(self._remote_kw,
-                                                 name=name),
-                    **self._server_kw)
-            else:
-                from ..io import load_inference_model
-                server = PredictorServer(
-                    load_inference_model(self.dirname), **self._server_kw)
+            server = self._respawn(name, verb="replace")
         with self._lock:
             old = self._replicas.get(name)
             self._replicas[name] = _Replica(name, server)
@@ -364,6 +329,138 @@ class FleetRouter:
         self.journal.emit("fleet.replace", inst=self.telemetry_inst,
                           replica=name)
         return server
+
+    def _respawn(self, name: str, verb: str = "respawn"):
+        """Build a fresh server for ``name`` from the fleet's recorded
+        artifact + server_kw, the same way the fleet was originally
+        built: through a live host agent (cross-host), as a new OS
+        process (remote), or in-process over a fresh artifact load.
+        Shared by :meth:`replace` (death recovery) and :meth:`grow`
+        (autoscale)."""
+        if self.dirname is None:
+            raise ValueError(
+                f"{verb}({name!r}) needs an explicit server for an "
+                "adopted fleet (no artifact dirname on record)")
+        if not self._server_kw:
+            _log().warning(
+                "%s(%r): no server_kw on record (adopted fleet) — "
+                "the new replica comes up with PredictorServer "
+                "defaults; pass server_kw to FleetRouter to respawn "
+                "with the fleet's real config", verb, name)
+        if self._remote and self._agents:
+            # cross-host: spawn through a LIVE host agent — preferring
+            # the replica's previous host if any (warm artifact cache),
+            # falling back to any surviving one — with the artifact
+            # shipped over FETCH (a content-addressed no-op when that
+            # host's cache already holds it)
+            from . import remote as _remote
+            with self._lock:
+                cur = self._replicas.get(name)
+            prefer = getattr(getattr(cur, "server", None), "agent", None)
+            agent = self._pick_agent(prefer=prefer)
+            return _remote.adopt_replica(
+                agent, self.dirname, name,
+                remote_kw=dict(self._remote_kw), link=self._link,
+                **self._server_kw)
+        if self._remote:
+            # a remote fleet spawns a PROCESS from the artifact — the
+            # recovery half of the process-kill drill, and the grow
+            # half of the autoscale drill
+            from . import remote as _remote
+            return _remote.spawn_replica(
+                self.dirname, remote_kw=dict(self._remote_kw, name=name),
+                **self._server_kw)
+        from ..io import load_inference_model
+        return PredictorServer(
+            load_inference_model(self.dirname), **self._server_kw)
+
+    def grow(self, name: Optional[str] = None) -> str:
+        """Add one replica to the fleet (the autoscaler's scale-up
+        primitive): spawn from the recorded artifact the same way the
+        fleet was built — locally, as a remote process, or through a
+        host agent — and enter it into routing. ``name`` defaults to
+        the first free ``r{i}`` slot; returns the name. Routing picks
+        the newcomer up on the next submit (least-loaded ready replica
+        wins, and an empty fresh queue is maximally attractive)."""
+        if name is None:
+            with self._lock:
+                taken = set(self._replicas)
+            i = 0
+            while f"r{i}" in taken:
+                i += 1
+            name = f"r{i}"
+        else:
+            with self._lock:
+                if name in self._replicas:
+                    raise ValueError(f"grow({name!r}): name already in "
+                                     "the fleet")
+        server = self._respawn(name, verb="grow")
+        with self._lock:
+            if name in self._replicas:  # lost a race with another grow
+                self._lockless_kill(server, f"grow({name}) raced")
+                raise ValueError(f"grow({name!r}): name already in "
+                                 "the fleet")
+            self._replicas[name] = _Replica(name, server)
+            self._routed.setdefault(name, 0)
+            self._journal_ship_seq.pop(name, None)
+            self._counters["replicas_grown"] += 1
+        # the newcomer's artifact load moved the process-wide AOT
+        # counter: re-pin the siblings (same reason as replace())
+        self._repin_all()
+        self.journal.emit("fleet.grow", inst=self.telemetry_inst,
+                          replica=name)
+        return name
+
+    @staticmethod
+    def _lockless_kill(server, reason: str) -> None:
+        try:
+            server.kill(reason=reason)
+        except Exception:  # pragma: no cover - teardown best effort
+            pass
+
+    def retire(self, name: str, drain: bool = True,
+               timeout: Optional[float] = None) -> None:
+        """Remove ``name`` from the fleet (the autoscaler's scale-down
+        primitive) WITHOUT dropping accepted work: the replica leaves
+        routing first (new submits can no longer pick it, and a
+        rerouted :class:`FleetPending` won't re-pick it either), then
+        the server is closed with ``drain=True`` — dispatched requests
+        run to completion, queued-but-never-dispatched ones surface
+        ``ServerClosed`` and the fleet future transparently reroutes
+        them to a surviving replica, so the at-most-once
+        ``ReplicaDied``/``ServerClosed`` classification is preserved
+        end to end. For a remote replica the drain rides the wire
+        SHUTDOWN and the owning agent reaps the process (``close()``
+        on :class:`~paddle_tpu.fleet.remote.RemoteReplica` already
+        STOPs through the agent that spawned it).
+
+        Refuses to retire the LAST replica — an empty fleet cannot
+        reroute anything (scale the band's floor with the policy's
+        ``min_replicas`` instead)."""
+        with self._lock:
+            if name not in self._replicas:
+                raise KeyError(f"retire({name!r}): no such replica "
+                               f"(have {sorted(self._replicas)})")
+            if len(self._replicas) == 1:
+                raise ValueError(
+                    f"retire({name!r}): refusing to retire the last "
+                    "replica — an empty fleet cannot reroute")
+            rep = self._replicas.pop(name)
+            self._routed.pop(name, None)
+            self._journal_ship_seq.pop(name, None)
+            self._counters["replicas_retired"] += 1
+        try:
+            rep.server.close(drain=drain, timeout=timeout)
+        except Exception as e:
+            # a wedged drain must not leave a zombie process serving
+            # nothing: fall back to the kill path (queued work gets the
+            # at-most-once ServerClosed/ReplicaDied classification and
+            # reroutes — the replica is already out of routing)
+            _log().warning("retire(%r): drain close failed (%s: %s) — "
+                           "killing", name, type(e).__name__, e)
+            self._lockless_kill(rep.server, f"retired by router ({name})")
+        self.journal.emit("fleet.retire", inst=self.telemetry_inst,
+                          replica=name, drain=bool(drain))
 
     def _pick_agent(self, prefer=None):
         """First host agent that answers a PS probe (``prefer`` tried
@@ -843,6 +940,12 @@ class FleetRouter:
             counter_family("paddle_tpu_fleet_replicas_replaced_total",
                            "Replicas replaced after death",
                            [(labels, counters["replicas_replaced"])]),
+            counter_family("paddle_tpu_fleet_replicas_grown_total",
+                           "Replicas added by scale-up",
+                           [(labels, counters["replicas_grown"])]),
+            counter_family("paddle_tpu_fleet_replicas_retired_total",
+                           "Replicas drained out by scale-down",
+                           [(labels, counters["replicas_retired"])]),
             counter_family(
                 "paddle_tpu_fleet_reloads_total",
                 "Rolling reloads (by outcome)",

@@ -684,6 +684,101 @@ class PreemptionHandler:
         return False
 
 
+# -- scheduled elastic resize ------------------------------------------------
+
+
+class ResizeRequest:
+    """Scheduled ``fit(elastic=True)`` grow/shrink: the autoscaler's
+    trainer-side analog. Where :class:`PreemptionHandler` reacts to a
+    SIGTERM nobody planned, a ResizeRequest watches a request FILE an
+    operator (or the autoscaler) drops next to the run::
+
+        with ResizeRequest("/run/resize.json") as rz:
+            fit(trainer, ..., elastic=True, resize=rz)
+
+        # elsewhere: echo '{"dp": 4}' > /run/resize.json
+
+    ``fit(resize=...)`` polls :attr:`requested` at the same chunk
+    boundary it polls preemption: when the file appears (or the
+    optional ``signal_num`` arrives — e.g. SIGUSR1), the run
+    checkpoints at the boundary and returns cleanly with
+    ``fit.resized`` journaled, so the launcher can relaunch at the new
+    size and ``fit(elastic=True, resume=True)`` reshards the
+    checkpoint onto the new mesh (:func:`reshard_restore`). The file's
+    JSON body (:attr:`target`, e.g. ``{"dp": 4}``) is advisory — the
+    relaunch decides the actual mesh; an empty or unparsable file
+    reads as ``{}`` (a bare "resize now" kick).
+
+    ``consume()`` removes the file and clears the flag — the launcher
+    calls it after acting so a stale request can't re-trigger on the
+    next run. Like PreemptionHandler, the signal handler installs only
+    in the main thread and degrades to an inert flag elsewhere; the
+    file watch works from any thread."""
+
+    def __init__(self, path: str, signal_num: Optional[int] = None):
+        self.path = path
+        self.signal_num = signal_num
+        self._flag = threading.Event()
+        self._old: Any = None
+        self.installed = False
+
+    @property
+    def requested(self) -> bool:
+        return self._flag.is_set() or os.path.exists(self.path)
+
+    @property
+    def target(self) -> Dict[str, Any]:
+        """The request body (``{}`` when absent/empty/unparsable)."""
+        try:
+            with open(self.path) as f:
+                body = f.read().strip()
+            doc = json.loads(body) if body else {}
+            return doc if isinstance(doc, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def request(self, target: Optional[Dict[str, Any]] = None) -> None:
+        """Drop the request file (what an in-process scheduler calls;
+        operators just write the file)."""
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(dict(target or {}), f)
+        os.replace(tmp, self.path)
+
+    def consume(self) -> Dict[str, Any]:
+        """Read-and-clear: returns the target, removes the file,
+        resets the flag — the next run starts unrequested."""
+        target = self.target
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+        self._flag.clear()
+        return target
+
+    def _handle(self, signum, frame):
+        self._flag.set()
+        _log().warning(
+            "received %s: elastic resize requested — checkpointing at "
+            "the next chunk boundary", signal.Signals(signum).name)
+
+    def __enter__(self) -> "ResizeRequest":
+        if self.signal_num is not None and \
+                threading.current_thread() is threading.main_thread():
+            self._old = signal.signal(self.signal_num, self._handle)
+            self.installed = True
+        return self
+
+    def __exit__(self, *exc):
+        if self.installed:
+            try:
+                signal.signal(self.signal_num, self._old)
+            except (ValueError, TypeError):
+                pass
+            self.installed = False
+        return False
+
+
 # -- NaN/Inf guard policy ----------------------------------------------------
 
 
@@ -807,7 +902,8 @@ def record_incident(incidents: List[Incident], step: int,
 
 __all__ = [
     "CheckpointCorrupt", "CheckpointInfo", "GuardPolicy", "Incident",
-    "InjectedCrash", "PreemptionHandler", "ReshardError", "check_segment",
+    "InjectedCrash", "PreemptionHandler", "ReshardError", "ResizeRequest",
+    "check_segment",
     "crash_point", "crash_points", "feed_digest", "frame_record",
     "iter_records", "list_checkpoints", "mesh_axes",
     "normalize_mesh_axes", "read_manifest", "reshard_restore",

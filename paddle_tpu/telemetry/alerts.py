@@ -71,6 +71,7 @@ METRIC_TABLE: Dict[str, Tuple[str, frozenset]] = {
     "paddle_tpu_trainer_guard_incidents_total": ("counter", frozenset()),
     "paddle_tpu_trainer_checkpoints_total": ("counter", frozenset({"kind"})),
     "paddle_tpu_trainer_preemptions_total": ("counter", frozenset()),
+    "paddle_tpu_trainer_resizes_total": ("counter", frozenset()),
     "paddle_tpu_resilience_reshards_total": ("counter", frozenset()),
     # input pipeline
     "paddle_tpu_feeder_stage_seconds_total": ("counter", frozenset({"stage"})),
@@ -113,10 +114,18 @@ METRIC_TABLE: Dict[str, Tuple[str, frozenset]] = {
     "paddle_tpu_fleet_rerouted_total": ("counter", frozenset()),
     "paddle_tpu_fleet_shed_total": ("counter", frozenset()),
     "paddle_tpu_fleet_replicas_replaced_total": ("counter", frozenset()),
+    "paddle_tpu_fleet_replicas_grown_total": ("counter", frozenset()),
+    "paddle_tpu_fleet_replicas_retired_total": ("counter", frozenset()),
     "paddle_tpu_fleet_reloads_total": ("counter", frozenset({"outcome"})),
     "paddle_tpu_fleet_reload_rollbacks_total": ("counter", frozenset()),
     "paddle_tpu_fleet_replicas_live": ("gauge", frozenset()),
     "paddle_tpu_fleet_replicas_ready": ("gauge", frozenset()),
+    # autoscaler (the closed loop over this plane)
+    "paddle_tpu_autoscaler_ticks_total": ("counter", frozenset()),
+    "paddle_tpu_autoscaler_scale_ups_total": ("counter", frozenset()),
+    "paddle_tpu_autoscaler_scale_downs_total": ("counter", frozenset()),
+    "paddle_tpu_autoscaler_holds_total": ("counter", frozenset({"reason"})),
+    "paddle_tpu_autoscaler_replicas": ("gauge", frozenset()),
     # telemetry shipping (this PR's own publishers)
     "paddle_tpu_shipper_shipped_total": ("counter", frozenset()),
     "paddle_tpu_shipper_dropped_total": ("counter", frozenset()),

@@ -45,8 +45,11 @@ Knobs (env defaults in parentheses): ``origin`` — the label this
 process's series carry at the collector (``PDTPU_TELEMETRY_ORIGIN``,
 else ``<hostname>-<pid>`` — pids collide across machines the moment a
 fleet spans hosts, so the default origin carries the sanitized
-hostname); ``flush_interval`` (``PDTPU_TELEMETRY_FLUSH_S``, 0.25s);
-``buffer_events`` (``PDTPU_TELEMETRY_BUFFER``, 4096).
+hostname); ``flush_interval`` (``PDTPU_TELEMETRY_FLUSH_S``, 0.25s) —
+each shipper adds a deterministic per-origin phase offset
+(:func:`flush_jitter`) so K replicas spawned in the same second don't
+synchronize their pushes into the collector; ``buffer_events``
+(``PDTPU_TELEMETRY_BUFFER``, 4096).
 
 :class:`ReplicationClient` is the OTHER puller on this wire: a
 cross-host standby collector's client for the primary's ``SEGMENTS``
@@ -87,6 +90,24 @@ def default_origin() -> str:
     host = "".join(c if (c.isalnum() or c in "._-") else "-"
                    for c in _socket.gethostname()) or "host"
     return f"{host}-{os.getpid()}"
+
+
+def flush_jitter(origin: str, interval: float, frac: float = 0.25) -> float:
+    """Deterministic per-origin offset added to every flush wait:
+    ``hash(origin)`` mapped into ``[0, frac * interval)``. A scale-up
+    that spawns K replicas in the same second gives all K the same
+    flush cadence — without jitter their pushes synchronize into the
+    collector as a K-wide thundering herd every tick. Keying the
+    jitter on the origin (stable per process across restarts, distinct
+    across replicas by construction — see :func:`default_origin`)
+    desynchronizes them deterministically: no RNG, so the schedule is
+    reproducible and two same-period shippers provably never share a
+    phase unless they share an origin."""
+    import hashlib
+
+    h = hashlib.sha1(origin.encode("utf-8", "surrogatepass")).digest()[:8]
+    u = int.from_bytes(h, "big") / float(2 ** 64)   # [0, 1)
+    return u * float(frac) * float(interval)
 
 
 def parse_addr(addr: AddrLike) -> Tuple[str, int]:
@@ -255,6 +276,9 @@ class Shipper:
         self.snapshot_interval = float(
             snapshot_interval if snapshot_interval is not None
             else max(self.flush_interval, 0.5))
+        # per-origin phase offset on the flush wait: K replicas spawned
+        # together would otherwise push in lockstep (see flush_jitter)
+        self.flush_jitter = flush_jitter(self.origin, self.flush_interval)
         bound = int(buffer_events if buffer_events is not None
                     else os.environ.get("PDTPU_TELEMETRY_BUFFER", 4096))
         self._buf_lock = threading.Lock()
@@ -337,7 +361,7 @@ class Shipper:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            self._wake.wait(self.flush_interval)
+            self._wake.wait(self.flush_interval + self.flush_jitter)
             self._wake.clear()
             if self._stop.is_set():
                 break
@@ -577,5 +601,6 @@ def maybe_auto_ship() -> Optional[Shipper]:
 
 
 __all__ = ["ReplicationClient", "Shipper", "ShipperClient",
-           "active_shipper", "default_origin", "maybe_auto_ship",
-           "parse_addr", "parse_addrs", "ship_to", "stop_shipping"]
+           "active_shipper", "default_origin", "flush_jitter",
+           "maybe_auto_ship", "parse_addr", "parse_addrs", "ship_to",
+           "stop_shipping"]

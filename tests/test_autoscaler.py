@@ -1,0 +1,698 @@
+"""Autoscaler acceptance suite: the pure policy core, the
+complete-bucket guard, the control loop over fake readers/routers, and
+the real ``FleetRouter.grow()`` / ``retire(drain=True)`` primitives.
+
+The acceptance contracts:
+
+  * every policy behavior — hysteresis windows, per-direction
+    cooldowns, anti-flap, quorum floor, fail-static — is pinned WITHOUT
+    a single sleep: the clock is an explicit ``now`` in ScaleSignals;
+  * scale-up fires BOTH ways: a sustained trend read AND an alert
+    transition (immediate, no sustain window on top);
+  * stale telemetry pauses scaling AND resets sustain windows AND
+    leaves the alert edge-detection baseline uncommitted (a collector
+    failover never manufactures a firing edge);
+  * the trend math only ever consumes complete downsample buckets;
+  * ``retire(drain=True)`` completes every accepted in-flight request
+    (at-most-once classification intact, zero dropped);
+  * the agent's dead-children history stays bounded under churn with
+    live pids never evicted;
+  * per-origin flush jitter is deterministic and desynchronizes
+    same-interval shippers.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import paddle_tpu as pt
+from paddle_tpu import io as pio
+from paddle_tpu.fleet import FleetRouter
+from paddle_tpu.fleet.autoscaler import (AutoscalePolicy, Autoscaler,
+                                         HttpCollectorReader,
+                                         LocalCollectorReader, ScaleDecision,
+                                         ScaleSignals, complete_buckets)
+from paddle_tpu.telemetry.journal import RunJournal
+
+
+# -- policy: every pin uses an explicit clock, no sleeps anywhere ------------
+
+
+def _sig(now, replicas=2, **kw):
+    return ScaleSignals(now=now, replicas=replicas, **kw)
+
+
+def _policy(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("up_window_s", 2.0)
+    kw.setdefault("down_window_s", 5.0)
+    kw.setdefault("up_cooldown_s", 5.0)
+    kw.setdefault("down_cooldown_s", 10.0)
+    kw.setdefault("flap_guard_s", 10.0)
+    return AutoscalePolicy(**kw)
+
+
+class TestPolicyBand:
+    def test_band_validation(self):
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_replicas=0, max_replicas=2)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_replicas=3, max_replicas=2)
+
+    def test_below_band_repair_ignores_cooldown(self):
+        p = _policy(min_replicas=2, up_cooldown_s=100.0)
+        # burn the up-cooldown with an alert-driven up at t=0
+        d = p.decide(_sig(0.0, replicas=2, queue_per_replica=0.0,
+                          alert_firing=True, alert_transition=True))
+        assert d.action == "up"
+        # a dead replica drops the fleet below min: repair fires even
+        # inside the cooldown window
+        d = p.decide(_sig(1.0, replicas=1, queue_per_replica=0.0))
+        assert (d.action, d.reason, d.target) == ("up", "below-band", 2)
+
+    def test_below_band_still_fail_static(self):
+        p = _policy(min_replicas=2)
+        d = p.decide(_sig(0.0, replicas=1, data_ok=False))
+        assert (d.action, d.reason) == ("hold", "fail-static")
+
+
+class TestPolicyScaleUp:
+    def test_trend_must_sustain_up_window(self):
+        p = _policy(up_queue_per_replica=2.0, up_window_s=2.0)
+        assert p.decide(_sig(0.0, queue_per_replica=5.0)).reason == "steady"
+        assert p.decide(_sig(1.0, queue_per_replica=5.0)).reason == "steady"
+        d = p.decide(_sig(2.0, queue_per_replica=5.0))
+        assert (d.action, d.reason, d.detail) == ("up", "trend-sustained",
+                                                  "queue")
+        assert d.target == 3
+
+    def test_trend_gap_resets_sustain(self):
+        p = _policy(up_queue_per_replica=2.0, up_window_s=2.0)
+        p.decide(_sig(0.0, queue_per_replica=5.0))
+        # one cold tick erases the partial sustain...
+        p.decide(_sig(1.0, queue_per_replica=0.0))
+        # ...so hot at t=2.5 has only been hot since t=2.5
+        assert p.decide(_sig(2.5, queue_per_replica=5.0)).reason == "steady"
+        assert p.decide(_sig(4.0, queue_per_replica=5.0)).reason == "steady"
+        assert p.decide(_sig(4.5, queue_per_replica=5.0)).action == "up"
+
+    def test_shed_rate_is_an_up_signal(self):
+        p = _policy(up_shed_rate=1.0, up_window_s=0.0)
+        d = p.decide(_sig(0.0, shed_rate=3.0))
+        assert (d.action, d.detail) == ("up", "shed")
+
+    def test_alert_transition_is_immediate(self):
+        # the BOTH-trigger contract, second half: no sustain window on
+        # top of a firing edge — the trend signals are stone cold here
+        p = _policy(up_window_s=60.0)
+        d = p.decide(_sig(0.0, queue_per_replica=0.0,
+                          alert_firing=True, alert_transition=True))
+        assert (d.action, d.reason, d.target) == ("up", "alert-transition", 3)
+
+    def test_alert_transition_respects_at_max(self):
+        p = _policy(max_replicas=2)
+        d = p.decide(_sig(0.0, replicas=2, alert_firing=True,
+                          alert_transition=True))
+        assert (d.action, d.reason) == ("hold", "at-max")
+
+    def test_up_cooldown_blocks_second_up(self):
+        p = _policy(up_window_s=0.0, up_cooldown_s=5.0)
+        assert p.decide(_sig(0.0, queue_per_replica=9.0)).action == "up"
+        d = p.decide(_sig(3.0, queue_per_replica=9.0))
+        assert (d.action, d.reason) == ("hold", "up-cooldown")
+        # NB: the cooldown hold does not extend the cooldown
+        assert p.decide(_sig(5.0, queue_per_replica=9.0)).action == "up"
+
+    def test_up_resets_hot_window(self):
+        # after an up the burst must re-prove itself: hot since the up,
+        # not since the original onset
+        p = _policy(up_window_s=2.0, up_cooldown_s=0.0)
+        p.decide(_sig(0.0, queue_per_replica=9.0))
+        assert p.decide(_sig(2.0, queue_per_replica=9.0)).action == "up"
+        assert p.decide(_sig(3.0, queue_per_replica=9.0)).reason == "steady"
+        assert p.decide(_sig(4.0, queue_per_replica=9.0)).reason == "steady"
+        assert p.decide(_sig(5.0, queue_per_replica=9.0)).action == "up"
+
+    def test_step_clamps_to_max(self):
+        p = _policy(max_replicas=3, step=5, up_window_s=0.0)
+        d = p.decide(_sig(0.0, replicas=2, queue_per_replica=9.0))
+        assert (d.action, d.target) == ("up", 3)
+
+
+class TestPolicyScaleDown:
+    def _cold_run(self, p, t0=0.0, replicas=2):
+        """Feed cold ticks until the down window has elapsed; return
+        the decision at the window edge."""
+        p.decide(_sig(t0, replicas=replicas, queue_per_replica=0.0))
+        return p.decide(_sig(t0 + p.down_window_s, replicas=replicas,
+                             queue_per_replica=0.0))
+
+    def test_down_needs_sustained_cold(self):
+        p = _policy(down_window_s=5.0)
+        assert p.decide(_sig(0.0, queue_per_replica=0.0)).reason == "steady"
+        assert p.decide(_sig(4.9, queue_per_replica=0.0)).reason == "steady"
+        d = p.decide(_sig(5.0, queue_per_replica=0.0))
+        assert (d.action, d.reason, d.target) == ("down", "trend-cold", 1)
+
+    def test_hysteresis_gap_is_steady(self):
+        # between down and up thresholds: neither hot nor cold
+        p = _policy(up_queue_per_replica=2.0, down_queue_per_replica=0.5,
+                    down_window_s=0.0)
+        d = p.decide(_sig(0.0, queue_per_replica=1.0))
+        assert (d.action, d.reason) == ("hold", "steady")
+
+    def test_silence_is_not_coldness(self):
+        # no trend signal present at all: never a down verdict
+        p = _policy(down_window_s=0.0)
+        assert p.decide(_sig(0.0)).reason == "steady"
+        assert p.decide(_sig(100.0)).reason == "steady"
+
+    def test_at_min_holds(self):
+        p = _policy(min_replicas=1, down_window_s=0.0)
+        d = p.decide(_sig(0.0, replicas=1, queue_per_replica=0.0))
+        assert (d.action, d.reason) == ("hold", "at-min")
+
+    def test_down_cooldown(self):
+        p = _policy(down_window_s=0.0, down_cooldown_s=10.0,
+                    flap_guard_s=0.0, max_replicas=4)
+        d = p.decide(_sig(0.0, replicas=3, queue_per_replica=0.0))
+        assert d.action == "down"
+        d = p.decide(_sig(5.0, replicas=2, queue_per_replica=0.0))
+        assert (d.action, d.reason) == ("hold", "down-cooldown")
+        assert p.decide(_sig(10.0, replicas=2,
+                             queue_per_replica=0.0)).action == "down"
+
+    def test_anti_flap_runs_from_retire_completion(self):
+        p = _policy(down_window_s=0.0, down_cooldown_s=0.0,
+                    flap_guard_s=10.0, max_replicas=4)
+        assert p.decide(_sig(0.0, replicas=3,
+                             queue_per_replica=0.0)).action == "down"
+        # the drain took 4 seconds: completion stamped at t=4, so the
+        # flap guard holds until t=14 — not t=10
+        p.note_retired(4.0)
+        d = p.decide(_sig(12.0, replicas=2, queue_per_replica=0.0))
+        assert (d.action, d.reason) == ("hold", "anti-flap")
+        assert p.decide(_sig(14.0, replicas=2,
+                             queue_per_replica=0.0)).action == "down"
+
+    def test_quorum_floor_only_while_alert_fires(self):
+        p = _policy(min_replicas=1, quorum=2, down_window_s=0.0,
+                    down_cooldown_s=0.0, flap_guard_s=0.0)
+        # trend cold but an alert still firing: never below quorum
+        d = p.decide(_sig(0.0, replicas=2, queue_per_replica=0.0,
+                          alert_firing=True))
+        assert (d.action, d.reason) == ("hold", "quorum-floor")
+        # alert resolved: the same cold trend may now shrink past it
+        d = p.decide(_sig(1.0, replicas=2, queue_per_replica=0.0,
+                          alert_firing=False))
+        assert (d.action, d.target) == ("down", 1)
+
+    def test_quorum_does_not_block_above_floor(self):
+        p = _policy(min_replicas=1, quorum=2, max_replicas=4,
+                    down_window_s=0.0, down_cooldown_s=0.0,
+                    flap_guard_s=0.0)
+        d = p.decide(_sig(0.0, replicas=4, queue_per_replica=0.0,
+                          alert_firing=True))
+        assert (d.action, d.target) == ("down", 3)
+
+
+class TestPolicyFailStatic:
+    def test_fail_static_holds_and_resets_windows(self):
+        p = _policy(up_window_s=2.0)
+        p.decide(_sig(0.0, queue_per_replica=9.0))
+        d = p.decide(_sig(1.0, data_ok=False))
+        assert (d.action, d.reason) == ("hold", "fail-static")
+        # the gap erased the sustain: hot at t=2 (>= up_window past the
+        # original onset) is NOT enough, it must re-sustain from t=2
+        assert p.decide(_sig(2.0, queue_per_replica=9.0)).reason == "steady"
+        assert p.decide(_sig(4.0, queue_per_replica=9.0)).action == "up"
+
+    def test_fail_static_resets_cold_window_too(self):
+        p = _policy(down_window_s=5.0)
+        p.decide(_sig(0.0, queue_per_replica=0.0))
+        p.decide(_sig(3.0, data_ok=False))
+        assert p.decide(_sig(5.0, queue_per_replica=0.0)).reason == "steady"
+        assert p.decide(_sig(10.0, queue_per_replica=0.0)).action == "down"
+
+
+# -- complete_buckets --------------------------------------------------------
+
+
+def test_complete_buckets_drops_trailing_partial():
+    pts = [(0.0, 1.0), (0.5, 2.0), (1.0, 3.0)]
+    # the bucket starting at 1.0 spans [1.0, 1.5) > to=1.2: partial
+    assert complete_buckets(pts, step=0.5, to=1.2) == [(0.0, 1.0),
+                                                      (0.5, 2.0)]
+    # to=1.5 closes it
+    assert complete_buckets(pts, step=0.5, to=1.5) == pts
+
+
+def test_complete_buckets_raw_points_pass_through():
+    pts = [(0.0, 1.0), (1.1, 2.0), (2.0, 3.0)]
+    assert complete_buckets(pts, step=0.0, to=1.5) == [(0.0, 1.0),
+                                                      (1.1, 2.0)]
+    assert complete_buckets(pts, step=-1.0, to=5.0) == pts
+
+
+def test_complete_buckets_empty():
+    assert complete_buckets([], step=0.5, to=10.0) == []
+
+
+# -- the control loop over fakes ---------------------------------------------
+
+
+class _FakeRouter:
+    def __init__(self, names=("r0",)):
+        self.names = list(names)
+        self.grown = []
+        self.retired = []
+
+    @property
+    def replica_names(self):
+        return list(self.names)
+
+    def grow(self, name=None):
+        name = name or f"r{len(self.names)}"
+        self.names.append(name)
+        self.grown.append(name)
+        return name
+
+    def retire(self, name, drain=True, timeout=None):
+        self.names.remove(name)
+        self.retired.append((name, drain))
+
+
+class _FakeReader:
+    """Scriptable collector: per-metric /query docs + /alerts snaps."""
+
+    def __init__(self):
+        self.queue_points = {}    # series key -> [(t, v), ...]
+        self.shed_points = {}
+        self.step = 0.5
+        self.firing = []
+        self.fail = False
+
+    def query(self, metric, labels=None, start=0.0, end=None, step=0.0):
+        if self.fail:
+            raise ConnectionError("collector down")
+        pts = self.queue_points if "queue" in metric else self.shed_points
+        return {"metric": metric, "from": start, "to": end,
+                "step": step if step else 0.0,
+                "series": [{"key": k, "labels": {}, "points": list(v)}
+                           for k, v in sorted(pts.items())]}
+
+    def alerts(self):
+        if self.fail:
+            raise ConnectionError("collector down")
+        return {"firing": list(self.firing)}
+
+
+def _scaler(router, reader, policy=None, **kw):
+    kw.setdefault("trend_window_s", 5.0)
+    kw.setdefault("trend_step_s", 0.5)
+    kw.setdefault("stale_after_s", 2.0)
+    return Autoscaler(router, reader,
+                      policy or _policy(up_window_s=0.0, up_cooldown_s=0.0),
+                      **kw)
+
+
+def _hot_queue(reader, now, per_replica=9.0, names=("r0", "r1")):
+    """Fresh, complete hot buckets for every named series."""
+    reader.queue_points = {
+        n: [(now - 1.5, per_replica), (now - 1.0, per_replica),
+            (now - 0.5, per_replica)]
+        for n in names}
+
+
+class TestAutoscalerLoop:
+    def setup_method(self):
+        from paddle_tpu import telemetry
+        telemetry.set_journal(RunJournal())
+
+    def test_trend_sustained_scale_up(self):
+        # the BOTH-trigger contract, first half: a pure trend read —
+        # no alert anywhere — grows the fleet once sustained
+        router = _FakeRouter(["r0", "r1"])
+        reader = _FakeReader()
+        pol = _policy(up_queue_per_replica=2.0, up_window_s=1.0,
+                      up_cooldown_s=0.0)
+        with _scaler(router, reader, pol) as sc:
+            _hot_queue(reader, 100.0)
+            assert sc.tick(now=100.0).reason == "steady"
+            _hot_queue(reader, 101.0)
+            d = sc.tick(now=101.0)
+            assert (d.action, d.reason) == ("up", "trend-sustained")
+            assert router.grown == ["r2"]
+            assert sc.counters()["scale_ups"] == 1
+
+    def test_alert_transition_scale_up(self):
+        # cold trend + a fresh firing edge: immediate up
+        router = _FakeRouter(["r0", "r1"])
+        reader = _FakeReader()
+        with _scaler(router, reader, _policy(up_window_s=60.0),
+                     alert_rules=["queue_hot"]) as sc:
+            reader.queue_points = {"r0": [(99.5, 0.0)], "r1": [(99.5, 0.0)]}
+            reader.firing = [{"rule": "queue_hot", "key": "r0"}]
+            d = sc.tick(now=100.0)
+            assert (d.action, d.reason) == ("up", "alert-transition")
+            assert router.grown == ["r2"]
+            # same alert still firing next tick: no new edge, no new up
+            reader.queue_points = {n: [(100.5, 0.0)] for n in router.names}
+            assert sc.tick(now=101.0).action == "hold"
+
+    def test_alert_rules_filter(self):
+        router = _FakeRouter(["r0", "r1"])
+        reader = _FakeReader()
+        with _scaler(router, reader, _policy(up_window_s=60.0),
+                     alert_rules=["queue_hot"]) as sc:
+            reader.queue_points = {"r0": [(99.5, 0.0)]}
+            reader.firing = [{"rule": "unrelated_rule", "key": "x"}]
+            d = sc.tick(now=100.0)
+            assert d.action == "hold"
+            assert router.grown == []
+
+    def test_stale_data_is_fail_static(self):
+        router = _FakeRouter(["r0", "r1"])
+        reader = _FakeReader()
+        with _scaler(router, reader, stale_after_s=2.0) as sc:
+            # hot but ANCIENT points: freshest age 50s > stale_after
+            reader.queue_points = {"r0": [(50.0, 9.0)], "r1": [(50.0, 9.0)]}
+            d = sc.tick(now=100.0)
+            assert (d.action, d.reason) == ("hold", "fail-static")
+            assert sc.counters()["holds"]["fail-static"] == 1
+
+    def test_reader_error_is_fail_static(self):
+        router = _FakeRouter(["r0", "r1"])
+        reader = _FakeReader()
+        reader.fail = True
+        with _scaler(router, reader) as sc:
+            s = sc.signals(now=100.0)
+            assert s.data_ok is False
+            assert sc.tick(now=100.0).reason == "fail-static"
+
+    def test_stale_tick_does_not_commit_alert_baseline(self):
+        # the failover pin: while data is stale the alert view (empty,
+        # replayed, whatever the promoting standby serves) must NOT
+        # advance the edge baseline — and the still-firing alert after
+        # recovery must NOT read as a fresh edge
+        router = _FakeRouter(["r0", "r1"])
+        reader = _FakeReader()
+        with _scaler(router, reader, _policy(up_window_s=60.0)) as sc:
+            reader.queue_points = {"r0": [(99.5, 0.0)], "r1": [(99.5, 0.0)]}
+            reader.firing = [{"rule": "queue_hot", "key": "r0"}]
+            assert sc.tick(now=100.0).action == "up"          # real edge
+            # failover: stale data, alerts view briefly EMPTY
+            reader.queue_points = {"r0": [(99.5, 0.0)]}
+            reader.firing = []
+            assert sc.tick(now=110.0).reason == "fail-static"
+            # recovery: same alert still firing — not a new edge
+            reader.queue_points = {n: [(119.5, 0.0)]
+                                   for n in router.names}
+            reader.firing = [{"rule": "queue_hot", "key": "r0"}]
+            d = sc.tick(now=120.0)
+            assert d.action == "hold"
+            assert router.grown == ["r2"]   # exactly the one real up
+
+    def test_partial_bucket_never_gates(self):
+        # only a partial trailing bucket in the window: no verdict ⇒
+        # the qpr signal is None and nothing scales on it
+        router = _FakeRouter(["r0", "r1"])
+        reader = _FakeReader()
+        with _scaler(router, reader, trend_step_s=0.5) as sc:
+            reader.queue_points = {"r0": [(99.8, 50.0)],
+                                   "r1": [(99.8, 50.0)]}
+            s = sc.signals(now=100.0)
+            assert s.data_ok is True           # fresh, just no verdict
+            assert s.queue_per_replica is None
+            assert sc.tick(now=100.0).action == "hold"
+            assert router.grown == []
+
+    def test_queue_trend_sums_series_per_replica(self):
+        router = _FakeRouter(["r0", "r1"])
+        reader = _FakeReader()
+        with _scaler(router, reader) as sc:
+            reader.queue_points = {"r0": [(99.0, 3.0), (99.5, 4.0)],
+                                   "r1": [(99.0, 1.0), (99.5, 6.0)]}
+            qpr, age = sc._trend_queue(100.0)
+            assert qpr == pytest.approx((4.0 + 6.0) / 2)
+            assert age == pytest.approx(0.5)
+
+    def test_shed_rate_counter_delta_and_reset(self):
+        router = _FakeRouter(["r0"])
+        reader = _FakeReader()
+        with _scaler(router, reader) as sc:
+            reader.shed_points = {"f": [(90.0, 10.0), (100.0, 30.0)]}
+            assert sc._trend_shed(100.0) == pytest.approx(2.0)
+            # restart reset the counter: count from the new value
+            reader.shed_points = {"f": [(90.0, 50.0), (100.0, 20.0)]}
+            assert sc._trend_shed(100.0) == pytest.approx(2.0)
+
+    def test_scale_down_retires_lifo_with_drain(self):
+        router = _FakeRouter(["r0", "r1", "r2"])
+        reader = _FakeReader()
+        pol = _policy(down_window_s=0.0, down_cooldown_s=0.0,
+                      flap_guard_s=0.0)
+        with _scaler(router, reader, pol) as sc:
+            reader.queue_points = {n: [(99.5, 0.0)] for n in router.names}
+            d = sc.tick(now=100.0)
+            assert d.action == "down"
+            assert router.retired == [("r2", True)]
+            assert sc.counters()["scale_downs"] == 1
+            # the retire completion was stamped for the flap guard
+            assert pol._last_retire_at != float("-inf")
+
+    def test_pick_victim_highest_suffix(self):
+        router = _FakeRouter(["r0", "r10", "r2"])
+        sc = _scaler(router, _FakeReader())
+        try:
+            assert sc._pick_victim() == "r10"
+        finally:
+            sc.close()
+
+    def test_hold_journal_edges_only(self):
+        from paddle_tpu import telemetry
+        router = _FakeRouter(["r0"])
+        reader = _FakeReader()
+        with _scaler(router, reader) as sc:
+            reader.queue_points = {"r0": [(99.5, 1.0)]}
+            sc.tick(now=100.0)
+            reader.queue_points = {"r0": [(100.5, 1.0)]}
+            sc.tick(now=101.0)
+            ev = telemetry.get_journal().recent(kind="autoscale.hold")
+            assert len([e for e in ev if e["reason"] == "steady"]) == 1
+
+
+def test_http_reader_url_parsing():
+    r = HttpCollectorReader("http://a:1/, http://b:2")
+    assert r.urls == ["http://a:1", "http://b:2"]
+    r = HttpCollectorReader(["http://a:1/"])
+    assert r.urls == ["http://a:1"]
+    with pytest.raises(ValueError):
+        HttpCollectorReader("")
+    with pytest.raises(ValueError):
+        HttpCollectorReader([])
+
+
+# -- real FleetRouter grow/retire --------------------------------------------
+
+
+def _feed(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"image": rng.randn(n, 784).astype(np.float32),
+            "label": rng.randint(0, 10, (n, 1)).astype(np.int64)}
+
+
+def _single(feed, i):
+    return {k: np.asarray(v)[i:i + 1] for k, v in feed.items()}
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    from paddle_tpu.models import mnist
+
+    d = str(tmp_path_factory.mktemp("autoscale") / "model")
+    prog = pt.build(mnist.mlp)
+    feed8 = _feed(8)
+    params, state = prog.init(jax.random.PRNGKey(0), **feed8)
+    pio.save_inference_model(d, prog, jax.tree.map(np.asarray, params),
+                             state, feed8, batch_buckets=[4, 8])
+    return d
+
+
+@pytest.fixture()
+def fresh_journal():
+    from paddle_tpu import telemetry
+    telemetry.set_journal(RunJournal())
+    yield
+
+
+class TestRouterElasticity:
+    def test_grow_then_retire_drains_in_flight(self, artifact,
+                                               fresh_journal):
+        router = FleetRouter.spawn(artifact, replicas=1, workers=1,
+                                   queue_size=64)
+        try:
+            assert router.replica_names == ["r0"]
+            name = router.grow()
+            assert name == "r1"
+            assert sorted(router.replica_names) == ["r0", "r1"]
+            assert router._counters["replicas_grown"] == 1
+
+            feed8 = _feed(8, seed=3)
+            futs = [router.submit(_single(feed8, i % 8))
+                    for i in range(24)]
+            # retire the newcomer while its share is in flight: every
+            # accepted request must still produce a result (drained or
+            # transparently rerouted) — zero dropped
+            router.retire("r1", drain=True, timeout=60.0)
+            assert router.replica_names == ["r0"]
+            assert router._counters["replicas_retired"] == 1
+            results = [f.result(timeout=60.0) for f in futs]
+            assert len(results) == 24
+            for r in results:
+                assert np.asarray(r["logits"]).shape == (1, 10)
+            from paddle_tpu import telemetry
+            ev = telemetry.get_journal().recent(kind="fleet.retire")
+            assert ev and ev[-1]["replica"] == "r1" and ev[-1]["drain"]
+        finally:
+            router.close(drain=False)
+
+    def test_retire_unknown_and_last(self, artifact):
+        router = FleetRouter.spawn(artifact, replicas=1, workers=1)
+        try:
+            with pytest.raises(KeyError):
+                router.retire("nope")
+            with pytest.raises(ValueError):
+                router.retire("r0")   # never retire the last replica
+            assert router.replica_names == ["r0"]
+        finally:
+            router.close(drain=False)
+
+    def test_grow_rejects_duplicate_name(self, artifact):
+        router = FleetRouter.spawn(artifact, replicas=1, workers=1)
+        try:
+            with pytest.raises(ValueError):
+                router.grow("r0")
+        finally:
+            router.close(drain=False)
+
+
+# -- agent dead-children history ---------------------------------------------
+
+
+class _StubProc:
+    def __init__(self, alive):
+        self.alive = alive
+
+    def poll(self):
+        return None if self.alive else 0
+
+
+class TestAgentDeadHistory:
+    def _service(self, tmp_path, max_dead):
+        from paddle_tpu.fleet.agent import AgentService
+        return AgentService(str(tmp_path / "agent"), max_dead=max_dead)
+
+    def test_prune_evicts_oldest_dead_only(self, tmp_path):
+        svc = self._service(tmp_path, max_dead=3)
+        # interleave live and dead children, spawn order = pid order
+        for pid in range(1, 9):
+            alive = pid % 2 == 0
+            svc._procs[pid] = {"name": f"c{pid}",
+                               "proc": _StubProc(alive), "addr": ("h", pid)}
+        with svc._lock:
+            svc._prune_dead_locked()
+        # dead pids were 1,3,5,7 — the oldest (1) is evicted, the
+        # newest 3 dead are retained; live pids all survive
+        assert sorted(svc._procs) == [2, 3, 4, 5, 6, 7, 8]
+
+    def test_live_children_never_evicted_under_churn(self, tmp_path):
+        svc = self._service(tmp_path, max_dead=2)
+        live_pids = []
+        for pid in range(1, 101):
+            alive = pid % 10 == 0
+            if alive:
+                live_pids.append(pid)
+            svc._procs[pid] = {"name": f"c{pid}",
+                               "proc": _StubProc(alive), "addr": ("h", pid)}
+            with svc._lock:
+                svc._prune_dead_locked()
+            dead_now = [p for p, i in svc._procs.items()
+                        if i["proc"].poll() is not None]
+            assert len(dead_now) <= 2
+        # a hundred spawns later: every live pid is still tracked and
+        # the table is bounded to live + max_dead
+        assert [p for p in live_pids if p in svc._procs] == live_pids
+        assert len(svc._procs) == len(live_pids) + 2
+        # the retained dead are the NEWEST dead
+        dead_now = sorted(p for p, i in svc._procs.items()
+                          if i["proc"].poll() is not None)
+        assert dead_now == [98, 99]
+
+    def test_prune_noop_under_cap(self, tmp_path):
+        svc = self._service(tmp_path, max_dead=10)
+        for pid in (1, 2, 3):
+            svc._procs[pid] = {"name": f"c{pid}", "proc": _StubProc(False),
+                               "addr": ("h", pid)}
+        with svc._lock:
+            svc._prune_dead_locked()
+        assert sorted(svc._procs) == [1, 2, 3]
+
+
+# -- shipper flush jitter ----------------------------------------------------
+
+
+class TestFlushJitter:
+    def test_deterministic_and_bounded(self):
+        from paddle_tpu.telemetry.shipper import flush_jitter
+        for origin in ("r0", "r1", "host-1234", "x"):
+            for interval in (0.25, 1.0, 5.0):
+                j = flush_jitter(origin, interval)
+                assert j == flush_jitter(origin, interval)
+                assert 0.0 <= j < 0.25 * interval
+
+    def test_distinct_origins_desync(self):
+        from paddle_tpu.telemetry.shipper import flush_jitter
+        js = {flush_jitter(f"r{i}", 1.0) for i in range(8)}
+        # 8 same-interval shippers land on 8 distinct phases
+        assert len(js) == 8
+
+    def test_scales_with_interval(self):
+        from paddle_tpu.telemetry.shipper import flush_jitter
+        assert flush_jitter("r0", 2.0) == pytest.approx(
+            2.0 * flush_jitter("r0", 1.0))
+        assert flush_jitter("r0", 1.0, frac=0.5) == pytest.approx(
+            2.0 * flush_jitter("r0", 1.0, frac=0.25))
+
+    def test_shipper_instances_pick_up_jitter(self):
+        # ctor is connect-free: a bogus addr never dials until flush
+        from paddle_tpu.telemetry.shipper import Shipper, flush_jitter
+        a = Shipper("127.0.0.1:1", origin="rep-a")
+        b = Shipper("127.0.0.1:1", origin="rep-b")
+        try:
+            assert a.flush_jitter == flush_jitter("rep-a", a.flush_interval)
+            assert b.flush_jitter == flush_jitter("rep-b", b.flush_interval)
+            assert a.flush_jitter != b.flush_jitter
+        finally:
+            a.close()
+            b.close()
+
+
+# -- the drill (slow): diurnal replay, 1→N→1, zero dropped -------------------
+
+
+@pytest.mark.slow
+def test_autoscale_drill_passes():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    import fleet_drill
+    assert fleet_drill.main(["--drills", "autoscale"]) == 0

@@ -29,7 +29,7 @@ def test_priority_order_leads_with_baseline_configs():
     expect = (set(bench.TRAIN_CONFIGS) | set(bench.INFER_CONFIGS)
               | {"gpt_decode", "dispatch_overhead", "guard_overhead",
                  "quantized_allreduce", "zero_sharding", "input_pipeline",
-                 "device_cache", "serving", "serving_fleet",
+                 "device_cache", "serving", "serving_fleet", "autoscale",
                  "fusion_profile", "elastic_reshard"})
     assert set(names) == expect and len(names) == len(expect)
 
@@ -457,6 +457,82 @@ def test_serving_fleet_quick_overrides(monkeypatch):
     bench._run_one("serving_fleet", 1.0, quick=True)
     assert seen == {"requests": 60, "replicas": 2}
     assert bench._result_key("serving_fleet") == "serving_fleet"
+
+
+def test_autoscale_quick_overrides(monkeypatch):
+    seen = {}
+    monkeypatch.setattr(bench, "bench_autoscale",
+                        lambda peak, **kw: seen.update(kw) or {"v": 1})
+    bench._run_one("autoscale", 1.0, quick=True)
+    assert seen == {"low_s": 0.8, "burst_s": 1.5, "max_replicas": 2}
+    assert bench._result_key("autoscale") == "autoscale"
+
+
+def test_autoscale_row_schema(monkeypatch):
+    """The autoscale row (closed-loop autoscaler vs statically
+    peak-provisioned fleet over the same diurnal curve) pins its
+    schema: rounds are compared by the p99 + worker-seconds-per-1k +
+    SLO-attainment cells, so the keys must not drift. Artifact/front/
+    driver/variant-runner are stubbed — the assembly math is pure
+    python."""
+
+    class _Front:
+        def close(self, drain=True, timeout=None):
+            pass
+
+    monkeypatch.setattr(bench, "_fleet_artifact",
+                        lambda bs: ("DIR", {"x": 1}))
+    monkeypatch.setattr(
+        bench, "_make_fleet_front",
+        lambda dirname, variant, replicas, workers, queue_size,
+        max_wait_ms: _Front())
+    # one replica's measured coalesced capacity: 500 rps
+    monkeypatch.setattr(bench, "_saturation_probe",
+                        lambda front, feed, n=128, inflight=16: 500.0)
+    info_by_variant = {
+        "fixed": {"provisioned": 3, "peak_replicas": 3},
+        "autoscaled": {"provisioned": 1, "peak_replicas": 3,
+                       "scale_ups": 2, "scale_downs": 2},
+    }
+    # fixed burns 3 workers the whole elapsed 10s; autoscaled 16 ws
+    ws_by_variant = {"fixed": 30.0, "autoscaled": 16.0}
+    lat_by_variant = {"fixed": 0.004, "autoscaled": 0.006}
+
+    def run_variant(dirname, variant, max_replicas, workers, queue_size,
+                    max_wait_ms, feed, phases):
+        n = sum(k for k, _ in phases)
+        return ([lat_by_variant[variant]] * n, 0, 10.0,
+                ws_by_variant[variant], info_by_variant[variant])
+
+    monkeypatch.setattr(bench, "_run_autoscale_variant", run_variant)
+    row = bench.bench_autoscale(1.0, batch_size=8, low_s=2.0, burst_s=4.0,
+                                max_replicas=3, workers=1, queue_size=4,
+                                max_wait_ms=2.0, slo_ms=50.0)
+    for key in ("value", "unit", "latency_ms", "worker_seconds_per_1k",
+                "slo_attainment", "slo_ms", "reject_rate", "scale",
+                "offered_rps", "phases", "requests", "max_replicas",
+                "workers", "queue_size", "batch_size", "max_wait_ms"):
+        assert key in row, key
+    variants = {"fixed", "autoscaled"}
+    for per_variant in ("latency_ms", "worker_seconds_per_1k",
+                        "slo_attainment", "reject_rate", "scale"):
+        assert set(row[per_variant]) == variants, per_variant
+    for v in row["latency_ms"].values():
+        assert set(v) == {"p50", "p99"}
+    assert row["value"] == row["latency_ms"]["autoscaled"]["p99"] == 6.0
+    # curve: low = 0.4 * 500 = 200 rps for 2s (400 reqs) twice, burst
+    # = 2.5 * 500 = 1250 rps for 4s (5000 reqs)
+    assert row["offered_rps"] == {"low": 200.0, "burst": 1250.0}
+    assert row["requests"] == 400 + 5000 + 400
+    # worker-seconds per 1k completed: ws / n * 1000
+    assert row["worker_seconds_per_1k"]["fixed"] == round(
+        30.0 / 5800 * 1000, 2)
+    assert row["worker_seconds_per_1k"]["autoscaled"] == round(
+        16.0 / 5800 * 1000, 2)
+    # 4/6ms latencies both inside the 50ms SLO
+    assert row["slo_attainment"] == {"fixed": 1.0, "autoscaled": 1.0}
+    assert row["scale"]["autoscaled"]["scale_ups"] == 2
+    assert row["scale"]["fixed"]["provisioned"] == 3
 
 
 def test_serving_fleet_row_schema(monkeypatch):

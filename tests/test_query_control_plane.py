@@ -1,0 +1,293 @@
+"""``GET /query`` control-plane acceptance: the range-read surface
+the autoscaler steers by. A control loop acting on a misread window
+scales a production fleet wrong, so the read side gets its own pins:
+
+  * from/to/step edge semantics (inclusive bounds, bucket stamps at
+    the bucket START, last-sample-per-bucket);
+  * downsample stability: the COMPLETE buckets of a window never
+    change when later samples land — only the trailing partial moves;
+  * a partial trailing bucket is never acted on (and IS acted on one
+    window later, once complete);
+  * an empty window yields NO verdict — the autoscaler fail-statics
+    rather than treating silence as zero load;
+  * responses stay well-formed under concurrent ingest;
+  * the HTTP endpoint 400s malformed parameters instead of guessing;
+  * HttpCollectorReader sticks to the first answering collector and
+    rotates on failure, raising only when nobody answers.
+"""
+
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from paddle_tpu import telemetry
+from paddle_tpu.fleet.autoscaler import (AutoscalePolicy, Autoscaler,
+                                         HttpCollectorReader,
+                                         LocalCollectorReader,
+                                         complete_buckets)
+from paddle_tpu.telemetry.collector import TelemetryCollector
+from paddle_tpu.telemetry.journal import RunJournal
+
+QUEUE = "paddle_tpu_serving_queue_depth"
+
+
+@pytest.fixture(autouse=True)
+def fresh_journal():
+    telemetry.set_journal(RunJournal())
+    yield
+
+
+def _snap(name, value, labels=None, type_="gauge"):
+    return {name: {"type": type_, "help": "h",
+                   "samples": [{"labels": dict(labels or {}),
+                                "value": value}]}}
+
+
+class _FakeRouter:
+    def __init__(self, names=("r0",)):
+        self.names = list(names)
+        self.grown = []
+
+    @property
+    def replica_names(self):
+        return list(self.names)
+
+    def grow(self, name=None):
+        name = name or f"r{len(self.names)}"
+        self.names.append(name)
+        self.grown.append(name)
+        return name
+
+    def retire(self, name, drain=True, timeout=None):
+        self.names.remove(name)
+
+
+# -- range semantics ---------------------------------------------------------
+
+
+def test_from_to_bounds_are_inclusive():
+    with TelemetryCollector(eval_interval=3600) as col:
+        for t, v in [(10.0, 1.0), (11.0, 2.0), (12.0, 3.0), (13.0, 4.0)]:
+            col.store.ingest("r0", _snap(QUEUE, v), t=t)
+        doc = col.query(QUEUE, start=11.0, end=12.0, step=0.0)
+        (series,) = doc["series"]
+        assert [v for _, v in series["points"]] == [2.0, 3.0]
+        assert doc["from"] == 11.0 and doc["to"] == 12.0
+
+
+def test_step_buckets_stamp_at_bucket_start_last_sample_wins():
+    with TelemetryCollector(eval_interval=3600) as col:
+        # two samples inside one bucket: the newer one represents it
+        for t, v in [(10.1, 1.0), (10.4, 7.0), (11.2, 3.0)]:
+            col.store.ingest("r0", _snap(QUEUE, v), t=t)
+        doc = col.query(QUEUE, start=10.0, end=12.0, step=1.0)
+        (series,) = doc["series"]
+        assert series["points"] == [[10.0, 7.0], [11.0, 3.0]]
+
+
+def test_downsample_stability_under_later_appends():
+    with TelemetryCollector(eval_interval=3600) as col:
+        for t, v in [(10.2, 1.0), (11.3, 2.0)]:
+            col.store.ingest("r0", _snap(QUEUE, v), t=t)
+
+        def complete(to):
+            doc = col.query(QUEUE, start=10.0, end=to, step=1.0)
+            (series,) = doc["series"]
+            return complete_buckets(series["points"], 1.0, to)
+
+        first = complete(11.5)            # bucket [11,12) still partial
+        assert first == [(10.0, 1.0)]
+        # a later sample lands in the (previously partial) bucket: the
+        # already-complete buckets are byte-identical, only the
+        # trailing partial moved
+        col.store.ingest("r0", _snap(QUEUE, 9.0), t=11.8)
+        assert complete(11.5) == first
+        assert complete(12.0) == [(10.0, 1.0), (11.0, 9.0)]
+
+
+def test_step_zero_returns_raw_points():
+    with TelemetryCollector(eval_interval=3600) as col:
+        pts = [(10.0, 1.0), (10.1, 2.0), (10.2, 3.0)]
+        for t, v in pts:
+            col.store.ingest("r0", _snap(QUEUE, v), t=t)
+        doc = col.query(QUEUE, start=0.0, end=20.0, step=0.0)
+        (series,) = doc["series"]
+        assert [(t, v) for t, v in series["points"]] == pts
+
+
+def test_label_matchers_select_series():
+    with TelemetryCollector(eval_interval=3600) as col:
+        col.store.ingest("a", _snap(QUEUE, 1.0, {"inst": "0"}), t=10.0)
+        col.store.ingest("b", _snap(QUEUE, 2.0, {"inst": "0"}), t=10.0)
+        doc = col.query(QUEUE, {"origin": "b"}, start=0.0, end=20.0)
+        (series,) = doc["series"]
+        assert series["labels"]["origin"] == "b"
+        assert [v for _, v in series["points"]] == [2.0]
+
+
+# -- verdict rules the autoscaler rides on -----------------------------------
+
+
+def test_empty_window_is_no_verdict_not_zero_load():
+    with TelemetryCollector(eval_interval=3600) as col:
+        # data exists, just not IN the queried window
+        col.store.ingest("r0", _snap(QUEUE, 9.0), t=10.0)
+        doc = col.query(QUEUE, start=100.0, end=105.0, step=1.0)
+        (series,) = doc["series"]
+        assert series["points"] == []
+        # ...and through the autoscaler that reads as fail-static, not
+        # as "queue is 0, scale down"
+        router = _FakeRouter(["r0", "r1"])
+        sc = Autoscaler(router, LocalCollectorReader(col),
+                        AutoscalePolicy(down_window_s=0.0,
+                                        down_cooldown_s=0.0,
+                                        flap_guard_s=0.0),
+                        trend_window_s=5.0, trend_step_s=1.0,
+                        stale_after_s=2.0)
+        try:
+            d = sc.tick(now=105.0)
+            assert (d.action, d.reason) == ("hold", "fail-static")
+            assert router.replica_names == ["r0", "r1"]
+        finally:
+            sc.close()
+
+
+def test_partial_bucket_never_acted_on_until_complete():
+    with TelemetryCollector(eval_interval=3600) as col:
+        router = _FakeRouter(["r0"])
+        sc = Autoscaler(router, LocalCollectorReader(col),
+                        AutoscalePolicy(max_replicas=3,
+                                        up_queue_per_replica=2.0,
+                                        up_window_s=0.0, up_cooldown_s=0.0),
+                        trend_window_s=5.0, trend_step_s=2.0,
+                        stale_after_s=10.0)
+        try:
+            # one scorching sample, but its bucket [t0+5, t0+7) spills
+            # past the window's to=t0+6: a trailing PARTIAL bucket
+            t0 = 1000.0
+            col.store.ingest("r0", _snap(QUEUE, 50.0), t=t0 + 5.5)
+            s = sc.signals(now=t0 + 6.0)
+            assert s.data_ok is True          # fresh — just no verdict
+            assert s.queue_per_replica is None
+            assert sc.tick(now=t0 + 6.0).action == "hold"
+            assert router.grown == []
+            # one window later the same sample's bucket is complete:
+            # NOW it gates, and it scales
+            d = sc.tick(now=t0 + 8.0)
+            assert (d.action, d.reason) == ("up", "trend-sustained")
+            assert router.grown == ["r1"]
+        finally:
+            sc.close()
+
+
+# -- concurrency -------------------------------------------------------------
+
+
+def test_query_stays_well_formed_under_concurrent_ingest():
+    with TelemetryCollector(eval_interval=3600) as col:
+        n_per, origins = 60, ("a", "b", "c")
+        stop = threading.Event()
+        errs = []
+
+        def writer(origin):
+            try:
+                for i in range(n_per):
+                    col.store.ingest(origin, _snap(QUEUE, float(i)),
+                                     t=100.0 + i * 0.25)
+            except Exception as e:  # pragma: no cover - the assert below
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer, args=(o,))
+                   for o in origins]
+        for th in threads:
+            th.start()
+        try:
+            # hammer range reads (stepped and raw) while writers run
+            for _ in range(40):
+                for step in (0.0, 1.0):
+                    doc = col.query(QUEUE, start=100.0, end=200.0,
+                                    step=step)
+                    for series in doc["series"]:
+                        ts = [t for t, _ in series["points"]]
+                        assert ts == sorted(ts)       # time-ordered
+                        if step:                      # aligned stamps
+                            assert all((t - 100.0) % step == 0
+                                       for t in ts)
+        finally:
+            stop.set()
+            for th in threads:
+                th.join(10)
+        assert not errs
+        # quiesced: every write is visible, per-origin, in order
+        doc = col.query(QUEUE, start=100.0, end=200.0, step=0.0)
+        assert len(doc["series"]) == len(origins)
+        for series in doc["series"]:
+            assert [v for _, v in series["points"]] == \
+                [float(i) for i in range(n_per)]
+
+
+# -- the HTTP endpoint -------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as r:
+        return json.loads(r.read())
+
+
+def test_http_query_param_edges():
+    with TelemetryCollector(eval_interval=3600) as col:
+        col.store.ingest("r0", _snap(QUEUE, 4.0), t=10.5)
+        srv = col.serve_http()
+        base = srv.url + "/query"
+        doc = _get(base + f"?metric={QUEUE}&from=10.0&to=11.0&step=1.0")
+        assert doc["metric"] == QUEUE
+        assert doc["from"] == 10.0 and doc["to"] == 11.0
+        assert doc["step"] == 1.0
+        (series,) = doc["series"]
+        assert series["points"] == [[10.0, 4.0]]
+        # to= empty string means "now"
+        doc = _get(base + f"?metric={QUEUE}&from=0.0&to=&step=0")
+        assert doc["series"]
+        # missing metric and unparsable floats are 400s, not guesses
+        for bad in ("", "?metric=&from=1", f"?metric={QUEUE}&from=abc",
+                    f"?metric={QUEUE}&to=abc", f"?metric={QUEUE}&step=abc"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + bad)
+            assert ei.value.code == 400
+
+
+def test_http_reader_failover_and_exhaustion():
+    col_a = TelemetryCollector(eval_interval=3600)
+    col_b = TelemetryCollector(eval_interval=3600)
+    try:
+        col_a.store.ingest("ra", _snap(QUEUE, 1.0), t=10.0)
+        col_b.store.ingest("rb", _snap(QUEUE, 2.0), t=10.0)
+        srv_a = col_a.serve_http()
+        srv_b = col_b.serve_http()
+        reader = HttpCollectorReader([srv_a.url, srv_b.url], timeout=2.0)
+        doc = reader.query(QUEUE, start=0.0, end=20.0)
+        assert doc["series"][0]["labels"]["origin"] == "ra"   # sticky #1
+        assert set(reader.alerts()) >= {"firing", "pending"}
+        # primary dies: the read fails over to the standby URL
+        srv_a.close()
+        doc = reader.query(QUEUE, start=0.0, end=20.0)
+        assert doc["series"][0]["labels"]["origin"] == "rb"
+        # ...and sticks there (no flapping back through the corpse)
+        assert reader._i == 1
+        # everybody dead: a typed ConnectionError, the autoscaler's
+        # fail-static trigger
+        srv_b.close()
+        with pytest.raises(ConnectionError):
+            reader.query(QUEUE, start=0.0, end=20.0)
+        with pytest.raises(ConnectionError):
+            reader.alerts()
+    finally:
+        col_a.close()
+        col_b.close()

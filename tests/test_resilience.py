@@ -535,3 +535,92 @@ def test_fit_surfaces_reader_exception():
         pt.fit(tr, reader, num_epochs=1, feed_names=["x", "label"],
                dtypes=["float32", "int64"])
     assert tr.global_step == 2  # good batches trained, then loud abort
+
+
+# -- scheduled elastic resize (the autoscaler's trainer-side analog) ---------
+
+
+def test_resize_request_file_watch_and_consume(tmp_path):
+    path = str(tmp_path / "resize.json")
+    rz = resilience.ResizeRequest(path)
+    assert not rz.requested
+    rz.request({"dp": 4})
+    assert rz.requested
+    assert rz.target == {"dp": 4}
+    # garbage body: still a bare "resize now" kick, target reads {}
+    with open(path, "w") as f:
+        f.write("not json")
+    assert rz.requested and rz.target == {}
+    with open(path, "w") as f:
+        f.write("[1, 2]")   # parses, but not a dict
+    assert rz.target == {}
+    rz.request({"dp": 2})
+    assert rz.consume() == {"dp": 2}
+    assert not rz.requested and not os.path.exists(path)
+    assert rz.consume() == {}    # idempotent
+
+
+def test_fit_resize_boundary_checkpoint_and_clean_exit(tmp_path):
+    from paddle_tpu import telemetry
+
+    cfg = pt.CheckpointConfig(str(tmp_path / "ck"), epoch_interval=0,
+                              step_interval=0, max_num_checkpoints=3)
+    rz = resilience.ResizeRequest(str(tmp_path / "resize.json"))
+    events = []
+
+    def handler(e):
+        events.append(e)
+        if e.kind == "end_step" and e.step == 5:
+            rz.request({"dp": 2})    # the scheduler drops the file
+
+    def _resizes():
+        fam = telemetry.get_registry().snapshot().get(
+            "paddle_tpu_trainer_resizes_total")
+        return sum(s["value"] for s in fam["samples"]) if fam else 0
+
+    before = _resizes()
+    tr = _fit(_trainer(), cfg, handler=handler, resize=rz)
+    assert tr.global_step == 5                     # clean return, no raise
+    assert events[-1].kind == "resized"
+    assert _resizes() == before + 1
+    ev = telemetry.get_journal().recent(kind="fit.resized")
+    assert ev and ev[-1]["global_step"] == 5
+    assert ev[-1]["target"] == {"dp": 2}
+    # the boundary checkpoint is there for the post-resize relaunch
+    ckpts = resilience.list_checkpoints(str(tmp_path / "ck"))
+    assert [c.global_step for c in ckpts] == [5]
+    # the launcher acts, consumes, relaunches: the consumed request
+    # cannot re-trigger, so the resumed fit runs to completion
+    assert rz.consume() == {"dp": 2}
+    tr2 = _trainer()
+    assert resilience.restore_latest(str(tmp_path / "ck"), tr2) is not None
+    assert tr2.global_step == 5
+    tr2 = _fit(tr2, cfg, handler=None, resize=rz)
+    assert tr2.global_step == 5 + 2 * N_BATCHES
+
+
+def test_sigterm_wins_over_concurrent_resize(tmp_path):
+    """A real preemption must never be reported as a planned resize:
+    when both land in the same chunk, the SIGTERM verdict wins."""
+    from paddle_tpu import telemetry
+
+    cfg = pt.CheckpointConfig(str(tmp_path), epoch_interval=0,
+                              step_interval=0, max_num_checkpoints=3)
+    # the path form of resize= (fit wraps it in a ResizeRequest)
+    path = str(tmp_path / "resize.json")
+    events = []
+
+    def handler(e):
+        events.append(e.kind)
+        if e.kind == "end_step" and e.step == 5:
+            resilience.ResizeRequest(path).request({"dp": 2})
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    j0 = len(telemetry.get_journal().recent(kind="fit.resized"))
+    tr = _fit(_trainer(), cfg, handler=handler, resize=path)
+    assert tr.global_step == 5
+    assert events[-1] == "preempted"
+    assert len(telemetry.get_journal().recent(kind="fit.resized")) == j0
+    # the boundary checkpoint still happened (preemption flow)
+    assert [c.global_step
+            for c in resilience.list_checkpoints(str(tmp_path))] == [5]

@@ -747,7 +747,10 @@ def test_e2e_trainer_and_remote_replica_one_collector(
         eval_interval=0.1, origin_expiry_s=5.0)
     monkeypatch.setenv("PDTPU_TELEMETRY_ADDR", f"{col.host}:{col.port}")
     monkeypatch.setenv("PDTPU_TELEMETRY_FLUSH_S", "0.1")
-    my_origin = f"pid-{os.getpid()}"
+    # origins are <host>-<pid> (the cross-host contract); the replica is
+    # spawned on THIS host, so it shares the hostname prefix
+    my_origin = tshipper.default_origin()
+    host_prefix = my_origin.rsplit("-", 1)[0]
     rep = None
     try:
         # the trainer's constructor auto-ships this process
@@ -764,7 +767,7 @@ def test_e2e_trainer_and_remote_replica_one_collector(
             artifact["dir"], remote_kw=dict(probe_timeout=0.5,
                                             down_cooldown=0.4),
             workers=1, golden_feed=artifact["feed8"])
-        rep_origin = f"pid-{rep.proc.pid}"
+        rep_origin = f"{host_prefix}-{rep.proc.pid}"
         feed1 = {k: np.asarray(v)[:1] for k, v in artifact["feed8"].items()}
         pending = rep.submit(feed1)
         pending.result(timeout=60)

@@ -227,9 +227,13 @@ def test_standby_joins_mid_retention_gets_contiguous_suffix(
                                  store_dir=pd, segment_max_bytes=700,
                                  retention_bytes=6000, retention_s=3600)
     j = RunJournal()
+    # a PRIVATE empty registry: each push's snapshot record must stay
+    # small and constant-size, or the 6000-byte retention budget below
+    # measures whatever metrics earlier tests left in the process-global
+    # registry instead of this test's tick history
     sh = tshipper.Shipper(f"{primary.host}:{primary.port}", origin="o1",
                           journal=j, flush_interval=3600,
-                          client_timeout=2.0)
+                          client_timeout=2.0, registry=MetricsRegistry())
     standby = None
     try:
         _ship_ticks(sh, j, 0, 60)

@@ -1124,11 +1124,202 @@ def drill_host_kill(root, replicas, requests):
     return violations
 
 
+def drill_autoscale(root, replicas, requests):
+    """Diurnal-load replay over the CLOSED LOOP: a 1-replica fleet +
+    the telemetry autoscaler ride a low → 3x-burst → low curve. The
+    contract: the fleet scales 1→N on the burst (trend and/or alert
+    triggered — both trigger paths are unit-pinned; here the loop just
+    has to scale), FREEZES (fail-static) when the telemetry stream
+    goes dark mid-decision, resumes off the promoted standby after the
+    primary collector is SIGKILLed, drains back to 1 on the fade — and
+    not ONE accepted request is dropped anywhere in the swing."""
+    import json as _json
+    import signal as _signal
+
+    from paddle_tpu.fleet.autoscaler import (
+        AutoscalePolicy, Autoscaler, HttpCollectorReader)
+    from paddle_tpu.telemetry import alerts, get_journal
+    from paddle_tpu.telemetry import collector as tcollector
+    from paddle_tpu.telemetry import shipper as tshipper
+
+    dirname, feed = _build_artifact(root, name="model_autoscale")
+    store_dir = os.path.join(root, "autoscale_store")
+    rules_path = os.path.join(root, "autoscale_rules.json")
+    # the page the autoscaler treats as an immediate scale trigger: a
+    # replica queue holding >3 deep for 0.3s
+    with open(rules_path, "w") as f:
+        _json.dump([{"name": "autoscale_queue", "severity": "page",
+                     "expr": "paddle_tpu_serving_queue_depth > 3 "
+                             "for 0.3s"}], f)
+    primary = tcollector.CollectorProcess(
+        rules_path=rules_path, store_dir=store_dir,
+        args=("--eval-interval", "0.1", "--origin-expiry", "60"))
+    standby = tcollector.TelemetryCollector(
+        rules=alerts.load_rules(rules_path), eval_interval=0.1,
+        origin_expiry_s=60.0, store_dir=store_dir, standby=True)
+    standby_http = standby.serve_http(port=0)
+    addr_list = (f"{primary.host}:{primary.port},"
+                 f"{standby.host}:{standby.port}")
+    # the drill attaches its shipper EXPLICITLY (fail-static needs a
+    # deterministic stop/re-attach): clear the env default so the
+    # router ctor's auto-ship can't race it
+    prev_addr = os.environ.pop("PDTPU_TELEMETRY_ADDR", None)
+    prev_origin = os.environ.pop("PDTPU_TELEMETRY_ORIGIN", None)
+    router = None
+    scaler = None
+    sub = None
+    violations = []
+    all_pending = []
+    try:
+        router = _spawn_fleet(dirname, feed, 1)
+        tshipper.ship_to(addr_list, flush_interval=0.1,
+                         snapshot_interval=0.15, client_timeout=1.0)
+        policy = AutoscalePolicy(
+            min_replicas=1, max_replicas=3, quorum=1,
+            up_queue_per_replica=2.0, down_queue_per_replica=0.5,
+            up_window_s=0.5, down_window_s=2.0,
+            up_cooldown_s=1.5, down_cooldown_s=0.7, flap_guard_s=0.5)
+        scaler = Autoscaler(
+            router, HttpCollectorReader([primary.http_url,
+                                         standby_http.url]),
+            policy, interval=0.15, trend_window_s=4.0, trend_step_s=0.4,
+            stale_after_s=1.0, alert_rules=["autoscale_queue"],
+            retire_timeout=60.0)
+        rate = _saturation_rate(router, feed)   # 3x ONE replica
+        # live-capture the scaler's journal events: the serving drive
+        # emits thousands of events, so the ring has long since evicted
+        # autoscale.* by the time the drill asserts on them
+        scale_events = []
+        sub = get_journal().subscribe(
+            lambda e: scale_events.append(e)
+            if e["kind"].startswith("autoscale.") else None)
+        scaler.start()
+
+        def _drive_phase(seconds, frac, label):
+            n = max(8, min(3000, int(rate * frac * seconds)))
+            pending, rejected = _drive(router, feed, n, rate * frac)
+            all_pending.extend(pending)
+            print(f"  autoscale[{label}]: accepted={len(pending)} "
+                  f"shed={rejected} replicas={len(router.replica_names)}")
+
+        # phase A — steady low load: the loop must HOLD at 1. Well
+        # under one replica's capacity — the saturation estimate is
+        # open-loop and optimistic, so leave real headroom or the
+        # "steady" queue builds past the trend threshold on its own.
+        _drive_phase(2.0, 1.0 / 20.0, "steady")
+        if len(router.replica_names) != 1:
+            violations.append(
+                f"scaled during steady low load "
+                f"(replicas={router.replica_names})")
+
+        # phase B — the burst at ~3x one replica's capacity: queue
+        # builds, the rule pages, the loop must scale up
+        _drive_phase(4.0, 1.0, "burst")
+        deadline = time.monotonic() + 12
+        while time.monotonic() < deadline and \
+                len(router.replica_names) < 2:
+            time.sleep(0.1)
+        grown = len(router.replica_names)
+        if grown < 2:
+            violations.append(
+                f"burst did not scale the fleet up within 12s "
+                f"(replicas={router.replica_names}, "
+                f"counters={scaler.counters()})")
+        up_reasons = sorted({e.get("reason") for e in scale_events
+                             if e["kind"] == "autoscale.up"})
+        print(f"  autoscale: grew to {grown} (up_reasons={up_reasons})")
+
+        # phase C — fail-static: the shipper stops (telemetry goes
+        # dark) -> the loop must FREEZE, not scale on the gap
+        tshipper.stop_shipping()
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline and \
+                not scaler.counters()["holds"].get("fail-static"):
+            time.sleep(0.1)
+        if not scaler.counters()["holds"].get("fail-static"):
+            violations.append(
+                "autoscaler never recorded a fail-static hold within 8s "
+                f"of the telemetry stream stopping "
+                f"(counters={scaler.counters()})")
+        frozen_at = len(router.replica_names)
+        time.sleep(1.5)
+        if len(router.replica_names) != frozen_at:
+            violations.append(
+                f"fleet resized on stale telemetry "
+                f"({frozen_at} -> {len(router.replica_names)})")
+
+        # the collector itself dies mid-gap; shipping resumes on the
+        # failover list, the standby promotes off the shared log, and
+        # the loop's reads fail over to the standby's HTTP endpoint
+        os.kill(primary.pid, _signal.SIGKILL)
+        tshipper.ship_to(addr_list, flush_interval=0.1,
+                         snapshot_interval=0.15, client_timeout=1.0)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and standby.is_standby:
+            time.sleep(0.1)
+        if standby.is_standby:
+            violations.append("standby never promoted within 20s of the "
+                              "primary SIGKILL")
+            return violations
+
+        # phase D — the fade: low load again, decisions now served by
+        # the promoted standby; the loop must drain back to 1
+        _drive_phase(3.0, 1.0 / 6.0, "fade")
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and \
+                len(router.replica_names) > 1:
+            time.sleep(0.1)
+        if len(router.replica_names) != 1:
+            violations.append(
+                f"fade did not drain the fleet back to 1 within 20s "
+                f"(replicas={router.replica_names}, "
+                f"counters={scaler.counters()})")
+
+        # the whole swing: every ACCEPTED request resolved (retires
+        # drained; ServerClosed/untyped would be a dropped accept)
+        outcomes, dropped = _collect(all_pending)
+        # retire POPS the replica from routing up front (the size poll
+        # above sees 1 immediately) but stamps scale_downs only when
+        # the drained close COMPLETES — queue drain + worker/watchdog
+        # joins take real seconds, so wait for completion here
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and \
+                scaler.counters()["scale_downs"] < 1:
+            time.sleep(0.1)
+        c = scaler.counters()
+        print(f"  autoscale: outcomes={outcomes} scale_ups="
+              f"{c['scale_ups']} scale_downs={c['scale_downs']} "
+              f"holds={c['holds']}")
+        if dropped:
+            violations.append(f"dropped accepted request(s) across the "
+                              f"swing: {dropped[:3]}")
+        if c["scale_ups"] < 1:
+            violations.append(f"no scale-up recorded (counters={c})")
+        if c["scale_downs"] < 1:
+            violations.append(f"no drained scale-down recorded "
+                              f"(counters={c})")
+    finally:
+        if prev_addr is not None:
+            os.environ["PDTPU_TELEMETRY_ADDR"] = prev_addr
+        if prev_origin is not None:
+            os.environ["PDTPU_TELEMETRY_ORIGIN"] = prev_origin
+        if sub is not None:
+            get_journal().unsubscribe(sub)
+        if scaler is not None:
+            scaler.close()
+        if router is not None:
+            router.close(drain=False, timeout=10)
+        tshipper.stop_shipping()
+        standby.close()
+        primary.kill()
+    return violations
+
+
 DRILLS = {"kill": drill_kill, "hang": drill_hang, "reload": drill_reload,
           "pkill": drill_pkill, "partition": drill_partition,
           "alert": drill_alert,
           "collector_failover": drill_collector_failover,
-          "host_kill": drill_host_kill}
+          "host_kill": drill_host_kill, "autoscale": drill_autoscale}
 
 
 def main(argv=None) -> int:
@@ -1138,11 +1329,13 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=90)
     ap.add_argument("--drills", default="kill,hang,reload",
                     help="comma list from: kill,hang,reload,pkill,"
-                         "partition,alert,collector_failover,host_kill "
-                         "(the last five spawn a real cross-process "
-                         "fleet; alert/collector_failover/host_kill "
-                         "also attach telemetry collectors); 'all' "
-                         "runs every drill")
+                         "partition,alert,collector_failover,host_kill,"
+                         "autoscale (pkill/partition/alert/"
+                         "collector_failover/host_kill spawn a real "
+                         "cross-process fleet; the telemetry drills "
+                         "also attach collectors; autoscale replays a "
+                         "diurnal load curve through the closed-loop "
+                         "autoscaler); 'all' runs every drill")
     args = ap.parse_args(argv)
     names = [n.strip() for n in args.drills.split(",") if n.strip()]
     if names == ["all"]:
